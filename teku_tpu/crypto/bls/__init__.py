@@ -1,0 +1,131 @@
+"""BLS facade — single entry point for all BLS operations in the framework.
+
+Mirrors the reference's static BLS facade with a pluggable provider
+(reference: infrastructure/bls/src/main/java/tech/pegasys/teku/bls/BLS.java:40-62):
+all node code calls these functions, never a provider directly, so swapping
+the pure-Python fallback for the JAX/TPU provider is one call to
+set_implementation().  Also carries the eth2-spec wrapper semantics
+(eth_aggregate_pubkeys / eth_fast_aggregate_verify empty-list rules) and the
+verification kill-switch (reference BLS.java:93 BLSConstants.verificationDisabled).
+"""
+
+from typing import List, Optional, Sequence, Tuple
+
+from .pure_impl import (G1_INFINITY, G2_INFINITY, PureBls12381, keygen,
+                        random_secret_key)
+from .spi import BLS12381, BatchSemiAggregate
+
+_IMPL: BLS12381 = PureBls12381()
+
+# Kill-switch for test scenarios where signature checking must be skipped.
+verification_disabled = False
+
+
+def set_implementation(impl: BLS12381) -> None:
+    global _IMPL
+    _IMPL = impl
+
+
+def get_implementation() -> BLS12381:
+    return _IMPL
+
+
+def reset_implementation() -> None:
+    set_implementation(PureBls12381())
+
+
+# --- keys ----------------------------------------------------------------
+
+def secret_to_public_key(secret: int) -> bytes:
+    return _IMPL.secret_key_to_public_key(secret)
+
+
+def sign(secret: int, message: bytes) -> bytes:
+    return _IMPL.sign(secret, message)
+
+
+def public_key_is_valid(public_key: bytes) -> bool:
+    return _IMPL.public_key_is_valid(public_key)
+
+
+def signature_is_valid(signature: bytes) -> bool:
+    return _IMPL.signature_is_valid(signature)
+
+
+# --- aggregation ---------------------------------------------------------
+
+def aggregate_signatures(signatures: Sequence[bytes]) -> bytes:
+    return _IMPL.aggregate_signatures(signatures)
+
+
+def aggregate_public_keys(public_keys: Sequence[bytes]) -> bytes:
+    return _IMPL.aggregate_public_keys(public_keys)
+
+
+def eth_aggregate_pubkeys(public_keys: Sequence[bytes]) -> bytes:
+    """eth2 spec eth_aggregate_pubkeys: all keys must be valid, list nonempty."""
+    if not public_keys:
+        raise ValueError("eth_aggregate_pubkeys of empty list")
+    for pk in public_keys:
+        if not _IMPL.public_key_is_valid(pk):
+            raise ValueError("invalid public key in eth_aggregate_pubkeys")
+    return _IMPL.aggregate_public_keys(public_keys)
+
+
+# --- verification --------------------------------------------------------
+
+def verify(public_key: bytes, message: bytes, signature: bytes) -> bool:
+    if verification_disabled:
+        return True
+    return _IMPL.verify(public_key, message, signature)
+
+
+def aggregate_verify(public_keys: Sequence[bytes], messages: Sequence[bytes],
+                     signature: bytes) -> bool:
+    if verification_disabled:
+        return True
+    return _IMPL.aggregate_verify(public_keys, messages, signature)
+
+
+def fast_aggregate_verify(public_keys: Sequence[bytes], message: bytes,
+                          signature: bytes) -> bool:
+    if verification_disabled:
+        return True
+    return _IMPL.fast_aggregate_verify(public_keys, message, signature)
+
+
+def eth_fast_aggregate_verify(public_keys: Sequence[bytes], message: bytes,
+                              signature: bytes) -> bool:
+    """eth2 wrapper: empty key list + infinity signature verifies (deneb rule)."""
+    if verification_disabled:
+        return True
+    if not public_keys and signature == G2_INFINITY:
+        return True
+    return _IMPL.fast_aggregate_verify(public_keys, message, signature)
+
+
+def batch_verify(
+    triples: Sequence[Tuple[Sequence[bytes], bytes, bytes]],
+) -> bool:
+    if verification_disabled:
+        return True
+    if not triples:
+        return True
+    if len(triples) == 1:
+        pks, msg, sig = triples[0]
+        return _IMPL.fast_aggregate_verify(pks, msg, sig)
+    return _IMPL.batch_verify(triples)
+
+
+def prepare_batch_verify(
+    triple: Tuple[Sequence[bytes], bytes, bytes]
+) -> Optional[BatchSemiAggregate]:
+    return _IMPL.prepare_batch_verify(triple)
+
+
+def complete_batch_verify(
+    semi_aggregates: Sequence[Optional[BatchSemiAggregate]]
+) -> bool:
+    if verification_disabled:
+        return True
+    return _IMPL.complete_batch_verify(semi_aggregates)

@@ -1,0 +1,133 @@
+"""Optimal ate pairing on BLS12-381 (pure Python oracle).
+
+The oracle favours clarity over speed: the Miller loop runs in affine
+coordinates directly in Fq12 after untwisting the G2 point, so there is no
+twist-type case analysis and no sparse-multiplication trickery.  Subfield
+factors (line denominators, sign conventions) are killed by the final
+exponentiation, which is why they are elided.
+
+This is the correctness reference for the batched JAX Miller-loop kernel in
+teku_tpu/ops/pairing.py.  Reference client equivalent: native blst pairing
+behind infrastructure/bls/.../impl/blst/BlstBLS12381.java:124-189.
+"""
+
+from typing import List, Optional, Tuple
+
+from . import fields as F
+from .constants import P, R, X_ABS
+
+# ---------------------------------------------------------------------------
+# Embeddings into Fq12
+# ---------------------------------------------------------------------------
+
+
+def fq_to_fq12(a: int):
+    return (((a % P, 0), F.FQ2_ZERO, F.FQ2_ZERO), F.FQ6_ZERO)
+
+
+def fq2_to_fq12(a):
+    return ((a, F.FQ2_ZERO, F.FQ2_ZERO), F.FQ6_ZERO)
+
+
+# w = (0, (1, 0, 0)) in our tower; w^2 = v, w^6 = xi.
+FQ12_W = (F.FQ6_ZERO, F.FQ6_ONE)
+FQ12_W2 = F.fq12_mul(FQ12_W, FQ12_W)
+FQ12_W3 = F.fq12_mul(FQ12_W2, FQ12_W)
+FQ12_W2_INV = F.fq12_inv(FQ12_W2)
+FQ12_W3_INV = F.fq12_inv(FQ12_W3)
+
+
+def untwist(q_affine) -> Tuple:
+    """Map an affine G2 point on E'(Fq2) to E(Fq12): (x/w^2, y/w^3)."""
+    x, y = q_affine
+    return (F.fq12_mul(fq2_to_fq12(x), FQ12_W2_INV),
+            F.fq12_mul(fq2_to_fq12(y), FQ12_W3_INV))
+
+
+# ---------------------------------------------------------------------------
+# Miller loop (affine, Fq12)
+# ---------------------------------------------------------------------------
+
+_X_BITS = bin(X_ABS)[3:]  # bits below the MSB, as '0'/'1' chars
+
+
+def _line_eval(lam, a, p):
+    """(y_P - y_A) - lam * (x_P - x_A), all in Fq12."""
+    ax, ay = a
+    px, py = p
+    return F.fq12_sub(F.fq12_sub(py, ay),
+                      F.fq12_mul(lam, F.fq12_sub(px, ax)))
+
+
+def _affine_double(t):
+    x, y = t
+    x2 = F.fq12_sqr(x)
+    lam = F.fq12_mul(F.fq12_add(F.fq12_add(x2, x2), x2),
+                     F.fq12_inv(F.fq12_add(y, y)))
+    x3 = F.fq12_sub(F.fq12_sqr(lam), F.fq12_add(x, x))
+    y3 = F.fq12_sub(F.fq12_mul(lam, F.fq12_sub(x, x3)), y)
+    return lam, (x3, y3)
+
+
+def _affine_add(t, q):
+    tx, ty = t
+    qx, qy = q
+    lam = F.fq12_mul(F.fq12_sub(ty, qy), F.fq12_inv(F.fq12_sub(tx, qx)))
+    x3 = F.fq12_sub(F.fq12_sub(F.fq12_sqr(lam), tx), qx)
+    y3 = F.fq12_sub(F.fq12_mul(lam, F.fq12_sub(tx, x3)), ty)
+    return lam, (x3, y3)
+
+
+def miller_loop(p_affine: Optional[Tuple[int, int]],
+                q_affine: Optional[Tuple]) -> Tuple:
+    """Miller loop of the optimal ate pairing.
+
+    p_affine: affine G1 point (x, y) as ints, or None for infinity.
+    q_affine: affine G2 point ((x0,x1),(y0,y1)) in Fq2, or None for infinity.
+    Returns an Fq12 element (un-exponentiated).
+    """
+    if p_affine is None or q_affine is None:
+        return F.FQ12_ONE
+    p12 = (fq_to_fq12(p_affine[0]), fq_to_fq12(p_affine[1]))
+    q12 = untwist(q_affine)
+    t = q12
+    f = F.FQ12_ONE
+    for c in _X_BITS:
+        # tangent line at the *current* T, evaluated at P
+        prev = t
+        lam, t = _affine_double(t)
+        f = F.fq12_mul(F.fq12_sqr(f), _line_eval(lam, prev, p12))
+        if c == "1":
+            prev = t
+            lam, t = _affine_add(t, q12)
+            f = F.fq12_mul(f, _line_eval(lam, prev, p12))
+    # BLS parameter x is negative: conjugate.
+    return F.fq12_conj(f)
+
+
+# ---------------------------------------------------------------------------
+# Final exponentiation
+# ---------------------------------------------------------------------------
+
+_HARD_EXP = (P ** 4 - P ** 2 + 1) // R
+
+
+def final_exponentiation(f) -> Tuple:
+    # easy part: f^((p^6 - 1)(p^2 + 1))
+    g = F.fq12_mul(F.fq12_conj(f), F.fq12_inv(f))
+    g = F.fq12_mul(F.fq12_frobenius(g, 2), g)
+    # hard part: g^((p^4 - p^2 + 1) / r)
+    return F.fq12_pow(g, _HARD_EXP)
+
+
+def pairing(p_affine, q_affine) -> Tuple:
+    """Full pairing e(P, Q): final_exponentiation(miller_loop(P, Q))."""
+    return final_exponentiation(miller_loop(p_affine, q_affine))
+
+
+def multi_pairing(pairs: List[Tuple]) -> Tuple:
+    """prod_i e(P_i, Q_i) with a single shared final exponentiation."""
+    f = F.FQ12_ONE
+    for p_affine, q_affine in pairs:
+        f = F.fq12_mul(f, miller_loop(p_affine, q_affine))
+    return final_exponentiation(f)

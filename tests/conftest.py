@@ -1,0 +1,15 @@
+"""Test configuration.
+
+Tests run on the XLA CPU backend with 8 virtual devices so multi-chip
+sharding paths (jax.sharding.Mesh over ICI in production) are exercised
+without TPU hardware, per the project's multi-chip test strategy.
+Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()

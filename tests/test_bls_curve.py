@@ -1,0 +1,151 @@
+"""Tests for G1/G2 group law, subgroup checks and ZCash/ETH2 serialization."""
+
+import random
+
+import pytest
+
+from teku_tpu.crypto.bls import curve as C
+from teku_tpu.crypto.bls.constants import P, R
+
+rng = random.Random(99)
+
+
+def rand_g1():
+    return C.point_mul(C.FQ_OPS, rng.randrange(1, R), C.G1_GENERATOR)
+
+
+def rand_g2():
+    return C.point_mul(C.FQ2_OPS, rng.randrange(1, R), C.G2_GENERATOR)
+
+
+class TestGroupLaw:
+    @pytest.mark.parametrize("ops,gen", [
+        (C.FQ_OPS, C.G1_GENERATOR), (C.FQ2_OPS, C.G2_GENERATOR)])
+    def test_generator_on_curve_and_order(self, ops, gen):
+        assert C.is_on_curve(ops, gen)
+        assert C.is_infinity(ops, C.point_mul(ops, R, gen))
+        assert not C.is_infinity(ops, C.point_mul(ops, R - 1, gen))
+
+    @pytest.mark.parametrize("ops,gen", [
+        (C.FQ_OPS, C.G1_GENERATOR), (C.FQ2_OPS, C.G2_GENERATOR)])
+    def test_add_commutes_and_associates(self, ops, gen):
+        a = C.point_mul(ops, 7, gen)
+        b = C.point_mul(ops, 11, gen)
+        c = C.point_mul(ops, 13, gen)
+        assert C.point_eq(ops, C.point_add(ops, a, b), C.point_add(ops, b, a))
+        assert C.point_eq(ops,
+                          C.point_add(ops, C.point_add(ops, a, b), c),
+                          C.point_add(ops, a, C.point_add(ops, b, c)))
+
+    @pytest.mark.parametrize("ops,gen", [
+        (C.FQ_OPS, C.G1_GENERATOR), (C.FQ2_OPS, C.G2_GENERATOR)])
+    def test_scalar_mul_matches_repeated_add(self, ops, gen):
+        acc = C.infinity(ops)
+        for k in range(1, 8):
+            acc = C.point_add(ops, acc, gen)
+            assert C.point_eq(ops, acc, C.point_mul(ops, k, gen))
+
+    @pytest.mark.parametrize("ops,gen", [
+        (C.FQ_OPS, C.G1_GENERATOR), (C.FQ2_OPS, C.G2_GENERATOR)])
+    def test_double_equals_add_self(self, ops, gen):
+        p = C.point_mul(ops, 12345, gen)
+        assert C.point_eq(ops, C.point_double(ops, p), C.point_add(ops, p, p))
+
+    @pytest.mark.parametrize("ops,gen", [
+        (C.FQ_OPS, C.G1_GENERATOR), (C.FQ2_OPS, C.G2_GENERATOR)])
+    def test_neg_cancels(self, ops, gen):
+        p = C.point_mul(ops, 777, gen)
+        assert C.is_infinity(ops, C.point_add(ops, p, C.point_neg(ops, p)))
+
+    @pytest.mark.parametrize("ops,gen", [
+        (C.FQ_OPS, C.G1_GENERATOR), (C.FQ2_OPS, C.G2_GENERATOR)])
+    def test_infinity_is_identity(self, ops, gen):
+        p = C.point_mul(ops, 31337, gen)
+        inf = C.infinity(ops)
+        assert C.point_eq(ops, C.point_add(ops, p, inf), p)
+        assert C.point_eq(ops, C.point_add(ops, inf, p), p)
+
+    def test_mul_negative_scalar(self):
+        p = rand_g1()
+        assert C.point_eq(C.FQ_OPS, C.point_mul(C.FQ_OPS, -5, p),
+                          C.point_neg(C.FQ_OPS, C.point_mul(C.FQ_OPS, 5, p)))
+
+
+class TestSerialization:
+    def test_g1_roundtrip(self):
+        for _ in range(8):
+            p = rand_g1()
+            data = C.g1_compress(p)
+            assert len(data) == 48
+            assert data[0] & 0x80
+            assert C.point_eq(C.FQ_OPS, C.g1_decompress(data), p)
+
+    def test_g2_roundtrip(self):
+        for _ in range(8):
+            p = rand_g2()
+            data = C.g2_compress(p)
+            assert len(data) == 96
+            assert C.point_eq(C.FQ2_OPS, C.g2_decompress(data), p)
+
+    def test_infinity_roundtrip(self):
+        inf1 = bytes([0xC0] + [0] * 47)
+        assert C.g1_compress(C.infinity(C.FQ_OPS)) == inf1
+        assert C.is_infinity(C.FQ_OPS, C.g1_decompress(inf1))
+        inf2 = bytes([0xC0] + [0] * 95)
+        assert C.g2_compress(C.infinity(C.FQ2_OPS)) == inf2
+        assert C.is_infinity(C.FQ2_OPS, C.g2_decompress(inf2))
+
+    def test_known_generator_bytes(self):
+        # The canonical compressed G1 generator starts 0x97f1d3... (flags|x)
+        data = C.g1_compress(C.G1_GENERATOR)
+        assert data.hex().startswith("97f1d3a73197d794")
+
+    def test_rejects_bad_lengths(self):
+        with pytest.raises(ValueError):
+            C.g1_decompress(b"\x00" * 47)
+        with pytest.raises(ValueError):
+            C.g2_decompress(b"\x00" * 95)
+
+    def test_rejects_uncompressed_flag(self):
+        with pytest.raises(ValueError):
+            C.g1_decompress(b"\x00" * 48)
+
+    def test_rejects_x_out_of_range(self):
+        bad = bytearray((P).to_bytes(48, "big"))
+        bad[0] |= 0x80
+        with pytest.raises(ValueError):
+            C.g1_decompress(bytes(bad))
+
+    def test_rejects_not_on_curve(self):
+        # x with no square rhs: search deterministically
+        x = 5
+        from teku_tpu.crypto.bls import fields as F
+        while F.fq_sqrt((x * x % P * x + 4) % P) is not None:
+            x += 1
+        bad = bytearray(x.to_bytes(48, "big"))
+        bad[0] |= 0x80
+        with pytest.raises(ValueError):
+            C.g1_decompress(bytes(bad))
+
+    def test_rejects_non_subgroup_point(self):
+        # find a curve point with order != r (cofactor group): take a point
+        # on curve not multiple of r by hashing x until on-curve then clearing
+        from teku_tpu.crypto.bls import fields as F
+        x = 1
+        while True:
+            rhs = (x * x % P * x + 4) % P
+            y = F.fq_sqrt(rhs)
+            if y is not None:
+                p = C.from_affine(C.FQ_OPS, x, y)
+                if not C.is_infinity(C.FQ_OPS, C.point_mul(C.FQ_OPS, R, p)):
+                    break
+            x += 1
+        data = C.g1_compress(p)
+        with pytest.raises(ValueError):
+            C.g1_decompress(data)
+
+    def test_malformed_infinity_rejected(self):
+        bad = bytearray([0xC0] + [0] * 47)
+        bad[20] = 1
+        with pytest.raises(ValueError):
+            C.g1_decompress(bytes(bad))

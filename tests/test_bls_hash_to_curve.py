@@ -1,0 +1,85 @@
+"""Hash-to-G2 tests: expand_message_xmd, SSWU/isogeny, cofactor clearing."""
+
+from teku_tpu.crypto.bls import curve as C, fields as F, hash_to_curve as H
+from teku_tpu.crypto.bls.constants import DST_G2_POP, P
+
+
+class TestExpandMessageXmd:
+    def test_lengths(self):
+        for n in (32, 64, 127, 128, 255, 256):
+            out = H.expand_message_xmd(b"msg", b"DST", n)
+            assert len(out) == n
+
+    def test_deterministic_and_msg_sensitive(self):
+        a = H.expand_message_xmd(b"msg", b"DST", 64)
+        assert a == H.expand_message_xmd(b"msg", b"DST", 64)
+        assert a != H.expand_message_xmd(b"msh", b"DST", 64)
+        assert a != H.expand_message_xmd(b"msg", b"DSU", 64)
+
+    def test_length_in_domain(self):
+        # len_in_bytes is bound into b_0, so different lengths diverge fully
+        a = H.expand_message_xmd(b"msg", b"DST", 32)
+        b = H.expand_message_xmd(b"msg", b"DST", 64)
+        assert b[:32] != a
+
+
+class TestHashToField:
+    def test_in_range_and_distinct(self):
+        u = H.hash_to_field_fq2(b"some message", 2)
+        assert len(u) == 2
+        for el in u:
+            assert 0 <= el[0] < P and 0 <= el[1] < P
+        assert u[0] != u[1]
+
+
+class TestMapToCurve:
+    def test_sswu_output_on_iso_curve(self):
+        for i in range(4):
+            (u,) = H.hash_to_field_fq2(bytes([i]), 1)
+            x, y = H.map_to_curve_sswu_g2(u)
+            assert F.fq2_eq(F.fq2_sqr(y), H._gx_prime(x))
+
+    def test_iso_output_on_e2(self):
+        for i in range(4):
+            (u,) = H.hash_to_field_fq2(bytes([i]), 1)
+            p = H.iso_map_g2(H.map_to_curve_sswu_g2(u))
+            assert C.is_on_curve(C.FQ2_OPS, C.from_affine(C.FQ2_OPS, *p))
+
+
+class TestClearCofactor:
+    def test_psi_matches_h_eff(self):
+        for i in range(3):
+            (u,) = H.hash_to_field_fq2(bytes([7 + i]), 1)
+            p = C.from_affine(
+                C.FQ2_OPS, *H.iso_map_g2(H.map_to_curve_sswu_g2(u)))
+            fast = H.clear_cofactor_g2(p)
+            slow = H.clear_cofactor_g2_slow(p)
+            assert C.point_eq(C.FQ2_OPS, fast, slow)
+
+    def test_psi_is_endomorphism(self):
+        # psi(P + Q) = psi(P) + psi(Q) on the curve
+        q1 = H.hash_to_g2(b"a")
+        q2 = H.hash_to_g2(b"b")
+        lhs = H.psi(C.point_add(C.FQ2_OPS, q1, q2))
+        rhs = C.point_add(C.FQ2_OPS, H.psi(q1), H.psi(q2))
+        assert C.point_eq(C.FQ2_OPS, lhs, rhs)
+
+
+class TestHashToG2:
+    def test_in_subgroup(self):
+        for msg in (b"", b"abc", b"attestation data root"):
+            p = H.hash_to_g2(msg)
+            assert C.g2_in_subgroup(p)
+            assert not C.is_infinity(C.FQ2_OPS, p)
+
+    def test_deterministic_distinct(self):
+        p1 = H.hash_to_g2(b"m1")
+        p2 = H.hash_to_g2(b"m1")
+        p3 = H.hash_to_g2(b"m2")
+        assert C.point_eq(C.FQ2_OPS, p1, p2)
+        assert not C.point_eq(C.FQ2_OPS, p1, p3)
+
+    def test_dst_separation(self):
+        p1 = H.hash_to_g2(b"m", DST_G2_POP)
+        p2 = H.hash_to_g2(b"m", b"OTHER_DST")
+        assert not C.point_eq(C.FQ2_OPS, p1, p2)

@@ -1,0 +1,74 @@
+"""Pairing property tests: bilinearity, non-degeneracy, multi-pairing."""
+
+import random
+
+from teku_tpu.crypto.bls import curve as C, fields as F, pairing as PR
+from teku_tpu.crypto.bls.constants import R
+
+rng = random.Random(7)
+
+G1_AFF = C.to_affine(C.FQ_OPS, C.G1_GENERATOR)
+G2_AFF = C.to_affine(C.FQ2_OPS, C.G2_GENERATOR)
+E_GG = PR.pairing(G1_AFF, G2_AFF)
+
+
+def g1(k):
+    return C.to_affine(C.FQ_OPS, C.point_mul(C.FQ_OPS, k, C.G1_GENERATOR))
+
+
+def g2(k):
+    return C.to_affine(C.FQ2_OPS, C.point_mul(C.FQ2_OPS, k, C.G2_GENERATOR))
+
+
+class TestPairing:
+    def test_non_degenerate(self):
+        assert not F.fq12_is_one(E_GG)
+
+    def test_output_in_gt(self):
+        # e(G1, G2)^r == 1: output has order dividing r
+        assert F.fq12_is_one(F.fq12_pow(E_GG, R))
+
+    def test_bilinear_in_g1(self):
+        a = rng.randrange(2, 10 ** 6)
+        assert F.fq12_eq(PR.pairing(g1(a), G2_AFF), F.fq12_pow(E_GG, a))
+
+    def test_bilinear_in_g2(self):
+        b = rng.randrange(2, 10 ** 6)
+        assert F.fq12_eq(PR.pairing(G1_AFF, g2(b)), F.fq12_pow(E_GG, b))
+
+    def test_bilinear_joint(self):
+        a = rng.randrange(2, 10 ** 6)
+        b = rng.randrange(2, 10 ** 6)
+        assert F.fq12_eq(PR.pairing(g1(a), g2(b)),
+                         F.fq12_pow(E_GG, (a * b) % R))
+
+    def test_additive_in_g1(self):
+        # e(P1 + P2, Q) = e(P1,Q) e(P2,Q)
+        p1, p2 = 111, 222
+        lhs = PR.pairing(g1(p1 + p2), G2_AFF)
+        rhs = F.fq12_mul(PR.pairing(g1(p1), G2_AFF), PR.pairing(g1(p2), G2_AFF))
+        assert F.fq12_eq(lhs, rhs)
+
+    def test_infinity_pairs_to_one(self):
+        assert F.fq12_is_one(PR.pairing(None, G2_AFF))
+        assert F.fq12_is_one(PR.pairing(G1_AFF, None))
+
+    def test_multi_pairing_cancellation(self):
+        # e(aG1, G2) * e(-aG1, G2) == 1
+        a = 314159
+        neg = C.to_affine(
+            C.FQ_OPS, C.point_neg(C.FQ_OPS, C.point_mul(C.FQ_OPS, a, C.G1_GENERATOR)))
+        result = PR.multi_pairing([(g1(a), G2_AFF), (neg, G2_AFF)])
+        assert F.fq12_is_one(result)
+
+    def test_multi_pairing_verify_equation(self):
+        # The BLS verify equation: e(pk, H) * e(-G1, sig) == 1 where
+        # pk = sk*G1 and sig = sk*H for any H in G2.
+        sk = 987654321
+        h = g2(424242)  # stand-in for a hashed message point
+        pk = g1(sk)
+        sig = C.to_affine(
+            C.FQ2_OPS,
+            C.point_mul(C.FQ2_OPS, sk, C.from_affine(C.FQ2_OPS, *h)))
+        neg_g1 = C.to_affine(C.FQ_OPS, C.point_neg(C.FQ_OPS, C.G1_GENERATOR))
+        assert F.fq12_is_one(PR.multi_pairing([(pk, h), (neg_g1, sig)]))
