@@ -16,7 +16,7 @@ from typing import Any, Callable, Optional, Tuple
 
 from . import fields as F
 from .constants import (B_G1, B_G2, G1_X, G1_Y, G2_X0, G2_X1, G2_Y0, G2_Y1,
-                        H_G1, P, R, X as BLS_X)
+                        P, R)
 
 
 @dataclass(frozen=True)

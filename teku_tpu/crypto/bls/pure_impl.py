@@ -53,12 +53,11 @@ def random_secret_key() -> int:
 class _SemiAggregate(BatchSemiAggregate):
     """Miller-loop product + multiplier-weighted signature for one triple."""
 
-    __slots__ = ("ml", "weighted_sig", "valid")
+    __slots__ = ("ml", "weighted_sig")
 
-    def __init__(self, ml, weighted_sig, valid: bool):
+    def __init__(self, ml, weighted_sig):
         self.ml = ml
         self.weighted_sig = weighted_sig
-        self.valid = valid
 
 
 class PureBls12381(BLS12381):
@@ -71,10 +70,12 @@ class PureBls12381(BLS12381):
         self._pk_cache: dict = {}
         self._sig_cache: dict = {}
 
+    _MISS = object()  # cache sentinel: None is a legitimate value (infinity)
+
     def _parse_pk(self, pk: bytes):
         """Returns affine G1 point, None for infinity; raises if invalid."""
-        hit = self._pk_cache.get(pk)
-        if hit is None:
+        hit = self._pk_cache.get(pk, self._MISS)
+        if hit is self._MISS:
             point = C.g1_decompress(pk)
             hit = C.to_affine(C.FQ_OPS, point)  # None when infinity
             if len(self._pk_cache) > 100_000:
@@ -83,8 +84,8 @@ class PureBls12381(BLS12381):
         return hit
 
     def _parse_sig(self, sig: bytes):
-        hit = self._sig_cache.get(sig)
-        if hit is None:
+        hit = self._sig_cache.get(sig, self._MISS)
+        if hit is self._MISS:
             point = C.g2_decompress(sig)
             hit = C.to_affine(C.FQ2_OPS, point)
             if len(self._sig_cache) > 100_000:
@@ -210,7 +211,7 @@ class PureBls12381(BLS12381):
         else:
             weighted_sig = C.point_mul(
                 C.FQ2_OPS, r, C.from_affine(C.FQ2_OPS, *sig_aff))
-        return _SemiAggregate(ml, weighted_sig, True)
+        return _SemiAggregate(ml, weighted_sig)
 
     def complete_batch_verify(
         self, semi_aggregates: Sequence[Optional[BatchSemiAggregate]]
