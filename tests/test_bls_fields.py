@@ -146,3 +146,20 @@ class TestFq12:
         a = rand_fq12()
         assert F.fq12_eq(F.fq12_pow(a, 5),
                          F.fq12_mul(F.fq12_mul(F.fq12_mul(F.fq12_mul(a, a), a), a), a))
+
+
+def test_cyclotomic_square_matches_generic():
+    """Granger-Scott squaring == generic squaring on cyclotomic elements."""
+    from teku_tpu.crypto.bls import curve as C
+    from teku_tpu.crypto.bls import pairing as PR
+
+    p = C.to_affine(C.FQ_OPS, C.point_mul(C.FQ_OPS, rng.randrange(1, PR.R),
+                                          C.G1_GENERATOR))
+    q = C.to_affine(C.FQ2_OPS, C.point_mul(C.FQ2_OPS, rng.randrange(1, PR.R),
+                                           C.G2_GENERATOR))
+    f = PR.final_exponentiation(PR.miller_loop(p, q))
+    assert F.fq12_eq(F.fq12_cyclo_sqr(f), F.fq12_sqr(f))
+    # also holds right after the easy part (the _pow_z input domain)
+    g = F.fq12_mul(F.fq12_conj(f), F.fq12_inv(f))
+    g = F.fq12_mul(F.fq12_frobenius(g, 2), g)
+    assert F.fq12_eq(F.fq12_cyclo_sqr(g), F.fq12_sqr(g))

@@ -72,3 +72,21 @@ class TestPairing:
             C.point_mul(C.FQ2_OPS, sk, C.from_affine(C.FQ2_OPS, *h)))
         neg_g1 = C.to_affine(C.FQ_OPS, C.point_neg(C.FQ_OPS, C.G1_GENERATOR))
         assert F.fq12_is_one(PR.multi_pairing([(pk, h), (neg_g1, sig)]))
+
+
+def test_twist_miller_matches_untwist_oracle():
+    """Production twist-coordinate Miller loop == clarity-first untwist loop.
+
+    The twist loop (Jacobian on E'/Fq2 with sparse line mults) is the
+    algorithm the JAX kernel mirrors; the untwist loop is its independent
+    oracle.  They must agree up to final exponentiation.
+    """
+    for _ in range(2):
+        p = g1(rng.randrange(1, R))
+        q = g2(rng.randrange(1, R))
+        fast = PR.final_exponentiation(PR.miller_loop(p, q))
+        slow = PR.final_exponentiation(PR.miller_loop_untwist(p, q))
+        assert F.fq12_eq(fast, slow)
+    # infinity handling is identical
+    assert PR.miller_loop(None, q) == F.FQ12_ONE
+    assert PR.miller_loop(p, None) == F.FQ12_ONE
