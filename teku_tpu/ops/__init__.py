@@ -1,0 +1,19 @@
+"""TPU kernel library (JAX) — fixed-width bigint crypto for the hot path.
+
+This package is the TPU-native replacement for the reference's native
+crypto layer (blst C/asm via JNI, reference: infrastructure/bls/.../impl/
+blst/).
+
+IMPORT SIDE EFFECT: the limb kernels require 64-bit integer lanes, so
+importing this package enables jax x64 mode PROCESS-WIDE (new arrays and
+literals default to int64/float64; arrays created earlier keep their
+dtype).  teku_tpu is an application (a consensus node), not an embeddable
+library, so it owns this global; anything embedding these kernels in a
+32-bit JAX program must isolate them in their own process.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+if not jax.config.jax_enable_x64:  # pragma: no cover - defensive
+    raise RuntimeError("teku_tpu.ops requires jax x64 mode; enabling it failed")

@@ -1,0 +1,243 @@
+"""fp381 fixed-width limb arithmetic for TPU (JAX).
+
+The base field Fq of BLS12-381 (381-bit prime P) represented as 15 limbs of
+26 bits each stored in int64 lanes, in Montgomery form (a*R mod P with
+R = 2^390).  This replaces the native blst limb arithmetic the reference
+client calls through JNI (reference: infrastructure/bls/src/main/java/tech/
+pegasys/teku/bls/impl/blst/BlstBLS12381.java — there delegated to C/asm).
+
+Design for TPU/XLA:
+- Element = trailing dim of size 15; every op broadcasts over arbitrary
+  leading batch dims, so batching is plain array broadcasting (no vmap
+  needed) and XLA sees large fused elementwise ops feeding the VPU.
+- 26-bit radix: limb products are <= 2^52 and column sums across the
+  schoolbook multiply + Montgomery reduction stay < 2^58, well inside
+  int64 — no data-dependent carries, no overflow branches.
+- Branch-free throughout: conditional reduction is a lane-wise select,
+  so everything jits with static shapes and is constant-time by
+  construction (the reference gets this from blst's asm).
+
+Layer validation: tests/test_ops_limbs.py checks every op against the
+pure-Python oracle (teku_tpu/crypto/bls/fields.py).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..crypto.bls.constants import P
+
+# --------------------------------------------------------------------------
+# Representation constants
+# --------------------------------------------------------------------------
+
+W = 26                    # bits per limb
+L = 15                    # limb count (15*26 = 390 >= 381)
+MASK = (1 << W) - 1
+RADIX = 1 << W
+
+R_MOD_P = (1 << (W * L)) % P          # Montgomery R mod P
+R2_MOD_P = (R_MOD_P * R_MOD_P) % P    # R^2 mod P (to_mont multiplier)
+N0INV = (-pow(P, -1, RADIX)) % RADIX  # -P^-1 mod 2^W
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Host-side: python int -> canonical limb vector (NOT Montgomery form)."""
+    if not 0 <= x < (1 << (W * L)):
+        raise ValueError("value out of limb range")
+    return np.array([(x >> (W * i)) & MASK for i in range(L)], dtype=np.int64)
+
+
+def limbs_to_int(a) -> int:
+    """Host-side: limb vector -> python int."""
+    a = np.asarray(a)
+    return sum(int(a[..., i]) << (W * i) for i in range(L))
+
+
+P_LIMBS = int_to_limbs(P)
+ZERO = np.zeros(L, dtype=np.int64)
+ONE_MONT = int_to_limbs(R_MOD_P)          # 1 in Montgomery form
+R2_LIMBS = int_to_limbs(R2_MOD_P)
+
+
+def int_to_mont(x: int) -> np.ndarray:
+    """Host-side: python int mod P -> Montgomery-form limb vector."""
+    return int_to_limbs((x % P) * R_MOD_P % P)
+
+
+def mont_to_int(a) -> int:
+    """Host-side: Montgomery-form limbs -> python int mod P."""
+    return limbs_to_int(a) * pow(R_MOD_P, -1, P) % P
+
+
+# --------------------------------------------------------------------------
+# Core ops.  All take/return int64 arrays of shape (..., L), canonical
+# limbs (< 2^W), value < P, Montgomery form where noted.
+# --------------------------------------------------------------------------
+
+def _carry_propagate(r):
+    """Normalize limbs after accumulation: (..., L) with values < 2^63-ish,
+    total value < 2^(W*L), into canonical limbs."""
+    out = []
+    c = jnp.zeros(r.shape[:-1], dtype=jnp.int64)
+    for i in range(L):
+        v = r[..., i] + c
+        out.append(v & MASK)
+        c = v >> W
+    return jnp.stack(out, axis=-1)
+
+
+def _sub_with_borrow(a, b):
+    """(a - b) limbwise with sequential borrow; returns (diff, borrow)
+    where borrow is 0 if a >= b else -1.  Inputs canonical."""
+    out = []
+    c = jnp.zeros(a.shape[:-1] if a.ndim >= b.ndim else b.shape[:-1],
+                  dtype=jnp.int64)
+    for i in range(L):
+        v = a[..., i] - b[..., i] + c
+        out.append(v & MASK)
+        c = v >> W          # arithmetic shift: 0 or -1
+    return jnp.stack(out, axis=-1), c
+
+
+def _cond_sub_p(a):
+    """a < 2P canonical-limbed -> a mod P."""
+    p = jnp.asarray(P_LIMBS)
+    d, borrow = _sub_with_borrow(a, p)
+    return jnp.where((borrow != 0)[..., None], a, d)
+
+
+def add(a, b):
+    """Field addition (works in either plain or Montgomery form)."""
+    return _cond_sub_p(_carry_propagate(a + b))
+
+
+def sub(a, b):
+    """Field subtraction."""
+    d, borrow = _sub_with_borrow(a, b)
+    dp = _carry_propagate(d + jnp.asarray(P_LIMBS))
+    return jnp.where((borrow != 0)[..., None], dp, d)
+
+
+def neg(a):
+    """Field negation: P - a, with -0 = 0."""
+    d, _ = _sub_with_borrow(jnp.asarray(P_LIMBS), a)
+    return jnp.where(is_zero(a)[..., None], jnp.zeros_like(a), d)
+
+
+def is_zero(a):
+    return jnp.all(a == 0, axis=-1)
+
+
+def eq(a, b):
+    return jnp.all(a == b, axis=-1)
+
+
+def select(cond, a, b):
+    """Lane select: cond True -> a, else b.  cond shape = batch shape."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def mont_mul(a, b):
+    """Montgomery multiplication: returns a*b*R^-1 mod P.
+
+    Schoolbook column products then word-by-word Montgomery reduction;
+    all loops are over the static limb count so XLA sees a flat fused
+    graph with no dynamic control flow.
+    """
+    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    t = jnp.zeros(batch + (2 * L,), dtype=jnp.int64)
+    for i in range(L):
+        t = t.at[..., i:i + L].add(a[..., i:i + 1] * b)
+    p = jnp.asarray(P_LIMBS)
+    for i in range(L):
+        m = ((t[..., i] & MASK) * N0INV) & MASK
+        t = t.at[..., i:i + L].add(m[..., None] * p)
+        t = t.at[..., i + 1].add(t[..., i] >> W)
+    return _cond_sub_p(_carry_propagate(t[..., L:]))
+
+
+def mont_sqr(a):
+    """Montgomery squaring (symmetric products computed once, doubled)."""
+    batch = a.shape[:-1]
+    t = jnp.zeros(batch + (2 * L,), dtype=jnp.int64)
+    for i in range(L):
+        t = t.at[..., 2 * i].add(a[..., i] * a[..., i])
+        if i + 1 < L:
+            cross = 2 * a[..., i:i + 1] * a[..., i + 1:]
+            t = t.at[..., 2 * i + 1:i + L].add(cross)
+    p = jnp.asarray(P_LIMBS)
+    for i in range(L):
+        m = ((t[..., i] & MASK) * N0INV) & MASK
+        t = t.at[..., i:i + L].add(m[..., None] * p)
+        t = t.at[..., i + 1].add(t[..., i] >> W)
+    return _cond_sub_p(_carry_propagate(t[..., L:]))
+
+
+def to_mont(a):
+    """Plain limbs -> Montgomery form."""
+    return mont_mul(a, jnp.asarray(R2_LIMBS))
+
+
+def from_mont(a):
+    """Montgomery form -> plain limbs."""
+    one = jnp.zeros_like(a).at[..., 0].set(1)
+    return mont_mul(a, one)
+
+
+def double(a):
+    return add(a, a)
+
+
+def mul_small(a, k: int):
+    """Multiply by a small static non-negative int (k < 2^10 or so)."""
+    assert 0 <= k
+    if k == 0:
+        return jnp.zeros_like(a)
+    r = _carry_propagate(a * k)
+    # value < k*P: subtract P up to k-1 times (static unroll, select each)
+    for _ in range(k - 1):
+        r = _cond_sub_p(r)
+    return r
+
+
+# --------------------------------------------------------------------------
+# Exponentiation with a static exponent (scan over constant bit vector)
+# --------------------------------------------------------------------------
+
+def pow_static(a, e: int):
+    """a^e mod P for a static python-int exponent; a in Montgomery form.
+
+    Square-and-multiply over the exponent's bits as a traced scan: one
+    sqr + one selected mul per bit, so the compiled graph is O(1) in the
+    exponent length while the runtime is O(bits).
+    """
+    if e == 0:
+        return jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape)
+    bits = np.array([(e >> i) & 1 for i in range(e.bit_length())][::-1],
+                    dtype=np.int64)
+
+    def body(acc, bit):
+        acc = mont_sqr(acc)
+        acc = select(bit != 0, mont_mul(acc, a), acc)
+        return acc, None
+
+    init = jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape)
+    # First bit is always 1: start from a directly to save a step.
+    acc, _ = lax.scan(body, jnp.where(jnp.ones((), bool), a, init),
+                      jnp.asarray(bits[1:]))
+    return acc
+
+
+def inv(a):
+    """Field inverse via Fermat (a^(P-2)); a in Montgomery form.
+    inv(0) returns 0 (callers select around it, branch-free)."""
+    return pow_static(a, P - 2)
+
+
+def sqrt_candidate(a):
+    """a^((P+1)/4) — the square root when a is a QR (P = 3 mod 4).
+    Caller must check candidate^2 == a."""
+    return pow_static(a, (P + 1) // 4)

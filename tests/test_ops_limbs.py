@@ -1,0 +1,106 @@
+"""fp381 limb arithmetic vs the pure-Python oracle."""
+
+import random
+
+import numpy as np
+import pytest
+
+from teku_tpu.crypto.bls.constants import P
+from teku_tpu.ops import limbs as fp
+
+rng = random.Random(0xB15)
+
+
+def rand_fq():
+    return rng.randrange(P)
+
+
+EDGE = [0, 1, 2, P - 1, P - 2, (P - 1) // 2, fp.R_MOD_P, P - fp.R_MOD_P]
+
+
+def batch_mont(values):
+    return np.stack([fp.int_to_mont(v) for v in values])
+
+
+def unbatch(arr):
+    return [fp.mont_to_int(np.asarray(arr)[i]) for i in range(arr.shape[0])]
+
+
+def test_limb_roundtrip():
+    for v in EDGE + [rand_fq() for _ in range(20)]:
+        assert fp.limbs_to_int(fp.int_to_limbs(v)) == v
+        assert fp.mont_to_int(fp.int_to_mont(v)) == v
+
+
+def test_add_sub_neg():
+    a_vals = EDGE + [rand_fq() for _ in range(24)]
+    b_vals = list(reversed(EDGE)) + [rand_fq() for _ in range(24)]
+    a, b = batch_mont(a_vals), batch_mont(b_vals)
+    assert unbatch(fp.add(a, b)) == [(x + y) % P for x, y in zip(a_vals, b_vals)]
+    assert unbatch(fp.sub(a, b)) == [(x - y) % P for x, y in zip(a_vals, b_vals)]
+    assert unbatch(fp.neg(a)) == [(-x) % P for x in a_vals]
+
+
+def test_mont_mul_sqr():
+    a_vals = EDGE + [rand_fq() for _ in range(24)]
+    b_vals = list(reversed(EDGE)) + [rand_fq() for _ in range(24)]
+    a, b = batch_mont(a_vals), batch_mont(b_vals)
+    assert unbatch(fp.mont_mul(a, b)) == [x * y % P for x, y in zip(a_vals, b_vals)]
+    assert unbatch(fp.mont_sqr(a)) == [x * x % P for x in a_vals]
+
+
+def test_mul_broadcast():
+    # (4,1,L) x (3,L) -> (4,3,L)
+    a_vals = [rand_fq() for _ in range(4)]
+    b_vals = [rand_fq() for _ in range(3)]
+    a = batch_mont(a_vals)[:, None, :]
+    b = batch_mont(b_vals)
+    out = np.asarray(fp.mont_mul(a, b))
+    assert out.shape == (4, 3, fp.L)
+    for i in range(4):
+        for j in range(3):
+            assert fp.mont_to_int(out[i, j]) == a_vals[i] * b_vals[j] % P
+
+
+def test_to_from_mont_device():
+    vals = EDGE + [rand_fq() for _ in range(8)]
+    plain = np.stack([fp.int_to_limbs(v) for v in vals])
+    m = fp.to_mont(plain)
+    back = np.asarray(fp.from_mont(m))
+    assert [fp.limbs_to_int(back[i]) for i in range(len(vals))] == vals
+
+
+def test_is_zero_eq_select():
+    a = batch_mont([0, 1, P - 1, 0])
+    b = batch_mont([0, 1, 1, 5])
+    assert list(np.asarray(fp.is_zero(a))) == [True, False, False, True]
+    assert list(np.asarray(fp.eq(a, b))) == [True, True, False, False]
+    sel = fp.select(fp.eq(a, b), a, b)
+    assert unbatch(sel) == [0, 1, 1, 5]
+
+
+def test_mul_small():
+    a_vals = [rand_fq() for _ in range(6)] + [P - 1]
+    a = batch_mont(a_vals)
+    for k in (0, 1, 2, 3, 8):
+        assert unbatch(fp.mul_small(a, k)) == [v * k % P for v in a_vals]
+
+
+def test_pow_static_and_inv():
+    a_vals = [rand_fq() for _ in range(4)] + [1, P - 1]
+    a = batch_mont(a_vals)
+    for e in (1, 2, 3, 65537, (P - 1) // 2):
+        assert unbatch(fp.pow_static(a, e)) == [pow(v, e, P) for v in a_vals]
+    got = unbatch(fp.inv(a))
+    assert got == [pow(v, -1, P) for v in a_vals]
+    # inv(0) = 0 convention
+    z = batch_mont([0])
+    assert unbatch(fp.inv(z)) == [0]
+
+
+def test_sqrt_candidate():
+    for _ in range(6):
+        r = rand_fq()
+        sq = r * r % P
+        cand = fp.mont_to_int(np.asarray(fp.sqrt_candidate(batch_mont([sq]))[0]))
+        assert cand in (r, P - r)
