@@ -79,27 +79,27 @@ def mont_to_int(a) -> int:
 
 def _carry_propagate(r):
     """Normalize limbs after accumulation: (..., L) with values < 2^63-ish,
-    total value < 2^(W*L), into canonical limbs."""
-    out = []
-    c = jnp.zeros(r.shape[:-1], dtype=jnp.int64)
-    for i in range(L):
-        v = r[..., i] + c
-        out.append(v & MASK)
-        c = v >> W
-    return jnp.stack(out, axis=-1)
+    total value < 2^(W*L), into canonical limbs.  Sequential carry chain
+    expressed as a scan so the compiled graph is O(1) in limb count."""
+    def step(c, col):
+        v = col + c
+        return v >> W, v & MASK
+    c0 = jnp.zeros(r.shape[:-1], dtype=jnp.int64)
+    _, limbs = lax.scan(step, c0, jnp.moveaxis(r, -1, 0))
+    return jnp.moveaxis(limbs, 0, -1)
 
 
 def _sub_with_borrow(a, b):
     """(a - b) limbwise with sequential borrow; returns (diff, borrow)
     where borrow is 0 if a >= b else -1.  Inputs canonical."""
-    out = []
-    c = jnp.zeros(a.shape[:-1] if a.ndim >= b.ndim else b.shape[:-1],
-                  dtype=jnp.int64)
-    for i in range(L):
-        v = a[..., i] - b[..., i] + c
-        out.append(v & MASK)
-        c = v >> W          # arithmetic shift: 0 or -1
-    return jnp.stack(out, axis=-1), c
+    a, b = jnp.broadcast_arrays(a, b)
+    def step(c, cols):
+        v = cols[0] - cols[1] + c
+        return v >> W, v & MASK   # arithmetic shift: carry 0 or -1
+    c0 = jnp.zeros(a.shape[:-1], dtype=jnp.int64)
+    c, limbs = lax.scan(step, c0,
+                        (jnp.moveaxis(a, -1, 0), jnp.moveaxis(b, -1, 0)))
+    return jnp.moveaxis(limbs, 0, -1), c
 
 
 def _cond_sub_p(a):
@@ -140,40 +140,59 @@ def select(cond, a, b):
     return jnp.where(cond[..., None], a, b)
 
 
+def gt(a, b):
+    """a > b as canonical plain-form (non-Montgomery) limb integers."""
+    _, borrow = _sub_with_borrow(b, a)
+    return borrow != 0
+
+
+def _pad_last(x, lo, hi):
+    return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(lo, hi)])
+
+
+def _mont_reduce(t):
+    """Word-serial Montgomery reduction of 2L product columns.
+
+    The 15-step serial dependency (each m_i needs the running low column)
+    is a scan whose body shifts the column window down one word per step;
+    column magnitudes stay < 2^58, inside int64.
+    """
+    p_pad = _pad_last(jnp.asarray(P_LIMBS), 0, L)
+
+    def red(t, _):
+        m = ((t[..., 0] & MASK) * N0INV) & MASK
+        t = t + m[..., None] * p_pad
+        c = t[..., 0] >> W
+        head = t[..., 1:2] + c[..., None]
+        t = jnp.concatenate(
+            [head, t[..., 2:], jnp.zeros_like(t[..., :1])], axis=-1)
+        return t, None
+
+    t, _ = lax.scan(red, t, None, length=L)
+    return _cond_sub_p(_carry_propagate(t[..., :L]))
+
+
 def mont_mul(a, b):
     """Montgomery multiplication: returns a*b*R^-1 mod P.
 
-    Schoolbook column products then word-by-word Montgomery reduction;
-    all loops are over the static limb count so XLA sees a flat fused
-    graph with no dynamic control flow.
+    Schoolbook column products built by pad-and-sum (no scatter ops —
+    XLA fuses the static pads into one elementwise reduction), then the
+    scan-based word-serial reduction.
     """
-    batch = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    t = jnp.zeros(batch + (2 * L,), dtype=jnp.int64)
-    for i in range(L):
-        t = t.at[..., i:i + L].add(a[..., i:i + 1] * b)
-    p = jnp.asarray(P_LIMBS)
-    for i in range(L):
-        m = ((t[..., i] & MASK) * N0INV) & MASK
-        t = t.at[..., i:i + L].add(m[..., None] * p)
-        t = t.at[..., i + 1].add(t[..., i] >> W)
-    return _cond_sub_p(_carry_propagate(t[..., L:]))
+    t = sum(_pad_last(a[..., i:i + 1] * b, i, L - i) for i in range(L))
+    return _mont_reduce(t)
 
 
 def mont_sqr(a):
-    """Montgomery squaring (symmetric products computed once, doubled)."""
-    batch = a.shape[:-1]
-    t = jnp.zeros(batch + (2 * L,), dtype=jnp.int64)
+    """Montgomery squaring: symmetric cross products computed once and
+    doubled (~half the limb multiplies of mont_mul)."""
+    rows = []
     for i in range(L):
-        t = t.at[..., 2 * i].add(a[..., i] * a[..., i])
-        if i + 1 < L:
-            cross = 2 * a[..., i:i + 1] * a[..., i + 1:]
-            t = t.at[..., 2 * i + 1:i + L].add(cross)
-    p = jnp.asarray(P_LIMBS)
-    for i in range(L):
-        m = ((t[..., i] & MASK) * N0INV) & MASK
-        t = t.at[..., i:i + L].add(m[..., None] * p)
-        t = t.at[..., i + 1].add(t[..., i] >> W)
-    return _cond_sub_p(_carry_propagate(t[..., L:]))
+        diag = a[..., i:i + 1] * a[..., i:i + 1]
+        cross = 2 * a[..., i:i + 1] * a[..., i + 1:]
+        seg = jnp.concatenate([diag, cross], axis=-1)   # columns 2i..i+L-1
+        rows.append(_pad_last(seg, 2 * i, L - i))
+    return _mont_reduce(sum(rows))
 
 
 def to_mont(a):
@@ -224,10 +243,8 @@ def pow_static(a, e: int):
         acc = select(bit != 0, mont_mul(acc, a), acc)
         return acc, None
 
-    init = jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape)
     # First bit is always 1: start from a directly to save a step.
-    acc, _ = lax.scan(body, jnp.where(jnp.ones((), bool), a, init),
-                      jnp.asarray(bits[1:]))
+    acc, _ = lax.scan(body, jnp.asarray(a), jnp.asarray(bits[1:]))
     return acc
 
 

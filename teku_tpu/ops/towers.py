@@ -1,0 +1,378 @@
+"""BLS12-381 extension-field towers on TPU limb arithmetic (JAX).
+
+Fq2 = Fq[u]/(u^2+1) as a tuple (c0, c1) of limb arrays; Fq6 = Fq2[v]/(v^3-xi)
+with xi = 1+u as a 3-tuple of Fq2; Fq12 = Fq6[w]/(w^2-v) as a 2-tuple of Fq6.
+Tuples are JAX pytrees, so every op broadcasts over leading batch dims and
+composes with jit/scan/shard_map untouched.
+
+Algorithms mirror the pure-Python oracle (teku_tpu/crypto/bls/fields.py) —
+Karatsuba Fq2/Fq6/Fq12 mul, Chung-Hasan Fq6 squaring, Granger-Scott
+cyclotomic squaring, computed Frobenius constants — re-expressed branch-free
+on Montgomery limbs.  The reference client gets this layer from native blst
+(reference: infrastructure/bls/src/main/java/tech/pegasys/teku/bls/impl/
+blst/BlstBLS12381.java, SWIG classes P1/P2/Pairing).
+
+Validation: tests/test_ops_towers.py checks every op against the oracle.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..crypto.bls import fields as F
+from ..crypto.bls.constants import P
+from . import limbs as fp
+
+# --------------------------------------------------------------------------
+# Constants (host-computed, Montgomery form)
+# --------------------------------------------------------------------------
+
+
+def fq2_const(c) -> tuple:
+    """Host: oracle Fq2 tuple of ints -> Montgomery limb constant pair."""
+    return (np.asarray(fp.int_to_mont(c[0])), np.asarray(fp.int_to_mont(c[1])))
+
+
+FQ2_ZERO_NP = fq2_const((0, 0))
+FQ2_ONE_NP = fq2_const((1, 0))
+
+FROB6_C1 = fq2_const(F.FROB6_C1)
+FROB6_C2 = fq2_const(F.FROB6_C2)
+FROB12_C1 = fq2_const(F.FROB12_C1)
+
+# sqrt constants for q = P^2 ≡ 9 (mod 16): c1 = sqrt(-1), c2 = sqrt(c1),
+# c3 = sqrt(-c1); all four of {cand, c1*cand, c2*cand, c3*cand} are tried
+# branch-free (RFC 9380 appendix I.3 constant-time sqrt shape).
+_SQRT_M1 = F.fq2_sqrt((P - 1, 0))
+_SQRT_C2 = F.fq2_sqrt(_SQRT_M1)
+_SQRT_C3 = F.fq2_sqrt(F.fq2_neg(_SQRT_M1))
+assert _SQRT_M1 and _SQRT_C2 and _SQRT_C3
+SQRT_EXP = (P * P + 7) // 16
+assert (P * P) % 16 == 9
+
+
+def _bcast2(c, like):
+    """Broadcast an Fq2 numpy constant to the batch shape of `like`."""
+    shape = like[0].shape
+    return (jnp.broadcast_to(jnp.asarray(c[0]), shape),
+            jnp.broadcast_to(jnp.asarray(c[1]), shape))
+
+
+# --------------------------------------------------------------------------
+# Fq2
+# --------------------------------------------------------------------------
+
+def fq2_add(a, b):
+    return (fp.add(a[0], b[0]), fp.add(a[1], b[1]))
+
+
+def fq2_sub(a, b):
+    return (fp.sub(a[0], b[0]), fp.sub(a[1], b[1]))
+
+
+def fq2_neg(a):
+    return (fp.neg(a[0]), fp.neg(a[1]))
+
+
+def fq2_double(a):
+    return fq2_add(a, a)
+
+
+def fq2_mul(a, b):
+    # Karatsuba: 3 base muls
+    t0 = fp.mont_mul(a[0], b[0])
+    t1 = fp.mont_mul(a[1], b[1])
+    t2 = fp.mont_mul(fp.add(a[0], a[1]), fp.add(b[0], b[1]))
+    return (fp.sub(t0, t1), fp.sub(fp.sub(t2, t0), t1))
+
+
+def fq2_sqr(a):
+    # (a0+a1)(a0-a1), 2 a0 a1
+    c0 = fp.mont_mul(fp.add(a[0], a[1]), fp.sub(a[0], a[1]))
+    t = fp.mont_mul(a[0], a[1])
+    return (c0, fp.add(t, t))
+
+
+def fq2_mul_fp(a, s):
+    """Multiply both components by an Fq (Montgomery) scalar."""
+    return (fp.mont_mul(a[0], s), fp.mont_mul(a[1], s))
+
+
+def fq2_conj(a):
+    return (a[0], fp.neg(a[1]))
+
+
+def fq2_mul_by_xi(a):
+    # a * (1 + u) = (a0 - a1) + (a0 + a1) u
+    return (fp.sub(a[0], a[1]), fp.add(a[0], a[1]))
+
+
+def fq2_inv(a):
+    """Branch-free inverse; inv(0) = 0 (callers select around zero)."""
+    norm = fp.add(fp.mont_sqr(a[0]), fp.mont_sqr(a[1]))
+    ninv = fp.inv(norm)
+    return (fp.mont_mul(a[0], ninv), fp.neg(fp.mont_mul(a[1], ninv)))
+
+
+def fq2_is_zero(a):
+    return fp.is_zero(a[0]) & fp.is_zero(a[1])
+
+
+def fq2_eq(a, b):
+    return fp.eq(a[0], b[0]) & fp.eq(a[1], b[1])
+
+
+def fq2_select(cond, a, b):
+    return (fp.select(cond, a[0], b[0]), fp.select(cond, a[1], b[1]))
+
+
+def fq2_pow_static(a, e: int):
+    """a^e for a static exponent via scan (1 sqr + 1 selected mul per bit)."""
+    assert e > 0
+    bits = np.array([(e >> i) & 1 for i in range(e.bit_length())][::-1],
+                    dtype=np.int64)
+
+    def body(acc, bit):
+        acc = fq2_sqr(acc)
+        acc = fq2_select(bit != 0, fq2_mul(acc, a), acc)
+        return acc, None
+
+    acc, _ = lax.scan(body, a, jnp.asarray(bits[1:]))
+    return acc
+
+
+def fq2_sqrt(a):
+    """Branch-free square root in Fq2 (q ≡ 9 mod 16).
+
+    Returns (ok, root): ok is False where `a` is a non-residue (root lanes
+    are then garbage and must be selected away by the caller).
+    """
+    cand = fq2_pow_static(a, SQRT_EXP)   # a = 0 -> cand = 0, matches below
+    root = cand
+    found = jnp.zeros(fq2_is_zero(a).shape, dtype=bool)
+    for c in (None, _SQRT_M1, _SQRT_C2, _SQRT_C3):
+        t = cand if c is None else fq2_mul(_bcast2(fq2_const(c), cand), cand)
+        match = fq2_eq(fq2_sqr(t), a) & ~found
+        root = fq2_select(match, t, root)
+        found = found | match
+    return found, root
+
+
+def fq2_is_large(a_plain):
+    """Lexicographic 'y is the larger root' on PLAIN-form limbs
+    (wire-format sign bit; oracle curve.py _fq2_is_large)."""
+    half = jnp.asarray(fp.int_to_limbs((P - 1) // 2))
+    large1 = fp.gt(a_plain[1], half)
+    return large1 | (fp.is_zero(a_plain[1]) & fp.gt(a_plain[0], half))
+
+
+def fq2_from_mont(a):
+    return (fp.from_mont(a[0]), fp.from_mont(a[1]))
+
+
+# --------------------------------------------------------------------------
+# Fq6
+# --------------------------------------------------------------------------
+
+def fq6_add(a, b):
+    return tuple(fq2_add(x, y) for x, y in zip(a, b))
+
+
+def fq6_sub(a, b):
+    return tuple(fq2_sub(x, y) for x, y in zip(a, b))
+
+
+def fq6_neg(a):
+    return tuple(fq2_neg(x) for x in a)
+
+
+def fq6_mul(a, b):
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t0 = fq2_mul(a0, b0)
+    t1 = fq2_mul(a1, b1)
+    t2 = fq2_mul(a2, b2)
+    c0 = fq2_add(t0, fq2_mul_by_xi(fq2_sub(fq2_sub(
+        fq2_mul(fq2_add(a1, a2), fq2_add(b1, b2)), t1), t2)))
+    c1 = fq2_add(fq2_sub(fq2_sub(
+        fq2_mul(fq2_add(a0, a1), fq2_add(b0, b1)), t0), t1),
+        fq2_mul_by_xi(t2))
+    c2 = fq2_add(fq2_sub(fq2_sub(
+        fq2_mul(fq2_add(a0, a2), fq2_add(b0, b2)), t0), t2), t1)
+    return (c0, c1, c2)
+
+
+def fq6_sqr(a):
+    # Chung-Hasan SQR2
+    a0, a1, a2 = a
+    s0 = fq2_sqr(a0)
+    s1 = fq2_mul(a0, a1)
+    s1 = fq2_add(s1, s1)
+    s2 = fq2_sqr(fq2_add(fq2_sub(a0, a1), a2))
+    s3 = fq2_mul(a1, a2)
+    s3 = fq2_add(s3, s3)
+    s4 = fq2_sqr(a2)
+    c0 = fq2_add(s0, fq2_mul_by_xi(s3))
+    c1 = fq2_add(s1, fq2_mul_by_xi(s4))
+    c2 = fq2_sub(fq2_add(fq2_add(s1, s2), s3), fq2_add(s0, s4))
+    return (c0, c1, c2)
+
+
+def fq6_mul_by_v(a):
+    return (fq2_mul_by_xi(a[2]), a[0], a[1])
+
+
+def fq6_mul_by_fq2(a, s):
+    return tuple(fq2_mul(x, s) for x in a)
+
+
+def fq6_inv(a):
+    a0, a1, a2 = a
+    t0 = fq2_sub(fq2_sqr(a0), fq2_mul_by_xi(fq2_mul(a1, a2)))
+    t1 = fq2_sub(fq2_mul_by_xi(fq2_sqr(a2)), fq2_mul(a0, a1))
+    t2 = fq2_sub(fq2_sqr(a1), fq2_mul(a0, a2))
+    norm = fq2_add(fq2_mul(a0, t0),
+                   fq2_mul_by_xi(fq2_add(fq2_mul(a2, t1), fq2_mul(a1, t2))))
+    ninv = fq2_inv(norm)
+    return (fq2_mul(t0, ninv), fq2_mul(t1, ninv), fq2_mul(t2, ninv))
+
+
+def fq6_eq(a, b):
+    r = fq2_eq(a[0], b[0])
+    return r & fq2_eq(a[1], b[1]) & fq2_eq(a[2], b[2])
+
+
+def fq6_select(cond, a, b):
+    return tuple(fq2_select(cond, x, y) for x, y in zip(a, b))
+
+
+def fq6_frobenius(a):
+    return (fq2_conj(a[0]),
+            fq2_mul(fq2_conj(a[1]), _bcast2(FROB6_C1, a[1])),
+            fq2_mul(fq2_conj(a[2]), _bcast2(FROB6_C2, a[2])))
+
+
+# --------------------------------------------------------------------------
+# Fq12
+# --------------------------------------------------------------------------
+
+def fq12_ones(batch_shape=()):
+    """FQ12 one broadcast to a batch shape."""
+    one = _bcast2(FQ2_ONE_NP, (jnp.zeros(batch_shape + (fp.L,),
+                                         dtype=jnp.int64),) * 2)
+    zero2 = _bcast2(FQ2_ZERO_NP, one)
+    z6 = (zero2, zero2, zero2)
+    return ((one, zero2, zero2), z6)
+
+
+def fq12_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = fq6_mul(a0, b0)
+    t1 = fq6_mul(a1, b1)
+    c0 = fq6_add(t0, fq6_mul_by_v(t1))
+    c1 = fq6_sub(fq6_sub(fq6_mul(fq6_add(a0, a1), fq6_add(b0, b1)), t0), t1)
+    return (c0, c1)
+
+
+def fq12_sqr(a):
+    a0, a1 = a
+    t = fq6_mul(a0, a1)
+    c0 = fq6_sub(fq6_mul(fq6_add(a0, a1), fq6_add(a0, fq6_mul_by_v(a1))),
+                 fq6_add(t, fq6_mul_by_v(t)))
+    c1 = fq6_add(t, t)
+    return (c0, c1)
+
+
+def fq12_conj(a):
+    return (a[0], fq6_neg(a[1]))
+
+
+def _fp4_sqr(a, b):
+    t = fq2_mul(a, b)
+    return (fq2_add(fq2_sqr(a), fq2_mul_by_xi(fq2_sqr(b))), fq2_add(t, t))
+
+
+def fq12_cyclo_sqr(a):
+    """Granger-Scott squaring for cyclotomic-subgroup elements
+    (mirrors oracle fields.fq12_cyclo_sqr; validated against fq12_sqr)."""
+    (g0, g1, g2), (h0, h1, h2) = a
+    a0, a1 = _fp4_sqr(g0, h1)
+    b0, b1 = _fp4_sqr(h0, g2)
+    c0, c1 = _fp4_sqr(g1, h2)
+    sc0, sc1 = fq2_mul_by_xi(c1), c0
+
+    def comb(s0, s1, o0, o1, sign):
+        t0 = fq2_add(fq2_add(s0, s0), s0)
+        t1 = fq2_add(fq2_add(s1, s1), s1)
+        d0 = fq2_add(o0, o0)
+        d1 = fq2_add(o1, o1)
+        if sign > 0:
+            return (fq2_add(t0, d0), fq2_sub(t1, d1))
+        return (fq2_sub(t0, d0), fq2_add(t1, d1))
+
+    B0 = comb(a0, a1, g0, h1, -1)
+    B1 = comb(sc0, sc1, h0, g2, +1)
+    B2 = comb(b0, b1, g1, h2, -1)
+    return ((B0[0], B2[0], B1[1]), (B1[0], B0[1], B2[1]))
+
+
+def fq12_inv(a):
+    a0, a1 = a
+    norm = fq6_sub(fq6_sqr(a0), fq6_mul_by_v(fq6_sqr(a1)))
+    ninv = fq6_inv(norm)
+    return (fq6_mul(a0, ninv), fq6_neg(fq6_mul(a1, ninv)))
+
+
+def fq12_frobenius(a, power: int = 1):
+    result = a
+    for _ in range(power % 12):
+        c0 = fq6_frobenius(result[0])
+        c1 = fq6_frobenius(result[1])
+        c1 = fq6_mul_by_fq2(c1, _bcast2(FROB12_C1, c1[0]))
+        result = (c0, c1)
+    return result
+
+
+def fq12_eq(a, b):
+    return fq6_eq(a[0], b[0]) & fq6_eq(a[1], b[1])
+
+
+def fq12_is_one(a):
+    return fq12_eq(a, fq12_ones(a[0][0][0].shape[:-1]))
+
+
+def fq12_select(cond, a, b):
+    return tuple(fq6_select(cond, x, y) for x, y in zip(a, b))
+
+
+# --------------------------------------------------------------------------
+# Host conversions (tests / boundaries)
+# --------------------------------------------------------------------------
+
+def fq2_to_device(c):
+    """Oracle Fq2 (int pair) -> Montgomery limb arrays (unbatched)."""
+    return (jnp.asarray(fp.int_to_mont(c[0])), jnp.asarray(fp.int_to_mont(c[1])))
+
+
+def fq2_from_device(a, index=()) -> tuple:
+    """Montgomery limb arrays -> oracle Fq2 int pair at a batch index."""
+    return (fp.mont_to_int(np.asarray(a[0])[index]),
+            fp.mont_to_int(np.asarray(a[1])[index]))
+
+
+def fq6_to_device(c):
+    return tuple(fq2_to_device(x) for x in c)
+
+
+def fq6_from_device(a, index=()):
+    return tuple(fq2_from_device(x, index) for x in a)
+
+
+def fq12_to_device(c):
+    return tuple(fq6_to_device(x) for x in c)
+
+
+def fq12_from_device(a, index=()):
+    return tuple(fq6_from_device(x, index) for x in a)

@@ -3,13 +3,23 @@
 Tests run on the XLA CPU backend with 8 virtual devices so multi-chip
 sharding paths (jax.sharding.Mesh over ICI in production) are exercised
 without TPU hardware, per the project's multi-chip test strategy.
-Must run before jax is imported anywhere.
+
+The override must be a hard set, not setdefault: the agent environment
+ships JAX_PLATFORMS=axon (a remote single-tenant TPU tunnel), and letting
+tests default onto it turns every eager op into a network RPC — and wedges
+the tunnel for the real benchmark runs.  jax may already be imported by
+the interpreter's sitecustomize, so the config is also forced via
+jax.config for the already-imported module.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,201 @@
+"""Curve-group kernels vs the pure-Python oracle."""
+
+import random
+
+import jax
+import numpy as np
+
+from teku_tpu.crypto.bls import curve as C
+from teku_tpu.crypto.bls import fields as F
+from teku_tpu.crypto.bls.constants import P, R
+from teku_tpu.ops import limbs as fp
+from teku_tpu.ops import points as PT
+from teku_tpu.ops import towers as T
+
+rng = random.Random(0x61)
+
+
+def rand_g1():
+    return C.point_mul(C.FQ_OPS, rng.randrange(1, R), C.G1_GENERATOR)
+
+
+def rand_g2():
+    return C.point_mul(C.FQ2_OPS, rng.randrange(1, R), C.G2_GENERATOR)
+
+
+def stack_g1(points):
+    """Oracle G1 Jacobian points -> batched device point."""
+    cols = []
+    for i in range(3):
+        cols.append(np.stack([fp.int_to_mont(p[i]) for p in points]))
+    return tuple(cols)
+
+
+def stack_g2(points):
+    out = []
+    for i in range(3):
+        out.append((np.stack([fp.int_to_mont(p[i][0]) for p in points]),
+                    np.stack([fp.int_to_mont(p[i][1]) for p in points])))
+    return tuple(out)
+
+
+def check_eq_g1(dev, i, oracle_pt):
+    got = PT.g1_from_device(dev, (i,))
+    assert C.point_eq(C.FQ_OPS, got, oracle_pt)
+
+
+def check_eq_g2(dev, i, oracle_pt):
+    got = PT.g2_from_device(dev, (i,))
+    assert C.point_eq(C.FQ2_OPS, got, oracle_pt)
+
+
+def non_subgroup_g1():
+    """On-curve G1 point outside the r-subgroup (h1-torsion component)."""
+    while True:
+        x = rng.randrange(P)
+        y = F.fq_sqrt((x * x % P * x + 4) % P)
+        if y is None:
+            continue
+        p = (x, y, 1)
+        if not C.g1_in_subgroup(p):
+            return p
+
+
+def non_subgroup_g2():
+    while True:
+        x = (rng.randrange(P), rng.randrange(P))
+        rhs = F.fq2_add(F.fq2_mul(F.fq2_sqr(x), x), (4, 4))
+        y = F.fq2_sqrt(rhs)
+        if y is None:
+            continue
+        p = (x, y, F.FQ2_ONE)
+        if not C.g2_in_subgroup(p):
+            return p
+
+
+def test_g1_add_double_edge_cases():
+    a, b = rand_g1(), rand_g1()
+    inf = C.infinity(C.FQ_OPS)
+    pairs = [(a, b), (a, a), (a, C.point_neg(C.FQ_OPS, a)), (inf, b),
+             (a, inf), (inf, inf)]
+    pa = stack_g1([p for p, _ in pairs])
+    pb = stack_g1([q for _, q in pairs])
+    out = jax.jit(lambda x, y: PT.point_add(PT.G1_KIT, x, y))(pa, pb)
+    for i, (p, q) in enumerate(pairs):
+        check_eq_g1(out, i, C.point_add(C.FQ_OPS, p, q))
+    dbl = jax.jit(lambda x: PT.point_double(PT.G1_KIT, x))(pa)
+    for i, (p, _) in enumerate(pairs):
+        check_eq_g1(dbl, i, C.point_double(C.FQ_OPS, p))
+
+
+def test_g2_add_double_edge_cases():
+    a, b = rand_g2(), rand_g2()
+    inf = C.infinity(C.FQ2_OPS)
+    pairs = [(a, b), (a, a), (a, C.point_neg(C.FQ2_OPS, a)), (inf, b),
+             (a, inf)]
+    pa = stack_g2([p for p, _ in pairs])
+    pb = stack_g2([q for _, q in pairs])
+    out = jax.jit(lambda x, y: PT.point_add(PT.G2_KIT, x, y))(pa, pb)
+    for i, (p, q) in enumerate(pairs):
+        check_eq_g2(out, i, C.point_add(C.FQ2_OPS, p, q))
+
+
+def test_scalar_mul_bits_g1():
+    pts = [rand_g1() for _ in range(3)] + [C.infinity(C.FQ_OPS)]
+    scalars = [rng.getrandbits(64) for _ in range(3)] + [12345]
+    dev = stack_g1(pts)
+    bits = PT.scalar_from_uint64(np.array(scalars, dtype=np.int64))
+    out = jax.jit(lambda b, p: PT.scalar_mul_bits(PT.G1_KIT, b, p))(bits, dev)
+    for i, (p, s) in enumerate(zip(pts, scalars)):
+        check_eq_g1(out, i, C.point_mul(C.FQ_OPS, s, p))
+
+
+def test_scalar_mul_bits_g2():
+    pts = [rand_g2() for _ in range(2)]
+    scalars = [rng.getrandbits(64) for _ in range(2)]
+    dev = stack_g2(pts)
+    bits = PT.scalar_from_uint64(np.array(scalars, dtype=np.int64))
+    out = jax.jit(lambda b, p: PT.scalar_mul_bits(PT.G2_KIT, b, p))(bits, dev)
+    for i, (p, s) in enumerate(zip(pts, scalars)):
+        check_eq_g2(out, i, C.point_mul(C.FQ2_OPS, s, p))
+
+
+def test_scalar_mul_static():
+    p = rand_g1()
+    dev = stack_g1([p])
+    for e in (0, 1, 7, R - 1, R):
+        out = jax.jit(
+            lambda x, e=e: PT.scalar_mul_static(PT.G1_KIT, e, x))(dev)
+        check_eq_g1(out, 0, C.point_mul(C.FQ_OPS, e, p))
+
+
+def test_psi_is_frobenius_eigenvalue():
+    # On G2, psi acts as [p]; p ≡ z (mod r) so psi(Q) == [z]Q there.
+    q = rand_g2()
+    dev = stack_g2([q])
+    psi = jax.jit(PT.g2_psi)(dev)
+    expect = C.point_mul(C.FQ2_OPS, P % R, q)
+    check_eq_g2(psi, 0, expect)
+
+
+def test_subgroup_checks():
+    good1 = [rand_g1() for _ in range(2)] + [C.infinity(C.FQ_OPS)]
+    bad1 = [non_subgroup_g1()]
+    dev = stack_g1(good1 + bad1)
+    got = list(np.asarray(jax.jit(PT.g1_in_subgroup)(dev)))
+    assert got == [True, True, True, False]
+
+    good2 = [rand_g2() for _ in range(2)]
+    bad2 = [non_subgroup_g2()]
+    dev2 = stack_g2(good2 + bad2)
+    got2 = list(np.asarray(jax.jit(PT.g2_in_subgroup)(dev2)))
+    assert got2 == [True, True, False]
+
+
+def test_g1_decompress_device():
+    pts = [rand_g1() for _ in range(4)]
+    comp = [C.g1_compress(p) for p in pts]
+    xs, flags = [], []
+    for c in comp:
+        xs.append(fp.int_to_limbs(int.from_bytes(
+            bytes([c[0] & 0x1F]) + c[1:], "big")))
+        flags.append(bool(c[0] & 0x20))
+    ok, point = jax.jit(PT.g1_recover_y)(
+        np.stack(xs), np.array(flags))
+    assert all(np.asarray(ok))
+    for i, p in enumerate(pts):
+        check_eq_g1(point, i, p)
+    # invalid x (not on curve): valid=False
+    bad_x = 5
+    while F.fq_sqrt((bad_x ** 3 + 4) % P) is not None:
+        bad_x += 1
+    ok2, _ = jax.jit(PT.g1_recover_y)(
+        np.stack([fp.int_to_limbs(bad_x)]), np.array([False]))
+    assert not np.asarray(ok2)[0]
+
+
+def test_g2_decompress_device():
+    pts = [rand_g2() for _ in range(3)]
+    comp = [C.g2_compress(p) for p in pts]
+    x0s, x1s, flags = [], [], []
+    for c in comp:
+        x1s.append(fp.int_to_limbs(int.from_bytes(
+            bytes([c[0] & 0x1F]) + c[1:48], "big")))
+        x0s.append(fp.int_to_limbs(int.from_bytes(c[48:96], "big")))
+        flags.append(bool(c[0] & 0x20))
+    ok, point = jax.jit(PT.g2_recover_y)(
+        (np.stack(x0s), np.stack(x1s)), np.array(flags))
+    assert all(np.asarray(ok))
+    for i, p in enumerate(pts):
+        check_eq_g2(point, i, p)
+
+
+def test_on_curve():
+    pts = [rand_g1() for _ in range(2)] + [C.infinity(C.FQ_OPS)]
+    dev = stack_g1(pts)
+    assert all(np.asarray(jax.jit(
+        lambda p: PT.is_on_curve(PT.G1_KIT, p))(dev)))
+    # corrupt one Y
+    bad = (dev[0], dev[1].at[0].set(np.asarray(fp.int_to_mont(12345))), dev[2])
+    got = np.asarray(jax.jit(lambda p: PT.is_on_curve(PT.G1_KIT, p))(bad))
+    assert not got[0] and got[1] and got[2]

@@ -1,0 +1,169 @@
+"""Extension-field tower kernels vs the pure-Python oracle."""
+
+import random
+
+import jax
+import numpy as np
+
+from teku_tpu.crypto.bls import fields as F
+from teku_tpu.crypto.bls.constants import P
+from teku_tpu.ops import limbs as fp
+from teku_tpu.ops import towers as T
+
+rng = random.Random(0xF12)
+
+
+def rand_fq2():
+    return (rng.randrange(P), rng.randrange(P))
+
+
+def rand_fq6():
+    return tuple(rand_fq2() for _ in range(3))
+
+
+def rand_fq12():
+    return (rand_fq6(), rand_fq6())
+
+
+def stack2(vals):
+    """List of oracle Fq2 -> batched device Fq2."""
+    return (np.stack([fp.int_to_mont(v[0]) for v in vals]),
+            np.stack([fp.int_to_mont(v[1]) for v in vals]))
+
+
+def stack6(vals):
+    return tuple(stack2([v[i] for v in vals]) for i in range(3))
+
+
+def stack12(vals):
+    return tuple(stack6([v[i] for v in vals]) for i in range(2))
+
+
+def un2(dev, n):
+    return [T.fq2_from_device(dev, (i,)) for i in range(n)]
+
+
+def un12(dev, n):
+    return [T.fq12_from_device(dev, (i,)) for i in range(n)]
+
+
+N = 6
+A2 = [rand_fq2() for _ in range(N)] + [(0, 0), (1, 0), (0, 1)]
+B2 = [rand_fq2() for _ in range(N)] + [(5, 7), (0, 0), (P - 1, P - 1)]
+M = len(A2)
+
+
+def test_fq2_ring_ops():
+    a, b = stack2(A2), stack2(B2)
+    add = jax.jit(T.fq2_add)(a, b)
+    mul = jax.jit(T.fq2_mul)(a, b)
+    sqr = jax.jit(T.fq2_sqr)(a)
+    xi = jax.jit(T.fq2_mul_by_xi)(a)
+    conj = jax.jit(T.fq2_conj)(a)
+    assert un2(add, M) == [F.fq2_add(x, y) for x, y in zip(A2, B2)]
+    assert un2(mul, M) == [F.fq2_mul(x, y) for x, y in zip(A2, B2)]
+    assert un2(sqr, M) == [F.fq2_sqr(x) for x in A2]
+    assert un2(xi, M) == [F.fq2_mul_by_xi(x) for x in A2]
+    assert un2(conj, M) == [F.fq2_conj(x) for x in A2]
+
+
+def test_fq2_inv():
+    a = stack2(A2)
+    inv = jax.jit(T.fq2_inv)(a)
+    got = un2(inv, M)
+    for x, g in zip(A2, got):
+        if x == (0, 0):
+            assert g == (0, 0)  # inv(0) = 0 convention
+        else:
+            assert g == F.fq2_inv(x)
+
+
+def test_fq2_pow_and_sqrt():
+    sq_vals = [F.fq2_sqr(rand_fq2()) for _ in range(4)]
+    nonsq = []
+    while len(nonsq) < 2:
+        c = rand_fq2()
+        if F.fq2_sqrt(c) is None:
+            nonsq.append(c)
+    vals = sq_vals + nonsq + [(0, 0)]
+    a = stack2(vals)
+    p3 = jax.jit(lambda x: T.fq2_pow_static(x, 65537))(a)
+    assert un2(p3, len(vals)) == [F.fq2_pow(v, 65537) for v in vals]
+    ok, root = jax.jit(T.fq2_sqrt)(a)
+    ok = np.asarray(ok)
+    roots = un2(root, len(vals))
+    for i, v in enumerate(vals):
+        expect = F.fq2_sqrt(v)
+        if expect is None:
+            assert not ok[i]
+        else:
+            assert ok[i]
+            assert F.fq2_sqr(roots[i]) == F.fq2_sqr(expect) == (
+                v[0] % P, v[1] % P)
+
+
+def test_fq2_is_large():
+    vals = [(1, 0), (P - 1, 0), (0, 1), (0, P - 1), ((P - 1) // 2, 0),
+            ((P + 1) // 2, 0)]
+    plain = (np.stack([fp.int_to_limbs(v[0]) for v in vals]),
+             np.stack([fp.int_to_limbs(v[1]) for v in vals]))
+    got = list(np.asarray(jax.jit(T.fq2_is_large)(plain)))
+    from teku_tpu.crypto.bls.curve import _fq2_is_large
+    assert got == [_fq2_is_large(v) for v in vals]
+
+
+def test_fq6_ops():
+    A6 = [rand_fq6() for _ in range(4)] + [F.FQ6_ZERO, F.FQ6_ONE]
+    B6 = [rand_fq6() for _ in range(4)] + [F.FQ6_ONE, F.FQ6_ZERO]
+    a, b = stack6(A6), stack6(B6)
+    n = len(A6)
+    mul = jax.jit(T.fq6_mul)(a, b)
+    sqr = jax.jit(T.fq6_sqr)(a)
+    inv = jax.jit(T.fq6_inv)(a)
+    frob = jax.jit(T.fq6_frobenius)(a)
+    got_mul = [T.fq6_from_device(mul, (i,)) for i in range(n)]
+    got_sqr = [T.fq6_from_device(sqr, (i,)) for i in range(n)]
+    got_inv = [T.fq6_from_device(inv, (i,)) for i in range(n)]
+    got_frob = [T.fq6_from_device(frob, (i,)) for i in range(n)]
+    for i in range(n):
+        assert got_mul[i] == F.fq6_mul(A6[i], B6[i])
+        assert got_sqr[i] == F.fq6_sqr(A6[i])
+        if A6[i] != F.FQ6_ZERO:
+            assert got_inv[i] == F.fq6_inv(A6[i])
+        assert got_frob[i] == F.fq6_frobenius(A6[i])
+
+
+def test_fq12_ops():
+    A12 = [rand_fq12() for _ in range(3)] + [F.FQ12_ONE]
+    B12 = [rand_fq12() for _ in range(3)] + [F.FQ12_ONE]
+    a, b = stack12(A12), stack12(B12)
+    n = len(A12)
+    mul = jax.jit(T.fq12_mul)(a, b)
+    sqr = jax.jit(T.fq12_sqr)(a)
+    inv = jax.jit(T.fq12_inv)(a)
+    conj = jax.jit(T.fq12_conj)(a)
+    fr1 = jax.jit(lambda x: T.fq12_frobenius(x, 1))(a)
+    fr2 = jax.jit(lambda x: T.fq12_frobenius(x, 2))(a)
+    for i in range(n):
+        assert un12(mul, n)[i] == F.fq12_mul(A12[i], B12[i])
+        assert un12(sqr, n)[i] == F.fq12_sqr(A12[i])
+        assert un12(inv, n)[i] == F.fq12_inv(A12[i])
+        assert un12(conj, n)[i] == F.fq12_conj(A12[i])
+        assert un12(fr1, n)[i] == F.fq12_frobenius(A12[i], 1)
+        assert un12(fr2, n)[i] == F.fq12_frobenius(A12[i], 2)
+
+
+def _cyclotomic(f):
+    t = F.fq12_mul(F.fq12_conj(f), F.fq12_inv(f))
+    return F.fq12_mul(F.fq12_frobenius(t, 2), t)
+
+
+def test_fq12_cyclo_sqr_and_is_one():
+    cyc = [_cyclotomic(rand_fq12()) for _ in range(3)] + [F.FQ12_ONE]
+    a = stack12(cyc)
+    n = len(cyc)
+    cs = jax.jit(T.fq12_cyclo_sqr)(a)
+    for i in range(n):
+        assert un12(cs, n)[i] == F.fq12_sqr(cyc[i])
+    ones = np.asarray(jax.jit(T.fq12_is_one)(a))
+    assert list(ones) == [c == F.FQ12_ONE for c in cyc]
