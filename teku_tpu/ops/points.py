@@ -223,9 +223,10 @@ def scalar_mul_static(k: FieldKit, e: int, p):
 
 
 def scalar_from_uint64(vals):
-    """uint64 scalar array (...,) -> bit array (..., 64) MSB first."""
-    shifts = jnp.arange(63, -1, -1, dtype=jnp.int64)
-    return (vals[..., None] >> shifts) & 1
+    """uint64 scalar array (...,) -> int64 bit array (..., 64) MSB first."""
+    vals = jnp.asarray(vals).astype(jnp.uint64)
+    shifts = jnp.arange(63, -1, -1, dtype=jnp.uint64)
+    return ((vals[..., None] >> shifts) & 1).astype(jnp.int64)
 
 
 # --------------------------------------------------------------------------
