@@ -1,0 +1,181 @@
+"""Batched hash-to-G2 for TPU (RFC 9380 BLS12381G2_XMD:SHA-256_SSWU_RO_).
+
+Split host/device at the hash boundary: expand_message_xmd is SHA-256
+over short inputs (microseconds on host, no device win), while the field
+math — simplified SWU, 3-isogeny, cofactor clearing — runs batched and
+branch-free on device.  The reference client hashes inside native blst
+(reference: infrastructure/bls/src/main/java/tech/pegasys/teku/bls/impl/
+blst/HashToCurve.java:23 — the DST this module shares via the oracle).
+
+Branch-free SSWU: the RFC's exceptional cases and the two-candidate x
+selection are computed unconditionally and resolved with selects.  Square
+roots use ONE Fq2 exponentiation per u via the SSWU identity
+g(x2) = Z^3 u^6 g(x1): candidates for sqrt(g(x1)) are gx1^((q+7)/16)
+times the four 8th-roots-of-unity square roots (q = p^2 ≡ 9 mod 16), and
+candidates for sqrt(g(x2)) reuse the same power times u^3 (Z^3)^((q+7)/16).
+
+Cofactor clearing is Budroni-Pintore via the psi endomorphism, matching
+the oracle's production path (crypto/bls/hash_to_curve.py:152-158).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..crypto.bls import fields as F
+from ..crypto.bls import hash_to_curve as OH
+from ..crypto.bls.constants import (DST_G2_POP, ISO3_X_DEN, ISO3_X_NUM,
+                                    ISO3_Y_DEN, ISO3_Y_NUM, P, SSWU_A2,
+                                    SSWU_B2, SSWU_Z2, X_ABS)
+from . import limbs as fp
+from . import points as PT
+from . import towers as T
+
+# --------------------------------------------------------------------------
+# Host-computed constants (oracle arithmetic, converted once)
+# --------------------------------------------------------------------------
+
+_NEG_B_OVER_A = F.fq2_neg(F.fq2_mul(SSWU_B2, F.fq2_inv(SSWU_A2)))
+_X1_EXC = F.fq2_mul(SSWU_B2, F.fq2_inv(F.fq2_mul(SSWU_Z2, SSWU_A2)))
+_Z3_POW_E = F.fq2_pow(
+    F.fq2_mul(F.fq2_sqr(SSWU_Z2), SSWU_Z2), T.SQRT_EXP)
+
+_C = {name: T.fq2_const(val) for name, val in dict(
+    A=SSWU_A2, B=SSWU_B2, Z=SSWU_Z2,
+    NEG_B_OVER_A=_NEG_B_OVER_A, X1_EXC=_X1_EXC, Z3E=_Z3_POW_E,
+    R1=T._SQRT_M1, R2=T._SQRT_C2, R3=T._SQRT_C3,
+).items()}
+
+
+def _c(name, like):
+    return T._bcast2(_C[name], like)
+
+
+# --------------------------------------------------------------------------
+# Map to curve (SSWU on E' then 3-isogeny to E), fully batched
+# --------------------------------------------------------------------------
+
+def _gx_prime(x, like):
+    x3 = T.fq2_mul(T.fq2_sqr(x), x)
+    return T.fq2_add(T.fq2_add(x3, T.fq2_mul(_c("A", like), x)),
+                     _c("B", like))
+
+
+def fq2_sgn0(a):
+    """RFC 9380 sgn0 on a Montgomery-form element (device)."""
+    plain = T.fq2_from_mont(a)
+    a0_odd = plain[0][..., 0] & 1
+    a0_zero = fp.is_zero(plain[0])
+    a1_odd = plain[1][..., 0] & 1
+    return a0_odd | (a0_zero.astype(jnp.int64) & a1_odd)
+
+
+def map_to_curve_sswu(u):
+    """Batched simplified SWU: Fq2 u -> affine point on E' (total)."""
+    z_u2 = T.fq2_mul(_c("Z", u), T.fq2_sqr(u))
+    tv = T.fq2_add(T.fq2_sqr(z_u2), z_u2)
+    tv_zero = T.fq2_is_zero(tv)
+    x1 = T.fq2_mul(_c("NEG_B_OVER_A", u),
+                   T.fq2_add(T._bcast2(T.FQ2_ONE_NP, u), T.fq2_inv(tv)))
+    x1 = T.fq2_select(tv_zero, _c("X1_EXC", u), x1)
+    gx1 = _gx_prime(x1, u)
+
+    # one exponentiation serves both sqrt cases
+    cand = T.fq2_pow_static(gx1, T.SQRT_EXP)
+    x2 = T.fq2_mul(z_u2, x1)
+    gx2 = _gx_prime(x2, u)   # == Z^3 u^6 gx1 by the SSWU identity
+    u3 = T.fq2_mul(T.fq2_sqr(u), u)
+    cand2 = T.fq2_mul(T.fq2_mul(u3, _c("Z3E", u)), cand)
+
+    found1 = jnp.zeros(tv_zero.shape, dtype=bool)
+    y1 = cand
+    found2 = jnp.zeros(tv_zero.shape, dtype=bool)
+    y2 = cand2
+    for root in (None, "R1", "R2", "R3"):
+        t1 = cand if root is None else T.fq2_mul(_c(root, u), cand)
+        m1 = T.fq2_eq(T.fq2_sqr(t1), gx1) & ~found1
+        y1 = T.fq2_select(m1, t1, y1)
+        found1 |= m1
+        t2 = cand2 if root is None else T.fq2_mul(_c(root, u), cand2)
+        m2 = T.fq2_eq(T.fq2_sqr(t2), gx2) & ~found2
+        y2 = T.fq2_select(m2, t2, y2)
+        found2 |= m2
+
+    x = T.fq2_select(found1, x1, x2)
+    y = T.fq2_select(found1, y1, y2)
+    flip = fq2_sgn0(u) != fq2_sgn0(y)
+    y = T.fq2_select(flip, T.fq2_neg(y), y)
+    return x, y
+
+
+def iso_map(x, y):
+    """3-isogeny E' -> E, affine->affine, one fused inversion."""
+    def horner(coeffs):
+        acc = T._bcast2(T.fq2_const(coeffs[-1]), x)
+        for c in reversed(coeffs[:-1]):
+            acc = T.fq2_add(T.fq2_mul(acc, x), T._bcast2(T.fq2_const(c), x))
+        return acc
+
+    x_num = horner(ISO3_X_NUM)
+    x_den = horner(ISO3_X_DEN)
+    y_num = horner(ISO3_Y_NUM)
+    y_den = horner(ISO3_Y_DEN)
+    # one inversion: 1/(x_den*y_den), then recover both
+    inv_prod = T.fq2_inv(T.fq2_mul(x_den, y_den))
+    x_out = T.fq2_mul(x_num, T.fq2_mul(inv_prod, y_den))
+    y_out = T.fq2_mul(y, T.fq2_mul(y_num, T.fq2_mul(inv_prod, x_den)))
+    return x_out, y_out
+
+
+# --------------------------------------------------------------------------
+# Cofactor clearing (Budroni-Pintore) + full pipeline
+# --------------------------------------------------------------------------
+
+def clear_cofactor(p):
+    """h_eff*P = [x^2-x-1]P + [x-1]psi(P) + psi^2(2P), with the BLS
+    parameter negative: [x]Q computed as -[|x|]Q."""
+    def mul_x(q):
+        return PT.point_neg(PT.G2_KIT,
+                            PT.scalar_mul_static(PT.G2_KIT, X_ABS, q))
+
+    a = PT.point_add(PT.G2_KIT, mul_x(p), PT.point_neg(PT.G2_KIT, p))
+    res = PT.point_add(PT.G2_KIT, mul_x(a), PT.point_neg(PT.G2_KIT, p))
+    res = PT.point_add(PT.G2_KIT, res, PT.g2_psi(a))
+    dbl = PT.point_double(PT.G2_KIT, p)
+    res = PT.point_add(PT.G2_KIT, res, PT.g2_psi(PT.g2_psi(dbl)))
+    return res
+
+
+def hash_to_g2_device(u0, u1):
+    """Device pipeline: two Fq2 draws -> G2 Jacobian point (in-subgroup)."""
+    x0, y0 = iso_map(*map_to_curve_sswu(u0))
+    x1, y1 = iso_map(*map_to_curve_sswu(u1))
+    one = T._bcast2(T.FQ2_ONE_NP, x0)
+    r = PT.point_add(PT.G2_KIT, (x0, y0, one), (x1, y1, one))
+    return clear_cofactor(r)
+
+
+def messages_to_fields(messages, dst: bytes = DST_G2_POP):
+    """Host: list of message bytes -> batched Montgomery Fq2 draws (u0, u1).
+
+    Mirrors the oracle's hash_to_field (crypto/bls/hash_to_curve.py:54-65).
+    """
+    u0c0, u0c1, u1c0, u1c1 = [], [], [], []
+    for msg in messages:
+        (a, b), (c, d) = OH.hash_to_field_fq2(msg, 2, dst)
+        u0c0.append(fp.int_to_mont(a))
+        u0c1.append(fp.int_to_mont(b))
+        u1c0.append(fp.int_to_mont(c))
+        u1c1.append(fp.int_to_mont(d))
+    return ((np.stack(u0c0), np.stack(u0c1)),
+            (np.stack(u1c0), np.stack(u1c1)))
+
+
+def to_affine_g2(p):
+    """Jacobian -> affine on device (one inversion); infinity lanes
+    return garbage coords — callers carry the infinity mask."""
+    zinv = T.fq2_inv(p[2])
+    zinv2 = T.fq2_sqr(zinv)
+    return (T.fq2_mul(p[0], zinv2),
+            T.fq2_mul(p[1], T.fq2_mul(zinv2, zinv)))

@@ -52,6 +52,8 @@ class FieldKit(NamedTuple):
     select: callable
     const: callable       # host int-tuple / int -> device constant
     b_coeff: object       # curve b as a host constant (device-ready)
+    stack: callable       # list of elements -> wide-lane element
+    unstack: callable     # wide-lane element -> list
 
 
 def _fp_const(v: int):
@@ -63,16 +65,25 @@ def _fq2_const(v):
     return (jnp.asarray(c[0]), jnp.asarray(c[1]))
 
 
+def _fp_stack(elems):
+    return jnp.stack(elems, axis=-2)
+
+
+def _fp_unstack(s):
+    return [s[..., i, :] for i in range(s.shape[-2])]
+
+
 G1_KIT = FieldKit(
     add=fp.add, sub=fp.sub, mul=fp.mont_mul, sqr=fp.mont_sqr, neg=fp.neg,
     double=fp.double, is_zero=fp.is_zero, eq=fp.eq, select=fp.select,
-    const=_fp_const, b_coeff=B_G1,
+    const=_fp_const, b_coeff=B_G1, stack=_fp_stack, unstack=_fp_unstack,
 )
 
 G2_KIT = FieldKit(
     add=T.fq2_add, sub=T.fq2_sub, mul=T.fq2_mul, sqr=T.fq2_sqr,
     neg=T.fq2_neg, double=T.fq2_double, is_zero=T.fq2_is_zero,
     eq=T.fq2_eq, select=T.fq2_select, const=_fq2_const, b_coeff=B_G2,
+    stack=T._fq2s, unstack=T._fq2u,
 )
 
 
@@ -108,45 +119,51 @@ def point_neg(k: FieldKit, p):
 
 
 def point_double(k: FieldKit, p):
-    """Jacobian doubling (a=0).  Total: doubling infinity gives Z3=0."""
+    """Jacobian doubling (a=0).  Total: doubling infinity gives Z3=0.
+    Independent multiplies batched into wide-lane rounds."""
     X1, Y1, Z1 = p
-    A = k.sqr(X1)
-    B = k.sqr(Y1)
-    C = k.sqr(B)
-    D = k.sub(k.sub(k.sqr(k.add(X1, B)), A), C)
-    D = k.add(D, D)
+    A, B, YZ = k.unstack(k.mul(k.stack([X1, Y1, Y1]),
+                               k.stack([X1, Y1, Z1])))
     E = k.add(k.add(A, A), A)
-    Fv = k.sqr(E)
+    XB = k.add(X1, B)
+    XB2, C, Fv = k.unstack(k.mul(k.stack([XB, B, E]),
+                                 k.stack([XB, B, E])))
+    D = k.sub(k.sub(XB2, A), C)
+    D = k.add(D, D)
     X3 = k.sub(Fv, k.add(D, D))
     C2 = k.add(C, C)
     C4 = k.add(C2, C2)
     C8 = k.add(C4, C4)
     Y3 = k.sub(k.mul(E, k.sub(D, X3)), C8)
-    Z3 = k.mul(k.add(Y1, Y1), Z1)
+    Z3 = k.add(YZ, YZ)
     return (X3, Y3, Z3)
 
 
 def point_add(k: FieldKit, p, q):
     """Unified Jacobian addition: every exceptional case (either input at
-    infinity, P == Q, P == -Q) is computed and selected lane-wise."""
+    infinity, P == Q, P == -Q) is computed and selected lane-wise.
+    Independent multiplies batched into wide-lane rounds."""
     X1, Y1, Z1 = p
     X2, Y2, Z2 = q
-    Z1Z1 = k.sqr(Z1)
-    Z2Z2 = k.sqr(Z2)
-    U1 = k.mul(X1, Z2Z2)
-    U2 = k.mul(X2, Z1Z1)
-    S1 = k.mul(Y1, k.mul(Z2, Z2Z2))
-    S2 = k.mul(Y2, k.mul(Z1, Z1Z1))
+    Z1Z1, Z2Z2, Z1Z2 = k.unstack(k.mul(k.stack([Z1, Z2, Z1]),
+                                       k.stack([Z1, Z2, Z2])))
+    U1, U2, Z2c, Z1c = k.unstack(k.mul(
+        k.stack([X1, X2, Z2, Z1]),
+        k.stack([Z2Z2, Z1Z1, Z2Z2, Z1Z1])))
+    S1, S2 = k.unstack(k.mul(k.stack([Y1, Y2]), k.stack([Z2c, Z1c])))
     H = k.sub(U2, U1)
     rr = k.sub(S2, S1)
     rr = k.add(rr, rr)
-    I = k.sqr(k.add(H, H))
-    J = k.mul(H, I)
-    V = k.mul(U1, I)
-    X3 = k.sub(k.sub(k.sqr(rr), J), k.add(V, V))
-    S1J = k.mul(S1, J)
-    Y3 = k.sub(k.mul(rr, k.sub(V, X3)), k.add(S1J, S1J))
-    Z3 = k.mul(k.add(k.mul(Z1, Z2), k.mul(Z1, Z2)), H)
+    H2 = k.add(H, H)
+    I, R2 = k.unstack(k.mul(k.stack([H2, rr]), k.stack([H2, rr])))
+    J, V, ZZH = k.unstack(k.mul(
+        k.stack([H, U1, k.add(Z1Z2, Z1Z2)]),
+        k.stack([I, I, H])))
+    X3 = k.sub(k.sub(R2, J), k.add(V, V))
+    RVX, S1J = k.unstack(k.mul(k.stack([rr, S1]),
+                               k.stack([k.sub(V, X3), J])))
+    Y3 = k.sub(RVX, k.add(S1J, S1J))
+    Z3 = ZZH
     out = (X3, Y3, Z3)
 
     same_x = k.is_zero(H)

@@ -1,0 +1,34 @@
+"""Device hash-to-G2 vs the oracle (which carries RFC 9380 vectors)."""
+
+import jax
+import numpy as np
+
+from teku_tpu.crypto.bls import curve as C
+from teku_tpu.crypto.bls import hash_to_curve as OH
+from teku_tpu.ops import h2c
+from teku_tpu.ops import points as PT
+from teku_tpu.ops import towers as T
+
+MSGS = [b"", b"abc", b"hello world", b"\x00" * 32, b"q" * 100]
+
+
+def test_map_to_curve_matches_oracle():
+    us = []
+    for m in MSGS:
+        us.extend(OH.hash_to_field_fq2(m, 2))
+    dev = (np.stack([np.asarray(T.fq2_const(u)[0]) for u in us]),
+           np.stack([np.asarray(T.fq2_const(u)[1]) for u in us]))
+    x, y = jax.jit(h2c.map_to_curve_sswu)(dev)
+    for i, u in enumerate(us):
+        ex, ey = OH.map_to_curve_sswu_g2(u)
+        assert T.fq2_from_device(x, (i,)) == ex
+        assert T.fq2_from_device(y, (i,)) == ey
+
+
+def test_full_hash_to_g2_matches_oracle():
+    u0, u1 = h2c.messages_to_fields(MSGS)
+    out = jax.jit(h2c.hash_to_g2_device)(u0, u1)
+    for i, m in enumerate(MSGS):
+        got = PT.g2_from_device(out, (i,))
+        expect = OH.hash_to_g2(m)
+        assert C.point_eq(C.FQ2_OPS, got, expect)

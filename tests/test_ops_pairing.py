@@ -1,0 +1,103 @@
+"""Pairing kernel vs the pure-Python oracle (bilinearity + agreement)."""
+
+import random
+
+import jax
+import numpy as np
+
+from teku_tpu.crypto.bls import curve as C
+from teku_tpu.crypto.bls import fields as F
+from teku_tpu.crypto.bls import pairing as OP
+from teku_tpu.crypto.bls.constants import R
+from teku_tpu.ops import limbs as fp
+from teku_tpu.ops import pairing as PR
+from teku_tpu.ops import towers as T
+
+rng = random.Random(0xA7E)
+
+
+def aff_g1(k):
+    return C.to_affine(C.FQ_OPS, C.point_mul(C.FQ_OPS, k, C.G1_GENERATOR))
+
+
+def aff_g2(k):
+    return C.to_affine(C.FQ2_OPS, C.point_mul(C.FQ2_OPS, k, C.G2_GENERATOR))
+
+
+def stack_p(pts):
+    """Affine oracle G1 points -> batched device (x, y)."""
+    return (np.stack([fp.int_to_mont(p[0]) for p in pts]),
+            np.stack([fp.int_to_mont(p[1]) for p in pts]))
+
+
+def stack_q(pts):
+    return tuple(
+        (np.stack([fp.int_to_mont(p[i][0]) for p in pts]),
+         np.stack([fp.int_to_mont(p[i][1]) for p in pts]))
+        for i in range(2))
+
+
+_miller = jax.jit(PR.miller_loop)
+_finalexp = jax.jit(PR.final_exponentiation)
+
+
+def test_miller_loop_matches_oracle():
+    ks = [rng.randrange(1, R) for _ in range(3)]
+    ls = [rng.randrange(1, R) for _ in range(3)]
+    p = stack_p([aff_g1(k) for k in ks])
+    q = stack_q([aff_g2(l) for l in ls])
+    got = _miller(p, q)
+    for i, (k, l) in enumerate(zip(ks, ls)):
+        expect = OP.miller_loop(aff_g1(k), aff_g2(l))
+        assert T.fq12_from_device(got, (i,)) == expect
+
+
+def test_final_exponentiation_matches_oracle():
+    k, l = rng.randrange(1, R), rng.randrange(1, R)
+    ml = OP.miller_loop(aff_g1(k), aff_g2(l))
+    dev = T.fq12_to_device(ml)
+    dev = jax.tree_util.tree_map(lambda x: x[None], dev)
+    got = _finalexp(dev)
+    assert T.fq12_from_device(got, (0,)) == OP.final_exponentiation(ml)
+
+
+def test_bilinearity_on_device():
+    # e([a]P, [b]Q) == e(P, [ab]Q); check via ML(aP,bQ) * ML(P,-abQ) -> 1
+    a, b = rng.randrange(1, R), rng.randrange(1, R)
+    p1, q1 = aff_g1(a), aff_g2(b)
+    p2 = aff_g1(1)
+    q2_neg = C.to_affine(C.FQ2_OPS, C.point_neg(
+        C.FQ2_OPS, C.point_mul(C.FQ2_OPS, a * b % R, C.G2_GENERATOR)))
+    p = stack_p([p1, p2])
+    q = stack_q([q1, q2_neg])
+    ml = _miller(p, q)
+    prod = PR.batch_product(ml)
+    prod = jax.tree_util.tree_map(lambda x: x[None], prod)
+    ok = np.asarray(jax.jit(PR.pairing_check)(prod))
+    assert ok[0]
+    # and a wrong pair does NOT verify
+    q_bad = stack_q([q1, aff_g2(a * b % R)])
+    ml2 = _miller(p, q_bad)
+    prod2 = jax.tree_util.tree_map(
+        lambda x: x[None], PR.batch_product(ml2))
+    assert not np.asarray(jax.jit(PR.pairing_check)(prod2))[0]
+
+
+def test_miller_mask_gives_one():
+    p = stack_p([aff_g1(5), aff_g1(7)])
+    q = stack_q([aff_g2(3), aff_g2(11)])
+    mask = np.array([True, False])
+    got = jax.jit(PR.miller_loop)(p, q, mask)
+    assert T.fq12_from_device(got, (1,)) == F.FQ12_ONE
+    assert T.fq12_from_device(got, (0,)) == OP.miller_loop(aff_g1(5), aff_g2(3))
+
+
+def test_batch_product_odd():
+    vals = [OP.miller_loop(aff_g1(i + 2), aff_g2(3)) for i in range(3)]
+    dev = jax.tree_util.tree_map(
+        lambda *xs: np.stack(xs), *[T.fq12_to_device(v) for v in vals])
+    got = PR.batch_product(dev)
+    expect = F.FQ12_ONE
+    for v in vals:
+        expect = F.fq12_mul(expect, v)
+    assert T.fq12_from_device(got, ()) == expect
