@@ -177,5 +177,8 @@ def to_affine_g2(p):
     return garbage coords — callers carry the infinity mask."""
     zinv = T.fq2_inv(p[2])
     zinv2 = T.fq2_sqr(zinv)
-    return (T.fq2_mul(p[0], zinv2),
-            T.fq2_mul(p[1], T.fq2_mul(zinv2, zinv)))
+    x = T.fq2_mul(p[0], zinv2)
+    y = T.fq2_mul(p[1], T.fq2_mul(zinv2, zinv))
+    out = fp.compress(jnp.stack([x[0], x[1], y[0], y[1]], axis=-2))
+    return ((out[..., 0, :], out[..., 1, :]),
+            (out[..., 2, :], out[..., 3, :]))

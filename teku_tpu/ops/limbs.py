@@ -1,21 +1,35 @@
 """fp381 fixed-width limb arithmetic for TPU (JAX).
 
-The base field Fq of BLS12-381 (381-bit prime P) represented as 15 limbs of
-26 bits each stored in int64 lanes, in Montgomery form (a*R mod P with
-R = 2^390).  This replaces the native blst limb arithmetic the reference
-client calls through JNI (reference: infrastructure/bls/src/main/java/tech/
-pegasys/teku/bls/impl/blst/BlstBLS12381.java — there delegated to C/asm).
+The base field Fq of BLS12-381 (381-bit prime P) as 15 limbs of 26 bits
+in int64 lanes, Montgomery form (a*R mod P, R = 2^390).  This replaces
+the native blst limb arithmetic the reference client calls through JNI
+(reference: infrastructure/bls/src/main/java/tech/pegasys/teku/bls/impl/
+blst/BlstBLS12381.java — there delegated to C/asm).
 
-Design for TPU/XLA:
-- Element = trailing dim of size 15; every op broadcasts over arbitrary
-  leading batch dims, so batching is plain array broadcasting (no vmap
-  needed) and XLA sees large fused elementwise ops feeding the VPU.
-- 26-bit radix: limb products are <= 2^52 and column sums across the
-  schoolbook multiply + Montgomery reduction stay < 2^58, well inside
-  int64 — no data-dependent carries, no overflow branches.
-- Branch-free throughout: conditional reduction is a lane-wise select,
-  so everything jits with static shapes and is constant-time by
-  construction (the reference gets this from blst's asm).
+LAZY-REDUCTION DESIGN.  Serial carry chains are the enemy of both XLA
+compile time and TPU runtime, so they are paid only where mathematically
+required:
+
+- `add`/`sub`/`neg`/`double`/`mul_small` are PURE ELEMENTWISE lane ops —
+  no carry propagation, no mod-P reduction.  Limbs are signed and are
+  allowed to grow; int64 headroom absorbs it.
+- `compress` folds a value back to one "unit" (low limbs canonical in
+  [0, 2^W), small signed top limb) with a single carry scan.
+- `mont_mul`/`mont_sqr` accept bounded lazy operands and emit one
+  compressed unit with value in (-P, 2P): one reduction scan plus one
+  compress scan, and NO conditional subtraction.
+- Exact mod-P representatives exist only where semantics demand them
+  (`canonical`, used by eq / is-zero / wire-format comparisons): a
+  Montgomery multiply maps any lazy value x to x*R mod P in [0, P),
+  which is a bijection on residue classes, so comparing canonical
+  images decides equality.
+
+Operand-magnitude contract: a compressed unit has low limbs < 2^W and
+|top limb| < 2^22.  Callers may feed mont_mul sums/differences of units
+as long as units(a) * units(b) <= 64 — the product-column bound
+15 * (ua*2^W)(ub*2^W) then stays under 2^62.  Call sites that approach
+the bound carry a comment.  Everything broadcasts over leading batch
+dims; batching is plain array broadcasting.
 
 Layer validation: tests/test_ops_limbs.py checks every op against the
 pure-Python oracle (teku_tpu/crypto/bls/fields.py).
@@ -51,14 +65,15 @@ def int_to_limbs(x: int) -> np.ndarray:
 
 
 def limbs_to_int(a) -> int:
-    """Host-side: limb vector -> python int."""
+    """Host-side: (possibly lazy, signed) limb vector -> python int mod P."""
     a = np.asarray(a)
-    return sum(int(a[..., i]) << (W * i) for i in range(L))
+    return sum(int(a[..., i]) << (W * i) for i in range(L)) % P
 
 
 P_LIMBS = int_to_limbs(P)
 ZERO = np.zeros(L, dtype=np.int64)
 ONE_MONT = int_to_limbs(R_MOD_P)          # 1 in Montgomery form
+ONE_PLAIN = int_to_limbs(1)
 R2_LIMBS = int_to_limbs(R2_MOD_P)
 
 
@@ -73,66 +88,28 @@ def mont_to_int(a) -> int:
 
 
 # --------------------------------------------------------------------------
-# Core ops.  All take/return int64 arrays of shape (..., L), canonical
-# limbs (< 2^W), value < P, Montgomery form where noted.
+# Lazy elementwise ops (no carries, no reduction)
 # --------------------------------------------------------------------------
 
-def _carry_propagate(r):
-    """Normalize limbs after accumulation: (..., L) with values < 2^63-ish,
-    total value < 2^(W*L), into canonical limbs.  Sequential carry chain
-    expressed as a scan so the compiled graph is O(1) in limb count."""
-    def step(c, col):
-        v = col + c
-        return v >> W, v & MASK
-    c0 = jnp.zeros(r.shape[:-1], dtype=jnp.int64)
-    _, limbs = lax.scan(step, c0, jnp.moveaxis(r, -1, 0))
-    return jnp.moveaxis(limbs, 0, -1)
-
-
-def _sub_with_borrow(a, b):
-    """(a - b) limbwise with sequential borrow; returns (diff, borrow)
-    where borrow is 0 if a >= b else -1.  Inputs canonical."""
-    a, b = jnp.broadcast_arrays(a, b)
-    def step(c, cols):
-        v = cols[0] - cols[1] + c
-        return v >> W, v & MASK   # arithmetic shift: carry 0 or -1
-    c0 = jnp.zeros(a.shape[:-1], dtype=jnp.int64)
-    c, limbs = lax.scan(step, c0,
-                        (jnp.moveaxis(a, -1, 0), jnp.moveaxis(b, -1, 0)))
-    return jnp.moveaxis(limbs, 0, -1), c
-
-
-def _cond_sub_p(a):
-    """a < 2P canonical-limbed -> a mod P."""
-    p = jnp.asarray(P_LIMBS)
-    d, borrow = _sub_with_borrow(a, p)
-    return jnp.where((borrow != 0)[..., None], a, d)
-
-
 def add(a, b):
-    """Field addition (works in either plain or Montgomery form)."""
-    return _cond_sub_p(_carry_propagate(a + b))
+    return a + b
 
 
 def sub(a, b):
-    """Field subtraction."""
-    d, borrow = _sub_with_borrow(a, b)
-    dp = _carry_propagate(d + jnp.asarray(P_LIMBS))
-    return jnp.where((borrow != 0)[..., None], dp, d)
+    return a - b
 
 
 def neg(a):
-    """Field negation: P - a, with -0 = 0."""
-    d, _ = _sub_with_borrow(jnp.asarray(P_LIMBS), a)
-    return jnp.where(is_zero(a)[..., None], jnp.zeros_like(a), d)
+    return -a
 
 
-def is_zero(a):
-    return jnp.all(a == 0, axis=-1)
+def double(a):
+    return a + a
 
 
-def eq(a, b):
-    return jnp.all(a == b, axis=-1)
+def mul_small(a, k: int):
+    """Multiply by a small static int (grows units by |k|)."""
+    return a * k
 
 
 def select(cond, a, b):
@@ -140,22 +117,64 @@ def select(cond, a, b):
     return jnp.where(cond[..., None], a, b)
 
 
+# --------------------------------------------------------------------------
+# Carry machinery
+# --------------------------------------------------------------------------
+
+def compress(r):
+    """One signed carry scan; folds the final carry into the top limb.
+
+    Input: any lazy value with |limbs| < 2^62 and |value| < 2^(W*L+20).
+    Output: value-preserving unit — limbs 0..L-2 in [0, 2^W), top limb
+    signed with |top| ~ value / 2^(W*(L-1)).
+    """
+    def step(c, col):
+        v = col + c
+        return v >> W, v & MASK
+    c0 = jnp.zeros(r.shape[:-1], dtype=jnp.int64)
+    c, limbs = lax.scan(step, c0, jnp.moveaxis(r, -1, 0))
+    limbs = jnp.moveaxis(limbs, 0, -1)
+    return limbs.at[..., L - 1].add(c * RADIX)
+
+
+def _sub_with_borrow(a, b):
+    """(a - b) limbwise with sequential borrow; canonical inputs.
+    Returns (diff, borrow): borrow 0 if a >= b else -1."""
+    a, b = jnp.broadcast_arrays(a, b)
+    def step(c, cols):
+        v = cols[0] - cols[1] + c
+        return v >> W, v & MASK
+    c0 = jnp.zeros(a.shape[:-1], dtype=jnp.int64)
+    c, limbs = lax.scan(step, c0,
+                        (jnp.moveaxis(a, -1, 0), jnp.moveaxis(b, -1, 0)))
+    return jnp.moveaxis(limbs, 0, -1), c
+
+
+def _cond_sub_p(a):
+    """Canonical-limbed a in [0, 2P) -> a mod P."""
+    p = jnp.asarray(P_LIMBS)
+    d, borrow = _sub_with_borrow(a, p)
+    return jnp.where((borrow != 0)[..., None], a, d)
+
+
 def gt(a, b):
-    """a > b as canonical plain-form (non-Montgomery) limb integers."""
+    """a > b as integers; both inputs must be truly canonical."""
     _, borrow = _sub_with_borrow(b, a)
     return borrow != 0
 
+
+# --------------------------------------------------------------------------
+# Montgomery multiplication
+# --------------------------------------------------------------------------
 
 def _pad_last(x, lo, hi):
     return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(lo, hi)])
 
 
 def _mont_reduce(t):
-    """Word-serial Montgomery reduction of 2L product columns.
-
-    The 15-step serial dependency (each m_i needs the running low column)
-    is a scan whose body shifts the column window down one word per step;
-    column magnitudes stay < 2^58, inside int64.
+    """Word-serial Montgomery reduction of 2L product columns (one scan),
+    then compress.  Signed columns are fine: `& MASK` and arithmetic
+    shifts compute the correct residues/floors.  Output value in (-P, 2P).
     """
     p_pad = _pad_last(jnp.asarray(P_LIMBS), 0, L)
 
@@ -169,15 +188,14 @@ def _mont_reduce(t):
         return t, None
 
     t, _ = lax.scan(red, t, None, length=L)
-    return _cond_sub_p(_carry_propagate(t[..., :L]))
+    return compress(t[..., :L])
 
 
 def mont_mul(a, b):
-    """Montgomery multiplication: returns a*b*R^-1 mod P.
+    """Montgomery product a*b*R^-1 (one unit out, value in (-P, 2P)).
 
-    Schoolbook column products built by pad-and-sum (no scatter ops —
-    XLA fuses the static pads into one elementwise reduction), then the
-    scan-based word-serial reduction.
+    Schoolbook column products built by pad-and-sum — no scatters, no
+    carries; XLA fuses the static pads into one elementwise reduction.
     """
     t = sum(_pad_last(a[..., i:i + 1] * b, i, L - i) for i in range(L))
     return _mont_reduce(t)
@@ -196,30 +214,48 @@ def mont_sqr(a):
 
 
 def to_mont(a):
-    """Plain limbs -> Montgomery form."""
+    """Plain limbs -> Montgomery form (one unit)."""
     return mont_mul(a, jnp.asarray(R2_LIMBS))
 
 
-def from_mont(a):
-    """Montgomery form -> plain limbs."""
+# --------------------------------------------------------------------------
+# Canonical representatives (equality / wire formats)
+# --------------------------------------------------------------------------
+
+def canonical(a):
+    """Map any bounded lazy value to THE canonical limbs of (a*R) mod P.
+
+    a*R mod P is a bijection on residue classes, so canonical images
+    decide equality and zero-ness; callers comparing against constants
+    must pass them through the same map.
+    """
+    y = mont_mul(a, jnp.asarray(R2_LIMBS))   # value in (-P, 2P)
+    y = compress(y + jnp.asarray(P_LIMBS))   # (0, 3P), canonical limbs
+    return _cond_sub_p(_cond_sub_p(y))
+
+
+def canonical_plain(a):
+    """Exact canonical plain-form (non-Montgomery) limbs of a Montgomery
+    unit — for wire-format comparisons (sign bit, x < P checks)."""
     one = jnp.zeros_like(a).at[..., 0].set(1)
-    return mont_mul(a, one)
+    y = mont_mul(a, one)                     # value = plain, in (-P, 2P)
+    y = compress(y + jnp.asarray(P_LIMBS))
+    return _cond_sub_p(_cond_sub_p(y))
 
 
-def double(a):
-    return add(a, a)
+def is_zero(a):
+    """a ≡ 0 mod P, for any bounded lazy value."""
+    return jnp.all(canonical(a) == 0, axis=-1)
 
 
-def mul_small(a, k: int):
-    """Multiply by a small static non-negative int (k < 2^10 or so)."""
-    assert 0 <= k
-    if k == 0:
-        return jnp.zeros_like(a)
-    r = _carry_propagate(a * k)
-    # value < k*P: subtract P up to k-1 times (static unroll, select each)
-    for _ in range(k - 1):
-        r = _cond_sub_p(r)
-    return r
+def eq(a, b):
+    """a ≡ b mod P, for bounded lazy values."""
+    return is_zero(a - b)
+
+
+def from_mont(a):
+    """Montgomery unit -> canonical plain limbs."""
+    return canonical_plain(a)
 
 
 # --------------------------------------------------------------------------
@@ -227,7 +263,7 @@ def mul_small(a, k: int):
 # --------------------------------------------------------------------------
 
 def pow_static(a, e: int):
-    """a^e mod P for a static python-int exponent; a in Montgomery form.
+    """a^e mod P for a static python-int exponent; a a Montgomery unit.
 
     Square-and-multiply over the exponent's bits as a traced scan: one
     sqr + one selected mul per bit, so the compiled graph is O(1) in the
@@ -249,8 +285,8 @@ def pow_static(a, e: int):
 
 
 def inv(a):
-    """Field inverse via Fermat (a^(P-2)); a in Montgomery form.
-    inv(0) returns 0 (callers select around it, branch-free)."""
+    """Field inverse via Fermat (a^(P-2)); inv(0) ≡ 0 (callers select
+    around it, branch-free)."""
     return pow_static(a, P - 2)
 
 

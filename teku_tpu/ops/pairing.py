@@ -55,30 +55,35 @@ _RUNS = _parse_runs(_X_BITS)
 
 def _dbl_step(t, px_neg, py):
     """Double T; line through T evaluated at P as sparse (c0, c1, c2).
-    Independent fq2 multiplies are gathered into wide calls per round."""
+    Independent fq2 multiplies are gathered into wide calls per round;
+    lazy intermediates are compressed before they would breach the limb
+    layer's operand-magnitude contract.  T coords must be one unit."""
     X, Y, Z = t
     A, B, Z2 = T._fq2u(T.fq2_sqr(T._fq2s([X, Y, Z])))
-    E = T.fq2_add(T.fq2_add(A, A), A)
+    XB, E = T._fq2u(T.fq2_compress(T._fq2s(
+        [T.fq2_add(X, B), T.fq2_add(T.fq2_add(A, A), A)])))
     # round 2: squares of (X+B), B, E and product Y*Z
-    r2 = T._fq2u(T.fq2_mul(T._fq2s([T.fq2_add(X, B), B, E, Y]),
-                           T._fq2s([T.fq2_add(X, B), B, E, Z])))
+    r2 = T._fq2u(T.fq2_mul(T._fq2s([XB, B, E, Y]),
+                           T._fq2s([XB, B, E, Z])))
     XB2, Cc, Fv, YZ = r2
     D = T.fq2_sub(T.fq2_sub(XB2, A), Cc)
     D = T.fq2_add(D, D)
-    X3 = T.fq2_sub(Fv, T.fq2_add(D, D))
+    Z3 = T.fq2_add(YZ, YZ)
+    D, X3, Z3 = T._fq2u(T.fq2_compress(T._fq2s(
+        [D, T.fq2_sub(Fv, T.fq2_add(D, D)), Z3])))
     C2 = T.fq2_add(Cc, Cc)
     C4 = T.fq2_add(C2, C2)
     C8 = T.fq2_add(C4, C4)
-    Z3 = T.fq2_add(YZ, YZ)
     # round 3: E*(D-X3), Z3*Z2, E*X, E*Z2
     r3 = T._fq2u(T.fq2_mul(T._fq2s([E, Z3, E, E]),
                            T._fq2s([T.fq2_sub(D, X3), Z2, X, Z2])))
     EDX, Z3Z2, EX, EZ2 = r3
     Y3 = T.fq2_sub(EDX, C8)
+    X3, Y3, Z3 = T._fq2u(T.fq2_compress(T._fq2s([X3, Y3, Z3])))
     # scale by the G1 coordinates (two fq2-by-fp muls in one width-4 call)
+    xiz = T.fq2_mul_by_xi(Z3Z2)
     sc = fp.mont_mul(
-        jnp.stack([T.fq2_mul_by_xi(Z3Z2)[0], T.fq2_mul_by_xi(Z3Z2)[1],
-                   EZ2[0], EZ2[1]], axis=-2),
+        jnp.stack([xiz[0], xiz[1], EZ2[0], EZ2[1]], axis=-2),
         jnp.stack([py, py, px_neg, px_neg], axis=-2))
     c0 = (sc[..., 0, :], sc[..., 1, :])
     c1 = T.fq2_sub(EX, T.fq2_add(B, B))
@@ -87,15 +92,16 @@ def _dbl_step(t, px_neg, py):
 
 
 def _add_step(t, q, px_neg, py):
-    """Mixed-add affine Q into T; chord line at P as sparse coeffs."""
+    """Mixed-add affine Q into T; chord line at P as sparse coeffs.
+    T coords and affine Q must be one unit."""
     X, Y, Z = t
     xq, yq = q
     Z2 = T.fq2_sqr(Z)
     r1 = T._fq2u(T.fq2_mul(T._fq2s([xq, Z2]), T._fq2s([Z2, Z])))
     U2, Z3cu = r1
     S2 = T.fq2_mul(yq, Z3cu)
-    H = T.fq2_sub(U2, X)
-    r = T.fq2_sub(S2, Y)
+    H, r = T._fq2u(T.fq2_compress(T._fq2s(
+        [T.fq2_sub(U2, X), T.fq2_sub(S2, Y)])))
     r2 = T._fq2u(T.fq2_mul(T._fq2s([H, r, Z]), T._fq2s([H, r, H])))
     H2, R2, Z3 = r2
     r3 = T._fq2u(T.fq2_mul(T._fq2s([H, X, r, yq]),
@@ -105,6 +111,7 @@ def _add_step(t, q, px_neg, py):
     r4 = T._fq2u(T.fq2_mul(T._fq2s([r, Y]),
                            T._fq2s([T.fq2_sub(V, X3), H3])))
     Y3 = T.fq2_sub(r4[0], r4[1])
+    X3, Y3, Z3 = T._fq2u(T.fq2_compress(T._fq2s([X3, Y3, Z3])))
     xiz3 = T.fq2_mul_by_xi(Z3)
     sc = fp.mont_mul(
         jnp.stack([xiz3[0], xiz3[1], r[0], r[1]], axis=-2),
@@ -141,7 +148,7 @@ def _mul_by_line(f, line):
     f1c0 = (p[15], p[16], p[17])
     res0 = T.fq6_add(f0c0, (T.fq2_mul_by_xi(t1[2]), t1[0], t1[1]))
     res1 = T.fq6_add(s0, f1c0)
-    return (res0, res1)
+    return T.fq12_compress((res0, res1))
 
 
 # --------------------------------------------------------------------------

@@ -54,6 +54,8 @@ class FieldKit(NamedTuple):
     b_coeff: object       # curve b as a host constant (device-ready)
     stack: callable       # list of elements -> wide-lane element
     unstack: callable     # wide-lane element -> list
+    zero_many: callable   # list of (lazy) elements -> list of zero-masks
+    compress: callable    # lazy element -> one-unit element
 
 
 def _fp_const(v: int):
@@ -73,10 +75,29 @@ def _fp_unstack(s):
     return [s[..., i, :] for i in range(s.shape[-2])]
 
 
+def _fp_zero_many(elems):
+    """Batched ≡0-mod-P tests: ONE canonical map for all of them."""
+    c = fp.canonical(jnp.stack(elems, axis=-2))
+    z = jnp.all(c == 0, axis=-1)
+    return [z[..., i] for i in range(len(elems))]
+
+
+def _fq2_zero_many(elems):
+    c = fp.canonical(jnp.stack(
+        [comp for e in elems for comp in e], axis=-2))
+    z = jnp.all(c == 0, axis=-1)
+    return [z[..., 2 * i] & z[..., 2 * i + 1] for i in range(len(elems))]
+
+
+def _fq2_compress(a):
+    return T.fq2_compress(a)
+
+
 G1_KIT = FieldKit(
     add=fp.add, sub=fp.sub, mul=fp.mont_mul, sqr=fp.mont_sqr, neg=fp.neg,
     double=fp.double, is_zero=fp.is_zero, eq=fp.eq, select=fp.select,
     const=_fp_const, b_coeff=B_G1, stack=_fp_stack, unstack=_fp_unstack,
+    zero_many=_fp_zero_many, compress=fp.compress,
 )
 
 G2_KIT = FieldKit(
@@ -84,6 +105,7 @@ G2_KIT = FieldKit(
     neg=T.fq2_neg, double=T.fq2_double, is_zero=T.fq2_is_zero,
     eq=T.fq2_eq, select=T.fq2_select, const=_fq2_const, b_coeff=B_G2,
     stack=T._fq2s, unstack=T._fq2u,
+    zero_many=_fq2_zero_many, compress=_fq2_compress,
 )
 
 
@@ -120,29 +142,33 @@ def point_neg(k: FieldKit, p):
 
 def point_double(k: FieldKit, p):
     """Jacobian doubling (a=0).  Total: doubling infinity gives Z3=0.
-    Independent multiplies batched into wide-lane rounds."""
+    Independent multiplies batched into wide-lane rounds; intermediates
+    compressed where lazy unit counts would breach the mul contract.
+    Inputs must be one-unit coordinates; output is compressed."""
     X1, Y1, Z1 = p
     A, B, YZ = k.unstack(k.mul(k.stack([X1, Y1, Y1]),
                                k.stack([X1, Y1, Z1])))
-    E = k.add(k.add(A, A), A)
-    XB = k.add(X1, B)
+    XB, E = k.unstack(k.compress(k.stack(
+        [k.add(X1, B), k.add(k.add(A, A), A)])))
     XB2, C, Fv = k.unstack(k.mul(k.stack([XB, B, E]),
                                  k.stack([XB, B, E])))
     D = k.sub(k.sub(XB2, A), C)
     D = k.add(D, D)
-    X3 = k.sub(Fv, k.add(D, D))
+    D, X3 = k.unstack(k.compress(k.stack([D, k.sub(Fv, k.add(D, D))])))
     C2 = k.add(C, C)
     C4 = k.add(C2, C2)
     C8 = k.add(C4, C4)
     Y3 = k.sub(k.mul(E, k.sub(D, X3)), C8)
     Z3 = k.add(YZ, YZ)
+    X3, Y3, Z3 = k.unstack(k.compress(k.stack([X3, Y3, Z3])))
     return (X3, Y3, Z3)
 
 
 def point_add(k: FieldKit, p, q):
     """Unified Jacobian addition: every exceptional case (either input at
-    infinity, P == Q, P == -Q) is computed and selected lane-wise.
-    Independent multiplies batched into wide-lane rounds."""
+    infinity, P == Q, P == -Q) is computed and selected lane-wise; the
+    four predicate zero-tests share one canonical map.  Inputs must be
+    one-unit coordinates; output is compressed."""
     X1, Y1, Z1 = p
     X2, Y2, Z2 = q
     Z1Z1, Z2Z2, Z1Z2 = k.unstack(k.mul(k.stack([Z1, Z2, Z1]),
@@ -152,8 +178,8 @@ def point_add(k: FieldKit, p, q):
         k.stack([Z2Z2, Z1Z1, Z2Z2, Z1Z1])))
     S1, S2 = k.unstack(k.mul(k.stack([Y1, Y2]), k.stack([Z2c, Z1c])))
     H = k.sub(U2, U1)
-    rr = k.sub(S2, S1)
-    rr = k.add(rr, rr)
+    sdiff = k.sub(S2, S1)
+    H, rr = k.unstack(k.compress(k.stack([H, k.add(sdiff, sdiff)])))
     H2 = k.add(H, H)
     I, R2 = k.unstack(k.mul(k.stack([H2, rr]), k.stack([H2, rr])))
     J, V, ZZH = k.unstack(k.mul(
@@ -164,12 +190,9 @@ def point_add(k: FieldKit, p, q):
                                k.stack([k.sub(V, X3), J])))
     Y3 = k.sub(RVX, k.add(S1J, S1J))
     Z3 = ZZH
-    out = (X3, Y3, Z3)
+    out = tuple(k.unstack(k.compress(k.stack([X3, Y3, Z3]))))
 
-    same_x = k.is_zero(H)
-    same_y = k.is_zero(k.sub(S2, S1))
-    p_inf = k.is_zero(Z1)
-    q_inf = k.is_zero(Z2)
+    same_x, same_y, p_inf, q_inf = k.zero_many([H, sdiff, Z1, Z2])
     finite = (~p_inf) & (~q_inf)
     # P == Q (and both finite): double
     dbl = point_double(k, p)
@@ -188,13 +211,18 @@ def _select_point(k: FieldKit, cond, a, b):
 
 
 def point_eq(k: FieldKit, p, q):
-    """Equality in Jacobian coordinates (cross-multiplied), total."""
-    Z1Z1 = k.sqr(p[2])
-    Z2Z2 = k.sqr(q[2])
-    x_eq = k.eq(k.mul(p[0], Z2Z2), k.mul(q[0], Z1Z1))
-    y_eq = k.eq(k.mul(p[1], k.mul(q[2], Z2Z2)), k.mul(q[1], k.mul(p[2], Z1Z1)))
-    both_inf = is_infinity(k, p) & is_infinity(k, q)
-    one_inf = is_infinity(k, p) ^ is_infinity(k, q)
+    """Equality in Jacobian coordinates (cross-multiplied), total; all
+    four zero-tests share one canonical map."""
+    Z1Z1, Z2Z2 = k.unstack(k.mul(k.stack([p[2], q[2]]),
+                                 k.stack([p[2], q[2]])))
+    Z2c, Z1c = k.unstack(k.mul(k.stack([q[2], p[2]]),
+                               k.stack([Z2Z2, Z1Z1])))
+    m = k.unstack(k.mul(k.stack([p[0], q[0], p[1], q[1]]),
+                        k.stack([Z2Z2, Z1Z1, Z2c, Z1c])))
+    x_eq, y_eq, p_inf, q_inf = k.zero_many(
+        [k.sub(m[0], m[1]), k.sub(m[2], m[3]), p[2], q[2]])
+    both_inf = p_inf & q_inf
+    one_inf = p_inf ^ q_inf
     return (x_eq & y_eq & ~one_inf) | both_inf
 
 
@@ -334,6 +362,7 @@ def g2_recover_y(x_plain, y_is_large):
     b = _broadcast_const(G2_KIT, _fq2_const(B_G2), x)
     rhs = T.fq2_add(T.fq2_mul(T.fq2_sqr(x), x), b)
     ok, y = T.fq2_sqrt(rhs)
+    y = T.fq2_compress(y)
     large = T.fq2_is_large(T.fq2_from_mont(y))
     y = T.fq2_select(large == y_is_large, y, T.fq2_neg(y))
     one = _broadcast_const(G2_KIT, _fq2_const((1, 0)), x)

@@ -7,12 +7,21 @@ composes with jit/scan/shard_map untouched.
 
 Algorithms mirror the pure-Python oracle (teku_tpu/crypto/bls/fields.py) —
 Karatsuba Fq2/Fq6/Fq12 mul, Chung-Hasan Fq6 squaring, Granger-Scott
-cyclotomic squaring, computed Frobenius constants — re-expressed branch-free
-on Montgomery limbs.  The reference client gets this layer from native blst
-(reference: infrastructure/bls/src/main/java/tech/pegasys/teku/bls/impl/
-blst/BlstBLS12381.java, SWIG classes P1/P2/Pairing).
+cyclotomic squaring, computed Frobenius constants — on the lazy-reduction
+limb layer (see limbs.py):
 
-Validation: tests/test_ops_towers.py checks every op against the oracle.
+- additive ops and conjugation are free (elementwise, no carries);
+- each tower op gathers its independent base-field multiplies into ONE
+  wide fp.mont_mul call (same multiply count as the oracle's Karatsuba,
+  ~20x smaller XLA graphs, wide lanes for the TPU VPU);
+- Fq12-level ops compress their outputs back to one "unit" so values
+  stay inside the limb layer's operand-magnitude contract; Fq2/Fq6
+  results may be lazy (a few units) and call sites track that.
+
+The reference client gets this layer from native blst (reference:
+infrastructure/bls/src/main/java/tech/pegasys/teku/bls/impl/blst/
+BlstBLS12381.java).  Validation: tests/test_ops_towers.py checks every op
+against the oracle.
 """
 
 import numpy as np
@@ -61,30 +70,8 @@ def _bcast2(c, like):
 
 
 # --------------------------------------------------------------------------
-# Fq2
+# Lane stacking helpers
 # --------------------------------------------------------------------------
-
-def fq2_add(a, b):
-    return (fp.add(a[0], b[0]), fp.add(a[1], b[1]))
-
-
-def fq2_sub(a, b):
-    return (fp.sub(a[0], b[0]), fp.sub(a[1], b[1]))
-
-
-def fq2_neg(a):
-    return (fp.neg(a[0]), fp.neg(a[1]))
-
-
-def fq2_double(a):
-    return fq2_add(a, a)
-
-
-# Batched-mul design note: each tower op gathers its independent base-field
-# multiplies into ONE wide fp.mont_mul call (stacked along a fresh axis).
-# The arithmetic is the same Karatsuba the oracle uses; the XLA graph is
-# ~20x smaller (one reduction scan per layer instead of per multiply), and
-# the wide lanes are exactly the shape the TPU VPU wants.
 
 def _stk(*xs):
     return jnp.stack(xs, axis=-2)
@@ -111,8 +98,64 @@ def tree_unstack(t, n):
     return [jax.tree_util.tree_map(lambda x: x[i], t) for i in range(n)]
 
 
+def fq2_compress(a):
+    t = fp.compress(_stk(a[0], a[1]))
+    return (t[..., 0, :], t[..., 1, :])
+
+
+def fq6_compress(a):
+    t = fp.compress(_stk(a[0][0], a[0][1], a[1][0], a[1][1],
+                         a[2][0], a[2][1]))
+    return ((t[..., 0, :], t[..., 1, :]), (t[..., 2, :], t[..., 3, :]),
+            (t[..., 4, :], t[..., 5, :]))
+
+
+def fq12_compress(a):
+    comps = [c for six in a for two in six for c in two]
+    t = fp.compress(jnp.stack(comps, axis=-2))
+    out = [t[..., i, :] for i in range(12)]
+    return (((out[0], out[1]), (out[2], out[3]), (out[4], out[5])),
+            ((out[6], out[7]), (out[8], out[9]), (out[10], out[11])))
+
+
+def fq12_reduce_value(a):
+    """Re-bound the integer VALUE of every component to (-P, 2P) without
+    changing residues: one wide Montgomery multiply by R (x*R*R^-1 = x).
+
+    compress() bounds limb magnitudes but leaves values untouched; ops
+    whose output includes an additive copy of their input (cyclotomic
+    squaring's conjugate terms) would otherwise double their value every
+    iteration until the product columns overflow int64.
+    """
+    comps = [c for six in a for two in six for c in two]
+    t = fp.mont_mul(jnp.stack(comps, axis=-2), jnp.asarray(fp.ONE_MONT))
+    out = [t[..., i, :] for i in range(12)]
+    return (((out[0], out[1]), (out[2], out[3]), (out[4], out[5])),
+            ((out[6], out[7]), (out[8], out[9]), (out[10], out[11])))
+
+
+# --------------------------------------------------------------------------
+# Fq2 — additive ops are lazy/free; results of mul/sqr are <= 3 units
+# --------------------------------------------------------------------------
+
+def fq2_add(a, b):
+    return (fp.add(a[0], b[0]), fp.add(a[1], b[1]))
+
+
+def fq2_sub(a, b):
+    return (fp.sub(a[0], b[0]), fp.sub(a[1], b[1]))
+
+
+def fq2_neg(a):
+    return (fp.neg(a[0]), fp.neg(a[1]))
+
+
+def fq2_double(a):
+    return fq2_add(a, a)
+
+
 def fq2_mul(a, b):
-    # Karatsuba, 3 base muls in one width-3 call
+    # Karatsuba, 3 base muls in one width-3 call; output <= 3 units
     t = fp.mont_mul(_stk(a[0], a[1], fp.add(a[0], a[1])),
                     _stk(b[0], b[1], fp.add(b[0], b[1])))
     t0, t1, t2 = t[..., 0, :], t[..., 1, :], t[..., 2, :]
@@ -120,7 +163,7 @@ def fq2_mul(a, b):
 
 
 def fq2_sqr(a):
-    # (a0+a1)(a0-a1), a0*a1 — one width-2 call
+    # (a0+a1)(a0-a1), a0*a1 — one width-2 call; output <= 2 units
     t = fp.mont_mul(_stk(fp.add(a[0], a[1]), a[0]),
                     _stk(fp.sub(a[0], a[1]), a[1]))
     return (t[..., 0, :], fp.double(t[..., 1, :]))
@@ -137,12 +180,13 @@ def fq2_conj(a):
 
 
 def fq2_mul_by_xi(a):
-    # a * (1 + u) = (a0 - a1) + (a0 + a1) u
+    # a * (1 + u) = (a0 - a1) + (a0 + a1) u  — doubles the unit count
     return (fp.sub(a[0], a[1]), fp.add(a[0], a[1]))
 
 
 def fq2_inv(a):
-    """Branch-free inverse; inv(0) = 0 (callers select around zero)."""
+    """Branch-free inverse; inv(0) = 0 (callers select around zero).
+    Input may be lazy up to ~5 units."""
     sq = fp.mont_sqr(_stk(a[0], a[1]))
     norm = fp.add(sq[..., 0, :], sq[..., 1, :])
     ninv = fp.inv(norm)
@@ -151,11 +195,12 @@ def fq2_inv(a):
 
 
 def fq2_is_zero(a):
-    return fp.is_zero(a[0]) & fp.is_zero(a[1])
+    c = fp.canonical(_stk(a[0], a[1]))
+    return jnp.all(c == 0, axis=(-2, -1))
 
 
 def fq2_eq(a, b):
-    return fp.eq(a[0], b[0]) & fp.eq(a[1], b[1])
+    return fq2_is_zero(fq2_sub(a, b))
 
 
 def fq2_select(cond, a, b):
@@ -163,10 +208,12 @@ def fq2_select(cond, a, b):
 
 
 def fq2_pow_static(a, e: int):
-    """a^e for a static exponent via scan (1 sqr + 1 selected mul per bit)."""
+    """a^e for a static exponent via scan (1 sqr + 1 selected mul / bit).
+    `a` may be lazy up to ~4 units (the scan state stays <= 3 units)."""
     assert e > 0
     bits = np.array([(e >> i) & 1 for i in range(e.bit_length())][::-1],
                     dtype=np.int64)
+    a = fq2_compress(a)   # both the init and the per-bit multiplier
 
     def body(acc, bit):
         acc = fq2_sqr(acc)
@@ -182,32 +229,43 @@ def fq2_sqrt(a):
 
     Returns (ok, root): ok is False where `a` is a non-residue (root lanes
     are then garbage and must be selected away by the caller).
+    `a` may be lazy (a few units).
     """
+    a = fq2_compress(a)
     cand = fq2_pow_static(a, SQRT_EXP)   # a = 0 -> cand = 0, matches below
+    consts = [fq2_const(c) for c in (_SQRT_M1, _SQRT_C2, _SQRT_C3)]
+    cands = [cand] + [fq2_mul(_bcast2(c, cand), cand) for c in consts]
+    # all four squares and the four differences checked in ONE canonical map
+    sq = fq2_sqr(_fq2s(cands))
+    d = fq2_sub(sq, (a[0][..., None, :], a[1][..., None, :]))
+    zc = fp.canonical(jnp.stack([d[0], d[1]], axis=-2))  # (..., 4?, 2, L)
+    matches = jnp.all(zc == 0, axis=(-2, -1))            # (..., 4)
+    found = jnp.zeros(matches.shape[:-1], dtype=bool)
     root = cand
-    found = jnp.zeros(fq2_is_zero(a).shape, dtype=bool)
-    for c in (None, _SQRT_M1, _SQRT_C2, _SQRT_C3):
-        t = cand if c is None else fq2_mul(_bcast2(fq2_const(c), cand), cand)
-        match = fq2_eq(fq2_sqr(t), a) & ~found
-        root = fq2_select(match, t, root)
-        found = found | match
+    for i in range(4):
+        m = matches[..., i] & ~found
+        root = fq2_select(m, cands[i], root)
+        found = found | m
     return found, root
 
 
 def fq2_is_large(a_plain):
-    """Lexicographic 'y is the larger root' on PLAIN-form limbs
+    """Lexicographic 'y is the larger root' on CANONICAL PLAIN limbs
     (wire-format sign bit; oracle curve.py _fq2_is_large)."""
     half = jnp.asarray(fp.int_to_limbs((P - 1) // 2))
+    zero1 = jnp.all(a_plain[1] == 0, axis=-1)
     large1 = fp.gt(a_plain[1], half)
-    return large1 | (fp.is_zero(a_plain[1]) & fp.gt(a_plain[0], half))
+    return large1 | (zero1 & fp.gt(a_plain[0], half))
 
 
 def fq2_from_mont(a):
-    return (fp.from_mont(a[0]), fp.from_mont(a[1]))
+    """Montgomery (possibly lazy) -> canonical plain limbs."""
+    t = fp.canonical_plain(_stk(a[0], a[1]))
+    return (t[..., 0, :], t[..., 1, :])
 
 
 # --------------------------------------------------------------------------
-# Fq6
+# Fq6 — outputs lazy (<= 7 units); unit inputs required for mul/sqr
 # --------------------------------------------------------------------------
 
 def fq6_add(a, b):
@@ -223,7 +281,8 @@ def fq6_neg(a):
 
 
 def fq6_mul(a, b):
-    # Toom-style 6-mul Karatsuba, all six fq2 muls in one wide call
+    # Toom-style 6-mul Karatsuba, all six fq2 muls in one wide call.
+    # Inputs must be <= 2 units per component.
     a0, a1, a2 = a
     b0, b1, b2 = b
     A = _fq2s([a0, a1, a2, fq2_add(a1, a2), fq2_add(a0, a1), fq2_add(a0, a2)])
@@ -260,6 +319,7 @@ def fq6_mul_by_fq2(a, s):
 
 
 def fq6_inv(a):
+    """Input <= 2 units per component."""
     a0, a1, a2 = a
     p6 = _fq2u(fq2_mul(_fq2s([a0, a2, a1, a1, a0, a0]),
                        _fq2s([a0, a2, a1, a2, a1, a2])))
@@ -269,15 +329,16 @@ def fq6_inv(a):
     t2 = fq2_sub(sq1, m02)
     n3 = _fq2u(fq2_mul(_fq2s([a0, a2, a1]), _fq2s([t0, t1, t2])))
     norm = fq2_add(n3[0], fq2_mul_by_xi(fq2_add(n3[1], n3[2])))
-    ninv = fq2_inv(norm)
-    out = _fq2u(fq2_mul(_fq2s([t0, t1, t2]),
-                        _fq2s([ninv, ninv, ninv])))
+    ninv = fq2_compress(fq2_inv(norm))
+    out = _fq2u(fq2_mul(_fq2s([t0, t1, t2]), _fq2s([ninv, ninv, ninv])))
     return (out[0], out[1], out[2])
 
 
 def fq6_eq(a, b):
-    r = fq2_eq(a[0], b[0])
-    return r & fq2_eq(a[1], b[1]) & fq2_eq(a[2], b[2])
+    d = fq6_sub(a, b)
+    c = fp.canonical(_stk(d[0][0], d[0][1], d[1][0], d[1][1],
+                          d[2][0], d[2][1]))
+    return jnp.all(c == 0, axis=(-2, -1))
 
 
 def fq6_select(cond, a, b):
@@ -292,7 +353,7 @@ def fq6_frobenius(a):
 
 
 # --------------------------------------------------------------------------
-# Fq12
+# Fq12 — all ops take unit inputs and return COMPRESSED (unit) outputs
 # --------------------------------------------------------------------------
 
 def fq12_ones(batch_shape=()):
@@ -314,7 +375,7 @@ def fq12_mul(a, b):
     t0, t1, t2 = tree_unstack(fq6_mul(A, B), 3)
     c0 = fq6_add(t0, fq6_mul_by_v(t1))
     c1 = fq6_sub(fq6_sub(t2, t0), t1)
-    return (c0, c1)
+    return fq12_compress((c0, c1))
 
 
 def fq12_sqr(a):
@@ -325,7 +386,7 @@ def fq12_sqr(a):
     t, u = tree_unstack(fq6_mul(A, B), 2)
     c0 = fq6_sub(u, fq6_add(t, fq6_mul_by_v(t)))
     c1 = fq6_add(t, t)
-    return (c0, c1)
+    return fq12_compress((c0, c1))
 
 
 def fq12_conj(a):
@@ -349,9 +410,11 @@ def fq12_cyclo_sqr(a):
     c0, c1 = fp4(tc, se, sf)
     sc0, sc1 = fq2_mul_by_xi(c1), c0
 
+    def triple(x):
+        return fq2_add(fq2_add(x, x), x)
+
     def comb(s0, s1, o0, o1, sign):
-        t0 = fq2_add(fq2_add(s0, s0), s0)
-        t1 = fq2_add(fq2_add(s1, s1), s1)
+        t0, t1 = triple(s0), triple(s1)
         d0 = fq2_add(o0, o0)
         d1 = fq2_add(o1, o1)
         if sign > 0:
@@ -361,17 +424,19 @@ def fq12_cyclo_sqr(a):
     B0 = comb(a0, a1, g0, h1, -1)
     B1 = comb(sc0, sc1, h0, g2, +1)
     B2 = comb(b0, b1, g1, h2, -1)
-    return ((B0[0], B2[0], B1[1]), (B1[0], B0[1], B2[1]))
+    # value-reduce, not just compress: the ±2*conj(input) terms otherwise
+    # compound the component values across squaring chains
+    return fq12_reduce_value(((B0[0], B2[0], B1[1]), (B1[0], B0[1], B2[1])))
 
 
 def fq12_inv(a):
     a0, a1 = a
     s0, s1 = tree_unstack(fq6_sqr(tree_stack([a0, a1])), 2)
-    norm = fq6_sub(s0, fq6_mul_by_v(s1))
-    ninv = fq6_inv(norm)
+    norm = fq6_compress(fq6_sub(s0, fq6_mul_by_v(s1)))
+    ninv = fq6_compress(fq6_inv(norm))
     m0, m1 = tree_unstack(
         fq6_mul(tree_stack([a0, a1]), tree_stack([ninv, ninv])), 2)
-    return (m0, fq6_neg(m1))
+    return fq12_compress((m0, fq6_neg(m1)))
 
 
 def fq12_frobenius(a, power: int = 1):
@@ -380,12 +445,16 @@ def fq12_frobenius(a, power: int = 1):
         c0 = fq6_frobenius(result[0])
         c1 = fq6_frobenius(result[1])
         c1 = fq6_mul_by_fq2(c1, _bcast2(FROB12_C1, c1[0]))
-        result = (c0, c1)
+        result = fq12_compress((c0, c1))
     return result
 
 
 def fq12_eq(a, b):
-    return fq6_eq(a[0], b[0]) & fq6_eq(a[1], b[1])
+    d0 = fq6_sub(a[0], b[0])
+    d1 = fq6_sub(a[1], b[1])
+    comps = [c for six in (d0, d1) for two in six for c in two]
+    c = fp.canonical(jnp.stack(comps, axis=-2))
+    return jnp.all(c == 0, axis=(-2, -1))
 
 
 def fq12_is_one(a):
