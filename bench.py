@@ -1,0 +1,79 @@
+"""North-star benchmark: BLS signatures verified per second per chip.
+
+Measures the batched verification kernel (teku_tpu/ops/verify.py) on the
+real device at the BASELINE.md batch sizes (1 / 64 / 512 / 4096), end to
+end per dispatch: host arrays in, verdict out, device synchronized.
+
+Prints ONE JSON line:
+  {"metric": "bls_verify_sigs_per_sec", "value": <best>, "unit":
+   "sigs/sec/chip", "vs_baseline": <value / 50_000>, ...detail...}
+
+vs_baseline is against the project target (>= 50k attestation sigs/sec on
+one TPU v5e-1, BASELINE.md; the reference's CPU blst does ~1-2k
+verifies/sec/core).  The reference measures the same surface with JMH
+(reference: eth-benchmark-tests/src/jmh/java/tech/pegasys/teku/
+benchmarks/BLSBenchmark.java:37-80).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    t_start = time.time()
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+    batches = [int(b) for b in
+               os.environ.get("BENCH_BATCHES", "1,64,512,4096").split(",")]
+
+    import jax
+
+    import __graft_entry__ as ge
+    from teku_tpu.ops import verify as V
+
+    kernel = jax.jit(V.verify_kernel)
+    detail = {}
+    best = 0.0
+    best_batch = None
+    for n in batches:
+        if time.time() - t_start > budget_s and detail:
+            detail[str(n)] = "skipped: budget"
+            continue
+        args = ge._example_batch(n)
+        # warm-up (compile)
+        t0 = time.time()
+        ok, sig_ok = kernel(*args)
+        ok = bool(np.asarray(ok))
+        compile_s = time.time() - t0
+        assert ok and np.asarray(sig_ok).all(), f"batch {n} did not verify"
+        # timed steady-state dispatches
+        iters = max(1, min(30, int(200 / max(n / 64, 1))))
+        t0 = time.time()
+        for _ in range(iters):
+            ok, sig_ok = kernel(*args)
+        jax.block_until_ready((ok, sig_ok))
+        dt = (time.time() - t0) / iters
+        rate = n / dt
+        detail[str(n)] = {"sigs_per_sec": round(rate, 1),
+                          "dispatch_ms": round(dt * 1e3, 2),
+                          "compile_s": round(compile_s, 1)}
+        if rate > best:
+            best, best_batch = rate, n
+
+    out = {
+        "metric": "bls_verify_sigs_per_sec",
+        "value": round(best, 1),
+        "unit": "sigs/sec/chip",
+        "vs_baseline": round(best / 50_000, 4),
+        "best_batch": best_batch,
+        "device": str(jax.devices()[0]),
+        "detail": detail,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

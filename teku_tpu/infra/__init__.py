@@ -1,0 +1,6 @@
+"""Infrastructure primitives: metrics, async utilities, events.
+
+The analogue of the reference's infrastructure/* modules (async
+SafeFuture/AsyncRunner, events EventChannels, metrics MetricsSystem) —
+rebuilt on asyncio idioms rather than translated from the JVM design.
+"""

@@ -1,0 +1,143 @@
+"""Minimal Prometheus-style metrics registry.
+
+Counterpart of the reference's Besu-backed MetricsSystem (reference:
+infrastructure/metrics/src/main/java/tech/pegasys/teku/infrastructure/
+metrics/MetricsEndpoint.java, TekuMetricCategory.java) reduced to what
+the node needs: counters, gauges (settable or callback-backed),
+fixed-bucket histograms, and a text exposition for scraping.  No
+external dependencies, safe for use from asyncio tasks and worker
+threads (operations are simple attribute updates guarded by locks).
+"""
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+class Counter:
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def collect(self) -> List[str]:
+        return [f"# TYPE {self.name} counter",
+                f"{self.name} {self._value}"]
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str,
+                 supplier: Optional[Callable[[], float]] = None):
+        self.name = name
+        self.help = help_
+        self._supplier = supplier
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        self._value = value
+
+    @property
+    def value(self) -> float:
+        return self._supplier() if self._supplier else self._value
+
+    def collect(self) -> List[str]:
+        return [f"# TYPE {self.name} gauge", f"{self.name} {self.value}"]
+
+
+class Histogram:
+    """Fixed upper-bound buckets (cumulative, Prometheus-style)."""
+
+    DEFAULT_BUCKETS = (1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500)
+
+    def __init__(self, name: str, help_: str,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._total += 1
+            for i, ub in enumerate(self.buckets):
+                if value <= ub:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @property
+    def count(self) -> int:
+        return self._total
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def collect(self) -> List[str]:
+        out = [f"# TYPE {self.name} histogram"]
+        cum = 0
+        for i, ub in enumerate(self.buckets):
+            cum += self._counts[i]
+            out.append(f'{self.name}_bucket{{le="{ub}"}} {cum}')
+        cum += self._counts[-1]
+        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
+        out.append(f"{self.name}_sum {self._sum}")
+        out.append(f"{self.name}_count {self._total}")
+        return out
+
+
+class MetricsRegistry:
+    """Named registry; categories mirror TekuMetricCategory groupings."""
+
+    def __init__(self) -> None:
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_create(name, lambda: Counter(name, help_), Counter)
+
+    def gauge(self, name: str, help_: str = "",
+              supplier: Optional[Callable[[], float]] = None) -> Gauge:
+        return self._get_or_create(
+            name, lambda: Gauge(name, help_, supplier), Gauge)
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Sequence[float] = Histogram.DEFAULT_BUCKETS
+                  ) -> Histogram:
+        return self._get_or_create(
+            name, lambda: Histogram(name, help_, buckets), Histogram)
+
+    def _get_or_create(self, name, factory, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise ValueError(f"metric {name} already registered "
+                                 f"as {type(m).__name__}")
+            return m
+
+    def expose(self) -> str:
+        """Prometheus text exposition of every registered metric."""
+        lines: List[str] = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            lines.extend(m.collect())
+        return "\n".join(lines) + "\n"
+
+
+GLOBAL_REGISTRY = MetricsRegistry()

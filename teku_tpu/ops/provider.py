@@ -1,0 +1,319 @@
+"""JaxBls12381 — the TPU-backed BLS provider behind the node's SPI.
+
+Plugs the batched verification kernel (teku_tpu/ops/verify.py) into the
+same provider seam the reference exposes for blst (reference:
+infrastructure/bls/src/main/java/tech/pegasys/teku/bls/impl/BLS12381.java:
+34-157, installed via bls/BLS.java:51-62 setBlsImplementation).  The
+pure-Python oracle remains the host-side fallback and supplies the rare
+non-batch operations (key generation, signing), mirroring how the
+reference keeps BlstLoader's graceful-degradation path.
+
+Host/device split:
+- host: wire-format parsing (flag bits, x < P), SHA-256 message
+  expansion, pubkey cache bookkeeping, random multipliers;
+- device: pubkey decompression + subgroup checks for cache misses (one
+  batched dispatch), and the whole verification pipeline (hash-to-G2,
+  scalar muls, Miller loops, final exponentiation) in ONE jitted call
+  per padded batch-size bucket.
+
+Batch sizes are padded to powers of two so the jit cache stays small and
+shapes stay static (XLA recompiles nothing after warm-up).
+"""
+
+import secrets
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..crypto.bls import hash_to_curve as OH
+from ..crypto.bls.constants import P, R
+from ..crypto.bls.pure_impl import PureBls12381
+from ..crypto.bls.spi import BLS12381, BatchSemiAggregate
+from . import limbs as fp
+from . import points as PT
+from . import verify as V
+
+_G1_INF = bytes([0xC0] + [0] * 47)
+_G2_INF = bytes([0xC0] + [0] * 95)
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class _Semi(BatchSemiAggregate):
+    """Parsed, host-validated triple awaiting the device dispatch."""
+
+    __slots__ = ("pk_points", "message", "sig")
+
+    def __init__(self, pk_points, message, sig):
+        self.pk_points = pk_points   # list of (x, y) int affine G1
+        self.message = message
+        self.sig = sig               # (x0, x1, large, is_inf) or None=inf
+
+
+def _parse_g2_wire(sig: bytes):
+    """Host wire checks for a compressed G2 signature.
+
+    Returns (x0, x1, large, is_inf) or None when malformed.  On-curve and
+    subgroup membership are checked on device."""
+    if len(sig) != 96 or not sig[0] & 0x80:
+        return None
+    if sig[0] & 0x40:
+        if any(sig[1:]) or (sig[0] & 0x3F):
+            return None
+        return (0, 0, False, True)
+    x1 = int.from_bytes(bytes([sig[0] & 0x1F]) + sig[1:48], "big")
+    x0 = int.from_bytes(sig[48:96], "big")
+    if x0 >= P or x1 >= P:
+        return None
+    return (x0, x1, bool(sig[0] & 0x20), False)
+
+
+def _parse_g1_wire(pk: bytes):
+    """Host wire checks for a compressed G1 pubkey; same contract."""
+    if len(pk) != 48 or not pk[0] & 0x80:
+        return None
+    if pk[0] & 0x40:
+        if any(pk[1:]) or (pk[0] & 0x3F):
+            return None
+        return (0, False, True)
+    x = int.from_bytes(bytes([pk[0] & 0x1F]) + pk[1:], "big")
+    if x >= P:
+        return None
+    return (x, bool(pk[0] & 0x20), False)
+
+
+class JaxBls12381(BLS12381):
+    """TPU provider: batched pairing verification as single dispatches."""
+
+    name = "jax-tpu"
+
+    def __init__(self, max_batch: int = 4096):
+        self._pure = PureBls12381()
+        self.max_batch = max_batch
+        # pk bytes -> ("ok", (x, y)) | ("bad",);  validated on device
+        self._pk_cache: dict = {}
+        self._u_cache: dict = {}
+        self._verify_jit = jax.jit(V.verify_kernel)
+        self._pk_validate_jit = jax.jit(self._pk_validate_kernel)
+
+    # ------------------------------------------------------------------
+    # Host-side SPI ops delegated to the oracle (rare, non-batch paths)
+    # ------------------------------------------------------------------
+    def secret_key_to_public_key(self, secret: int) -> bytes:
+        return self._pure.secret_key_to_public_key(secret)
+
+    def sign(self, secret: int, message: bytes) -> bytes:
+        return self._pure.sign(secret, message)
+
+    def aggregate_public_keys(self, public_keys: Sequence[bytes]) -> bytes:
+        return self._pure.aggregate_public_keys(public_keys)
+
+    def aggregate_signatures(self, signatures: Sequence[bytes]) -> bytes:
+        return self._pure.aggregate_signatures(signatures)
+
+    def signature_is_valid(self, signature: bytes) -> bool:
+        return self._pure.signature_is_valid(signature)
+
+    # ------------------------------------------------------------------
+    # Pubkey cache with batched device validation
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _pk_validate_kernel(x_plain, large):
+        ok, pt = PT.g1_recover_y(x_plain, large)
+        ok = ok & PT.g1_in_subgroup(pt)
+        aff = V.to_affine_g1(pt)   # Z == 1, so this just normalizes limbs
+        return ok, fp.canonical_plain(aff[0]), fp.canonical_plain(aff[1])
+
+    def _resolve_pks(self, all_pks: Sequence[bytes]):
+        """Fill the cache for every unseen pubkey in one device dispatch."""
+        miss = {}
+        for pk in all_pks:
+            if pk in self._pk_cache or pk in miss:
+                continue
+            wire = _parse_g1_wire(pk)
+            if wire is None or wire[2]:   # malformed or infinity
+                self._pk_cache[pk] = ("bad",)
+            else:
+                miss[pk] = wire
+        miss = list(miss.items())
+        if not miss:
+            return
+        n = _next_pow2(len(miss))
+        xs = np.zeros((n, fp.L), dtype=np.int64)
+        large = np.zeros(n, dtype=bool)
+        for i, (_, (x, lg, _inf)) in enumerate(miss):
+            xs[i] = fp.int_to_limbs(x)
+            large[i] = lg
+        ok, gx, gy = self._pk_validate_jit(xs, large)
+        ok = np.asarray(ok)
+        gx, gy = np.asarray(gx), np.asarray(gy)
+        for i, (pk, _) in enumerate(miss):
+            if ok[i]:
+                self._pk_cache[pk] = (
+                    "ok", (fp.limbs_to_int(gx[i]), fp.limbs_to_int(gy[i])))
+            else:
+                self._pk_cache[pk] = ("bad",)
+
+    def public_key_is_valid(self, public_key: bytes) -> bool:
+        self._resolve_pks([public_key])
+        return self._pk_cache[public_key][0] == "ok"
+
+    # ------------------------------------------------------------------
+    # Message hashing (host SHA-256 -> field draws, cached)
+    # ------------------------------------------------------------------
+    def _u_draws(self, message: bytes):
+        hit = self._u_cache.get(message)
+        if hit is None:
+            (a, b), (c, d) = OH.hash_to_field_fq2(message, 2)
+            hit = (fp.int_to_mont(a), fp.int_to_mont(b),
+                   fp.int_to_mont(c), fp.int_to_mont(d))
+            if len(self._u_cache) > 100_000:
+                self._u_cache.clear()
+            self._u_cache[message] = hit
+        return hit
+
+    # ------------------------------------------------------------------
+    # Aggregation of a triple's pubkeys (device tree-sum for K > 1)
+    # ------------------------------------------------------------------
+    def _aggregate_triple_pk(self, points):
+        if len(points) == 1:
+            return points[0]
+        n = _next_pow2(len(points))
+        xs = np.zeros((n, fp.L), dtype=np.int64)
+        ys = np.zeros((n, fp.L), dtype=np.int64)
+        present = np.zeros(n, dtype=bool)
+        for i, (x, y) in enumerate(points):
+            xs[i] = fp.int_to_mont(x)
+            ys[i] = fp.int_to_mont(y)
+            present[i] = True
+        jac = _agg_jit(xs, ys, present)
+        x3, y3, z3 = (np.asarray(c) for c in jac)
+        # host-normalize the single result (tiny)
+        from ..crypto.bls import curve as C
+        aff = C.to_affine(C.FQ_OPS, (fp.mont_to_int(x3), fp.mont_to_int(y3),
+                                     fp.mont_to_int(z3)))
+        return aff   # None if keys summed to infinity
+
+    # ------------------------------------------------------------------
+    # Verification API — everything lands in the batched kernel
+    # ------------------------------------------------------------------
+    def prepare_batch_verify(
+        self, triple: Tuple[Sequence[bytes], bytes, bytes]
+    ) -> Optional[BatchSemiAggregate]:
+        public_keys, message, signature = triple
+        if not public_keys:
+            return None
+        self._resolve_pks(public_keys)
+        points = []
+        for pk in public_keys:
+            entry = self._pk_cache[pk]
+            if entry[0] != "ok":
+                return None
+            points.append(entry[1])
+        sig = _parse_g2_wire(signature)
+        if sig is None:
+            return None
+        return _Semi(points, message, sig)
+
+    def complete_batch_verify(
+        self, semi_aggregates: Sequence[Optional[BatchSemiAggregate]]
+    ) -> bool:
+        if any(sa is None for sa in semi_aggregates):
+            return False
+        if not semi_aggregates:
+            return True
+        semis: List[_Semi] = list(semi_aggregates)
+        if len(semis) > self.max_batch:
+            # split oversized batches; all chunks must pass
+            return all(
+                self.complete_batch_verify(semis[i:i + self.max_batch])
+                for i in range(0, len(semis), self.max_batch))
+        return self._dispatch(semis, randomize=True)
+
+    def batch_verify(
+        self, triples: Sequence[Tuple[Sequence[bytes], bytes, bytes]],
+    ) -> bool:
+        return self.complete_batch_verify(
+            [self.prepare_batch_verify(t) for t in triples])
+
+    def verify(self, public_key: bytes, message: bytes,
+               signature: bytes) -> bool:
+        return self.fast_aggregate_verify([public_key], message, signature)
+
+    def fast_aggregate_verify(self, public_keys: Sequence[bytes],
+                              message: bytes, signature: bytes) -> bool:
+        semi = self.prepare_batch_verify((public_keys, message, signature))
+        if semi is None:
+            return False
+        return self._dispatch([semi], randomize=False)
+
+    def aggregate_verify(self, public_keys: Sequence[bytes],
+                         messages: Sequence[bytes], signature: bytes) -> bool:
+        if not public_keys or len(public_keys) != len(messages):
+            return False
+        # prod_i e(pk_i, H(m_i)) == e(g1, sig): the r=1 batch with the
+        # signature attached to lane 0 and infinity signatures elsewhere.
+        semis = []
+        for i, (pk, msg) in enumerate(zip(public_keys, messages)):
+            sig = signature if i == 0 else _G2_INF
+            semi = self.prepare_batch_verify(([pk], msg, sig))
+            if semi is None:
+                return False
+            semis.append(semi)
+        return self._dispatch(semis, randomize=False)
+
+    # ------------------------------------------------------------------
+    def _dispatch(self, semis: List[_Semi], randomize: bool) -> bool:
+        n = len(semis)
+        padded = _next_pow2(n)
+        pk_x = np.zeros((padded, fp.L), dtype=np.int64)
+        pk_y = np.zeros((padded, fp.L), dtype=np.int64)
+        u0c0 = np.zeros((padded, fp.L), dtype=np.int64)
+        u0c1 = np.zeros((padded, fp.L), dtype=np.int64)
+        u1c0 = np.zeros((padded, fp.L), dtype=np.int64)
+        u1c1 = np.zeros((padded, fp.L), dtype=np.int64)
+        sx0 = np.zeros((padded, fp.L), dtype=np.int64)
+        sx1 = np.zeros((padded, fp.L), dtype=np.int64)
+        s_large = np.zeros(padded, dtype=bool)
+        s_inf = np.zeros(padded, dtype=bool)
+        rs = np.zeros(padded, dtype=np.uint64)
+        lane_valid = np.zeros(padded, dtype=bool)
+        for i, s in enumerate(semis):
+            agg = self._aggregate_triple_pk(s.pk_points)
+            if agg is None:
+                return False   # keys summed to infinity (oracle parity)
+            pk_x[i] = fp.int_to_mont(agg[0])
+            pk_y[i] = fp.int_to_mont(agg[1])
+            u0c0[i], u0c1[i], u1c0[i], u1c1[i] = self._u_draws(s.message)
+            x0, x1, lg, inf = s.sig
+            sx0[i] = fp.int_to_limbs(x0)
+            sx1[i] = fp.int_to_limbs(x1)
+            s_large[i] = lg
+            s_inf[i] = inf
+            if randomize:
+                r = 0
+                while r == 0:
+                    r = secrets.randbits(64)
+            else:
+                r = 1
+            rs[i] = r
+            lane_valid[i] = True
+        r_bits = np.asarray(PT.scalar_from_uint64(rs))
+        ok, sig_ok = self._verify_jit(
+            pk_x, pk_y, (u0c0, u0c1), (u1c0, u1c1), (sx0, sx1),
+            s_large, s_inf, r_bits, lane_valid)
+        sig_ok = np.asarray(sig_ok)
+        return bool(np.asarray(ok)) and bool(sig_ok[:n].all())
+
+
+_agg_jit = jax.jit(
+    lambda xs, ys, present: V.aggregate_points_kernel(
+        PT.G1_KIT, xs, ys, present))

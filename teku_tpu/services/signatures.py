@@ -1,0 +1,154 @@
+"""Aggregating signature verification service — the TPU batch scheduler.
+
+Async front-end that converts bursty per-message verification requests
+into device-sized batches, preserving the semantics of the reference's
+gossip-side batcher (reference: ethereum/statetransition/src/main/java/
+tech/pegasys/teku/statetransition/validation/signatures/
+AggregatingSignatureVerificationService.java:41-262):
+
+- bounded queue; overflow raises ServiceCapacityExceeded (:146-160);
+- worker drain of up to max_batch_size tasks into ONE batch verify
+  (:171-205) — here a single TPU dispatch via the provider, whose
+  power-of-two padding keeps jit shapes static;
+- on batch failure: single task fails; >= split_threshold bisects
+  recursively; otherwise tasks verify individually (:213-226);
+- multi-signature tasks stay atomic — a task's triples verify together
+  or not at all (AsyncBatchBLSSignatureVerifier.java:24-60 grouping);
+- queue-size gauge, batch/task counters, batch-size histogram (:76-98).
+
+Deliberate departure from the reference: its workers block up to 30 s
+waiting to fill a batch, which is throughput-friendly but latency-naive;
+here a worker takes whatever is queued the moment it goes idle (the
+dispatch itself provides natural batching back-pressure), optimizing the
+attestation-gossip p50 the north star measures.
+"""
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+from ..crypto import bls
+from ..infra.metrics import GLOBAL_REGISTRY, MetricsRegistry
+
+Triple = Tuple[Sequence[bytes], bytes, bytes]
+
+
+class ServiceCapacityExceededError(Exception):
+    """Queue full — the caller sheds load (gossip IGNORE)."""
+
+
+@dataclass
+class _Task:
+    triples: List[Triple]
+    future: asyncio.Future = field(repr=False)
+
+
+class AggregatingSignatureVerificationService:
+    """Queue/drain/dispatch batch verifier over the pluggable BLS SPI."""
+
+    def __init__(self, num_workers: int = 2, queue_capacity: int = 15_000,
+                 max_batch_size: int = 250, split_threshold: int = 25,
+                 registry: MetricsRegistry = GLOBAL_REGISTRY,
+                 name: str = "signature_verifications"):
+        if num_workers < 1:
+            raise ValueError("need at least one worker")
+        self.num_workers = num_workers
+        self.queue_capacity = queue_capacity
+        self.max_batch_size = max_batch_size
+        self.split_threshold = split_threshold
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._workers: List[asyncio.Task] = []
+        self._started = False
+        self._stopped = False
+        self._m_queue = registry.gauge(
+            f"{name}_queue_size", "pending verification tasks",
+            supplier=lambda: self._queue.qsize())
+        self._m_batches = registry.counter(
+            f"{name}_batch_count_total", "batches dispatched")
+        self._m_tasks = registry.counter(
+            f"{name}_task_count_total", "tasks completed")
+        self._m_batch_size = registry.histogram(
+            f"{name}_batch_size", "signatures per dispatched batch",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
+
+    # ------------------------------------------------------------------
+    async def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        for i in range(self.num_workers):
+            self._workers.append(
+                asyncio.create_task(self._worker(), name=f"sig-verify-{i}"))
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for w in self._workers:
+            w.cancel()
+        for w in self._workers:
+            try:
+                await w
+            except asyncio.CancelledError:
+                pass
+        self._workers.clear()
+
+    # ------------------------------------------------------------------
+    def verify(self, public_keys: Sequence[bytes], message: bytes,
+               signature: bytes) -> "asyncio.Future[bool]":
+        """Queue one fast-aggregate triple; resolves with the verdict."""
+        return self.verify_multi([(public_keys, message, signature)])
+
+    def verify_multi(self, triples: Sequence[Triple]
+                     ) -> "asyncio.Future[bool]":
+        """Queue several triples as ONE atomic task (e.g. the three
+        signatures of a SignedAggregateAndProof verify together)."""
+        if not self._started or self._stopped:
+            raise RuntimeError("service not running")
+        if self._queue.qsize() >= self.queue_capacity:
+            raise ServiceCapacityExceededError(
+                f"queue at capacity ({self.queue_capacity})")
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._queue.put_nowait(_Task(list(triples), fut))
+        return fut
+
+    # ------------------------------------------------------------------
+    async def _worker(self) -> None:
+        while not self._stopped:
+            first = await self._queue.get()
+            tasks = [first]
+            budget = self.max_batch_size - len(first.triples)
+            while budget > 0:
+                try:
+                    nxt = self._queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                tasks.append(nxt)
+                budget -= len(nxt.triples)
+            await self._verify_batch(tasks)
+
+    async def _verify_batch(self, tasks: List[_Task]) -> None:
+        tasks = [t for t in tasks if not t.future.cancelled()]
+        if not tasks:
+            return
+        triples = [tr for t in tasks for tr in t.triples]
+        self._m_batches.inc()
+        self._m_batch_size.observe(len(triples))
+        ok = await asyncio.to_thread(bls.batch_verify, triples)
+        if ok:
+            for t in tasks:
+                self._complete(t, True)
+            return
+        if len(tasks) == 1:
+            self._complete(tasks[0], False)
+            return
+        if len(tasks) >= self.split_threshold:
+            half = len(tasks) // 2
+            await self._verify_batch(tasks[:half])
+            await self._verify_batch(tasks[half:])
+        else:
+            for t in tasks:
+                await self._verify_batch([t])
+
+    def _complete(self, task: _Task, result: bool) -> None:
+        self._m_tasks.inc()
+        if not task.future.done():
+            task.future.set_result(result)

@@ -1,0 +1,133 @@
+"""JaxBls12381 provider behind the facade — parity with the oracle.
+
+Batch sizes are kept tiny (<= 4 triples) so the CPU-XLA compile cost of
+each padded-size bucket is paid at most a handful of times.
+"""
+
+import pytest
+
+from teku_tpu.crypto import bls
+from teku_tpu.crypto.bls import keygen
+from teku_tpu.crypto.bls.pure_impl import G1_INFINITY, G2_INFINITY
+from teku_tpu.ops.provider import JaxBls12381
+
+
+@pytest.fixture(scope="module")
+def jax_impl():
+    impl = JaxBls12381()
+    bls.set_implementation(impl)
+    yield impl
+    bls.reset_implementation()
+
+
+SKS = [keygen(bytes([i]) * 32) for i in range(1, 5)]
+PKS = None
+MSG = b"attestation data root"
+
+
+def _pks():
+    global PKS
+    if PKS is None:
+        from teku_tpu.crypto.bls.pure_impl import PureBls12381
+        p = PureBls12381()
+        PKS = [p.secret_key_to_public_key(sk) for sk in SKS]
+    return PKS
+
+
+def test_verify_roundtrip(jax_impl):
+    pk = _pks()[0]
+    sig = bls.sign(SKS[0], MSG)
+    assert bls.verify(pk, MSG, sig)
+    assert not bls.verify(pk, b"other message", sig)
+    assert not bls.verify(_pks()[1], MSG, sig)
+
+
+def test_verify_garbage_inputs(jax_impl):
+    pk = _pks()[0]
+    sig = bls.sign(SKS[0], MSG)
+    assert not bls.verify(pk[:-1], MSG, sig)       # truncated pk
+    assert not bls.verify(pk, MSG, sig[:-1])       # truncated sig
+    assert not bls.verify(G1_INFINITY, MSG, sig)   # infinity pk invalid
+    assert not bls.verify(pk, MSG, G2_INFINITY)
+    bad_sig = bytes([sig[0]]) + bytes(95)
+    assert not bls.verify(pk, MSG, bad_sig)
+
+
+def test_fast_aggregate_verify(jax_impl):
+    sigs = [bls.sign(sk, MSG) for sk in SKS[:3]]
+    agg = bls.aggregate_signatures(sigs)
+    assert bls.fast_aggregate_verify(_pks()[:3], MSG, agg)
+    assert not bls.fast_aggregate_verify(_pks()[:2], MSG, agg)
+    assert not bls.fast_aggregate_verify(_pks()[:3], b"wrong", agg)
+
+
+def test_aggregate_verify_distinct_messages(jax_impl):
+    msgs = [b"m-%d" % i for i in range(3)]
+    sigs = [bls.sign(sk, m) for sk, m in zip(SKS[:3], msgs)]
+    agg = bls.aggregate_signatures(sigs)
+    assert bls.aggregate_verify(_pks()[:3], msgs, agg)
+    assert not bls.aggregate_verify(_pks()[:3], list(reversed(msgs)), agg)
+    assert not bls.aggregate_verify(_pks()[:2], msgs[:2], agg)
+
+
+def test_batch_verify_mixed(jax_impl):
+    triples = []
+    for i, sk in enumerate(SKS[:3]):
+        msg = b"batch-%d" % i
+        triples.append(([_pks()[i]], msg, bls.sign(sk, msg)))
+    # multi-key triple (fast-aggregate semantics inside one lane)
+    agg_msg = b"agg lane"
+    agg_sig = bls.aggregate_signatures(
+        [bls.sign(sk, agg_msg) for sk in SKS[:3]])
+    triples.append((_pks()[:3], agg_msg, agg_sig))
+    assert bls.batch_verify(triples)
+    # one corrupted lane fails the whole batch
+    bad = list(triples)
+    bad[1] = (bad[1][0], b"tampered", bad[1][2])
+    assert not bls.batch_verify(bad)
+
+
+def test_batch_verify_infinity_sig_lane(jax_impl):
+    # infinity signature with a real pubkey cannot verify
+    triples = [([_pks()[0]], MSG, G2_INFINITY)]
+    assert not bls.batch_verify(triples)
+
+
+def test_prepare_complete_split(jax_impl):
+    msg = b"split path"
+    semis = [
+        bls.prepare_batch_verify(([_pks()[i]], msg, bls.sign(SKS[i], msg)))
+        for i in range(2)
+    ]
+    assert all(s is not None for s in semis)
+    assert bls.complete_batch_verify(semis)
+    assert bls.prepare_batch_verify(([], msg, G2_INFINITY)) is None
+    assert not bls.complete_batch_verify(semis + [None])
+
+
+def test_eth_wrappers(jax_impl):
+    assert bls.eth_fast_aggregate_verify([], b"x", G2_INFINITY)
+    with pytest.raises(ValueError):
+        bls.eth_aggregate_pubkeys([])
+    assert bls.public_key_is_valid(_pks()[0])
+    assert not bls.public_key_is_valid(G1_INFINITY)
+    assert not bls.public_key_is_valid(b"\x00" * 48)
+
+
+def test_non_subgroup_signature_rejected(jax_impl):
+    # an on-curve G2 point outside the subgroup must be rejected on device
+    import random
+    from teku_tpu.crypto.bls import curve as C, fields as F
+    from teku_tpu.crypto.bls.constants import P
+    rng = random.Random(5)
+    while True:
+        x = (rng.randrange(P), rng.randrange(P))
+        rhs = F.fq2_add(F.fq2_mul(F.fq2_sqr(x), x), (4, 4))
+        y = F.fq2_sqrt(rhs)
+        if y is None:
+            continue
+        p = (x, y, F.FQ2_ONE)
+        if not C.g2_in_subgroup(p):
+            break
+    bad_sig_bytes = C.g2_compress(p)  # compress doesn't subgroup-check
+    assert not bls.verify(_pks()[0], MSG, bad_sig_bytes)

@@ -1,0 +1,119 @@
+"""Batching service semantics: drain, bisect-on-fail, overflow, grouping.
+
+Runs against the pure-Python provider (fast enough at these sizes and
+identical semantics through the SPI; the TPU provider is exercised by
+tests/test_jax_provider.py)."""
+
+import asyncio
+
+import pytest
+
+from teku_tpu.crypto import bls
+from teku_tpu.crypto.bls import keygen
+from teku_tpu.infra.metrics import MetricsRegistry
+from teku_tpu.services.signatures import (
+    AggregatingSignatureVerificationService, ServiceCapacityExceededError)
+
+SKS = [keygen(bytes([40 + i]) * 32) for i in range(4)]
+PKS = [bls.secret_to_public_key(sk) for sk in SKS]
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_service(**kw):
+    kw.setdefault("registry", MetricsRegistry())
+    return AggregatingSignatureVerificationService(**kw)
+
+
+def test_basic_verify_and_metrics():
+    async def main():
+        reg = MetricsRegistry()
+        svc = make_service(num_workers=1, registry=reg)
+        await svc.start()
+        msg = b"single"
+        sig = bls.sign(SKS[0], msg)
+        ok = await svc.verify([PKS[0]], msg, sig)
+        bad = await svc.verify([PKS[0]], b"other", sig)
+        await svc.stop()
+        assert ok and not bad
+        assert reg.counter("signature_verifications_task_count_total").value >= 2
+        assert reg.counter("signature_verifications_batch_count_total").value >= 2
+        assert "signature_verifications_batch_size_bucket" in reg.expose()
+    run(main())
+
+
+def test_batching_drains_queue():
+    async def main():
+        reg = MetricsRegistry()
+        svc = make_service(num_workers=1, registry=reg)
+        await svc.start()
+        futs = []
+        msgs = [b"drain-%d" % i for i in range(6)]
+        for i, m in enumerate(msgs):
+            futs.append(svc.verify([PKS[i % 4]], m, bls.sign(SKS[i % 4], m)))
+        results = await asyncio.gather(*futs)
+        await svc.stop()
+        assert all(results)
+        # fewer batches than tasks proves the drain actually batched
+        assert (reg.counter("signature_verifications_batch_count_total").value
+                < len(msgs))
+    run(main())
+
+
+def test_bad_signature_isolated_by_bisect():
+    async def main():
+        svc = make_service(num_workers=1, split_threshold=2)
+        await svc.start()
+        futs = []
+        for i in range(5):
+            m = b"bisect-%d" % i
+            sig = bls.sign(SKS[i % 4], m)
+            if i == 2:
+                m = b"tampered"
+            futs.append(svc.verify([PKS[i % 4]], m, sig))
+        results = await asyncio.gather(*futs)
+        await svc.stop()
+        assert results == [True, True, False, True, True]
+    run(main())
+
+
+def test_multi_triple_task_atomic():
+    async def main():
+        svc = make_service(num_workers=1)
+        await svc.start()
+        m1, m2 = b"proof", b"aggregate"
+        good = [([PKS[0]], m1, bls.sign(SKS[0], m1)),
+                ([PKS[1]], m2, bls.sign(SKS[1], m2))]
+        bad = [([PKS[0]], m1, bls.sign(SKS[0], m1)),
+               ([PKS[1]], b"wrong", bls.sign(SKS[1], m2))]
+        ok = await svc.verify_multi(good)
+        not_ok = await svc.verify_multi(bad)
+        await svc.stop()
+        assert ok and not not_ok  # one bad sig fails the whole task
+    run(main())
+
+
+def test_queue_overflow():
+    async def main():
+        svc = make_service(num_workers=1, queue_capacity=2)
+        await svc.start()
+        msg = b"overflow"
+        sig = bls.sign(SKS[0], msg)
+        # stall the worker by flooding faster than it can drain
+        futs = [svc.verify([PKS[0]], msg, sig) for _ in range(2)]
+        with pytest.raises(ServiceCapacityExceededError):
+            for _ in range(50):
+                futs.append(svc.verify([PKS[0]], msg, sig))
+        await asyncio.gather(*futs)
+        await svc.stop()
+    run(main())
+
+
+def test_not_started_raises():
+    async def main():
+        svc = make_service()
+        with pytest.raises(RuntimeError):
+            svc.verify([PKS[0]], b"x", b"y" * 96)
+    run(main())
