@@ -2,35 +2,78 @@
 
 Measures the batched verification kernel (teku_tpu/ops/verify.py) on the
 real device at the BASELINE.md batch sizes (1 / 64 / 512 / 4096), end to
-end per dispatch: host arrays in, verdict out, device synchronized.
+end per dispatch: host arrays in, verdict out, device synchronized; plus
+a bursty-arrival latency phase (BASELINE.md measurement config 5)
+reporting attestation-verify p50/p99 through the batching service.
 
 Prints ONE JSON line:
   {"metric": "bls_verify_sigs_per_sec", "value": <best>, "unit":
-   "sigs/sec/chip", "vs_baseline": <value / 50_000>, ...detail...}
+   "sigs/sec/chip", "vs_baseline": <value / 50_000>, "p50_ms": ...,
+   ...detail...}
 
-vs_baseline is against the project target (>= 50k attestation sigs/sec on
-one TPU v5e-1, BASELINE.md; the reference's CPU blst does ~1-2k
+Hardened bring-up (round 2 failed with rc=1 and no JSON at all):
+- device init is retried with backoff, then falls back to CPU so a JSON
+  line ALWAYS comes out (flagged via "device"/"fallback");
+- every phase is fenced: a failure records an "error" field for that
+  phase instead of crashing the process;
+- a wall-clock budget (BENCH_BUDGET_S) gates each extra compile.
+
+vs_baseline is against the project target (>= 50k attestation sigs/sec
+on one TPU v5e-1, BASELINE.md; the reference's CPU blst does ~1-2k
 verifies/sec/core).  The reference measures the same surface with JMH
 (reference: eth-benchmark-tests/src/jmh/java/tech/pegasys/teku/
-benchmarks/BLSBenchmark.java:37-80).
+benchmarks/BLSBenchmark.java:37-80 and ethereum/statetransition/src/jmh/
+.../AggregatingSignatureVerificationServiceBenchmark.java).
 """
 
 import json
 import os
 import sys
 import time
+import traceback
 
 import numpy as np
 
+OUT = {
+    "metric": "bls_verify_sigs_per_sec",
+    "value": 0.0,
+    "unit": "sigs/sec/chip",
+    "vs_baseline": 0.0,
+}
 
-def main():
-    t_start = time.time()
-    budget_s = float(os.environ.get("BENCH_BUDGET_S", "1500"))
-    batches = [int(b) for b in
-               os.environ.get("BENCH_BATCHES", "1,64,512,4096").split(",")]
 
+def _emit():
+    print(json.dumps(OUT))
+    sys.stdout.flush()
+
+
+def _init_device():
+    """Initialize a JAX backend, retrying the TPU tunnel with backoff and
+    falling back to CPU rather than dying (round 2's failure mode)."""
     import jax
 
+    last = None
+    for attempt in range(3):
+        try:
+            devs = jax.devices()
+            OUT["device"] = str(devs[0])
+            return jax
+        except Exception as exc:  # backend init failure
+            last = exc
+            time.sleep(15 * (attempt + 1))
+    # fall back to CPU so the harness still produces a number
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    devs = jax.devices()
+    OUT["device"] = str(devs[0])
+    OUT["fallback"] = f"tpu init failed: {type(last).__name__}: {last}"
+    return jax
+
+
+def _throughput_phase(jax, deadline, batches):
     import __graft_entry__ as ge
     from teku_tpu.ops import verify as V
 
@@ -39,40 +82,126 @@ def main():
     best = 0.0
     best_batch = None
     for n in batches:
-        if time.time() - t_start > budget_s and detail:
+        if time.time() > deadline and detail:
             detail[str(n)] = "skipped: budget"
             continue
-        args = ge._example_batch(n)
-        # warm-up (compile)
-        t0 = time.time()
-        ok, sig_ok = kernel(*args)
-        ok = bool(np.asarray(ok))
-        compile_s = time.time() - t0
-        assert ok and np.asarray(sig_ok).all(), f"batch {n} did not verify"
-        # timed steady-state dispatches
-        iters = max(1, min(30, int(200 / max(n / 64, 1))))
-        t0 = time.time()
-        for _ in range(iters):
-            ok, sig_ok = kernel(*args)
-        jax.block_until_ready((ok, sig_ok))
-        dt = (time.time() - t0) / iters
-        rate = n / dt
-        detail[str(n)] = {"sigs_per_sec": round(rate, 1),
-                          "dispatch_ms": round(dt * 1e3, 2),
-                          "compile_s": round(compile_s, 1)}
-        if rate > best:
-            best, best_batch = rate, n
+        try:
+            args = ge._example_batch(n)
+            t0 = time.time()
+            ok, lane_ok = kernel(*args)
+            ok = bool(np.asarray(ok))
+            compile_s = time.time() - t0
+            entry = {"compile_s": round(compile_s, 1)}
+            detail[str(n)] = entry
+            if not (ok and np.asarray(lane_ok).all()):
+                entry["error"] = "batch did not verify"
+                continue
+            iters = max(1, min(30, int(200 / max(n / 64, 1))))
+            t0 = time.time()
+            for _ in range(iters):
+                ok, lane_ok = kernel(*args)
+            jax.block_until_ready((ok, lane_ok))
+            dt = (time.time() - t0) / iters
+            rate = n / dt
+            entry["sigs_per_sec"] = round(rate, 1)
+            entry["dispatch_ms"] = round(dt * 1e3, 2)
+            if rate > best:
+                best, best_batch = rate, n
+        except Exception as exc:
+            detail[str(n)] = {"error": f"{type(exc).__name__}: {exc}"}
+    OUT["detail"] = detail
+    OUT["best_batch"] = best_batch
+    OUT["value"] = round(best, 1)
+    OUT["vs_baseline"] = round(best / 50_000, 4)
 
-    out = {
-        "metric": "bls_verify_sigs_per_sec",
-        "value": round(best, 1),
-        "unit": "sigs/sec/chip",
-        "vs_baseline": round(best / 50_000, 4),
-        "best_batch": best_batch,
-        "device": str(jax.devices()[0]),
-        "detail": detail,
-    }
-    print(json.dumps(out))
+
+def _latency_phase(jax, deadline):
+    """Slot-burst replay through AggregatingSignatureVerificationService:
+    Poisson-bursty single-attestation tasks, p50/p99 task latency."""
+    import asyncio
+    import secrets
+
+    from teku_tpu.crypto import bls
+    from teku_tpu.crypto.bls import keygen
+    from teku_tpu.ops.provider import JaxBls12381
+    from teku_tpu.services.signatures import (
+        AggregatingSignatureVerificationService)
+
+    impl = JaxBls12381(max_batch=256)
+    bls.set_implementation(impl)
+    try:
+        sks = [keygen(bytes([i + 1]) * 32) for i in range(16)]
+        pks = [impl.secret_key_to_public_key(sk) for sk in sks]
+        msgs = [b"att-%d" % i for i in range(16)]
+        sigs = [impl.sign(sk, m) for sk, m in zip(sks, msgs)]
+        # warm the pow-2 buckets the service will hit
+        for size in (1, 2, 4, 8, 16, 32, 64, 128, 256):
+            if time.time() > deadline:
+                break
+            triples = [([pks[i % 16]], msgs[i % 16], sigs[i % 16])
+                       for i in range(size)]
+            t0 = time.time()
+            if not impl.batch_verify(triples):
+                raise RuntimeError("warmup batch failed")
+            OUT.setdefault("warm_compile_s", {})[str(size)] = round(
+                time.time() - t0, 1)
+
+        lat: list = []
+
+        async def run():
+            svc = AggregatingSignatureVerificationService(
+                num_workers=2, max_batch_size=256)
+            await svc.start()
+            rng = np.random.default_rng(3)
+            # one slot-boundary burst: ~500 attestations arriving in
+            # ~200ms (BASELINE config 5 scaled to bench budget)
+            n_msgs = 500
+            pending = []
+            for i in range(n_msgs):
+                j = i % 16
+                t_submit = time.perf_counter()
+                fut = svc.verify([pks[j]], msgs[j], sigs[j])
+                pending.append((t_submit, fut))
+                await asyncio.sleep(float(rng.exponential(0.0004)))
+            for t_submit, fut in pending:
+                okv = await fut
+                assert okv
+                lat.append(time.perf_counter() - t_submit)
+            await svc.stop()
+
+        asyncio.run(run())
+        lat_ms = np.asarray(sorted(lat)) * 1e3
+        OUT["p50_ms"] = round(float(np.percentile(lat_ms, 50)), 2)
+        OUT["p99_ms"] = round(float(np.percentile(lat_ms, 99)), 2)
+        OUT["latency_tasks"] = len(lat_ms)
+    finally:
+        bls.reset_implementation()
+
+
+def main():
+    t_start = time.time()
+    budget_s = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+    deadline = t_start + budget_s
+    batches = [int(b) for b in
+               os.environ.get("BENCH_BATCHES", "1,64,512,4096").split(",")]
+    try:
+        jax = _init_device()
+    except Exception as exc:
+        OUT["error"] = f"device init: {type(exc).__name__}: {exc}"
+        _emit()
+        return
+    try:
+        _throughput_phase(jax, deadline, batches)
+    except Exception as exc:
+        OUT["error"] = f"throughput: {type(exc).__name__}: {exc}"
+        OUT["trace"] = traceback.format_exc(limit=3)
+    if os.environ.get("BENCH_P50", "1") != "0" and time.time() < deadline:
+        try:
+            _latency_phase(jax, deadline)
+        except Exception as exc:
+            OUT["p50_error"] = f"{type(exc).__name__}: {exc}"
+    OUT["total_s"] = round(time.time() - t_start, 1)
+    _emit()
 
 
 if __name__ == "__main__":

@@ -7,12 +7,24 @@ branch-free on device.  The reference client hashes inside native blst
 (reference: infrastructure/bls/src/main/java/tech/pegasys/teku/bls/impl/
 blst/HashToCurve.java:23 — the DST this module shares via the oracle).
 
-Branch-free SSWU: the RFC's exceptional cases and the two-candidate x
-selection are computed unconditionally and resolved with selects.  Square
-roots use ONE Fq2 exponentiation per u via the SSWU identity
-g(x2) = Z^3 u^6 g(x1): candidates for sqrt(g(x1)) are gx1^((q+7)/16)
-times the four 8th-roots-of-unity square roots (q = p^2 ≡ 9 mod 16), and
-candidates for sqrt(g(x2)) reuse the same power times u^3 (Z^3)^((q+7)/16).
+DIVISIONLESS DESIGN.  Field inversion (Fermat, a ~380-iteration scan) is
+the compile-time and runtime hotspot, so the map runs fully projective:
+
+- SSWU computes x = xn/xd and y = yp/xd^3 without ever dividing (the
+  RFC's non-division form: x1n = -B(tv2+1), x1d = A*tv2, with the
+  exceptional case selected in).  The square root is taken on
+  gval = gx_num * xd^3 — same residue class as gx, so the QR decision
+  and the 4-candidate constant-time sqrt shape are unchanged — and the
+  root IS the projective y: (yp)^2 = gval  <=>  (yp/xd^3)^2 = gx.
+- The 3-isogeny maps numerators/denominators homogeneously
+  (x = XN/XD, y = YN/YD), still division-free.
+- ONE batched inversion (limbs.inv_many — a single Fermat for the whole
+  batch via Montgomery's trick) converts both draws of every lane to
+  affine, where the RFC sgn0 sign is applied.
+
+Square roots use ONE Fq2 exponentiation per draw via the SSWU identity
+gx2 = Z^3 u^6 gx1: candidates for sqrt(gval2) reuse the same power times
+u^3 (Z^3)^((q+7)/16) (q = p^2 ≡ 9 mod 16).
 
 Cofactor clearing is Budroni-Pintore via the psi endomorphism, matching
 the oracle's production path (crypto/bls/hash_to_curve.py:152-158).
@@ -36,14 +48,11 @@ from . import towers as T
 # Host-computed constants (oracle arithmetic, converted once)
 # --------------------------------------------------------------------------
 
-_NEG_B_OVER_A = F.fq2_neg(F.fq2_mul(SSWU_B2, F.fq2_inv(SSWU_A2)))
-_X1_EXC = F.fq2_mul(SSWU_B2, F.fq2_inv(F.fq2_mul(SSWU_Z2, SSWU_A2)))
 _Z3_POW_E = F.fq2_pow(
     F.fq2_mul(F.fq2_sqr(SSWU_Z2), SSWU_Z2), T.SQRT_EXP)
 
 _C = {name: T.fq2_const(val) for name, val in dict(
-    A=SSWU_A2, B=SSWU_B2, Z=SSWU_Z2,
-    NEG_B_OVER_A=_NEG_B_OVER_A, X1_EXC=_X1_EXC, Z3E=_Z3_POW_E,
+    A=SSWU_A2, B=SSWU_B2, Z=SSWU_Z2, Z3E=_Z3_POW_E,
     R1=T._SQRT_M1, R2=T._SQRT_C2, R3=T._SQRT_C3,
 ).items()}
 
@@ -53,14 +62,8 @@ def _c(name, like):
 
 
 # --------------------------------------------------------------------------
-# Map to curve (SSWU on E' then 3-isogeny to E), fully batched
+# Map to curve: projective SSWU on E', fully batched, no inversions
 # --------------------------------------------------------------------------
-
-def _gx_prime(x, like):
-    x3 = T.fq2_mul(T.fq2_sqr(x), x)
-    return T.fq2_add(T.fq2_add(x3, T.fq2_mul(_c("A", like), x)),
-                     _c("B", like))
-
 
 def fq2_sgn0(a):
     """RFC 9380 sgn0 on a Montgomery-form element (device)."""
@@ -71,61 +74,118 @@ def fq2_sgn0(a):
     return a0_odd | (a0_zero.astype(jnp.int64) & a1_odd)
 
 
-def map_to_curve_sswu(u):
-    """Batched simplified SWU: Fq2 u -> affine point on E' (total)."""
-    z_u2 = T.fq2_mul(_c("Z", u), T.fq2_sqr(u))
-    tv = T.fq2_add(T.fq2_sqr(z_u2), z_u2)
-    tv_zero = T.fq2_is_zero(tv)
-    x1 = T.fq2_mul(_c("NEG_B_OVER_A", u),
-                   T.fq2_add(T._bcast2(T.FQ2_ONE_NP, u), T.fq2_inv(tv)))
-    x1 = T.fq2_select(tv_zero, _c("X1_EXC", u), x1)
-    gx1 = _gx_prime(x1, u)
+def map_to_curve_sswu_proj(u):
+    """Batched divisionless simplified SWU: Fq2 u -> (xn, xd, yp) on E'
+    with x = xn/xd and y = yp/xd^3 (sgn0 sign NOT yet applied)."""
+    one = T._bcast2(T.FQ2_ONE_NP, u)
+    u2 = T.fq2_sqr(u)
+    tv = T.fq2_compress(T.fq2_mul(_c("Z", u), u2))
+    tv2 = T.fq2_compress(T.fq2_add(T.fq2_sqr(tv), tv))   # Z^2 u^4 + Z u^2
+    tv2_zero = T.fq2_is_zero(tv2)
+    # x1 = (-B/A)(1 + 1/tv2)  ==  -B(tv2+1) / (A tv2); exceptional case
+    # tv2 == 0  ->  x1 = B/(Z A)
+    r1 = T._fq2u(T.fq2_mul(
+        T._fq2s([_c("B", u), _c("A", u)]),
+        T._fq2s([T.fq2_add(tv2, one),
+                 T.fq2_select(tv2_zero, _c("Z", u), tv2)])))
+    x1n = T.fq2_select(tv2_zero, _c("B", u), T.fq2_neg(r1[0]))
+    xd = T.fq2_compress(r1[1])
+    x1n = T.fq2_compress(x1n)
 
-    # one exponentiation serves both sqrt cases
-    cand = T.fq2_pow_static(gx1, T.SQRT_EXP)
-    x2 = T.fq2_mul(z_u2, x1)
-    gx2 = _gx_prime(x2, u)   # == Z^3 u^6 gx1 by the SSWU identity
-    u3 = T.fq2_mul(T.fq2_sqr(u), u)
+    # gx1n = x1n^3 + A x1n xd^2 + B xd^3  (numerator of g(x1) over xd^3)
+    sq = T._fq2u(T.fq2_sqr(T._fq2s([x1n, xd])))
+    x1n2, xd2 = (T.fq2_compress(s) for s in sq)
+    r2 = T._fq2u(T.fq2_mul(
+        T._fq2s([x1n2, xd2, T.fq2_compress(T.fq2_mul(_c("A", u), x1n))]),
+        T._fq2s([x1n, xd, xd2])))
+    x1n3, xd3, axd2 = r2
+    xd3 = T.fq2_compress(xd3)
+    gx1n = T.fq2_add(T.fq2_add(x1n3, axd2),
+                     T.fq2_mul(_c("B", u), xd3))
+    # the sqrt runs on gval = gx1n * xd^3: same QR class as g(x1), and a
+    # root yp of gval is exactly the projective y (y = yp/xd^3)
+    gval = T.fq2_compress(T.fq2_mul(T.fq2_compress(gx1n), xd3))
+
+    cand = T.fq2_pow_static(gval, T.SQRT_EXP)
+    # second candidate set for x2 = tv*x1: gval2 = tv^3 gval = Z^3 u^6 gval
+    u3 = T.fq2_compress(T.fq2_mul(u2, u))
     cand2 = T.fq2_mul(T.fq2_mul(u3, _c("Z3E", u)), cand)
+    tv3 = T.fq2_compress(T.fq2_mul(T.fq2_compress(T.fq2_sqr(tv)), tv))
+    gval2 = T.fq2_compress(T.fq2_mul(tv3, gval))
 
-    found1 = jnp.zeros(tv_zero.shape, dtype=bool)
+    found1 = jnp.zeros(tv2_zero.shape, dtype=bool)
     y1 = cand
-    found2 = jnp.zeros(tv_zero.shape, dtype=bool)
+    found2 = jnp.zeros(tv2_zero.shape, dtype=bool)
     y2 = cand2
     for root in (None, "R1", "R2", "R3"):
         t1 = cand if root is None else T.fq2_mul(_c(root, u), cand)
-        m1 = T.fq2_eq(T.fq2_sqr(t1), gx1) & ~found1
+        m1 = T.fq2_eq(T.fq2_sqr(t1), gval) & ~found1
         y1 = T.fq2_select(m1, t1, y1)
         found1 |= m1
         t2 = cand2 if root is None else T.fq2_mul(_c(root, u), cand2)
-        m2 = T.fq2_eq(T.fq2_sqr(t2), gx2) & ~found2
+        m2 = T.fq2_eq(T.fq2_sqr(t2), gval2) & ~found2
         y2 = T.fq2_select(m2, t2, y2)
         found2 |= m2
 
-    x = T.fq2_select(found1, x1, x2)
-    y = T.fq2_select(found1, y1, y2)
+    xn = T.fq2_select(found1, x1n, T.fq2_compress(T.fq2_mul(tv, x1n)))
+    yp = T.fq2_select(found1, y1, y2)
+    return T.fq2_compress(xn), xd, T.fq2_compress(yp)
+
+
+def iso_map_proj(xn, xd, yp):
+    """3-isogeny E' -> E on projective inputs, division-free.
+
+    Input x = xn/xd, y = yp/xd^3; output x = XN/XD, y = YN/YD with all
+    four homogeneous in (xn, xd)."""
+    sq = T._fq2u(T.fq2_sqr(T._fq2s([xn, xd])))
+    xn2, xd2 = (T.fq2_compress(s) for s in sq)
+    r = T._fq2u(T.fq2_mul(T._fq2s([xn2, xd2]), T._fq2s([xn, xd])))
+    xn3, xd3 = (T.fq2_compress(s) for s in r)
+    xd_pows = [None, xd, xd2, xd3]
+    xn_pows = [None, xn, xn2, xn3]
+
+    def homog(coeffs):
+        """sum_i k_i xn^i xd^(d-i) for ascending coeffs of degree d."""
+        d = len(coeffs) - 1
+        acc = None
+        for i, k in enumerate(coeffs):
+            kc = T._bcast2(T.fq2_const(k), xn)
+            term = kc
+            if i:
+                term = T.fq2_mul(term, xn_pows[i])
+            if d - i:
+                term = T.fq2_mul(T.fq2_compress(term), xd_pows[d - i])
+            acc = term if acc is None else T.fq2_add(acc, term)
+        return T.fq2_compress(acc)
+
+    XN = homog(ISO3_X_NUM)                       # deg 3
+    XD = T.fq2_mul(xd, homog(ISO3_X_DEN))        # deg 2 -> * xd
+    YN = T.fq2_mul(yp, homog(ISO3_Y_NUM))        # y factor: yp/xd^3
+    YD = T.fq2_mul(xd3, homog(ISO3_Y_DEN))       # matching xd^3
+    return XN, T.fq2_compress(XD), T.fq2_compress(YN), T.fq2_compress(YD)
+
+
+def _proj_to_affine_signed(u, XN, XD, YN, YD):
+    """Batched projective -> affine with RFC sgn0(u) sign fix; ONE
+    inversion of XD*YD per element, batched into a single Fermat
+    exponentiation across the whole batch (limbs.inv_many)."""
+    pinv = T.fq2_inv(T.fq2_compress(T.fq2_mul(XD, YD)))
+    r = T._fq2u(T.fq2_mul(T._fq2s([XN, YN]),
+                          T._fq2s([T.fq2_compress(T.fq2_mul(pinv, YD)),
+                                   T.fq2_compress(T.fq2_mul(pinv, XD))])))
+    x, y = (T.fq2_compress(c) for c in r)
     flip = fq2_sgn0(u) != fq2_sgn0(y)
     y = T.fq2_select(flip, T.fq2_neg(y), y)
-    return x, y
+    return x, T.fq2_compress(y)
 
 
-def iso_map(x, y):
-    """3-isogeny E' -> E, affine->affine, one fused inversion."""
-    def horner(coeffs):
-        acc = T._bcast2(T.fq2_const(coeffs[-1]), x)
-        for c in reversed(coeffs[:-1]):
-            acc = T.fq2_add(T.fq2_mul(acc, x), T._bcast2(T.fq2_const(c), x))
-        return acc
-
-    x_num = horner(ISO3_X_NUM)
-    x_den = horner(ISO3_X_DEN)
-    y_num = horner(ISO3_Y_NUM)
-    y_den = horner(ISO3_Y_DEN)
-    # one inversion: 1/(x_den*y_den), then recover both
-    inv_prod = T.fq2_inv(T.fq2_mul(x_den, y_den))
-    x_out = T.fq2_mul(x_num, T.fq2_mul(inv_prod, y_den))
-    y_out = T.fq2_mul(y, T.fq2_mul(y_num, T.fq2_mul(inv_prod, x_den)))
-    return x_out, y_out
+def map_to_curve_sswu(u):
+    """Affine SSWU on E' (test/oracle parity surface): projective map +
+    affine conversion + sgn0 sign."""
+    xn, xd, yp = map_to_curve_sswu_proj(u)
+    # y = yp/xd^3: reuse the generic converter with XD=xd, YN=yp, YD=xd^3
+    xd3 = T.fq2_compress(T.fq2_mul(T.fq2_compress(T.fq2_sqr(xd)), xd))
+    return _proj_to_affine_signed(u, xn, xd, yp, xd3)
 
 
 # --------------------------------------------------------------------------
@@ -148,11 +208,36 @@ def clear_cofactor(p):
 
 
 def hash_to_g2_device(u0, u1):
-    """Device pipeline: two Fq2 draws -> G2 Jacobian point (in-subgroup)."""
-    x0, y0 = iso_map(*map_to_curve_sswu(u0))
-    x1, y1 = iso_map(*map_to_curve_sswu(u1))
-    one = T._bcast2(T.FQ2_ONE_NP, x0)
-    r = PT.point_add(PT.G2_KIT, (x0, y0, one), (x1, y1, one))
+    """Device pipeline: two Fq2 draws -> G2 Jacobian point (in-subgroup).
+
+    Both draws are stacked on a leading axis so the map, the isogeny and
+    the (single, batched) inversion run once at double width.
+
+    The RFC's sgn0 sign applies to the E' point BEFORE the isogeny
+    (y' = yp/xd^3); flipping y' flips the isogeny output, so the affine
+    y' (needed only for its sign) and the affine E coordinates are all
+    recovered from ONE shared inversion of xd^3 * XD * YD."""
+    U = T.tree_stack([u0, u1])
+    xn, xd, yp = map_to_curve_sswu_proj(U)
+    XN, XD, YN, YD = iso_map_proj(xn, xd, yp)
+    xd3 = T.fq2_compress(T.fq2_mul(T.fq2_compress(T.fq2_sqr(xd)), xd))
+    xd3_XD = T.fq2_compress(T.fq2_mul(xd3, XD))
+    pinv = T.fq2_inv(T.fq2_compress(T.fq2_mul(xd3_XD, YD)))  # batched
+    r = T._fq2u(T.fq2_mul(
+        T._fq2s([T.fq2_compress(T.fq2_mul(XD, YD)),
+                 T.fq2_compress(T.fq2_mul(xd3, YD)),
+                 xd3_XD]),
+        T._fq2s([pinv, pinv, pinv])))
+    inv_xd3, inv_XD, inv_YD = (T.fq2_compress(c) for c in r)
+    r2 = T._fq2u(T.fq2_mul(T._fq2s([yp, XN, YN]),
+                           T._fq2s([inv_xd3, inv_XD, inv_YD])))
+    y_prime, x, y = (T.fq2_compress(c) for c in r2)
+    flip = fq2_sgn0(U) != fq2_sgn0(y_prime)
+    y = T.fq2_select(flip, T.fq2_neg(y), y)
+    y = T.fq2_compress(y)
+    one = T._bcast2(T.FQ2_ONE_NP, x)
+    (x0, y0, o0), (x1, y1, o1) = T.tree_unstack((x, y, one), 2)
+    r = PT.point_add(PT.G2_KIT, (x0, y0, o0), (x1, y1, o1))
     return clear_cofactor(r)
 
 
@@ -173,8 +258,8 @@ def messages_to_fields(messages, dst: bytes = DST_G2_POP):
 
 
 def to_affine_g2(p):
-    """Jacobian -> affine on device (one inversion); infinity lanes
-    return garbage coords — callers carry the infinity mask."""
+    """Jacobian -> affine on device (one batched inversion); infinity
+    lanes return garbage coords — callers carry the infinity mask."""
     zinv = T.fq2_inv(p[2])
     zinv2 = T.fq2_sqr(zinv)
     x = T.fq2_mul(p[0], zinv2)

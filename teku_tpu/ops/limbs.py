@@ -290,6 +290,39 @@ def inv(a):
     return pow_static(a, P - 2)
 
 
+def inv_many(a):
+    """Batched field inverse: ONE Fermat exponentiation for the whole
+    batch via Montgomery's trick, parallelized with prefix/suffix
+    product scans.
+
+    a: (..., L) Montgomery units, any batch shape (flattened internally).
+    Cost: one single-element a^(P-2) scan plus ~6 mont_muls per element
+    (two log-depth associative scans + the recombine), versus one full
+    380-bit Fermat scan per element for `inv` — the dominant
+    compile-time and runtime win of the verification kernel.
+
+    inv_many(0) ≡ 0 per-lane (zero lanes are masked out of the product
+    so they cannot poison the batch).
+    """
+    shape = a.shape
+    flat = a.reshape((-1, L))
+    m = flat.shape[0]
+    if m == 1:
+        out = inv(flat)
+        return out.reshape(shape)
+    zero = is_zero(flat)                                  # (M,)
+    one = jnp.broadcast_to(jnp.asarray(ONE_MONT), flat.shape)
+    safe = jnp.where(zero[:, None], one, flat)
+    pre = lax.associative_scan(mont_mul, safe, axis=0)    # prefix products
+    suf = lax.associative_scan(mont_mul, safe, axis=0, reverse=True)
+    tinv = inv(pre[-1])                                   # ONE Fermat
+    left = jnp.concatenate([one[:1], pre[:-1]], axis=0)   # prod before i
+    right = jnp.concatenate([suf[1:], one[:1]], axis=0)   # prod after i
+    out = mont_mul(mont_mul(left, right), tinv[None])
+    out = jnp.where(zero[:, None], 0, out)
+    return out.reshape(shape)
+
+
 def sqrt_candidate(a):
     """a^((P+1)/4) — the square root when a is a QR (P = 3 mod 4).
     Caller must check candidate^2 == a."""

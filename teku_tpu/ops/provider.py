@@ -10,14 +10,17 @@ reference keeps BlstLoader's graceful-degradation path.
 
 Host/device split:
 - host: wire-format parsing (flag bits, x < P), SHA-256 message
-  expansion, pubkey cache bookkeeping, random multipliers;
+  expansion, pubkey cache bookkeeping, random multipliers — all
+  marshaling vectorized with numpy (no per-lane Python bigint work on
+  the hot path);
 - device: pubkey decompression + subgroup checks for cache misses (one
-  batched dispatch), and the whole verification pipeline (hash-to-G2,
-  scalar muls, Miller loops, final exponentiation) in ONE jitted call
-  per padded batch-size bucket.
+  batched dispatch), and the whole verification pipeline — per-lane
+  multi-key aggregation, hash-to-G2, scalar muls, Miller loops, final
+  exponentiation — in ONE jitted call per padded batch-shape bucket.
 
-Batch sizes are padded to powers of two so the jit cache stays small and
-shapes stay static (XLA recompiles nothing after warm-up).
+Batch sizes (and the per-lane key-count axis) are padded to powers of
+two so the jit cache stays small and shapes stay static (XLA recompiles
+nothing after warm-up).
 """
 
 import secrets
@@ -47,33 +50,58 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def bytes_to_limbs_np(b: np.ndarray) -> np.ndarray:
+    """Vectorized big-endian byte matrix (N, nbytes) -> limb matrix
+    (N, L), replacing per-lane Python bigint conversion on the dispatch
+    hot path."""
+    le = b[:, ::-1].astype(np.uint64)          # little-endian bytes
+    n, nb = le.shape
+    out = np.zeros((n, fp.L), dtype=np.int64)
+    for i in range(fp.L):
+        bit0 = fp.W * i
+        byte0, shift = divmod(bit0, 8)
+        acc = np.zeros(n, dtype=np.uint64)
+        for k in range(5):                     # 26 + 7 bits span <= 5 bytes
+            idx = byte0 + k
+            if idx < nb:
+                acc |= le[:, idx] << np.uint64(8 * k)
+        out[:, i] = ((acc >> np.uint64(shift))
+                     & np.uint64(fp.MASK)).astype(np.int64)
+    return out
+
+
 class _Semi(BatchSemiAggregate):
     """Parsed, host-validated triple awaiting the device dispatch."""
 
-    __slots__ = ("pk_points", "message", "sig")
+    __slots__ = ("pk_limbs", "message", "sig_x_bytes", "sig_large",
+                 "sig_inf")
 
-    def __init__(self, pk_points, message, sig):
-        self.pk_points = pk_points   # list of (x, y) int affine G1
+    def __init__(self, pk_limbs, message, sig_x_bytes, sig_large, sig_inf):
+        self.pk_limbs = pk_limbs     # list of (x_mont, y_mont) np (L,)
         self.message = message
-        self.sig = sig               # (x0, x1, large, is_inf) or None=inf
+        self.sig_x_bytes = sig_x_bytes  # (2, 48) BE bytes of (x1, x0)
+        self.sig_large = sig_large
+        self.sig_inf = sig_inf
 
 
 def _parse_g2_wire(sig: bytes):
     """Host wire checks for a compressed G2 signature.
 
-    Returns (x0, x1, large, is_inf) or None when malformed.  On-curve and
-    subgroup membership are checked on device."""
+    Returns (x_bytes (2, 48), large, is_inf) or None when malformed.
+    On-curve and subgroup membership are checked on device."""
     if len(sig) != 96 or not sig[0] & 0x80:
         return None
     if sig[0] & 0x40:
         if any(sig[1:]) or (sig[0] & 0x3F):
             return None
-        return (0, 0, False, True)
+        return (np.zeros((2, 48), dtype=np.uint8), False, True)
     x1 = int.from_bytes(bytes([sig[0] & 0x1F]) + sig[1:48], "big")
     x0 = int.from_bytes(sig[48:96], "big")
     if x0 >= P or x1 >= P:
         return None
-    return (x0, x1, bool(sig[0] & 0x20), False)
+    xb = np.frombuffer(sig, dtype=np.uint8).reshape(2, 48).copy()
+    xb[0, 0] &= 0x1F
+    return (xb, bool(sig[0] & 0x20), False)
 
 
 def _parse_g1_wire(pk: bytes):
@@ -95,10 +123,11 @@ class JaxBls12381(BLS12381):
 
     name = "jax-tpu"
 
-    def __init__(self, max_batch: int = 4096):
+    def __init__(self, max_batch: int = 4096, max_keys_per_lane: int = 2048):
         self._pure = PureBls12381()
         self.max_batch = max_batch
-        # pk bytes -> ("ok", (x, y)) | ("bad",);  validated on device
+        self.max_keys_per_lane = max_keys_per_lane
+        # pk bytes -> ("ok", x_mont (L,), y_mont (L,)) | ("bad",)
         self._pk_cache: dict = {}
         self._u_cache: dict = {}
         self._verify_jit = jax.jit(V.verify_kernel)
@@ -129,11 +158,16 @@ class JaxBls12381(BLS12381):
     def _pk_validate_kernel(x_plain, large):
         ok, pt = PT.g1_recover_y(x_plain, large)
         ok = ok & PT.g1_in_subgroup(pt)
-        aff = V.to_affine_g1(pt)   # Z == 1, so this just normalizes limbs
-        return ok, fp.canonical_plain(aff[0]), fp.canonical_plain(aff[1])
+        # Z == 1 by construction: (X, Y) are already the affine coords
+        return ok, fp.compress(pt[0]), fp.compress(pt[1])
 
     def _resolve_pks(self, all_pks: Sequence[bytes]):
         """Fill the cache for every unseen pubkey in one device dispatch."""
+        if len(self._pk_cache) > 200_000:
+            # Bound like _u_cache: pubkey bytes can be attacker-influenced,
+            # so an unbounded cache (including "bad" entries) is a slow
+            # memory-growth vector.
+            self._pk_cache.clear()
         miss = {}
         for pk in all_pks:
             if pk in self._pk_cache or pk in miss:
@@ -157,8 +191,7 @@ class JaxBls12381(BLS12381):
         gx, gy = np.asarray(gx), np.asarray(gy)
         for i, (pk, _) in enumerate(miss):
             if ok[i]:
-                self._pk_cache[pk] = (
-                    "ok", (fp.limbs_to_int(gx[i]), fp.limbs_to_int(gy[i])))
+                self._pk_cache[pk] = ("ok", gx[i], gy[i])
             else:
                 self._pk_cache[pk] = ("bad",)
 
@@ -181,35 +214,13 @@ class JaxBls12381(BLS12381):
         return hit
 
     # ------------------------------------------------------------------
-    # Aggregation of a triple's pubkeys (device tree-sum for K > 1)
-    # ------------------------------------------------------------------
-    def _aggregate_triple_pk(self, points):
-        if len(points) == 1:
-            return points[0]
-        n = _next_pow2(len(points))
-        xs = np.zeros((n, fp.L), dtype=np.int64)
-        ys = np.zeros((n, fp.L), dtype=np.int64)
-        present = np.zeros(n, dtype=bool)
-        for i, (x, y) in enumerate(points):
-            xs[i] = fp.int_to_mont(x)
-            ys[i] = fp.int_to_mont(y)
-            present[i] = True
-        jac = _agg_jit(xs, ys, present)
-        x3, y3, z3 = (np.asarray(c) for c in jac)
-        # host-normalize the single result (tiny)
-        from ..crypto.bls import curve as C
-        aff = C.to_affine(C.FQ_OPS, (fp.mont_to_int(x3), fp.mont_to_int(y3),
-                                     fp.mont_to_int(z3)))
-        return aff   # None if keys summed to infinity
-
-    # ------------------------------------------------------------------
     # Verification API — everything lands in the batched kernel
     # ------------------------------------------------------------------
     def prepare_batch_verify(
         self, triple: Tuple[Sequence[bytes], bytes, bytes]
     ) -> Optional[BatchSemiAggregate]:
         public_keys, message, signature = triple
-        if not public_keys:
+        if not public_keys or len(public_keys) > self.max_keys_per_lane:
             return None
         self._resolve_pks(public_keys)
         points = []
@@ -217,11 +228,11 @@ class JaxBls12381(BLS12381):
             entry = self._pk_cache[pk]
             if entry[0] != "ok":
                 return None
-            points.append(entry[1])
+            points.append((entry[1], entry[2]))
         sig = _parse_g2_wire(signature)
         if sig is None:
             return None
-        return _Semi(points, message, sig)
+        return _Semi(points, message, *sig)
 
     def complete_batch_verify(
         self, semi_aggregates: Sequence[Optional[BatchSemiAggregate]]
@@ -274,46 +285,42 @@ class JaxBls12381(BLS12381):
     def _dispatch(self, semis: List[_Semi], randomize: bool) -> bool:
         n = len(semis)
         padded = _next_pow2(n)
-        pk_x = np.zeros((padded, fp.L), dtype=np.int64)
-        pk_y = np.zeros((padded, fp.L), dtype=np.int64)
+        kmax = _next_pow2(max(len(s.pk_limbs) for s in semis))
+        pk_xs = np.zeros((padded, kmax, fp.L), dtype=np.int64)
+        pk_ys = np.zeros((padded, kmax, fp.L), dtype=np.int64)
+        pk_present = np.zeros((padded, kmax), dtype=bool)
         u0c0 = np.zeros((padded, fp.L), dtype=np.int64)
         u0c1 = np.zeros((padded, fp.L), dtype=np.int64)
         u1c0 = np.zeros((padded, fp.L), dtype=np.int64)
         u1c1 = np.zeros((padded, fp.L), dtype=np.int64)
-        sx0 = np.zeros((padded, fp.L), dtype=np.int64)
-        sx1 = np.zeros((padded, fp.L), dtype=np.int64)
+        sig_bytes = np.zeros((padded, 2, 48), dtype=np.uint8)
         s_large = np.zeros(padded, dtype=bool)
         s_inf = np.zeros(padded, dtype=bool)
-        rs = np.zeros(padded, dtype=np.uint64)
         lane_valid = np.zeros(padded, dtype=bool)
         for i, s in enumerate(semis):
-            agg = self._aggregate_triple_pk(s.pk_points)
-            if agg is None:
-                return False   # keys summed to infinity (oracle parity)
-            pk_x[i] = fp.int_to_mont(agg[0])
-            pk_y[i] = fp.int_to_mont(agg[1])
+            for j, (x, y) in enumerate(s.pk_limbs):
+                pk_xs[i, j] = x
+                pk_ys[i, j] = y
+                pk_present[i, j] = True
             u0c0[i], u0c1[i], u1c0[i], u1c1[i] = self._u_draws(s.message)
-            x0, x1, lg, inf = s.sig
-            sx0[i] = fp.int_to_limbs(x0)
-            sx1[i] = fp.int_to_limbs(x1)
-            s_large[i] = lg
-            s_inf[i] = inf
-            if randomize:
-                r = 0
-                while r == 0:
-                    r = secrets.randbits(64)
-            else:
-                r = 1
-            rs[i] = r
+            sig_bytes[i] = s.sig_x_bytes
+            s_large[i] = s.sig_large
+            s_inf[i] = s.sig_inf
             lane_valid[i] = True
+        sx1 = bytes_to_limbs_np(sig_bytes[:, 0])
+        sx0 = bytes_to_limbs_np(sig_bytes[:, 1])
+        if randomize:
+            # one os-entropy draw for the whole batch (the reference uses
+            # SecureRandom per multiplier, BlstBLS12381.java:191-195);
+            # zero lanes are nudged to 1 (2^-64 bias, negligible)
+            rs = np.frombuffer(secrets.token_bytes(8 * padded),
+                               dtype=np.uint64).copy()
+            rs[rs == 0] = 1
+        else:
+            rs = np.ones(padded, dtype=np.uint64)
         r_bits = np.asarray(PT.scalar_from_uint64(rs))
-        ok, sig_ok = self._verify_jit(
-            pk_x, pk_y, (u0c0, u0c1), (u1c0, u1c1), (sx0, sx1),
-            s_large, s_inf, r_bits, lane_valid)
-        sig_ok = np.asarray(sig_ok)
-        return bool(np.asarray(ok)) and bool(sig_ok[:n].all())
-
-
-_agg_jit = jax.jit(
-    lambda xs, ys, present: V.aggregate_points_kernel(
-        PT.G1_KIT, xs, ys, present))
+        ok, lane_ok = self._verify_jit(
+            pk_xs, pk_ys, pk_present, (u0c0, u0c1), (u1c0, u1c1),
+            (sx0, sx1), s_large, s_inf, r_bits, lane_valid)
+        lane_ok = np.asarray(lane_ok)
+        return bool(np.asarray(ok)) and bool(lane_ok[:n].all())

@@ -186,10 +186,12 @@ def fq2_mul_by_xi(a):
 
 def fq2_inv(a):
     """Branch-free inverse; inv(0) = 0 (callers select around zero).
-    Input may be lazy up to ~5 units."""
+    Input may be lazy up to ~5 units.  The underlying Fq inversion of
+    the norm is batched across the whole batch shape (ONE Fermat
+    exponentiation per call via limbs.inv_many)."""
     sq = fp.mont_sqr(_stk(a[0], a[1]))
-    norm = fp.add(sq[..., 0, :], sq[..., 1, :])
-    ninv = fp.inv(norm)
+    norm = fp.compress(fp.add(sq[..., 0, :], sq[..., 1, :]))
+    ninv = fp.inv_many(norm)
     t = fp.mont_mul(_stk(a[0], a[1]), ninv[..., None, :])
     return (t[..., 0, :], fp.neg(t[..., 1, :]))
 

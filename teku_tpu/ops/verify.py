@@ -59,22 +59,48 @@ def point_batch_sum(k, p):
 
 
 def to_affine_g1(p):
-    """Batched Jacobian -> affine for G1 (vectorized Fermat inversions)."""
-    zinv = fp.inv(p[2])
+    """Batched Jacobian -> affine for G1 (one batched inversion: a
+    single Fermat exponentiation for the whole batch)."""
+    zinv = fp.inv_many(p[2])
     zinv2 = fp.mont_sqr(zinv)
     t = fp.mont_mul(jnp.stack([p[0], fp.mont_mul(zinv2, zinv)], axis=-2),
                     jnp.stack([zinv2, p[1]], axis=-2))
     return (t[..., 0, :], t[..., 1, :])
 
 
-def _lane_work(pk_x, pk_y, u0, u1, sig_x_plain, sig_large, sig_inf,
-               r_bits, lane_valid):
+def _aggregate_lane_pks(pk_xs, pk_ys, pk_present):
+    """Per-lane pubkey aggregation INSIDE the dispatch: (N, K, L) padded
+    affine key matrices -> one Jacobian aggregate per lane + infinity
+    flag.  Replaces the reference's host-side aggregate loop (and round
+    2's per-triple device round trips) with a log2(K)-depth masked tree
+    sum that ships in the same compiled program."""
+    one = jnp.broadcast_to(jnp.asarray(fp.ONE_MONT), pk_xs.shape)
+    if pk_xs.shape[-2] == 1:
+        pk_jac = (pk_xs[..., 0, :], pk_ys[..., 0, :], one[..., 0, :])
+        inf = PT.infinity_like(PT.G1_KIT, pk_jac[0])
+        pk_jac = PT._select_point(PT.G1_KIT, ~pk_present[..., 0],
+                                 inf, pk_jac)
+    else:
+        jac = (pk_xs, pk_ys, one)
+        inf = PT.infinity_like(PT.G1_KIT, pk_xs)
+        jac = PT._select_point(PT.G1_KIT, pk_present, jac, inf)
+        jac = jax.tree_util.tree_map(
+            lambda x: jnp.moveaxis(x, -2, 0), jac)   # (K, N, L)
+        pk_jac = point_batch_sum(PT.G1_KIT, jac)
+    return pk_jac, PT.is_infinity(PT.G1_KIT, pk_jac)
+
+
+def _lane_work(pk_xs, pk_ys, pk_present, u0, u1, sig_x_plain, sig_large,
+               sig_inf, r_bits, lane_valid):
     """Per-lane pipeline (shardable over the batch axis with no
-    communication): signature parse + subgroup check, hash-to-G2,
-    random-multiplier scalar muls, per-lane Miller loop.
+    communication): per-lane pubkey aggregation, signature parse +
+    subgroup check, hash-to-G2, random-multiplier scalar muls, per-lane
+    Miller loop.
 
     Returns (ml (N-lane Fq12 values), wsig (N weighted sig points),
-    sig_ok (N,))."""
+    lane_ok (N,))."""
+    pk_jac, pk_inf = _aggregate_lane_pks(pk_xs, pk_ys, pk_present)
+
     dec_ok, sig_pt = PT.g2_recover_y(sig_x_plain, sig_large)
     in_sub = PT.g2_in_subgroup(sig_pt)
     sig_ok = (dec_ok & in_sub) | sig_inf
@@ -85,14 +111,12 @@ def _lane_work(pk_x, pk_y, u0, u1, sig_x_plain, sig_large, sig_inf,
     hm = h2c.hash_to_g2_device(u0, u1)
     hm_aff = h2c.to_affine_g2(hm)
 
-    pk_jac = (pk_x, pk_y, jnp.broadcast_to(jnp.asarray(fp.ONE_MONT),
-                                           pk_x.shape))
     pk_r = PT.scalar_mul_bits(PT.G1_KIT, r_bits, pk_jac)
     pk_r_aff = to_affine_g1(pk_r)
     wsig = PT.scalar_mul_bits(PT.G2_KIT, r_bits, sig_jac)
 
-    ml = PR.miller_loop(pk_r_aff, hm_aff, mask=lane_valid)
-    return ml, wsig, sig_ok
+    ml = PR.miller_loop(pk_r_aff, hm_aff, mask=lane_valid & ~pk_inf)
+    return ml, wsig, sig_ok & ~pk_inf
 
 
 def _finish(ml_prod, s_sum):
@@ -107,26 +131,30 @@ def _finish(ml_prod, s_sum):
     return PR.pairing_check(f)
 
 
-def verify_kernel(pk_x, pk_y, u0, u1, sig_x_plain, sig_large, sig_inf,
-                  r_bits, lane_valid):
+def verify_kernel(pk_xs, pk_ys, pk_present, u0, u1, sig_x_plain,
+                  sig_large, sig_inf, r_bits, lane_valid):
     """The batched verification dispatch (single device).
 
-    pk_x/pk_y: (N, L) Montgomery limbs — per-triple AGGREGATE pubkey,
-        already validated (subgroup, non-infinity) by the caller's cache.
+    pk_xs/pk_ys: (N, K, L) Montgomery limbs — per-triple pubkeys, each
+        already validated (subgroup, non-infinity) by the caller's
+        cache, padded to K along axis 1; aggregation happens in-kernel.
+    pk_present: (N, K) — False for key-padding slots.
     u0/u1: Fq2 draws of each message's hash_to_field (host SHA-256).
     sig_x_plain: ((N, L), (N, L)) plain-form Fq2 x of each signature;
     sig_large: (N,) wire sign bit; sig_inf: (N,) infinity-signature mask.
     r_bits: (N, 64) bits of the nonzero random multipliers, MSB first.
     lane_valid: (N,) — False for padding lanes.
 
-    Returns (ok, sig_ok): ok is the whole-batch pairing verdict; sig_ok
-    flags lanes whose signature failed decompression/subgroup checks
-    (the caller must AND `ok` with all valid lanes' sig_ok).
+    Returns (ok, lane_ok): ok is the whole-batch pairing verdict;
+    lane_ok flags lanes whose signature failed decompression/subgroup
+    checks or whose keys aggregated to infinity (the caller must AND
+    `ok` with all valid lanes' lane_ok).
     """
-    ml, wsig, sig_ok = _lane_work(pk_x, pk_y, u0, u1, sig_x_plain,
-                                  sig_large, sig_inf, r_bits, lane_valid)
+    ml, wsig, lane_ok = _lane_work(pk_xs, pk_ys, pk_present, u0, u1,
+                                   sig_x_plain, sig_large, sig_inf,
+                                   r_bits, lane_valid)
     ok = _finish(PR.batch_product(ml), point_batch_sum(PT.G2_KIT, wsig))
-    return ok, sig_ok
+    return ok, lane_ok
 
 
 def verify_kernel_sharded(mesh, axis: str = "dp"):
@@ -142,12 +170,13 @@ def verify_kernel_sharded(mesh, axis: str = "dp"):
 
     lane = P(axis)
     lane2 = P(axis, None)       # (N, L) and (N, 64)
+    lane3 = P(axis, None, None)  # (N, K, L)
 
-    def shard_fn(pk_x, pk_y, u0, u1, sig_x, sig_large, sig_inf,
-                 r_bits, lane_valid):
-        ml, wsig, sig_ok = _lane_work(pk_x, pk_y, u0, u1, sig_x,
-                                      sig_large, sig_inf, r_bits,
-                                      lane_valid)
+    def shard_fn(pk_xs, pk_ys, pk_present, u0, u1, sig_x, sig_large,
+                 sig_inf, r_bits, lane_valid):
+        ml, wsig, lane_ok = _lane_work(pk_xs, pk_ys, pk_present, u0, u1,
+                                       sig_x, sig_large, sig_inf, r_bits,
+                                       lane_valid)
         local_prod = PR.batch_product(ml)
         local_sum = point_batch_sum(PT.G2_KIT, wsig)
         # gather the tiny per-device partials and combine identically
@@ -158,9 +187,9 @@ def verify_kernel_sharded(mesh, axis: str = "dp"):
         total_prod = PR.batch_product(gathered_prod)
         total_sum = point_batch_sum(PT.G2_KIT, gathered_sum)
         ok = _finish(total_prod, total_sum)
-        return ok, sig_ok
+        return ok, lane_ok
 
-    in_specs = (lane2, lane2, (lane2, lane2), (lane2, lane2),
+    in_specs = (lane3, lane3, lane2, (lane2, lane2), (lane2, lane2),
                 (lane2, lane2), lane, lane, lane2, lane)
     out_specs = (P(), lane)
     return shard_map(shard_fn, mesh=mesh, in_specs=in_specs,

@@ -24,6 +24,7 @@ attestation-gossip p50 the north star measures.
 """
 
 import asyncio
+import logging
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Tuple
 
@@ -31,6 +32,8 @@ from ..crypto import bls
 from ..infra.metrics import GLOBAL_REGISTRY, MetricsRegistry
 
 Triple = Tuple[Sequence[bytes], bytes, bytes]
+
+_LOG = logging.getLogger(__name__)
 
 
 class ServiceCapacityExceededError(Exception):
@@ -56,7 +59,11 @@ class AggregatingSignatureVerificationService:
         self.queue_capacity = queue_capacity
         self.max_batch_size = max_batch_size
         self.split_threshold = split_threshold
-        self._queue: asyncio.Queue = asyncio.Queue()
+        # Genuinely bounded, like the reference's ArrayBlockingQueue.offer
+        # (AggregatingSignatureVerificationService.java:146-160): put_nowait
+        # on a full queue raises QueueFull -> capacity-exceeded, so
+        # concurrent producers cannot overshoot the capacity.
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=queue_capacity)
         self._workers: List[asyncio.Task] = []
         self._started = False
         self._stopped = False
@@ -90,6 +97,14 @@ class AggregatingSignatureVerificationService:
             except asyncio.CancelledError:
                 pass
         self._workers.clear()
+        # Fail tasks still in the queue so callers never hang on shutdown.
+        while True:
+            try:
+                task = self._queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            if not task.future.done():
+                task.future.cancel()
 
     # ------------------------------------------------------------------
     def verify(self, public_keys: Sequence[bytes], message: bytes,
@@ -103,11 +118,12 @@ class AggregatingSignatureVerificationService:
         signatures of a SignedAggregateAndProof verify together)."""
         if not self._started or self._stopped:
             raise RuntimeError("service not running")
-        if self._queue.qsize() >= self.queue_capacity:
-            raise ServiceCapacityExceededError(
-                f"queue at capacity ({self.queue_capacity})")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._queue.put_nowait(_Task(list(triples), fut))
+        try:
+            self._queue.put_nowait(_Task(list(triples), fut))
+        except asyncio.QueueFull:
+            raise ServiceCapacityExceededError(
+                f"queue at capacity ({self.queue_capacity})") from None
         return fut
 
     # ------------------------------------------------------------------
@@ -123,7 +139,18 @@ class AggregatingSignatureVerificationService:
                     break
                 tasks.append(nxt)
                 budget -= len(nxt.triples)
-            await self._verify_batch(tasks)
+            try:
+                await self._verify_batch(tasks)
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # provider/JAX runtime error
+                # The worker must survive (the reference at least logs
+                # worker death, doStart .finish(err -> LOG.error)); fail
+                # the affected futures so callers never await forever.
+                _LOG.exception("signature batch verification failed")
+                for t in tasks:
+                    if not t.future.done():
+                        t.future.set_exception(exc)
 
     async def _verify_batch(self, tasks: List[_Task]) -> None:
         tasks = [t for t in tasks if not t.future.cancelled()]

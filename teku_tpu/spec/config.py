@@ -1,0 +1,153 @@
+"""Consensus spec configuration: presets + per-network parameters.
+
+Equivalent of the reference's SpecConfig/preset system (reference:
+ethereum/spec/src/main/java/tech/pegasys/teku/spec/config/SpecConfig.java
+and the bundled preset YAMLs under spec/config/configs/) — here a plain
+frozen dataclass with the mainnet and minimal presets inlined (the
+values are protocol constants from the public consensus specs, not
+reference-repo code).
+"""
+
+from dataclasses import dataclass, field, replace
+from typing import Dict, Tuple
+
+FAR_FUTURE_EPOCH = 2 ** 64 - 1
+GENESIS_SLOT = 0
+GENESIS_EPOCH = 0
+
+# BLS domain types (consensus spec constants)
+DOMAIN_BEACON_PROPOSER = bytes.fromhex("00000000")
+DOMAIN_BEACON_ATTESTER = bytes.fromhex("01000000")
+DOMAIN_RANDAO = bytes.fromhex("02000000")
+DOMAIN_DEPOSIT = bytes.fromhex("03000000")
+DOMAIN_VOLUNTARY_EXIT = bytes.fromhex("04000000")
+DOMAIN_SELECTION_PROOF = bytes.fromhex("05000000")
+DOMAIN_AGGREGATE_AND_PROOF = bytes.fromhex("06000000")
+DOMAIN_SYNC_COMMITTEE = bytes.fromhex("07000000")
+DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF = bytes.fromhex("08000000")
+DOMAIN_CONTRIBUTION_AND_PROOF = bytes.fromhex("09000000")
+DOMAIN_BLS_TO_EXECUTION_CHANGE = bytes.fromhex("0A000000")
+DOMAIN_APPLICATION_MASK = bytes.fromhex("00000001")
+
+
+@dataclass(frozen=True)
+class SpecConfig:
+    """Phase0(+) spec parameters; field names follow the consensus spec."""
+
+    preset_name: str = "mainnet"
+    config_name: str = "mainnet"
+
+    # Misc
+    MAX_COMMITTEES_PER_SLOT: int = 64
+    TARGET_COMMITTEE_SIZE: int = 128
+    MAX_VALIDATORS_PER_COMMITTEE: int = 2048
+    SHUFFLE_ROUND_COUNT: int = 90
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT: int = 16384
+    MIN_GENESIS_TIME: int = 1606824000
+    HYSTERESIS_QUOTIENT: int = 4
+    HYSTERESIS_DOWNWARD_MULTIPLIER: int = 1
+    HYSTERESIS_UPWARD_MULTIPLIER: int = 5
+    PROPORTIONAL_SLASHING_MULTIPLIER: int = 1
+
+    # Gwei values
+    MIN_DEPOSIT_AMOUNT: int = 10 ** 9
+    MAX_EFFECTIVE_BALANCE: int = 32 * 10 ** 9
+    EJECTION_BALANCE: int = 16 * 10 ** 9
+    EFFECTIVE_BALANCE_INCREMENT: int = 10 ** 9
+
+    # Initial values
+    GENESIS_FORK_VERSION: bytes = bytes(4)
+    GENESIS_DELAY: int = 604800
+    BLS_WITHDRAWAL_PREFIX: bytes = b"\x00"
+
+    # Time parameters
+    SECONDS_PER_SLOT: int = 12
+    SECONDS_PER_ETH1_BLOCK: int = 14
+    MIN_ATTESTATION_INCLUSION_DELAY: int = 1
+    SLOTS_PER_EPOCH: int = 32
+    MIN_SEED_LOOKAHEAD: int = 1
+    MAX_SEED_LOOKAHEAD: int = 4
+    EPOCHS_PER_ETH1_VOTING_PERIOD: int = 64
+    SLOTS_PER_HISTORICAL_ROOT: int = 8192
+    MIN_VALIDATOR_WITHDRAWABILITY_DELAY: int = 256
+    SHARD_COMMITTEE_PERIOD: int = 256
+    MIN_EPOCHS_TO_INACTIVITY_PENALTY: int = 4
+
+    # State list lengths
+    EPOCHS_PER_HISTORICAL_VECTOR: int = 65536
+    EPOCHS_PER_SLASHINGS_VECTOR: int = 8192
+    HISTORICAL_ROOTS_LIMIT: int = 2 ** 24
+    VALIDATOR_REGISTRY_LIMIT: int = 2 ** 40
+
+    # Rewards and penalties
+    BASE_REWARD_FACTOR: int = 64
+    WHISTLEBLOWER_REWARD_QUOTIENT: int = 512
+    PROPOSER_REWARD_QUOTIENT: int = 8
+    INACTIVITY_PENALTY_QUOTIENT: int = 2 ** 26
+    MIN_SLASHING_PENALTY_QUOTIENT: int = 128
+
+    # Max operations per block
+    MAX_PROPOSER_SLASHINGS: int = 16
+    MAX_ATTESTER_SLASHINGS: int = 2
+    MAX_ATTESTATIONS: int = 128
+    MAX_DEPOSITS: int = 16
+    MAX_VOLUNTARY_EXITS: int = 16
+
+    # Deposit contract
+    DEPOSIT_CONTRACT_TREE_DEPTH: int = 32
+    DEPOSIT_CHAIN_ID: int = 1
+    DEPOSIT_NETWORK_ID: int = 1
+
+    # Fork choice
+    PROPOSER_SCORE_BOOST: int = 40
+    INTERVALS_PER_SLOT: int = 3
+
+    # Networking / gossip validation windows
+    ATTESTATION_PROPAGATION_SLOT_RANGE: int = 32
+    MAXIMUM_GOSSIP_CLOCK_DISPARITY_MS: int = 500
+
+    # Validator
+    TARGET_AGGREGATORS_PER_COMMITTEE: int = 16
+    RANDOM_SUBNETS_PER_VALIDATOR: int = 1
+    EPOCHS_PER_RANDOM_SUBNET_SUBSCRIPTION: int = 256
+    ATTESTATION_SUBNET_COUNT: int = 64
+
+
+MAINNET = SpecConfig()
+
+MINIMAL = SpecConfig(
+    preset_name="minimal",
+    config_name="minimal",
+    MAX_COMMITTEES_PER_SLOT=4,
+    TARGET_COMMITTEE_SIZE=4,
+    SHUFFLE_ROUND_COUNT=10,
+    MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=64,
+    MIN_GENESIS_TIME=1578009600,
+    SECONDS_PER_SLOT=6,
+    SLOTS_PER_EPOCH=8,
+    EPOCHS_PER_ETH1_VOTING_PERIOD=4,
+    SLOTS_PER_HISTORICAL_ROOT=64,
+    MIN_VALIDATOR_WITHDRAWABILITY_DELAY=256,
+    SHARD_COMMITTEE_PERIOD=64,
+    EPOCHS_PER_HISTORICAL_VECTOR=64,
+    EPOCHS_PER_SLASHINGS_VECTOR=64,
+    HISTORICAL_ROOTS_LIMIT=2 ** 24,
+    VALIDATOR_REGISTRY_LIMIT=2 ** 40,
+    GENESIS_DELAY=300,
+    INACTIVITY_PENALTY_QUOTIENT=2 ** 25,
+    MIN_SLASHING_PENALTY_QUOTIENT=64,
+    PROPORTIONAL_SLASHING_MULTIPLIER=2,
+    GENESIS_FORK_VERSION=bytes.fromhex("00000001"),
+)
+
+NETWORKS: Dict[str, SpecConfig] = {
+    "mainnet": MAINNET,
+    "minimal": MINIMAL,
+}
+
+
+def get_config(name: str) -> SpecConfig:
+    try:
+        return NETWORKS[name]
+    except KeyError:
+        raise ValueError(f"unknown network/preset {name!r}") from None

@@ -1,0 +1,278 @@
+"""Phase0 consensus datastructures on the SSZ engine.
+
+Equivalent of the reference's spec/datastructures tree (reference:
+ethereum/spec/src/main/java/tech/pegasys/teku/spec/datastructures/ —
+there versioned schema registries; here Container classes built per
+SpecConfig, since list limits and vector lengths are preset-dependent).
+
+`Schemas(config)` materializes the full phase0 family once per config
+and is cached; `SCHEMAS_MAINNET` / `SCHEMAS_MINIMAL` are the common
+instantiations.
+"""
+
+from functools import lru_cache
+
+from ..ssz import (Bitlist, Bitvector, boolean, Bytes4, Bytes32, Bytes48,
+                   Bytes96, Container, List, uint64, Vector)
+from ..ssz.types import _ContainerMeta
+from .config import MAINNET, MINIMAL, SpecConfig
+
+
+def _container(name, fields):
+    """Create a Container subclass from (field, schema) pairs."""
+    return _ContainerMeta(name, (Container,),
+                          {"__annotations__": dict(fields)})
+
+
+# ---- preset-independent containers (defined once, module level) ----
+
+class Fork(Container):
+    previous_version: Bytes4
+    current_version: Bytes4
+    epoch: uint64
+
+
+class ForkData(Container):
+    current_version: Bytes4
+    genesis_validators_root: Bytes32
+
+
+class Checkpoint(Container):
+    epoch: uint64
+    root: Bytes32
+
+
+class Validator(Container):
+    pubkey: Bytes48
+    withdrawal_credentials: Bytes32
+    effective_balance: uint64
+    slashed: boolean
+    activation_eligibility_epoch: uint64
+    activation_epoch: uint64
+    exit_epoch: uint64
+    withdrawable_epoch: uint64
+
+
+class AttestationData(Container):
+    slot: uint64
+    index: uint64
+    beacon_block_root: Bytes32
+    source: Checkpoint
+    target: Checkpoint
+
+
+class Eth1Data(Container):
+    deposit_root: Bytes32
+    deposit_count: uint64
+    block_hash: Bytes32
+
+
+class DepositMessage(Container):
+    pubkey: Bytes48
+    withdrawal_credentials: Bytes32
+    amount: uint64
+
+
+class DepositData(Container):
+    pubkey: Bytes48
+    withdrawal_credentials: Bytes32
+    amount: uint64
+    signature: Bytes96
+
+
+class BeaconBlockHeader(Container):
+    slot: uint64
+    proposer_index: uint64
+    parent_root: Bytes32
+    state_root: Bytes32
+    body_root: Bytes32
+
+
+class SignedBeaconBlockHeader(Container):
+    message: BeaconBlockHeader
+    signature: Bytes96
+
+
+class ProposerSlashing(Container):
+    signed_header_1: SignedBeaconBlockHeader
+    signed_header_2: SignedBeaconBlockHeader
+
+
+class VoluntaryExit(Container):
+    epoch: uint64
+    validator_index: uint64
+
+
+class SignedVoluntaryExit(Container):
+    message: VoluntaryExit
+    signature: Bytes96
+
+
+class SigningData(Container):
+    object_root: Bytes32
+    domain: Bytes32
+
+
+class Eth1Block(Container):
+    timestamp: uint64
+    deposit_root: Bytes32
+    deposit_count: uint64
+
+
+class Status(Container):
+    """Req/resp status message (networking/eth2 rpc)."""
+    fork_digest: Bytes4
+    finalized_root: Bytes32
+    finalized_epoch: uint64
+    head_root: Bytes32
+    head_slot: uint64
+
+
+class Goodbye(Container):
+    reason: uint64
+
+
+class Ping(Container):
+    seq_number: uint64
+
+
+class MetadataMessage(Container):
+    seq_number: uint64
+    attnets: Bitvector(64)
+
+
+class Schemas:
+    """Preset-parameterized phase0 schema family.
+
+    Mirrors the reference's SchemaDefinitions registry (reference:
+    ethereum/spec/.../spec/schemas/SchemaDefinitions.java): one object
+    holding every container class for a given SpecConfig.
+    """
+
+    def __init__(self, cfg: SpecConfig):
+        self.config = cfg
+
+        # re-export the preset-independent ones for a single namespace
+        self.Fork = Fork
+        self.ForkData = ForkData
+        self.Checkpoint = Checkpoint
+        self.Validator = Validator
+        self.AttestationData = AttestationData
+        self.Eth1Data = Eth1Data
+        self.DepositMessage = DepositMessage
+        self.DepositData = DepositData
+        self.BeaconBlockHeader = BeaconBlockHeader
+        self.SignedBeaconBlockHeader = SignedBeaconBlockHeader
+        self.ProposerSlashing = ProposerSlashing
+        self.VoluntaryExit = VoluntaryExit
+        self.SignedVoluntaryExit = SignedVoluntaryExit
+        self.SigningData = SigningData
+
+        self.IndexedAttestation = _container("IndexedAttestation", [
+            ("attesting_indices",
+             List(uint64, cfg.MAX_VALIDATORS_PER_COMMITTEE)),
+            ("data", AttestationData),
+            ("signature", Bytes96),
+        ])
+        self.AttesterSlashing = _container("AttesterSlashing", [
+            ("attestation_1", self.IndexedAttestation),
+            ("attestation_2", self.IndexedAttestation),
+        ])
+        self.Attestation = _container("Attestation", [
+            ("aggregation_bits",
+             Bitlist(cfg.MAX_VALIDATORS_PER_COMMITTEE)),
+            ("data", AttestationData),
+            ("signature", Bytes96),
+        ])
+        self.PendingAttestation = _container("PendingAttestation", [
+            ("aggregation_bits",
+             Bitlist(cfg.MAX_VALIDATORS_PER_COMMITTEE)),
+            ("data", AttestationData),
+            ("inclusion_delay", uint64),
+            ("proposer_index", uint64),
+        ])
+        self.Deposit = _container("Deposit", [
+            ("proof", Vector(Bytes32, cfg.DEPOSIT_CONTRACT_TREE_DEPTH + 1)),
+            ("data", DepositData),
+        ])
+        self.AggregateAndProof = _container("AggregateAndProof", [
+            ("aggregator_index", uint64),
+            ("aggregate", self.Attestation),
+            ("selection_proof", Bytes96),
+        ])
+        self.SignedAggregateAndProof = _container("SignedAggregateAndProof", [
+            ("message", self.AggregateAndProof),
+            ("signature", Bytes96),
+        ])
+        self.BeaconBlockBody = _container("BeaconBlockBody", [
+            ("randao_reveal", Bytes96),
+            ("eth1_data", Eth1Data),
+            ("graffiti", Bytes32),
+            ("proposer_slashings",
+             List(ProposerSlashing, cfg.MAX_PROPOSER_SLASHINGS)),
+            ("attester_slashings",
+             List(self.AttesterSlashing, cfg.MAX_ATTESTER_SLASHINGS)),
+            ("attestations", List(self.Attestation, cfg.MAX_ATTESTATIONS)),
+            ("deposits", List(self.Deposit, cfg.MAX_DEPOSITS)),
+            ("voluntary_exits",
+             List(SignedVoluntaryExit, cfg.MAX_VOLUNTARY_EXITS)),
+        ])
+        self.BeaconBlock = _container("BeaconBlock", [
+            ("slot", uint64),
+            ("proposer_index", uint64),
+            ("parent_root", Bytes32),
+            ("state_root", Bytes32),
+            ("body", self.BeaconBlockBody),
+        ])
+        self.SignedBeaconBlock = _container("SignedBeaconBlock", [
+            ("message", self.BeaconBlock),
+            ("signature", Bytes96),
+        ])
+        self.HistoricalBatch = _container("HistoricalBatch", [
+            ("block_roots", Vector(Bytes32, cfg.SLOTS_PER_HISTORICAL_ROOT)),
+            ("state_roots", Vector(Bytes32, cfg.SLOTS_PER_HISTORICAL_ROOT)),
+        ])
+        self.BeaconState = _container("BeaconState", [
+            ("genesis_time", uint64),
+            ("genesis_validators_root", Bytes32),
+            ("slot", uint64),
+            ("fork", Fork),
+            ("latest_block_header", BeaconBlockHeader),
+            ("block_roots", Vector(Bytes32, cfg.SLOTS_PER_HISTORICAL_ROOT)),
+            ("state_roots", Vector(Bytes32, cfg.SLOTS_PER_HISTORICAL_ROOT)),
+            ("historical_roots", List(Bytes32, cfg.HISTORICAL_ROOTS_LIMIT)),
+            ("eth1_data", Eth1Data),
+            ("eth1_data_votes",
+             List(Eth1Data, cfg.EPOCHS_PER_ETH1_VOTING_PERIOD
+                  * cfg.SLOTS_PER_EPOCH)),
+            ("eth1_deposit_index", uint64),
+            ("validators",
+             List(Validator, cfg.VALIDATOR_REGISTRY_LIMIT)),
+            ("balances", List(uint64, cfg.VALIDATOR_REGISTRY_LIMIT)),
+            ("randao_mixes",
+             Vector(Bytes32, cfg.EPOCHS_PER_HISTORICAL_VECTOR)),
+            ("slashings", Vector(uint64, cfg.EPOCHS_PER_SLASHINGS_VECTOR)),
+            ("previous_epoch_attestations",
+             List(self.PendingAttestation,
+                  cfg.MAX_ATTESTATIONS * cfg.SLOTS_PER_EPOCH)),
+            ("current_epoch_attestations",
+             List(self.PendingAttestation,
+                  cfg.MAX_ATTESTATIONS * cfg.SLOTS_PER_EPOCH)),
+            ("justification_bits", Bitvector(4)),
+            ("previous_justified_checkpoint", Checkpoint),
+            ("current_justified_checkpoint", Checkpoint),
+            ("finalized_checkpoint", Checkpoint),
+        ])
+
+
+@lru_cache(maxsize=8)
+def _schemas_for(cfg: SpecConfig) -> Schemas:
+    return Schemas(cfg)
+
+
+def get_schemas(cfg: SpecConfig) -> Schemas:
+    return _schemas_for(cfg)
+
+
+SCHEMAS_MAINNET = get_schemas(MAINNET)
+SCHEMAS_MINIMAL = get_schemas(MINIMAL)

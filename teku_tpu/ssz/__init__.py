@@ -1,0 +1,25 @@
+"""SSZ engine: schemas, serialization, hash-tree-root merkleization.
+
+TPU-build equivalent of the reference's SSZ sub-framework (reference:
+infrastructure/ssz/ — SszSchema/SszContainer/TreeNode hierarchy).
+"""
+
+from .hash import (ZERO_CHUNK, hash_pair, merkleize, mix_in_length,
+                   mix_in_selector, pack_bytes, zero_hash)
+from .types import (Bitlist, BitlistType, Bitvector, BitvectorType, boolean,
+                    ByteList, ByteListType, Bytes4, Bytes20, Bytes32,
+                    Bytes48, Bytes96, ByteVector, ByteVectorType, Container,
+                    List, ListType, SszError, SszType, uint8, uint16,
+                    uint32, uint64, uint128, uint256, UIntType, Union,
+                    UnionType, Vector, VectorType)
+
+__all__ = [
+    "ZERO_CHUNK", "hash_pair", "merkleize", "mix_in_length",
+    "mix_in_selector", "pack_bytes", "zero_hash",
+    "Bitlist", "BitlistType", "Bitvector", "BitvectorType", "boolean",
+    "ByteList", "ByteListType", "Bytes4", "Bytes20", "Bytes32", "Bytes48",
+    "Bytes96", "ByteVector", "ByteVectorType", "Container", "List",
+    "ListType", "SszError", "SszType", "uint8", "uint16", "uint32",
+    "uint64", "uint128", "uint256", "UIntType", "Union", "UnionType",
+    "Vector", "VectorType",
+]

@@ -1,0 +1,83 @@
+"""SSZ merkleization: SHA-256 binary merkle trees with virtual zero
+subtrees.
+
+The TPU-native analogue of the reference's tree hashing
+(reference: infrastructure/ssz/src/main/java/tech/pegasys/teku/
+infrastructure/ssz/tree/TreeUtil.java and .../tree/BranchNode.java —
+there an incremental persistent tree; here level-by-level hashing with
+memoized per-view roots at the schema layer, plus an optional native
+C++ level hasher for bulk re-hashes).
+"""
+
+import hashlib
+from functools import lru_cache
+from typing import List, Optional, Sequence
+
+ZERO_CHUNK = b"\x00" * 32
+
+try:  # optional C++ bulk pair-hasher (teku_tpu/native)
+    from ..native import hashtree as _native
+except Exception:  # pragma: no cover - native build unavailable
+    _native = None
+
+
+@lru_cache(maxsize=64)
+def zero_hash(depth: int) -> bytes:
+    """Root of an all-zero subtree of the given depth."""
+    if depth == 0:
+        return ZERO_CHUNK
+    h = zero_hash(depth - 1)
+    return hashlib.sha256(h + h).digest()
+
+
+def hash_pair(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(a + b).digest()
+
+
+def _hash_level(level: List[bytes], pad: bytes) -> List[bytes]:
+    if len(level) % 2:
+        level = level + [pad]
+    if _native is not None and len(level) >= 8:
+        return _native.hash_pairs(level)
+    out = []
+    for i in range(0, len(level), 2):
+        out.append(hashlib.sha256(level[i] + level[i + 1]).digest())
+    return out
+
+
+def merkleize(chunks: Sequence[bytes], limit: Optional[int] = None) -> bytes:
+    """Merkle root of 32-byte chunks, virtually padded to `limit` leaves
+    (or to the next power of two when limit is None).
+
+    Mirrors the consensus-spec `merkleize(chunks, limit)`; the all-zero
+    right-hand subtrees are folded in via precomputed zero hashes rather
+    than materialized.
+    """
+    count = len(chunks)
+    size = max(count, 1) if limit is None else limit
+    depth = (size - 1).bit_length() if size > 1 else 0
+    if limit is not None and count > limit:
+        raise ValueError(f"{count} chunks exceed limit {limit}")
+    if count == 0:
+        return zero_hash(depth)
+    level = list(chunks)
+    for d in range(depth):
+        level = _hash_level(level, zero_hash(d))
+    return level[0]
+
+
+def mix_in_length(root: bytes, length: int) -> bytes:
+    return hash_pair(root, length.to_bytes(32, "little"))
+
+
+def mix_in_selector(root: bytes, selector: int) -> bytes:
+    return hash_pair(root, selector.to_bytes(32, "little"))
+
+
+def pack_bytes(data: bytes) -> List[bytes]:
+    """Right-pad serialized bytes into 32-byte chunks."""
+    if not data:
+        return []
+    if len(data) % 32:
+        data = data + b"\x00" * (32 - len(data) % 32)
+    return [data[i:i + 32] for i in range(0, len(data), 32)]

@@ -1,0 +1,726 @@
+"""SSZ schema system: basic types, vectors, lists, bitfields, containers.
+
+The data substrate for every consensus object — the TPU build's
+equivalent of the reference's SSZ sub-framework (reference:
+infrastructure/ssz/src/main/java/tech/pegasys/teku/infrastructure/ssz/
+schema/SszSchema.java, .../SszContainerSchema.java, view hierarchy in
+.../SszContainer.java etc., 18.8k LoC).  Differences are deliberate and
+idiomatic-Python:
+
+- schemas are lightweight objects with serialize/deserialize/
+  hash_tree_root over PLAIN values (ints, bool, bytes, tuples,
+  Container instances) instead of a schema+backing-tree+view triple;
+- containers are declared with class annotations and are immutable
+  value objects; hash_tree_root is memoized per instance, so unchanged
+  subtrees hash once across state copies (the moral equivalent of the
+  reference's cached branch nodes);
+- deserialization is strict: offset monotonicity, exact consumption,
+  bitlist delimiter checks — malformed wire input raises SszError
+  (the reference's DeserializeException).
+"""
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Type
+
+from .hash import (ZERO_CHUNK, merkleize, mix_in_length, mix_in_selector,
+                   pack_bytes)
+
+OFFSET_SIZE = 4
+
+
+class SszError(ValueError):
+    """Malformed SSZ input (the wire must be rejected, not repaired)."""
+
+
+# --------------------------------------------------------------------------
+# Schema base
+# --------------------------------------------------------------------------
+
+class SszType:
+    """Base schema: fixed/variable size, ser/de, hash-tree-root."""
+
+    def is_fixed_size(self) -> bool:
+        raise NotImplementedError
+
+    def fixed_size(self) -> int:
+        """Byte length when fixed-size (raises otherwise)."""
+        raise NotImplementedError
+
+    def serialize(self, value) -> bytes:
+        raise NotImplementedError
+
+    def deserialize(self, data: bytes):
+        raise NotImplementedError
+
+    def hash_tree_root(self, value) -> bytes:
+        raise NotImplementedError
+
+    def default(self):
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------------
+# Basic types
+# --------------------------------------------------------------------------
+
+class UIntType(SszType):
+    def __init__(self, bits: int):
+        assert bits in (8, 16, 32, 64, 128, 256)
+        self.bits = bits
+        self.bytes_len = bits // 8
+
+    def is_fixed_size(self):
+        return True
+
+    def fixed_size(self):
+        return self.bytes_len
+
+    def serialize(self, value) -> bytes:
+        value = int(value)
+        if not 0 <= value < (1 << self.bits):
+            raise SszError(f"uint{self.bits} out of range: {value}")
+        return value.to_bytes(self.bytes_len, "little")
+
+    def deserialize(self, data: bytes) -> int:
+        if len(data) != self.bytes_len:
+            raise SszError(f"uint{self.bits}: want {self.bytes_len} bytes, "
+                           f"got {len(data)}")
+        return int.from_bytes(data, "little")
+
+    def hash_tree_root(self, value) -> bytes:
+        return self.serialize(value).ljust(32, b"\x00")
+
+    def default(self) -> int:
+        return 0
+
+    def __repr__(self):
+        return f"uint{self.bits}"
+
+
+class BooleanType(SszType):
+    def is_fixed_size(self):
+        return True
+
+    def fixed_size(self):
+        return 1
+
+    def serialize(self, value) -> bytes:
+        return b"\x01" if value else b"\x00"
+
+    def deserialize(self, data: bytes) -> bool:
+        if data == b"\x00":
+            return False
+        if data == b"\x01":
+            return True
+        raise SszError(f"invalid boolean byte {data!r}")
+
+    def hash_tree_root(self, value) -> bytes:
+        return self.serialize(value).ljust(32, b"\x00")
+
+    def default(self) -> bool:
+        return False
+
+    def __repr__(self):
+        return "boolean"
+
+
+uint8 = UIntType(8)
+uint16 = UIntType(16)
+uint32 = UIntType(32)
+uint64 = UIntType(64)
+uint128 = UIntType(128)
+uint256 = UIntType(256)
+boolean = BooleanType()
+
+
+# --------------------------------------------------------------------------
+# Byte vectors / byte lists (bytes-valued fast paths)
+# --------------------------------------------------------------------------
+
+class ByteVectorType(SszType):
+    def __init__(self, length: int):
+        self.length = length
+
+    def is_fixed_size(self):
+        return True
+
+    def fixed_size(self):
+        return self.length
+
+    def serialize(self, value: bytes) -> bytes:
+        value = bytes(value)
+        if len(value) != self.length:
+            raise SszError(f"ByteVector[{self.length}]: got {len(value)}")
+        return value
+
+    def deserialize(self, data: bytes) -> bytes:
+        if len(data) != self.length:
+            raise SszError(f"ByteVector[{self.length}]: got {len(data)}")
+        return bytes(data)
+
+    def hash_tree_root(self, value) -> bytes:
+        return merkleize(pack_bytes(self.serialize(value)),
+                         (self.length + 31) // 32)
+
+    def default(self) -> bytes:
+        return b"\x00" * self.length
+
+    def __repr__(self):
+        return f"ByteVector[{self.length}]"
+
+
+class ByteListType(SszType):
+    def __init__(self, limit: int):
+        self.limit = limit
+
+    def is_fixed_size(self):
+        return False
+
+    def serialize(self, value: bytes) -> bytes:
+        value = bytes(value)
+        if len(value) > self.limit:
+            raise SszError(f"ByteList[{self.limit}]: got {len(value)}")
+        return value
+
+    def deserialize(self, data: bytes) -> bytes:
+        if len(data) > self.limit:
+            raise SszError(f"ByteList[{self.limit}]: got {len(data)}")
+        return bytes(data)
+
+    def hash_tree_root(self, value) -> bytes:
+        value = self.serialize(value)
+        root = merkleize(pack_bytes(value), (self.limit + 31) // 32)
+        return mix_in_length(root, len(value))
+
+    def default(self) -> bytes:
+        return b""
+
+    def __repr__(self):
+        return f"ByteList[{self.limit}]"
+
+
+Bytes4 = ByteVectorType(4)
+Bytes20 = ByteVectorType(20)
+Bytes32 = ByteVectorType(32)
+Bytes48 = ByteVectorType(48)
+Bytes96 = ByteVectorType(96)
+
+
+# --------------------------------------------------------------------------
+# Homogeneous collections
+# --------------------------------------------------------------------------
+
+def _is_basic(t: SszType) -> bool:
+    return isinstance(t, (UIntType, BooleanType))
+
+
+def _pack_basic(elem: SszType, values: Sequence) -> List[bytes]:
+    return pack_bytes(b"".join(elem.serialize(v) for v in values))
+
+
+class VectorType(SszType):
+    def __init__(self, elem: SszType, length: int):
+        assert length > 0
+        self.elem = elem
+        self.length = length
+
+    def is_fixed_size(self):
+        return self.elem.is_fixed_size()
+
+    def fixed_size(self):
+        return self.elem.fixed_size() * self.length
+
+    def serialize(self, value) -> bytes:
+        value = tuple(value)
+        if len(value) != self.length:
+            raise SszError(f"Vector[{self.length}]: got {len(value)}")
+        if self.elem.is_fixed_size():
+            return b"".join(self.elem.serialize(v) for v in value)
+        parts = [self.elem.serialize(v) for v in value]
+        return _serialize_offsets(parts)
+
+    def deserialize(self, data: bytes):
+        if self.elem.is_fixed_size():
+            es = self.elem.fixed_size()
+            if len(data) != es * self.length:
+                raise SszError("vector size mismatch")
+            return tuple(self.elem.deserialize(data[i * es:(i + 1) * es])
+                         for i in range(self.length))
+        parts = _deserialize_offsets(data)
+        if len(parts) != self.length:
+            raise SszError("vector element count mismatch")
+        return tuple(self.elem.deserialize(p) for p in parts)
+
+    def hash_tree_root(self, value) -> bytes:
+        value = tuple(value)
+        if len(value) != self.length:
+            raise SszError(f"Vector[{self.length}]: got {len(value)}")
+        if _is_basic(self.elem):
+            chunks = _pack_basic(self.elem, value)
+            limit = (self.length * self.elem.fixed_size() + 31) // 32
+            return merkleize(chunks, limit)
+        return merkleize([self.elem.hash_tree_root(v) for v in value],
+                         self.length)
+
+    def default(self):
+        return tuple(self.elem.default() for _ in range(self.length))
+
+    def __repr__(self):
+        return f"Vector[{self.elem!r}, {self.length}]"
+
+
+class ListType(SszType):
+    def __init__(self, elem: SszType, limit: int):
+        self.elem = elem
+        self.limit = limit
+
+    def is_fixed_size(self):
+        return False
+
+    def serialize(self, value) -> bytes:
+        value = tuple(value)
+        if len(value) > self.limit:
+            raise SszError(f"List[{self.limit}]: got {len(value)}")
+        if self.elem.is_fixed_size():
+            return b"".join(self.elem.serialize(v) for v in value)
+        return _serialize_offsets([self.elem.serialize(v) for v in value])
+
+    def deserialize(self, data: bytes):
+        if self.elem.is_fixed_size():
+            es = self.elem.fixed_size()
+            if len(data) % es:
+                raise SszError("list size not a multiple of element size")
+            n = len(data) // es
+            if n > self.limit:
+                raise SszError("list over limit")
+            return tuple(self.elem.deserialize(data[i * es:(i + 1) * es])
+                         for i in range(n))
+        if not data:
+            return ()
+        parts = _deserialize_offsets(data)
+        if len(parts) > self.limit:
+            raise SszError("list over limit")
+        return tuple(self.elem.deserialize(p) for p in parts)
+
+    def hash_tree_root(self, value) -> bytes:
+        value = tuple(value)
+        if len(value) > self.limit:
+            raise SszError(f"List[{self.limit}]: got {len(value)}")
+        if _is_basic(self.elem):
+            chunks = _pack_basic(self.elem, value)
+            limit = (self.limit * self.elem.fixed_size() + 31) // 32
+            root = merkleize(chunks, limit)
+        else:
+            root = merkleize([self.elem.hash_tree_root(v) for v in value],
+                             self.limit)
+        return mix_in_length(root, len(value))
+
+    def default(self):
+        return ()
+
+    def __repr__(self):
+        return f"List[{self.elem!r}, {self.limit}]"
+
+
+class BitvectorType(SszType):
+    def __init__(self, length: int):
+        assert length > 0
+        self.length = length
+
+    def is_fixed_size(self):
+        return True
+
+    def fixed_size(self):
+        return (self.length + 7) // 8
+
+    def serialize(self, value) -> bytes:
+        bits = tuple(bool(b) for b in value)
+        if len(bits) != self.length:
+            raise SszError(f"Bitvector[{self.length}]: got {len(bits)}")
+        out = bytearray((self.length + 7) // 8)
+        for i, b in enumerate(bits):
+            if b:
+                out[i // 8] |= 1 << (i % 8)
+        return bytes(out)
+
+    def deserialize(self, data: bytes):
+        if len(data) != self.fixed_size():
+            raise SszError("bitvector size mismatch")
+        if self.length % 8:
+            if data[-1] >> (self.length % 8):
+                raise SszError("bitvector padding bits set")
+        return tuple(bool(data[i // 8] >> (i % 8) & 1)
+                     for i in range(self.length))
+
+    def hash_tree_root(self, value) -> bytes:
+        return merkleize(pack_bytes(self.serialize(value)),
+                         (self.length + 255) // 256)
+
+    def default(self):
+        return tuple(False for _ in range(self.length))
+
+    def __repr__(self):
+        return f"Bitvector[{self.length}]"
+
+
+class BitlistType(SszType):
+    def __init__(self, limit: int):
+        self.limit = limit
+
+    def is_fixed_size(self):
+        return False
+
+    def serialize(self, value) -> bytes:
+        bits = tuple(bool(b) for b in value)
+        if len(bits) > self.limit:
+            raise SszError(f"Bitlist[{self.limit}]: got {len(bits)}")
+        n = len(bits)
+        out = bytearray(n // 8 + 1)
+        for i, b in enumerate(bits):
+            if b:
+                out[i // 8] |= 1 << (i % 8)
+        out[n // 8] |= 1 << (n % 8)          # delimiter bit
+        return bytes(out)
+
+    def deserialize(self, data: bytes):
+        if not data:
+            raise SszError("empty bitlist encoding")
+        if data[-1] == 0:
+            raise SszError("bitlist missing delimiter bit")
+        top = data[-1].bit_length() - 1
+        n = (len(data) - 1) * 8 + top
+        if n > self.limit:
+            raise SszError("bitlist over limit")
+        return tuple(bool(data[i // 8] >> (i % 8) & 1) for i in range(n))
+
+    def hash_tree_root(self, value) -> bytes:
+        bits = tuple(bool(b) for b in value)
+        if len(bits) > self.limit:
+            raise SszError(f"Bitlist[{self.limit}]: got {len(bits)}")
+        n = len(bits)
+        out = bytearray((n + 7) // 8)
+        for i, b in enumerate(bits):
+            if b:
+                out[i // 8] |= 1 << (i % 8)
+        root = merkleize(pack_bytes(bytes(out)), (self.limit + 255) // 256)
+        return mix_in_length(root, n)
+
+    def default(self):
+        return ()
+
+    def __repr__(self):
+        return f"Bitlist[{self.limit}]"
+
+
+class UnionType(SszType):
+    """SSZ Union[...]; values are (selector, value) pairs."""
+
+    def __init__(self, options: Sequence[Optional[SszType]]):
+        assert 1 <= len(options) <= 128
+        if options[0] is None:
+            assert len(options) > 1
+        self.options = tuple(options)
+
+    def is_fixed_size(self):
+        return False
+
+    def serialize(self, value) -> bytes:
+        sel, v = value
+        opt = self.options[sel]
+        if opt is None:
+            if v is not None:
+                raise SszError("None option carries no value")
+            return bytes([sel])
+        return bytes([sel]) + opt.serialize(v)
+
+    def deserialize(self, data: bytes):
+        if not data:
+            raise SszError("empty union")
+        sel = data[0]
+        if sel >= len(self.options):
+            raise SszError("union selector out of range")
+        opt = self.options[sel]
+        if opt is None:
+            if len(data) != 1:
+                raise SszError("trailing bytes after None option")
+            return (0, None)
+        return (sel, opt.deserialize(data[1:]))
+
+    def hash_tree_root(self, value) -> bytes:
+        sel, v = value
+        opt = self.options[sel]
+        root = ZERO_CHUNK if opt is None else opt.hash_tree_root(v)
+        return mix_in_selector(root, sel)
+
+    def default(self):
+        opt = self.options[0]
+        return (0, None if opt is None else opt.default())
+
+
+# --------------------------------------------------------------------------
+# Offset machinery (variable-size element framing)
+# --------------------------------------------------------------------------
+
+def _serialize_offsets(parts: List[bytes]) -> bytes:
+    head = len(parts) * OFFSET_SIZE
+    offsets = []
+    pos = head
+    for p in parts:
+        offsets.append(pos.to_bytes(OFFSET_SIZE, "little"))
+        pos += len(p)
+    return b"".join(offsets) + b"".join(parts)
+
+
+def _deserialize_offsets(data: bytes) -> List[bytes]:
+    if len(data) < OFFSET_SIZE:
+        raise SszError("truncated offset table")
+    first = int.from_bytes(data[:OFFSET_SIZE], "little")
+    if first % OFFSET_SIZE or first == 0:
+        raise SszError("misaligned first offset")
+    n = first // OFFSET_SIZE
+    if first > len(data):
+        raise SszError("first offset beyond input")
+    offsets = [int.from_bytes(data[i * OFFSET_SIZE:(i + 1) * OFFSET_SIZE],
+                              "little") for i in range(n)]
+    offsets.append(len(data))
+    parts = []
+    for i in range(n):
+        if offsets[i] > offsets[i + 1]:
+            raise SszError("offsets not monotonic")
+        parts.append(data[offsets[i]:offsets[i + 1]])
+    return parts
+
+
+# --------------------------------------------------------------------------
+# Containers
+# --------------------------------------------------------------------------
+
+class _ContainerMeta(type):
+    def __new__(mcs, name, bases, ns):
+        cls = super().__new__(mcs, name, bases, ns)
+        fields: Dict[str, SszType] = {}
+        for base in reversed(cls.__mro__[1:]):
+            fields.update(getattr(base, "_ssz_fields", {}))
+        for fname, schema in ns.get("__annotations__", {}).items():
+            if isinstance(schema, SszType) or (
+                    isinstance(schema, type)
+                    and issubclass(schema, Container)):
+                fields[fname] = schema
+        cls._ssz_fields = fields
+        return cls
+
+
+class Container(SszType, metaclass=_ContainerMeta):
+    """Declarative SSZ container; the class doubles as its own schema.
+
+    Instances are immutable value objects; `copy_with(**changes)` shares
+    unchanged children, and hash_tree_root is memoized per instance so
+    state copies re-hash only changed subtrees (the reference caches
+    branch hashes in its backing tree for the same reason).
+    """
+
+    _ssz_fields: Dict[str, SszType] = {}
+    __hash_cache: Optional[bytes]
+
+    def __init__(self, **kwargs):
+        cls = type(self)
+        for fname, schema in cls._ssz_fields.items():
+            if fname in kwargs:
+                v = kwargs.pop(fname)
+            else:
+                v = _schema(schema).default()
+            object.__setattr__(self, fname, v)
+        if kwargs:
+            raise TypeError(f"unknown fields {sorted(kwargs)} for {cls.__name__}")
+        object.__setattr__(self, "_Container__hash_cache", None)
+
+    def __setattr__(self, key, value):
+        raise AttributeError(
+            f"{type(self).__name__} is immutable; use copy_with()")
+
+    def copy_with(self, **changes):
+        cls = type(self)
+        vals = {f: getattr(self, f) for f in cls._ssz_fields}
+        for k, v in changes.items():
+            if k not in vals:
+                raise TypeError(f"unknown field {k} for {cls.__name__}")
+            vals[k] = v
+        return cls(**vals)
+
+    def __eq__(self, other):
+        if type(self) is not type(other):
+            return NotImplemented
+        return all(getattr(self, f) == getattr(other, f)
+                   for f in self._ssz_fields)
+
+    def __hash__(self):
+        return hash(self.hash_tree_root())
+
+    def __repr__(self):
+        inner = ", ".join(f"{f}={getattr(self, f)!r}"
+                          for f in self._ssz_fields)
+        return f"{type(self).__name__}({inner})"
+
+    # ---- schema API (classmethods so the class IS the schema) ----
+    @classmethod
+    def is_fixed_size(cls) -> bool:
+        return all(_schema(s).is_fixed_size()
+                   for s in cls._ssz_fields.values())
+
+    @classmethod
+    def fixed_size(cls) -> int:
+        assert cls.is_fixed_size()
+        return sum(_schema(s).fixed_size() for s in cls._ssz_fields.values())
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    @classmethod
+    def serialize(cls, value: "Container") -> bytes:
+        fixed_parts: List[Optional[bytes]] = []
+        var_parts: List[bytes] = []
+        for fname, schema in cls._ssz_fields.items():
+            s = _schema(schema)
+            v = getattr(value, fname)
+            if s.is_fixed_size():
+                fixed_parts.append(s.serialize(v))
+            else:
+                fixed_parts.append(None)
+                var_parts.append(s.serialize(v))
+        head_len = sum(OFFSET_SIZE if p is None else len(p)
+                       for p in fixed_parts)
+        out = []
+        pos = head_len
+        vi = 0
+        for p in fixed_parts:
+            if p is None:
+                out.append(pos.to_bytes(OFFSET_SIZE, "little"))
+                pos += len(var_parts[vi])
+                vi += 1
+            else:
+                out.append(p)
+        return b"".join(out) + b"".join(var_parts)
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "Container":
+        schemas = [(f, _schema(s)) for f, s in cls._ssz_fields.items()]
+        pos = 0
+        offsets: List[Tuple[str, SszType, int]] = []
+        values: Dict[str, Any] = {}
+        order: List[str] = []
+        for fname, s in schemas:
+            order.append(fname)
+            if s.is_fixed_size():
+                size = s.fixed_size()
+                if pos + size > len(data):
+                    raise SszError("truncated fixed part")
+                values[fname] = s.deserialize(data[pos:pos + size])
+                pos += size
+            else:
+                if pos + OFFSET_SIZE > len(data):
+                    raise SszError("truncated offset")
+                off = int.from_bytes(data[pos:pos + OFFSET_SIZE], "little")
+                offsets.append((fname, s, off))
+                pos += OFFSET_SIZE
+        if offsets:
+            if offsets[0][2] != pos:
+                raise SszError("first offset does not follow fixed part")
+            bounds = [off for (_, _, off) in offsets] + [len(data)]
+            for i, (fname, s, off) in enumerate(offsets):
+                end = bounds[i + 1]
+                if off > end or end > len(data):
+                    raise SszError("offsets not monotonic")
+                values[fname] = s.deserialize(data[off:end])
+        elif pos != len(data):
+            raise SszError("trailing bytes after fixed container")
+        return cls(**values)
+
+    @classmethod
+    def hash_tree_root(cls, value: "Container" = None) -> bytes:
+        # usable both as schema.hash_tree_root(value) and value.hash_tree_root()
+        if value is None:
+            raise TypeError("hash_tree_root needs a value")
+        cached = value.__dict__.get("_Container__hash_cache")
+        if cached is not None:
+            return cached
+        leaves = [
+            _schema(s).hash_tree_root(getattr(value, f))
+            for f, s in cls._ssz_fields.items()
+        ]
+        root = merkleize(leaves, len(leaves))
+        object.__setattr__(value, "_Container__hash_cache", root)
+        return root
+
+    # instance-call sugar
+    def ssz_serialize(self) -> bytes:
+        return type(self).serialize(self)
+
+    @classmethod
+    def ssz_deserialize(cls, data: bytes) -> "Container":
+        return cls.deserialize(data)
+
+    def htr(self) -> bytes:
+        return type(self).hash_tree_root(self)
+
+
+def _schema(s) -> SszType:
+    """Accept both SszType instances and Container classes as schemas."""
+    if isinstance(s, type) and issubclass(s, Container):
+        return _ContainerSchemaAdapter(s)
+    return s
+
+
+class _ContainerSchemaAdapter(SszType):
+    """Adapter so a Container CLASS can sit in schema positions."""
+
+    def __init__(self, cls: Type[Container]):
+        self.cls = cls
+
+    def is_fixed_size(self):
+        return self.cls.is_fixed_size()
+
+    def fixed_size(self):
+        return self.cls.fixed_size()
+
+    def serialize(self, value):
+        return self.cls.serialize(value)
+
+    def deserialize(self, data):
+        return self.cls.deserialize(data)
+
+    def hash_tree_root(self, value):
+        return self.cls.hash_tree_root(value)
+
+    def default(self):
+        return self.cls()
+
+    def __repr__(self):
+        return self.cls.__name__
+
+
+def Vector(elem, length: int) -> VectorType:
+    return VectorType(_schema(elem), length)
+
+
+def List(elem, limit: int) -> ListType:  # noqa: A001 - SSZ naming
+    return ListType(_schema(elem), limit)
+
+
+def Bitvector(length: int) -> BitvectorType:
+    return BitvectorType(length)
+
+
+def Bitlist(limit: int) -> BitlistType:
+    return BitlistType(limit)
+
+
+def ByteVector(length: int) -> ByteVectorType:
+    return ByteVectorType(length)
+
+
+def ByteList(limit: int) -> ByteListType:
+    return ByteListType(limit)
+
+
+def Union(*options) -> UnionType:
+    return UnionType([None if o is None else _schema(o) for o in options])

@@ -1,0 +1,214 @@
+"""SSZ engine: unit semantics + round-trip against REAL reference
+fixtures.
+
+The reference repo ships raw SSZ-encoded minimal-preset phase0 blocks
+and attestations with YAML value companions
+(/root/reference/fork-choice-tests/src/integration-test/resources/cache/).
+Serializing the YAML values must reproduce the SSZ bytes exactly, and
+each block's parent_root must equal the hash-tree-root of the previous
+block's header — an end-to-end external check of both serialization and
+merkleization.
+"""
+
+import os
+from pathlib import Path
+
+import pytest
+import yaml
+
+from teku_tpu.spec.datastructures import SCHEMAS_MINIMAL as S
+from teku_tpu.ssz import (Bitlist, Bitvector, boolean, Bytes32, Container,
+                          List, merkleize, mix_in_length, SszError, uint8,
+                          uint16, uint64, Union, Vector, zero_hash)
+
+CACHE = Path("/root/reference/fork-choice-tests/src/integration-test/"
+             "resources/cache")
+
+
+# --------------------------------------------------------------------------
+# Unit semantics
+# --------------------------------------------------------------------------
+
+def test_uint_roundtrip_and_bounds():
+    assert uint64.serialize(1) == b"\x01" + b"\x00" * 7
+    assert uint64.deserialize(b"\xff" * 8) == 2 ** 64 - 1
+    with pytest.raises(SszError):
+        uint8.serialize(256)
+    with pytest.raises(SszError):
+        uint16.deserialize(b"\x00")  # wrong width
+
+
+def test_uint_htr_is_padded_le():
+    assert uint64.hash_tree_root(5) == (5).to_bytes(8, "little") + b"\x00" * 24
+
+
+def test_boolean_strictness():
+    with pytest.raises(SszError):
+        boolean.deserialize(b"\x02")
+
+
+def test_vector_of_uint64_htr_packs():
+    v = Vector(uint64, 4)
+    ser = v.serialize((1, 2, 3, 4))
+    assert len(ser) == 32
+    assert v.hash_tree_root((1, 2, 3, 4)) == ser  # single chunk, no hash
+
+
+def test_list_htr_mixes_length():
+    l4 = List(uint64, 4)
+    root = merkleize([b"".join(
+        u.to_bytes(8, "little") for u in (1, 2, 3, 4))], 1)
+    assert l4.hash_tree_root((1, 2, 3, 4)) == mix_in_length(root, 4)
+    assert l4.hash_tree_root(()) == mix_in_length(zero_hash(0), 0)
+
+
+def test_bitlist_delimiter():
+    b = Bitlist(8)
+    assert b.serialize(()) == b"\x01"
+    assert b.serialize((True,) * 3) == b"\x0f"
+    assert b.deserialize(b"\x0f") == (True,) * 3
+    with pytest.raises(SszError):
+        b.deserialize(b"\x00")      # missing delimiter
+    with pytest.raises(SszError):
+        Bitlist(2).deserialize(b"\x0f")  # over limit
+
+
+def test_bitvector_padding_bits_rejected():
+    with pytest.raises(SszError):
+        Bitvector(3).deserialize(b"\x0f")
+
+
+def test_union_roundtrip():
+    u = Union(None, uint64)
+    assert u.deserialize(u.serialize((1, 7))) == (1, 7)
+    assert u.serialize((0, None)) == b"\x00"
+
+
+def test_container_offsets_strict():
+    class VarC(Container):
+        a: uint64
+        b: List(uint64, 8)
+        c: uint64
+
+    v = VarC(a=1, b=(9, 10), c=2)
+    data = VarC.serialize(v)
+    assert VarC.deserialize(data) == v
+    # corrupt the offset: must be rejected, not mis-parsed
+    bad = bytearray(data)
+    bad[8] = 0xFF
+    with pytest.raises(SszError):
+        VarC.deserialize(bytes(bad))
+
+
+def test_container_immutability_and_copy():
+    cp = S.Checkpoint(epoch=3, root=b"\x11" * 32)
+    with pytest.raises(AttributeError):
+        cp.epoch = 4
+    cp2 = cp.copy_with(epoch=4)
+    assert cp.epoch == 3 and cp2.epoch == 4 and cp2.root == cp.root
+
+
+def test_htr_memoized_per_instance():
+    cp = S.Checkpoint(epoch=3, root=b"\x11" * 32)
+    r1 = cp.htr()
+    assert cp.htr() is r1  # cached object, not recomputed
+
+
+# --------------------------------------------------------------------------
+# Reference fixtures (real serialized minimal-preset phase0 objects)
+# --------------------------------------------------------------------------
+
+def _h(s: str) -> bytes:
+    return bytes.fromhex(s[2:] if s.startswith("0x") else s)
+
+
+def _attestation_from_yaml(d) -> "Container":
+    def chk(c):
+        return S.Checkpoint(epoch=c["epoch"], root=_h(c["root"]))
+    bits_bytes = _h(d["aggregation_bits"])
+    bits = S.Attestation._ssz_fields["aggregation_bits"].deserialize(
+        bits_bytes)
+    return S.Attestation(
+        aggregation_bits=bits,
+        data=S.AttestationData(
+            slot=d["data"]["slot"], index=d["data"]["index"],
+            beacon_block_root=_h(d["data"]["beacon_block_root"]),
+            source=chk(d["data"]["source"]),
+            target=chk(d["data"]["target"])),
+        signature=_h(d["signature"]))
+
+
+def _block_from_yaml(d) -> "Container":
+    m = d["message"]
+    b = m["body"]
+    body = S.BeaconBlockBody(
+        randao_reveal=_h(b["randao_reveal"]),
+        eth1_data=S.Eth1Data(
+            deposit_root=_h(b["eth1_data"]["deposit_root"]),
+            deposit_count=b["eth1_data"]["deposit_count"],
+            block_hash=_h(b["eth1_data"]["block_hash"])),
+        graffiti=_h(b["graffiti"]),
+        proposer_slashings=(),
+        attester_slashings=(),
+        attestations=tuple(_attestation_from_yaml(a)
+                           for a in b["attestations"]),
+        deposits=(),
+        voluntary_exits=())
+    assert not b["proposer_slashings"] and not b["deposits"]
+    block = S.BeaconBlock(
+        slot=m["slot"], proposer_index=m["proposer_index"],
+        parent_root=_h(m["parent_root"]), state_root=_h(m["state_root"]),
+        body=body)
+    return S.SignedBeaconBlock(message=block, signature=_h(d["signature"]))
+
+
+needs_fixtures = pytest.mark.skipif(
+    not CACHE.is_dir(), reason="reference fixtures not present")
+
+
+@needs_fixtures
+def test_attestation_fixtures_roundtrip():
+    n = 0
+    for ssz_path in sorted(CACHE.glob("attestation_*.ssz")):
+        data = ssz_path.read_bytes()
+        with open(ssz_path.with_suffix(".yaml")) as f:
+            val = _attestation_from_yaml(yaml.safe_load(f))
+        assert S.Attestation.serialize(val) == data, ssz_path.name
+        assert S.Attestation.deserialize(data) == val
+        n += 1
+    assert n >= 10
+
+
+@needs_fixtures
+def test_block_fixtures_roundtrip():
+    n = 0
+    for ssz_path in sorted(CACHE.glob("block_*.ssz")):
+        data = ssz_path.read_bytes()
+        with open(ssz_path.with_suffix(".yaml")) as f:
+            val = _block_from_yaml(yaml.safe_load(f))
+        assert S.SignedBeaconBlock.serialize(val) == data, ssz_path.name
+        assert S.SignedBeaconBlock.deserialize(data) == val
+        n += 1
+    assert n >= 10
+
+
+@needs_fixtures
+def test_block_parent_roots_match_header_htr():
+    """block[i].parent_root must equal HTR of block[j]'s header for some
+    ancestor j — an external validation of hash_tree_root."""
+    blocks = {}
+    for ssz_path in CACHE.glob("block_*.ssz"):
+        blk = S.SignedBeaconBlock.deserialize(ssz_path.read_bytes()).message
+        header = S.BeaconBlockHeader(
+            slot=blk.slot, proposer_index=blk.proposer_index,
+            parent_root=blk.parent_root, state_root=blk.state_root,
+            body_root=blk.body.htr())
+        blocks[header.htr()] = blk
+    linked = sum(1 for blk in blocks.values()
+                 if blk.parent_root in blocks)
+    # the cache holds several fork branches and not every parent, but a
+    # large majority of parent_roots must resolve to a computed header
+    # HTR — each link is an exact 32-byte match, so even one link is
+    # strong evidence and dozens are conclusive
+    assert linked >= len(blocks) * 2 // 3
+    assert len(blocks) >= 10
