@@ -1,0 +1,48 @@
+"""Consensus spec engine: config, datastructures, helpers, transition.
+
+The TPU build's equivalent of the reference's ethereum/spec module
+(reference: ethereum/spec/src/main/java/tech/pegasys/teku/spec/
+Spec.java:108 facade).  `Spec` bundles a SpecConfig with its schema
+family and the transition entry points — the one object the node wires
+everywhere.
+"""
+
+from .config import get_config, MAINNET, MINIMAL, SpecConfig
+from .datastructures import get_schemas, Schemas
+
+
+class Spec:
+    """Config + schemas + transition functions in one handle."""
+
+    def __init__(self, cfg: SpecConfig):
+        self.config = cfg
+        self.schemas = get_schemas(cfg)
+
+    # -- delegation to the functional engine --
+    def state_transition(self, state, signed_block, validate_result=True):
+        from .transition import state_transition
+        return state_transition(self.config, state, signed_block,
+                                validate_result)
+
+    def process_slots(self, state, slot):
+        from .transition import process_slots
+        return process_slots(self.config, state, slot)
+
+    def interop_genesis(self, n_validators, genesis_time=1578009600):
+        from .genesis import interop_genesis
+        return interop_genesis(self.config, n_validators, genesis_time)
+
+    def get_beacon_committee(self, state, slot, index):
+        from . import helpers as H
+        return H.get_beacon_committee(self.config, state, slot, index)
+
+    def get_beacon_proposer_index(self, state):
+        from . import helpers as H
+        return H.get_beacon_proposer_index(self.config, state)
+
+    def compute_epoch_at_slot(self, slot):
+        return slot // self.config.SLOTS_PER_EPOCH
+
+
+def create_spec(network: str = "minimal") -> Spec:
+    return Spec(get_config(network))

@@ -1,0 +1,299 @@
+"""Phase0 block processing over immutable SSZ states.
+
+Equivalent of the reference's AbstractBlockProcessor (reference:
+ethereum/spec/src/main/java/tech/pegasys/teku/spec/logic/common/block/
+AbstractBlockProcessor.java:84-890): process_block_header → randao →
+eth1 data → operations, with every signature routed through the
+SignatureVerifier seam so block import can collect-then-batch.  Deposit
+signatures are the one deliberate exception: they verify EAGERLY with
+their own verifier because an invalid deposit signature means "skip the
+deposit", not "invalid block" (AbstractBlockProcessor.java:84-93).
+"""
+
+from typing import Optional
+
+from .config import (DOMAIN_BEACON_ATTESTER, DOMAIN_BEACON_PROPOSER,
+                     DOMAIN_DEPOSIT, DOMAIN_RANDAO, DOMAIN_VOLUNTARY_EXIT,
+                     FAR_FUTURE_EPOCH, SpecConfig)
+from .datastructures import DepositMessage, get_schemas
+from . import helpers as H
+from .verifiers import SIMPLE, SignatureVerifier
+
+
+class BlockProcessingError(Exception):
+    """Invalid block content (the reference's BlockProcessingException)."""
+
+
+def _require(cond: bool, what: str):
+    if not cond:
+        raise BlockProcessingError(what)
+
+
+# --------------------------------------------------------------------------
+# Signature checks (all via the seam)
+# --------------------------------------------------------------------------
+
+def verify_block_signature(cfg: SpecConfig, state, signed_block,
+                           verifier: SignatureVerifier) -> bool:
+    proposer = state.validators[signed_block.message.proposer_index]
+    domain = H.get_domain(cfg, state, DOMAIN_BEACON_PROPOSER)
+    root = H.compute_signing_root(signed_block.message, domain)
+    return verifier.verify([proposer.pubkey], root, signed_block.signature)
+
+
+def verify_randao_reveal(cfg: SpecConfig, state, body,
+                         verifier: SignatureVerifier) -> bool:
+    epoch = H.get_current_epoch(cfg, state)
+    proposer = state.validators[H.get_beacon_proposer_index(cfg, state)]
+    domain = H.get_domain(cfg, state, DOMAIN_RANDAO)
+    root = H.compute_signing_root(
+        epoch.to_bytes(8, "little").ljust(32, b"\x00"), domain)
+    # signing root of uint64 epoch: HTR of uint64 is its LE bytes padded
+    return verifier.verify([proposer.pubkey], root, body.randao_reveal)
+
+
+def is_valid_indexed_attestation(cfg: SpecConfig, state, indexed,
+                                 verifier: SignatureVerifier) -> bool:
+    """Spec is_valid_indexed_attestation via the seam (reference:
+    AttestationUtil.java:162-291)."""
+    indices = list(indexed.attesting_indices)
+    if not indices or indices != sorted(set(indices)):
+        return False
+    if any(i >= len(state.validators) for i in indices):
+        return False
+    pubkeys = [state.validators[i].pubkey for i in indices]
+    domain = H.get_domain(cfg, state, DOMAIN_BEACON_ATTESTER,
+                          indexed.data.target.epoch)
+    root = H.compute_signing_root(indexed.data, domain)
+    return verifier.verify(pubkeys, root, indexed.signature)
+
+
+# --------------------------------------------------------------------------
+# Per-operation processing
+# --------------------------------------------------------------------------
+
+def process_block_header(cfg: SpecConfig, state, block):
+    _require(block.slot == state.slot, "block slot mismatch")
+    _require(block.slot > state.latest_block_header.slot,
+             "block older than latest header")
+    _require(block.proposer_index == H.get_beacon_proposer_index(cfg, state),
+             "wrong proposer")
+    _require(block.parent_root == state.latest_block_header.htr(),
+             "parent root mismatch")
+    proposer = state.validators[block.proposer_index]
+    _require(not proposer.slashed, "proposer slashed")
+    from .datastructures import BeaconBlockHeader
+    header = BeaconBlockHeader(
+        slot=block.slot, proposer_index=block.proposer_index,
+        parent_root=block.parent_root, state_root=bytes(32),
+        body_root=block.body.htr())
+    return state.copy_with(latest_block_header=header)
+
+
+def process_randao(cfg: SpecConfig, state, body,
+                   verifier: SignatureVerifier):
+    _require(verify_randao_reveal(cfg, state, body, verifier),
+             "bad randao reveal")
+    epoch = H.get_current_epoch(cfg, state)
+    mix = H.xor32(H.get_randao_mix(cfg, state, epoch),
+                  H.hash32(body.randao_reveal))
+    mixes = list(state.randao_mixes)
+    mixes[epoch % cfg.EPOCHS_PER_HISTORICAL_VECTOR] = mix
+    return state.copy_with(randao_mixes=tuple(mixes))
+
+
+def process_eth1_data(cfg: SpecConfig, state, body):
+    votes = list(state.eth1_data_votes) + [body.eth1_data]
+    state = state.copy_with(eth1_data_votes=tuple(votes))
+    period = cfg.EPOCHS_PER_ETH1_VOTING_PERIOD * cfg.SLOTS_PER_EPOCH
+    if votes.count(body.eth1_data) * 2 > period:
+        state = state.copy_with(eth1_data=body.eth1_data)
+    return state
+
+
+def process_proposer_slashing(cfg: SpecConfig, state, slashing,
+                              verifier: SignatureVerifier):
+    h1 = slashing.signed_header_1.message
+    h2 = slashing.signed_header_2.message
+    _require(h1.slot == h2.slot, "slashing: slots differ")
+    _require(h1.proposer_index == h2.proposer_index,
+             "slashing: proposers differ")
+    _require(h1 != h2, "slashing: identical headers")
+    proposer = state.validators[h1.proposer_index]
+    _require(H.is_slashable_validator(
+        proposer, H.get_current_epoch(cfg, state)), "not slashable")
+    for signed in (slashing.signed_header_1, slashing.signed_header_2):
+        domain = H.get_domain(
+            cfg, state, DOMAIN_BEACON_PROPOSER,
+            H.compute_epoch_at_slot(cfg, signed.message.slot))
+        root = H.compute_signing_root(signed.message, domain)
+        _require(verifier.verify([proposer.pubkey], root, signed.signature),
+                 "slashing: bad header signature")
+    return H.slash_validator(cfg, state, h1.proposer_index)
+
+
+def process_attester_slashing(cfg: SpecConfig, state, slashing,
+                              verifier: SignatureVerifier):
+    a1, a2 = slashing.attestation_1, slashing.attestation_2
+    _require(H.is_slashable_attestation_data(a1.data, a2.data),
+             "attestations not slashable")
+    _require(is_valid_indexed_attestation(cfg, state, a1, verifier),
+             "attestation_1 invalid")
+    _require(is_valid_indexed_attestation(cfg, state, a2, verifier),
+             "attestation_2 invalid")
+    slashed_any = False
+    now = H.get_current_epoch(cfg, state)
+    common = sorted(set(a1.attesting_indices) & set(a2.attesting_indices))
+    for idx in common:
+        if H.is_slashable_validator(state.validators[idx], now):
+            state = H.slash_validator(cfg, state, idx)
+            slashed_any = True
+    _require(slashed_any, "nobody slashed")
+    return state
+
+
+def process_attestation(cfg: SpecConfig, state, attestation,
+                        verifier: SignatureVerifier):
+    data = attestation.data
+    _require(data.target.epoch in (H.get_previous_epoch(cfg, state),
+                                   H.get_current_epoch(cfg, state)),
+             "target epoch out of range")
+    _require(data.target.epoch == H.compute_epoch_at_slot(cfg, data.slot),
+             "target/slot mismatch")
+    _require(data.slot + cfg.MIN_ATTESTATION_INCLUSION_DELAY <= state.slot
+             <= data.slot + cfg.SLOTS_PER_EPOCH, "inclusion window")
+    _require(data.index < H.get_committee_count_per_slot(
+        cfg, state, data.target.epoch), "committee index out of range")
+    committee = H.get_beacon_committee(cfg, state, data.slot, data.index)
+    _require(len(attestation.aggregation_bits) == len(committee),
+             "bits/committee size mismatch")
+
+    S = get_schemas(cfg)
+    pending = S.PendingAttestation(
+        aggregation_bits=attestation.aggregation_bits, data=data,
+        inclusion_delay=state.slot - data.slot,
+        proposer_index=H.get_beacon_proposer_index(cfg, state))
+    if data.target.epoch == H.get_current_epoch(cfg, state):
+        _require(data.source == state.current_justified_checkpoint,
+                 "wrong source (current)")
+        state = state.copy_with(
+            current_epoch_attestations=(
+                tuple(state.current_epoch_attestations) + (pending,)))
+    else:
+        _require(data.source == state.previous_justified_checkpoint,
+                 "wrong source (previous)")
+        state = state.copy_with(
+            previous_epoch_attestations=(
+                tuple(state.previous_epoch_attestations) + (pending,)))
+    indexed = H.get_indexed_attestation(cfg, state, attestation)
+    _require(is_valid_indexed_attestation(cfg, state, indexed, verifier),
+             "bad attestation signature")
+    return state
+
+
+def get_validator_from_deposit(cfg: SpecConfig, pubkey: bytes,
+                               withdrawal_credentials: bytes, amount: int):
+    from .datastructures import Validator
+    effective = min(amount - amount % cfg.EFFECTIVE_BALANCE_INCREMENT,
+                    cfg.MAX_EFFECTIVE_BALANCE)
+    return Validator(
+        pubkey=pubkey, withdrawal_credentials=withdrawal_credentials,
+        effective_balance=effective, slashed=False,
+        activation_eligibility_epoch=FAR_FUTURE_EPOCH,
+        activation_epoch=FAR_FUTURE_EPOCH, exit_epoch=FAR_FUTURE_EPOCH,
+        withdrawable_epoch=FAR_FUTURE_EPOCH)
+
+
+def apply_deposit(cfg: SpecConfig, state, pubkey: bytes,
+                  withdrawal_credentials: bytes, amount: int,
+                  signature: bytes,
+                  deposit_verifier: SignatureVerifier = SIMPLE):
+    pubkeys = [v.pubkey for v in state.validators]
+    if pubkey not in pubkeys:
+        # EAGER proof-of-possession check — its own verifier, never the
+        # block batch (AbstractBlockProcessor.java:84-93): failure skips
+        # the deposit rather than invalidating the block.
+        msg = DepositMessage(pubkey=pubkey,
+                             withdrawal_credentials=withdrawal_credentials,
+                             amount=amount)
+        domain = H.compute_domain(
+            DOMAIN_DEPOSIT, cfg.GENESIS_FORK_VERSION, bytes(32))
+        root = H.compute_signing_root(msg, domain)
+        if not deposit_verifier.verify([pubkey], root, signature):
+            return state
+        state = state.copy_with(
+            validators=tuple(state.validators)
+            + (get_validator_from_deposit(
+                cfg, pubkey, withdrawal_credentials, amount),),
+            balances=tuple(state.balances) + (amount,))
+        return state
+    index = pubkeys.index(pubkey)
+    return H.increase_balance(state, index, amount)
+
+
+def process_deposit(cfg: SpecConfig, state, deposit,
+                    deposit_verifier: SignatureVerifier = SIMPLE):
+    _require(H.is_valid_merkle_branch(
+        deposit.data.htr(), deposit.proof,
+        cfg.DEPOSIT_CONTRACT_TREE_DEPTH + 1, state.eth1_deposit_index,
+        state.eth1_data.deposit_root), "bad deposit proof")
+    state = state.copy_with(eth1_deposit_index=state.eth1_deposit_index + 1)
+    return apply_deposit(
+        cfg, state, deposit.data.pubkey,
+        deposit.data.withdrawal_credentials, deposit.data.amount,
+        deposit.data.signature, deposit_verifier)
+
+
+def process_voluntary_exit(cfg: SpecConfig, state, signed_exit,
+                           verifier: SignatureVerifier):
+    exit_msg = signed_exit.message
+    _require(exit_msg.validator_index < len(state.validators),
+             "exit: unknown validator")
+    v = state.validators[exit_msg.validator_index]
+    now = H.get_current_epoch(cfg, state)
+    _require(H.is_active_validator(v, now), "exit: not active")
+    _require(v.exit_epoch == FAR_FUTURE_EPOCH, "exit: already exiting")
+    _require(now >= exit_msg.epoch, "exit: future epoch")
+    _require(now >= v.activation_epoch + cfg.SHARD_COMMITTEE_PERIOD,
+             "exit: too young")
+    domain = H.get_domain(cfg, state, DOMAIN_VOLUNTARY_EXIT, exit_msg.epoch)
+    root = H.compute_signing_root(exit_msg, domain)
+    _require(verifier.verify([v.pubkey], root, signed_exit.signature),
+             "exit: bad signature")
+    return H.initiate_validator_exit(cfg, state, exit_msg.validator_index)
+
+
+# --------------------------------------------------------------------------
+# process_block
+# --------------------------------------------------------------------------
+
+def process_operations(cfg: SpecConfig, state, body,
+                       verifier: SignatureVerifier,
+                       deposit_verifier: SignatureVerifier = SIMPLE):
+    expected_deposits = min(
+        cfg.MAX_DEPOSITS,
+        state.eth1_data.deposit_count - state.eth1_deposit_index)
+    _require(len(body.deposits) == expected_deposits,
+             "wrong deposit count")
+    for op in body.proposer_slashings:
+        state = process_proposer_slashing(cfg, state, op, verifier)
+    for op in body.attester_slashings:
+        state = process_attester_slashing(cfg, state, op, verifier)
+    for op in body.attestations:
+        state = process_attestation(cfg, state, op, verifier)
+    for op in body.deposits:
+        state = process_deposit(cfg, state, op, deposit_verifier)
+    for op in body.voluntary_exits:
+        state = process_voluntary_exit(cfg, state, op, verifier)
+    return state
+
+
+def process_block(cfg: SpecConfig, state, block,
+                  verifier: SignatureVerifier,
+                  deposit_verifier: SignatureVerifier = SIMPLE):
+    state = process_block_header(cfg, state, block)
+    state = process_randao(cfg, state, block.body, verifier)
+    state = process_eth1_data(cfg, state, block.body)
+    state = process_operations(cfg, state, block.body, verifier,
+                               deposit_verifier)
+    return state

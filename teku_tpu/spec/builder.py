@@ -1,0 +1,169 @@
+"""Block and attestation production over the spec engine.
+
+Equivalent of the reference's block-production utilities (reference:
+ethereum/spec/src/main/java/tech/pegasys/teku/spec/logic/common/util/
+BlockProposalUtil.java and beacon/validator/.../BlockFactoryPhase0) and
+the attestation-production side of AttestationUtil.java — used by the
+validator client's duties and by chain-scenario tests (the reference's
+ChainBuilder testFixture plays the same role).
+
+Signing goes through a `signer(validator_index, signing_root) -> bytes`
+callback so callers can plug local keys, slashing-protected signers, or
+remote signers.
+"""
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .config import (DOMAIN_AGGREGATE_AND_PROOF, DOMAIN_BEACON_ATTESTER,
+                     DOMAIN_BEACON_PROPOSER, DOMAIN_RANDAO,
+                     DOMAIN_SELECTION_PROOF, SpecConfig)
+from .datastructures import AttestationData, Checkpoint, get_schemas
+from . import helpers as H
+from .transition import process_slots
+from .verifiers import SIMPLE
+from ..crypto import bls
+
+Signer = Callable[[int, bytes], bytes]
+
+
+def make_local_signer(secret_keys: Dict[int, int]) -> Signer:
+    def signer(validator_index: int, signing_root: bytes) -> bytes:
+        return bls.sign(secret_keys[validator_index], signing_root)
+    return signer
+
+
+def get_randao_reveal(cfg: SpecConfig, state, epoch: int,
+                      proposer_index: int, signer: Signer) -> bytes:
+    domain = H.get_domain(cfg, state, DOMAIN_RANDAO, epoch)
+    root = H.compute_signing_root(
+        epoch.to_bytes(8, "little").ljust(32, b"\x00"), domain)
+    return signer(proposer_index, root)
+
+
+def attestation_data_for(cfg: SpecConfig, state, slot: int,
+                         index: int, head_root: bytes) -> AttestationData:
+    """AttestationData per the validator spec: head = current head,
+    target = epoch-boundary block."""
+    epoch = H.compute_epoch_at_slot(cfg, slot)
+    start_slot = H.compute_start_slot_at_epoch(cfg, epoch)
+    if start_slot == state.slot or start_slot >= state.slot:
+        target_root = head_root
+    else:
+        target_root = H.get_block_root_at_slot(cfg, state, start_slot)
+    return AttestationData(
+        slot=slot, index=index, beacon_block_root=head_root,
+        source=state.current_justified_checkpoint,
+        target=Checkpoint(epoch=epoch, root=target_root))
+
+
+def produce_attestations(cfg: SpecConfig, state, slot: int,
+                         head_root: bytes, signer: Signer,
+                         committee_indices: Optional[Sequence[int]] = None,
+                         ) -> List:
+    """One fully-aggregated attestation per committee at `slot` (every
+    member signs; bits all set) — the shape a perfect devnet produces."""
+    S = get_schemas(cfg)
+    epoch = H.compute_epoch_at_slot(cfg, slot)
+    out = []
+    n_committees = H.get_committee_count_per_slot(cfg, state, epoch)
+    targets = (range(n_committees) if committee_indices is None
+               else committee_indices)
+    for ci in targets:
+        committee = H.get_beacon_committee(cfg, state, slot, ci)
+        if not committee:
+            continue
+        data = attestation_data_for(cfg, state, slot, ci, head_root)
+        domain = H.get_domain(cfg, state, DOMAIN_BEACON_ATTESTER, epoch)
+        root = H.compute_signing_root(data, domain)
+        sigs = [signer(v, root) for v in committee]
+        out.append(S.Attestation(
+            aggregation_bits=tuple(True for _ in committee), data=data,
+            signature=bls.aggregate_signatures(sigs)))
+    return out
+
+
+def produce_block(cfg: SpecConfig, state, slot: int, signer: Signer,
+                  attestations: Sequence = (),
+                  deposits: Sequence = (),
+                  proposer_slashings: Sequence = (),
+                  attester_slashings: Sequence = (),
+                  voluntary_exits: Sequence = (),
+                  graffiti: bytes = bytes(32)):
+    """Produce and sign a block for `slot` on top of `state`.
+
+    Returns (signed_block, post_state).  The state root is computed by
+    running the real transition with signature validation disabled
+    (production trusts its own signatures), mirroring the reference's
+    unsigned-block + state-root flow (BlockProposalUtil.java
+    createNewUnsignedBlock)."""
+    from . import block as B
+    S = get_schemas(cfg)
+    pre = process_slots(cfg, state, slot) if state.slot < slot else state
+    proposer_index = H.get_beacon_proposer_index(cfg, pre)
+    epoch = H.compute_epoch_at_slot(cfg, slot)
+    body = S.BeaconBlockBody(
+        randao_reveal=get_randao_reveal(cfg, pre, epoch, proposer_index,
+                                        signer),
+        eth1_data=pre.eth1_data, graffiti=graffiti,
+        proposer_slashings=tuple(proposer_slashings),
+        attester_slashings=tuple(attester_slashings),
+        attestations=tuple(attestations), deposits=tuple(deposits),
+        voluntary_exits=tuple(voluntary_exits))
+    block = S.BeaconBlock(
+        slot=slot, proposer_index=proposer_index,
+        parent_root=_parent_root(pre), state_root=bytes(32), body=body)
+    post = B.process_block(cfg, pre, block, _TRUSTING, _TRUSTING)
+    block = block.copy_with(state_root=post.htr())
+    domain = H.get_domain(cfg, pre, DOMAIN_BEACON_PROPOSER, epoch)
+    root = H.compute_signing_root(block, domain)
+    signed = S.SignedBeaconBlock(message=block,
+                                 signature=signer(proposer_index, root))
+    return signed, post
+
+
+def _parent_root(pre) -> bytes:
+    """Root of the latest block header with its state_root filled in
+    (process_slot has already done that for any caught-up state)."""
+    hdr = pre.latest_block_header
+    if hdr.state_root == bytes(32):
+        hdr = hdr.copy_with(state_root=pre.htr())
+    return hdr.htr()
+
+
+class _Trusting:
+    def verify(self, public_keys, message, signature) -> bool:
+        return True
+
+
+_TRUSTING = _Trusting()
+
+
+def get_selection_proof(cfg: SpecConfig, state, slot: int,
+                        validator_index: int, signer: Signer) -> bytes:
+    domain = H.get_domain(cfg, state, DOMAIN_SELECTION_PROOF,
+                          H.compute_epoch_at_slot(cfg, slot))
+    root = H.compute_signing_root(
+        slot.to_bytes(8, "little").ljust(32, b"\x00"), domain)
+    return signer(validator_index, root)
+
+
+def is_aggregator(cfg: SpecConfig, state, slot: int, index: int,
+                  selection_proof: bytes) -> bool:
+    committee = H.get_beacon_committee(cfg, state, slot, index)
+    modulo = max(1, len(committee) // cfg.TARGET_AGGREGATORS_PER_COMMITTEE)
+    return (int.from_bytes(H.hash32(selection_proof)[:8], "little")
+            % modulo == 0)
+
+
+def produce_aggregate_and_proof(cfg: SpecConfig, state, aggregate,
+                                aggregator_index: int, signer: Signer):
+    S = get_schemas(cfg)
+    proof = get_selection_proof(cfg, state, aggregate.data.slot,
+                                aggregator_index, signer)
+    msg = S.AggregateAndProof(aggregator_index=aggregator_index,
+                              aggregate=aggregate, selection_proof=proof)
+    domain = H.get_domain(cfg, state, DOMAIN_AGGREGATE_AND_PROOF,
+                          H.compute_epoch_at_slot(cfg, aggregate.data.slot))
+    root = H.compute_signing_root(msg, domain)
+    return S.SignedAggregateAndProof(message=msg,
+                                     signature=signer(aggregator_index, root))

@@ -79,6 +79,10 @@ class SpecConfig:
     HISTORICAL_ROOTS_LIMIT: int = 2 ** 24
     VALIDATOR_REGISTRY_LIMIT: int = 2 ** 40
 
+    # Validator cycle
+    MIN_PER_EPOCH_CHURN_LIMIT: int = 4
+    CHURN_LIMIT_QUOTIENT: int = 65536
+
     # Rewards and penalties
     BASE_REWARD_FACTOR: int = 64
     WHISTLEBLOWER_REWARD_QUOTIENT: int = 512
@@ -134,6 +138,7 @@ MINIMAL = SpecConfig(
     HISTORICAL_ROOTS_LIMIT=2 ** 24,
     VALIDATOR_REGISTRY_LIMIT=2 ** 40,
     GENESIS_DELAY=300,
+    CHURN_LIMIT_QUOTIENT=32,
     INACTIVITY_PENALTY_QUOTIENT=2 ** 25,
     MIN_SLASHING_PENALTY_QUOTIENT=64,
     PROPORTIONAL_SLASHING_MULTIPLIER=2,

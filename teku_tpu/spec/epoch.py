@@ -1,0 +1,352 @@
+"""Phase0 epoch processing: justification/finalization, rewards,
+registry updates, slashings, final updates.
+
+Equivalent of the reference's EpochProcessor (reference: ethereum/spec/
+src/main/java/tech/pegasys/teku/spec/logic/common/statetransition/epoch/
+EpochProcessor.java and versions/phase0/.../EpochProcessorPhase0.java).
+Deltas are accumulated in flat arrays and applied in one state rebuild
+per sub-step — the batch-friendly shape — instead of the reference's
+per-validator object mutation.
+"""
+
+from typing import List, Sequence, Set, Tuple
+
+from .config import GENESIS_EPOCH, FAR_FUTURE_EPOCH, SpecConfig
+from .datastructures import Checkpoint, get_schemas
+from . import helpers as H
+
+BASE_REWARDS_PER_EPOCH = 4
+
+
+# --------------------------------------------------------------------------
+# Matching attestations
+# --------------------------------------------------------------------------
+
+def get_matching_source_attestations(cfg, state, epoch):
+    if epoch == H.get_current_epoch(cfg, state):
+        return state.current_epoch_attestations
+    assert epoch == H.get_previous_epoch(cfg, state)
+    return state.previous_epoch_attestations
+
+
+def get_matching_target_attestations(cfg, state, epoch):
+    root = H.get_block_root(cfg, state, epoch)
+    return tuple(a for a in get_matching_source_attestations(
+        cfg, state, epoch) if a.data.target.root == root)
+
+
+def get_matching_head_attestations(cfg, state, epoch):
+    return tuple(
+        a for a in get_matching_target_attestations(cfg, state, epoch)
+        if a.data.beacon_block_root
+        == H.get_block_root_at_slot(cfg, state, a.data.slot))
+
+
+def get_unslashed_attesting_indices(cfg, state, attestations) -> Set[int]:
+    out: Set[int] = set()
+    for a in attestations:
+        out.update(H.get_attesting_indices(
+            cfg, state, a.data, a.aggregation_bits))
+    return {i for i in out if not state.validators[i].slashed}
+
+
+def get_attesting_balance(cfg, state, attestations) -> int:
+    return H.get_total_balance(
+        cfg, state, get_unslashed_attesting_indices(
+            cfg, state, attestations))
+
+
+# --------------------------------------------------------------------------
+# Justification & finalization
+# --------------------------------------------------------------------------
+
+def process_justification_and_finalization(cfg: SpecConfig, state):
+    if H.get_current_epoch(cfg, state) <= GENESIS_EPOCH + 1:
+        return state
+    previous_epoch = H.get_previous_epoch(cfg, state)
+    current_epoch = H.get_current_epoch(cfg, state)
+    prev_target = get_attesting_balance(
+        cfg, state,
+        get_matching_target_attestations(cfg, state, previous_epoch))
+    cur_target = get_attesting_balance(
+        cfg, state,
+        get_matching_target_attestations(cfg, state, current_epoch))
+    total = H.get_total_active_balance(cfg, state)
+    return weigh_justification_and_finalization(
+        cfg, state, total, prev_target, cur_target)
+
+
+def weigh_justification_and_finalization(cfg, state, total_balance,
+                                         previous_target, current_target):
+    previous_epoch = H.get_previous_epoch(cfg, state)
+    current_epoch = H.get_current_epoch(cfg, state)
+    old_prev = state.previous_justified_checkpoint
+    old_cur = state.current_justified_checkpoint
+
+    bits = list(state.justification_bits)
+    bits = [False] + bits[:3]
+    prev_just = old_cur
+    cur_just = old_cur
+    if previous_target * 3 >= total_balance * 2:
+        cur_just = Checkpoint(
+            epoch=previous_epoch,
+            root=H.get_block_root(cfg, state, previous_epoch))
+        bits[1] = True
+    if current_target * 3 >= total_balance * 2:
+        cur_just = Checkpoint(
+            epoch=current_epoch,
+            root=H.get_block_root(cfg, state, current_epoch))
+        bits[0] = True
+
+    finalized = state.finalized_checkpoint
+    # 2nd/3rd/4th most recent epochs justified
+    if all(bits[1:4]) and old_prev.epoch + 3 == current_epoch:
+        finalized = old_prev
+    if all(bits[1:3]) and old_prev.epoch + 2 == current_epoch:
+        finalized = old_prev
+    if all(bits[0:3]) and old_cur.epoch + 2 == current_epoch:
+        finalized = old_cur
+    if all(bits[0:2]) and old_cur.epoch + 1 == current_epoch:
+        finalized = old_cur
+
+    return state.copy_with(
+        previous_justified_checkpoint=prev_just,
+        current_justified_checkpoint=cur_just,
+        justification_bits=tuple(bits),
+        finalized_checkpoint=finalized)
+
+
+# --------------------------------------------------------------------------
+# Rewards & penalties
+# --------------------------------------------------------------------------
+
+def get_base_reward(cfg, state, index, total_balance) -> int:
+    eff = state.validators[index].effective_balance
+    return (eff * cfg.BASE_REWARD_FACTOR
+            // H.integer_squareroot(total_balance)
+            // BASE_REWARDS_PER_EPOCH)
+
+
+def get_proposer_reward(cfg, state, index, total_balance) -> int:
+    return (get_base_reward(cfg, state, index, total_balance)
+            // cfg.PROPOSER_REWARD_QUOTIENT)
+
+
+def get_finality_delay(cfg, state) -> int:
+    return (H.get_previous_epoch(cfg, state)
+            - state.finalized_checkpoint.epoch)
+
+
+def is_in_inactivity_leak(cfg, state) -> bool:
+    return get_finality_delay(cfg, state) > cfg.MIN_EPOCHS_TO_INACTIVITY_PENALTY
+
+
+def get_eligible_validator_indices(cfg, state) -> List[int]:
+    previous_epoch = H.get_previous_epoch(cfg, state)
+    return [i for i, v in enumerate(state.validators)
+            if H.is_active_validator(v, previous_epoch)
+            or (v.slashed and previous_epoch + 1 < v.withdrawable_epoch)]
+
+
+def _component_deltas(cfg, state, attestations, n, total_balance,
+                      eligible):
+    rewards = [0] * n
+    penalties = [0] * n
+    unslashed = get_unslashed_attesting_indices(cfg, state, attestations)
+    attesting_balance = H.get_total_balance(cfg, state, unslashed)
+    increment = cfg.EFFECTIVE_BALANCE_INCREMENT
+    leak = is_in_inactivity_leak(cfg, state)
+    for index in eligible:
+        base = get_base_reward(cfg, state, index, total_balance)
+        if index in unslashed:
+            if leak:
+                rewards[index] += base
+            else:
+                rewards[index] += (base * (attesting_balance // increment)
+                                   // (total_balance // increment))
+        else:
+            penalties[index] += base
+    return rewards, penalties
+
+
+def get_attestation_deltas(cfg, state) -> Tuple[List[int], List[int]]:
+    n = len(state.validators)
+    total_balance = H.get_total_active_balance(cfg, state)
+    eligible = get_eligible_validator_indices(cfg, state)
+    previous_epoch = H.get_previous_epoch(cfg, state)
+    src = get_matching_source_attestations(cfg, state, previous_epoch)
+    tgt = get_matching_target_attestations(cfg, state, previous_epoch)
+    head = get_matching_head_attestations(cfg, state, previous_epoch)
+
+    r1, p1 = _component_deltas(cfg, state, src, n, total_balance, eligible)
+    r2, p2 = _component_deltas(cfg, state, tgt, n, total_balance, eligible)
+    r3, p3 = _component_deltas(cfg, state, head, n, total_balance, eligible)
+
+    # inclusion-delay rewards
+    r4 = [0] * n
+    att_cache = {}
+    for a in src:
+        for i in H.get_attesting_indices(cfg, state, a.data,
+                                         a.aggregation_bits):
+            prev = att_cache.get(i)
+            if prev is None or a.inclusion_delay < prev.inclusion_delay:
+                att_cache[i] = a
+    for index in get_unslashed_attesting_indices(cfg, state, src):
+        a = att_cache[index]
+        base = get_base_reward(cfg, state, index, total_balance)
+        proposer_reward = base // cfg.PROPOSER_REWARD_QUOTIENT
+        r4[a.proposer_index] += proposer_reward
+        max_attester = base - proposer_reward
+        r4[index] += max_attester // a.inclusion_delay
+
+    # inactivity penalties
+    p4 = [0] * n
+    if is_in_inactivity_leak(cfg, state):
+        tgt_unslashed = get_unslashed_attesting_indices(cfg, state, tgt)
+        delay = get_finality_delay(cfg, state)
+        for index in eligible:
+            base = get_base_reward(cfg, state, index, total_balance)
+            p4[index] += (BASE_REWARDS_PER_EPOCH * base
+                          - base // cfg.PROPOSER_REWARD_QUOTIENT)
+            if index not in tgt_unslashed:
+                eff = state.validators[index].effective_balance
+                p4[index] += (eff * delay
+                              // cfg.INACTIVITY_PENALTY_QUOTIENT)
+
+    rewards = [r1[i] + r2[i] + r3[i] + r4[i] for i in range(n)]
+    penalties = [p1[i] + p2[i] + p3[i] + p4[i] for i in range(n)]
+    return rewards, penalties
+
+
+def process_rewards_and_penalties(cfg: SpecConfig, state):
+    if H.get_current_epoch(cfg, state) == GENESIS_EPOCH:
+        return state
+    rewards, penalties = get_attestation_deltas(cfg, state)
+    balances = list(state.balances)
+    for i in range(len(balances)):
+        balances[i] = max(0, balances[i] + rewards[i] - penalties[i])
+    return state.copy_with(balances=tuple(balances))
+
+
+# --------------------------------------------------------------------------
+# Registry updates / slashings / final updates
+# --------------------------------------------------------------------------
+
+def process_registry_updates(cfg: SpecConfig, state):
+    current_epoch = H.get_current_epoch(cfg, state)
+    validators = list(state.validators)
+    changed = False
+    for i, v in enumerate(validators):
+        if H.is_eligible_for_activation_queue(cfg, v):
+            validators[i] = v.copy_with(
+                activation_eligibility_epoch=current_epoch + 1)
+            changed = True
+    if changed:
+        state = state.copy_with(validators=tuple(validators))
+    for i, v in enumerate(state.validators):
+        if (H.is_active_validator(v, current_epoch)
+                and v.effective_balance <= cfg.EJECTION_BALANCE):
+            state = H.initiate_validator_exit(cfg, state, i)
+
+    queue = sorted(
+        (i for i, v in enumerate(state.validators)
+         if H.is_eligible_for_activation(state, v)),
+        key=lambda i: (state.validators[i].activation_eligibility_epoch, i))
+    churn = H.get_validator_churn_limit(cfg, state)
+    if queue:
+        validators = list(state.validators)
+        target_epoch = H.compute_activation_exit_epoch(cfg, current_epoch)
+        for i in queue[:churn]:
+            validators[i] = validators[i].copy_with(
+                activation_epoch=target_epoch)
+        state = state.copy_with(validators=tuple(validators))
+    return state
+
+
+def process_slashings(cfg: SpecConfig, state):
+    epoch = H.get_current_epoch(cfg, state)
+    total_balance = H.get_total_active_balance(cfg, state)
+    adjusted = min(sum(state.slashings)
+                   * cfg.PROPORTIONAL_SLASHING_MULTIPLIER, total_balance)
+    increment = cfg.EFFECTIVE_BALANCE_INCREMENT
+    balances = list(state.balances)
+    for i, v in enumerate(state.validators):
+        if (v.slashed and epoch + cfg.EPOCHS_PER_SLASHINGS_VECTOR // 2
+                == v.withdrawable_epoch):
+            penalty = (v.effective_balance // increment * adjusted
+                       // total_balance * increment)
+            balances[i] = max(0, balances[i] - penalty)
+    return state.copy_with(balances=tuple(balances))
+
+
+def process_eth1_data_reset(cfg: SpecConfig, state):
+    next_epoch = H.get_current_epoch(cfg, state) + 1
+    if next_epoch % cfg.EPOCHS_PER_ETH1_VOTING_PERIOD == 0:
+        return state.copy_with(eth1_data_votes=())
+    return state
+
+
+def process_effective_balance_updates(cfg: SpecConfig, state):
+    validators = list(state.validators)
+    changed = False
+    inc = cfg.EFFECTIVE_BALANCE_INCREMENT
+    down = inc * cfg.HYSTERESIS_DOWNWARD_MULTIPLIER // cfg.HYSTERESIS_QUOTIENT
+    up = inc * cfg.HYSTERESIS_UPWARD_MULTIPLIER // cfg.HYSTERESIS_QUOTIENT
+    for i, v in enumerate(validators):
+        balance = state.balances[i]
+        if (balance + down < v.effective_balance
+                or v.effective_balance + up < balance):
+            validators[i] = v.copy_with(effective_balance=min(
+                balance - balance % inc, cfg.MAX_EFFECTIVE_BALANCE))
+            changed = True
+    if changed:
+        return state.copy_with(validators=tuple(validators))
+    return state
+
+
+def process_slashings_reset(cfg: SpecConfig, state):
+    next_epoch = H.get_current_epoch(cfg, state) + 1
+    slashings = list(state.slashings)
+    slashings[next_epoch % cfg.EPOCHS_PER_SLASHINGS_VECTOR] = 0
+    return state.copy_with(slashings=tuple(slashings))
+
+
+def process_randao_mixes_reset(cfg: SpecConfig, state):
+    current_epoch = H.get_current_epoch(cfg, state)
+    next_epoch = current_epoch + 1
+    mixes = list(state.randao_mixes)
+    mixes[next_epoch % cfg.EPOCHS_PER_HISTORICAL_VECTOR] = (
+        H.get_randao_mix(cfg, state, current_epoch))
+    return state.copy_with(randao_mixes=tuple(mixes))
+
+
+def process_historical_roots_update(cfg: SpecConfig, state):
+    next_epoch = H.get_current_epoch(cfg, state) + 1
+    if next_epoch % (cfg.SLOTS_PER_HISTORICAL_ROOT
+                     // cfg.SLOTS_PER_EPOCH) == 0:
+        S = get_schemas(cfg)
+        batch = S.HistoricalBatch(block_roots=state.block_roots,
+                                  state_roots=state.state_roots)
+        return state.copy_with(
+            historical_roots=tuple(state.historical_roots) + (batch.htr(),))
+    return state
+
+
+def process_participation_record_updates(cfg: SpecConfig, state):
+    return state.copy_with(
+        previous_epoch_attestations=state.current_epoch_attestations,
+        current_epoch_attestations=())
+
+
+def process_epoch(cfg: SpecConfig, state):
+    state = process_justification_and_finalization(cfg, state)
+    state = process_rewards_and_penalties(cfg, state)
+    state = process_registry_updates(cfg, state)
+    state = process_slashings(cfg, state)
+    state = process_eth1_data_reset(cfg, state)
+    state = process_effective_balance_updates(cfg, state)
+    state = process_slashings_reset(cfg, state)
+    state = process_randao_mixes_reset(cfg, state)
+    state = process_historical_roots_update(cfg, state)
+    state = process_participation_record_updates(cfg, state)
+    return state

@@ -1,0 +1,103 @@
+"""Genesis state construction, including the interop/devnet path.
+
+Equivalent of the reference's genesis machinery (reference: ethereum/
+spec/src/main/java/tech/pegasys/teku/spec/logic/common/util/
+BeaconStateUtil / genesis generators used by the interop feature and
+statetransition/genesis/) — here the deterministic interop path: keys
+derived per the interop scheme, deposits applied without proofs, the
+eth1 block hash fixed, matching what the acceptance-test devnets use.
+"""
+
+import hashlib
+from typing import List, Sequence, Tuple
+
+from .config import FAR_FUTURE_EPOCH, GENESIS_EPOCH, SpecConfig
+from .datastructures import (BeaconBlockHeader, Eth1Data, Fork,
+                             get_schemas, Validator)
+from . import block as B
+from . import helpers as H
+
+# curve order for interop key derivation
+_R = 0x73eda753299d7d483339d80809a1d80553bda402fffe5bfeffffffff00000001
+
+
+def interop_secret_keys(n: int) -> List[int]:
+    """The standardized interop secret keys:
+    sk_i = int(sha256(uint_to_bytes(uint64(i)))) mod r."""
+    out = []
+    for i in range(n):
+        h = hashlib.sha256(i.to_bytes(32, "little")).digest()
+        out.append(int.from_bytes(h, "little") % _R)
+    return out
+
+
+def interop_credentials(pubkey: bytes) -> bytes:
+    return b"\x00" + hashlib.sha256(pubkey).digest()[1:]
+
+
+def initialize_beacon_state(cfg: SpecConfig,
+                            genesis_time: int,
+                            deposits: Sequence[Tuple[bytes, bytes, int]],
+                            eth1_block_hash: bytes = b"\x42" * 32):
+    """Build a genesis state from (pubkey, withdrawal_credentials,
+    amount) tuples — the interop path skips deposit proofs/signatures
+    (keys are trusted at genesis)."""
+    S = get_schemas(cfg)
+    state = S.BeaconState(
+        genesis_time=genesis_time,
+        fork=Fork(previous_version=cfg.GENESIS_FORK_VERSION,
+                  current_version=cfg.GENESIS_FORK_VERSION,
+                  epoch=GENESIS_EPOCH),
+        eth1_data=Eth1Data(deposit_root=bytes(32),
+                           deposit_count=len(deposits),
+                           block_hash=eth1_block_hash),
+        latest_block_header=BeaconBlockHeader(
+            body_root=S.BeaconBlockBody().htr()),
+        randao_mixes=tuple(
+            eth1_block_hash
+            for _ in range(cfg.EPOCHS_PER_HISTORICAL_VECTOR)),
+    )
+    validators = []
+    balances = []
+    for pubkey, creds, amount in deposits:
+        validators.append(B.get_validator_from_deposit(
+            cfg, pubkey, creds, amount))
+        balances.append(amount)
+    # genesis activations
+    for i, v in enumerate(validators):
+        if v.effective_balance == cfg.MAX_EFFECTIVE_BALANCE:
+            validators[i] = v.copy_with(
+                activation_eligibility_epoch=GENESIS_EPOCH,
+                activation_epoch=GENESIS_EPOCH)
+    state = state.copy_with(
+        validators=tuple(validators), balances=tuple(balances),
+        eth1_deposit_index=len(deposits),
+        genesis_validators_root=_validators_root(cfg, validators))
+    return state
+
+
+def _validators_root(cfg: SpecConfig, validators) -> bytes:
+    from ..ssz import List as SszList
+    return SszList(Validator, cfg.VALIDATOR_REGISTRY_LIMIT
+                   ).hash_tree_root(tuple(validators))
+
+
+def interop_genesis(cfg: SpecConfig, n_validators: int,
+                    genesis_time: int = 1578009600):
+    """(state, secret_keys) for an n-validator interop devnet."""
+    from ..crypto import bls
+    sks = interop_secret_keys(n_validators)
+    deposits = []
+    for sk in sks:
+        pk = bls.secret_to_public_key(sk)
+        deposits.append((pk, interop_credentials(pk),
+                         cfg.MAX_EFFECTIVE_BALANCE))
+    state = initialize_beacon_state(cfg, genesis_time, deposits)
+    return state, sks
+
+
+def is_valid_genesis_state(cfg: SpecConfig, state) -> bool:
+    if state.genesis_time < cfg.MIN_GENESIS_TIME:
+        return False
+    active = H.get_active_validator_indices(state, GENESIS_EPOCH)
+    return len(active) >= cfg.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT

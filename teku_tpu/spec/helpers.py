@@ -1,0 +1,426 @@
+"""Phase0 spec helper functions: math, shuffling, committees, domains,
+state accessors/mutators, predicates.
+
+Equivalent of the reference's helper layer (reference: ethereum/spec/src/
+main/java/tech/pegasys/teku/spec/logic/common/helpers/MiscHelpers.java,
+BeaconStateAccessors.java, BeaconStateMutators.java, Predicates.java,
+MathHelpers.java) — here plain functions over the immutable SSZ
+containers, with the swap-or-not shuffle vectorized over the whole index
+list in numpy (one pass per round for every index at once) instead of
+the reference's per-index loop, because committee computation is the
+per-epoch hot loop and whole-list batching is the TPU-first shape.
+"""
+
+import hashlib
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ssz import Container
+from .config import (DOMAIN_BEACON_ATTESTER, FAR_FUTURE_EPOCH,
+                     GENESIS_EPOCH, SpecConfig)
+from .datastructures import (AttestationData, Checkpoint, Fork, ForkData,
+                             SigningData, Validator)
+
+
+def hash32(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def integer_squareroot(n: int) -> int:
+    return math.isqrt(n)
+
+
+def xor32(a: bytes, b: bytes) -> bytes:
+    return bytes(x ^ y for x, y in zip(a, b))
+
+
+def uint_to_bytes(n: int, length: int = 8) -> bytes:
+    return n.to_bytes(length, "little")
+
+
+def bytes_to_uint64(data: bytes) -> int:
+    return int.from_bytes(data, "little")
+
+
+# --------------------------------------------------------------------------
+# Epoch / slot math
+# --------------------------------------------------------------------------
+
+def compute_epoch_at_slot(cfg: SpecConfig, slot: int) -> int:
+    return slot // cfg.SLOTS_PER_EPOCH
+
+
+def compute_start_slot_at_epoch(cfg: SpecConfig, epoch: int) -> int:
+    return epoch * cfg.SLOTS_PER_EPOCH
+
+
+def compute_activation_exit_epoch(cfg: SpecConfig, epoch: int) -> int:
+    return epoch + 1 + cfg.MAX_SEED_LOOKAHEAD
+
+
+def get_current_epoch(cfg: SpecConfig, state) -> int:
+    return compute_epoch_at_slot(cfg, state.slot)
+
+
+def get_previous_epoch(cfg: SpecConfig, state) -> int:
+    cur = get_current_epoch(cfg, state)
+    return GENESIS_EPOCH if cur == GENESIS_EPOCH else cur - 1
+
+
+# --------------------------------------------------------------------------
+# Shuffling (swap-or-not, vectorized)
+# --------------------------------------------------------------------------
+
+def compute_shuffled_index(cfg: SpecConfig, index: int, index_count: int,
+                           seed: bytes) -> int:
+    """Single-index forward shuffle (spec-literal, for spot checks)."""
+    assert index < index_count
+    for r in range(cfg.SHUFFLE_ROUND_COUNT):
+        pivot = bytes_to_uint64(
+            hash32(seed + bytes([r]))[:8]) % index_count
+        flip = (pivot + index_count - index) % index_count
+        position = max(index, flip)
+        source = hash32(seed + bytes([r])
+                        + uint_to_bytes(position // 256, 8)[:4])
+        byte = source[(position % 256) // 8]
+        if (byte >> (position % 8)) % 2:
+            index = flip
+    return index
+
+
+def shuffle_list(cfg: SpecConfig, indices: np.ndarray, seed: bytes,
+                 ) -> np.ndarray:
+    """Shuffle the WHOLE list at once: per round, one vectorized
+    swap-or-not pass over every position (the reference shuffles lists
+    via the same inverted-round trick in
+    spec/logic/common/helpers/MiscHelpers.java shuffleList)."""
+    n = len(indices)
+    if n == 0:
+        return indices
+    out = indices.copy()
+    # list-shuffle applies rounds in reverse to match per-index forward
+    for r in range(cfg.SHUFFLE_ROUND_COUNT - 1, -1, -1):
+        rb = bytes([r])
+        pivot = bytes_to_uint64(hash32(seed + rb)[:8]) % n
+        pos = np.arange(n, dtype=np.int64)
+        flip = (pivot + n - pos) % n
+        position = np.maximum(pos, flip)
+        # one source hash per 256 positions
+        n_words = int(position.max()) // 256 + 1
+        srcs = np.frombuffer(
+            b"".join(hash32(seed + rb + uint_to_bytes(w, 8)[:4])
+                     for w in range(n_words)), dtype=np.uint8,
+        ).reshape(n_words, 32)
+        byte = srcs[position // 256, (position % 256) // 8]
+        bit = (byte >> (position % 8).astype(np.uint8)) & 1
+        swapped = np.where(bit.astype(bool), out[flip], out)
+        out = swapped
+    return out
+
+
+def compute_proposer_index(cfg: SpecConfig, state, indices: Sequence[int],
+                           seed: bytes) -> int:
+    """Balance-weighted proposer sampling (spec compute_proposer_index)."""
+    assert len(indices) > 0
+    MAX_RANDOM_BYTE = 2 ** 8 - 1
+    i = 0
+    total = len(indices)
+    validators = state.validators
+    while True:
+        candidate = indices[compute_shuffled_index(
+            cfg, i % total, total, seed)]
+        random_byte = hash32(seed + uint_to_bytes(i // 32, 8))[i % 32]
+        eff = validators[candidate].effective_balance
+        if eff * MAX_RANDOM_BYTE >= cfg.MAX_EFFECTIVE_BALANCE * random_byte:
+            return candidate
+        i += 1
+
+
+# --------------------------------------------------------------------------
+# Accessors
+# --------------------------------------------------------------------------
+
+def is_active_validator(v: Validator, epoch: int) -> bool:
+    return v.activation_epoch <= epoch < v.exit_epoch
+
+
+def get_active_validator_indices(state, epoch: int) -> List[int]:
+    return [i for i, v in enumerate(state.validators)
+            if is_active_validator(v, epoch)]
+
+
+def get_validator_churn_limit(cfg: SpecConfig, state) -> int:
+    active = get_active_validator_indices(
+        state, get_current_epoch(cfg, state))
+    return max(cfg.MIN_PER_EPOCH_CHURN_LIMIT,
+               len(active) // cfg.CHURN_LIMIT_QUOTIENT)
+
+
+def get_randao_mix(cfg: SpecConfig, state, epoch: int) -> bytes:
+    return state.randao_mixes[epoch % cfg.EPOCHS_PER_HISTORICAL_VECTOR]
+
+
+def get_seed(cfg: SpecConfig, state, epoch: int, domain_type: bytes) -> bytes:
+    mix = get_randao_mix(
+        cfg, state,
+        epoch + cfg.EPOCHS_PER_HISTORICAL_VECTOR
+        - cfg.MIN_SEED_LOOKAHEAD - 1)
+    return hash32(domain_type + uint_to_bytes(epoch, 8) + mix)
+
+
+def get_block_root_at_slot(cfg: SpecConfig, state, slot: int) -> bytes:
+    assert slot < state.slot <= slot + cfg.SLOTS_PER_HISTORICAL_ROOT
+    return state.block_roots[slot % cfg.SLOTS_PER_HISTORICAL_ROOT]
+
+
+def get_block_root(cfg: SpecConfig, state, epoch: int) -> bytes:
+    return get_block_root_at_slot(
+        cfg, state, compute_start_slot_at_epoch(cfg, epoch))
+
+
+def get_committee_count_per_slot(cfg: SpecConfig, state, epoch: int) -> int:
+    active = len(get_active_validator_indices(state, epoch))
+    return max(1, min(
+        cfg.MAX_COMMITTEES_PER_SLOT,
+        active // cfg.SLOTS_PER_EPOCH // cfg.TARGET_COMMITTEE_SIZE))
+
+
+class ShufflingCache:
+    """Per-(seed, epoch) active-index shuffling, computed once.
+
+    The reference keeps the same data in TransitionCaches/epoch caches
+    (reference: ethereum/spec/.../spec/cache/); committee queries per
+    slot slice the one shuffled array.
+    """
+
+    def __init__(self):
+        self._cache: dict = {}
+
+    def get(self, cfg: SpecConfig, state, epoch: int) -> np.ndarray:
+        seed = get_seed(cfg, state, epoch, DOMAIN_BEACON_ATTESTER)
+        indices = np.asarray(
+            get_active_validator_indices(state, epoch), dtype=np.int64)
+        # seed alone can collide across deep conflicting forks whose
+        # activation sets diverged; the active-index digest pins the key
+        # to the exact membership (the O(n) scan is unavoidable anyway,
+        # the cache exists to skip the shuffle rounds).
+        key = (seed, epoch, hash(indices.tobytes()))
+        hit = self._cache.get(key)
+        if hit is None:
+            hit = shuffle_list(cfg, indices, seed)
+            if len(self._cache) > 64:
+                self._cache.clear()
+            self._cache[key] = hit
+        return hit
+
+
+_SHUFFLING = ShufflingCache()
+
+
+def get_beacon_committee(cfg: SpecConfig, state, slot: int,
+                         index: int) -> List[int]:
+    epoch = compute_epoch_at_slot(cfg, slot)
+    per_slot = get_committee_count_per_slot(cfg, state, epoch)
+    committees_per_epoch = per_slot * cfg.SLOTS_PER_EPOCH
+    committee_index = (slot % cfg.SLOTS_PER_EPOCH) * per_slot + index
+    shuffled = _SHUFFLING.get(cfg, state, epoch)
+    n = len(shuffled)
+    start = n * committee_index // committees_per_epoch
+    end = n * (committee_index + 1) // committees_per_epoch
+    return [int(x) for x in shuffled[start:end]]
+
+
+def get_beacon_proposer_index(cfg: SpecConfig, state) -> int:
+    epoch = get_current_epoch(cfg, state)
+    from .config import DOMAIN_BEACON_PROPOSER
+    seed = hash32(get_seed(cfg, state, epoch, DOMAIN_BEACON_PROPOSER)
+                  + uint_to_bytes(state.slot, 8))
+    indices = get_active_validator_indices(state, epoch)
+    return compute_proposer_index(cfg, state, indices, seed)
+
+
+def get_total_balance(cfg: SpecConfig, state, indices) -> int:
+    return max(cfg.EFFECTIVE_BALANCE_INCREMENT,
+               sum(state.validators[i].effective_balance for i in indices))
+
+
+def get_total_active_balance(cfg: SpecConfig, state) -> int:
+    return get_total_balance(
+        cfg, state,
+        get_active_validator_indices(state, get_current_epoch(cfg, state)))
+
+
+# --------------------------------------------------------------------------
+# Domains / signing roots
+# --------------------------------------------------------------------------
+
+def compute_fork_data_root(current_version: bytes,
+                           genesis_validators_root: bytes) -> bytes:
+    return ForkData(current_version=current_version,
+                    genesis_validators_root=genesis_validators_root).htr()
+
+
+def compute_fork_digest(current_version: bytes,
+                        genesis_validators_root: bytes) -> bytes:
+    return compute_fork_data_root(
+        current_version, genesis_validators_root)[:4]
+
+
+def compute_domain(domain_type: bytes, fork_version: bytes = bytes(4),
+                   genesis_validators_root: bytes = bytes(32)) -> bytes:
+    root = compute_fork_data_root(fork_version, genesis_validators_root)
+    return domain_type + root[:28]
+
+
+def get_domain(cfg: SpecConfig, state, domain_type: bytes,
+               epoch: Optional[int] = None) -> bytes:
+    epoch = get_current_epoch(cfg, state) if epoch is None else epoch
+    fork: Fork = state.fork
+    version = (fork.previous_version if epoch < fork.epoch
+               else fork.current_version)
+    return compute_domain(domain_type, version,
+                          state.genesis_validators_root)
+
+
+def compute_signing_root(obj, domain: bytes) -> bytes:
+    root = obj if isinstance(obj, bytes) else obj.htr()
+    return SigningData(object_root=root, domain=domain).htr()
+
+
+# --------------------------------------------------------------------------
+# Predicates
+# --------------------------------------------------------------------------
+
+def is_slashable_validator(v: Validator, epoch: int) -> bool:
+    return (not v.slashed
+            and v.activation_epoch <= epoch < v.withdrawable_epoch)
+
+
+def is_slashable_attestation_data(d1: AttestationData,
+                                  d2: AttestationData) -> bool:
+    double = d1 != d2 and d1.target.epoch == d2.target.epoch
+    surround = (d1.source.epoch < d2.source.epoch
+                and d2.target.epoch < d1.target.epoch)
+    return double or surround
+
+
+def is_eligible_for_activation_queue(cfg: SpecConfig, v: Validator) -> bool:
+    return (v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
+            and v.effective_balance == cfg.MAX_EFFECTIVE_BALANCE)
+
+
+def is_eligible_for_activation(state, v: Validator) -> bool:
+    return (v.activation_eligibility_epoch
+            <= state.finalized_checkpoint.epoch
+            and v.activation_epoch == FAR_FUTURE_EPOCH)
+
+
+def is_valid_merkle_branch(leaf: bytes, branch: Sequence[bytes], depth: int,
+                           index: int, root: bytes) -> bool:
+    value = leaf
+    for i in range(depth):
+        if (index >> i) & 1:
+            value = hash32(branch[i] + value)
+        else:
+            value = hash32(value + branch[i])
+    return value == root
+
+
+# --------------------------------------------------------------------------
+# Attestation helpers
+# --------------------------------------------------------------------------
+
+def get_attesting_indices(cfg: SpecConfig, state, data: AttestationData,
+                          bits) -> List[int]:
+    committee = get_beacon_committee(cfg, state, data.slot, data.index)
+    assert len(bits) == len(committee)
+    return sorted(i for i, b in zip(committee, bits) if b)
+
+
+def get_indexed_attestation(cfg: SpecConfig, state, attestation):
+    from .datastructures import get_schemas
+    S = get_schemas(cfg)
+    indices = get_attesting_indices(
+        cfg, state, attestation.data, attestation.aggregation_bits)
+    return S.IndexedAttestation(
+        attesting_indices=tuple(indices),
+        data=attestation.data,
+        signature=attestation.signature)
+
+
+# --------------------------------------------------------------------------
+# Mutators (return new states — containers are immutable)
+# --------------------------------------------------------------------------
+
+def increase_balance(state, index: int, delta: int):
+    balances = list(state.balances)
+    balances[index] += delta
+    return state.copy_with(balances=tuple(balances))
+
+
+def decrease_balance(state, index: int, delta: int):
+    balances = list(state.balances)
+    balances[index] = max(0, balances[index] - delta)
+    return state.copy_with(balances=tuple(balances))
+
+
+def compute_exit_epoch_and_update(cfg: SpecConfig, state):
+    """(exit_queue_epoch, churn) for initiate_validator_exit."""
+    exit_epochs = [v.exit_epoch for v in state.validators
+                   if v.exit_epoch != FAR_FUTURE_EPOCH]
+    exit_queue_epoch = max(
+        exit_epochs
+        + [compute_activation_exit_epoch(
+            cfg, get_current_epoch(cfg, state))])
+    exit_queue_churn = sum(
+        1 for v in state.validators if v.exit_epoch == exit_queue_epoch)
+    if exit_queue_churn >= get_validator_churn_limit(cfg, state):
+        exit_queue_epoch += 1
+    return exit_queue_epoch
+
+
+def initiate_validator_exit(cfg: SpecConfig, state, index: int):
+    v = state.validators[index]
+    if v.exit_epoch != FAR_FUTURE_EPOCH:
+        return state
+    exit_queue_epoch = compute_exit_epoch_and_update(cfg, state)
+    v = v.copy_with(
+        exit_epoch=exit_queue_epoch,
+        withdrawable_epoch=(exit_queue_epoch
+                            + cfg.MIN_VALIDATOR_WITHDRAWABILITY_DELAY))
+    validators = list(state.validators)
+    validators[index] = v
+    return state.copy_with(validators=tuple(validators))
+
+
+def slash_validator(cfg: SpecConfig, state, slashed_index: int,
+                    whistleblower_index: Optional[int] = None):
+    epoch = get_current_epoch(cfg, state)
+    state = initiate_validator_exit(cfg, state, slashed_index)
+    v = state.validators[slashed_index]
+    v = v.copy_with(
+        slashed=True,
+        withdrawable_epoch=max(
+            v.withdrawable_epoch, epoch + cfg.EPOCHS_PER_SLASHINGS_VECTOR))
+    validators = list(state.validators)
+    validators[slashed_index] = v
+    slashings = list(state.slashings)
+    slashings[epoch % cfg.EPOCHS_PER_SLASHINGS_VECTOR] += v.effective_balance
+    state = state.copy_with(validators=tuple(validators),
+                            slashings=tuple(slashings))
+    state = decrease_balance(
+        state, slashed_index,
+        v.effective_balance // cfg.MIN_SLASHING_PENALTY_QUOTIENT)
+
+    proposer_index = get_beacon_proposer_index(cfg, state)
+    if whistleblower_index is None:
+        whistleblower_index = proposer_index
+    whistleblower_reward = (v.effective_balance
+                            // cfg.WHISTLEBLOWER_REWARD_QUOTIENT)
+    proposer_reward = whistleblower_reward // cfg.PROPOSER_REWARD_QUOTIENT
+    state = increase_balance(state, proposer_index, proposer_reward)
+    state = increase_balance(state, whistleblower_index,
+                             whistleblower_reward - proposer_reward)
+    return state

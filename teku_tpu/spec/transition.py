@@ -1,0 +1,79 @@
+"""State transition driver: process_slots + full per-block transition.
+
+Equivalent of the reference's StateTransition (reference: ethereum/spec/
+src/main/java/tech/pegasys/teku/spec/logic/StateTransition.java:29-118)
+and the processAndValidateBlock entry in AbstractBlockProcessor.java:
+133-152: slot catch-up with epoch boundaries, then block processing with
+a per-block BatchSignatureVerifier whose ONE device dispatch settles
+every collected signature.
+"""
+
+from .config import SpecConfig
+from . import block as B
+from . import epoch as E
+from . import helpers as H
+from .verifiers import (BatchSignatureVerifier, SIMPLE, SignatureVerifier)
+
+
+class StateTransitionError(Exception):
+    """Invalid block (the reference's StateTransitionException)."""
+
+
+def process_slot(cfg: SpecConfig, state):
+    previous_state_root = state.htr()
+    roots = list(state.state_roots)
+    roots[state.slot % cfg.SLOTS_PER_HISTORICAL_ROOT] = previous_state_root
+    state = state.copy_with(state_roots=tuple(roots))
+    if state.latest_block_header.state_root == bytes(32):
+        state = state.copy_with(
+            latest_block_header=state.latest_block_header.copy_with(
+                state_root=previous_state_root))
+    block_roots = list(state.block_roots)
+    block_roots[state.slot % cfg.SLOTS_PER_HISTORICAL_ROOT] = (
+        state.latest_block_header.htr())
+    return state.copy_with(block_roots=tuple(block_roots))
+
+
+def process_slots(cfg: SpecConfig, state, slot: int):
+    if slot <= state.slot:
+        raise StateTransitionError(
+            f"cannot rewind: state at {state.slot}, asked for {slot}")
+    while state.slot < slot:
+        state = process_slot(cfg, state)
+        if (state.slot + 1) % cfg.SLOTS_PER_EPOCH == 0:
+            state = E.process_epoch(cfg, state)
+        state = state.copy_with(slot=state.slot + 1)
+    return state
+
+
+def state_transition(cfg: SpecConfig, state, signed_block,
+                     validate_result: bool = True):
+    """Full transition: slots catch-up, batched signature verification,
+    block processing, state-root check.  Raises StateTransitionError on
+    any invalidity (when validate_result)."""
+    block = signed_block.message
+    state = process_slots(cfg, state, block.slot)
+    verifier: SignatureVerifier = (
+        BatchSignatureVerifier() if validate_result else _ACCEPT_ALL)
+    try:
+        if validate_result and not B.verify_block_signature(
+                cfg, state, signed_block, verifier):
+            raise StateTransitionError("bad proposer signature")
+        state = B.process_block(cfg, state, block, verifier,
+                                deposit_verifier=SIMPLE)
+    except B.BlockProcessingError as exc:
+        raise StateTransitionError(str(exc)) from exc
+    if validate_result:
+        if not verifier.batch_verify():
+            raise StateTransitionError("batch signature verification failed")
+        if block.state_root != state.htr():
+            raise StateTransitionError("state root mismatch")
+    return state
+
+
+class _AcceptAll(SignatureVerifier):
+    def verify(self, public_keys, message, signature) -> bool:
+        return True
+
+
+_ACCEPT_ALL = _AcceptAll()

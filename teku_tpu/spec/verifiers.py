@@ -1,0 +1,136 @@
+"""Signature-verifier seams between the spec engine and the BLS backend.
+
+All spec-level verification code is written against these interfaces,
+never against the BLS facade directly — the reference's second SPI seam
+(reference: infrastructure/bls/src/main/java/tech/pegasys/teku/bls/
+BLSSignatureVerifier.java:1-87; ethereum/spec/src/main/java/tech/pegasys/
+teku/spec/logic/common/util/AsyncBLSSignatureVerifier.java:24-60;
+AsyncBatchBLSSignatureVerifier.java:24-60), so block import can swap in
+the collect-then-batch verifier and gossip validation can swap in the
+TPU batching service without the spec logic knowing.
+"""
+
+import asyncio
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+from ..crypto import bls
+
+Triple = Tuple[Sequence[bytes], bytes, bytes]
+
+
+class SignatureVerifier:
+    """Sync seam: verify one (pubkeys, message, signature) triple."""
+
+    def verify(self, public_keys: Sequence[bytes], message: bytes,
+               signature: bytes) -> bool:
+        raise NotImplementedError
+
+
+class SimpleSignatureVerifier(SignatureVerifier):
+    """Immediate verification straight through the BLS facade (the
+    reference's BLSSignatureVerifier.SIMPLE)."""
+
+    def verify(self, public_keys, message, signature) -> bool:
+        if len(public_keys) == 1:
+            return bls.verify(public_keys[0], message, signature)
+        return bls.fast_aggregate_verify(
+            list(public_keys), message, signature)
+
+
+SIMPLE = SimpleSignatureVerifier()
+
+
+class BatchSignatureVerifier(SignatureVerifier):
+    """Disposable collect-then-verify verifier for block import.
+
+    verify() only records the triple and optimistically returns True;
+    batch_verify() submits everything as ONE random-multiplier batch
+    (reference: ethereum/spec/.../statetransition/blockvalidator/
+    BatchSignatureVerifier.java:38-108 — there prepareBatchVerify over a
+    parallel stream + one completeBatchVerify; here one padded device
+    dispatch via bls.batch_verify).  Use once per imported block; a
+    False batch_verify invalidates every optimistic True.
+    """
+
+    def __init__(self):
+        self._jobs: List[Triple] = []
+        self._complete = False
+
+    def verify(self, public_keys, message, signature) -> bool:
+        assert not self._complete, "verifier already completed"
+        if not public_keys:
+            return False
+        self._jobs.append((list(public_keys), message, signature))
+        return True
+
+    def batch_verify(self) -> bool:
+        assert not self._complete, "verifier already completed"
+        self._complete = True
+        if not self._jobs:
+            return True
+        return bls.batch_verify(self._jobs)
+
+
+class AsyncSignatureVerifier:
+    """Async seam: the gossip-side interface the batching service
+    implements (reference AsyncBLSSignatureVerifier)."""
+
+    async def verify(self, public_keys: Sequence[bytes], message: bytes,
+                     signature: bytes) -> bool:
+        raise NotImplementedError
+
+    @staticmethod
+    def wrap(sync_verifier: SignatureVerifier) -> "AsyncSignatureVerifier":
+        return _WrappedAsync(sync_verifier)
+
+
+class _WrappedAsync(AsyncSignatureVerifier):
+    def __init__(self, inner: SignatureVerifier):
+        self._inner = inner
+
+    async def verify(self, public_keys, message, signature) -> bool:
+        return self._inner.verify(public_keys, message, signature)
+
+
+class ServiceAsyncSignatureVerifier(AsyncSignatureVerifier):
+    """Adapter onto AggregatingSignatureVerificationService (the TPU
+    batcher) — futures resolve when the device batch lands."""
+
+    def __init__(self, service):
+        self._service = service
+
+    async def verify(self, public_keys, message, signature) -> bool:
+        return await self._service.verify(
+            list(public_keys), message, signature)
+
+    async def verify_multi(self, triples: Sequence[Triple]) -> bool:
+        return await self._service.verify_multi(list(triples))
+
+
+class AsyncBatchSignatureVerifier:
+    """Collect-then-submit adapter: verify() records triples and returns
+    True; batch_verify() submits ALL collected triples as ONE atomic
+    task to the async delegate, so e.g. a SignedAggregateAndProof's
+    three signatures verify together or not at all (reference:
+    AsyncBatchBLSSignatureVerifier.java:24-60, used at
+    AggregateAttestationValidator.java:124-126,242).
+    """
+
+    def __init__(self, delegate: AsyncSignatureVerifier):
+        self._delegate = delegate
+        self._jobs: List[Triple] = []
+
+    def verify(self, public_keys, message, signature) -> bool:
+        self._jobs.append((list(public_keys), message, signature))
+        return True
+
+    async def batch_verify(self) -> bool:
+        if not self._jobs:
+            return True
+        if isinstance(self._delegate, ServiceAsyncSignatureVerifier):
+            return await self._delegate.verify_multi(self._jobs)
+        for pks, msg, sig in self._jobs:
+            if not await self._delegate.verify(pks, msg, sig):
+                return False
+        return True
