@@ -30,9 +30,13 @@ def get_matching_source_attestations(cfg, state, epoch):
 
 
 def get_matching_target_attestations(cfg, state, epoch):
+    src = get_matching_source_attestations(cfg, state, epoch)
+    if not src:
+        # avoid the boundary-root lookup (asserts when state.slot IS the
+        # epoch start, which pulled-up-tip evaluation can hit)
+        return ()
     root = H.get_block_root(cfg, state, epoch)
-    return tuple(a for a in get_matching_source_attestations(
-        cfg, state, epoch) if a.data.target.root == root)
+    return tuple(a for a in src if a.data.target.root == root)
 
 
 def get_matching_head_attestations(cfg, state, epoch):

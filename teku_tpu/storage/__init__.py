@@ -1,0 +1,8 @@
+"""Storage layer: fork-choice store, proto-array, chain data, KV.
+
+Reference: /root/reference/storage/ (Store.java, protoarray/,
+client/RecentChainData.java, server/ KV database).
+"""
+
+from .protoarray import ProtoArray, VoteTracker
+from .store import ForkChoiceError, Store

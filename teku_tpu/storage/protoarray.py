@@ -1,0 +1,237 @@
+"""Proto-array LMD-GHOST fork choice structure.
+
+Equivalent of the reference's ProtoArray (reference: storage/src/main/
+java/tech/pegasys/teku/storage/protoarray/ProtoArray.java, 759 LoC, and
+ProtoArrayScoreCalculator.java / VoteTracker.java): an append-only
+array of block nodes with parent indices, vote weights maintained by
+DELTAS (each validator's balance moves from its old target to its new
+target, then deltas back-propagate in one reverse sweep), and
+best_child/best_descendant pointers so find_head is O(1) after each
+O(n) apply pass.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class ProtoNode:
+    root: bytes
+    parent: Optional[int]
+    justified_epoch: int
+    finalized_epoch: int
+    slot: int = 0
+    weight: int = 0
+    best_child: Optional[int] = None
+    best_descendant: Optional[int] = None
+
+
+@dataclass
+class VoteTracker:
+    """Per-validator latest message (reference VoteTracker.java)."""
+    current_root: bytes = b"\x00" * 32
+    next_root: bytes = b"\x00" * 32
+    next_epoch: int = 0
+
+
+class ProtoArray:
+    def __init__(self, justified_epoch: int = 0, finalized_epoch: int = 0):
+        self.nodes: List[ProtoNode] = []
+        self.indices: Dict[bytes, int] = {}
+        self.justified_epoch = justified_epoch
+        self.finalized_epoch = finalized_epoch
+        self.votes: Dict[int, VoteTracker] = {}
+        self.balances: List[int] = []
+        # proposer boost: one boosted root per slot, cleared on tick
+        self.proposer_boost_root: bytes = b"\x00" * 32
+        self.proposer_boost_amount: int = 0
+
+    # ------------------------------------------------------------------
+    def contains(self, root: bytes) -> bool:
+        return root in self.indices
+
+    def on_block(self, slot: int, root: bytes, parent_root: bytes,
+                 justified_epoch: int, finalized_epoch: int) -> None:
+        if root in self.indices:
+            return
+        parent = self.indices.get(parent_root)
+        idx = len(self.nodes)
+        self.nodes.append(ProtoNode(
+            root=root, parent=parent, justified_epoch=justified_epoch,
+            finalized_epoch=finalized_epoch, slot=slot))
+        self.indices[root] = idx
+        if parent is not None:
+            self._maybe_update_best_child_and_descendant(parent, idx)
+
+    # ------------------------------------------------------------------
+    def process_attestation(self, validator_index: int, block_root: bytes,
+                            target_epoch: int) -> None:
+        vote = self.votes.get(validator_index)
+        if vote is None:
+            # a first vote is always accepted (spec update_latest_messages:
+            # "i not in store.latest_messages"), including target epoch 0
+            self.votes[validator_index] = VoteTracker(
+                next_root=block_root, next_epoch=target_epoch)
+        elif target_epoch > vote.next_epoch:
+            vote.next_root = block_root
+            vote.next_epoch = target_epoch
+
+    # ------------------------------------------------------------------
+    def find_head(self, justified_root: bytes,
+                  justified_epoch: int, finalized_epoch: int,
+                  justified_balances: List[int],
+                  current_epoch: int) -> bytes:
+        """Apply pending vote deltas and walk best_descendant from the
+        justified root (reference ForkChoiceStrategy.findHead →
+        protoArray.applyScoreChanges + node walk)."""
+        self.justified_epoch = justified_epoch
+        self.finalized_epoch = finalized_epoch
+        self._current_epoch = current_epoch
+        deltas = self._compute_deltas(justified_balances)
+        self._apply_score_changes(deltas)
+        self.balances = list(justified_balances)
+        idx = self.indices.get(justified_root)
+        if idx is None:
+            raise KeyError(f"unknown justified root {justified_root.hex()}")
+        node = self.nodes[idx]
+        best = node.best_descendant
+        head = self.nodes[best] if best is not None else node
+        return head.root
+
+    # ------------------------------------------------------------------
+    def _compute_deltas(self, new_balances: List[int]) -> List[int]:
+        """Move each changed vote's weight old→new (reference
+        ProtoArrayScoreCalculator.computeDeltas)."""
+        deltas = [0] * len(self.nodes)
+        old_balances = self.balances
+        for vi, vote in self.votes.items():
+            old_bal = old_balances[vi] if vi < len(old_balances) else 0
+            new_bal = new_balances[vi] if vi < len(new_balances) else 0
+            if (vote.current_root != vote.next_root
+                    or old_bal != new_bal):
+                i = self.indices.get(vote.current_root)
+                if i is not None:
+                    deltas[i] -= old_bal
+                j = self.indices.get(vote.next_root)
+                if j is not None:
+                    deltas[j] += new_bal
+                vote.current_root = vote.next_root
+        return deltas
+
+    def set_proposer_boost(self, root: bytes, amount: int) -> None:
+        self.proposer_boost_root = root
+        self.proposer_boost_amount = amount
+
+    def clear_proposer_boost(self) -> None:
+        self.proposer_boost_root = b"\x00" * 32
+        self.proposer_boost_amount = 0
+
+    def _apply_score_changes(self, deltas: List[int]) -> None:
+        """One reverse sweep: add each node's delta (+transient proposer
+        boost), bubble into the parent delta, refresh best pointers
+        (reference ProtoArray.applyScoreChanges)."""
+        boost_idx = self.indices.get(self.proposer_boost_root)
+        for idx in range(len(self.nodes) - 1, -1, -1):
+            node = self.nodes[idx]
+            delta = deltas[idx]
+            node.weight += delta
+            if node.parent is not None:
+                deltas[node.parent] += delta
+        # proposer boost is transient: undo last round's boost, apply
+        # this round's (the delta model is add-once, boosts are per-slot)
+        prev = getattr(self, "_applied_boost", None)
+        if prev is not None:
+            p_idx, p_amt = prev
+            self._bubble_weight(p_idx, -p_amt)
+            self._applied_boost = None
+        if boost_idx is not None and self.proposer_boost_amount:
+            self._bubble_weight(boost_idx, self.proposer_boost_amount)
+            self._applied_boost = (boost_idx, self.proposer_boost_amount)
+        for idx in range(len(self.nodes) - 1, -1, -1):
+            node = self.nodes[idx]
+            if node.parent is not None:
+                self._maybe_update_best_child_and_descendant(
+                    node.parent, idx)
+
+    def _bubble_weight(self, idx: int, amount: int) -> None:
+        i: Optional[int] = idx
+        while i is not None:
+            self.nodes[i].weight += amount
+            i = self.nodes[i].parent
+
+    # ------------------------------------------------------------------
+    def _node_is_viable_for_head(self, node: ProtoNode) -> bool:
+        """Voting-source viability (the modern lenient rule: the node's
+        justified epoch matches the store's, or is within 2 epochs of
+        the current epoch — spec filter_block_tree; reference
+        ProtoArray.nodeIsViableForHead)."""
+        current_epoch = getattr(self, "_current_epoch", None)
+        # finalized-descent is enforced at on_block admission, so only
+        # the justified voting-source condition filters here
+        return (self.justified_epoch == 0
+                or node.justified_epoch == self.justified_epoch
+                or (current_epoch is not None
+                    and node.justified_epoch + 2 >= current_epoch))
+
+    def _leads_to_viable_head(self, node: ProtoNode) -> bool:
+        if (node.best_descendant is not None):
+            return self._node_is_viable_for_head(
+                self.nodes[node.best_descendant])
+        return self._node_is_viable_for_head(node)
+
+    def _maybe_update_best_child_and_descendant(self, parent_idx: int,
+                                                child_idx: int) -> None:
+        child = self.nodes[child_idx]
+        parent = self.nodes[parent_idx]
+        child_leads = self._leads_to_viable_head(child)
+        child_best = (child.best_descendant
+                      if child.best_descendant is not None else child_idx)
+
+        if parent.best_child == child_idx:
+            if not child_leads:
+                parent.best_child = None
+                parent.best_descendant = None
+            else:
+                parent.best_descendant = child_best
+            return
+        if parent.best_child is None:
+            if child_leads:
+                parent.best_child = child_idx
+                parent.best_descendant = child_best
+            return
+        best = self.nodes[parent.best_child]
+        best_leads = self._leads_to_viable_head(best)
+        if child_leads and not best_leads:
+            take = True
+        elif not child_leads:
+            take = False
+        else:
+            cw, bw = child.weight, best.weight
+            if cw != bw:
+                take = cw > bw
+            else:  # tie-break on root bytes (reference: compareTo)
+                take = child.root > best.root
+        if take:
+            parent.best_child = child_idx
+            parent.best_descendant = child_best
+
+    # ------------------------------------------------------------------
+    def is_descendant(self, ancestor_root: bytes, root: bytes) -> bool:
+        a = self.indices.get(ancestor_root)
+        i = self.indices.get(root)
+        if a is None or i is None:
+            return False
+        while i is not None and i >= a:
+            if i == a:
+                return True
+            i = self.nodes[i].parent
+        return False
+
+    def ancestor_at_slot(self, root: bytes, slot: int) -> Optional[bytes]:
+        i = self.indices.get(root)
+        while i is not None:
+            node = self.nodes[i]
+            if node.slot <= slot:
+                return node.root
+            i = node.parent
+        return None

@@ -1,0 +1,266 @@
+"""Fork-choice store + spec on_tick/on_block/on_attestation rules.
+
+Equivalent of the reference's Store + ForkChoice pair (reference:
+storage/src/main/java/tech/pegasys/teku/storage/store/Store.java and
+ethereum/statetransition/src/main/java/tech/pegasys/teku/
+statetransition/forkchoice/ForkChoice.java:213-520, with the spec rules
+from ethereum/spec/.../logic/common/util/ForkChoiceUtil.java): holds
+blocks, states, checkpoints and votes; admits blocks via the full state
+transition; answers get_head through the proto-array.
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+from ..spec.config import SpecConfig
+from ..spec.datastructures import Checkpoint, get_schemas
+from ..spec import epoch as E
+from ..spec import helpers as H
+from ..spec.transition import (process_slots, state_transition,
+                               StateTransitionError)
+from .protoarray import ProtoArray
+
+INTERVALS_PER_SLOT = 3
+
+
+class ForkChoiceError(Exception):
+    """Block/attestation rejected by fork-choice rules."""
+
+
+class Store:
+    """get_forkchoice_store(anchor_state, anchor_block) (spec)."""
+
+    def __init__(self, cfg: SpecConfig, anchor_state, anchor_block,
+                 proposer_boost_enabled: bool = True):
+        self.cfg = cfg
+        anchor_root = anchor_block.htr()
+        assert anchor_block.state_root == anchor_state.htr()
+        anchor_epoch = H.get_current_epoch(cfg, anchor_state)
+        self.time = (anchor_state.genesis_time
+                     + cfg.SECONDS_PER_SLOT * anchor_state.slot)
+        self.genesis_time = anchor_state.genesis_time
+        self.justified_checkpoint = Checkpoint(epoch=anchor_epoch,
+                                               root=anchor_root)
+        self.finalized_checkpoint = Checkpoint(epoch=anchor_epoch,
+                                               root=anchor_root)
+        self.proposer_boost_enabled = proposer_boost_enabled
+        self.blocks: Dict[bytes, object] = {anchor_root: anchor_block}
+        self.block_states: Dict[bytes, object] = {anchor_root: anchor_state}
+        self.checkpoint_states: Dict[Tuple[int, bytes], object] = {
+            (anchor_epoch, anchor_root): anchor_state}
+        # per-block unrealized checkpoints (pulled-up tips)
+        self.unrealized_justifications: Dict[bytes, Checkpoint] = {
+            anchor_root: self.justified_checkpoint}
+        self.proto = ProtoArray(anchor_epoch, anchor_epoch)
+        self.proto.on_block(anchor_block.slot, anchor_root,
+                            b"\x00" * 32, anchor_epoch, anchor_epoch)
+        self._equivocating: set = set()
+
+    # ------------------------------------------------------------------
+    @property
+    def current_slot(self) -> int:
+        return (self.time - self.genesis_time) // self.cfg.SECONDS_PER_SLOT
+
+    def current_epoch(self) -> int:
+        return H.compute_epoch_at_slot(self.cfg, self.current_slot)
+
+    def get_checkpoint_state(self, checkpoint: Checkpoint):
+        """State advanced to the checkpoint epoch start (spec
+        store.checkpoint_states; used for attestation validation and
+        justified balances)."""
+        key = (checkpoint.epoch, checkpoint.root)
+        state = self.checkpoint_states.get(key)
+        if state is None:
+            base = self.block_states.get(checkpoint.root)
+            if base is None:
+                raise ForkChoiceError("unknown checkpoint root")
+            target_slot = H.compute_start_slot_at_epoch(
+                self.cfg, checkpoint.epoch)
+            if base.slot < target_slot:
+                base = process_slots(self.cfg, base, target_slot)
+            self.checkpoint_states[key] = base
+            state = base
+        return state
+
+    # ------------------------------------------------------------------
+    # on_tick
+    # ------------------------------------------------------------------
+
+    def on_tick(self, time: int) -> None:
+        prev_slot = self.current_slot
+        if time < self.time:
+            return
+        self.time = time
+        if self.current_slot > prev_slot:
+            self.proto.clear_proposer_boost()
+
+    def on_slot_start(self) -> None:
+        self.proto.clear_proposer_boost()
+
+    # ------------------------------------------------------------------
+    # on_block
+    # ------------------------------------------------------------------
+
+    def on_block(self, signed_block, validate_signatures: bool = True):
+        """Admit a block: parent known, not from the future, descends
+        from finalized; full (batched-signature) state transition; then
+        checkpoint bookkeeping + proto-array insert.  Returns the post
+        state (reference ForkChoice.onBlock → spec on_block)."""
+        block = signed_block.message
+        parent_root = block.parent_root
+        pre_state = self.block_states.get(parent_root)
+        if pre_state is None:
+            raise ForkChoiceError("unknown parent")
+        if self.current_slot < block.slot:
+            raise ForkChoiceError("block from the future")
+        finalized_slot = H.compute_start_slot_at_epoch(
+            self.cfg, self.finalized_checkpoint.epoch)
+        if block.slot <= finalized_slot:
+            raise ForkChoiceError("block slot not after finalized")
+        if self.proto.ancestor_at_slot(
+                parent_root, finalized_slot) != self.finalized_checkpoint.root:
+            raise ForkChoiceError("block does not descend from finalized")
+
+        root = block.htr()
+        if root in self.blocks:
+            return self.block_states[root]
+
+        try:
+            post = state_transition(self.cfg, pre_state, signed_block,
+                                    validate_result=validate_signatures)
+        except StateTransitionError as exc:
+            raise ForkChoiceError(f"invalid block: {exc}") from exc
+
+        self.blocks[root] = block
+        self.block_states[root] = post
+
+        # proposer boost (spec: if within the first interval of the slot)
+        time_into_slot = ((self.time - self.genesis_time)
+                          % self.cfg.SECONDS_PER_SLOT)
+        if (self.proposer_boost_enabled
+                and self.current_slot == block.slot
+                and time_into_slot
+                < self.cfg.SECONDS_PER_SLOT // INTERVALS_PER_SLOT):
+            committee_weight = (
+                H.get_total_active_balance(self.cfg, post)
+                // self.cfg.SLOTS_PER_EPOCH)
+            boost = (committee_weight
+                     * self.cfg.PROPOSER_SCORE_BOOST) // 100
+            self.proto.set_proposer_boost(root, boost)
+
+        # pulled-up justification: run epoch accounting on the post
+        # state to expose justification the chain has earned but not yet
+        # processed (modern spec compute_pulled_up_tip; the reference's
+        # protoarray stores the same per-node "unrealized" checkpoints)
+        unrealized = E.process_justification_and_finalization(
+            self.cfg, post)
+        uj = unrealized.current_justified_checkpoint
+        uf = unrealized.finalized_checkpoint
+        self.unrealized_justifications[root] = uj
+
+        block_epoch = H.compute_epoch_at_slot(self.cfg, block.slot)
+        if block_epoch < self.current_epoch():
+            # block from a prior epoch: unrealized counts immediately
+            self._update_checkpoints(uj, uf)
+        else:
+            self._update_checkpoints(post.current_justified_checkpoint,
+                                      post.finalized_checkpoint)
+
+        self.proto.on_block(
+            block.slot, root, parent_root,
+            self.unrealized_justifications[root].epoch
+            if block_epoch < self.current_epoch()
+            else post.current_justified_checkpoint.epoch,
+            post.finalized_checkpoint.epoch)
+
+        # votes carried inside the block count for fork choice
+        # (reference ForkChoice.applyIndexedAttestations; signatures
+        # were already settled by the block's own batch verification)
+        for att in block.body.attestations:
+            try:
+                indexed = H.get_indexed_attestation(self.cfg, post, att)
+                self.on_attestation(att, is_from_block=True,
+                                    indexed=indexed)
+            except (ForkChoiceError, AssertionError):
+                continue
+        return post
+
+    def _update_checkpoints(self, justified: Checkpoint,
+                            finalized: Checkpoint) -> None:
+        if justified.epoch > self.justified_checkpoint.epoch:
+            self.justified_checkpoint = justified
+        if finalized.epoch > self.finalized_checkpoint.epoch:
+            self.finalized_checkpoint = finalized
+
+    # ------------------------------------------------------------------
+    # on_attestation
+    # ------------------------------------------------------------------
+
+    def on_attestation(self, attestation, is_from_block: bool = False,
+                       indexed=None):
+        """Spec on_attestation: validate slot/target/block linkage, then
+        record latest messages.  The caller provides the indexed form
+        when it already computed it (gossip path); otherwise it is
+        derived from the target checkpoint state."""
+        data = attestation.data
+        target = data.target
+        if not is_from_block:
+            cur = self.current_epoch()
+            prev = cur - 1 if cur > 0 else 0
+            if target.epoch not in (cur, prev):
+                raise ForkChoiceError("attestation target epoch not current/previous")
+            if data.slot + 1 > self.current_slot:
+                raise ForkChoiceError("attestation from the future")
+        if target.epoch != H.compute_epoch_at_slot(self.cfg, data.slot):
+            raise ForkChoiceError("attestation target/slot mismatch")
+        if target.root not in self.blocks:
+            raise ForkChoiceError("unknown target root")
+        if data.beacon_block_root not in self.blocks:
+            raise ForkChoiceError("unknown head block")
+        if self.blocks[data.beacon_block_root].slot > data.slot:
+            raise ForkChoiceError("attestation for block newer than slot")
+        # LMD vote must be consistent with target
+        expected = self.proto.ancestor_at_slot(
+            data.beacon_block_root,
+            H.compute_start_slot_at_epoch(self.cfg, target.epoch))
+        if expected != target.root:
+            raise ForkChoiceError("head block not descendant of target")
+
+        if indexed is None:
+            target_state = self.get_checkpoint_state(target)
+            try:
+                if (data.index >= H.get_committee_count_per_slot(
+                        self.cfg, target_state, target.epoch)):
+                    raise ForkChoiceError("committee index out of range")
+                indexed = H.get_indexed_attestation(
+                    self.cfg, target_state, attestation)
+            except AssertionError as exc:
+                raise ForkChoiceError(f"malformed attestation: {exc}") from exc
+            # spec on_attestation: the indexed attestation must carry a
+            # valid aggregate signature (gossip pre-validation in the
+            # node feeds `indexed` instead and skips the re-check)
+            from ..spec.block import is_valid_indexed_attestation
+            from ..spec.verifiers import SIMPLE
+            if not is_valid_indexed_attestation(
+                    self.cfg, target_state, indexed, SIMPLE):
+                raise ForkChoiceError("invalid indexed attestation")
+        for vi in indexed.attesting_indices:
+            if vi not in self._equivocating:
+                self.proto.process_attestation(
+                    vi, data.beacon_block_root, target.epoch)
+
+    # ------------------------------------------------------------------
+    def get_head(self) -> bytes:
+        justified_state = self.get_checkpoint_state(
+            self.justified_checkpoint)
+        balances = [
+            v.effective_balance if H.is_active_validator(
+                v, H.get_current_epoch(self.cfg, justified_state)) else 0
+            for v in justified_state.validators]
+        return self.proto.find_head(
+            self.justified_checkpoint.root,
+            self.justified_checkpoint.epoch,
+            self.finalized_checkpoint.epoch,
+            balances, self.current_epoch())
+
+    def get_head_state(self):
+        return self.block_states[self.get_head()]
