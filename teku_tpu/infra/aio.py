@@ -1,0 +1,130 @@
+"""Async primitives: the node's concurrency discipline.
+
+Equivalent of the reference's infrastructure/async module (reference:
+infrastructure/async/src/main/java/tech/pegasys/teku/infrastructure/
+async/SafeFuture.java, ThrottlingTaskQueue.java, eventthread/
+EventThread.java): everything runs as awaitables on ONE asyncio loop
+(the analogue of the reference's named runners + event-thread
+confinement), with throttling queues for bounded concurrency and an
+ordered queue for single-writer subsystems like fork choice.
+"""
+
+import asyncio
+import logging
+import time
+from typing import Awaitable, Callable, Optional, TypeVar
+
+_LOG = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+
+def finish(awaitable: Awaitable, error_msg: str = "task failed") -> asyncio.Task:
+    """Fire-and-forget with error channeling — the reference's
+    SafeFuture.finish(err -> LOG) idiom: failures are logged, never
+    silently dropped."""
+    task = asyncio.ensure_future(awaitable)
+
+    def _done(t: asyncio.Task):
+        if not t.cancelled() and t.exception() is not None:
+            _LOG.error("%s: %r", error_msg, t.exception())
+    task.add_done_callback(_done)
+    return task
+
+
+class ThrottlingTaskQueue:
+    """At most `limit` tasks in flight; the rest queue (reference
+    ThrottlingTaskQueue.java — used to bound state regeneration etc.)."""
+
+    def __init__(self, limit: int, name: str = "queue"):
+        self._sem = asyncio.Semaphore(limit)
+        self.name = name
+        self.queued = 0
+
+    async def run(self, fn: Callable[[], Awaitable[T]]) -> T:
+        self.queued += 1
+        try:
+            async with self._sem:
+                return await fn()
+        finally:
+            self.queued -= 1
+
+
+class OrderedTaskQueue:
+    """Strictly serialized execution — the single-writer discipline the
+    reference enforces with its fork-choice EventThread (reference:
+    infrastructure/async/eventthread/EventThread.java); here a lock on
+    the one loop plus an owner assert for checkOnEventThread parity."""
+
+    def __init__(self, name: str = "ordered"):
+        self._lock = asyncio.Lock()
+        self.name = name
+        self._owner: Optional[asyncio.Task] = None
+
+    async def run(self, fn: Callable[[], Awaitable[T]]) -> T:
+        async with self._lock:
+            self._owner = asyncio.current_task()
+            try:
+                return await fn()
+            finally:
+                self._owner = None
+
+    def check_in_queue(self) -> None:
+        assert self._owner is asyncio.current_task(), (
+            f"not running inside ordered queue {self.name}")
+
+
+class RepeatingTask:
+    """Fixed-interval async timer (reference: RepeatingTaskScheduler /
+    the quartz TimerService driving slot events)."""
+
+    def __init__(self, interval_s: float,
+                 fn: Callable[[], Awaitable[None]],
+                 name: str = "repeating"):
+        self.interval_s = interval_s
+        self.fn = fn
+        self.name = name
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop(), name=self.name)
+
+    async def _loop(self) -> None:
+        while True:
+            t0 = time.monotonic()
+            try:
+                await self.fn()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                _LOG.exception("%s tick failed", self.name)
+            elapsed = time.monotonic() - t0
+            await asyncio.sleep(max(0.0, self.interval_s - elapsed))
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+
+async def retry_with_backoff(fn: Callable[[], Awaitable[T]],
+                             attempts: int = 3, base_delay_s: float = 0.5,
+                             what: str = "operation") -> T:
+    """Bounded exponential-backoff retry (the reference's
+    FailedExecutionPool / RetryingStorageUpdateChannel pattern)."""
+    last: Optional[BaseException] = None
+    for i in range(attempts):
+        try:
+            return await fn()
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            last = exc
+            if i + 1 < attempts:
+                await asyncio.sleep(base_delay_s * (2 ** i))
+    raise RuntimeError(f"{what} failed after {attempts} attempts") from last

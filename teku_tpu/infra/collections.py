@@ -1,0 +1,68 @@
+"""Bounded collections: LRU-limited set and map.
+
+Equivalent of the reference's LimitedSet/LimitedMap (reference:
+infrastructure/collections/src/main/java/tech/pegasys/teku/
+infrastructure/collections/LimitedSet.java, LimitedMap.java) — the
+containers behind every seen-message cache, sized so long-running nodes
+cannot grow without bound.
+"""
+
+from collections import OrderedDict
+from typing import Generic, Hashable, Iterator, Optional, TypeVar
+
+K = TypeVar("K", bound=Hashable)
+V = TypeVar("V")
+
+
+class LimitedSet(Generic[K]):
+    def __init__(self, max_size: int):
+        assert max_size > 0
+        self._max = max_size
+        self._items: "OrderedDict[K, None]" = OrderedDict()
+
+    def add(self, item: K) -> bool:
+        """Returns True if newly added (touches LRU order either way)."""
+        if item in self._items:
+            self._items.move_to_end(item)
+            return False
+        self._items[item] = None
+        if len(self._items) > self._max:
+            self._items.popitem(last=False)
+        return True
+
+    def __contains__(self, item: K) -> bool:
+        return item in self._items
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def discard(self, item: K) -> None:
+        self._items.pop(item, None)
+
+
+class LimitedMap(Generic[K, V]):
+    def __init__(self, max_size: int):
+        assert max_size > 0
+        self._max = max_size
+        self._items: "OrderedDict[K, V]" = OrderedDict()
+
+    def get(self, key: K, default: Optional[V] = None) -> Optional[V]:
+        if key in self._items:
+            self._items.move_to_end(key)
+            return self._items[key]
+        return default
+
+    def put(self, key: K, value: V) -> None:
+        self._items[key] = value
+        self._items.move_to_end(key)
+        if len(self._items) > self._max:
+            self._items.popitem(last=False)
+
+    def __contains__(self, key: K) -> bool:
+        return key in self._items
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __iter__(self) -> Iterator[K]:
+        return iter(self._items)

@@ -1,0 +1,98 @@
+"""Typed in-process event channels — the node's message bus.
+
+Equivalent of the reference's EventChannels dynamic-proxy pub/sub
+(reference: infrastructure/events/src/main/java/tech/pegasys/teku/
+infrastructure/events/EventChannels.java and EventChannel.java:58-142):
+a channel is declared as a Python Protocol-style class; `publisher()`
+returns a proxy whose method calls fan out to every subscriber, either
+synchronously (DirectEventDeliverer) or queued onto the event loop
+(AsyncEventDeliverer).  Errors in one subscriber never break the
+publisher or other subscribers.
+"""
+
+import asyncio
+import inspect
+import logging
+from typing import Callable, Dict, List, Optional, Type, TypeVar
+
+_LOG = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+
+class _Proxy:
+    def __init__(self, channels: "EventChannels", iface: type,
+                 async_delivery: bool):
+        self._channels = channels
+        self._iface = iface
+        self._async = async_delivery
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if not hasattr(self._iface, name):
+            raise AttributeError(
+                f"{self._iface.__name__} has no event {name}")
+
+        def dispatch(*args, **kwargs):
+            subs = self._channels._subscribers.get(self._iface, [])
+            for sub in list(subs):
+                fn = getattr(sub, name, None)
+                if fn is None:
+                    continue
+                if self._async:
+                    loop = self._channels._loop or asyncio.get_event_loop()
+                    loop.call_soon_threadsafe(
+                        _safe_call, fn, args, kwargs)
+                else:
+                    _safe_call(fn, args, kwargs)
+        return dispatch
+
+
+def _safe_call(fn: Callable, args, kwargs) -> None:
+    try:
+        result = fn(*args, **kwargs)
+        if inspect.iscoroutine(result):
+            asyncio.ensure_future(result)
+    except Exception:
+        _LOG.exception("event subscriber %r failed", fn)
+
+
+class EventChannels:
+    """Registry of channel interfaces → subscriber lists."""
+
+    def __init__(self, loop: Optional[asyncio.AbstractEventLoop] = None):
+        self._subscribers: Dict[type, List[object]] = {}
+        self._loop = loop
+
+    def subscribe(self, iface: Type[T], subscriber: T) -> "EventChannels":
+        self._subscribers.setdefault(iface, []).append(subscriber)
+        return self
+
+    def publisher(self, iface: Type[T], async_delivery: bool = False) -> T:
+        return _Proxy(self, iface, async_delivery)  # type: ignore
+
+
+# ---- standard channel interfaces (reference: *Channel interfaces) ----
+
+class SlotEventsChannel:
+    """reference: ethereum/statetransition SlotEventsChannel."""
+
+    def on_slot(self, slot: int) -> None: ...
+
+
+class FinalizedCheckpointChannel:
+    def on_new_finalized_checkpoint(self, checkpoint, from_optimistic_api=False) -> None: ...
+
+
+class ChainHeadChannel:
+    def on_chain_head_updated(self, slot: int, root: bytes,
+                              reorg: bool) -> None: ...
+
+
+class BlockImportChannel:
+    def on_block_imported(self, signed_block, post_state) -> None: ...
+
+
+class AttestationReceivedChannel:
+    def on_attestation(self, attestation) -> None: ...

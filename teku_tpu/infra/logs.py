@@ -1,0 +1,45 @@
+"""Curated operator-facing loggers.
+
+Equivalent of the reference's logging module (reference: infrastructure/
+logging/src/main/java/tech/pegasys/teku/infrastructure/logging/
+StatusLogger.java, EventLogger.java, ValidatorLogger.java): named
+channels with consistent, human-scannable slot/epoch event lines, on
+top of stdlib logging so operators configure handlers normally.
+"""
+
+import logging
+
+STATUS = logging.getLogger("teku_tpu.status")
+EVENTS = logging.getLogger("teku_tpu.events")
+VALIDATOR = logging.getLogger("teku_tpu.validator")
+P2P = logging.getLogger("teku_tpu.p2p")
+
+
+def configure(level: int = logging.INFO) -> None:
+    """Console setup with the reference's log line flavor."""
+    root = logging.getLogger()
+    if root.handlers:
+        root.setLevel(level)
+        return
+    handler = logging.StreamHandler()
+    handler.setFormatter(logging.Formatter(
+        "%(asctime)s | %(levelname)-5s | %(name)s | %(message)s",
+        datefmt="%H:%M:%S"))
+    root.addHandler(handler)
+    root.setLevel(level)
+
+
+def log_slot_event(slot: int, epoch: int, head_root: bytes,
+                   justified_epoch: int, finalized_epoch: int,
+                   peers: int = 0) -> None:
+    """reference EventLogger.epochEvent/slotEvent format."""
+    EVENTS.info(
+        "Slot Event  *** Slot: %d, Block: %s, Justified: %d, "
+        "Finalized: %d, Peers: %d (epoch %d)",
+        slot, head_root.hex()[:16], justified_epoch, finalized_epoch,
+        peers, epoch)
+
+
+def log_finalized(epoch: int, root: bytes) -> None:
+    EVENTS.info("Finalized checkpoint updated *** Epoch: %d, Root: %s",
+                epoch, root.hex()[:16])

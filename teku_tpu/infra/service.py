@@ -1,0 +1,85 @@
+"""Service lifecycle: start/stop state machine + ordered controller.
+
+Equivalent of the reference's serviceutils (reference: infrastructure/
+serviceutils/src/main/java/tech/pegasys/teku/service/serviceutils/
+Service.java and teku/.../services/BeaconNodeServiceController.java:
+41-101): a Service moves IDLE → RUNNING → STOPPED exactly once; the
+controller starts services in declaration order and stops them in
+reverse, so e.g. storage outlives everything that writes to it.
+"""
+
+import asyncio
+import enum
+import logging
+from typing import List
+
+_LOG = logging.getLogger(__name__)
+
+
+class ServiceState(enum.Enum):
+    IDLE = "idle"
+    RUNNING = "running"
+    STOPPED = "stopped"
+
+
+class Service:
+    """Subclasses implement do_start / do_stop."""
+
+    def __init__(self, name: str = None):
+        self.name = name or type(self).__name__
+        self.state = ServiceState.IDLE
+
+    async def start(self) -> None:
+        if self.state is not ServiceState.IDLE:
+            raise RuntimeError(f"{self.name} already {self.state.value}")
+        await self.do_start()
+        self.state = ServiceState.RUNNING
+        _LOG.info("service %s started", self.name)
+
+    async def stop(self) -> None:
+        if self.state is not ServiceState.RUNNING:
+            return
+        self.state = ServiceState.STOPPED
+        await self.do_stop()
+        _LOG.info("service %s stopped", self.name)
+
+    @property
+    def is_running(self) -> bool:
+        return self.state is ServiceState.RUNNING
+
+    async def do_start(self) -> None:  # pragma: no cover - interface
+        pass
+
+    async def do_stop(self) -> None:  # pragma: no cover - interface
+        pass
+
+
+class ServiceController(Service):
+    """Starts children in order, stops in reverse (reference
+    BeaconNodeServiceController: Storage → ExecutionLayer → BeaconChain
+    → Nat → Powchain → ValidatorClient)."""
+
+    def __init__(self, services: List[Service], name: str = "controller"):
+        super().__init__(name)
+        self.services = list(services)
+
+    async def do_start(self) -> None:
+        started = []
+        try:
+            for svc in self.services:
+                await svc.start()
+                started.append(svc)
+        except Exception:
+            for svc in reversed(started):
+                try:
+                    await svc.stop()
+                except Exception:  # best-effort unwind
+                    _LOG.exception("unwinding %s failed", svc.name)
+            raise
+
+    async def do_stop(self) -> None:
+        for svc in reversed(self.services):
+            try:
+                await svc.stop()
+            except Exception:
+                _LOG.exception("stopping %s failed", svc.name)

@@ -1,0 +1,12 @@
+"""Node runtime: chain data, gossip, validators, managers, wiring.
+
+Reference: /root/reference/services/beaconchain/ +
+/root/reference/ethereum/statetransition/.
+"""
+
+from .chaindata import RecentChainData
+from .devnet import Devnet
+from .gossip import InMemoryGossipNetwork, TopicHandler, ValidationResult
+from .managers import AttestationManager, BlockManager
+from .node import BeaconNode, InProcessValidatorClient
+from .pool import AggregatingAttestationPool

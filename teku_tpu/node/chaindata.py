@@ -1,0 +1,76 @@
+"""RecentChainData: the chain façade every component queries.
+
+Equivalent of the reference's RecentChainData/CombinedChainDataClient
+(reference: storage/src/main/java/tech/pegasys/teku/storage/client/
+RecentChainData.java): head/justified/finalized views over the
+fork-choice store, block and state lookup, and head-update events.
+"""
+
+from typing import Optional
+
+from ..infra.events import (ChainHeadChannel, EventChannels,
+                            FinalizedCheckpointChannel)
+from ..spec import Spec
+from ..storage.store import Store
+
+
+class RecentChainData:
+    def __init__(self, spec: Spec, store: Store,
+                 channels: Optional[EventChannels] = None):
+        self.spec = spec
+        self.store = store
+        self._channels = channels or EventChannels()
+        self._head_root: bytes = store.justified_checkpoint.root
+        self._finalized_epoch = store.finalized_checkpoint.epoch
+
+    # -- queries -------------------------------------------------------
+    @property
+    def head_root(self) -> bytes:
+        return self._head_root
+
+    def head_state(self):
+        return self.store.block_states[self._head_root]
+
+    def head_slot(self) -> int:
+        return self.store.blocks[self._head_root].slot
+
+    def current_slot(self) -> int:
+        return self.store.current_slot
+
+    def get_block(self, root: bytes):
+        return self.store.blocks.get(root)
+
+    def get_state(self, root: bytes):
+        return self.store.block_states.get(root)
+
+    def contains_block(self, root: bytes) -> bool:
+        return root in self.store.blocks
+
+    @property
+    def justified_checkpoint(self):
+        return self.store.justified_checkpoint
+
+    @property
+    def finalized_checkpoint(self):
+        return self.store.finalized_checkpoint
+
+    def genesis_time(self) -> int:
+        return self.store.genesis_time
+
+    # -- updates -------------------------------------------------------
+    def update_head(self) -> bytes:
+        """Recompute head via fork choice; emit events on change
+        (reference RecentChainData.updateHead)."""
+        new_head = self.store.get_head()
+        if new_head != self._head_root:
+            old = self._head_root
+            self._head_root = new_head
+            reorg = not self.store.proto.is_descendant(old, new_head)
+            self._channels.publisher(ChainHeadChannel).on_chain_head_updated(
+                self.store.blocks[new_head].slot, new_head, reorg)
+        if self.store.finalized_checkpoint.epoch > self._finalized_epoch:
+            self._finalized_epoch = self.store.finalized_checkpoint.epoch
+            self._channels.publisher(
+                FinalizedCheckpointChannel).on_new_finalized_checkpoint(
+                self.store.finalized_checkpoint)
+        return self._head_root

@@ -1,0 +1,80 @@
+"""In-process devnet: N nodes, loopback gossip, interop validators.
+
+The minimum end-to-end slice (SURVEY §7 stage 5): several BeaconNodes
+share an InMemoryGossipNetwork, interop validators split across them,
+every signature flows through each node's batching verification
+service, and the chain justifies + finalizes.  The reference's
+acceptance tests build the same topology with containers
+(acceptance-tests/.../dsl/TekuNode.java); here it is one process and a
+manually-advanced clock, which is what unit tests and the bench
+latency phase drive.
+"""
+
+import asyncio
+import logging
+from typing import Dict, List, Optional
+
+from ..infra.service import ServiceController
+from ..spec import create_spec, Spec
+from ..spec.genesis import interop_genesis
+from .gossip import InMemoryGossipNetwork
+from .node import BeaconNode, InProcessValidatorClient
+
+_LOG = logging.getLogger(__name__)
+
+
+class Devnet:
+    def __init__(self, n_nodes: int = 2, n_validators: int = 32,
+                 network: str = "minimal", genesis_time: int = 1578009600,
+                 spec: Optional[Spec] = None):
+        self.spec = spec or create_spec(network)
+        state, sks = interop_genesis(self.spec.config, n_validators,
+                                     genesis_time)
+        self.genesis_state = state
+        self.net = InMemoryGossipNetwork()
+        self.nodes: List[BeaconNode] = []
+        self.clients: List[InProcessValidatorClient] = []
+        for i in range(n_nodes):
+            node = BeaconNode(self.spec, state, self.net.endpoint(),
+                              name=f"node{i}")
+            keys = {v: sks[v] for v in range(n_validators)
+                    if v % n_nodes == i}
+            self.nodes.append(node)
+            self.clients.append(InProcessValidatorClient(node, keys))
+        self.controller = ServiceController(self.nodes, "devnet")
+
+    async def start(self) -> None:
+        await self.controller.start()
+
+    async def stop(self) -> None:
+        await self.controller.stop()
+
+    async def run_slot(self, slot: int) -> None:
+        """One full slot: tick everywhere, propose, attest, aggregate —
+        the three phases of the reference's SlotProcessor."""
+        for node in self.nodes:
+            node.on_slot(slot)
+        for client in self.clients:
+            await client.on_slot_start(slot)
+        for client in self.clients:
+            await client.on_attestation_due(slot)
+        for client in self.clients:
+            await client.on_aggregation_due(slot)
+
+    async def run_until_slot(self, last_slot: int,
+                             first_slot: int = 1) -> None:
+        for slot in range(first_slot, last_slot + 1):
+            await self.run_slot(slot)
+
+    # -- assertions/queries -------------------------------------------
+    def heads(self) -> List[bytes]:
+        return [n.chain.head_root for n in self.nodes]
+
+    def heads_converged(self) -> bool:
+        return len(set(self.heads())) == 1
+
+    def min_finalized_epoch(self) -> int:
+        return min(n.store.finalized_checkpoint.epoch for n in self.nodes)
+
+    def min_justified_epoch(self) -> int:
+        return min(n.store.justified_checkpoint.epoch for n in self.nodes)

@@ -1,0 +1,117 @@
+"""Gossip plumbing: topic handlers + an in-memory network.
+
+The transport-agnostic seam mirrors the reference's TopicHandler /
+GossipNetwork split (reference: networking/p2p/src/main/java/tech/
+pegasys/teku/networking/p2p/gossip/TopicHandler.java and networking/
+eth2/.../gossip/topics/topichandlers/Eth2TopicHandler.java:110-130):
+handlers receive raw SSZ payloads, decode, hand to an operation
+processor, and map the internal validation result to
+ACCEPT/IGNORE/REJECT, which the router uses for propagation — so the
+same handlers run unchanged over the in-memory bus (devnet/tests) and
+the TCP gossip transport (teku_tpu/networking).
+
+Topic names follow the consensus spec: beacon_block,
+beacon_attestation_{subnet}, beacon_aggregate_and_proof
+(GossipTopicName.java:18).
+"""
+
+import asyncio
+import enum
+import logging
+from typing import Awaitable, Callable, Dict, List, Optional
+
+_LOG = logging.getLogger(__name__)
+
+
+class ValidationResult(enum.Enum):
+    """reference: InternalValidationResult"""
+    ACCEPT = "accept"
+    IGNORE = "ignore"
+    SAVE_FOR_FUTURE = "save_for_future"
+    REJECT = "reject"
+
+
+class TopicHandler:
+    """Decodes + processes one topic's messages."""
+
+    async def handle_message(self, data: bytes) -> ValidationResult:
+        raise NotImplementedError
+
+
+class SszTopicHandler(TopicHandler):
+    """Decode SSZ then delegate (reference Eth2TopicHandler.handleMessage:
+    deserialize → async process → map result)."""
+
+    def __init__(self, schema, processor: Callable[[object],
+                                                   Awaitable[ValidationResult]],
+                 name: str = "topic"):
+        self.schema = schema
+        self.processor = processor
+        self.name = name
+
+    async def handle_message(self, data: bytes) -> ValidationResult:
+        try:
+            msg = self.schema.deserialize(data)
+        except Exception:
+            return ValidationResult.REJECT
+        try:
+            return await self.processor(msg)
+        except Exception:
+            _LOG.exception("processor for %s failed", self.name)
+            return ValidationResult.IGNORE
+
+
+class GossipNetwork:
+    """Transport interface: subscribe handlers, publish bytes."""
+
+    async def publish(self, topic: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def subscribe(self, topic: str, handler: TopicHandler) -> None:
+        raise NotImplementedError
+
+
+class InMemoryGossipNetwork(GossipNetwork):
+    """Loopback mesh for in-process devnets: publishing delivers to
+    every OTHER endpoint's handler; a message a peer REJECTs is not
+    re-propagated (gossipsub semantics, simplified to full-mesh).
+    The reference achieves the same test topology with real libp2p over
+    loopback (Eth2P2PNetworkFactory)."""
+
+    def __init__(self):
+        self._endpoints: List["InMemoryGossipEndpoint"] = []
+        self.messages_published = 0
+
+    def endpoint(self) -> "InMemoryGossipEndpoint":
+        ep = InMemoryGossipEndpoint(self)
+        self._endpoints.append(ep)
+        return ep
+
+    async def _deliver(self, origin, topic: str, data: bytes) -> None:
+        self.messages_published += 1
+        for ep in self._endpoints:
+            if ep is origin:
+                continue
+            handler = ep._handlers.get(topic)
+            if handler is not None:
+                await handler.handle_message(data)
+
+
+class InMemoryGossipEndpoint(GossipNetwork):
+    def __init__(self, net: InMemoryGossipNetwork):
+        self._net = net
+        self._handlers: Dict[str, TopicHandler] = {}
+
+    def subscribe(self, topic: str, handler: TopicHandler) -> None:
+        self._handlers[topic] = handler
+
+    async def publish(self, topic: str, data: bytes) -> None:
+        await self._net._deliver(self, topic, data)
+
+
+def attestation_subnet_topic(subnet_id: int) -> str:
+    return f"beacon_attestation_{subnet_id}"
+
+
+BEACON_BLOCK_TOPIC = "beacon_block"
+AGGREGATE_TOPIC = "beacon_aggregate_and_proof"

@@ -1,0 +1,129 @@
+"""Attestation/Block managers: pending pools + fork-choice application.
+
+Equivalent of the reference's AttestationManager and BlockManager
+(reference: ethereum/statetransition/src/main/java/tech/pegasys/teku/
+statetransition/attestation/AttestationManager.java:141-200 and
+statetransition/block/BlockManager.java:99-191): gossip-validated items
+flow into fork choice; items referencing unknown blocks wait in a
+pending pool keyed by the missing root; future-slot items wait in a
+future pool drained on slot ticks.
+"""
+
+import logging
+from collections import defaultdict
+from typing import Callable, Dict, List, Optional
+
+from ..infra.events import BlockImportChannel, EventChannels
+from ..spec import Spec
+from ..storage.store import ForkChoiceError
+from .chaindata import RecentChainData
+from .gossip import ValidationResult
+
+_LOG = logging.getLogger(__name__)
+
+
+class AttestationManager:
+    def __init__(self, spec: Spec, chain: RecentChainData,
+                 pool=None, max_pending: int = 4096):
+        self.spec = spec
+        self.chain = chain
+        self.pool = pool
+        self._pending_by_block: Dict[bytes, List] = defaultdict(list)
+        self._future_by_slot: Dict[int, List] = defaultdict(list)
+        self._max_pending = max_pending
+        self._n_pending = 0
+
+    def add_attestation(self, attestation) -> None:
+        """Apply a gossip-ACCEPTed attestation to fork choice; queue it
+        if its block is unknown or its slot not yet reached."""
+        data = attestation.data
+        if self.pool is not None:
+            self.pool.add(attestation)
+        if data.slot + 1 > self.chain.current_slot():
+            self._enqueue(self._future_by_slot[data.slot + 1], attestation)
+            return
+        if not self.chain.contains_block(data.beacon_block_root):
+            self._enqueue(self._pending_by_block[data.beacon_block_root],
+                          attestation)
+            return
+        self._apply(attestation)
+
+    def _enqueue(self, bucket: List, attestation) -> None:
+        if self._n_pending >= self._max_pending:
+            return  # shed under pressure (reference pools are bounded)
+        bucket.append(attestation)
+        self._n_pending += 1
+
+    def _apply(self, attestation) -> None:
+        try:
+            self.chain.store.on_attestation(attestation)
+        except ForkChoiceError as exc:
+            _LOG.debug("attestation dropped: %s", exc)
+
+    def on_slot(self, slot: int) -> None:
+        for s in [s for s in self._future_by_slot if s <= slot]:
+            for att in self._future_by_slot.pop(s):
+                self._n_pending -= 1
+                self.add_attestation(att)
+
+    def on_block_imported(self, block_root: bytes) -> None:
+        for att in self._pending_by_block.pop(block_root, ()):
+            self._n_pending -= 1
+            self.add_attestation(att)
+
+
+class BlockManager:
+    def __init__(self, spec: Spec, chain: RecentChainData,
+                 channels: Optional[EventChannels] = None,
+                 max_pending: int = 256):
+        self.spec = spec
+        self.chain = chain
+        self._channels = channels or EventChannels()
+        self._pending_by_parent: Dict[bytes, List] = defaultdict(list)
+        self._future_by_slot: Dict[int, List] = defaultdict(list)
+        self._max_pending = max_pending
+        self._n_pending = 0
+        self.on_imported: List[Callable[[bytes], None]] = []
+
+    def import_block(self, signed_block) -> bool:
+        """Import into fork choice; returns True if now in the store.
+        Unknown-parent / future blocks queue for retry (reference
+        BlockManager pending + futureBlocks pools)."""
+        block = signed_block.message
+        root = block.htr()
+        if self.chain.contains_block(root):
+            return True
+        if block.slot > self.chain.current_slot():
+            self._enqueue(self._future_by_slot[block.slot], signed_block)
+            return False
+        if not self.chain.contains_block(block.parent_root):
+            self._enqueue(self._pending_by_parent[block.parent_root],
+                          signed_block)
+            return False
+        try:
+            post = self.chain.store.on_block(signed_block)
+        except ForkChoiceError as exc:
+            _LOG.warning("block %s rejected: %s", root.hex()[:8], exc)
+            return False
+        self.chain.update_head()
+        self._channels.publisher(BlockImportChannel).on_block_imported(
+            signed_block, post)
+        for cb in self.on_imported:
+            cb(root)
+        # unblock children waiting on us
+        for child in self._pending_by_parent.pop(root, ()):
+            self._n_pending -= 1
+            self.import_block(child)
+        return True
+
+    def _enqueue(self, bucket: List, signed_block) -> None:
+        if self._n_pending >= self._max_pending:
+            return
+        bucket.append(signed_block)
+        self._n_pending += 1
+
+    def on_slot(self, slot: int) -> None:
+        for s in [s for s in self._future_by_slot if s <= slot]:
+            for blk in self._future_by_slot.pop(s):
+                self._n_pending -= 1
+                self.import_block(blk)

@@ -1,0 +1,251 @@
+"""BeaconNode: full in-process node wiring.
+
+Equivalent of the reference's BeaconChainController + SlotProcessor
+(reference: services/beaconchain/src/main/java/tech/pegasys/teku/
+services/beaconchain/BeaconChainController.java:504-546 initAll order,
+SlotProcessor.java:102-160): one object builds the store, chain data,
+signature batching service, gossip validators, managers, attestation
+pool and topic subscriptions, and exposes the slot-phase entry points
+(slot start / attestation due / aggregation due) that either a real
+timer or a devnet driver invokes.
+"""
+
+import logging
+from typing import Dict, List, Optional, Sequence
+
+from ..crypto import bls
+from ..infra.events import EventChannels, SlotEventsChannel
+from ..infra.logs import log_slot_event
+from ..infra.service import Service
+from ..services.signatures import AggregatingSignatureVerificationService
+from ..spec import Spec
+from ..spec import helpers as H
+from ..spec.builder import (is_aggregator, get_selection_proof,
+                            make_local_signer, produce_aggregate_and_proof,
+                            produce_block)
+from ..spec.config import DOMAIN_BEACON_ATTESTER
+from ..spec.verifiers import ServiceAsyncSignatureVerifier
+from ..storage.store import Store
+from .chaindata import RecentChainData
+from .gossip import (AGGREGATE_TOPIC, attestation_subnet_topic,
+                     BEACON_BLOCK_TOPIC, GossipNetwork, SszTopicHandler,
+                     ValidationResult)
+from .managers import AttestationManager, BlockManager
+from .pool import AggregatingAttestationPool
+from .validators import (AggregateValidator, AttestationValidator,
+                         BlockGossipValidator)
+
+_LOG = logging.getLogger(__name__)
+
+
+def compute_subnet_for_attestation(cfg, committees_per_slot: int,
+                                   slot: int, committee_index: int) -> int:
+    slots_since_epoch_start = slot % cfg.SLOTS_PER_EPOCH
+    committees_since_epoch_start = committees_per_slot * slots_since_epoch_start
+    return ((committees_since_epoch_start + committee_index)
+            % cfg.ATTESTATION_SUBNET_COUNT)
+
+
+class BeaconNode(Service):
+    def __init__(self, spec: Spec, genesis_state, gossip: GossipNetwork,
+                 name: str = "node", num_sig_workers: int = 2,
+                 max_batch_size: int = 250):
+        super().__init__(name)
+        self.spec = spec
+        S = spec.schemas
+        anchor = S.BeaconBlock(
+            slot=genesis_state.slot, parent_root=bytes(32),
+            state_root=genesis_state.htr(), body=S.BeaconBlockBody())
+        self.channels = EventChannels()
+        self.store = Store(spec.config, genesis_state, anchor)
+        self.chain = RecentChainData(spec, self.store, self.channels)
+        self.sig_service = AggregatingSignatureVerificationService(
+            num_workers=num_sig_workers, max_batch_size=max_batch_size,
+            name=f"{name}_signature_verifications")
+        self.verifier = ServiceAsyncSignatureVerifier(self.sig_service)
+        self.pool = AggregatingAttestationPool(spec)
+        self.attestation_manager = AttestationManager(
+            spec, self.chain, pool=self.pool)
+        self.block_manager = BlockManager(spec, self.chain, self.channels)
+        self.block_manager.on_imported.append(
+            self.attestation_manager.on_block_imported)
+        self.attestation_validator = AttestationValidator(
+            spec, self.chain, self.verifier)
+        self.aggregate_validator = AggregateValidator(
+            spec, self.chain, self.verifier)
+        self.block_validator = BlockGossipValidator(
+            spec, self.chain, self.verifier)
+        self.gossip = gossip
+        # one slot-advanced head state shared by all duty phases
+        self._advanced_cache: Optional[tuple] = None
+        self._subscribe_topics()
+
+    def advanced_head_state(self, slot: int):
+        """Head state advanced to `slot`, computed once per (head, slot)
+        — proposal, attestation and aggregation duties all need it, and
+        at epoch boundaries the advance includes full epoch processing."""
+        head_root = self.chain.head_root
+        cached = self._advanced_cache
+        if cached is not None and cached[0] == (head_root, slot):
+            return cached[1]
+        state = self.chain.head_state()
+        if state.slot < slot:
+            state = self.spec.process_slots(state, slot)
+        self._advanced_cache = ((head_root, slot), state)
+        return state
+
+    # ------------------------------------------------------------------
+    def _subscribe_topics(self) -> None:
+        S = self.spec.schemas
+        self.gossip.subscribe(BEACON_BLOCK_TOPIC, SszTopicHandler(
+            S.SignedBeaconBlock, self._process_gossip_block,
+            BEACON_BLOCK_TOPIC))
+        self.gossip.subscribe(AGGREGATE_TOPIC, SszTopicHandler(
+            S.SignedAggregateAndProof, self._process_gossip_aggregate,
+            AGGREGATE_TOPIC))
+        for subnet in range(self.spec.config.ATTESTATION_SUBNET_COUNT):
+            self.gossip.subscribe(
+                attestation_subnet_topic(subnet), SszTopicHandler(
+                    S.Attestation, self._process_gossip_attestation,
+                    f"attestation_{subnet}"))
+
+    async def _process_gossip_block(self, signed_block) -> ValidationResult:
+        result = await self.block_validator.validate(signed_block)
+        if result is ValidationResult.ACCEPT:
+            self.block_manager.import_block(signed_block)
+        elif result is ValidationResult.SAVE_FOR_FUTURE:
+            self.block_manager.import_block(signed_block)  # queues inside
+        return result
+
+    async def _process_gossip_attestation(self, att) -> ValidationResult:
+        result = await self.attestation_validator.validate(att)
+        if result in (ValidationResult.ACCEPT,
+                      ValidationResult.SAVE_FOR_FUTURE):
+            self.attestation_manager.add_attestation(att)
+        return result
+
+    async def _process_gossip_aggregate(self, agg) -> ValidationResult:
+        result = await self.aggregate_validator.validate(agg)
+        if result in (ValidationResult.ACCEPT,
+                      ValidationResult.SAVE_FOR_FUTURE):
+            self.attestation_manager.add_attestation(agg.message.aggregate)
+        return result
+
+    # ------------------------------------------------------------------
+    async def do_start(self) -> None:
+        await self.sig_service.start()
+
+    async def do_stop(self) -> None:
+        await self.sig_service.stop()
+
+    # ------------------------------------------------------------------
+    # slot phases (reference SlotProcessor.onSlot / attestation-due)
+    # ------------------------------------------------------------------
+
+    def on_slot(self, slot: int) -> None:
+        cfg = self.spec.config
+        self.store.on_tick(self.store.genesis_time
+                           + slot * cfg.SECONDS_PER_SLOT)
+        self.block_manager.on_slot(slot)
+        self.attestation_manager.on_slot(slot)
+        head = self.chain.update_head()
+        self.channels.publisher(SlotEventsChannel).on_slot(slot)
+        if slot % cfg.SLOTS_PER_EPOCH == 0:
+            log_slot_event(slot, slot // cfg.SLOTS_PER_EPOCH, head,
+                           self.store.justified_checkpoint.epoch,
+                           self.store.finalized_checkpoint.epoch)
+            self.pool.prune(self.store.finalized_checkpoint.epoch)
+
+
+class InProcessValidatorClient:
+    """Validator duties bound to one node — the devnet stand-in for the
+    reference's ValidatorClientService (reference: validator/client/
+    ValidatorClientService.java + duties/attestations/*): propose at
+    slot start, attest at 1/3, aggregate at 2/3, all signatures local.
+    """
+
+    def __init__(self, node: BeaconNode, secret_keys: Dict[int, int]):
+        self.node = node
+        self.spec = node.spec
+        self.keys = dict(secret_keys)
+        self.signer = make_local_signer(self.keys)
+        self.blocks_proposed = 0
+        self.attestations_sent = 0
+
+    # -- slot start: propose ------------------------------------------
+    async def on_slot_start(self, slot: int) -> None:
+        cfg = self.spec.config
+        pre = self.node.advanced_head_state(slot)
+        proposer = H.get_beacon_proposer_index(cfg, pre)
+        if proposer not in self.keys:
+            return
+        atts = self.node.pool.get_attestations_for_block(
+            pre, cfg.MAX_ATTESTATIONS)
+        signed, post = produce_block(cfg, pre, slot, self.signer,
+                                     attestations=atts)
+        self.blocks_proposed += 1
+        # local import + gossip publish
+        self.node.block_manager.import_block(signed)
+        await self.node.gossip.publish(
+            BEACON_BLOCK_TOPIC,
+            self.spec.schemas.SignedBeaconBlock.serialize(signed))
+
+    # -- 1/3 slot: attest ---------------------------------------------
+    async def on_attestation_due(self, slot: int) -> None:
+        cfg = self.spec.config
+        S = self.spec.schemas
+        head_root = self.node.chain.head_root
+        state = self.node.advanced_head_state(slot)
+        epoch = H.compute_epoch_at_slot(cfg, slot)
+        committees_per_slot = H.get_committee_count_per_slot(
+            cfg, state, epoch)
+        from ..spec.builder import attestation_data_for
+        for ci in range(committees_per_slot):
+            committee = H.get_beacon_committee(cfg, state, slot, ci)
+            mine = [v for v in committee if v in self.keys]
+            if not mine:
+                continue
+            data = attestation_data_for(cfg, state, slot, ci, head_root)
+            domain = H.get_domain(cfg, state, DOMAIN_BEACON_ATTESTER, epoch)
+            root = H.compute_signing_root(data, domain)
+            subnet = compute_subnet_for_attestation(
+                cfg, committees_per_slot, slot, ci)
+            for v in mine:
+                bits = tuple(m == v for m in committee)
+                att = S.Attestation(aggregation_bits=bits, data=data,
+                                    signature=self.signer(v, root))
+                self.attestations_sent += 1
+                self.node.attestation_manager.add_attestation(att)
+                await self.node.gossip.publish(
+                    attestation_subnet_topic(subnet),
+                    S.Attestation.serialize(att))
+
+    # -- 2/3 slot: aggregate ------------------------------------------
+    async def on_aggregation_due(self, slot: int) -> None:
+        cfg = self.spec.config
+        S = self.spec.schemas
+        state = self.node.advanced_head_state(slot)
+        epoch = H.compute_epoch_at_slot(cfg, slot)
+        committees_per_slot = H.get_committee_count_per_slot(
+            cfg, state, epoch)
+        for ci in range(committees_per_slot):
+            committee = H.get_beacon_committee(cfg, state, slot, ci)
+            for v in committee:
+                if v not in self.keys:
+                    continue
+                proof = get_selection_proof(cfg, state, slot, v,
+                                            self.signer)
+                if not is_aggregator(cfg, state, slot, ci, proof):
+                    continue
+                from ..spec.builder import attestation_data_for
+                data = attestation_data_for(
+                    cfg, state, slot, ci, self.node.chain.head_root)
+                agg = self.node.pool.get_aggregate(data)
+                if agg is None:
+                    continue
+                signed_agg = produce_aggregate_and_proof(
+                    cfg, state, agg, v, self.signer)
+                await self.node.gossip.publish(
+                    AGGREGATE_TOPIC,
+                    S.SignedAggregateAndProof.serialize(signed_agg))
+                break   # one aggregator per committee is enough locally

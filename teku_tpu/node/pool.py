@@ -1,0 +1,111 @@
+"""Aggregating attestation pool for block production.
+
+Equivalent of the reference's AggregatingAttestationPool +
+MatchingDataAttestationGroup + AggregateAttestationBuilder (reference:
+ethereum/statetransition/src/main/java/tech/pegasys/teku/
+statetransition/attestation/): attestations with identical
+AttestationData group together; non-overlapping bitlists OR into larger
+aggregates; block production takes the best aggregates not yet included.
+"""
+
+from collections import defaultdict
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..crypto import bls
+from ..spec import Spec
+from ..spec import helpers as H
+
+
+class _Group:
+    """All seen attestations for one AttestationData."""
+
+    def __init__(self, data):
+        self.data = data
+        self.attestations: List = []
+        self._seen_bits: Set[Tuple[bool, ...]] = set()
+
+    def add(self, attestation) -> None:
+        bits = tuple(attestation.aggregation_bits)
+        if bits in self._seen_bits:
+            return
+        self._seen_bits.add(bits)
+        self.attestations.append(attestation)
+
+    def best_aggregate(self, schema):
+        """Greedy OR of non-overlapping bitlists, largest first
+        (reference AggregateAttestationBuilder.aggregateAttestations)."""
+        if not self.attestations:
+            return None
+        by_size = sorted(self.attestations,
+                         key=lambda a: -sum(a.aggregation_bits))
+        acc_bits = list(by_size[0].aggregation_bits)
+        sigs = [by_size[0].signature]
+        for att in by_size[1:]:
+            bits = att.aggregation_bits
+            if any(a and b for a, b in zip(acc_bits, bits)):
+                continue
+            acc_bits = [a or b for a, b in zip(acc_bits, bits)]
+            sigs.append(att.signature)
+        return schema(
+            aggregation_bits=tuple(acc_bits), data=self.data,
+            signature=sigs[0] if len(sigs) == 1
+            else bls.aggregate_signatures(sigs))
+
+
+class AggregatingAttestationPool:
+    def __init__(self, spec: Spec, max_groups: int = 1024):
+        self.spec = spec
+        self._groups: Dict[bytes, _Group] = {}
+        self._max_groups = max_groups
+
+    def add(self, attestation) -> None:
+        key = attestation.data.htr()
+        group = self._groups.get(key)
+        if group is None:
+            if len(self._groups) >= self._max_groups:
+                return
+            group = self._groups[key] = _Group(attestation.data)
+        group.add(attestation)
+
+    def get_aggregate(self, data) -> Optional[object]:
+        """Best current aggregate for the given AttestationData (the
+        aggregator duty's getAggregate)."""
+        group = self._groups.get(data.htr())
+        if group is None:
+            return None
+        return group.best_aggregate(self.spec.schemas.Attestation)
+
+    def get_attestations_for_block(self, state, limit: int) -> List:
+        """Includable aggregates for a block on `state` (reference
+        AggregatingAttestationPool.getAttestationsForBlock)."""
+        cfg = self.spec.config
+        out = []
+        current = H.get_current_epoch(cfg, state)
+        previous = H.get_previous_epoch(cfg, state)
+        for group in sorted(self._groups.values(),
+                            key=lambda g: -g.data.slot):
+            data = group.data
+            if data.target.epoch not in (current, previous):
+                continue
+            if not (data.slot + cfg.MIN_ATTESTATION_INCLUSION_DELAY
+                    <= state.slot <= data.slot + cfg.SLOTS_PER_EPOCH):
+                continue
+            # source must match the state the block will execute on
+            expected_source = (state.current_justified_checkpoint
+                               if data.target.epoch == current
+                               else state.previous_justified_checkpoint)
+            if data.source != expected_source:
+                continue
+            agg = group.best_aggregate(self.spec.schemas.Attestation)
+            if agg is not None:
+                out.append(agg)
+            if len(out) >= limit:
+                break
+        return out
+
+    def prune(self, finalized_epoch: int) -> None:
+        cfg = self.spec.config
+        drop = [k for k, g in self._groups.items()
+                if g.data.target.epoch < finalized_epoch]
+        for k in drop:
+            del self._groups[k]

@@ -1,0 +1,208 @@
+"""Gossip validators: the admission rules ahead of fork choice.
+
+Equivalent of the reference's statetransition/validation package
+(reference: ethereum/statetransition/src/main/java/tech/pegasys/teku/
+statetransition/validation/AttestationValidator.java:34-120,
+AggregateAttestationValidator.java, BlockGossipValidator.java, shared
+GossipValidationHelper): protocol rules first (slot windows, single
+bit, known block, committee bounds), THEN the signature enters the
+async batch verifier — on the TPU provider that means gossip signatures
+ride the device batcher (AsyncBatchSignatureVerifier keeps an
+aggregate-and-proof's three signatures atomic in one task).
+"""
+
+import logging
+from typing import Optional, Set, Tuple
+
+from ..spec import Spec
+from ..spec import helpers as H
+from ..spec.block import is_valid_indexed_attestation
+from ..spec.config import (DOMAIN_AGGREGATE_AND_PROOF,
+                           DOMAIN_BEACON_ATTESTER, DOMAIN_BEACON_PROPOSER)
+from ..infra.collections import LimitedSet
+from ..spec.builder import is_aggregator
+from ..spec.verifiers import (AsyncBatchSignatureVerifier,
+                              AsyncSignatureVerifier)
+from .chaindata import RecentChainData
+from .gossip import ValidationResult
+
+_LOG = logging.getLogger(__name__)
+
+ACCEPT = ValidationResult.ACCEPT
+IGNORE = ValidationResult.IGNORE
+REJECT = ValidationResult.REJECT
+SAVE_FOR_FUTURE = ValidationResult.SAVE_FOR_FUTURE
+
+
+class AttestationValidator:
+    """Single (unaggregated) attestation gossip rules + batched sig."""
+
+    def __init__(self, spec: Spec, chain: RecentChainData,
+                 verifier: AsyncSignatureVerifier):
+        self.spec = spec
+        self.chain = chain
+        self.verifier = verifier
+        # bounded like the reference's LimitedSet seen-caches
+        self._seen: LimitedSet = LimitedSet(65536)
+
+    async def validate(self, attestation) -> ValidationResult:
+        cfg = self.spec.config
+        data = attestation.data
+        bits = attestation.aggregation_bits
+        # exactly one bit set (gossip rule)
+        if sum(1 for b in bits if b) != 1:
+            return REJECT
+        if data.target.epoch != H.compute_epoch_at_slot(cfg, data.slot):
+            return REJECT
+        # propagation slot window (with clock disparity handled by ticks)
+        current_slot = self.chain.current_slot()
+        if data.slot > current_slot:
+            return SAVE_FOR_FUTURE
+        if data.slot + cfg.ATTESTATION_PROPAGATION_SLOT_RANGE < current_slot:
+            return IGNORE
+        if not self.chain.contains_block(data.beacon_block_root):
+            return SAVE_FOR_FUTURE
+        try:
+            target_state = self.chain.store.get_checkpoint_state(data.target)
+        except Exception:
+            return IGNORE
+        if data.index >= H.get_committee_count_per_slot(
+                cfg, target_state, data.target.epoch):
+            return REJECT
+        committee = H.get_beacon_committee(cfg, target_state, data.slot,
+                                           data.index)
+        if len(bits) != len(committee):
+            return REJECT
+        validator_index = committee[next(i for i, b in enumerate(bits) if b)]
+        # first-seen per (validator, target epoch) dedupe (gossip rule)
+        key = (data.target.epoch, validator_index)
+        if key in self._seen:
+            return IGNORE
+        domain = H.get_domain(cfg, target_state, DOMAIN_BEACON_ATTESTER,
+                              data.target.epoch)
+        root = H.compute_signing_root(data, domain)
+        pubkey = target_state.validators[validator_index].pubkey
+        ok = await self.verifier.verify([pubkey], root,
+                                        attestation.signature)
+        if not ok:
+            return REJECT
+        self._seen.add(key)
+        return ACCEPT
+
+
+class AggregateValidator:
+    """SignedAggregateAndProof rules; the three signatures (selection
+    proof, aggregator, aggregate) verify as ONE atomic batch task
+    (reference AggregateAttestationValidator.java:124-126,242)."""
+
+    def __init__(self, spec: Spec, chain: RecentChainData,
+                 verifier: AsyncSignatureVerifier):
+        self.spec = spec
+        self.chain = chain
+        self.verifier = verifier
+        self._seen_aggregators: LimitedSet = LimitedSet(16384)
+
+    async def validate(self, signed_aggregate) -> ValidationResult:
+        cfg = self.spec.config
+        msg = signed_aggregate.message
+        aggregate = msg.aggregate
+        data = aggregate.data
+        current_slot = self.chain.current_slot()
+        if data.slot > current_slot:
+            return SAVE_FOR_FUTURE
+        if data.slot + cfg.ATTESTATION_PROPAGATION_SLOT_RANGE < current_slot:
+            return IGNORE    # stale: drop before any signature work
+        if data.target.epoch != H.compute_epoch_at_slot(cfg, data.slot):
+            return REJECT
+        if not self.chain.contains_block(data.beacon_block_root):
+            return SAVE_FOR_FUTURE
+        key = (data.slot, msg.aggregator_index)
+        if key in self._seen_aggregators:
+            return IGNORE
+        try:
+            state = self.chain.store.get_checkpoint_state(data.target)
+        except Exception:
+            return IGNORE
+        committee = H.get_beacon_committee(cfg, state, data.slot, data.index)
+        if len(aggregate.aggregation_bits) != len(committee):
+            return REJECT
+        if msg.aggregator_index not in committee:
+            return REJECT
+        if not is_aggregator(cfg, state, data.slot, data.index,
+                             msg.selection_proof):
+            return REJECT
+
+        # three signatures, one atomic task
+        batch = AsyncBatchSignatureVerifier(self.verifier)
+        agg_pubkey = state.validators[msg.aggregator_index].pubkey
+        sel_root = H.selection_proof_signing_root(cfg, state, data.slot)
+        batch.verify([agg_pubkey], sel_root, msg.selection_proof)
+
+        proof_domain = H.get_domain(
+            cfg, state, DOMAIN_AGGREGATE_AND_PROOF,
+            H.compute_epoch_at_slot(cfg, data.slot))
+        proof_root = H.compute_signing_root(msg, proof_domain)
+        batch.verify([agg_pubkey], proof_root, signed_aggregate.signature)
+
+        att_domain = H.get_domain(cfg, state, DOMAIN_BEACON_ATTESTER,
+                                  data.target.epoch)
+        att_root = H.compute_signing_root(data, att_domain)
+        participants = [state.validators[v].pubkey
+                        for v, b in zip(committee,
+                                        aggregate.aggregation_bits) if b]
+        if not participants:
+            return REJECT
+        batch.verify(participants, att_root, aggregate.signature)
+
+        if not await batch.batch_verify():
+            return REJECT
+        self._seen_aggregators.add(key)
+        return ACCEPT
+
+
+class BlockGossipValidator:
+    """Block gossip rules (reference BlockGossipValidator.java): slot
+    not from the future/too old, first block per (slot, proposer),
+    known parent, proposer signature against the parent's state."""
+
+    def __init__(self, spec: Spec, chain: RecentChainData,
+                 verifier: AsyncSignatureVerifier):
+        self.spec = spec
+        self.chain = chain
+        self.verifier = verifier
+        self._seen: LimitedSet = LimitedSet(16384)
+
+    async def validate(self, signed_block) -> ValidationResult:
+        cfg = self.spec.config
+        block = signed_block.message
+        current_slot = self.chain.current_slot()
+        if block.slot > current_slot:
+            return SAVE_FOR_FUTURE
+        finalized_slot = H.compute_start_slot_at_epoch(
+            cfg, self.chain.finalized_checkpoint.epoch)
+        if block.slot <= finalized_slot:
+            return IGNORE
+        key = (block.slot, block.proposer_index)
+        if key in self._seen:
+            return IGNORE
+        parent_state = self.chain.get_state(block.parent_root)
+        if parent_state is None:
+            return SAVE_FOR_FUTURE
+        if parent_state.slot >= block.slot:
+            return REJECT
+        try:
+            pre = self.spec.process_slots(parent_state, block.slot) \
+                if parent_state.slot < block.slot else parent_state
+            expected_proposer = H.get_beacon_proposer_index(cfg, pre)
+        except Exception:
+            return IGNORE
+        if block.proposer_index != expected_proposer:
+            return REJECT
+        proposer = pre.validators[block.proposer_index]
+        domain = H.get_domain(cfg, pre, DOMAIN_BEACON_PROPOSER)
+        root = H.compute_signing_root(block, domain)
+        if not await self.verifier.verify([proposer.pubkey], root,
+                                          signed_block.signature):
+            return REJECT
+        self._seen.add(key)
+        return ACCEPT

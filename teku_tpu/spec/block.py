@@ -45,10 +45,7 @@ def verify_randao_reveal(cfg: SpecConfig, state, body,
                          verifier: SignatureVerifier) -> bool:
     epoch = H.get_current_epoch(cfg, state)
     proposer = state.validators[H.get_beacon_proposer_index(cfg, state)]
-    domain = H.get_domain(cfg, state, DOMAIN_RANDAO)
-    root = H.compute_signing_root(
-        epoch.to_bytes(8, "little").ljust(32, b"\x00"), domain)
-    # signing root of uint64 epoch: HTR of uint64 is its LE bytes padded
+    root = H.randao_signing_root(cfg, state, epoch)
     return verifier.verify([proposer.pubkey], root, body.randao_reveal)
 
 

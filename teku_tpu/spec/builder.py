@@ -34,10 +34,8 @@ def make_local_signer(secret_keys: Dict[int, int]) -> Signer:
 
 def get_randao_reveal(cfg: SpecConfig, state, epoch: int,
                       proposer_index: int, signer: Signer) -> bytes:
-    domain = H.get_domain(cfg, state, DOMAIN_RANDAO, epoch)
-    root = H.compute_signing_root(
-        epoch.to_bytes(8, "little").ljust(32, b"\x00"), domain)
-    return signer(proposer_index, root)
+    return signer(proposer_index,
+                  H.randao_signing_root(cfg, state, epoch))
 
 
 def attestation_data_for(cfg: SpecConfig, state, slot: int,
@@ -140,11 +138,8 @@ _TRUSTING = _Trusting()
 
 def get_selection_proof(cfg: SpecConfig, state, slot: int,
                         validator_index: int, signer: Signer) -> bytes:
-    domain = H.get_domain(cfg, state, DOMAIN_SELECTION_PROOF,
-                          H.compute_epoch_at_slot(cfg, slot))
-    root = H.compute_signing_root(
-        slot.to_bytes(8, "little").ljust(32, b"\x00"), domain)
-    return signer(validator_index, root)
+    return signer(validator_index,
+                  H.selection_proof_signing_root(cfg, state, slot))
 
 
 def is_aggregator(cfg: SpecConfig, state, slot: int, index: int,

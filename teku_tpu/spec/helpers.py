@@ -289,6 +289,29 @@ def compute_signing_root(obj, domain: bytes) -> bytes:
     return SigningData(object_root=root, domain=domain).htr()
 
 
+def uint64_signing_root(value: int, domain: bytes) -> bytes:
+    """Signing root of a bare uint64 (epoch for RANDAO, slot for
+    selection proofs): HTR of uint64 is its LE bytes zero-padded to 32.
+    One definition shared by producers AND verifiers so the encoding
+    can never silently diverge."""
+    return compute_signing_root(
+        value.to_bytes(8, "little").ljust(32, b"\x00"), domain)
+
+
+def randao_signing_root(cfg: SpecConfig, state, epoch: int) -> bytes:
+    from .config import DOMAIN_RANDAO
+    return uint64_signing_root(
+        epoch, get_domain(cfg, state, DOMAIN_RANDAO, epoch))
+
+
+def selection_proof_signing_root(cfg: SpecConfig, state,
+                                 slot: int) -> bytes:
+    from .config import DOMAIN_SELECTION_PROOF
+    return uint64_signing_root(
+        slot, get_domain(cfg, state, DOMAIN_SELECTION_PROOF,
+                         compute_epoch_at_slot(cfg, slot)))
+
+
 # --------------------------------------------------------------------------
 # Predicates
 # --------------------------------------------------------------------------

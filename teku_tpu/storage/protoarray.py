@@ -18,9 +18,11 @@ from typing import Dict, List, Optional, Tuple
 class ProtoNode:
     root: bytes
     parent: Optional[int]
-    justified_epoch: int
+    justified_epoch: int            # realized (from the post state)
     finalized_epoch: int
     slot: int = 0
+    epoch: int = 0                  # epoch of `slot`
+    unrealized_justified_epoch: int = 0
     weight: int = 0
     best_child: Optional[int] = None
     best_descendant: Optional[int] = None
@@ -51,14 +53,19 @@ class ProtoArray:
         return root in self.indices
 
     def on_block(self, slot: int, root: bytes, parent_root: bytes,
-                 justified_epoch: int, finalized_epoch: int) -> None:
+                 justified_epoch: int, finalized_epoch: int,
+                 epoch: int = 0,
+                 unrealized_justified_epoch: Optional[int] = None) -> None:
         if root in self.indices:
             return
         parent = self.indices.get(parent_root)
         idx = len(self.nodes)
         self.nodes.append(ProtoNode(
             root=root, parent=parent, justified_epoch=justified_epoch,
-            finalized_epoch=finalized_epoch, slot=slot))
+            finalized_epoch=finalized_epoch, slot=slot, epoch=epoch,
+            unrealized_justified_epoch=(
+                justified_epoch if unrealized_justified_epoch is None
+                else unrealized_justified_epoch)))
         self.indices[root] = idx
         if parent is not None:
             self._maybe_update_best_child_and_descendant(parent, idx)
@@ -161,17 +168,23 @@ class ProtoArray:
 
     # ------------------------------------------------------------------
     def _node_is_viable_for_head(self, node: ProtoNode) -> bool:
-        """Voting-source viability (the modern lenient rule: the node's
-        justified epoch matches the store's, or is within 2 epochs of
-        the current epoch — spec filter_block_tree; reference
-        ProtoArray.nodeIsViableForHead)."""
+        """Voting-source viability (spec filter_block_tree /
+        get_voting_source; reference ProtoArray.nodeIsViableForHead):
+        once the store's epoch has moved past the block's own epoch, the
+        block's UNREALIZED justification is its voting source — a tip
+        that has earned justification the store just promoted stays
+        viable even though its realized checkpoint lags.  Plus the
+        lenient two-epoch tolerance.  Finalized descent is enforced at
+        on_block admission."""
         current_epoch = getattr(self, "_current_epoch", None)
-        # finalized-descent is enforced at on_block admission, so only
-        # the justified voting-source condition filters here
+        if current_epoch is not None and current_epoch > node.epoch:
+            voting_source = node.unrealized_justified_epoch
+        else:
+            voting_source = node.justified_epoch
         return (self.justified_epoch == 0
-                or node.justified_epoch == self.justified_epoch
+                or voting_source == self.justified_epoch
                 or (current_epoch is not None
-                    and node.justified_epoch + 2 >= current_epoch))
+                    and voting_source + 2 >= current_epoch))
 
     def _leads_to_viable_head(self, node: ProtoNode) -> bool:
         if (node.best_descendant is not None):

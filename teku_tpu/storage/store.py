@@ -50,9 +50,15 @@ class Store:
         # per-block unrealized checkpoints (pulled-up tips)
         self.unrealized_justifications: Dict[bytes, Checkpoint] = {
             anchor_root: self.justified_checkpoint}
+        # store-level unrealized checkpoints, promoted on epoch-boundary
+        # ticks (spec on_tick_per_epoch)
+        self.unrealized_justified = self.justified_checkpoint
+        self.unrealized_finalized = self.finalized_checkpoint
         self.proto = ProtoArray(anchor_epoch, anchor_epoch)
         self.proto.on_block(anchor_block.slot, anchor_root,
-                            b"\x00" * 32, anchor_epoch, anchor_epoch)
+                            b"\x00" * 32, anchor_epoch, anchor_epoch,
+                            epoch=anchor_epoch,
+                            unrealized_justified_epoch=anchor_epoch)
         self._equivocating: set = set()
 
     # ------------------------------------------------------------------
@@ -87,11 +93,18 @@ class Store:
 
     def on_tick(self, time: int) -> None:
         prev_slot = self.current_slot
+        prev_epoch = self.current_epoch()
         if time < self.time:
             return
         self.time = time
         if self.current_slot > prev_slot:
             self.proto.clear_proposer_boost()
+        if self.current_epoch() > prev_epoch:
+            # epoch boundary: justification the chain has earned but not
+            # yet processed becomes real (spec on_tick_per_epoch →
+            # update_checkpoints with the unrealized checkpoints)
+            self._update_checkpoints(self.unrealized_justified,
+                                     self.unrealized_finalized)
 
     def on_slot_start(self) -> None:
         self.proto.clear_proposer_boost()
@@ -156,21 +169,25 @@ class Store:
         uj = unrealized.current_justified_checkpoint
         uf = unrealized.finalized_checkpoint
         self.unrealized_justifications[root] = uj
+        if uj.epoch > self.unrealized_justified.epoch:
+            self.unrealized_justified = uj
+        if uf.epoch > self.unrealized_finalized.epoch:
+            self.unrealized_finalized = uf
 
         block_epoch = H.compute_epoch_at_slot(self.cfg, block.slot)
-        if block_epoch < self.current_epoch():
+        pulled_up = block_epoch < self.current_epoch()
+        if pulled_up:
             # block from a prior epoch: unrealized counts immediately
             self._update_checkpoints(uj, uf)
         else:
             self._update_checkpoints(post.current_justified_checkpoint,
-                                      post.finalized_checkpoint)
+                                     post.finalized_checkpoint)
 
         self.proto.on_block(
             block.slot, root, parent_root,
-            self.unrealized_justifications[root].epoch
-            if block_epoch < self.current_epoch()
-            else post.current_justified_checkpoint.epoch,
-            post.finalized_checkpoint.epoch)
+            post.current_justified_checkpoint.epoch,
+            post.finalized_checkpoint.epoch,
+            epoch=block_epoch, unrealized_justified_epoch=uj.epoch)
 
         # votes carried inside the block count for fork choice
         # (reference ForkChoice.applyIndexedAttestations; signatures

@@ -1,0 +1,174 @@
+"""Infra: event channels, async primitives, service lifecycle."""
+
+import asyncio
+
+import pytest
+
+from teku_tpu.infra.aio import (finish, OrderedTaskQueue, RepeatingTask,
+                                retry_with_backoff, ThrottlingTaskQueue)
+from teku_tpu.infra.events import EventChannels, SlotEventsChannel
+from teku_tpu.infra.service import Service, ServiceController, ServiceState
+
+
+def test_event_channel_fanout_and_isolation():
+    chans = EventChannels()
+    seen = []
+
+    class Good:
+        def on_slot(self, slot):
+            seen.append(slot)
+
+    class Bad:
+        def on_slot(self, slot):
+            raise RuntimeError("boom")
+
+    chans.subscribe(SlotEventsChannel, Bad())
+    chans.subscribe(SlotEventsChannel, Good())
+    chans.subscribe(SlotEventsChannel, Good())
+    chans.publisher(SlotEventsChannel).on_slot(7)
+    # the failing subscriber must not break the others
+    assert seen == [7, 7]
+
+
+def test_event_channel_unknown_event_rejected():
+    chans = EventChannels()
+    with pytest.raises(AttributeError):
+        chans.publisher(SlotEventsChannel).on_bogus
+
+
+def test_throttling_queue_bounds_concurrency():
+    async def run():
+        q = ThrottlingTaskQueue(2)
+        active = 0
+        peak = 0
+
+        async def job():
+            nonlocal active, peak
+            active += 1
+            peak = max(peak, active)
+            await asyncio.sleep(0.01)
+            active -= 1
+
+        await asyncio.gather(*(q.run(job) for _ in range(8)))
+        return peak
+    assert asyncio.run(run()) == 2
+
+
+def test_ordered_queue_serializes_and_asserts_ownership():
+    async def run():
+        q = OrderedTaskQueue()
+        order = []
+
+        async def job(i):
+            q.check_in_queue()
+            order.append(("start", i))
+            await asyncio.sleep(0.005)
+            order.append(("end", i))
+
+        await asyncio.gather(*(q.run(lambda i=i: job(i)) for i in range(3)))
+        # no interleaving: every start is immediately followed by its end
+        for j in range(0, len(order), 2):
+            assert order[j][0] == "start" and order[j + 1][0] == "end"
+            assert order[j][1] == order[j + 1][1]
+        with pytest.raises(AssertionError):
+            q.check_in_queue()
+    asyncio.run(run())
+
+
+def test_retry_with_backoff():
+    async def run():
+        calls = {"n": 0}
+
+        async def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ValueError("nope")
+            return "ok"
+
+        out = await retry_with_backoff(flaky, attempts=4,
+                                       base_delay_s=0.001)
+        assert out == "ok" and calls["n"] == 3
+
+        async def always_fails():
+            raise ValueError("always")
+        with pytest.raises(RuntimeError):
+            await retry_with_backoff(always_fails, attempts=2,
+                                     base_delay_s=0.001)
+    asyncio.run(run())
+
+
+def test_service_lifecycle_and_controller_order():
+    log = []
+
+    class Svc(Service):
+        def __init__(self, name):
+            super().__init__(name)
+
+        async def do_start(self):
+            log.append(("start", self.name))
+
+        async def do_stop(self):
+            log.append(("stop", self.name))
+
+    async def run():
+        a, b = Svc("a"), Svc("b")
+        ctl = ServiceController([a, b])
+        await ctl.start()
+        assert a.is_running and b.is_running
+        with pytest.raises(RuntimeError):
+            await a.start()     # double start forbidden
+        await ctl.stop()
+        assert log == [("start", "a"), ("start", "b"),
+                       ("stop", "b"), ("stop", "a")]
+    asyncio.run(run())
+
+
+def test_controller_unwinds_on_start_failure():
+    log = []
+
+    class Svc(Service):
+        async def do_start(self):
+            log.append(("start", self.name))
+
+        async def do_stop(self):
+            log.append(("stop", self.name))
+
+    class Broken(Service):
+        async def do_start(self):
+            raise RuntimeError("cannot start")
+
+    async def run():
+        a = Svc("a")
+        ctl = ServiceController([a, Broken("x"), Svc("c")])
+        with pytest.raises(RuntimeError):
+            await ctl.start()
+        assert log == [("start", "a"), ("stop", "a")]
+    asyncio.run(run())
+
+
+def test_finish_logs_but_does_not_raise():
+    async def run():
+        async def fails():
+            raise ValueError("boom")
+        t = finish(fails(), "background thing")
+        await asyncio.sleep(0.01)
+        assert t.done() and t.exception() is not None
+    asyncio.run(run())
+
+
+def test_repeating_task_ticks_and_stops():
+    async def run():
+        ticks = []
+
+        async def tick():
+            ticks.append(1)
+
+        rt = RepeatingTask(0.005, tick)
+        rt.start()
+        await asyncio.sleep(0.03)
+        await rt.stop()
+        n = len(ticks)
+        assert n >= 3
+        await asyncio.sleep(0.02)
+        assert len(ticks) == n   # no ticks after stop
+    asyncio.run(run())
