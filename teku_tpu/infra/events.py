@@ -53,9 +53,16 @@ def _safe_call(fn: Callable, args, kwargs) -> None:
     try:
         result = fn(*args, **kwargs)
         if inspect.iscoroutine(result):
-            asyncio.ensure_future(result)
+            task = asyncio.ensure_future(result)
+            task.add_done_callback(lambda t: _log_task_error(t, fn))
     except Exception:
         _LOG.exception("event subscriber %r failed", fn)
+
+
+def _log_task_error(task: "asyncio.Task", fn: Callable) -> None:
+    if not task.cancelled() and task.exception() is not None:
+        _LOG.error("async event subscriber %r failed: %r",
+                   fn, task.exception())
 
 
 class EventChannels:

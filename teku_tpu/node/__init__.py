@@ -8,5 +8,5 @@ from .chaindata import RecentChainData
 from .devnet import Devnet
 from .gossip import InMemoryGossipNetwork, TopicHandler, ValidationResult
 from .managers import AttestationManager, BlockManager
-from .node import BeaconNode, InProcessValidatorClient
+from .node import BeaconNode
 from .pool import AggregatingAttestationPool

@@ -18,7 +18,7 @@ from ..infra.service import ServiceController
 from ..spec import create_spec, Spec
 from ..spec.genesis import interop_genesis
 from .gossip import InMemoryGossipNetwork
-from .node import BeaconNode, InProcessValidatorClient
+from .node import BeaconNode
 
 _LOG = logging.getLogger(__name__)
 
@@ -33,14 +33,23 @@ class Devnet:
         self.genesis_state = state
         self.net = InMemoryGossipNetwork()
         self.nodes: List[BeaconNode] = []
-        self.clients: List[InProcessValidatorClient] = []
+        self.clients: List = []
+        from ..validator import (BeaconNodeValidatorApi, LocalSigner,
+                                 SlashingProtectedSigner, ValidatorClient)
+        from ..validator.slashing_protection import SlashingProtector
         for i in range(n_nodes):
             node = BeaconNode(self.spec, state, self.net.endpoint(),
                               name=f"node{i}")
             keys = {v: sks[v] for v in range(n_validators)
                     if v % n_nodes == i}
             self.nodes.append(node)
-            self.clients.append(InProcessValidatorClient(node, keys))
+            # the REAL validator client: duties via the API channel,
+            # slashing-protected local signer
+            signer = SlashingProtectedSigner(
+                LocalSigner(keys), SlashingProtector())
+            self.clients.append(ValidatorClient(
+                self.spec, BeaconNodeValidatorApi(node), signer,
+                sorted(keys)))
         self.controller = ServiceController(self.nodes, "devnet")
 
     async def start(self) -> None:
@@ -53,7 +62,7 @@ class Devnet:
         """One full slot: tick everywhere, propose, attest, aggregate —
         the three phases of the reference's SlotProcessor."""
         for node in self.nodes:
-            node.on_slot(slot)
+            await node.on_slot(slot)
         for client in self.clients:
             await client.on_slot_start(slot)
         for client in self.clients:

@@ -34,8 +34,12 @@ class AttestationManager:
         self._n_pending = 0
 
     def add_attestation(self, attestation) -> None:
-        """Apply a gossip-ACCEPTed attestation to fork choice; queue it
-        if its block is unknown or its slot not yet reached."""
+        """Apply a FULLY-VALIDATED attestation (signature settled by the
+        gossip pipeline or locally produced) to the pool + fork choice;
+        queue it if its block is unknown or its slot not yet reached.
+        Unvalidated gossip (SAVE_FOR_FUTURE) must NOT come here — the
+        node defers it for re-validation instead, or garbage signatures
+        would poison block production."""
         data = attestation.data
         if self.pool is not None:
             self.pool.add(attestation)
@@ -56,7 +60,8 @@ class AttestationManager:
 
     def _apply(self, attestation) -> None:
         try:
-            self.chain.store.on_attestation(attestation)
+            self.chain.store.on_attestation(attestation,
+                                            signature_verified=True)
         except ForkChoiceError as exc:
             _LOG.debug("attestation dropped: %s", exc)
 

@@ -20,10 +20,6 @@ from ..infra.service import Service
 from ..services.signatures import AggregatingSignatureVerificationService
 from ..spec import Spec
 from ..spec import helpers as H
-from ..spec.builder import (is_aggregator, get_selection_proof,
-                            make_local_signer, produce_aggregate_and_proof,
-                            produce_block)
-from ..spec.config import DOMAIN_BEACON_ATTESTER
 from ..spec.verifiers import ServiceAsyncSignatureVerifier
 from ..storage.store import Store
 from .chaindata import RecentChainData
@@ -78,6 +74,8 @@ class BeaconNode(Service):
         self.gossip = gossip
         # one slot-advanced head state shared by all duty phases
         self._advanced_cache: Optional[tuple] = None
+        # gossip awaiting re-validation (kind, message, retries)
+        self._deferred_gossip: List[tuple] = []
         self._subscribe_topics()
 
     def advanced_head_state(self, slot: int):
@@ -111,25 +109,55 @@ class BeaconNode(Service):
 
     async def _process_gossip_block(self, signed_block) -> ValidationResult:
         result = await self.block_validator.validate(signed_block)
-        if result is ValidationResult.ACCEPT:
+        if result in (ValidationResult.ACCEPT,
+                      ValidationResult.SAVE_FOR_FUTURE):
+            # future/unknown-parent blocks queue inside the manager and
+            # re-enter the FULL import validation when retried
             self.block_manager.import_block(signed_block)
-        elif result is ValidationResult.SAVE_FOR_FUTURE:
-            self.block_manager.import_block(signed_block)  # queues inside
         return result
 
     async def _process_gossip_attestation(self, att) -> ValidationResult:
         result = await self.attestation_validator.validate(att)
-        if result in (ValidationResult.ACCEPT,
-                      ValidationResult.SAVE_FOR_FUTURE):
+        if result is ValidationResult.ACCEPT:
             self.attestation_manager.add_attestation(att)
+        elif result is ValidationResult.SAVE_FOR_FUTURE:
+            # signature NOT yet checked (unknown block / future slot):
+            # defer the raw message and RE-VALIDATE later — it must not
+            # touch the pool or fork choice until it fully passes
+            self._defer("att", att)
         return result
 
     async def _process_gossip_aggregate(self, agg) -> ValidationResult:
         result = await self.aggregate_validator.validate(agg)
-        if result in (ValidationResult.ACCEPT,
-                      ValidationResult.SAVE_FOR_FUTURE):
+        if result is ValidationResult.ACCEPT:
             self.attestation_manager.add_attestation(agg.message.aggregate)
+        elif result is ValidationResult.SAVE_FOR_FUTURE:
+            self._defer("agg", agg)
         return result
+
+    def _defer(self, kind: str, msg) -> None:
+        if len(self._deferred_gossip) < 1024:
+            self._deferred_gossip.append((kind, msg, 0))
+
+    async def _retry_deferred(self) -> None:
+        """Re-validate deferred gossip (new slot or new blocks may have
+        unblocked it); three strikes and a message is dropped."""
+        items, self._deferred_gossip = self._deferred_gossip, []
+        for kind, msg, tries in items:
+            if kind == "att":
+                result = await self.attestation_validator.validate(msg)
+                if result is ValidationResult.ACCEPT:
+                    self.attestation_manager.add_attestation(msg)
+                    continue
+            else:
+                result = await self.aggregate_validator.validate(msg)
+                if result is ValidationResult.ACCEPT:
+                    self.attestation_manager.add_attestation(
+                        msg.message.aggregate)
+                    continue
+            if (result is ValidationResult.SAVE_FOR_FUTURE
+                    and tries < 3 and len(self._deferred_gossip) < 1024):
+                self._deferred_gossip.append((kind, msg, tries + 1))
 
     # ------------------------------------------------------------------
     async def do_start(self) -> None:
@@ -142,12 +170,13 @@ class BeaconNode(Service):
     # slot phases (reference SlotProcessor.onSlot / attestation-due)
     # ------------------------------------------------------------------
 
-    def on_slot(self, slot: int) -> None:
+    async def on_slot(self, slot: int) -> None:
         cfg = self.spec.config
         self.store.on_tick(self.store.genesis_time
                            + slot * cfg.SECONDS_PER_SLOT)
         self.block_manager.on_slot(slot)
         self.attestation_manager.on_slot(slot)
+        await self._retry_deferred()
         head = self.chain.update_head()
         self.channels.publisher(SlotEventsChannel).on_slot(slot)
         if slot % cfg.SLOTS_PER_EPOCH == 0:
@@ -155,97 +184,3 @@ class BeaconNode(Service):
                            self.store.justified_checkpoint.epoch,
                            self.store.finalized_checkpoint.epoch)
             self.pool.prune(self.store.finalized_checkpoint.epoch)
-
-
-class InProcessValidatorClient:
-    """Validator duties bound to one node — the devnet stand-in for the
-    reference's ValidatorClientService (reference: validator/client/
-    ValidatorClientService.java + duties/attestations/*): propose at
-    slot start, attest at 1/3, aggregate at 2/3, all signatures local.
-    """
-
-    def __init__(self, node: BeaconNode, secret_keys: Dict[int, int]):
-        self.node = node
-        self.spec = node.spec
-        self.keys = dict(secret_keys)
-        self.signer = make_local_signer(self.keys)
-        self.blocks_proposed = 0
-        self.attestations_sent = 0
-
-    # -- slot start: propose ------------------------------------------
-    async def on_slot_start(self, slot: int) -> None:
-        cfg = self.spec.config
-        pre = self.node.advanced_head_state(slot)
-        proposer = H.get_beacon_proposer_index(cfg, pre)
-        if proposer not in self.keys:
-            return
-        atts = self.node.pool.get_attestations_for_block(
-            pre, cfg.MAX_ATTESTATIONS)
-        signed, post = produce_block(cfg, pre, slot, self.signer,
-                                     attestations=atts)
-        self.blocks_proposed += 1
-        # local import + gossip publish
-        self.node.block_manager.import_block(signed)
-        await self.node.gossip.publish(
-            BEACON_BLOCK_TOPIC,
-            self.spec.schemas.SignedBeaconBlock.serialize(signed))
-
-    # -- 1/3 slot: attest ---------------------------------------------
-    async def on_attestation_due(self, slot: int) -> None:
-        cfg = self.spec.config
-        S = self.spec.schemas
-        head_root = self.node.chain.head_root
-        state = self.node.advanced_head_state(slot)
-        epoch = H.compute_epoch_at_slot(cfg, slot)
-        committees_per_slot = H.get_committee_count_per_slot(
-            cfg, state, epoch)
-        from ..spec.builder import attestation_data_for
-        for ci in range(committees_per_slot):
-            committee = H.get_beacon_committee(cfg, state, slot, ci)
-            mine = [v for v in committee if v in self.keys]
-            if not mine:
-                continue
-            data = attestation_data_for(cfg, state, slot, ci, head_root)
-            domain = H.get_domain(cfg, state, DOMAIN_BEACON_ATTESTER, epoch)
-            root = H.compute_signing_root(data, domain)
-            subnet = compute_subnet_for_attestation(
-                cfg, committees_per_slot, slot, ci)
-            for v in mine:
-                bits = tuple(m == v for m in committee)
-                att = S.Attestation(aggregation_bits=bits, data=data,
-                                    signature=self.signer(v, root))
-                self.attestations_sent += 1
-                self.node.attestation_manager.add_attestation(att)
-                await self.node.gossip.publish(
-                    attestation_subnet_topic(subnet),
-                    S.Attestation.serialize(att))
-
-    # -- 2/3 slot: aggregate ------------------------------------------
-    async def on_aggregation_due(self, slot: int) -> None:
-        cfg = self.spec.config
-        S = self.spec.schemas
-        state = self.node.advanced_head_state(slot)
-        epoch = H.compute_epoch_at_slot(cfg, slot)
-        committees_per_slot = H.get_committee_count_per_slot(
-            cfg, state, epoch)
-        for ci in range(committees_per_slot):
-            committee = H.get_beacon_committee(cfg, state, slot, ci)
-            for v in committee:
-                if v not in self.keys:
-                    continue
-                proof = get_selection_proof(cfg, state, slot, v,
-                                            self.signer)
-                if not is_aggregator(cfg, state, slot, ci, proof):
-                    continue
-                from ..spec.builder import attestation_data_for
-                data = attestation_data_for(
-                    cfg, state, slot, ci, self.node.chain.head_root)
-                agg = self.node.pool.get_aggregate(data)
-                if agg is None:
-                    continue
-                signed_agg = produce_aggregate_and_proof(
-                    cfg, state, agg, v, self.signer)
-                await self.node.gossip.publish(
-                    AGGREGATE_TOPIC,
-                    S.SignedAggregateAndProof.serialize(signed_agg))
-                break   # one aggregator per committee is enough locally

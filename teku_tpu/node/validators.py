@@ -123,6 +123,9 @@ class AggregateValidator:
             state = self.chain.store.get_checkpoint_state(data.target)
         except Exception:
             return IGNORE
+        if data.index >= H.get_committee_count_per_slot(
+                cfg, state, data.target.epoch):
+            return REJECT   # out-of-range index would alias another slot
         committee = H.get_beacon_committee(cfg, state, data.slot, data.index)
         if len(aggregate.aggregation_bits) != len(committee):
             return REJECT
@@ -191,8 +194,7 @@ class BlockGossipValidator:
         if parent_state.slot >= block.slot:
             return REJECT
         try:
-            pre = self.spec.process_slots(parent_state, block.slot) \
-                if parent_state.slot < block.slot else parent_state
+            pre = self.spec.process_slots(parent_state, block.slot)
             expected_proposer = H.get_beacon_proposer_index(cfg, pre)
         except Exception:
             return IGNORE

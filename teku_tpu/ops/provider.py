@@ -123,10 +123,15 @@ class JaxBls12381(BLS12381):
 
     name = "jax-tpu"
 
-    def __init__(self, max_batch: int = 4096, max_keys_per_lane: int = 2048):
+    def __init__(self, max_batch: int = 4096, max_keys_per_lane: int = 2048,
+                 min_bucket: int = 4):
         self._pure = PureBls12381()
         self.max_batch = max_batch
         self.max_keys_per_lane = max_keys_per_lane
+        # tiny batches pad up to one shared bucket: a couple of masked
+        # lanes cost microseconds on device, a fresh XLA compile costs
+        # minutes — fewer distinct shapes is strictly better
+        self.min_bucket = min_bucket
         # pk bytes -> ("ok", x_mont (L,), y_mont (L,)) | ("bad",)
         self._pk_cache: dict = {}
         self._u_cache: dict = {}
@@ -284,7 +289,7 @@ class JaxBls12381(BLS12381):
     # ------------------------------------------------------------------
     def _dispatch(self, semis: List[_Semi], randomize: bool) -> bool:
         n = len(semis)
-        padded = _next_pow2(n)
+        padded = max(_next_pow2(n), self.min_bucket)
         kmax = _next_pow2(max(len(s.pk_limbs) for s in semis))
         pk_xs = np.zeros((padded, kmax, fp.L), dtype=np.int64)
         pk_ys = np.zeros((padded, kmax, fp.L), dtype=np.int64)

@@ -213,11 +213,13 @@ class Store:
     # ------------------------------------------------------------------
 
     def on_attestation(self, attestation, is_from_block: bool = False,
-                       indexed=None):
+                       indexed=None, signature_verified: bool = False):
         """Spec on_attestation: validate slot/target/block linkage, then
-        record latest messages.  The caller provides the indexed form
-        when it already computed it (gossip path); otherwise it is
-        derived from the target checkpoint state."""
+        record latest messages.  `signature_verified=True` skips the
+        aggregate-signature re-check for attestations the gossip
+        pipeline already settled through the batch verifier — without
+        it every accepted attestation would pay a second, serial
+        pairing here."""
         data = attestation.data
         target = data.target
         if not is_from_block:
@@ -253,13 +255,14 @@ class Store:
             except AssertionError as exc:
                 raise ForkChoiceError(f"malformed attestation: {exc}") from exc
             # spec on_attestation: the indexed attestation must carry a
-            # valid aggregate signature (gossip pre-validation in the
-            # node feeds `indexed` instead and skips the re-check)
-            from ..spec.block import is_valid_indexed_attestation
-            from ..spec.verifiers import SIMPLE
-            if not is_valid_indexed_attestation(
-                    self.cfg, target_state, indexed, SIMPLE):
-                raise ForkChoiceError("invalid indexed attestation")
+            # valid aggregate signature (skipped when the gossip
+            # pipeline already batch-verified it)
+            if not signature_verified:
+                from ..spec.block import is_valid_indexed_attestation
+                from ..spec.verifiers import SIMPLE
+                if not is_valid_indexed_attestation(
+                        self.cfg, target_state, indexed, SIMPLE):
+                    raise ForkChoiceError("invalid indexed attestation")
         for vi in indexed.attesting_indices:
             if vi not in self._equivocating:
                 self.proto.process_attestation(

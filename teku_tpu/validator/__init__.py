@@ -1,0 +1,12 @@
+"""Validator stack: keystores, signers, slashing protection, duties.
+
+Reference: /root/reference/validator/ (client, api, remote) and
+/root/reference/infrastructure/bls-keystore/.
+"""
+
+from .api import (AttesterDuty, BeaconNodeValidatorApi, ProposerDuty,
+                  ValidatorApiChannel)
+from .client import ValidatorClient
+from .signer import (DutySigner, LocalSigner, SigningError,
+                     SlashingProtectedSigner)
+from .slashing_protection import SigningRecord, SlashingProtector

@@ -1,0 +1,177 @@
+"""ValidatorApiChannel: the BN↔VC seam.
+
+Equivalent of the reference's ValidatorApiChannel + ValidatorApiHandler
+(reference: validator/api/src/main/java/tech/pegasys/teku/validator/api/
+ValidatorApiChannel.java:52 and beacon/validator/.../coordinator/
+ValidatorApiHandler.java): duties queries, unsigned production,
+submission.  The in-process implementation binds directly to a
+BeaconNode (reference InProcessBeaconNodeApi); a remote implementation
+can speak the REST API instead without the client changing.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..spec import helpers as H
+from ..spec.builder import attestation_data_for, produce_block
+from ..node.gossip import (AGGREGATE_TOPIC, attestation_subnet_topic,
+                           BEACON_BLOCK_TOPIC)
+from ..node.node import BeaconNode, compute_subnet_for_attestation
+
+
+@dataclass
+class AttesterDuty:
+    validator_index: int
+    slot: int
+    committee_index: int
+    committee_position: int
+    committee_size: int
+    committees_at_slot: int
+
+
+@dataclass
+class ProposerDuty:
+    validator_index: int
+    slot: int
+
+
+class ValidatorApiChannel:
+    """The full duty surface the VC consumes."""
+
+    def get_proposer_duties(self, epoch: int) -> List[ProposerDuty]:
+        raise NotImplementedError
+
+    def get_attester_duties(self, epoch: int,
+                            indices: Sequence[int]) -> List[AttesterDuty]:
+        raise NotImplementedError
+
+    def get_attestation_data(self, slot: int, committee_index: int):
+        raise NotImplementedError
+
+    async def produce_unsigned_block(self, slot: int, randao_reveal: bytes,
+                                     graffiti: bytes):
+        raise NotImplementedError
+
+    async def publish_signed_block(self, signed_block) -> None:
+        raise NotImplementedError
+
+    async def publish_attestation(self, attestation) -> None:
+        raise NotImplementedError
+
+    def get_aggregate(self, data):
+        raise NotImplementedError
+
+    async def publish_aggregate_and_proof(self, signed_aggregate) -> None:
+        raise NotImplementedError
+
+    def duty_state(self, slot: int):
+        """Head state advanced to `slot` (signing context)."""
+        raise NotImplementedError
+
+
+class BeaconNodeValidatorApi(ValidatorApiChannel):
+    """In-process binding to one BeaconNode."""
+
+    def __init__(self, node: BeaconNode):
+        self.node = node
+        self.spec = node.spec
+
+    # -- duties --------------------------------------------------------
+    def get_proposer_duties(self, epoch: int) -> List[ProposerDuty]:
+        cfg = self.spec.config
+        out = []
+        first = H.compute_start_slot_at_epoch(cfg, epoch)
+        # advance ONE state incrementally across the epoch's slots: the
+        # expensive epoch-boundary transition runs once, not per slot
+        from ..spec.transition import process_slots
+        state = self.node.advanced_head_state(max(first, 1))
+        for slot in range(max(first, 1), first + cfg.SLOTS_PER_EPOCH):
+            if state.slot < slot:
+                state = process_slots(cfg, state, slot)
+            out.append(ProposerDuty(
+                validator_index=H.get_beacon_proposer_index(cfg, state),
+                slot=slot))
+        return out
+
+    def get_attester_duties(self, epoch: int,
+                            indices: Sequence[int]) -> List[AttesterDuty]:
+        cfg = self.spec.config
+        wanted = set(indices)
+        out = []
+        first = H.compute_start_slot_at_epoch(cfg, epoch)
+        state = self.node.advanced_head_state(max(first, 1))
+        committees = H.get_committee_count_per_slot(cfg, state, epoch)
+        for slot in range(first, first + cfg.SLOTS_PER_EPOCH):
+            for ci in range(committees):
+                committee = H.get_beacon_committee(cfg, state, slot, ci)
+                for pos, vi in enumerate(committee):
+                    if vi in wanted:
+                        out.append(AttesterDuty(
+                            validator_index=vi, slot=slot,
+                            committee_index=ci, committee_position=pos,
+                            committee_size=len(committee),
+                            committees_at_slot=committees))
+        return out
+
+    # -- production ----------------------------------------------------
+    def duty_state(self, slot: int):
+        return self.node.advanced_head_state(slot)
+
+    def get_attestation_data(self, slot: int, committee_index: int):
+        state = self.node.advanced_head_state(slot)
+        return attestation_data_for(self.spec.config, state, slot,
+                                    committee_index,
+                                    self.node.chain.head_root)
+
+    async def produce_unsigned_block(self, slot: int, randao_reveal: bytes,
+                                     graffiti: bytes = bytes(32)):
+        """(unsigned block with state_root, pre_state) — the caller
+        signs.  Mirrors ValidatorApiHandler.createUnsignedBlock."""
+        cfg = self.spec.config
+        pre = self.node.advanced_head_state(slot)
+        atts = self.node.pool.get_attestations_for_block(
+            pre, cfg.MAX_ATTESTATIONS)
+        # produce with a throwaway signer for randao (already provided)
+        from ..spec import block as B
+        from ..spec.builder import _parent_root, _TRUSTING
+        S = self.spec.schemas
+        body = S.BeaconBlockBody(
+            randao_reveal=randao_reveal, eth1_data=pre.eth1_data,
+            graffiti=graffiti, attestations=tuple(atts))
+        block = S.BeaconBlock(
+            slot=slot,
+            proposer_index=H.get_beacon_proposer_index(cfg, pre),
+            parent_root=_parent_root(pre), state_root=bytes(32), body=body)
+        post = B.process_block(cfg, pre, block, _TRUSTING, _TRUSTING)
+        return block.copy_with(state_root=post.htr()), pre
+
+    # -- submission ----------------------------------------------------
+    async def publish_signed_block(self, signed_block) -> None:
+        self.node.block_manager.import_block(signed_block)
+        await self.node.gossip.publish(
+            BEACON_BLOCK_TOPIC,
+            self.spec.schemas.SignedBeaconBlock.serialize(signed_block))
+
+    async def publish_attestation(self, attestation) -> None:
+        cfg = self.spec.config
+        data = attestation.data
+        state = self.node.advanced_head_state(max(data.slot, 1))
+        committees = H.get_committee_count_per_slot(cfg, state,
+                                                    data.target.epoch)
+        subnet = compute_subnet_for_attestation(
+            cfg, committees, data.slot, data.index)
+        self.node.attestation_manager.add_attestation(attestation)
+        await self.node.gossip.publish(
+            attestation_subnet_topic(subnet),
+            self.spec.schemas.Attestation.serialize(attestation))
+
+    def get_aggregate(self, data):
+        return self.node.pool.get_aggregate(data)
+
+    async def publish_aggregate_and_proof(self, signed_aggregate) -> None:
+        self.node.attestation_manager.add_attestation(
+            signed_aggregate.message.aggregate)
+        await self.node.gossip.publish(
+            AGGREGATE_TOPIC,
+            self.spec.schemas.SignedAggregateAndProof.serialize(
+                signed_aggregate))

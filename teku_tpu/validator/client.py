@@ -1,0 +1,146 @@
+"""Validator client: epoch duty schedulers driving signed duties
+through the ValidatorApiChannel.
+
+Equivalent of the reference's validator client (reference: validator/
+client/src/main/java/tech/pegasys/teku/validator/client/
+ValidatorClientService.java, AttestationDutyScheduler.java,
+BlockDutyScheduler.java, duties/attestations/AttestationProductionDuty
+.java, AggregationDuty.java): duties are queried once per epoch,
+executed at their slot phases, and every signature flows through the
+(slashing-protected) DutySigner — the client never touches raw keys or
+the node's internals, only the API channel.
+"""
+
+import logging
+from typing import Dict, List, Optional
+
+from ..spec import helpers as H
+from ..spec import Spec
+from ..spec.builder import is_aggregator
+from .api import AttesterDuty, ProposerDuty, ValidatorApiChannel
+from .signer import DutySigner, SigningError
+
+_LOG = logging.getLogger(__name__)
+
+
+class ValidatorClient:
+    """One client managing a set of validator indices."""
+
+    def __init__(self, spec: Spec, api: ValidatorApiChannel,
+                 signer: DutySigner, validator_indices: List[int],
+                 graffiti: bytes = bytes(32)):
+        self.spec = spec
+        self.api = api
+        self.signer = signer
+        self.indices = list(validator_indices)
+        self.graffiti = graffiti
+        self._proposer_duties: Dict[int, List[ProposerDuty]] = {}
+        self._attester_duties: Dict[int, List[AttesterDuty]] = {}
+        self.blocks_proposed = 0
+        self.attestations_sent = 0
+        self.aggregates_sent = 0
+
+    # -- duty loading (once per epoch, reference RetryingDutyLoader) ---
+    def _duties_for_epoch(self, epoch: int) -> None:
+        if epoch not in self._proposer_duties:
+            mine = set(self.indices)
+            self._proposer_duties[epoch] = [
+                d for d in self.api.get_proposer_duties(epoch)
+                if d.validator_index in mine]
+            self._attester_duties[epoch] = self.api.get_attester_duties(
+                epoch, self.indices)
+            for old in [e for e in self._proposer_duties if e < epoch - 1]:
+                del self._proposer_duties[old]
+                del self._attester_duties[old]
+
+    # -- slot phases ---------------------------------------------------
+    async def on_slot_start(self, slot: int) -> None:
+        cfg = self.spec.config
+        epoch = H.compute_epoch_at_slot(cfg, slot)
+        self._duties_for_epoch(epoch)
+        for duty in self._proposer_duties[epoch]:
+            if duty.slot != slot:
+                continue
+            state = self.api.duty_state(slot)
+            try:
+                reveal = self.signer.sign_randao_reveal(
+                    cfg, state, epoch, duty.validator_index)
+                block, pre = await self.api.produce_unsigned_block(
+                    slot, reveal, self.graffiti)
+                signature = self.signer.sign_block(cfg, pre, block)
+            except SigningError as exc:
+                _LOG.warning("block duty refused: %s", exc)
+                continue
+            except Exception:
+                # a failed proposal must never kill the duty driver
+                # (reference duties log-and-continue via SafeFuture)
+                _LOG.exception("block production failed at slot %d", slot)
+                continue
+            signed = self.spec.schemas.SignedBeaconBlock(
+                message=block, signature=signature)
+            await self.api.publish_signed_block(signed)
+            self.blocks_proposed += 1
+
+    async def on_attestation_due(self, slot: int) -> None:
+        cfg = self.spec.config
+        epoch = H.compute_epoch_at_slot(cfg, slot)
+        self._duties_for_epoch(epoch)
+        S = self.spec.schemas
+        data_by_committee = {}
+        for duty in self._attester_duties[epoch]:
+            if duty.slot != slot:
+                continue
+            if duty.committee_index not in data_by_committee:
+                data_by_committee[duty.committee_index] = (
+                    self.api.get_attestation_data(slot,
+                                                  duty.committee_index))
+            data = data_by_committee[duty.committee_index]
+            state = self.api.duty_state(slot)
+            try:
+                sig = self.signer.sign_attestation_data(
+                    cfg, state, data, duty.validator_index)
+            except SigningError as exc:
+                _LOG.warning("attestation duty refused: %s", exc)
+                continue
+            bits = tuple(i == duty.committee_position
+                         for i in range(duty.committee_size))
+            att = S.Attestation(aggregation_bits=bits, data=data,
+                                signature=sig)
+            await self.api.publish_attestation(att)
+            self.attestations_sent += 1
+
+    async def on_aggregation_due(self, slot: int) -> None:
+        cfg = self.spec.config
+        epoch = H.compute_epoch_at_slot(cfg, slot)
+        self._duties_for_epoch(epoch)
+        S = self.spec.schemas
+        aggregated_committees = set()
+        for duty in self._attester_duties[epoch]:
+            if duty.slot != slot:
+                continue
+            if duty.committee_index in aggregated_committees:
+                continue
+            state = self.api.duty_state(slot)
+            try:
+                proof = self.signer.sign_selection_proof(
+                    cfg, state, slot, duty.validator_index)
+            except SigningError:
+                continue
+            if not is_aggregator(cfg, state, slot, duty.committee_index,
+                                 proof):
+                continue
+            data = self.api.get_attestation_data(slot, duty.committee_index)
+            aggregate = self.api.get_aggregate(data)
+            if aggregate is None:
+                continue
+            msg = S.AggregateAndProof(
+                aggregator_index=duty.validator_index,
+                aggregate=aggregate, selection_proof=proof)
+            try:
+                sig = self.signer.sign_aggregate_and_proof(cfg, state, msg)
+            except SigningError:
+                continue
+            signed = S.SignedAggregateAndProof(message=msg, signature=sig)
+            await self.api.publish_aggregate_and_proof(signed)
+            self.aggregates_sent += 1
+            aggregated_committees.add(duty.committee_index)

@@ -1,0 +1,130 @@
+"""Duty signers: local keys + slashing-protected wrapper.
+
+Equivalent of the reference's signature stack (reference: ethereum/
+spec/src/main/java/tech/pegasys/teku/spec/signatures/Signer.java,
+LocalSigner.java, SlashingProtectedSigner.java, SigningRootUtil.java):
+a Signer turns duty payloads into BLS signatures; the slashing-protected
+wrapper consults the protector BEFORE the key touches anything.
+"""
+
+from typing import Dict, Optional
+
+from ..crypto import bls
+from ..spec import helpers as H
+from ..spec.config import (DOMAIN_AGGREGATE_AND_PROOF,
+                           DOMAIN_BEACON_ATTESTER, DOMAIN_BEACON_PROPOSER,
+                           SpecConfig)
+from .slashing_protection import SlashingProtector
+
+
+class SigningError(Exception):
+    """Refused (slashing risk) or impossible (unknown key)."""
+
+
+class DutySigner:
+    """Typed duty-signing API (reference Signer.java)."""
+
+    def sign_block(self, cfg: SpecConfig, state, block) -> bytes:
+        raise NotImplementedError
+
+    def sign_attestation_data(self, cfg: SpecConfig, state, data,
+                              validator_index: int) -> bytes:
+        raise NotImplementedError
+
+    def sign_randao_reveal(self, cfg: SpecConfig, state, epoch: int,
+                           validator_index: int) -> bytes:
+        raise NotImplementedError
+
+    def sign_aggregate_and_proof(self, cfg: SpecConfig, state, msg) -> bytes:
+        raise NotImplementedError
+
+    def sign_selection_proof(self, cfg: SpecConfig, state, slot: int,
+                             validator_index: int) -> bytes:
+        raise NotImplementedError
+
+
+class LocalSigner(DutySigner):
+    def __init__(self, secret_keys_by_index: Dict[int, int],
+                 pubkeys_by_index: Optional[Dict[int, bytes]] = None):
+        self.keys = dict(secret_keys_by_index)
+        self.pubkeys = pubkeys_by_index or {
+            i: bls.secret_to_public_key(sk) for i, sk in self.keys.items()}
+
+    def _sign(self, validator_index: int, root: bytes) -> bytes:
+        sk = self.keys.get(validator_index)
+        if sk is None:
+            raise SigningError(f"no key for validator {validator_index}")
+        return bls.sign(sk, root)
+
+    def sign_block(self, cfg, state, block) -> bytes:
+        domain = H.get_domain(cfg, state, DOMAIN_BEACON_PROPOSER,
+                              H.compute_epoch_at_slot(cfg, block.slot))
+        return self._sign(block.proposer_index,
+                          H.compute_signing_root(block, domain))
+
+    def sign_attestation_data(self, cfg, state, data,
+                              validator_index) -> bytes:
+        domain = H.get_domain(cfg, state, DOMAIN_BEACON_ATTESTER,
+                              data.target.epoch)
+        return self._sign(validator_index,
+                          H.compute_signing_root(data, domain))
+
+    def sign_randao_reveal(self, cfg, state, epoch,
+                           validator_index) -> bytes:
+        return self._sign(validator_index,
+                          H.randao_signing_root(cfg, state, epoch))
+
+    def sign_aggregate_and_proof(self, cfg, state, msg) -> bytes:
+        domain = H.get_domain(
+            cfg, state, DOMAIN_AGGREGATE_AND_PROOF,
+            H.compute_epoch_at_slot(cfg, msg.aggregate.data.slot))
+        return self._sign(msg.aggregator_index,
+                          H.compute_signing_root(msg, domain))
+
+    def sign_selection_proof(self, cfg, state, slot,
+                             validator_index) -> bytes:
+        return self._sign(validator_index,
+                          H.selection_proof_signing_root(cfg, state, slot))
+
+
+class SlashingProtectedSigner(DutySigner):
+    """Wraps a signer; block + attestation signatures consult the
+    protector first (reference SlashingProtectedSigner.java).  RANDAO,
+    selection proofs and aggregates carry no slashing risk and pass
+    through."""
+
+    def __init__(self, inner: LocalSigner, protector: SlashingProtector):
+        self.inner = inner
+        self.protector = protector
+
+    def _pubkey(self, validator_index: int) -> bytes:
+        return self.inner.pubkeys[validator_index]
+
+    def sign_block(self, cfg, state, block) -> bytes:
+        if not self.protector.may_sign_block(
+                self._pubkey(block.proposer_index), block.slot):
+            raise SigningError(
+                f"slashing protection refused block at slot {block.slot}")
+        return self.inner.sign_block(cfg, state, block)
+
+    def sign_attestation_data(self, cfg, state, data,
+                              validator_index) -> bytes:
+        if not self.protector.may_sign_attestation(
+                self._pubkey(validator_index), data.source.epoch,
+                data.target.epoch):
+            raise SigningError(
+                f"slashing protection refused attestation "
+                f"{data.source.epoch}->{data.target.epoch}")
+        return self.inner.sign_attestation_data(cfg, state, data,
+                                                validator_index)
+
+    def sign_randao_reveal(self, cfg, state, epoch, validator_index):
+        return self.inner.sign_randao_reveal(cfg, state, epoch,
+                                             validator_index)
+
+    def sign_aggregate_and_proof(self, cfg, state, msg):
+        return self.inner.sign_aggregate_and_proof(cfg, state, msg)
+
+    def sign_selection_proof(self, cfg, state, slot, validator_index):
+        return self.inner.sign_selection_proof(cfg, state, slot,
+                                               validator_index)

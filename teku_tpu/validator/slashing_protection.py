@@ -1,0 +1,131 @@
+"""Local slashing protection: signing records + EIP-3076 interchange.
+
+Equivalent of the reference's slashing protection (reference:
+ethereum/spec/src/main/java/tech/pegasys/teku/spec/signatures/
+LocalSlashingProtector.java, data/dataexchange/ for the EIP-3076
+import/export): before any block or attestation signature, the signing
+record for that validator must admit it — blocks strictly ascend by
+slot, attestation sources/targets never regress or surround.
+"""
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Optional, Union
+
+
+@dataclass
+class SigningRecord:
+    """reference: ethereum/signingrecord ValidatorSigningRecord."""
+    block_slot: int = 0
+    source_epoch: Optional[int] = None
+    target_epoch: Optional[int] = None
+
+    def may_sign_block(self, slot: int) -> bool:
+        return slot > self.block_slot
+
+    def may_sign_attestation(self, source: int, target: int) -> bool:
+        if self.source_epoch is None and self.target_epoch is None:
+            return source <= target
+        if source > target:
+            return False
+        if self.source_epoch is not None and source < self.source_epoch:
+            return False
+        if self.target_epoch is not None and target <= self.target_epoch:
+            return False
+        return True
+
+
+class SlashingProtector:
+    """Per-pubkey records, persisted as one JSON file per validator
+    (the reference stores YAML per validator in the data dir)."""
+
+    def __init__(self, data_dir: Optional[Union[str, Path]] = None):
+        self._dir = Path(data_dir) if data_dir else None
+        self._records: Dict[bytes, SigningRecord] = {}
+        if self._dir is not None:
+            self._dir.mkdir(parents=True, exist_ok=True)
+            for f in self._dir.glob("*.json"):
+                d = json.loads(f.read_text())
+                self._records[bytes.fromhex(f.stem)] = SigningRecord(
+                    block_slot=d.get("block_slot", 0),
+                    source_epoch=d.get("source_epoch"),
+                    target_epoch=d.get("target_epoch"))
+
+    def _get(self, pubkey: bytes) -> SigningRecord:
+        rec = self._records.get(pubkey)
+        if rec is None:
+            rec = self._records[pubkey] = SigningRecord()
+        return rec
+
+    def _persist(self, pubkey: bytes) -> None:
+        if self._dir is None:
+            return
+        rec = self._records[pubkey]
+        (self._dir / f"{pubkey.hex()}.json").write_text(json.dumps({
+            "block_slot": rec.block_slot,
+            "source_epoch": rec.source_epoch,
+            "target_epoch": rec.target_epoch}))
+
+    # -- the two checks, record-before-sign ---------------------------
+    def may_sign_block(self, pubkey: bytes, slot: int) -> bool:
+        rec = self._get(pubkey)
+        if not rec.may_sign_block(slot):
+            return False
+        rec.block_slot = slot
+        self._persist(pubkey)
+        return True
+
+    def may_sign_attestation(self, pubkey: bytes, source_epoch: int,
+                             target_epoch: int) -> bool:
+        rec = self._get(pubkey)
+        if not rec.may_sign_attestation(source_epoch, target_epoch):
+            return False
+        rec.source_epoch = source_epoch
+        rec.target_epoch = target_epoch
+        self._persist(pubkey)
+        return True
+
+    # -- EIP-3076 interchange -----------------------------------------
+    def export_interchange(self, genesis_validators_root: bytes) -> dict:
+        return {
+            "metadata": {
+                "interchange_format_version": "5",
+                "genesis_validators_root":
+                    "0x" + genesis_validators_root.hex(),
+            },
+            "data": [
+                {
+                    "pubkey": "0x" + pk.hex(),
+                    "signed_blocks": (
+                        [{"slot": str(rec.block_slot)}]
+                        if rec.block_slot else []),
+                    "signed_attestations": (
+                        [{"source_epoch": str(rec.source_epoch),
+                          "target_epoch": str(rec.target_epoch)}]
+                        if rec.target_epoch is not None else []),
+                }
+                for pk, rec in sorted(self._records.items())
+            ],
+        }
+
+    def import_interchange(self, doc: dict,
+                           genesis_validators_root: bytes) -> int:
+        meta_root = doc["metadata"]["genesis_validators_root"]
+        if bytes.fromhex(meta_root[2:]) != genesis_validators_root:
+            raise ValueError("interchange for a different chain")
+        n = 0
+        for entry in doc["data"]:
+            pk = bytes.fromhex(entry["pubkey"][2:])
+            rec = self._get(pk)
+            for sb in entry.get("signed_blocks", ()):
+                rec.block_slot = max(rec.block_slot, int(sb["slot"]))
+            for sa in entry.get("signed_attestations", ()):
+                src, tgt = int(sa["source_epoch"]), int(sa["target_epoch"])
+                if rec.source_epoch is None or src > rec.source_epoch:
+                    rec.source_epoch = src
+                if rec.target_epoch is None or tgt > rec.target_epoch:
+                    rec.target_epoch = tgt
+            self._persist(pk)
+            n += 1
+        return n

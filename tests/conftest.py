@@ -23,3 +23,16 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: the heavy pairing-kernel compiles are
+# identical across runs, so pay them once per machine, not per pytest
+# invocation.  (The cache key includes platform/flags, so the 8-device
+# CPU programs never leak into TPU runs.)
+_cache_dir = os.path.join(os.path.dirname(__file__), "..", ".jax_cache")
+try:
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.abspath(_cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+except Exception:  # pragma: no cover - older jax without these knobs
+    pass

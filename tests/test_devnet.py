@@ -39,6 +39,45 @@ def test_devnet_two_nodes_finalize():
 
 
 @pytest.mark.slow
+def test_unverified_save_for_future_attestation_never_pools():
+    """A garbage-signature attestation for an unknown block must not
+    poison the block-production pool (its signature was never checked —
+    gossip says SAVE_FOR_FUTURE before the batch verifier runs)."""
+    async def run():
+        net = Devnet(n_nodes=1, n_validators=16)
+        await net.start()
+        try:
+            await net.run_until_slot(2)
+            node = net.nodes[0]
+            S = net.spec.schemas
+            from teku_tpu.spec.datastructures import (AttestationData,
+                                                      Checkpoint)
+            committee = net.spec.get_beacon_committee(
+                node.chain.head_state(), 2, 0)
+            evil = S.Attestation(
+                aggregation_bits=tuple(i == 0 for i in
+                                       range(len(committee))),
+                data=AttestationData(
+                    slot=2, index=0,
+                    beacon_block_root=b"\x66" * 32,   # unknown block
+                    source=Checkpoint(epoch=0, root=bytes(32)),
+                    target=Checkpoint(epoch=0, root=b"\x67" * 32)),
+                signature=b"\x99" * 96)
+            handler = node.gossip._handlers["beacon_attestation_0"]
+            res = await handler.handle_message(S.Attestation.serialize(evil))
+            assert res is ValidationResult.SAVE_FOR_FUTURE
+            assert node.pool.get_aggregate(evil.data) is None, (
+                "unverified attestation reached the production pool")
+            # and after retries exhaust, it still never pools
+            for slot in (3, 4, 5, 6):
+                await node.on_slot(slot)
+            assert node.pool.get_aggregate(evil.data) is None
+        finally:
+            await net.stop()
+    asyncio.run(run())
+
+
+@pytest.mark.slow
 def test_devnet_rejects_invalid_gossip_block():
     async def run():
         net = Devnet(n_nodes=2, n_validators=16)
@@ -51,7 +90,7 @@ def test_devnet_rejects_invalid_gossip_block():
             # craft a structurally-correct slot-4 block (right proposer,
             # right parent) with a garbage signature: it must fail ONLY
             # at the signature check, i.e. be REJECTed and not imported
-            b.on_slot(4)
+            await b.on_slot(4)
             pre = b.advanced_head_state(4)
             proposer = HH.get_beacon_proposer_index(net.spec.config, pre)
             hdr = pre.latest_block_header

@@ -1,0 +1,148 @@
+"""Validator stack: EIP-2335 keystores against the reference's own test
+vectors, slashing protection semantics, duty-signing client wiring."""
+
+import asyncio
+import json
+from pathlib import Path
+
+import pytest
+
+from teku_tpu.validator.keystore import (decrypt, encrypt, KeystoreError,
+                                         load_directory)
+from teku_tpu.validator.signer import (LocalSigner, SigningError,
+                                       SlashingProtectedSigner)
+from teku_tpu.validator.slashing_protection import (SigningRecord,
+                                                    SlashingProtector)
+
+VECTORS = Path("/root/reference/infrastructure/bls-keystore/src/test/"
+               "resources/tech/pegasys/teku/bls/keystore")
+
+# EIP-2335 official test password (mathematical-fraktur "testpassword" +
+# U+1F511) and secret, as pinned by the reference's KeyStoreTest.java:48-50
+EIP2335_PASSWORD = ("\U0001D531\U0001D522\U0001D530\U0001D531\U0001D52D"
+                    "\U0001D51E\U0001D530\U0001D530\U0001D534\U0001D52C"
+                    "\U0001D52F\U0001D521\U0001F511")
+EIP2335_SECRET = bytes.fromhex(
+    "000000000019d6689c085ae165831e934ff763ae46a2a6c172b3f1b60a8ce26f")
+
+needs_vectors = pytest.mark.skipif(not VECTORS.is_dir(),
+                                   reason="reference vectors not present")
+
+
+@needs_vectors
+def test_pbkdf2_official_vector():
+    ks = json.loads((VECTORS / "pbkdf2TestVector.json").read_text())
+    assert decrypt(ks, EIP2335_PASSWORD) == EIP2335_SECRET
+
+
+@needs_vectors
+@pytest.mark.slow
+def test_scrypt_official_vector():
+    ks = json.loads((VECTORS / "scryptTestVector.json").read_text())
+    assert decrypt(ks, EIP2335_PASSWORD) == EIP2335_SECRET
+
+
+@needs_vectors
+def test_wrong_password_rejected():
+    ks = json.loads((VECTORS / "pbkdf2TestVector.json").read_text())
+    with pytest.raises(KeystoreError, match="checksum"):
+        decrypt(ks, "wrong password")
+
+
+@needs_vectors
+def test_unsupported_variants_rejected():
+    for name in ("unsupportedChecksumFunction.json",
+                 "unsupportedCipherFunction.json",
+                 "unsupportedKdfFunction.json",
+                 "unsupportedPBKDF2Prf.json",
+                 "v3TestVector.json"):
+        ks = json.loads((VECTORS / name).read_text())
+        with pytest.raises((KeystoreError, KeyError)):
+            decrypt(ks, EIP2335_PASSWORD)
+
+
+def test_encrypt_roundtrip_pbkdf2():
+    secret = bytes(range(32))
+    ks = encrypt(secret, "hunter2 🔐", kdf="pbkdf2")
+    assert decrypt(ks, "hunter2 🔐") == secret
+    with pytest.raises(KeystoreError):
+        decrypt(ks, "hunter3")
+
+
+def test_load_directory(tmp_path):
+    keys = tmp_path / "keys"
+    pws = tmp_path / "passwords"
+    keys.mkdir(), pws.mkdir()
+    secret = b"\x01" * 32
+    ks = encrypt(secret, "pw", kdf="pbkdf2", pubkey=b"\xaa" * 48)
+    (keys / "v1.json").write_text(json.dumps(ks))
+    (pws / "v1.txt").write_text("pw\n")
+    loaded = load_directory(keys, pws)
+    assert loaded == {b"\xaa" * 48: int.from_bytes(secret, "big")}
+
+
+# --------------------------------------------------------------------------
+# Slashing protection
+# --------------------------------------------------------------------------
+
+def test_signing_record_rules():
+    r = SigningRecord()
+    assert r.may_sign_attestation(0, 1)
+    r = SigningRecord(block_slot=5, source_epoch=2, target_epoch=3)
+    assert not r.may_sign_block(5)          # same slot = double proposal
+    assert r.may_sign_block(6)
+    assert not r.may_sign_attestation(1, 4)  # source regression = surround
+    assert not r.may_sign_attestation(2, 3)  # same target = double vote
+    assert not r.may_sign_attestation(4, 3)  # source > target
+    assert r.may_sign_attestation(2, 4)
+
+
+def test_protector_persists(tmp_path):
+    pk = b"\xbb" * 48
+    p1 = SlashingProtector(tmp_path)
+    assert p1.may_sign_block(pk, 10)
+    assert p1.may_sign_attestation(pk, 1, 2)
+    # reload from disk: records survive a restart
+    p2 = SlashingProtector(tmp_path)
+    assert not p2.may_sign_block(pk, 10)
+    assert not p2.may_sign_attestation(pk, 1, 2)
+    assert p2.may_sign_block(pk, 11)
+
+
+def test_interchange_roundtrip(tmp_path):
+    gvr = b"\x11" * 32
+    p1 = SlashingProtector()
+    pk = b"\xcc" * 48
+    p1.may_sign_block(pk, 42)
+    p1.may_sign_attestation(pk, 5, 6)
+    doc = p1.export_interchange(gvr)
+    assert doc["metadata"]["interchange_format_version"] == "5"
+    p2 = SlashingProtector()
+    assert p2.import_interchange(doc, gvr) == 1
+    assert not p2.may_sign_block(pk, 42)
+    with pytest.raises(ValueError):
+        p2.import_interchange(doc, b"\x22" * 32)
+
+
+# --------------------------------------------------------------------------
+# Slashing-protected signer refuses conflicting duties
+# --------------------------------------------------------------------------
+
+def test_protected_signer_refuses_double_attestation():
+    from teku_tpu.spec import config as C
+    from teku_tpu.spec.genesis import interop_genesis
+    from teku_tpu.spec.datastructures import AttestationData, Checkpoint
+    cfg = C.MINIMAL
+    state, sks = interop_genesis(cfg, 4)
+    signer = SlashingProtectedSigner(
+        LocalSigner({0: sks[0]}), SlashingProtector())
+    data = AttestationData(
+        slot=8, index=0, beacon_block_root=b"\x01" * 32,
+        source=Checkpoint(epoch=0, root=bytes(32)),
+        target=Checkpoint(epoch=1, root=b"\x02" * 32))
+    sig = signer.sign_attestation_data(cfg, state, data, 0)
+    assert len(sig) == 96
+    # same target epoch, different data: must refuse
+    data2 = data.copy_with(beacon_block_root=b"\x03" * 32)
+    with pytest.raises(SigningError):
+        signer.sign_attestation_data(cfg, state, data2, 0)
