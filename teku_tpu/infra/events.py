@@ -61,8 +61,8 @@ def _safe_call(fn: Callable, args, kwargs) -> None:
 
 def _log_task_error(task: "asyncio.Task", fn: Callable) -> None:
     if not task.cancelled() and task.exception() is not None:
-        _LOG.error("async event subscriber %r failed: %r",
-                   fn, task.exception())
+        _LOG.error("async event subscriber %r failed", fn,
+                   exc_info=task.exception())
 
 
 class EventChannels:

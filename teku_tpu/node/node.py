@@ -11,9 +11,8 @@ timer or a devnet driver invokes.
 """
 
 import logging
-from typing import Dict, List, Optional, Sequence
+from typing import List, Optional
 
-from ..crypto import bls
 from ..infra.events import EventChannels, SlotEventsChannel
 from ..infra.logs import log_slot_event
 from ..infra.service import Service

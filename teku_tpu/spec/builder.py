@@ -80,6 +80,35 @@ def produce_attestations(cfg: SpecConfig, state, slot: int,
     return out
 
 
+def build_unsigned_block(cfg: SpecConfig, pre, slot: int,
+                         randao_reveal: bytes,
+                         attestations: Sequence = (),
+                         deposits: Sequence = (),
+                         proposer_slashings: Sequence = (),
+                         attester_slashings: Sequence = (),
+                         voluntary_exits: Sequence = (),
+                         graffiti: bytes = bytes(32)):
+    """(unsigned block with state root filled, post_state) on an
+    already-slot-advanced pre-state — the ONE body-construction recipe
+    shared by local production and the validator API (reference:
+    BlockProposalUtil.createNewUnsignedBlock)."""
+    from . import block as B
+    S = get_schemas(cfg)
+    assert pre.slot == slot, "pre-state must be advanced to the slot"
+    body = S.BeaconBlockBody(
+        randao_reveal=randao_reveal,
+        eth1_data=pre.eth1_data, graffiti=graffiti,
+        proposer_slashings=tuple(proposer_slashings),
+        attester_slashings=tuple(attester_slashings),
+        attestations=tuple(attestations), deposits=tuple(deposits),
+        voluntary_exits=tuple(voluntary_exits))
+    block = S.BeaconBlock(
+        slot=slot, proposer_index=H.get_beacon_proposer_index(cfg, pre),
+        parent_root=_parent_root(pre), state_root=bytes(32), body=body)
+    post = B.process_block(cfg, pre, block, _TRUSTING, _TRUSTING)
+    return block.copy_with(state_root=post.htr()), post
+
+
 def produce_block(cfg: SpecConfig, state, slot: int, signer: Signer,
                   attestations: Sequence = (),
                   deposits: Sequence = (),
@@ -91,27 +120,15 @@ def produce_block(cfg: SpecConfig, state, slot: int, signer: Signer,
 
     Returns (signed_block, post_state).  The state root is computed by
     running the real transition with signature validation disabled
-    (production trusts its own signatures), mirroring the reference's
-    unsigned-block + state-root flow (BlockProposalUtil.java
-    createNewUnsignedBlock)."""
-    from . import block as B
+    (production trusts its own signatures)."""
     S = get_schemas(cfg)
     pre = process_slots(cfg, state, slot) if state.slot < slot else state
     proposer_index = H.get_beacon_proposer_index(cfg, pre)
     epoch = H.compute_epoch_at_slot(cfg, slot)
-    body = S.BeaconBlockBody(
-        randao_reveal=get_randao_reveal(cfg, pre, epoch, proposer_index,
-                                        signer),
-        eth1_data=pre.eth1_data, graffiti=graffiti,
-        proposer_slashings=tuple(proposer_slashings),
-        attester_slashings=tuple(attester_slashings),
-        attestations=tuple(attestations), deposits=tuple(deposits),
-        voluntary_exits=tuple(voluntary_exits))
-    block = S.BeaconBlock(
-        slot=slot, proposer_index=proposer_index,
-        parent_root=_parent_root(pre), state_root=bytes(32), body=body)
-    post = B.process_block(cfg, pre, block, _TRUSTING, _TRUSTING)
-    block = block.copy_with(state_root=post.htr())
+    reveal = get_randao_reveal(cfg, pre, epoch, proposer_index, signer)
+    block, post = build_unsigned_block(
+        cfg, pre, slot, reveal, attestations, deposits,
+        proposer_slashings, attester_slashings, voluntary_exits, graffiti)
     domain = H.get_domain(cfg, pre, DOMAIN_BEACON_PROPOSER, epoch)
     root = H.compute_signing_root(block, domain)
     signed = S.SignedBeaconBlock(message=block,

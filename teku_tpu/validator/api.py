@@ -9,14 +9,17 @@ BeaconNode (reference InProcessBeaconNodeApi); a remote implementation
 can speak the REST API instead without the client changing.
 """
 
+import logging
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..spec import helpers as H
-from ..spec.builder import attestation_data_for, produce_block
+from ..spec.builder import attestation_data_for, build_unsigned_block
 from ..node.gossip import (AGGREGATE_TOPIC, attestation_subnet_topic,
                            BEACON_BLOCK_TOPIC)
 from ..node.node import BeaconNode, compute_subnet_for_attestation
+
+_LOG = logging.getLogger(__name__)
 
 
 @dataclass
@@ -131,19 +134,10 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
         pre = self.node.advanced_head_state(slot)
         atts = self.node.pool.get_attestations_for_block(
             pre, cfg.MAX_ATTESTATIONS)
-        # produce with a throwaway signer for randao (already provided)
-        from ..spec import block as B
-        from ..spec.builder import _parent_root, _TRUSTING
-        S = self.spec.schemas
-        body = S.BeaconBlockBody(
-            randao_reveal=randao_reveal, eth1_data=pre.eth1_data,
-            graffiti=graffiti, attestations=tuple(atts))
-        block = S.BeaconBlock(
-            slot=slot,
-            proposer_index=H.get_beacon_proposer_index(cfg, pre),
-            parent_root=_parent_root(pre), state_root=bytes(32), body=body)
-        post = B.process_block(cfg, pre, block, _TRUSTING, _TRUSTING)
-        return block.copy_with(state_root=post.htr()), pre
+        block, _post = build_unsigned_block(cfg, pre, slot, randao_reveal,
+                                            attestations=atts,
+                                            graffiti=graffiti)
+        return block, pre
 
     # -- submission ----------------------------------------------------
     async def publish_signed_block(self, signed_block) -> None:
@@ -153,6 +147,15 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
             self.spec.schemas.SignedBeaconBlock.serialize(signed_block))
 
     async def publish_attestation(self, attestation) -> None:
+        """Locally-produced attestations run the SAME gossip validation
+        as remote ones before touching the pool or fork choice (the
+        reference marks them producedLocally but still validates) — a
+        signer bug or stale duty must not poison block production."""
+        from ..node.gossip import ValidationResult
+        result = await self.node.attestation_validator.validate(attestation)
+        if result is not ValidationResult.ACCEPT:
+            _LOG.warning("own attestation failed validation: %s", result)
+            return
         cfg = self.spec.config
         data = attestation.data
         state = self.node.advanced_head_state(max(data.slot, 1))
@@ -169,6 +172,12 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
         return self.node.pool.get_aggregate(data)
 
     async def publish_aggregate_and_proof(self, signed_aggregate) -> None:
+        from ..node.gossip import ValidationResult
+        result = await self.node.aggregate_validator.validate(
+            signed_aggregate)
+        if result is not ValidationResult.ACCEPT:
+            _LOG.warning("own aggregate failed validation: %s", result)
+            return
         self.node.attestation_manager.add_attestation(
             signed_aggregate.message.aggregate)
         await self.node.gossip.publish(

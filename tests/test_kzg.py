@@ -1,0 +1,136 @@
+"""KZG: roots of unity, barycentric evaluation, MSM, and end-to-end
+blob commitment/proof verification on both the insecure dev setup and
+the real ceremony trusted setup."""
+
+import secrets
+from pathlib import Path
+
+import pytest
+
+from teku_tpu.crypto import kzg
+from teku_tpu.crypto.bls import curve as C
+from teku_tpu.crypto.kzg import (blob_to_kzg_commitment, BYTES_PER_BLOB,
+                                 compute_blob_kzg_proof, compute_challenge,
+                                 evaluate_polynomial_in_evaluation_form,
+                                 FIELD_ELEMENTS_PER_BLOB, g1_msm,
+                                 insecure_setup, KzgError,
+                                 load_trusted_setup, R, roots_of_unity,
+                                 verify_blob_kzg_proof,
+                                 verify_blob_kzg_proof_batch)
+
+SETUP_PATH = Path(kzg.REFERENCE_SETUP_PATH)
+
+
+def _random_blob(rng_seed: int = 1) -> bytes:
+    import random
+    rng = random.Random(rng_seed)
+    return b"".join(
+        rng.randrange(R).to_bytes(32, "big")
+        for _ in range(FIELD_ELEMENTS_PER_BLOB))
+
+
+def test_roots_of_unity_are_roots():
+    roots = roots_of_unity()
+    assert len(set(roots)) == FIELD_ELEMENTS_PER_BLOB
+    for w in roots[:4] + roots[-2:]:
+        assert pow(w, FIELD_ELEMENTS_PER_BLOB, R) == 1
+    # the generator (index 1 bit-reversed = w^2048 = -1; the true
+    # generator sits at the bit-reversal of index 1's position)
+    w = pow(7, (R - 1) // FIELD_ELEMENTS_PER_BLOB, R)
+    assert w in roots
+    assert pow(w, FIELD_ELEMENTS_PER_BLOB // 2, R) == R - 1
+    assert roots[1] == R - 1  # brp[1] = w^(n/2)
+
+
+def test_barycentric_matches_direct_at_roots_and_elsewhere():
+    poly = [i * 7 + 3 for i in range(FIELD_ELEMENTS_PER_BLOB)]
+    roots = roots_of_unity()
+    # at a root: exactly the evaluation-form value
+    assert evaluate_polynomial_in_evaluation_form(poly, roots[5]) == poly[5]
+    # a constant polynomial evaluates to the constant anywhere
+    const = [42] * FIELD_ELEMENTS_PER_BLOB
+    assert evaluate_polynomial_in_evaluation_form(const, 123456789) == 42
+    # p(x) = x has evaluation form poly[i] = w_i
+    identity = list(roots)
+    z = 0xDEADBEEF
+    assert evaluate_polynomial_in_evaluation_form(identity, z) == z
+
+
+def test_msm_matches_naive():
+    import random
+    rng = random.Random(9)
+    pts = [C.point_mul(C.FQ_OPS, rng.randrange(1, R), C.G1_GENERATOR)
+           for _ in range(5)]
+    scalars = [rng.randrange(R) for _ in range(5)]
+    expect = (0, 1, 0)
+    for p, s in zip(pts, scalars):
+        expect = C.point_add(C.FQ_OPS, expect, C.point_mul(C.FQ_OPS, s, p))
+    got = g1_msm(pts, scalars)
+    assert C.point_eq(C.FQ_OPS, got, expect)
+
+
+def test_blob_proof_roundtrip_insecure_setup():
+    setup = insecure_setup()
+    blob = _random_blob(2)
+    commitment = blob_to_kzg_commitment(blob, setup)
+    proof = compute_blob_kzg_proof(blob, commitment, setup)
+    assert verify_blob_kzg_proof(blob, commitment, proof, setup)
+    # tampered blob fails
+    bad_blob = b"\x00" * 31 + b"\x01" + blob[32:]
+    assert not verify_blob_kzg_proof(bad_blob, commitment, proof, setup)
+    # tampered proof fails
+    other = compute_blob_kzg_proof(bad_blob,
+                                   blob_to_kzg_commitment(bad_blob, setup),
+                                   setup)
+    assert not verify_blob_kzg_proof(blob, commitment, other, setup)
+
+
+def test_batch_and_malformed_inputs():
+    setup = insecure_setup()
+    blobs, commits, proofs = [], [], []
+    for seed in (3, 4):
+        b = _random_blob(seed)
+        c = blob_to_kzg_commitment(b, setup)
+        p = compute_blob_kzg_proof(b, c, setup)
+        blobs.append(b), commits.append(c), proofs.append(p)
+    assert verify_blob_kzg_proof_batch(blobs, commits, proofs, setup)
+    assert not verify_blob_kzg_proof_batch(blobs, commits[::-1], proofs,
+                                           setup)
+    assert not verify_blob_kzg_proof_batch(blobs[:1], commits, proofs,
+                                           setup)
+    # malformed: wrong blob length, out-of-range element, bad point
+    assert not verify_blob_kzg_proof(b"\x00" * 10, commits[0], proofs[0],
+                                     setup)
+    bad_fe = (R).to_bytes(32, "big") + blobs[0][32:]
+    assert not verify_blob_kzg_proof(bad_fe, commits[0], proofs[0], setup)
+    assert not verify_blob_kzg_proof(blobs[0], b"\x00" * 48, proofs[0],
+                                     setup)
+
+
+def test_challenge_domain_separation():
+    blob = _random_blob(5)
+    c1 = compute_challenge(blob, b"\xc0" * 48)
+    c2 = compute_challenge(blob, b"\xc1" * 48)
+    assert c1 != c2 and 0 <= c1 < R
+
+
+needs_setup = pytest.mark.skipif(not SETUP_PATH.is_file(),
+                                 reason="ceremony setup not present")
+
+
+@needs_setup
+@pytest.mark.slow
+def test_real_trusted_setup_end_to_end():
+    """Commitment + proof via Pippenger MSM over the REAL ceremony
+    Lagrange basis, verified with the real [s]G2 — the full production
+    path with no insecure shortcut."""
+    setup = load_trusted_setup(SETUP_PATH)
+    assert len(setup.g1_lagrange) == FIELD_ELEMENTS_PER_BLOB
+    assert len(setup.g2_monomial) == 65
+    blob = _random_blob(6)
+    commitment = blob_to_kzg_commitment(blob, setup)
+    proof = compute_blob_kzg_proof(blob, commitment, setup)
+    assert verify_blob_kzg_proof(blob, commitment, proof, setup)
+    bad = bytearray(blob)
+    bad[40] ^= 1
+    assert not verify_blob_kzg_proof(bytes(bad), commitment, proof, setup)
