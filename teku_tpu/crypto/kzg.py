@@ -96,14 +96,7 @@ def evaluate_polynomial_in_evaluation_form(poly: Sequence[int],
             return poly[i] % R
     # batch-invert the (z - w_i) denominators with one Fermat pass
     denoms = [(z - w) % R for w in roots]
-    prefix = [1] * (n + 1)
-    for i, d in enumerate(denoms):
-        prefix[i + 1] = prefix[i] * d % R
-    inv_all = pow(prefix[n], R - 2, R)
-    invs = [0] * n
-    for i in range(n - 1, -1, -1):
-        invs[i] = prefix[i] * inv_all % R
-        inv_all = inv_all * denoms[i] % R
+    invs = _batch_inverse(denoms)
     acc = 0
     for p_i, w, inv in zip(poly, roots, invs):
         acc = (acc + p_i * w % R * inv) % R
@@ -168,18 +161,23 @@ def insecure_setup(tau: int = 0x107) -> TrustedSetup:
 
 
 _SETUP: Optional[TrustedSetup] = None
-REFERENCE_SETUP_PATH = ("/root/reference/ethereum/networks/src/main/"
-                        "resources/tech/pegasys/teku/networks/"
-                        "mainnet-trusted-setup.txt")
+# the public KZG-ceremony output (the exact artifact every consensus
+# client ships; vendored under teku_tpu/resources with provenance)
+REFERENCE_SETUP_PATH = str(
+    Path(__file__).resolve().parents[1]
+    / "resources" / "mainnet-trusted-setup.txt")
 
 
 def get_setup() -> TrustedSetup:
     global _SETUP
     if _SETUP is None:
-        if Path(REFERENCE_SETUP_PATH).is_file():
-            _SETUP = load_trusted_setup(REFERENCE_SETUP_PATH)
-        else:  # pragma: no cover - environments without the artifact
-            _SETUP = insecure_setup()
+        if not Path(REFERENCE_SETUP_PATH).is_file():
+            # NEVER degrade to the known-tau dev setup implicitly —
+            # that would make default-path proofs forgeable
+            raise KzgError(
+                "trusted setup missing; call set_setup() explicitly "
+                f"(looked at {REFERENCE_SETUP_PATH})")
+        _SETUP = load_trusted_setup(REFERENCE_SETUP_PATH)
     return _SETUP
 
 

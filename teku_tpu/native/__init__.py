@@ -1,0 +1,93 @@
+"""Native (C++) components: SHA-256 hashing + KV engine.
+
+Where the reference leans on native code — JVM SHA-256 intrinsics for
+merkleization and rocksdbjni/leveldb-native for storage (reference:
+gradle/versions.gradle:128-131) — this package builds a small C++
+library (SHA-NI accelerated hashing, append-log KV engine) on demand
+with the system toolchain and binds it via ctypes.  Everything has a
+pure-Python fallback so the framework still runs where no compiler
+exists.
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Optional
+
+_LOG = logging.getLogger(__name__)
+
+_SRC = Path(__file__).parent / "src"
+_LIB_NAME = "libteku_native.so"
+
+
+def _build(out_dir: Path) -> Optional[Path]:
+    out = out_dir / _LIB_NAME
+    srcs = [str(_SRC / "sha256.cpp"), str(_SRC / "kvstore.cpp")]
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if out.is_file() and os.path.getmtime(out) >= newest_src:
+        return out
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", str(out)] + srcs
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return out
+    except Exception as exc:  # pragma: no cover - toolchain missing
+        _LOG.warning("native build failed (%s); using pure-Python "
+                     "fallbacks", exc)
+        return None
+
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first use."""
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    build_dir = Path(os.environ.get(
+        "TEKU_TPU_NATIVE_DIR", Path(__file__).parent / "build"))
+    try:
+        build_dir.mkdir(parents=True, exist_ok=True)
+        path = _build(build_dir)
+        if path is None:
+            return None
+        lib = ctypes.CDLL(str(path))
+        lib.teku_hash_pairs.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                        ctypes.c_char_p]
+        lib.teku_sha256.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
+                                    ctypes.c_char_p]
+        lib.teku_sha_uses_shani.restype = ctypes.c_int
+        lib.kv_open.argtypes = [ctypes.c_char_p]
+        lib.kv_open.restype = ctypes.c_void_p
+        lib.kv_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_uint32, ctypes.c_char_p,
+                               ctypes.c_uint32]
+        lib.kv_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_uint32]
+        lib.kv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_uint32,
+                               ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+                               ctypes.POINTER(ctypes.c_uint32)]
+        lib.kv_keys.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_uint32,
+                                ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+                                ctypes.POINTER(ctypes.c_uint64)]
+        lib.kv_free.argtypes = [ctypes.POINTER(ctypes.c_ubyte)]
+        lib.kv_count.argtypes = [ctypes.c_void_p]
+        lib.kv_count.restype = ctypes.c_uint64
+        lib.kv_flush.argtypes = [ctypes.c_void_p]
+        lib.kv_compact.argtypes = [ctypes.c_void_p]
+        lib.kv_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        _LOG.info("native library loaded (sha-ni=%s)",
+                  bool(lib.teku_sha_uses_shani()))
+    except Exception as exc:  # pragma: no cover
+        _LOG.warning("native load failed: %s", exc)
+        _lib = None
+    return _lib

@@ -1,0 +1,42 @@
+"""Bulk SHA-256 pair hashing for merkleization (native-backed)."""
+
+from typing import List, Optional
+
+from . import get_lib
+
+_lib = None
+_checked = False
+
+
+import ctypes
+
+
+def _native():
+    global _lib, _checked
+    if not _checked:
+        _checked = True
+        lib = get_lib()
+        if lib is not None:
+            # self-check against hashlib before trusting the fast path
+            import hashlib
+            probe = bytes(range(64))
+            out = ctypes.create_string_buffer(32)
+            lib.teku_hash_pairs(probe, 1, out)
+            if out.raw == hashlib.sha256(probe).digest():
+                _lib = lib
+    return _lib
+
+
+def hash_pairs(level: List[bytes]) -> List[bytes]:
+    """[sha256(level[2i] + level[2i+1])] — one native call per level."""
+    lib = _native()
+    n = len(level) // 2
+    if lib is None:
+        import hashlib
+        return [hashlib.sha256(level[2 * i] + level[2 * i + 1]).digest()
+                for i in range(n)]
+    buf = b"".join(level)
+    out = ctypes.create_string_buffer(32 * n)
+    lib.teku_hash_pairs(buf, n, out)
+    raw = out.raw
+    return [raw[32 * i:32 * (i + 1)] for i in range(n)]

@@ -1,0 +1,205 @@
+"""KV database binding: C++ engine via ctypes, pure-Python fallback.
+
+Both implementations speak the SAME on-disk append-log format (op,
+lengths, payload, CRC32), so a database written by one opens under the
+other — which the tests exploit as a cross-implementation conformance
+check.  The role of the reference's rocksdb/leveldb storage server
+(reference: storage/.../server/kvstore/).
+"""
+
+import ctypes
+import struct
+import zlib
+from pathlib import Path
+from typing import Iterator, List, Optional, Union
+
+from . import get_lib
+
+_OP_PUT, _OP_DEL = 1, 2
+
+
+class KvStore:
+    """dict-like persistent store; explicit flush/compact/close."""
+
+    def __new__(cls, path):
+        lib = get_lib()
+        if cls is KvStore and lib is not None:
+            inst = object.__new__(_NativeKv)
+        else:
+            inst = object.__new__(
+                _PythonKv if cls is KvStore else cls)
+        return inst
+
+    # interface ---------------------------------------------------------
+    def get(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def put(self, key: bytes, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, key: bytes) -> None:
+        raise NotImplementedError
+
+    def keys_with_prefix(self, prefix: bytes = b"") -> List[bytes]:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        raise NotImplementedError
+
+    def compact(self) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class _NativeKv(KvStore):
+    def __init__(self, path):
+        self._lib = get_lib()
+        self._h = self._lib.kv_open(str(path).encode())
+        if not self._h:
+            raise OSError(f"kv_open failed for {path}")
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        out = ctypes.POINTER(ctypes.c_ubyte)()
+        out_len = ctypes.c_uint32()
+        rc = self._lib.kv_get(self._h, key, len(key),
+                              ctypes.byref(out), ctypes.byref(out_len))
+        if rc != 0:
+            return None
+        try:
+            return ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.kv_free(out)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        if self._lib.kv_put(self._h, key, len(key), value, len(value)):
+            raise OSError("kv_put failed")
+
+    def delete(self, key: bytes) -> None:
+        if self._lib.kv_del(self._h, key, len(key)) < 0:
+            raise OSError("kv_del failed")
+
+    def keys_with_prefix(self, prefix: bytes = b"") -> List[bytes]:
+        out = ctypes.POINTER(ctypes.c_ubyte)()
+        out_len = ctypes.c_uint64()
+        self._lib.kv_keys(self._h, prefix, len(prefix),
+                          ctypes.byref(out), ctypes.byref(out_len))
+        try:
+            blob = ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.kv_free(out)
+        keys, pos = [], 0
+        while pos < len(blob):
+            (n,) = struct.unpack_from("<I", blob, pos)
+            pos += 4
+            keys.append(blob[pos:pos + n])
+            pos += n
+        return keys
+
+    def __len__(self) -> int:
+        return self._lib.kv_count(self._h)
+
+    def flush(self) -> None:
+        if self._lib.kv_flush(self._h):
+            raise OSError("kv_flush failed")
+
+    def compact(self) -> None:
+        if self._lib.kv_compact(self._h):
+            raise OSError("kv_compact failed")
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.kv_close(self._h)
+            self._h = None
+
+
+class _PythonKv(KvStore):
+    """Same format, pure Python (no toolchain / cross-checks)."""
+
+    def __init__(self, path):
+        self._path = Path(path)
+        self._index = {}
+        good_end = 0
+        if self._path.is_file():
+            data = self._path.read_bytes()
+            pos = 0
+            while pos + 9 <= len(data):
+                op, klen, vlen = struct.unpack_from("<BII", data, pos)
+                end = pos + 9 + klen + vlen + 4
+                if (op not in (_OP_PUT, _OP_DEL) or klen > 1 << 30
+                        or vlen > 1 << 30 or end > len(data)):
+                    break
+                (want,) = struct.unpack_from("<I", data, end - 4)
+                if zlib.crc32(data[pos:end - 4]) != want:
+                    break
+                key = data[pos + 9:pos + 9 + klen]
+                if op == _OP_PUT:
+                    self._index[key] = data[pos + 9 + klen:end - 4]
+                else:
+                    self._index.pop(key, None)
+                pos = end
+            good_end = pos
+            if good_end < len(data):   # torn tail
+                with open(self._path, "r+b") as f:
+                    f.truncate(good_end)
+        self._log = open(self._path, "ab")
+
+    def _append(self, op: int, key: bytes, value: bytes) -> None:
+        rec = struct.pack("<BII", op, len(key), len(value)) + key + value
+        rec += struct.pack("<I", zlib.crc32(rec))
+        self._log.write(rec)
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._index.get(key)
+
+    def put(self, key: bytes, value: bytes) -> None:
+        self._append(_OP_PUT, key, value)
+        self._index[key] = value
+
+    def delete(self, key: bytes) -> None:
+        if key in self._index:
+            self._append(_OP_DEL, key, b"")
+            del self._index[key]
+
+    def keys_with_prefix(self, prefix: bytes = b"") -> List[bytes]:
+        return sorted(k for k in self._index if k.startswith(prefix))
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def flush(self) -> None:
+        self._log.flush()
+        import os
+        os.fsync(self._log.fileno())
+
+    def compact(self) -> None:
+        tmp = self._path.with_suffix(".compact")
+        old_log = self._log
+        with open(tmp, "wb") as f:
+            for k in sorted(self._index):
+                v = self._index[k]
+                rec = struct.pack("<BII", _OP_PUT, len(k), len(v)) + k + v
+                rec += struct.pack("<I", zlib.crc32(rec))
+                f.write(rec)
+            f.flush()
+            import os
+            os.fsync(f.fileno())
+        old_log.close()
+        tmp.replace(self._path)
+        self._log = open(self._path, "ab")
+
+    def close(self) -> None:
+        if self._log:
+            self._log.flush()
+            self._log.close()
+            self._log = None

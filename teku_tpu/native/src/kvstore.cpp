@@ -1,0 +1,236 @@
+// Embedded append-log KV engine with an in-memory index.
+//
+// The storage backend role RocksDB/LevelDB play for the reference
+// (reference: storage/src/main/java/tech/pegasys/teku/storage/server/
+// kvstore/ + rocksdbjni/leveldb-native deps in gradle/versions.gradle):
+// a write-ahead append log replayed into a std::map on open, explicit
+// flush (fsync), and compaction that rewrites the live set.  Record
+// framing is CRC-checked so a torn tail write is truncated, not
+// propagated.
+//
+// C ABI kept dumb-simple for ctypes: byte buffers + lengths, caller
+// frees returned buffers via kv_free.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+#ifndef _WIN32
+#include <unistd.h>
+#endif
+
+namespace {
+
+uint32_t crc32_table[256];
+bool crc_init_done = false;
+
+void crc_init() {
+  if (crc_init_done) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc32_table[i] = c;
+  }
+  crc_init_done = true;
+}
+
+uint32_t crc32(const uint8_t* p, size_t n, uint32_t seed = 0) {
+  crc_init();
+  uint32_t c = seed ^ 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = crc32_table[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+struct Store {
+  std::string path;
+  FILE* log = nullptr;
+  std::map<std::string, std::string> index;
+};
+
+constexpr uint8_t OP_PUT = 1;
+constexpr uint8_t OP_DEL = 2;
+
+// record: u8 op | u32 klen | u32 vlen | key | value | u32 crc(all prior)
+bool append_record(Store* s, uint8_t op, const std::string& k,
+                   const std::string& v) {
+  std::vector<uint8_t> buf;
+  buf.reserve(9 + k.size() + v.size() + 4);
+  buf.push_back(op);
+  uint32_t klen = (uint32_t)k.size(), vlen = (uint32_t)v.size();
+  const uint8_t* kp = (const uint8_t*)&klen;
+  const uint8_t* vp = (const uint8_t*)&vlen;
+  buf.insert(buf.end(), kp, kp + 4);
+  buf.insert(buf.end(), vp, vp + 4);
+  buf.insert(buf.end(), k.begin(), k.end());
+  buf.insert(buf.end(), v.begin(), v.end());
+  uint32_t crc = crc32(buf.data(), buf.size());
+  const uint8_t* cp = (const uint8_t*)&crc;
+  buf.insert(buf.end(), cp, cp + 4);
+  return fwrite(buf.data(), 1, buf.size(), s->log) == buf.size();
+}
+
+// replay; returns the byte offset of the last VALID record end
+long replay(Store* s, FILE* f) {
+  long good_end = 0;
+  for (;;) {
+    uint8_t head[9];
+    if (fread(head, 1, 9, f) != 9) break;
+    uint8_t op = head[0];
+    uint32_t klen, vlen;
+    memcpy(&klen, head + 1, 4);
+    memcpy(&vlen, head + 5, 4);
+    if ((op != OP_PUT && op != OP_DEL) || klen > (1u << 30) ||
+        vlen > (1u << 30))
+      break;
+    std::vector<uint8_t> body(klen + (size_t)vlen + 4);
+    if (fread(body.data(), 1, body.size(), f) != body.size()) break;
+    std::vector<uint8_t> all(head, head + 9);
+    all.insert(all.end(), body.begin(), body.end() - 4);
+    uint32_t want;
+    memcpy(&want, body.data() + klen + vlen, 4);
+    if (crc32(all.data(), all.size()) != want) break;  // torn tail
+    std::string key((char*)body.data(), klen);
+    if (op == OP_PUT)
+      s->index[key] = std::string((char*)body.data() + klen, vlen);
+    else
+      s->index.erase(key);
+    good_end = ftell(f);
+  }
+  return good_end;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* kv_open(const char* path) {
+  Store* s = new Store();
+  s->path = path;
+  FILE* f = fopen(path, "rb");
+  if (f) {
+    long good = replay(s, f);
+    fclose(f);
+    // truncate a torn tail so the next append starts clean
+    long full;
+    FILE* probe = fopen(path, "rb");
+    fseek(probe, 0, SEEK_END);
+    full = ftell(probe);
+    fclose(probe);
+    if (good < full) {
+      if (truncate(path, good) != 0) {
+        delete s;
+        return nullptr;
+      }
+    }
+  }
+  s->log = fopen(path, "ab");
+  if (!s->log) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+int kv_put(void* h, const uint8_t* k, uint32_t klen, const uint8_t* v,
+           uint32_t vlen) {
+  Store* s = (Store*)h;
+  std::string key((const char*)k, klen), val((const char*)v, vlen);
+  if (!append_record(s, OP_PUT, key, val)) return -1;
+  s->index[key] = std::move(val);
+  return 0;
+}
+
+int kv_del(void* h, const uint8_t* k, uint32_t klen) {
+  Store* s = (Store*)h;
+  std::string key((const char*)k, klen);
+  if (s->index.find(key) == s->index.end()) return 1;  // absent
+  if (!append_record(s, OP_DEL, key, "")) return -1;
+  s->index.erase(key);
+  return 0;
+}
+
+// returns 0 + malloc'd copy in *out; 1 if absent
+int kv_get(void* h, const uint8_t* k, uint32_t klen, uint8_t** out,
+           uint32_t* out_len) {
+  Store* s = (Store*)h;
+  auto it = s->index.find(std::string((const char*)k, klen));
+  if (it == s->index.end()) return 1;
+  *out_len = (uint32_t)it->second.size();
+  *out = (uint8_t*)malloc(it->second.size() ? it->second.size() : 1);
+  memcpy(*out, it->second.data(), it->second.size());
+  return 0;
+}
+
+void kv_free(uint8_t* p) { free(p); }
+
+uint64_t kv_count(void* h) { return ((Store*)h)->index.size(); }
+
+int kv_flush(void* h) {
+  Store* s = (Store*)h;
+  if (fflush(s->log) != 0) return -1;
+#ifndef _WIN32
+  if (fsync(fileno(s->log)) != 0) return -1;
+#endif
+  return 0;
+}
+
+// all keys with the given prefix, concatenated as u32len|key entries
+int kv_keys(void* h, const uint8_t* prefix, uint32_t plen, uint8_t** out,
+            uint64_t* out_len) {
+  Store* s = (Store*)h;
+  std::string pre((const char*)prefix, plen);
+  std::vector<uint8_t> buf;
+  for (auto it = s->index.lower_bound(pre); it != s->index.end(); ++it) {
+    if (it->first.compare(0, pre.size(), pre) != 0) break;
+    uint32_t n = (uint32_t)it->first.size();
+    const uint8_t* np = (const uint8_t*)&n;
+    buf.insert(buf.end(), np, np + 4);
+    buf.insert(buf.end(), it->first.begin(), it->first.end());
+  }
+  *out_len = buf.size();
+  *out = (uint8_t*)malloc(buf.size() ? buf.size() : 1);
+  memcpy(*out, buf.data(), buf.size());
+  return 0;
+}
+
+// rewrite only the live set (drops overwritten/deleted records)
+int kv_compact(void* h) {
+  Store* s = (Store*)h;
+  std::string tmp = s->path + ".compact";
+  FILE* old = s->log;
+  Store fresh;
+  fresh.path = tmp;
+  fresh.log = fopen(tmp.c_str(), "wb");
+  if (!fresh.log) return -1;
+  for (auto& kvp : s->index)
+    if (!append_record(&fresh, OP_PUT, kvp.first, kvp.second)) {
+      fclose(fresh.log);
+      return -1;
+    }
+  fflush(fresh.log);
+#ifndef _WIN32
+  fsync(fileno(fresh.log));
+#endif
+  fclose(fresh.log);
+  fclose(old);
+  if (rename(tmp.c_str(), s->path.c_str()) != 0) return -1;
+  s->log = fopen(s->path.c_str(), "ab");
+  return s->log ? 0 : -1;
+}
+
+void kv_close(void* h) {
+  Store* s = (Store*)h;
+  if (s->log) {
+    fflush(s->log);
+    fclose(s->log);
+  }
+  delete s;
+}
+
+}  // extern "C"

@@ -1,0 +1,204 @@
+// SHA-256 compression: portable scalar core + x86 SHA-NI fast path.
+//
+// The native hashing layer behind SSZ merkleization (the role the JVM's
+// SHA-256 intrinsics play for the reference's hash-tree-root; reference:
+// infrastructure/crypto + the Sha256Benchmark surface).  Exposes ONE
+// bulk primitive — hash_pairs over a contiguous buffer — because
+// merkleization only ever hashes 64-byte concatenations of two nodes.
+
+#include <cstdint>
+#include <cstring>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#include <cpuid.h>
+#endif
+
+namespace {
+
+constexpr uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+constexpr uint32_t H0[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                            0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+
+inline uint32_t rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+inline uint32_t be32(const uint8_t* p) {
+  return (uint32_t)p[0] << 24 | (uint32_t)p[1] << 16 | (uint32_t)p[2] << 8 |
+         (uint32_t)p[3];
+}
+inline void put_be32(uint8_t* p, uint32_t v) {
+  p[0] = v >> 24; p[1] = v >> 16; p[2] = v >> 8; p[3] = v;
+}
+
+void compress_scalar(uint32_t st[8], const uint8_t* block) {
+  uint32_t w[64];
+  for (int i = 0; i < 16; i++) w[i] = be32(block + 4 * i);
+  for (int i = 16; i < 64; i++) {
+    uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+    uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+    w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+  }
+  uint32_t a = st[0], b = st[1], c = st[2], d = st[3];
+  uint32_t e = st[4], f = st[5], g = st[6], h = st[7];
+  for (int i = 0; i < 64; i++) {
+    uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+    uint32_t ch = (e & f) ^ (~e & g);
+    uint32_t t1 = h + S1 + ch + K[i] + w[i];
+    uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+    uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+    uint32_t t2 = S0 + maj;
+    h = g; g = f; f = e; e = d + t1;
+    d = c; c = b; b = a; a = t1 + t2;
+  }
+  st[0] += a; st[1] += b; st[2] += c; st[3] += d;
+  st[4] += e; st[5] += f; st[6] += g; st[7] += h;
+}
+
+#if defined(__x86_64__)
+bool cpu_has_sha() {
+  unsigned int eax, ebx, ecx, edx;
+  if (!__get_cpuid_count(7, 0, &eax, &ebx, &ecx, &edx)) return false;
+  return (ebx >> 29) & 1;  // SHA extensions bit
+}
+
+// SHA-NI two-block compress of one 64-byte message with standard
+// one-shot padding (the merkleize case: message length is exactly 64).
+// Round scheduling follows the canonical SHA-NI pattern (public domain
+// reference implementations by Intel/Walton).
+__attribute__((target("sha,sse4.1")))
+void compress_shani(uint32_t st[8], const uint8_t* block) {
+  const __m128i MASK =
+      _mm_set_epi64x(0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+  __m128i STATE0 = _mm_loadu_si128((const __m128i*)&st[0]);  // a,b,c,d
+  __m128i STATE1 = _mm_loadu_si128((const __m128i*)&st[4]);  // e,f,g,h
+  // shuffle into the (CDAB / GHEF) order sha256rnds2 expects
+  __m128i TMP = _mm_shuffle_epi32(STATE0, 0xB1);       // b,a,d,c
+  STATE1 = _mm_shuffle_epi32(STATE1, 0x1B);            // h,g,f,e -> f,e,h,g?
+  STATE0 = _mm_alignr_epi8(TMP, STATE1, 8);
+  STATE1 = _mm_blend_epi16(STATE1, TMP, 0xF0);
+  const __m128i ABEF_SAVE = STATE0;
+  const __m128i CDGH_SAVE = STATE1;
+
+  __m128i MSG, MSG0, MSG1, MSG2, MSG3, TMP2;
+#define QROUND(Ki, M)                                        \
+  MSG = _mm_add_epi32(M, _mm_loadu_si128((const __m128i*)&K[Ki])); \
+  STATE1 = _mm_sha256rnds2_epu32(STATE1, STATE0, MSG);       \
+  MSG = _mm_shuffle_epi32(MSG, 0x0E);                        \
+  STATE0 = _mm_sha256rnds2_epu32(STATE0, STATE1, MSG);
+
+  MSG0 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i*)(block + 0)), MASK);
+  MSG1 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i*)(block + 16)), MASK);
+  MSG2 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i*)(block + 32)), MASK);
+  MSG3 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i*)(block + 48)), MASK);
+
+  QROUND(0, MSG0);
+  QROUND(4, MSG1);
+  QROUND(8, MSG2);
+  QROUND(12, MSG3);
+  for (int i = 16; i < 64; i += 16) {
+    MSG0 = _mm_sha256msg1_epu32(MSG0, MSG1);
+    TMP2 = _mm_alignr_epi8(MSG3, MSG2, 4);
+    MSG0 = _mm_add_epi32(MSG0, TMP2);
+    MSG0 = _mm_sha256msg2_epu32(MSG0, MSG3);
+    QROUND(i, MSG0);
+    MSG1 = _mm_sha256msg1_epu32(MSG1, MSG2);
+    TMP2 = _mm_alignr_epi8(MSG0, MSG3, 4);
+    MSG1 = _mm_add_epi32(MSG1, TMP2);
+    MSG1 = _mm_sha256msg2_epu32(MSG1, MSG0);
+    QROUND(i + 4, MSG1);
+    MSG2 = _mm_sha256msg1_epu32(MSG2, MSG3);
+    TMP2 = _mm_alignr_epi8(MSG1, MSG0, 4);
+    MSG2 = _mm_add_epi32(MSG2, TMP2);
+    MSG2 = _mm_sha256msg2_epu32(MSG2, MSG1);
+    QROUND(i + 8, MSG2);
+    MSG3 = _mm_sha256msg1_epu32(MSG3, MSG0);
+    TMP2 = _mm_alignr_epi8(MSG2, MSG1, 4);
+    MSG3 = _mm_add_epi32(MSG3, TMP2);
+    MSG3 = _mm_sha256msg2_epu32(MSG3, MSG2);
+    QROUND(i + 12, MSG3);
+  }
+#undef QROUND
+
+  STATE0 = _mm_add_epi32(STATE0, ABEF_SAVE);
+  STATE1 = _mm_add_epi32(STATE1, CDGH_SAVE);
+  TMP = _mm_shuffle_epi32(STATE0, 0x1B);
+  STATE1 = _mm_shuffle_epi32(STATE1, 0xB1);
+  STATE0 = _mm_blend_epi16(TMP, STATE1, 0xF0);
+  STATE1 = _mm_alignr_epi8(STATE1, TMP, 8);
+  _mm_storeu_si128((__m128i*)&st[0], STATE0);
+  _mm_storeu_si128((__m128i*)&st[4], STATE1);
+}
+
+bool g_use_shani = cpu_has_sha();
+#else
+bool g_use_shani = false;
+void compress_shani(uint32_t*, const uint8_t*) {}
+#endif
+
+inline void compress(uint32_t st[8], const uint8_t* block) {
+  if (g_use_shani)
+    compress_shani(st, block);
+  else
+    compress_scalar(st, block);
+}
+
+// constant second block for a 64-byte message: 0x80 then zeros, with the
+// 512-bit length in the last 8 bytes
+const uint8_t PAD64[64] = {0x80, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+                           0,    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+                           0,    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+                           0,    0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0,
+                           0,    0, 0, 0, 0, 0, 0x02, 0x00};
+
+}  // namespace
+
+extern "C" {
+
+// out[i] = sha256(in[64*i .. 64*i+63]) for i in [0, n)
+void teku_hash_pairs(const uint8_t* in, uint64_t n, uint8_t* out) {
+  for (uint64_t i = 0; i < n; i++) {
+    uint32_t st[8];
+    memcpy(st, H0, sizeof(st));
+    compress(st, in + 64 * i);
+    compress(st, PAD64);
+    for (int j = 0; j < 8; j++) put_be32(out + 32 * i + 4 * j, st[j]);
+  }
+}
+
+// general one-shot sha256 (tooling/tests)
+void teku_sha256(const uint8_t* in, uint64_t len, uint8_t* out) {
+  uint32_t st[8];
+  memcpy(st, H0, sizeof(st));
+  uint64_t off = 0;
+  while (len - off >= 64) {
+    compress(st, in + off);
+    off += 64;
+  }
+  uint8_t last[128];
+  uint64_t rem = len - off;
+  memcpy(last, in + off, rem);
+  last[rem] = 0x80;
+  uint64_t padlen = (rem < 56) ? 64 : 128;
+  memset(last + rem + 1, 0, padlen - rem - 1 - 8);
+  uint64_t bits = len * 8;
+  for (int j = 0; j < 8; j++)
+    last[padlen - 1 - j] = (uint8_t)(bits >> (8 * j));
+  compress(st, last);
+  if (padlen == 128) compress(st, last + 64);
+  for (int j = 0; j < 8; j++) put_be32(out + 4 * j, st[j]);
+}
+
+int teku_sha_uses_shani() { return g_use_shani ? 1 : 0; }
+
+}  // extern "C"

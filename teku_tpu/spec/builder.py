@@ -87,7 +87,8 @@ def build_unsigned_block(cfg: SpecConfig, pre, slot: int,
                          proposer_slashings: Sequence = (),
                          attester_slashings: Sequence = (),
                          voluntary_exits: Sequence = (),
-                         graffiti: bytes = bytes(32)):
+                         graffiti: bytes = bytes(32),
+                         proposer_index: Optional[int] = None):
     """(unsigned block with state root filled, post_state) on an
     already-slot-advanced pre-state — the ONE body-construction recipe
     shared by local production and the validator API (reference:
@@ -95,6 +96,8 @@ def build_unsigned_block(cfg: SpecConfig, pre, slot: int,
     from . import block as B
     S = get_schemas(cfg)
     assert pre.slot == slot, "pre-state must be advanced to the slot"
+    if proposer_index is None:
+        proposer_index = H.get_beacon_proposer_index(cfg, pre)
     body = S.BeaconBlockBody(
         randao_reveal=randao_reveal,
         eth1_data=pre.eth1_data, graffiti=graffiti,
@@ -103,7 +106,7 @@ def build_unsigned_block(cfg: SpecConfig, pre, slot: int,
         attestations=tuple(attestations), deposits=tuple(deposits),
         voluntary_exits=tuple(voluntary_exits))
     block = S.BeaconBlock(
-        slot=slot, proposer_index=H.get_beacon_proposer_index(cfg, pre),
+        slot=slot, proposer_index=proposer_index,
         parent_root=_parent_root(pre), state_root=bytes(32), body=body)
     post = B.process_block(cfg, pre, block, _TRUSTING, _TRUSTING)
     return block.copy_with(state_root=post.htr()), post
@@ -128,7 +131,8 @@ def produce_block(cfg: SpecConfig, state, slot: int, signer: Signer,
     reveal = get_randao_reveal(cfg, pre, epoch, proposer_index, signer)
     block, post = build_unsigned_block(
         cfg, pre, slot, reveal, attestations, deposits,
-        proposer_slashings, attester_slashings, voluntary_exits, graffiti)
+        proposer_slashings, attester_slashings, voluntary_exits, graffiti,
+        proposer_index=proposer_index)
     domain = H.get_domain(cfg, pre, DOMAIN_BEACON_PROPOSER, epoch)
     root = H.compute_signing_root(block, domain)
     signed = S.SignedBeaconBlock(message=block,

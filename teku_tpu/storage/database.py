@@ -1,0 +1,165 @@
+"""Chain database: persistence + restart/resume on the KV engine.
+
+Equivalent of the reference's storage server (reference: storage/src/
+main/java/tech/pegasys/teku/storage/server/Database.java:45 and
+kvstore/ column-family schema; StoreBuilder rebuilding the hot store on
+boot): blocks and states keyed by root, the finalized anchor + hot
+block set tracked in meta keys, ARCHIVE vs PRUNE state retention, and
+`load_anchor()` returning what a restarting node needs to resume.
+"""
+
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ..native.kv import KvStore
+from ..spec import Spec
+from .store import Store
+
+_LOG = logging.getLogger(__name__)
+
+_BLOCK = b"blk/"
+_STATE = b"st/"
+_HOT = b"hot/"
+_META_ANCHOR = b"meta/anchor_root"
+_META_JUST = b"meta/justified"
+_META_FIN = b"meta/finalized"
+
+ARCHIVE = "archive"
+PRUNE = "prune"
+
+
+class Database:
+    def __init__(self, path, spec: Spec, mode: str = PRUNE):
+        assert mode in (ARCHIVE, PRUNE)
+        self.spec = spec
+        self.mode = mode
+        self._kv = KvStore(path)
+
+    # -- writes --------------------------------------------------------
+    def save_block(self, signed_block, post_state=None) -> None:
+        root = signed_block.message.htr()
+        S = self.spec.schemas
+        self._kv.put(_BLOCK + root, S.SignedBeaconBlock.serialize(
+            signed_block))
+        self._kv.put(_HOT + root, b"1")
+        if post_state is not None and self.mode == ARCHIVE:
+            self._kv.put(_STATE + root, S.BeaconState.serialize(post_state))
+
+    def save_anchor(self, anchor_block, anchor_state) -> None:
+        """Persist a full (block, state) anchor — genesis or finalized
+        checkpoint (the restart/checkpoint-sync entry point)."""
+        S = self.spec.schemas
+        if not hasattr(anchor_block, "message"):   # bare BeaconBlock
+            anchor_block = S.SignedBeaconBlock(
+                message=anchor_block, signature=b"\x00" * 96)
+        root = anchor_block.message.htr()
+        self._kv.put(_BLOCK + root,
+                     S.SignedBeaconBlock.serialize(anchor_block))
+        self._kv.put(_STATE + root, S.BeaconState.serialize(anchor_state))
+        self._kv.put(_META_ANCHOR, root)
+
+    def on_finalized(self, checkpoint, state, live_roots) -> None:
+        """Advance the anchor to the new finalized checkpoint, persist
+        its state, drop pruned forks (PRUNE mode keeps only the
+        finalized chain + hot subtree; reference pruners in
+        storage/server/pruner/)."""
+        S = self.spec.schemas
+        root = checkpoint.root
+        self._kv.put(_STATE + root, S.BeaconState.serialize(state))
+        self._kv.put(_META_ANCHOR, root)
+        self._kv.put(_META_FIN, checkpoint.epoch.to_bytes(8, "little")
+                     + checkpoint.root)
+        live = set(live_roots)
+        for key in self._kv.keys_with_prefix(_HOT):
+            r = key[len(_HOT):]
+            if r not in live:
+                self._kv.delete(key)
+                if self.mode == PRUNE:
+                    self._kv.delete(_BLOCK + r)
+                    if r != root:
+                        self._kv.delete(_STATE + r)
+        self._kv.flush()
+
+    # -- reads ---------------------------------------------------------
+    def get_block(self, root: bytes):
+        raw = self._kv.get(_BLOCK + root)
+        if raw is None:
+            return None
+        return self.spec.schemas.SignedBeaconBlock.deserialize(raw)
+
+    def get_state(self, root: bytes):
+        raw = self._kv.get(_STATE + root)
+        if raw is None:
+            return None
+        return self.spec.schemas.BeaconState.deserialize(raw)
+
+    def load_anchor(self):
+        """(anchor_block_message, anchor_state, hot_blocks) or None —
+        everything a restarting node needs (reference StoreBuilder)."""
+        root = self._kv.get(_META_ANCHOR)
+        if root is None:
+            return None
+        signed = self.get_block(root)
+        state = self.get_state(root)
+        if signed is None or state is None:
+            return None
+        hot = []
+        for key in self._kv.keys_with_prefix(_HOT):
+            blk = self.get_block(key[len(_HOT):])
+            if blk is not None:
+                hot.append(blk)
+        hot.sort(key=lambda b: b.message.slot)
+        return signed.message, state, hot
+
+    def close(self) -> None:
+        self._kv.flush()
+        self._kv.close()
+
+    def compact(self) -> None:
+        self._kv.compact()
+
+
+class PersistentChainStorage:
+    """Binds a Database to a running Store: persists imports, advances
+    the anchor on finalization, and can resurrect a Store on boot
+    (reference: StorageBackedRecentChainData.create)."""
+
+    def __init__(self, db: Database):
+        self.db = db
+
+    def on_block_imported(self, signed_block, post_state) -> None:
+        self.db.save_block(signed_block, post_state)
+
+    def on_finalized(self, store: Store, checkpoint) -> None:
+        state = store.block_states.get(checkpoint.root)
+        if state is None:
+            return
+        live = [r for r in store.blocks
+                if store.proto.is_descendant(checkpoint.root, r)]
+        self.db.on_finalized(checkpoint, state, live)
+
+    def restore_store(self, spec: Spec,
+                      validate_signatures: bool = False) -> Optional[Store]:
+        """Rebuild a fork-choice store from the persisted anchor + hot
+        blocks (signatures were already verified before they were
+        persisted, so the replay skips them by default)."""
+        loaded = self.db.load_anchor()
+        if loaded is None:
+            return None
+        anchor_block, anchor_state, hot = loaded
+        store = Store(spec.config, anchor_state, anchor_block)
+        anchor_root = anchor_block.htr()
+        for signed in hot:
+            if signed.message.htr() == anchor_root:
+                continue
+            # advance the clock to the block's slot so replay is never
+            # rejected as "from the future"
+            store.on_tick(store.genesis_time + signed.message.slot
+                          * spec.config.SECONDS_PER_SLOT)
+            try:
+                store.on_block(signed,
+                               validate_signatures=validate_signatures)
+            except Exception as exc:
+                _LOG.warning("hot block replay dropped %s: %s",
+                             signed.message.htr().hex()[:8], exc)
+        return store

@@ -153,7 +153,14 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
         signer bug or stale duty must not poison block production."""
         from ..node.gossip import ValidationResult
         result = await self.node.attestation_validator.validate(attestation)
-        if result is not ValidationResult.ACCEPT:
+        if result is ValidationResult.ACCEPT:
+            self.node.attestation_manager.add_attestation(attestation)
+        elif result is ValidationResult.SAVE_FOR_FUTURE:
+            # transient timing skew (node a hair behind the duty timer):
+            # defer locally for re-validation, but still broadcast —
+            # peers judge for themselves (the message is honestly ours)
+            self.node._defer("att", attestation)
+        else:
             _LOG.warning("own attestation failed validation: %s", result)
             return
         cfg = self.spec.config
@@ -163,7 +170,6 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
                                                     data.target.epoch)
         subnet = compute_subnet_for_attestation(
             cfg, committees, data.slot, data.index)
-        self.node.attestation_manager.add_attestation(attestation)
         await self.node.gossip.publish(
             attestation_subnet_topic(subnet),
             self.spec.schemas.Attestation.serialize(attestation))
@@ -175,11 +181,14 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
         from ..node.gossip import ValidationResult
         result = await self.node.aggregate_validator.validate(
             signed_aggregate)
-        if result is not ValidationResult.ACCEPT:
+        if result is ValidationResult.ACCEPT:
+            self.node.attestation_manager.add_attestation(
+                signed_aggregate.message.aggregate)
+        elif result is ValidationResult.SAVE_FOR_FUTURE:
+            self.node._defer("agg", signed_aggregate)
+        else:
             _LOG.warning("own aggregate failed validation: %s", result)
             return
-        self.node.attestation_manager.add_attestation(
-            signed_aggregate.message.aggregate)
         await self.node.gossip.publish(
             AGGREGATE_TOPIC,
             self.spec.schemas.SignedAggregateAndProof.serialize(

@@ -1,0 +1,87 @@
+"""Chain database: persist a running chain, restart, resume — the
+checkpoint/resume surface (reference: StoreBuilder + StorageBackedRecentChainData)."""
+
+import pytest
+
+from teku_tpu.spec import config as C, create_spec
+from teku_tpu.spec.builder import (make_local_signer, produce_attestations,
+                                   produce_block)
+from teku_tpu.spec.datastructures import SCHEMAS_MINIMAL as S
+from teku_tpu.spec.genesis import interop_genesis
+from teku_tpu.storage import Store
+from teku_tpu.storage.database import (ARCHIVE, Database,
+                                       PersistentChainStorage, PRUNE)
+
+CFG = C.MINIMAL
+
+
+def _build_chain(n_slots: int):
+    spec = create_spec("minimal")
+    state, sks = interop_genesis(CFG, 32)
+    signer = make_local_signer(dict(enumerate(sks)))
+    anchor = S.BeaconBlock(slot=0, parent_root=bytes(32),
+                           state_root=state.htr(), body=S.BeaconBlockBody())
+    store = Store(CFG, state, anchor)
+    blocks = []
+    atts = []
+    cur = state
+    for slot in range(1, n_slots + 1):
+        store.on_tick(state.genesis_time + slot * CFG.SECONDS_PER_SLOT)
+        signed, post = produce_block(CFG, cur, slot, signer,
+                                     attestations=atts)
+        store.on_block(signed)
+        atts = produce_attestations(CFG, post, slot, signed.message.htr(),
+                                    signer)
+        blocks.append((signed, post))
+        cur = post
+    return spec, store, blocks, anchor, state
+
+
+@pytest.mark.slow
+def test_persist_restart_resume(tmp_path):
+    spec, store, blocks, anchor, genesis_state = _build_chain(
+        4 * CFG.SLOTS_PER_EPOCH)
+    db = Database(tmp_path / "chain.db", spec, mode=PRUNE)
+    storage = PersistentChainStorage(db)
+    db.save_anchor(anchor, genesis_state)
+    for signed, post in blocks:
+        storage.on_block_imported(signed, post)
+    # finalization advances the anchor and prunes
+    assert store.finalized_checkpoint.epoch >= 1
+    storage.on_finalized(store, store.finalized_checkpoint)
+    db.close()
+
+    # restart: rebuild the fork-choice store from disk
+    db2 = Database(tmp_path / "chain.db", spec, mode=PRUNE)
+    restored = PersistentChainStorage(db2).restore_store(spec)
+    assert restored is not None
+    assert (restored.finalized_checkpoint.root
+            == store.finalized_checkpoint.root)
+    # head matches the original chain's tip
+    assert restored.get_head() == store.get_head()
+    tip_root = blocks[-1][0].message.htr()
+    assert restored.get_head() == tip_root
+    # blocks before the finalized anchor were pruned from disk
+    first_root = blocks[0][0].message.htr()
+    assert db2.get_block(first_root) is None
+    db2.close()
+
+
+def test_archive_mode_keeps_states(tmp_path):
+    spec, store, blocks, anchor, genesis_state = _build_chain(3)
+    db = Database(tmp_path / "arch.db", spec, mode=ARCHIVE)
+    db.save_anchor(anchor, genesis_state)
+    for signed, post in blocks:
+        db.save_block(signed, post)
+    root = blocks[1][0].message.htr()
+    st = db.get_state(root)
+    assert st is not None and st.htr() == blocks[1][1].htr()
+    db.close()
+
+
+def test_empty_database_returns_no_anchor(tmp_path):
+    spec = create_spec("minimal")
+    db = Database(tmp_path / "empty.db", spec)
+    assert db.load_anchor() is None
+    assert PersistentChainStorage(db).restore_store(spec) is None
+    db.close()
