@@ -25,7 +25,8 @@ _LIB_NAME = "libteku_native.so"
 
 def _build(out_dir: Path) -> Optional[Path]:
     out = out_dir / _LIB_NAME
-    srcs = [str(_SRC / "sha256.cpp"), str(_SRC / "kvstore.cpp")]
+    srcs = [str(_SRC / "sha256.cpp"), str(_SRC / "kvstore.cpp"),
+            str(_SRC / "snappy.cpp")]
     newest_src = max(os.path.getmtime(s) for s in srcs)
     if out.is_file() and os.path.getmtime(out) >= newest_src:
         return out
@@ -84,6 +85,21 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.kv_flush.argtypes = [ctypes.c_void_p]
         lib.kv_compact.argtypes = [ctypes.c_void_p]
         lib.kv_close.argtypes = [ctypes.c_void_p]
+        lib.teku_snappy_max_compressed.argtypes = [ctypes.c_uint64]
+        lib.teku_snappy_max_compressed.restype = ctypes.c_uint64
+        lib.teku_snappy_compress.argtypes = [ctypes.c_char_p,
+                                             ctypes.c_uint64,
+                                             ctypes.c_char_p]
+        lib.teku_snappy_compress.restype = ctypes.c_uint64
+        lib.teku_snappy_uncompress.argtypes = [ctypes.c_char_p,
+                                               ctypes.c_uint64,
+                                               ctypes.c_char_p,
+                                               ctypes.c_uint64]
+        lib.teku_snappy_uncompress.restype = ctypes.c_uint64
+        lib.teku_snappy_uncompressed_length.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.teku_snappy_uncompressed_length.restype = ctypes.c_int
         _lib = lib
         _LOG.info("native library loaded (sha-ni=%s)",
                   bool(lib.teku_sha_uses_shani()))

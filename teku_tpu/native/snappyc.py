@@ -1,0 +1,142 @@
+"""Snappy codec binding: C++ implementation with pure-Python fallback.
+
+The ssz_snappy framing codec (reference: networking/eth2 gossip
+SszSnappyEncoding + snappy-java).  The Python fallback decompresses the
+full format and compresses as all-literals — spec-valid output, zero
+ratio, guaranteed correct.
+"""
+
+import ctypes
+from typing import Optional
+
+from . import get_lib
+
+MAX_UNCOMPRESSED = 1 << 27        # 128 MiB safety bound
+
+
+class SnappyError(ValueError):
+    pass
+
+
+def compress(data: bytes) -> bytes:
+    lib = get_lib()
+    if lib is not None:
+        cap = lib.teku_snappy_max_compressed(len(data))
+        out = ctypes.create_string_buffer(cap)
+        n = lib.teku_snappy_compress(data, len(data), out)
+        if n == 0 and data:
+            raise SnappyError("compress failed")
+        return out.raw[:n]
+    return _py_compress(data)
+
+
+def uncompress(data: bytes) -> bytes:
+    lib = get_lib()
+    if lib is not None:
+        want = ctypes.c_uint64()
+        if lib.teku_snappy_uncompressed_length(data, len(data),
+                                               ctypes.byref(want)):
+            raise SnappyError("bad varint header")
+        if want.value > MAX_UNCOMPRESSED:
+            raise SnappyError("declared size too large")
+        out = ctypes.create_string_buffer(max(1, want.value))
+        n = lib.teku_snappy_uncompress(data, len(data), out, want.value)
+        if n == 2 ** 64 - 1:
+            raise SnappyError("malformed snappy input")
+        return out.raw[:n]
+    return _py_uncompress(data)
+
+
+# -- pure-Python fallback ---------------------------------------------------
+
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+    return bytes(out)
+
+
+def _py_compress(data: bytes) -> bytes:
+    """All-literal encoding: valid snappy, no compression."""
+    out = bytearray(_varint(len(data)))
+    pos = 0
+    while pos < len(data):
+        chunk = data[pos:pos + 65536]
+        l = len(chunk) - 1
+        if l < 60:
+            out.append(l << 2)
+        else:
+            out.append(61 << 2)
+            out += l.to_bytes(2, "little")
+        out += chunk
+        pos += len(chunk)
+    return bytes(out)
+
+
+def _py_uncompress(data: bytes) -> bytes:
+    pos = 0
+    expect = 0
+    shift = 0
+    while True:
+        if pos >= len(data):
+            raise SnappyError("truncated varint")
+        b = data[pos]
+        pos += 1
+        expect |= (b & 0x7F) << shift
+        shift += 7
+        if not b & 0x80:
+            break
+        if shift > 63:
+            raise SnappyError("varint overflow")
+    if expect > MAX_UNCOMPRESSED:
+        raise SnappyError("declared size too large")
+    out = bytearray()
+    while pos < len(data):
+        tag = data[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == 0:
+            length = (tag >> 2) + 1
+            if length > 60:
+                extra = (tag >> 2) - 59     # 60->1, 61->2, 62->3 bytes
+                if pos + extra > len(data):
+                    raise SnappyError("truncated literal length")
+                length = int.from_bytes(data[pos:pos + extra],
+                                        "little") + 1
+                pos += extra
+            if pos + length > len(data):
+                raise SnappyError("truncated literal")
+            if len(out) + length > expect:
+                raise SnappyError("output exceeds declared size")
+            out += data[pos:pos + length]
+            pos += length
+        else:
+            if kind == 1:
+                if pos >= len(data):
+                    raise SnappyError("truncated copy")
+                length = ((tag >> 2) & 0x7) + 4
+                offset = ((tag >> 5) << 8) | data[pos]
+                pos += 1
+            elif kind == 2:
+                if pos + 2 > len(data):
+                    raise SnappyError("truncated copy")
+                length = (tag >> 2) + 1
+                offset = int.from_bytes(data[pos:pos + 2], "little")
+                pos += 2
+            else:
+                if pos + 4 > len(data):
+                    raise SnappyError("truncated copy")
+                length = (tag >> 2) + 1
+                offset = int.from_bytes(data[pos:pos + 4], "little")
+                pos += 4
+            if offset == 0 or offset > len(out):
+                raise SnappyError("bad copy offset")
+            if len(out) + length > expect:
+                raise SnappyError("output exceeds declared size")
+            for _ in range(length):
+                out.append(out[-offset])
+    if len(out) != expect:
+        raise SnappyError("length mismatch")
+    return bytes(out)

@@ -1,0 +1,191 @@
+// Snappy raw-block format codec (compress + uncompress).
+//
+// The gossip/req-resp framing codec role snappy-java plays for the
+// reference (reference: gradle/versions.gradle:140, used by
+// networking/eth2 gossip SszSnappyEncoding and rpc encodings).
+// Standard format: varint uncompressed length, then literal elements
+// (tag&3==0) and copy elements with 1/2/4-byte offsets.  Compression
+// is greedy with a 4-byte-hash match table — not byte-identical to
+// upstream snappy output, but format-valid, which is all the format
+// requires.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline uint32_t load32(const uint8_t* p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint32_t hash4(uint32_t v) { return (v * 0x1e35a7bdu) >> 18; }  // 14-bit
+
+size_t emit_varint(uint8_t* out, uint64_t v) {
+  size_t n = 0;
+  while (v >= 0x80) {
+    out[n++] = (uint8_t)(v | 0x80);
+    v >>= 7;
+  }
+  out[n++] = (uint8_t)v;
+  return n;
+}
+
+size_t emit_literal(uint8_t* out, const uint8_t* data, size_t len) {
+  size_t n = 0;
+  if (len == 0) return 0;
+  size_t l = len - 1;
+  if (l < 60) {
+    out[n++] = (uint8_t)(l << 2);
+  } else if (l < 256) {
+    out[n++] = 60 << 2;
+    out[n++] = (uint8_t)l;
+  } else if (l < 65536) {
+    out[n++] = 61 << 2;
+    out[n++] = (uint8_t)l;
+    out[n++] = (uint8_t)(l >> 8);
+  } else {
+    out[n++] = 62 << 2;
+    out[n++] = (uint8_t)l;
+    out[n++] = (uint8_t)(l >> 8);
+    out[n++] = (uint8_t)(l >> 16);
+  }
+  memcpy(out + n, data, len);
+  return n + len;
+}
+
+size_t emit_copy(uint8_t* out, size_t offset, size_t len) {
+  size_t n = 0;
+  // prefer 2-byte-offset copies (len 1..64, offset < 65536)
+  while (len > 0) {
+    size_t chunk = len > 64 ? 64 : len;
+    if (chunk < 4) chunk = len;  // tail shorter than 4 uses copy-2 too
+    if (chunk >= 4 && chunk <= 11 && offset < 2048) {
+      out[n++] = (uint8_t)(1 | ((chunk - 4) << 2) | ((offset >> 8) << 5));
+      out[n++] = (uint8_t)offset;
+    } else {
+      out[n++] = (uint8_t)(2 | ((chunk - 1) << 2));
+      out[n++] = (uint8_t)offset;
+      out[n++] = (uint8_t)(offset >> 8);
+    }
+    len -= chunk;
+  }
+  return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint64_t teku_snappy_max_compressed(uint64_t n) {
+  return 32 + n + n / 6;
+}
+
+// returns compressed size, or 0 on error
+uint64_t teku_snappy_compress(const uint8_t* in, uint64_t n, uint8_t* out) {
+  size_t pos = emit_varint(out, n);
+  if (n == 0) return pos;
+  static thread_local int32_t table[1 << 14];
+  memset(table, -1, sizeof(table));
+  size_t ip = 0, lit_start = 0;
+  while (ip + 4 <= n) {
+    uint32_t h = hash4(load32(in + ip));
+    int32_t cand = table[h];
+    table[h] = (int32_t)ip;
+    if (cand >= 0 && ip - (size_t)cand < 65536 &&
+        load32(in + cand) == load32(in + ip)) {
+      // flush pending literal
+      pos += emit_literal(out + pos, in + lit_start, ip - lit_start);
+      // extend the match
+      size_t len = 4;
+      while (ip + len < n && in[cand + len] == in[ip + len] && len < 1 << 16)
+        len++;
+      pos += emit_copy(out + pos, ip - cand, len);
+      ip += len;
+      lit_start = ip;
+    } else {
+      ip++;
+    }
+  }
+  pos += emit_literal(out + pos, in + lit_start, n - lit_start);
+  return pos;
+}
+
+// 0 on success; fills *out_n with the declared uncompressed size
+int teku_snappy_uncompressed_length(const uint8_t* in, uint64_t n,
+                                    uint64_t* out_n) {
+  uint64_t v = 0;
+  int shift = 0;
+  for (uint64_t i = 0; i < n && i < 10; i++) {
+    v |= (uint64_t)(in[i] & 0x7F) << shift;
+    if (!(in[i] & 0x80)) {
+      *out_n = v;
+      return 0;
+    }
+    shift += 7;
+  }
+  return -1;
+}
+
+// returns uncompressed size, or (uint64_t)-1 on malformed input
+uint64_t teku_snappy_uncompress(const uint8_t* in, uint64_t n, uint8_t* out,
+                                uint64_t cap) {
+  uint64_t expect = 0, ip = 0;
+  int shift = 0;
+  for (;;) {
+    if (ip >= n) return (uint64_t)-1;
+    uint8_t b = in[ip++];
+    expect |= (uint64_t)(b & 0x7F) << shift;
+    shift += 7;
+    if (!(b & 0x80)) break;
+    if (shift > 63) return (uint64_t)-1;
+  }
+  if (expect > cap) return (uint64_t)-1;
+  uint64_t op = 0;
+  while (ip < n) {
+    uint8_t tag = in[ip++];
+    uint32_t kind = tag & 3;
+    if (kind == 0) {  // literal
+      uint64_t len = (tag >> 2) + 1;
+      if (len > 60) {
+        uint32_t extra = (uint32_t)len - 60;
+        if (ip + extra > n) return (uint64_t)-1;
+        len = 0;
+        for (uint32_t i = 0; i < extra; i++)
+          len |= (uint64_t)in[ip + i] << (8 * i);
+        len += 1;
+        ip += extra;
+      }
+      if (ip + len > n || op + len > expect) return (uint64_t)-1;
+      memcpy(out + op, in + ip, len);
+      ip += len;
+      op += len;
+    } else {
+      uint64_t len, offset;
+      if (kind == 1) {
+        if (ip >= n) return (uint64_t)-1;
+        len = ((tag >> 2) & 0x7) + 4;
+        offset = ((uint64_t)(tag >> 5) << 8) | in[ip++];
+      } else if (kind == 2) {
+        if (ip + 2 > n) return (uint64_t)-1;
+        len = (tag >> 2) + 1;
+        offset = in[ip] | ((uint64_t)in[ip + 1] << 8);
+        ip += 2;
+      } else {
+        if (ip + 4 > n) return (uint64_t)-1;
+        len = (tag >> 2) + 1;
+        offset = load32(in + ip);
+        ip += 4;
+      }
+      if (offset == 0 || offset > op || op + len > expect)
+        return (uint64_t)-1;
+      // overlapping copies are byte-serial by definition
+      for (uint64_t i = 0; i < len; i++) out[op + i] = out[op + i - offset];
+      op += len;
+    }
+  }
+  return op == expect ? op : (uint64_t)-1;
+}
+
+}  // extern "C"

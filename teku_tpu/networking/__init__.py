@@ -1,0 +1,46 @@
+"""Networking: TCP transport, gossip router, req/resp RPC, sync.
+
+Reference: /root/reference/networking/ (p2p, eth2) and
+/root/reference/beacon/sync/.
+"""
+
+from .gossip import TcpGossipNetwork
+from .reqresp import BeaconRpc
+from .sync import SyncService
+from .transport import NetworkConfig, P2PNetwork, Peer
+
+
+class NetworkedNode:
+    """Convenience bundle: BeaconNode + TCP network + RPC + sync,
+    mirroring the reference's Eth2P2PNetworkBuilder composition."""
+
+    def __init__(self, spec, genesis_state, host: str = "127.0.0.1",
+                 port: int = 0, name: str = "node"):
+        from ..spec import helpers as H
+        from ..node.node import BeaconNode
+        digest = H.compute_fork_digest(
+            spec.config.GENESIS_FORK_VERSION,
+            genesis_state.genesis_validators_root)
+        self.net = P2PNetwork(NetworkConfig(host=host, port=port), digest)
+        self.gossip = TcpGossipNetwork(self.net)
+        self.node = BeaconNode(spec, genesis_state, self.gossip, name=name)
+        self.rpc = BeaconRpc(self.net, self.node)
+        self.sync = SyncService(self.net, self.rpc, self.node)
+
+        async def _on_connect(peer):
+            try:
+                await self.rpc.exchange_status(peer)
+            except Exception:
+                pass
+        self.net.on_peer_connected = _on_connect
+
+    async def start(self) -> None:
+        await self.net.start()
+        await self.node.start()
+
+    async def stop(self) -> None:
+        await self.node.stop()
+        await self.net.stop()
+
+    async def connect(self, other: "NetworkedNode"):
+        return await self.net.connect("127.0.0.1", other.net.port)

@@ -1,0 +1,102 @@
+"""Gossip router over the TCP transport: topics, dedupe, forwarding.
+
+The gossipsub role (reference: networking/p2p libp2p gossip +
+networking/eth2/.../gossip/encoding/SszSnappyEncoding.java): messages
+are ssz_snappy-encoded, identified by sha256(topic || data), seen-cache
+suppressed, delivered to the local TopicHandler, and FORWARDED only on
+ACCEPT (gossipsub validation gating).  Mesh = all connected peers
+(flood-publish within the peer set; peer scoring trims misbehavers).
+"""
+
+import hashlib
+import logging
+import struct
+from typing import Dict, Optional
+
+from ..infra.collections import LimitedSet
+from ..native import snappyc
+from ..node.gossip import GossipNetwork, TopicHandler, ValidationResult
+from .transport import KIND_GOSSIP, P2PNetwork, Peer
+
+_LOG = logging.getLogger(__name__)
+
+REJECT_SCORE = -10
+IGNORE_SCORE = -1
+
+
+class TcpGossipNetwork(GossipNetwork):
+    """GossipNetwork implementation the BeaconNode subscribes through —
+    same interface as the in-memory devnet bus, real wire underneath."""
+
+    def __init__(self, net: P2PNetwork):
+        self.net = net
+        self.net.on_gossip = self._on_gossip
+        self._handlers: Dict[str, TopicHandler] = {}
+        self._seen: LimitedSet = LimitedSet(65536)
+        self._scores: Dict[bytes, int] = {}
+        self.messages_forwarded = 0
+
+    # -- GossipNetwork interface --------------------------------------
+    def subscribe(self, topic: str, handler: TopicHandler) -> None:
+        self._handlers[topic] = handler
+
+    async def publish(self, topic: str, data: bytes) -> None:
+        frame = self._encode(topic, data)
+        self._seen.add(self._msg_id(topic, data))
+        await self._fanout(frame, exclude=None)
+
+    async def _fanout(self, frame: bytes, exclude) -> None:
+        """Concurrent sends: one slow peer's TCP backpressure must not
+        head-of-line-block propagation to the others."""
+        import asyncio
+        sends = [peer.send_frame(KIND_GOSSIP, frame)
+                 for peer in list(self.net.peers) if peer is not exclude]
+        if sends:
+            await asyncio.gather(*sends, return_exceptions=True)
+
+    # -- wire ----------------------------------------------------------
+    @staticmethod
+    def _encode(topic: str, data: bytes) -> bytes:
+        tb = topic.encode()
+        return (struct.pack("<B", len(tb)) + tb
+                + snappyc.compress(data))
+
+    @staticmethod
+    def _msg_id(topic: str, data: bytes) -> bytes:
+        tb = topic.encode()
+        # length-prefix the topic so (topic, data) boundaries can't be
+        # shifted to forge a colliding id that poisons seen-caches
+        return hashlib.sha256(
+            len(tb).to_bytes(4, "little") + tb + data).digest()[:20]
+
+    async def _on_gossip(self, peer: Peer, payload: bytes) -> None:
+        try:
+            tlen = payload[0]
+            topic = payload[1:1 + tlen].decode()
+            data = snappyc.uncompress(payload[1 + tlen:])
+        except Exception:
+            self._punish(peer, REJECT_SCORE)
+            return
+        mid = self._msg_id(topic, data)
+        if not self._seen.add(mid):
+            return                      # duplicate
+        handler = self._handlers.get(topic)
+        if handler is None:
+            return
+        result = await handler.handle_message(data)
+        if result is ValidationResult.ACCEPT:
+            # forward to everyone but the sender (gossipsub propagation
+            # only after validation)
+            self.messages_forwarded += 1
+            await self._fanout(self._encode(topic, data), exclude=peer)
+        elif result is ValidationResult.REJECT:
+            self._punish(peer, REJECT_SCORE)
+        elif result is ValidationResult.IGNORE:
+            self._punish(peer, IGNORE_SCORE)
+
+    def _punish(self, peer: Peer, delta: int) -> None:
+        score = self._scores.get(peer.node_id, 0) + delta
+        self._scores[peer.node_id] = score
+        if score <= -100:
+            _LOG.warning("disconnecting misbehaving peer")
+            peer.close()

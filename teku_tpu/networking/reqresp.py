@@ -1,0 +1,187 @@
+"""Req/resp RPC: status, ping/metadata, blocks by range/root.
+
+The reference's beacon-chain RPC methods over length-prefixed
+snappy-framed SSZ (reference: networking/eth2/src/main/java/tech/
+pegasys/teku/networking/eth2/rpc/beaconchain/methods/ — Status,
+Goodbye, Ping, Metadata, BeaconBlocksByRange/RootMessageHandler;
+framing per rpc/core/encodings/).  Responses here are one frame
+carrying [u8 ok][count:u32][u32-len-prefixed ssz_snappy chunks].
+"""
+
+import logging
+import struct
+from typing import List, Optional, Sequence
+
+from ..native import snappyc
+from ..spec import helpers as H
+from ..spec.datastructures import MetadataMessage, Ping, Status
+from .transport import P2PNetwork, Peer
+
+_LOG = logging.getLogger(__name__)
+
+STATUS = "status"
+PING = "ping"
+METADATA = "metadata"
+BLOCKS_BY_RANGE = "beacon_blocks_by_range"
+BLOCKS_BY_ROOT = "beacon_blocks_by_root"
+
+MAX_REQUEST_BLOCKS = 64
+
+
+MAX_RESPONSE_BYTES = (1 << 24) - 4096     # fits one transport frame
+
+
+def _pack_chunks(chunks: Sequence[bytes], ok: bool = True) -> bytes:
+    """Truncates (never splits) at the frame budget: a shorter valid
+    response lets the requester re-request the rest, an oversized frame
+    would get the whole connection torn down."""
+    body = []
+    total = 0
+    n = 0
+    for c in chunks:
+        comp = snappyc.compress(c)
+        if total + len(comp) + 4 > MAX_RESPONSE_BYTES:
+            break
+        body.append(struct.pack("<I", len(comp)))
+        body.append(comp)
+        total += len(comp) + 4
+        n += 1
+    return struct.pack("<BI", 1 if ok else 0, n) + b"".join(body)
+
+
+def _unpack_chunks(data: bytes) -> Optional[List[bytes]]:
+    if len(data) < 5:
+        return None
+    ok, count = struct.unpack("<BI", data[:5])
+    if not ok or count > 4096:
+        return None
+    pos = 5
+    chunks = []
+    for _ in range(count):
+        if pos + 4 > len(data):
+            return None
+        (n,) = struct.unpack("<I", data[pos:pos + 4])
+        pos += 4
+        if pos + n > len(data):
+            return None
+        chunks.append(snappyc.uncompress(data[pos:pos + n]))
+        pos += n
+    return chunks
+
+
+class BeaconRpc:
+    """Server + client for the beacon RPC methods, bound to a node's
+    chain data."""
+
+    def __init__(self, net: P2PNetwork, node):
+        self.net = net
+        self.node = node
+        self.seq_number = 0
+        net.on_request = self._handle
+
+    # -- server side ---------------------------------------------------
+    def _local_status(self) -> Status:
+        chain = self.node.chain
+        spec = self.node.spec
+        head_root = chain.head_root
+        head_slot = chain.head_slot()
+        fin = chain.finalized_checkpoint
+        digest = H.compute_fork_digest(
+            spec.config.GENESIS_FORK_VERSION,
+            chain.head_state().genesis_validators_root)
+        return Status(fork_digest=digest, finalized_root=fin.root,
+                      finalized_epoch=fin.epoch, head_root=head_root,
+                      head_slot=head_slot)
+
+    async def _handle(self, peer: Peer, method: str, body: bytes) -> bytes:
+        try:
+            if method == STATUS:
+                peer.status = Status.deserialize(snappyc.uncompress(body))
+                return _pack_chunks(
+                    [Status.serialize(self._local_status())])
+            if method == PING:
+                return _pack_chunks(
+                    [Ping.serialize(Ping(seq_number=self.seq_number))])
+            if method == METADATA:
+                return _pack_chunks([MetadataMessage.serialize(
+                    MetadataMessage(seq_number=self.seq_number))])
+            if method == BLOCKS_BY_RANGE:
+                start, count = struct.unpack(
+                    "<QQ", snappyc.uncompress(body))
+                count = min(count, MAX_REQUEST_BLOCKS)
+                return _pack_chunks(self._blocks_by_range(start, count))
+            if method == BLOCKS_BY_ROOT:
+                roots_blob = snappyc.uncompress(body)
+                roots = [roots_blob[i:i + 32]
+                         for i in range(0, min(len(roots_blob),
+                                               32 * MAX_REQUEST_BLOCKS), 32)]
+                return _pack_chunks(self._blocks_by_root(roots))
+        except Exception:
+            _LOG.exception("rpc %s failed", method)
+        return _pack_chunks([], ok=False)
+
+    def _blocks_by_range(self, start: int, count: int) -> List[bytes]:
+        """Canonical-chain blocks in [start, start+count) by slot."""
+        S = self.node.spec.schemas
+        store = self.node.store
+        out = []
+        head = self.node.chain.head_root
+        # walk canonical chain from head down, collect in-range
+        chain = []
+        root = head
+        while root in store.blocks:
+            blk = store.blocks[root]
+            if blk.slot < start:
+                break
+            if blk.slot < start + count:
+                chain.append(root)
+            parent = blk.parent_root
+            if parent == root or parent not in store.blocks:
+                break
+            root = parent
+        signed_blocks = store.signed_blocks
+        for r in reversed(chain):
+            signed = signed_blocks.get(r)
+            if signed is not None:
+                out.append(S.SignedBeaconBlock.serialize(signed))
+        return out
+
+    def _blocks_by_root(self, roots: Sequence[bytes]) -> List[bytes]:
+        S = self.node.spec.schemas
+        signed_blocks = self.node.store.signed_blocks
+        return [S.SignedBeaconBlock.serialize(signed_blocks[r])
+                for r in roots if r in signed_blocks]
+
+    # -- client side ---------------------------------------------------
+    async def exchange_status(self, peer: Peer) -> Optional[Status]:
+        resp = await peer.request(
+            STATUS,
+            snappyc.compress(Status.serialize(self._local_status())))
+        chunks = _unpack_chunks(resp)
+        if not chunks:
+            return None
+        peer.status = Status.deserialize(chunks[0])
+        return peer.status
+
+    async def blocks_by_range(self, peer: Peer, start: int,
+                              count: int) -> List:
+        S = self.node.spec.schemas
+        resp = await peer.request(
+            BLOCKS_BY_RANGE,
+            snappyc.compress(struct.pack("<QQ", start, count)),
+            timeout=30.0)
+        chunks = _unpack_chunks(resp)
+        if chunks is None:
+            return []
+        return [S.SignedBeaconBlock.deserialize(c) for c in chunks]
+
+    async def blocks_by_root(self, peer: Peer, roots: Sequence[bytes]
+                             ) -> List:
+        S = self.node.spec.schemas
+        resp = await peer.request(
+            BLOCKS_BY_ROOT, snappyc.compress(b"".join(roots)),
+            timeout=30.0)
+        chunks = _unpack_chunks(resp)
+        if chunks is None:
+            return []
+        return [S.SignedBeaconBlock.deserialize(c) for c in chunks]

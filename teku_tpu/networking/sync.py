@@ -1,0 +1,79 @@
+"""Forward sync: catch up to the best peer via blocks-by-range.
+
+The reference's multipeer forward sync, reduced to its spine
+(reference: beacon/sync/src/main/java/tech/pegasys/teku/beacon/sync/
+forward/multipeer/ — chain selection by peer-claimed head, batched
+range requests, import through the standard block pipeline): pick the
+peer claiming the highest head above ours, pull batches, import each
+through the BlockManager (full verification), repeat until caught up.
+"""
+
+import asyncio
+import logging
+from typing import Optional
+
+from .reqresp import BeaconRpc, MAX_REQUEST_BLOCKS
+from .transport import P2PNetwork
+
+_LOG = logging.getLogger(__name__)
+
+
+class SyncService:
+    def __init__(self, net: P2PNetwork, rpc: BeaconRpc, node):
+        self.net = net
+        self.rpc = rpc
+        self.node = node
+        self.syncing = False
+        self.blocks_imported = 0
+
+    def _best_peer(self):
+        best, best_slot = None, self.node.chain.head_slot()
+        for peer in self.net.peers:
+            if peer.status is not None and peer.status.head_slot > best_slot:
+                best, best_slot = peer, peer.status.head_slot
+        return best
+
+    async def sync_once(self) -> bool:
+        """One pass: returns True if any block was imported (the driver
+        loops until a pass imports nothing — caught up)."""
+        peer = self._best_peer()
+        if peer is None:
+            return False
+        self.syncing = True
+        start = self.node.chain.head_slot() + 1
+        target = peer.status.head_slot
+        imported_any = False
+        try:
+            while start <= target:
+                count = min(MAX_REQUEST_BLOCKS, target - start + 1)
+                try:
+                    blocks = await self.rpc.blocks_by_range(
+                        peer, start, count)
+                except Exception as exc:
+                    # one bad/silent peer must not kill the service
+                    _LOG.warning("range request failed: %s", exc)
+                    break
+                if not blocks:
+                    break
+                for signed in blocks:
+                    if self.node.block_manager.import_block(signed):
+                        self.blocks_imported += 1
+                        imported_any = True
+                # the cursor must STRICTLY advance regardless of what
+                # slots the peer claims, or a Byzantine peer replaying
+                # old blocks pins the loop forever
+                start = max(start + 1, blocks[-1].message.slot + 1)
+        finally:
+            self.syncing = False
+        return imported_any
+
+    async def run_until_synced(self, max_rounds: int = 50) -> None:
+        for _ in range(max_rounds):
+            # refresh statuses so the target tracks the peer's progress
+            for peer in list(self.net.peers):
+                try:
+                    await self.rpc.exchange_status(peer)
+                except Exception:
+                    continue
+            if not await self.sync_once():
+                return

@@ -1,0 +1,208 @@
+"""TCP p2p transport: framed streams, hello handshake, peer registry.
+
+The transport role of the reference's libp2p stack (reference:
+networking/p2p/src/main/java/tech/pegasys/teku/networking/p2p/libp2p/
+LibP2PNetwork.java:46 — there TCP+yamux+noise via jvm-libp2p; here
+asyncio TCP with u32-length frames and a hello handshake carrying
+node id + fork digest + listen port).  Frames multiplex three planes:
+gossip, request, response — the yamux-stream moral equivalent with a
+fixed lane per plane.
+"""
+
+import asyncio
+import logging
+import secrets
+import struct
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Dict, List, Optional, Tuple
+
+_LOG = logging.getLogger(__name__)
+
+KIND_HELLO = 0
+KIND_GOSSIP = 1
+KIND_REQUEST = 2
+KIND_RESPONSE = 3
+KIND_GOODBYE = 4
+
+MAX_FRAME = 1 << 24
+
+
+class Peer:
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter, outbound: bool):
+        self.reader = reader
+        self.writer = writer
+        self.outbound = outbound
+        self.node_id: bytes = b""
+        self.fork_digest: bytes = b""
+        self.listen_port: int = 0
+        self.status = None            # latest chain Status from them
+        self._req_id = 0
+        self._pending: Dict[int, asyncio.Future] = {}
+        self.connected = True
+
+    async def send_frame(self, kind: int, payload: bytes) -> None:
+        if not self.connected:
+            return
+        try:
+            self.writer.write(struct.pack("<IB", len(payload) + 1, kind)
+                              + payload)
+            await self.writer.drain()
+        except (ConnectionError, OSError):
+            self.connected = False
+
+    async def read_frame(self) -> Optional[Tuple[int, bytes]]:
+        try:
+            head = await self.reader.readexactly(4)
+            (n,) = struct.unpack("<I", head)
+            if not 1 <= n <= MAX_FRAME:
+                return None
+            body = await self.reader.readexactly(n)
+            return body[0], body[1:]
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            return None
+
+    async def request(self, method: str, payload: bytes,
+                      timeout: float = 10.0) -> bytes:
+        """Round-trip on the request lane; responses matched by id."""
+        self._req_id += 1
+        rid = self._req_id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        mb = method.encode()
+        await self.send_frame(
+            KIND_REQUEST,
+            struct.pack("<IB", rid, len(mb)) + mb + payload)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(rid, None)
+
+    def close(self) -> None:
+        self.connected = False
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+@dataclass
+class NetworkConfig:
+    host: str = "127.0.0.1"
+    port: int = 0                    # 0 = ephemeral
+    max_peers: int = 32
+
+
+class P2PNetwork:
+    """Listens + dials; owns per-peer read loops; hands decoded frames
+    to the gossip router and req/resp handler."""
+
+    def __init__(self, config: NetworkConfig, fork_digest: bytes,
+                 node_id: Optional[bytes] = None):
+        self.config = config
+        self.fork_digest = fork_digest
+        self.node_id = node_id or secrets.token_bytes(32)
+        self.peers: List[Peer] = []
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.port: int = config.port
+        # plane handlers, wired by gossip router / rpc dispatcher
+        self.on_gossip: Optional[Callable[[Peer, bytes],
+                                          Awaitable[None]]] = None
+        self.on_request: Optional[Callable[[Peer, str, bytes],
+                                           Awaitable[bytes]]] = None
+        self.on_peer_connected: Optional[Callable[[Peer],
+                                                  Awaitable[None]]] = None
+
+    # -- lifecycle -----------------------------------------------------
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._accept, self.config.host, self.config.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        for p in list(self.peers):
+            await p.send_frame(KIND_GOODBYE, b"\x01")
+            p.close()
+        self.peers.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # -- dialing / accepting ------------------------------------------
+    async def connect(self, host: str, port: int) -> Optional[Peer]:
+        if len(self.peers) >= self.config.max_peers:
+            return None
+        reader, writer = await asyncio.open_connection(host, port)
+        peer = Peer(reader, writer, outbound=True)
+        await self._handshake(peer)
+        if not peer.connected:
+            return None
+        self.peers.append(peer)
+        asyncio.create_task(self._read_loop(peer))
+        if self.on_peer_connected:
+            await self.on_peer_connected(peer)
+        return peer
+
+    async def _accept(self, reader, writer) -> None:
+        peer = Peer(reader, writer, outbound=False)
+        await self._handshake(peer)
+        if not peer.connected:
+            return
+        if len(self.peers) >= self.config.max_peers:
+            await peer.send_frame(KIND_GOODBYE, b"\x02")  # too many peers
+            peer.close()
+            return
+        self.peers.append(peer)
+        asyncio.create_task(self._read_loop(peer))
+        if self.on_peer_connected:
+            await self.on_peer_connected(peer)
+
+    async def _handshake(self, peer: Peer) -> None:
+        hello = (self.node_id + self.fork_digest
+                 + struct.pack("<H", self.port))
+        await peer.send_frame(KIND_HELLO, hello)
+        frame = await peer.read_frame()
+        if frame is None or frame[0] != KIND_HELLO or len(frame[1]) < 38:
+            peer.close()
+            return
+        data = frame[1]
+        peer.node_id = data[:32]
+        peer.fork_digest = data[32:36]
+        (peer.listen_port,) = struct.unpack("<H", data[36:38])
+        if peer.fork_digest != self.fork_digest:
+            _LOG.info("peer on a different fork, disconnecting")
+            await peer.send_frame(KIND_GOODBYE, b"\x03")  # irrelevant net
+            peer.close()
+        if peer.node_id == self.node_id:
+            peer.close()                                  # self-dial
+
+    # -- read pump -----------------------------------------------------
+    async def _read_loop(self, peer: Peer) -> None:
+        while peer.connected:
+            frame = await peer.read_frame()
+            if frame is None:
+                break
+            kind, payload = frame
+            try:
+                if kind == KIND_GOSSIP and self.on_gossip:
+                    await self.on_gossip(peer, payload)
+                elif kind == KIND_REQUEST and self.on_request:
+                    (rid, mlen) = struct.unpack("<IB", payload[:5])
+                    method = payload[5:5 + mlen].decode()
+                    body = payload[5 + mlen:]
+                    resp = await self.on_request(peer, method, body)
+                    await peer.send_frame(
+                        KIND_RESPONSE, struct.pack("<I", rid) + resp)
+                elif kind == KIND_RESPONSE:
+                    (rid,) = struct.unpack("<I", payload[:4])
+                    fut = peer._pending.get(rid)
+                    if fut is not None and not fut.done():
+                        fut.set_result(payload[4:])
+                elif kind == KIND_GOODBYE:
+                    break
+            except Exception:
+                _LOG.exception("peer frame handling failed")
+                break
+        peer.close()
+        if peer in self.peers:
+            self.peers.remove(peer)

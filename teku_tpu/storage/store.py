@@ -44,6 +44,14 @@ class Store:
                                                root=anchor_root)
         self.proposer_boost_enabled = proposer_boost_enabled
         self.blocks: Dict[bytes, object] = {anchor_root: anchor_block}
+        # full signed envelopes, retained to serve req/resp block syncs;
+        # the anchor gets a zero-signature envelope (its signature is
+        # not part of the anchor trust model) so RPC can serve it too
+        from ..spec.datastructures import get_schemas
+        S = get_schemas(cfg)
+        self.signed_blocks: Dict[bytes, object] = {
+            anchor_root: S.SignedBeaconBlock(message=anchor_block,
+                                             signature=b"\x00" * 96)}
         self.block_states: Dict[bytes, object] = {anchor_root: anchor_state}
         self.checkpoint_states: Dict[Tuple[int, bytes], object] = {
             (anchor_epoch, anchor_root): anchor_state}
@@ -144,6 +152,7 @@ class Store:
             raise ForkChoiceError(f"invalid block: {exc}") from exc
 
         self.blocks[root] = block
+        self.signed_blocks[root] = signed_block
         self.block_states[root] = post
 
         # proposer boost (spec: if within the first interval of the slot)
