@@ -1,0 +1,3 @@
+"""REST API surface (reference: data/beaconrestapi)."""
+
+from .beacon_api import BeaconRestApi

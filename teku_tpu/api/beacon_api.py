@@ -1,0 +1,288 @@
+"""Beacon REST API: the standard eth2 node HTTP surface.
+
+Equivalent of the reference's beacon REST API (reference: data/
+beaconrestapi/src/main/java/tech/pegasys/teku/beaconrestapi/
+JsonTypeDefinitionBeaconRestApi.java and handlers/v1/{node,beacon,
+validator,config}/): node identity/health/syncing, chain queries
+(genesis, headers, blocks, finality checkpoints, validators), pool
+submission, duty queries, spec config, plus the Prometheus /metrics
+exposition (infrastructure/metrics MetricsEndpoint analogue).
+"""
+
+import logging
+from typing import Optional
+
+from ..infra.metrics import GLOBAL_REGISTRY
+from ..infra.restapi import HttpError, RestApi
+from ..spec import helpers as H
+
+_LOG = logging.getLogger(__name__)
+
+VERSION = "teku-tpu/0.3.0"
+
+
+def _hex(b: bytes) -> str:
+    return "0x" + b.hex()
+
+
+class BeaconRestApi(RestApi):
+    """Routes bound to one BeaconNode (and optionally its p2p net)."""
+
+    def __init__(self, node, networked=None, host: str = "127.0.0.1",
+                 port: int = 0, validator_api=None):
+        super().__init__(host, port)
+        self.node = node
+        self.networked = networked
+        self.validator_api = validator_api
+        g = self.get
+        p = self.post
+        g("/eth/v1/node/health", self._health)
+        g("/eth/v1/node/version", self._version)
+        g("/eth/v1/node/identity", self._identity)
+        g("/eth/v1/node/syncing", self._syncing)
+        g("/eth/v1/node/peers", self._peers)
+        g("/eth/v1/beacon/genesis", self._genesis)
+        g("/eth/v1/beacon/headers/{block_id}", self._header)
+        g("/eth/v2/beacon/blocks/{block_id}", self._block)
+        g("/eth/v1/beacon/states/{state_id}/root", self._state_root)
+        g("/eth/v1/beacon/states/{state_id}/finality_checkpoints",
+          self._finality)
+        g("/eth/v1/beacon/states/{state_id}/validators", self._validators)
+        g("/eth/v1/config/spec", self._spec_config)
+        g("/eth/v1/validator/duties/proposer/{epoch}", self._proposer_duties)
+        p("/eth/v1/validator/duties/attester/{epoch}", self._attester_duties)
+        p("/eth/v1/beacon/pool/attestations", self._submit_attestations)
+        g("/metrics", self._metrics)
+
+    # -- resolution helpers -------------------------------------------
+    def _resolve_block_root(self, block_id: str) -> bytes:
+        chain = self.node.chain
+        if block_id == "head":
+            return chain.head_root
+        if block_id == "finalized":
+            return chain.finalized_checkpoint.root
+        if block_id == "justified":
+            return chain.justified_checkpoint.root
+        if block_id.startswith("0x"):
+            try:
+                root = bytes.fromhex(block_id[2:])
+            except ValueError:
+                raise HttpError(400, f"invalid root {block_id!r}")
+            if len(root) != 32:
+                raise HttpError(400, "root must be 32 bytes")
+            if chain.contains_block(root):
+                return root
+            raise HttpError(404, "block not found")
+        try:
+            slot = int(block_id)
+        except ValueError:
+            raise HttpError(400, f"invalid block id {block_id!r}")
+        root = self.node.store.proto.ancestor_at_slot(chain.head_root, slot)
+        if root is None or self.node.store.blocks[root].slot != slot:
+            raise HttpError(404, "no canonical block at slot")
+        return root
+
+    def _resolve_state(self, state_id: str):
+        root = self._resolve_block_root(
+            "head" if state_id == "head" else state_id)
+        state = self.node.chain.get_state(root)
+        if state is None:
+            raise HttpError(404, "state not available")
+        return state
+
+    # -- node ----------------------------------------------------------
+    async def _health(self):
+        return {}
+
+    async def _version(self):
+        return {"data": {"version": VERSION}}
+
+    async def _identity(self):
+        node_id = (self.networked.net.node_id.hex()
+                   if self.networked else "00" * 32)
+        return {"data": {"peer_id": node_id, "enr": "",
+                         "p2p_addresses": [], "metadata": {
+                             "seq_number": "0", "attnets": "0x" + "00" * 8}}}
+
+    async def _syncing(self):
+        syncing = bool(self.networked and self.networked.sync.syncing)
+        head = self.node.chain.head_slot()
+        current = self.node.chain.current_slot()
+        return {"data": {"head_slot": str(head),
+                         "sync_distance": str(max(0, current - head)),
+                         "is_syncing": syncing,
+                         "is_optimistic": False, "el_offline": False}}
+
+    async def _peers(self):
+        peers = []
+        if self.networked:
+            for peer in self.networked.net.peers:
+                peers.append({
+                    "peer_id": peer.node_id.hex(),
+                    "state": "connected" if peer.connected
+                    else "disconnected",
+                    "direction": "outbound" if peer.outbound
+                    else "inbound"})
+        return {"data": peers,
+                "meta": {"count": len(peers)}}
+
+    # -- beacon --------------------------------------------------------
+    async def _genesis(self):
+        # every state carries the same genesis fields
+        state = self.node.chain.head_state()
+        return {"data": {
+            "genesis_time": str(state.genesis_time),
+            "genesis_validators_root": _hex(state.genesis_validators_root),
+            "genesis_fork_version": _hex(
+                self.node.spec.config.GENESIS_FORK_VERSION)}}
+
+    async def _header(self, block_id: str):
+        root = self._resolve_block_root(block_id)
+        block = self.node.store.blocks[root]
+        return {"data": {
+            "root": _hex(root),
+            "canonical": True,
+            "header": {"message": {
+                "slot": str(block.slot),
+                "proposer_index": str(block.proposer_index),
+                "parent_root": _hex(block.parent_root),
+                "state_root": _hex(block.state_root),
+                "body_root": _hex(block.body.htr())}}},
+            "execution_optimistic": False, "finalized": False}
+
+    async def _block(self, block_id: str):
+        root = self._resolve_block_root(block_id)
+        signed = self.node.store.signed_blocks.get(root)
+        if signed is None:
+            raise HttpError(404, "signed block not retained")
+        block = signed.message
+        return {"version": "phase0", "data": {
+            "message": {
+                "slot": str(block.slot),
+                "proposer_index": str(block.proposer_index),
+                "parent_root": _hex(block.parent_root),
+                "state_root": _hex(block.state_root),
+                "body": {
+                    "randao_reveal": _hex(block.body.randao_reveal),
+                    "graffiti": _hex(block.body.graffiti),
+                    "attestations_count": len(block.body.attestations)},
+            },
+            "signature": _hex(signed.signature)}}
+
+    async def _state_root(self, state_id: str):
+        state = self._resolve_state(state_id)
+        return {"data": {"root": _hex(state.htr())}}
+
+    async def _finality(self, state_id: str):
+        state = self._resolve_state(state_id)
+        def cp(c):
+            return {"epoch": str(c.epoch), "root": _hex(c.root)}
+        return {"data": {
+            "previous_justified": cp(state.previous_justified_checkpoint),
+            "current_justified": cp(state.current_justified_checkpoint),
+            "finalized": cp(state.finalized_checkpoint)}}
+
+    async def _validators(self, state_id: str, query=None):
+        state = self._resolve_state(state_id)
+        cfg = self.node.spec.config
+        epoch = H.get_current_epoch(cfg, state)
+        from ..spec.config import FAR_FUTURE_EPOCH
+        out = []
+        for i, v in enumerate(state.validators):
+            if H.is_active_validator(v, epoch):
+                status = ("active_slashed" if v.slashed
+                          else "active_exiting"
+                          if v.exit_epoch != FAR_FUTURE_EPOCH
+                          else "active_ongoing")
+            elif epoch >= v.exit_epoch:
+                status = ("withdrawal_possible"
+                          if epoch >= v.withdrawable_epoch
+                          else "exited_slashed" if v.slashed
+                          else "exited_unslashed")
+            else:
+                status = ("pending_queued"
+                          if v.activation_eligibility_epoch
+                          != FAR_FUTURE_EPOCH else "pending_initialized")
+            out.append({"index": str(i),
+                        "balance": str(state.balances[i]),
+                        "status": status,
+                        "validator": {
+                            "pubkey": _hex(v.pubkey),
+                            "effective_balance": str(v.effective_balance),
+                            "slashed": v.slashed,
+                            "activation_epoch": str(v.activation_epoch),
+                            "exit_epoch": str(v.exit_epoch)}})
+        return {"data": out}
+
+    async def _spec_config(self):
+        cfg = self.node.spec.config
+        out = {}
+        for name in cfg.__dataclass_fields__:
+            v = getattr(cfg, name)
+            out[name] = _hex(v) if isinstance(v, bytes) else str(v)
+        return {"data": out}
+
+    # -- validator -----------------------------------------------------
+    async def _proposer_duties(self, epoch: str):
+        if self.validator_api is None:
+            raise HttpError(503, "validator api not wired")
+        duties = self.validator_api.get_proposer_duties(int(epoch))
+        state = self.node.chain.head_state()
+        return {"data": [
+            {"pubkey": _hex(
+                state.validators[d.validator_index].pubkey),
+             "validator_index": str(d.validator_index),
+             "slot": str(d.slot)} for d in duties]}
+
+    async def _attester_duties(self, epoch: str, body=None):
+        if self.validator_api is None:
+            raise HttpError(503, "validator api not wired")
+        indices = [int(i) for i in (body or [])]
+        duties = self.validator_api.get_attester_duties(int(epoch), indices)
+        state = self.node.chain.head_state()
+        return {"data": [
+            {"pubkey": _hex(state.validators[d.validator_index].pubkey),
+             "validator_index": str(d.validator_index),
+             "committee_index": str(d.committee_index),
+             "committee_length": str(d.committee_size),
+             "committees_at_slot": str(d.committees_at_slot),
+             "validator_committee_index": str(d.committee_position),
+             "slot": str(d.slot)} for d in duties]}
+
+    async def _submit_attestations(self, body=None):
+        if not isinstance(body, list):
+            raise HttpError(400, "expected a list of attestations")
+        S = self.node.spec.schemas
+        from ..spec.datastructures import AttestationData, Checkpoint
+        accepted = 0
+        for a in body:
+            try:
+                data = a["data"]
+                att = S.Attestation(
+                    aggregation_bits=S.Attestation._ssz_fields[
+                        "aggregation_bits"].deserialize(
+                        bytes.fromhex(a["aggregation_bits"][2:])),
+                    data=AttestationData(
+                        slot=int(data["slot"]),
+                        index=int(data["index"]),
+                        beacon_block_root=bytes.fromhex(
+                            data["beacon_block_root"][2:]),
+                        source=Checkpoint(
+                            epoch=int(data["source"]["epoch"]),
+                            root=bytes.fromhex(data["source"]["root"][2:])),
+                        target=Checkpoint(
+                            epoch=int(data["target"]["epoch"]),
+                            root=bytes.fromhex(data["target"]["root"][2:]))),
+                    signature=bytes.fromhex(a["signature"][2:]))
+            except (KeyError, ValueError, TypeError, AttributeError) as exc:
+                raise HttpError(400, f"malformed attestation: {exc}")
+            result = await self.node.attestation_validator.validate(att)
+            from ..node.gossip import ValidationResult
+            if result is ValidationResult.ACCEPT:
+                self.node.attestation_manager.add_attestation(att)
+                accepted += 1
+        return {"data": {"accepted": accepted}}
+
+    # -- metrics -------------------------------------------------------
+    async def _metrics(self):
+        return GLOBAL_REGISTRY.expose(), "text/plain; version=0.0.4"

@@ -1,0 +1,338 @@
+"""Command-line entry point: subcommands + layered configuration.
+
+Equivalent of the reference's CLI layer (reference: teku/src/main/java/
+tech/pegasys/teku/Teku.java:37, cli/BeaconNodeCommand.java with
+CLI > env (TEKU_*) > YAML layering via CascadingParamsProvider, and the
+cli/subcommand/ family — node, validator-client, transition, genesis,
+slashing-protection, peer): here argparse subcommands with the same
+precedence rules (flags beat TEKU_TPU_* env vars beat --config-file
+YAML beat defaults).
+
+Run as `python -m teku_tpu.cli <subcommand>`.
+"""
+
+import argparse
+import asyncio
+import json
+import logging
+import os
+import sys
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+from .infra.logs import configure as configure_logging
+
+ENV_PREFIX = "TEKU_TPU_"
+
+
+def layered_value(name: str, cli_value, yaml_cfg: Dict[str, Any],
+                  default=None, cast=str):
+    """CLI > env > YAML > default (reference CascadingParamsProvider)."""
+    if cli_value is not None:
+        return cli_value
+    env = os.environ.get(ENV_PREFIX + name.upper().replace("-", "_"))
+    if env is not None:
+        return cast(env)
+    if name in yaml_cfg:
+        return cast(yaml_cfg[name])
+    return default
+
+
+def _load_yaml(path: Optional[str]) -> Dict[str, Any]:
+    if not path:
+        return {}
+    import yaml
+    with open(path) as f:
+        out = yaml.safe_load(f) or {}
+    if not isinstance(out, dict):
+        raise SystemExit("config file must be a mapping")
+    return out
+
+
+# --------------------------------------------------------------------------
+# subcommands
+# --------------------------------------------------------------------------
+
+def cmd_node(args) -> int:
+    """Run a beacon node: p2p + REST + optional validators + storage."""
+    from .networking import NetworkedNode
+    from .api import BeaconRestApi
+    from .spec import create_spec
+    from .spec.genesis import interop_genesis
+    from .storage.database import Database, PersistentChainStorage
+    from .validator import (BeaconNodeValidatorApi, LocalSigner,
+                            SlashingProtectedSigner, ValidatorClient)
+    from .validator.slashing_protection import SlashingProtector
+
+    yaml_cfg = _load_yaml(args.config_file)
+    network = layered_value("network", args.network, yaml_cfg, "minimal")
+    port = int(layered_value("p2p-port", args.p2p_port, yaml_cfg, 0, int))
+    rest_port = int(layered_value("rest-port", args.rest_port, yaml_cfg,
+                                  5051, int))
+    data_dir = layered_value("data-dir", args.data_dir, yaml_cfg)
+    n_interop = int(layered_value("interop-validators",
+                                  args.interop_validators, yaml_cfg, 0,
+                                  int))
+    total_interop = int(layered_value("interop-total",
+                                      args.interop_total, yaml_cfg,
+                                      max(n_interop, 64), int))
+
+    import time
+    spec = create_spec(network)
+    genesis_time_cfg = int(layered_value(
+        "genesis-time", args.genesis_time, yaml_cfg, 0, int))
+
+    # an existing database wins: resume the persisted chain instead of
+    # minting a fresh genesis that would orphan it (reference:
+    # StorageBackedRecentChainData boot path)
+    db = None
+    storage = None
+    restored = None
+    if data_dir:
+        Path(data_dir).mkdir(parents=True, exist_ok=True)
+        db = Database(Path(data_dir) / "chain.db", spec)
+        storage = PersistentChainStorage(db)
+        restored = storage.restore_store(spec)
+
+    if restored is not None:
+        anchor_state = db.get_state(db.load_anchor()[0].htr())
+        genesis_state = anchor_state
+        genesis_time = restored.genesis_time
+        sks = interop_genesis(spec.config, total_interop,
+                              genesis_time)[1] if n_interop else []
+        print(f"resumed from data dir: head slot "
+              f"{restored.blocks[restored.get_head()].slot}")
+    else:
+        # interop devnets anchor genesis at "now" unless pinned — every
+        # node on the devnet must pass the SAME value to share a chain
+        genesis_time = genesis_time_cfg or int(time.time())
+        genesis_state, sks = interop_genesis(spec.config, total_interop,
+                                             genesis_time)
+
+    async def run():
+        from .infra.events import FinalizedCheckpointChannel
+        nn = NetworkedNode(spec, genesis_state, port=port, store=restored)
+        if db is not None:
+            if restored is None:
+                anchor = nn.node.store.blocks[
+                    nn.node.store.justified_checkpoint.root]
+                db.save_anchor(anchor,
+                               nn.node.store.block_states[anchor.htr()])
+            nn.node.block_manager.on_imported.append(
+                lambda root: storage.on_block_imported(
+                    nn.node.store.signed_blocks[root],
+                    nn.node.store.block_states[root]))
+
+            class _FinalizedSink:
+                def on_new_finalized_checkpoint(self, checkpoint,
+                                                from_optimistic_api=False):
+                    storage.on_finalized(nn.node.store, checkpoint)
+            nn.node.channels.subscribe(FinalizedCheckpointChannel,
+                                       _FinalizedSink())
+        await nn.start()
+        api_channel = BeaconNodeValidatorApi(nn.node)
+        rest_api = BeaconRestApi(nn.node, nn, port=rest_port,
+                                 validator_api=api_channel)
+        await rest_api.start()
+        clients = []
+        if n_interop:
+            keys = {i: sks[i] for i in range(n_interop)}
+            signer = SlashingProtectedSigner(
+                LocalSigner(keys),
+                SlashingProtector(Path(data_dir) / "slashing"
+                                  if data_dir else None))
+            clients.append(ValidatorClient(spec, api_channel, signer,
+                                           sorted(keys)))
+        for addr in args.peer or []:
+            host, _, p = addr.rpartition(":")
+            try:
+                await nn.net.connect(host or "127.0.0.1", int(p))
+            except OSError as exc:
+                logging.warning("dial %s failed: %s", addr, exc)
+        print(f"node up: p2p={nn.net.port} rest={rest_api.port} "
+              f"validators={n_interop}/{total_interop}")
+        # real-time slot loop
+        try:
+            while True:
+                now = int(time.time())
+                slot = max(0, (now - genesis_time)
+                           // spec.config.SECONDS_PER_SLOT)
+                if slot > 0:
+                    await nn.node.on_slot(slot)
+                    for c in clients:
+                        await c.on_slot_start(slot)
+                    await asyncio.sleep(spec.config.SECONDS_PER_SLOT / 3)
+                    for c in clients:
+                        await c.on_attestation_due(slot)
+                    await asyncio.sleep(spec.config.SECONDS_PER_SLOT / 3)
+                    for c in clients:
+                        await c.on_aggregation_due(slot)
+                next_slot_time = genesis_time + (slot + 1) * \
+                    spec.config.SECONDS_PER_SLOT
+                await asyncio.sleep(max(0.1, next_slot_time - time.time()))
+        finally:
+            await rest_api.stop()
+            await nn.stop()
+            if db is not None:
+                db.close()
+
+    asyncio.run(run())
+    return 0
+
+
+def cmd_devnet(args) -> int:
+    """In-process devnet: N nodes, loopback gossip, fast clock."""
+    from .node import Devnet
+
+    async def run():
+        net = Devnet(n_nodes=args.nodes, n_validators=args.validators)
+        await net.start()
+        try:
+            last = args.epochs * net.spec.config.SLOTS_PER_EPOCH
+            for slot in range(1, last + 1):
+                await net.run_slot(slot)
+                if slot % net.spec.config.SLOTS_PER_EPOCH == 0:
+                    print(f"epoch {slot // net.spec.config.SLOTS_PER_EPOCH}"
+                          f": justified={net.min_justified_epoch()} "
+                          f"finalized={net.min_finalized_epoch()} "
+                          f"converged={net.heads_converged()}")
+            ok = (net.heads_converged()
+                  and net.min_finalized_epoch() >= args.epochs - 3)
+            print("devnet", "FINALIZED" if ok else "DID NOT FINALIZE")
+            return 0 if ok else 1
+        finally:
+            await net.stop()
+
+    return asyncio.run(run())
+
+
+def cmd_transition(args) -> int:
+    """Offline state transition over SSZ files (reference `transition`
+    subcommand: cli/subcommand/TransitionCommand)."""
+    from .spec import create_spec
+    from .spec.transition import state_transition, StateTransitionError
+
+    spec = create_spec(args.network)
+    S = spec.schemas
+    state = S.BeaconState.deserialize(Path(args.pre).read_bytes())
+    for blk_path in args.blocks:
+        signed = S.SignedBeaconBlock.deserialize(
+            Path(blk_path).read_bytes())
+        try:
+            state = state_transition(spec.config, state, signed,
+                                     validate_result=not args.no_validate)
+        except StateTransitionError as exc:
+            print(f"invalid block {blk_path}: {exc}", file=sys.stderr)
+            return 1
+    Path(args.post).write_bytes(S.BeaconState.serialize(state))
+    print(f"post state written: slot={state.slot} root=0x"
+          f"{state.htr().hex()}")
+    return 0
+
+
+def cmd_genesis(args) -> int:
+    """Write an interop genesis state (reference `genesis` subcommand)."""
+    from .spec import create_spec
+    from .spec.genesis import interop_genesis
+
+    spec = create_spec(args.network)
+    state, _sks = interop_genesis(spec.config, args.validators,
+                                  args.genesis_time)
+    Path(args.out).write_bytes(spec.schemas.BeaconState.serialize(state))
+    print(f"genesis written: {args.out} validators={args.validators} "
+          f"root=0x{state.htr().hex()}")
+    return 0
+
+
+def cmd_slashing_protection(args) -> int:
+    """EIP-3076 interchange import/export (reference
+    slashing-protection subcommand)."""
+    from .validator.slashing_protection import SlashingProtector
+
+    protector = SlashingProtector(args.data_dir)
+    gvr = bytes.fromhex(args.genesis_validators_root.removeprefix("0x"))
+    if args.action == "export":
+        doc = protector.export_interchange(gvr)
+        Path(args.file).write_text(json.dumps(doc, indent=2))
+        print(f"exported {len(doc['data'])} records")
+    else:
+        doc = json.loads(Path(args.file).read_text())
+        n = protector.import_interchange(doc, gvr)
+        print(f"imported {n} records")
+    return 0
+
+
+def cmd_peer(args) -> int:
+    """Generate a node identity (reference `peer generate`)."""
+    import secrets
+    node_id = secrets.token_bytes(32)
+    print(json.dumps({"node_id": node_id.hex()}))
+    return 0
+
+
+# --------------------------------------------------------------------------
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="teku-tpu", description="TPU-native beacon node")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    n = sub.add_parser("node", help="run a beacon node")
+    n.add_argument("--network", default=None)
+    n.add_argument("--config-file", default=None)
+    n.add_argument("--p2p-port", type=int, default=None)
+    n.add_argument("--rest-port", type=int, default=None)
+    n.add_argument("--data-dir", default=None)
+    n.add_argument("--interop-validators", type=int, default=None,
+                   help="run the first N interop validators locally")
+    n.add_argument("--interop-total", type=int, default=None,
+                   help="total validators at genesis")
+    n.add_argument("--genesis-time", type=int, default=None,
+                   help="unix genesis time (default: now; devnet nodes "
+                        "must agree)")
+    n.add_argument("--peer", action="append",
+                   help="host:port to dial (repeatable)")
+    n.set_defaults(fn=cmd_node)
+
+    d = sub.add_parser("devnet", help="in-process fast devnet")
+    d.add_argument("--nodes", type=int, default=2)
+    d.add_argument("--validators", type=int, default=32)
+    d.add_argument("--epochs", type=int, default=4)
+    d.set_defaults(fn=cmd_devnet)
+
+    t = sub.add_parser("transition", help="offline state transition")
+    t.add_argument("--network", default="minimal")
+    t.add_argument("--pre", required=True)
+    t.add_argument("--post", required=True)
+    t.add_argument("--no-validate", action="store_true")
+    t.add_argument("blocks", nargs="*")
+    t.set_defaults(fn=cmd_transition)
+
+    g = sub.add_parser("genesis", help="write an interop genesis state")
+    g.add_argument("--network", default="minimal")
+    g.add_argument("--validators", type=int, default=64)
+    g.add_argument("--genesis-time", type=int, default=1578009600)
+    g.add_argument("--out", required=True)
+    g.set_defaults(fn=cmd_genesis)
+
+    s = sub.add_parser("slashing-protection",
+                       help="EIP-3076 interchange import/export")
+    s.add_argument("action", choices=["import", "export"])
+    s.add_argument("--data-dir", required=True)
+    s.add_argument("--file", required=True)
+    s.add_argument("--genesis-validators-root", default="00" * 32)
+    s.set_defaults(fn=cmd_slashing_protection)
+
+    pe = sub.add_parser("peer", help="generate a node identity")
+    pe.set_defaults(fn=cmd_peer)
+    return p
+
+
+def main(argv=None) -> int:
+    configure_logging()
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,154 @@
+"""Minimal asyncio HTTP/1.1 server with typed JSON routes.
+
+The REST plumbing role of the reference's Javalin wrapper (reference:
+infrastructure/restapi/src/main/java/tech/pegasys/teku/infrastructure/
+restapi/RestApi.java:19-34): a route table of (method, path pattern)
+→ async handler, path params via {name} segments, JSON in/out, error
+mapping.  Deliberately tiny — enough for the beacon API surface and
+the Prometheus exposition, with zero third-party dependencies.
+"""
+
+import asyncio
+import json
+import logging
+import re
+from typing import Awaitable, Callable, Dict, List, Optional, Tuple
+
+_LOG = logging.getLogger(__name__)
+
+Handler = Callable[..., Awaitable]
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class RestApi:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._routes: List[Tuple[str, "re.Pattern", Handler]] = []
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    def route(self, method: str, pattern: str, handler: Handler) -> None:
+        regex = re.compile(
+            "^" + re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$")
+        self._routes.append((method.upper(), regex, handler))
+
+    def get(self, pattern: str, handler: Handler) -> None:
+        self.route("GET", pattern, handler)
+
+    def post(self, pattern: str, handler: Handler) -> None:
+        self.route("POST", pattern, handler)
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._client, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    # ------------------------------------------------------------------
+    async def _client(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+                parts = line.decode("latin1").strip().split(" ")
+                if len(parts) != 3:
+                    break
+                method, target, _version = parts
+                headers: Dict[str, str] = {}
+                while True:
+                    h = await reader.readline()
+                    if not h or h in (b"\r\n", b"\n"):
+                        break
+                    k, _, v = h.decode("latin1").partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                n = int(headers.get("content-length", "0") or "0")
+                if n > (1 << 22):
+                    # can't resync the stream past an unread body:
+                    # reject and close
+                    await self._respond(writer, 413,
+                                        {"code": 413,
+                                         "message": "body too large"})
+                    break
+                if n:
+                    body = await reader.readexactly(n)
+                keep = headers.get("connection", "").lower() != "close"
+                await self._dispatch(writer, method, target, body)
+                if not keep:
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        except Exception:
+            _LOG.exception("http client loop failed")
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, writer, method: str, target: str,
+                        body: bytes) -> None:
+        path, _, query = target.partition("?")
+        params = {}
+        for kv in query.split("&"):
+            if "=" in kv:
+                k, v = kv.split("=", 1)
+                params[k] = v
+        status, payload, ctype = 404, {"code": 404,
+                                       "message": "not found"}, None
+        import inspect
+        for m, regex, handler in self._routes:
+            match = regex.match(path)
+            if m == method and match:
+                try:
+                    kwargs = dict(match.groupdict())
+                    accepted = inspect.signature(handler).parameters
+                    if body and "body" in accepted:
+                        try:
+                            kwargs["body"] = json.loads(body)
+                        except json.JSONDecodeError:
+                            raise HttpError(400, "invalid JSON body")
+                    if params and "query" in accepted:
+                        kwargs["query"] = params
+                    result = await handler(**kwargs)
+                    if isinstance(result, tuple):       # (payload, ctype)
+                        payload, ctype = result
+                    else:
+                        payload = result
+                    status = 200
+                except HttpError as exc:
+                    status = exc.status
+                    payload = {"code": exc.status, "message": exc.message}
+                except Exception as exc:
+                    _LOG.exception("handler failed: %s %s", method, path)
+                    status = 500
+                    payload = {"code": 500, "message": str(exc)}
+                break
+        await self._respond(writer, status, payload, ctype)
+
+    @staticmethod
+    async def _respond(writer, status: int, payload,
+                       ctype: Optional[str] = None) -> None:
+        if ctype is None:
+            data = json.dumps(payload).encode()
+            ctype = "application/json"
+        else:
+            data = payload if isinstance(payload, bytes) else str(
+                payload).encode()
+        head = (f"HTTP/1.1 {status} X\r\nContent-Type: {ctype}\r\n"
+                f"Content-Length: {len(data)}\r\n\r\n")
+        writer.write(head.encode() + data)
+        await writer.drain()

@@ -15,7 +15,7 @@ class NetworkedNode:
     mirroring the reference's Eth2P2PNetworkBuilder composition."""
 
     def __init__(self, spec, genesis_state, host: str = "127.0.0.1",
-                 port: int = 0, name: str = "node"):
+                 port: int = 0, name: str = "node", store=None):
         from ..spec import helpers as H
         from ..node.node import BeaconNode
         digest = H.compute_fork_digest(
@@ -23,7 +23,8 @@ class NetworkedNode:
             genesis_state.genesis_validators_root)
         self.net = P2PNetwork(NetworkConfig(host=host, port=port), digest)
         self.gossip = TcpGossipNetwork(self.net)
-        self.node = BeaconNode(spec, genesis_state, self.gossip, name=name)
+        self.node = BeaconNode(spec, genesis_state, self.gossip,
+                               name=name, store=store)
         self.rpc = BeaconRpc(self.net, self.node)
         self.sync = SyncService(self.net, self.rpc, self.node)
 

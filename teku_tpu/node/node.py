@@ -44,15 +44,18 @@ def compute_subnet_for_attestation(cfg, committees_per_slot: int,
 class BeaconNode(Service):
     def __init__(self, spec: Spec, genesis_state, gossip: GossipNetwork,
                  name: str = "node", num_sig_workers: int = 2,
-                 max_batch_size: int = 250):
+                 max_batch_size: int = 250,
+                 store: Optional[Store] = None):
         super().__init__(name)
         self.spec = spec
         S = spec.schemas
-        anchor = S.BeaconBlock(
-            slot=genesis_state.slot, parent_root=bytes(32),
-            state_root=genesis_state.htr(), body=S.BeaconBlockBody())
         self.channels = EventChannels()
-        self.store = Store(spec.config, genesis_state, anchor)
+        if store is None:
+            anchor = S.BeaconBlock(
+                slot=genesis_state.slot, parent_root=bytes(32),
+                state_root=genesis_state.htr(), body=S.BeaconBlockBody())
+            store = Store(spec.config, genesis_state, anchor)
+        self.store = store
         self.chain = RecentChainData(spec, self.store, self.channels)
         self.sig_service = AggregatingSignatureVerificationService(
             num_workers=num_sig_workers, max_batch_size=max_batch_size,

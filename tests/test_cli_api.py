@@ -1,0 +1,133 @@
+"""CLI subcommands + REST API served over real HTTP."""
+
+import asyncio
+import json
+import urllib.request
+
+import pytest
+
+from teku_tpu.cli import main
+from teku_tpu.spec import create_spec
+
+
+def test_genesis_and_transition_roundtrip(tmp_path):
+    gen = tmp_path / "genesis.ssz"
+    assert main(["genesis", "--validators", "16", "--out", str(gen)]) == 0
+    spec = create_spec("minimal")
+    state = spec.schemas.BeaconState.deserialize(gen.read_bytes())
+    assert len(state.validators) == 16
+
+    # build one block offline and run the transition subcommand over it
+    from teku_tpu.spec.builder import make_local_signer, produce_block
+    from teku_tpu.spec.genesis import interop_genesis
+    st, sks = interop_genesis(spec.config, 16)
+    signed, post = produce_block(
+        spec.config, st, 1, make_local_signer(dict(enumerate(sks))))
+    blk = tmp_path / "block1.ssz"
+    blk.write_bytes(spec.schemas.SignedBeaconBlock.serialize(signed))
+    out = tmp_path / "post.ssz"
+    assert main(["transition", "--pre", str(gen), "--post", str(out),
+                 str(blk)]) == 0
+    result = spec.schemas.BeaconState.deserialize(out.read_bytes())
+    assert result.htr() == post.htr()
+
+
+def test_transition_rejects_bad_block(tmp_path, capsys):
+    gen = tmp_path / "g.ssz"
+    main(["genesis", "--validators", "16", "--out", str(gen)])
+    spec = create_spec("minimal")
+    from teku_tpu.spec.builder import make_local_signer, produce_block
+    from teku_tpu.spec.genesis import interop_genesis
+    st, sks = interop_genesis(spec.config, 16)
+    signed, _ = produce_block(
+        spec.config, st, 1, make_local_signer(dict(enumerate(sks))))
+    bad = signed.copy_with(signature=b"\x11" + signed.signature[1:])
+    blk = tmp_path / "bad.ssz"
+    blk.write_bytes(spec.schemas.SignedBeaconBlock.serialize(bad))
+    assert main(["transition", "--pre", str(gen),
+                 "--post", str(tmp_path / "p.ssz"), str(blk)]) == 1
+
+
+def test_slashing_protection_interchange(tmp_path):
+    d1, d2 = tmp_path / "a", tmp_path / "b"
+    from teku_tpu.validator.slashing_protection import SlashingProtector
+    p = SlashingProtector(d1)
+    p.may_sign_block(b"\xaa" * 48, 5)
+    f = tmp_path / "interchange.json"
+    assert main(["slashing-protection", "export", "--data-dir", str(d1),
+                 "--file", str(f)]) == 0
+    assert main(["slashing-protection", "import", "--data-dir", str(d2),
+                 "--file", str(f)]) == 0
+    p2 = SlashingProtector(d2)
+    assert not p2.may_sign_block(b"\xaa" * 48, 5)
+
+
+@pytest.mark.slow
+def test_devnet_subcommand_finalizes():
+    assert main(["devnet", "--nodes", "2", "--validators", "16",
+                 "--epochs", "4"]) == 0
+
+
+@pytest.mark.slow
+def test_rest_api_over_http():
+    from teku_tpu.api import BeaconRestApi
+    from teku_tpu.node import Devnet
+    from teku_tpu.validator import BeaconNodeValidatorApi
+
+    async def run():
+        net = Devnet(n_nodes=1, n_validators=16)
+        await net.start()
+        api = BeaconRestApi(
+            net.nodes[0],
+            validator_api=BeaconNodeValidatorApi(net.nodes[0]))
+        await api.start()
+        try:
+            await net.run_until_slot(net.spec.config.SLOTS_PER_EPOCH + 2)
+
+            def fetch(path):
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{api.port}{path}",
+                        timeout=5) as r:
+                    body = r.read()
+                    if r.headers.get_content_type() == "application/json":
+                        return json.loads(body)
+                    return body
+
+            # run blocking urllib in a thread so the server can serve
+            loop = asyncio.get_running_loop()
+            health = await loop.run_in_executor(
+                None, fetch, "/eth/v1/node/health")
+            assert health == {}
+            genesis = await loop.run_in_executor(
+                None, fetch, "/eth/v1/beacon/genesis")
+            assert genesis["data"]["genesis_validators_root"].startswith(
+                "0x")
+            syncing = await loop.run_in_executor(
+                None, fetch, "/eth/v1/node/syncing")
+            assert syncing["data"]["is_syncing"] is False
+            header = await loop.run_in_executor(
+                None, fetch, "/eth/v1/beacon/headers/head")
+            assert int(header["data"]["header"]["message"]["slot"]) >= 1
+            fin = await loop.run_in_executor(
+                None, fetch,
+                "/eth/v1/beacon/states/head/finality_checkpoints")
+            assert "finalized" in fin["data"]
+            duties = await loop.run_in_executor(
+                None, fetch, "/eth/v1/validator/duties/proposer/1")
+            assert len(duties["data"]) == net.spec.config.SLOTS_PER_EPOCH
+            metrics = await loop.run_in_executor(None, fetch, "/metrics")
+            assert b"signature_verifications" in metrics
+            vals = await loop.run_in_executor(
+                None, fetch, "/eth/v1/beacon/states/head/validators")
+            assert len(vals["data"]) == 16
+            # 404 mapping
+            try:
+                await loop.run_in_executor(
+                    None, fetch, "/eth/v1/beacon/headers/0x" + "ab" * 32)
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as exc:
+                assert exc.code == 404
+        finally:
+            await api.stop()
+            await net.stop()
+    asyncio.run(run())
