@@ -52,6 +52,7 @@ class BeaconRestApi(RestApi):
         g("/eth/v1/validator/duties/proposer/{epoch}", self._proposer_duties)
         p("/eth/v1/validator/duties/attester/{epoch}", self._attester_duties)
         p("/eth/v1/beacon/pool/attestations", self._submit_attestations)
+        p("/eth/v1/beacon/pool/voluntary_exits", self._submit_exit)
         g("/metrics", self._metrics)
 
     # -- resolution helpers -------------------------------------------
@@ -282,6 +283,28 @@ class BeaconRestApi(RestApi):
                 self.node.attestation_manager.add_attestation(att)
                 accepted += 1
         return {"data": {"accepted": accepted}}
+
+    async def _submit_exit(self, body=None):
+        from ..spec.datastructures import (SignedVoluntaryExit,
+                                           VoluntaryExit)
+        try:
+            msg = body["message"]
+            exit_op = SignedVoluntaryExit(
+                message=VoluntaryExit(
+                    epoch=int(msg["epoch"]),
+                    validator_index=int(msg["validator_index"])),
+                signature=bytes.fromhex(
+                    body["signature"].removeprefix("0x")))
+        except (KeyError, ValueError, TypeError, AttributeError) as exc:
+            raise HttpError(400, f"malformed exit: {exc}")
+        pool = self.node.operation_pools["voluntary_exits"]
+        if not pool.add(self.node.chain.head_state(), exit_op):
+            raise HttpError(400, "exit invalid or duplicate")
+        from ..node.gossip import VOLUNTARY_EXIT_TOPIC
+        from ..spec.datastructures import SignedVoluntaryExit as SVE
+        await self.node.gossip.publish(
+            VOLUNTARY_EXIT_TOPIC, SVE.serialize(exit_op))
+        return {}
 
     # -- metrics -------------------------------------------------------
     async def _metrics(self):

@@ -1,0 +1,137 @@
+"""Execution-layer seam: engine-API channel + stub.
+
+Equivalent of the reference's execution layer (reference: ethereum/
+executionlayer/src/main/java/tech/pegasys/teku/ethereum/executionlayer/
+ExecutionLayerManagerImpl.java over the web3j engine JSON-RPC client,
+and ExecutionLayerManagerStub for test/pre-merge operation): the node
+is written against ExecutionLayerChannel; phase0/altair never call it,
+bellatrix+ block processing will drive new_payload/forkchoice_updated
+through it.  The stub accepts everything (the reference stub's
+pre-merge behavior); the JSON-RPC client speaks engine API over a raw
+asyncio HTTP connection with JWT auth when an endpoint is configured.
+"""
+
+import asyncio
+import base64
+import hashlib
+import hmac
+import json
+import logging
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+_LOG = logging.getLogger(__name__)
+
+
+@dataclass
+class PayloadStatus:
+    status: str                      # VALID | INVALID | SYNCING
+    latest_valid_hash: Optional[bytes] = None
+    validation_error: Optional[str] = None
+
+
+class ExecutionLayerChannel:
+    """The seam bellatrix+ block processing calls through."""
+
+    async def new_payload(self, payload) -> PayloadStatus:
+        raise NotImplementedError
+
+    async def forkchoice_updated(self, head_hash: bytes,
+                                 safe_hash: bytes,
+                                 finalized_hash: bytes,
+                                 payload_attributes=None) -> PayloadStatus:
+        raise NotImplementedError
+
+    async def get_payload(self, payload_id: bytes):
+        raise NotImplementedError
+
+
+class ExecutionLayerStub(ExecutionLayerChannel):
+    """Accept-everything stub (reference ExecutionLayerManagerStub):
+    correct for phase0/altair and for pre-merge test chains."""
+
+    def __init__(self):
+        self.new_payload_calls = 0
+        self.forkchoice_calls = 0
+
+    async def new_payload(self, payload) -> PayloadStatus:
+        self.new_payload_calls += 1
+        return PayloadStatus(status="VALID")
+
+    async def forkchoice_updated(self, head_hash, safe_hash,
+                                 finalized_hash,
+                                 payload_attributes=None) -> PayloadStatus:
+        self.forkchoice_calls += 1
+        return PayloadStatus(status="VALID")
+
+    async def get_payload(self, payload_id):
+        raise NotImplementedError("stub cannot build payloads")
+
+
+def _jwt_token(secret: bytes) -> str:
+    """Engine-API JWT (HS256, iat claim) — reference executionclient/
+    auth/."""
+    def b64(data: bytes) -> str:
+        return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+    header = b64(json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+    payload = b64(json.dumps({"iat": int(time.time())}).encode())
+    signing = f"{header}.{payload}".encode()
+    sig = b64(hmac.new(secret, signing, hashlib.sha256).digest())
+    return f"{header}.{payload}.{sig}"
+
+
+class EngineJsonRpcClient(ExecutionLayerChannel):
+    """Minimal engine JSON-RPC client over raw asyncio HTTP (the
+    reference uses web3j; same wire protocol)."""
+
+    def __init__(self, host: str, port: int, jwt_secret: bytes):
+        self.host = host
+        self.port = port
+        self.jwt_secret = jwt_secret
+        self._id = 0
+
+    async def _call(self, method: str, params) -> Dict[str, Any]:
+        self._id += 1
+        body = json.dumps({"jsonrpc": "2.0", "id": self._id,
+                           "method": method, "params": params}).encode()
+        token = _jwt_token(self.jwt_secret)
+        req = (f"POST / HTTP/1.1\r\nHost: {self.host}\r\n"
+               f"Authorization: Bearer {token}\r\n"
+               f"Content-Type: application/json\r\n"
+               f"Content-Length: {len(body)}\r\nConnection: close\r\n"
+               f"\r\n").encode() + body
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        try:
+            writer.write(req)
+            await writer.drain()
+            raw = await reader.read()
+        finally:
+            writer.close()
+        head, _, payload = raw.partition(b"\r\n\r\n")
+        out = json.loads(payload)
+        if "error" in out:
+            raise RuntimeError(f"engine error: {out['error']}")
+        return out["result"]
+
+    async def new_payload(self, payload) -> PayloadStatus:
+        result = await self._call("engine_newPayloadV1", [payload])
+        return PayloadStatus(
+            status=result.get("status", "INVALID"),
+            validation_error=result.get("validationError"))
+
+    async def forkchoice_updated(self, head_hash, safe_hash,
+                                 finalized_hash,
+                                 payload_attributes=None) -> PayloadStatus:
+        state = {"headBlockHash": "0x" + head_hash.hex(),
+                 "safeBlockHash": "0x" + safe_hash.hex(),
+                 "finalizedBlockHash": "0x" + finalized_hash.hex()}
+        result = await self._call("engine_forkchoiceUpdatedV1",
+                                  [state, payload_attributes])
+        return PayloadStatus(
+            status=result.get("payloadStatus", {}).get("status",
+                                                       "INVALID"))
+
+    async def get_payload(self, payload_id):
+        return await self._call("engine_getPayloadV1",
+                                ["0x" + payload_id.hex()])

@@ -1,0 +1,48 @@
+"""Step-timer performance trackers for hot paths.
+
+Equivalent of the reference's per-operation performance objects
+(reference: ethereum/statetransition/.../block/
+BlockImportPerformance.java and ethereum/performance-trackers/
+BlockProductionPerformanceImpl.java — lazy flows of named timestamps,
+logged only when over threshold): cheap monotonic checkpoints threaded
+through an operation, one log line when the total breaches the budget.
+"""
+
+import logging
+import time
+from typing import List, Optional, Tuple
+
+_LOG = logging.getLogger("teku_tpu.perf")
+
+
+class StepTimer:
+    """`timer.mark("name")` after each stage; `complete()` logs a
+    breakdown if the total exceeded `threshold_ms`."""
+
+    def __init__(self, what: str, threshold_ms: float = 500.0,
+                 enabled: bool = True):
+        self.what = what
+        self.threshold_ms = threshold_ms
+        self.enabled = enabled
+        self._t0 = time.perf_counter() if enabled else 0.0
+        self._marks: List[Tuple[str, float]] = []
+
+    def mark(self, name: str) -> None:
+        if self.enabled:
+            self._marks.append((name, time.perf_counter()))
+
+    def complete(self) -> Optional[float]:
+        """Returns total ms (None when disabled)."""
+        if not self.enabled:
+            return None
+        end = time.perf_counter()
+        total_ms = (end - self._t0) * 1e3
+        if total_ms >= self.threshold_ms:
+            prev = self._t0
+            parts = []
+            for name, t in self._marks:
+                parts.append(f"{name}={((t - prev) * 1e3):.0f}ms")
+                prev = t
+            _LOG.warning("%s slow: total=%.0fms %s", self.what, total_ms,
+                         " ".join(parts))
+        return total_ms

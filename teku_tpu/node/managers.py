@@ -105,16 +105,24 @@ class BlockManager:
             self._enqueue(self._pending_by_parent[block.parent_root],
                           signed_block)
             return False
+        # step-timed like the reference's BlockImportPerformance
+        # (invoked at ForkChoice.java:221,455,462)
+        from ..infra.perf import StepTimer
+        timer = StepTimer(f"block import slot {block.slot}",
+                          threshold_ms=2000.0)
         try:
             post = self.chain.store.on_block(signed_block)
+            timer.mark("transition+fork_choice")
         except ForkChoiceError as exc:
             _LOG.warning("block %s rejected: %s", root.hex()[:8], exc)
             return False
         self.chain.update_head()
+        timer.mark("update_head")
         self._channels.publisher(BlockImportChannel).on_block_imported(
             signed_block, post)
         for cb in self.on_imported:
             cb(root)
+        timer.complete()   # before recursing: children time themselves
         # unblock children waiting on us
         for child in self._pending_by_parent.pop(root, ()):
             self._n_pending -= 1

@@ -22,9 +22,11 @@ from ..spec import helpers as H
 from ..spec.verifiers import ServiceAsyncSignatureVerifier
 from ..storage.store import Store
 from .chaindata import RecentChainData
-from .gossip import (AGGREGATE_TOPIC, attestation_subnet_topic,
-                     BEACON_BLOCK_TOPIC, GossipNetwork, SszTopicHandler,
-                     ValidationResult)
+from .gossip import (AGGREGATE_TOPIC, ATTESTER_SLASHING_TOPIC,
+                     attestation_subnet_topic, BEACON_BLOCK_TOPIC,
+                     GossipNetwork, PROPOSER_SLASHING_TOPIC,
+                     SszTopicHandler, ValidationResult,
+                     VOLUNTARY_EXIT_TOPIC)
 from .managers import AttestationManager, BlockManager
 from .pool import AggregatingAttestationPool
 from .validators import (AggregateValidator, AttestationValidator,
@@ -62,11 +64,14 @@ class BeaconNode(Service):
             name=f"{name}_signature_verifications")
         self.verifier = ServiceAsyncSignatureVerifier(self.sig_service)
         self.pool = AggregatingAttestationPool(spec)
+        from .oppool import make_operation_pools
+        self.operation_pools = make_operation_pools(spec.config)
         self.attestation_manager = AttestationManager(
             spec, self.chain, pool=self.pool)
         self.block_manager = BlockManager(spec, self.chain, self.channels)
         self.block_manager.on_imported.append(
             self.attestation_manager.on_block_imported)
+        self.block_manager.on_imported.append(self._prune_included_ops)
         self.attestation_validator = AttestationValidator(
             spec, self.chain, self.verifier)
         self.aggregate_validator = AggregateValidator(
@@ -94,6 +99,15 @@ class BeaconNode(Service):
         self._advanced_cache = ((head_root, slot), state)
         return state
 
+    def _prune_included_ops(self, root: bytes) -> None:
+        body = self.store.blocks[root].body
+        self.operation_pools["proposer_slashings"].on_included(
+            body.proposer_slashings)
+        self.operation_pools["attester_slashings"].on_included(
+            body.attester_slashings)
+        self.operation_pools["voluntary_exits"].on_included(
+            body.voluntary_exits)
+
     # ------------------------------------------------------------------
     def _subscribe_topics(self) -> None:
         S = self.spec.schemas
@@ -108,6 +122,26 @@ class BeaconNode(Service):
                 attestation_subnet_topic(subnet), SszTopicHandler(
                     S.Attestation, self._process_gossip_attestation,
                     f"attestation_{subnet}"))
+        # operation gossip feeds the pools (reference: the per-type
+        # validators in statetransition/validation/*Validator.java —
+        # here the pool's apply-rule IS the validation)
+        for topic, schema, pool_name in (
+                (VOLUNTARY_EXIT_TOPIC, S.SignedVoluntaryExit,
+                 "voluntary_exits"),
+                (PROPOSER_SLASHING_TOPIC, S.ProposerSlashing,
+                 "proposer_slashings"),
+                (ATTESTER_SLASHING_TOPIC, S.AttesterSlashing,
+                 "attester_slashings")):
+            self.gossip.subscribe(topic, SszTopicHandler(
+                schema, self._make_op_processor(pool_name), topic))
+
+    def _make_op_processor(self, pool_name: str):
+        async def process(op) -> ValidationResult:
+            pool = self.operation_pools[pool_name]
+            if pool.add(self.chain.head_state(), op):
+                return ValidationResult.ACCEPT
+            return ValidationResult.IGNORE   # duplicate or invalid here
+        return process
 
     async def _process_gossip_block(self, signed_block) -> ValidationResult:
         result = await self.block_validator.validate(signed_block)

@@ -1,0 +1,103 @@
+"""Operation pools: proposer/attester slashings + voluntary exits.
+
+Equivalent of the reference's OperationPool family (reference:
+ethereum/statetransition/src/main/java/tech/pegasys/teku/
+statetransition/OperationPool.java, SimpleOperationPool,
+MappedOperationPool): gossip/API-submitted operations are validated on
+entry, deduplicated, selected for blocks by APPLYING them sequentially
+(so mutually conflicting ops can't poison a proposal), and pruned when
+included or invalidated on-chain.
+"""
+
+import logging
+from typing import Callable, Dict, List, Optional
+
+from ..spec.verifiers import SIMPLE
+
+_LOG = logging.getLogger(__name__)
+
+
+class OperationPool:
+    """`apply_fn(state, op) -> new_state` both validates (by raising)
+    and advances the selection state."""
+
+    def __init__(self, name: str, key_fn: Callable, apply_fn: Callable,
+                 max_size: int = 256):
+        self.name = name
+        self._key = key_fn
+        self._apply = apply_fn
+        self._ops: Dict = {}
+        self._max = max_size
+
+    def _valid(self, state, op) -> bool:
+        try:
+            self._apply(state, op)
+            return True
+        except Exception:
+            return False
+
+    def add(self, state, op) -> bool:
+        key = self._key(op)
+        if key in self._ops:
+            return False
+        # validate BEFORE the capacity check so junk can never occupy
+        # a slot a valid op then gets refused for
+        if not self._valid(state, op):
+            return False
+        if len(self._ops) >= self._max:
+            return False
+        self._ops[key] = op
+        return True
+
+    def get_for_block(self, limit: int, state=None) -> List:
+        """Select ops by applying each to a RUNNING state: op #2 is
+        checked against the world where op #1 already executed, so the
+        selection can never make the proposal itself invalid.  Entries
+        that fail against the canonical state are evicted (self-healing
+        against on-chain invalidation under a different key)."""
+        out = []
+        if state is None:
+            return list(self._ops.values())[:limit]
+        dead = []
+        for key, op in self._ops.items():
+            if len(out) >= limit:
+                break
+            try:
+                state = self._apply(state, op)
+                out.append(op)
+            except Exception:
+                dead.append(key)
+        for key in dead:
+            del self._ops[key]
+        return out
+
+    def on_included(self, ops) -> None:
+        for op in ops:
+            self._ops.pop(self._key(op), None)
+
+    def __len__(self) -> int:
+        return len(self._ops)
+
+
+def make_operation_pools(cfg):
+    """The three phase0 pools with the spec process_* functions as
+    their apply/validate rules."""
+    from ..spec import block as B
+
+    def _apply(fn):
+        return lambda state, op: fn(cfg, state, op, SIMPLE)
+
+    return {
+        "proposer_slashings": OperationPool(
+            "proposer_slashings",
+            key_fn=lambda op: op.signed_header_1.message.proposer_index,
+            apply_fn=_apply(B.process_proposer_slashing)),
+        "attester_slashings": OperationPool(
+            "attester_slashings",
+            key_fn=lambda op: op.htr(),
+            apply_fn=_apply(B.process_attester_slashing)),
+        "voluntary_exits": OperationPool(
+            "voluntary_exits",
+            key_fn=lambda op: op.message.validator_index,
+            apply_fn=_apply(B.process_voluntary_exit)),
+    }

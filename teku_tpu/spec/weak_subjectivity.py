@@ -1,0 +1,61 @@
+"""Weak subjectivity: safe-epoch window + anchor validation.
+
+Equivalent of the reference's weak-subjectivity module (reference:
+ethereum/weaksubjectivity/src/main/java/tech/pegasys/teku/
+weaksubjectivity/WeakSubjectivityCalculator.java and
+WeakSubjectivityValidator.java, checked at startup from
+BeaconChainController.java:495-502): the period formula from the
+public consensus specs, and a validator that refuses to start from a
+checkpoint older than the window.
+"""
+
+import logging
+
+from . import helpers as H
+from .config import SpecConfig
+
+_LOG = logging.getLogger(__name__)
+
+
+def compute_weak_subjectivity_period(cfg: SpecConfig, state) -> int:
+    """Spec compute_weak_subjectivity_period (safety decay 10%)."""
+    ws_period = cfg.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    N = len(H.get_active_validator_indices(
+        state, H.get_current_epoch(cfg, state)))
+    t = (H.get_total_active_balance(cfg, state) // N
+         // 10 ** 9) if N else 0          # avg balance in ETH
+    T = cfg.MAX_EFFECTIVE_BALANCE // 10 ** 9
+    delta = H.get_validator_churn_limit(cfg, state)
+    Delta = cfg.MAX_DEPOSITS * cfg.SLOTS_PER_EPOCH
+    D = 10  # SAFETY_DECAY percent
+    if T * (200 + 3 * D) < t * (200 + 12 * D):
+        epochs_for_validator_set_churn = (
+            N * (t * (200 + 12 * D) - T * (200 + 3 * D))
+            // (600 * delta * (2 * t + T)))
+        epochs_for_balance_top_ups = (
+            N * (200 + 3 * D) // (600 * Delta))
+        ws_period += max(epochs_for_validator_set_churn,
+                         epochs_for_balance_top_ups)
+    else:
+        ws_period += (3 * N * D * t
+                      // (200 * Delta * (T - t))) if T > t else ws_period
+    return ws_period
+
+
+class WeakSubjectivityValidator:
+    def __init__(self, cfg: SpecConfig):
+        self.cfg = cfg
+
+    def is_within_period(self, ws_state, current_epoch: int) -> bool:
+        """May the node still trust this weak-subjectivity anchor?"""
+        ws_epoch = H.get_current_epoch(self.cfg, ws_state)
+        period = compute_weak_subjectivity_period(self.cfg, ws_state)
+        return current_epoch <= ws_epoch + period
+
+    def validate_anchor(self, anchor_state, current_epoch: int) -> None:
+        if not self.is_within_period(anchor_state, current_epoch):
+            raise ValueError(
+                "weak subjectivity anchor is outside the safe period — "
+                "obtain a recent finalized checkpoint")
+        _LOG.info("weak subjectivity check passed (period=%d epochs)",
+                  compute_weak_subjectivity_period(self.cfg, anchor_state))

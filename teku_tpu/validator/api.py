@@ -134,9 +134,16 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
         pre = self.node.advanced_head_state(slot)
         atts = self.node.pool.get_attestations_for_block(
             pre, cfg.MAX_ATTESTATIONS)
-        block, _post = build_unsigned_block(cfg, pre, slot, randao_reveal,
-                                            attestations=atts,
-                                            graffiti=graffiti)
+        pools = self.node.operation_pools
+        block, _post = build_unsigned_block(
+            cfg, pre, slot, randao_reveal, attestations=atts,
+            proposer_slashings=pools["proposer_slashings"].get_for_block(
+                cfg.MAX_PROPOSER_SLASHINGS, pre),
+            attester_slashings=pools["attester_slashings"].get_for_block(
+                cfg.MAX_ATTESTER_SLASHINGS, pre),
+            voluntary_exits=pools["voluntary_exits"].get_for_block(
+                cfg.MAX_VOLUNTARY_EXITS, pre),
+            graffiti=graffiti)
         return block, pre
 
     # -- submission ----------------------------------------------------

@@ -1,0 +1,106 @@
+"""Key-manager REST API: list/import/delete validator keystores.
+
+Equivalent of the reference's EIP-3076-aware key-manager API on the
+validator client (reference: validator/client/restapi/ — the standard
+keymanager endpoints on :5052): keystores live in a directory, imports
+decrypt + register with the running client, deletes export the
+validator's slashing-protection record alongside.
+"""
+
+import json
+import logging
+from pathlib import Path
+from typing import Dict, Optional
+
+from ..crypto import bls
+from ..infra.restapi import HttpError, RestApi
+from .keystore import decrypt, KeystoreError
+
+_LOG = logging.getLogger(__name__)
+
+
+class KeyManagerApi(RestApi):
+    def __init__(self, keys_dir, protector=None, on_key_added=None,
+                 on_key_removed=None, host: str = "127.0.0.1",
+                 port: int = 0):
+        super().__init__(host, port)
+        self.keys_dir = Path(keys_dir)
+        self.keys_dir.mkdir(parents=True, exist_ok=True)
+        self.protector = protector
+        self.on_key_added = on_key_added
+        self.on_key_removed = on_key_removed
+        # pubkey hex (no 0x) -> secret int, for keys loaded this session
+        self.active: Dict[str, int] = {}
+        self.get("/eth/v1/keystores", self._list)
+        self.post("/eth/v1/keystores", self._import)
+        self.route("DELETE", "/eth/v1/keystores", self._delete)
+
+    async def _list(self):
+        out = []
+        for f in sorted(self.keys_dir.glob("*.json")):
+            try:
+                ks = json.loads(f.read_text())
+            except json.JSONDecodeError:
+                continue
+            out.append({"validating_pubkey": "0x" + ks.get("pubkey", ""),
+                        "derivation_path": ks.get("path", ""),
+                        "readonly": False})
+        return {"data": out}
+
+    async def _import(self, body=None):
+        if not isinstance(body, dict):
+            raise HttpError(400, "expected an import request object")
+        keystores = body.get("keystores", [])
+        passwords = body.get("passwords", [])
+        if len(keystores) != len(passwords):
+            raise HttpError(400, "keystores/passwords length mismatch")
+        statuses = []
+        for ks_json, password in zip(keystores, passwords):
+            try:
+                ks = (json.loads(ks_json) if isinstance(ks_json, str)
+                      else ks_json)
+                secret = decrypt(ks, password)
+                secret_int = int.from_bytes(secret, "big")
+                pubkey = ks.get("pubkey") or bls.secret_to_public_key(
+                    secret_int).hex()
+                (self.keys_dir / f"{pubkey[:16]}.json").write_text(
+                    json.dumps(ks))
+                self.active[pubkey] = secret_int
+                if self.on_key_added:
+                    self.on_key_added(bytes.fromhex(pubkey), secret_int)
+                statuses.append({"status": "imported", "message": ""})
+            except (KeystoreError, ValueError, KeyError) as exc:
+                statuses.append({"status": "error", "message": str(exc)})
+        return {"data": statuses}
+
+    async def _delete(self, body=None):
+        if not isinstance(body, dict):
+            raise HttpError(400, "expected a delete request object")
+        statuses = []
+        interchange = {"metadata": {
+            "interchange_format_version": "5",
+            "genesis_validators_root": "0x" + "00" * 32}, "data": []}
+        for pk_hex in body.get("pubkeys", []):
+            pk_hex = pk_hex.removeprefix("0x")
+            found = False
+            for f in self.keys_dir.glob("*.json"):
+                try:
+                    ks = json.loads(f.read_text())
+                except json.JSONDecodeError:
+                    continue
+                if ks.get("pubkey") == pk_hex:
+                    f.unlink()
+                    found = True
+                    break
+            self.active.pop(pk_hex, None)
+            if self.on_key_removed and found:
+                self.on_key_removed(bytes.fromhex(pk_hex))
+            if found and self.protector is not None:
+                doc = self.protector.export_interchange(b"\x00" * 32)
+                interchange["data"] = [
+                    e for e in doc["data"]
+                    if e["pubkey"] == "0x" + pk_hex]
+            statuses.append({"status": "deleted" if found
+                             else "not_found", "message": ""})
+        return {"data": statuses,
+                "slashing_protection": json.dumps(interchange)}

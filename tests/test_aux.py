@@ -1,0 +1,128 @@
+"""Aux subsystems: operation pools, weak subjectivity, step timers."""
+
+import dataclasses
+import logging
+
+import pytest
+
+from teku_tpu.infra.perf import StepTimer
+from teku_tpu.node.oppool import make_operation_pools
+from teku_tpu.spec import config as C
+from teku_tpu.spec import helpers as H
+from teku_tpu.spec.config import DOMAIN_VOLUNTARY_EXIT
+from teku_tpu.spec.datastructures import (SignedVoluntaryExit,
+                                          VoluntaryExit)
+from teku_tpu.spec.genesis import interop_genesis
+from teku_tpu.spec.transition import process_slots
+from teku_tpu.spec.weak_subjectivity import (
+    compute_weak_subjectivity_period, WeakSubjectivityValidator)
+from teku_tpu.crypto import bls
+
+# exits allowed immediately for pool tests
+CFG = dataclasses.replace(C.MINIMAL, SHARD_COMMITTEE_PERIOD=0)
+
+
+def _signed_exit(state, sks, index, epoch=0):
+    msg = VoluntaryExit(epoch=epoch, validator_index=index)
+    domain = H.get_domain(CFG, state, DOMAIN_VOLUNTARY_EXIT, epoch)
+    root = H.compute_signing_root(msg, domain)
+    return SignedVoluntaryExit(message=msg,
+                               signature=bls.sign(sks[index], root))
+
+
+def test_voluntary_exit_pool_validates_dedupes_and_includes():
+    state, sks = interop_genesis(CFG, 16)
+    state = process_slots(CFG, state, 1)
+    pools = make_operation_pools(CFG)
+    pool = pools["voluntary_exits"]
+
+    good = _signed_exit(state, sks, 3)
+    assert pool.add(state, good)
+    assert not pool.add(state, good)                 # dedupe
+    # bad signature rejected on entry
+    bad = _signed_exit(state, sks, 4).copy_with(signature=b"\x09" * 96)
+    assert not pool.add(state, bad)
+    # unknown validator rejected
+    assert not pool.add(state, _signed_exit(
+        state, dict(enumerate(sks)) | {99: sks[0]}, 99))
+    assert len(pool) == 1
+    assert pool.get_for_block(16, state) == [good]
+    # once included, pruned
+    pool.on_included([good])
+    assert len(pool) == 0
+
+
+def test_exit_flows_into_produced_block():
+    """Pool → block production → state transition end to end."""
+    from teku_tpu.spec.builder import make_local_signer, produce_block
+    from teku_tpu.spec.transition import state_transition
+    state, sks = interop_genesis(CFG, 16)
+    signer = make_local_signer(dict(enumerate(sks)))
+    pools = make_operation_pools(CFG)
+    pre = process_slots(CFG, state, 1)
+    exit_op = _signed_exit(pre, sks, 5)
+    assert pools["voluntary_exits"].add(pre, exit_op)
+    signed, post = produce_block(
+        CFG, state, 1, signer,
+        voluntary_exits=pools["voluntary_exits"].get_for_block(16, pre))
+    verified = state_transition(CFG, state, signed)
+    assert verified.validators[5].exit_epoch != C.FAR_FUTURE_EPOCH
+
+
+@pytest.mark.slow
+def test_exit_gossips_between_nodes_and_lands_in_block():
+    """Exit enters node A via the pool API, gossips to node B, and is
+    included by whichever proposer builds next."""
+    import asyncio
+    from teku_tpu.node import Devnet
+    from teku_tpu.node.gossip import VOLUNTARY_EXIT_TOPIC
+    from teku_tpu.spec import Spec
+
+    async def run():
+        net = Devnet(n_nodes=2, n_validators=16, spec=Spec(CFG))
+        await net.start()
+        try:
+            await net.run_until_slot(2)
+            a, b = net.nodes
+            state = a.chain.head_state()
+            sks = [s for s in
+                   __import__("teku_tpu.spec.genesis",
+                              fromlist=["interop_secret_keys"]
+                              ).interop_secret_keys(16)]
+            exit_op = _signed_exit(state, sks, 7)
+            assert a.operation_pools["voluntary_exits"].add(state, exit_op)
+            await a.gossip.publish(
+                VOLUNTARY_EXIT_TOPIC,
+                type(exit_op).serialize(exit_op))
+            assert len(b.operation_pools["voluntary_exits"]) == 1
+            await net.run_until_slot(4, first_slot=3)
+            head_state = a.chain.head_state()
+            assert head_state.validators[7].exit_epoch != C.FAR_FUTURE_EPOCH
+        finally:
+            await net.stop()
+    asyncio.run(run())
+
+
+def test_weak_subjectivity_period_and_validator():
+    state, _ = interop_genesis(C.MINIMAL, 64)
+    period = compute_weak_subjectivity_period(C.MINIMAL, state)
+    assert period >= C.MINIMAL.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    v = WeakSubjectivityValidator(C.MINIMAL)
+    assert v.is_within_period(state, period // 2)
+    assert not v.is_within_period(state, period + 1)
+    with pytest.raises(ValueError):
+        v.validate_anchor(state, period + 100)
+    v.validate_anchor(state, 1)          # fresh anchor passes
+
+
+def test_step_timer_logs_only_over_threshold(caplog):
+    t = StepTimer("fast op", threshold_ms=10_000)
+    t.mark("a")
+    assert t.complete() is not None
+    with caplog.at_level(logging.WARNING, logger="teku_tpu.perf"):
+        slow = StepTimer("slow op", threshold_ms=0.0)
+        slow.mark("stage1")
+        total = slow.complete()
+        assert total is not None
+    assert any("slow op" in r.message for r in caplog.records)
+    assert StepTimer("off", enabled=False).complete() is None

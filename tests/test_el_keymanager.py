@@ -1,0 +1,95 @@
+"""Execution-layer stub/JWT + key-manager REST API."""
+
+import asyncio
+import base64
+import hashlib
+import hmac
+import json
+import urllib.request
+
+import pytest
+
+from teku_tpu.executionlayer import (_jwt_token, ExecutionLayerStub,
+                                     PayloadStatus)
+from teku_tpu.validator.keymanager import KeyManagerApi
+from teku_tpu.validator.keystore import encrypt
+
+
+def test_execution_stub_accepts_everything():
+    async def run():
+        el = ExecutionLayerStub()
+        st = await el.new_payload({"blockHash": "0x00"})
+        assert st.status == "VALID"
+        st = await el.forkchoice_updated(b"\x01" * 32, b"\x01" * 32,
+                                         b"\x01" * 32)
+        assert st.status == "VALID"
+        assert el.new_payload_calls == 1 and el.forkchoice_calls == 1
+    asyncio.run(run())
+
+
+def test_engine_jwt_is_valid_hs256():
+    secret = b"\x42" * 32
+    token = _jwt_token(secret)
+    h, p, s = token.split(".")
+
+    def unb64(x):
+        return base64.urlsafe_b64decode(x + "=" * (-len(x) % 4))
+    assert json.loads(unb64(h))["alg"] == "HS256"
+    assert "iat" in json.loads(unb64(p))
+    expect = hmac.new(secret, f"{h}.{p}".encode(), hashlib.sha256).digest()
+    assert unb64(s) == expect
+
+
+def test_keymanager_import_list_delete(tmp_path):
+    async def run():
+        added, removed = [], []
+        api = KeyManagerApi(tmp_path / "keys",
+                            on_key_added=lambda pk, sk: added.append(pk),
+                            on_key_removed=lambda pk: removed.append(pk))
+        await api.start()
+        try:
+            base = f"http://127.0.0.1:{api.port}"
+            loop = asyncio.get_running_loop()
+            secret = bytes(range(32))
+            from teku_tpu.crypto import bls
+            pubkey = bls.secret_to_public_key(
+                int.from_bytes(secret, "big"))
+            ks = encrypt(secret, "pw", kdf="pbkdf2", pubkey=pubkey)
+
+            def req(method, path, payload=None):
+                r = urllib.request.Request(
+                    base + path, method=method,
+                    data=json.dumps(payload).encode() if payload else None,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(r, timeout=5) as resp:
+                    return json.loads(resp.read())
+
+            out = await loop.run_in_executor(None, req, "POST",
+                                             "/eth/v1/keystores",
+                                             {"keystores": [ks],
+                                              "passwords": ["pw"]})
+            assert out["data"][0]["status"] == "imported"
+            assert added and added[0] == pubkey
+
+            listed = await loop.run_in_executor(None, req, "GET",
+                                                "/eth/v1/keystores")
+            assert listed["data"][0]["validating_pubkey"] == (
+                "0x" + pubkey.hex())
+
+            out = await loop.run_in_executor(
+                None, req, "DELETE", "/eth/v1/keystores",
+                {"pubkeys": ["0x" + pubkey.hex()]})
+            assert out["data"][0]["status"] == "deleted"
+            assert removed == [pubkey]
+            listed = await loop.run_in_executor(None, req, "GET",
+                                                "/eth/v1/keystores")
+            assert listed["data"] == []
+            # wrong password import reports error, not crash
+            out = await loop.run_in_executor(None, req, "POST",
+                                             "/eth/v1/keystores",
+                                             {"keystores": [ks],
+                                              "passwords": ["wrong"]})
+            assert out["data"][0]["status"] == "error"
+        finally:
+            await api.stop()
+    asyncio.run(run())
