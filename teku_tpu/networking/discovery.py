@@ -1,0 +1,135 @@
+"""Peer discovery: peer-exchange over the transport + target-count
+maintenance.
+
+The role of the reference's discv5 stack (reference: networking/p2p/
+src/main/java/tech/pegasys/teku/networking/p2p/discovery/discv5/
+DiscV5Service.java + DiscoveryNetwork composing discovery with the
+connection manager): there UDP Kademlia walks global ENRs; here — the
+deployment target being single-host/ICI-pod meshes with zero external
+egress — peers gossip their peer tables over the existing TCP lanes
+("discovery_peers" RPC), and the service dials newly-learned addresses
+until the target peer count holds.  The seam (`lookup()` + periodic
+maintenance) matches, so a UDP walker can replace the backend without
+callers changing.
+"""
+
+import asyncio
+import logging
+import struct
+from typing import List, Optional, Set, Tuple
+
+from ..infra.aio import RepeatingTask
+from .reqresp import _pack_chunks, _unpack_chunks
+from .transport import P2PNetwork, Peer
+
+_LOG = logging.getLogger(__name__)
+
+DISCOVERY_METHOD = "discovery_peers"
+
+
+class DiscoveryService:
+    def __init__(self, net: P2PNetwork, target_peers: int = 8,
+                 interval_s: float = 30.0):
+        self.net = net
+        self.target_peers = target_peers
+        self.known: Set[Tuple[str, int]] = set()
+        self._task = RepeatingTask(interval_s, self._round, "discovery")
+        self._prev_on_request = None
+
+    # -- wiring --------------------------------------------------------
+    def install(self) -> None:
+        """Chain onto the rpc dispatcher: answer discovery requests,
+        delegate everything else to the existing handler."""
+        self._prev_on_request = self.net.on_request
+
+        async def handle(peer: Peer, method: str, body: bytes) -> bytes:
+            if method == DISCOVERY_METHOD:
+                return _pack_chunks([self._encode_peers()])
+            if self._prev_on_request is not None:
+                return await self._prev_on_request(peer, method, body)
+            return _pack_chunks([], ok=False)
+        self.net.on_request = handle
+
+    def start(self) -> None:
+        self._task.start()
+
+    async def stop(self) -> None:
+        await self._task.stop()
+
+    # -- peer table exchange ------------------------------------------
+    def _encode_peers(self) -> bytes:
+        out = []
+        for peer in self.net.peers:
+            if peer.connected and peer.listen_port:
+                host = peer.writer.get_extra_info("peername")
+                if host:
+                    addr = f"{host[0]}:{peer.listen_port}"
+                    out.append(struct.pack("<B", len(addr))
+                               + addr.encode())
+        return b"".join(out)
+
+    @staticmethod
+    def _decode_peers(blob: bytes) -> List[Tuple[str, int]]:
+        out, pos = [], 0
+        while pos < len(blob):
+            n = blob[pos]
+            pos += 1
+            addr = blob[pos:pos + n].decode(errors="replace")
+            pos += n
+            host, _, port = addr.rpartition(":")
+            try:
+                out.append((host, int(port)))
+            except ValueError:
+                continue
+        return out
+
+    async def lookup(self) -> List[Tuple[str, int]]:
+        """One peer-table sweep, all peers queried CONCURRENTLY so dead
+        peers cost one timeout, not one each."""
+        async def ask(peer):
+            try:
+                return await peer.request(DISCOVERY_METHOD, b"",
+                                          timeout=5.0)
+            except Exception:
+                return None
+        responses = await asyncio.gather(
+            *(ask(p) for p in list(self.net.peers)))
+        found = []
+        for resp in responses:
+            if resp is None:
+                continue
+            chunks = _unpack_chunks(resp)
+            if chunks:
+                found.extend(self._decode_peers(chunks[0]))
+        return found
+
+    def _connected_addrs(self) -> Set[Tuple[str, int]]:
+        out = set()
+        for peer in self.net.peers:
+            info = peer.writer.get_extra_info("peername")
+            if info and peer.listen_port:
+                out.add((info[0], peer.listen_port))
+        return out
+
+    async def _round(self) -> None:
+        if len(self.net.peers) >= self.target_peers:
+            return
+        connected = self._connected_addrs()
+        for host, port in await self.lookup():
+            if (host, port) in connected:
+                continue          # already have this peer
+            # loopback self-dial guard; cross-host same-port is legal
+            # (multi-host meshes commonly share one listen port) and the
+            # handshake's node-id check catches any remaining self-dial
+            if port == self.net.port and host in ("127.0.0.1",
+                                                  "localhost", "::1"):
+                continue
+            if len(self.net.peers) >= self.target_peers:
+                break
+            try:
+                peer = await asyncio.wait_for(
+                    self.net.connect(host, port), timeout=5.0)
+            except (OSError, asyncio.TimeoutError):
+                continue          # retried naturally next round
+            if peer is not None and peer.connected:
+                self.known.add((host, port))

@@ -77,6 +77,9 @@ class BeaconRpc:
         self.net = net
         self.node = node
         self.seq_number = 0
+        # chain, don't clobber: another protocol (e.g. discovery) may
+        # already be installed — unknown methods fall through to it
+        self._next_handler = net.on_request
         net.on_request = self._handle
 
     # -- server side ---------------------------------------------------
@@ -116,6 +119,8 @@ class BeaconRpc:
                          for i in range(0, min(len(roots_blob),
                                                32 * MAX_REQUEST_BLOCKS), 32)]
                 return _pack_chunks(self._blocks_by_root(roots))
+            if self._next_handler is not None:
+                return await self._next_handler(peer, method, body)
         except Exception:
             _LOG.exception("rpc %s failed", method)
         return _pack_chunks([], ok=False)

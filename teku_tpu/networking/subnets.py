@@ -1,0 +1,51 @@
+"""Attestation subnet management: duty-driven + persistent subscriptions.
+
+Equivalent of the reference's subnet machinery (reference: networking/
+eth2/src/main/java/tech/pegasys/teku/networking/eth2/gossip/subnets/
+AttestationTopicSubscriber.java + NodeBasedStableSubnetSubscriber): a
+validator's committee assignment implies a subnet subscription window;
+every node also holds a deterministic persistent subnet for mesh
+health.  The manager tracks {subnet: unsubscribe_slot} and tells the
+gossip layer which attestation topics to carry.
+"""
+
+import hashlib
+import logging
+from typing import Dict, Set
+
+from ..spec.config import SpecConfig
+
+_LOG = logging.getLogger(__name__)
+
+
+class AttestationSubnetManager:
+    def __init__(self, cfg: SpecConfig, node_id: bytes):
+        self.cfg = cfg
+        self.node_id = node_id
+        self._until: Dict[int, int] = {}
+
+    def persistent_subnets(self) -> Set[int]:
+        """Node-stable subnets (reference NodeBasedStableSubnetSubscriber
+        derives them from the node id).  Counter-hashed so any
+        configured count works (a windowed digest silently zero-fills
+        past 8 entries)."""
+        return {
+            int.from_bytes(
+                hashlib.sha256(self.node_id
+                               + i.to_bytes(4, "little")).digest()[:4],
+                "little") % self.cfg.ATTESTATION_SUBNET_COUNT
+            for i in range(self.cfg.RANDOM_SUBNETS_PER_VALIDATOR)}
+
+    def subscribe_for_duty(self, subnet: int, until_slot: int) -> None:
+        """reference AttestationTopicSubscriber.subscribeToCommitteeForAggregation"""
+        self._until[subnet] = max(self._until.get(subnet, 0), until_slot)
+
+    def on_slot(self, slot: int) -> Set[int]:
+        """Active subnets after expiring stale duty subscriptions."""
+        for subnet in [s for s, until in self._until.items()
+                       if until < slot]:
+            del self._until[subnet]
+        return self.active_subnets()
+
+    def active_subnets(self) -> Set[int]:
+        return set(self._until) | self.persistent_subnets()

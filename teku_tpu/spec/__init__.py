@@ -12,11 +12,21 @@ from .datastructures import get_schemas, Schemas
 
 
 class Spec:
-    """Config + schemas + transition functions in one handle."""
+    """Config + schemas + transition functions in one handle, with the
+    milestone routing seam (reference Spec.atSlot/forMilestone)."""
 
     def __init__(self, cfg: SpecConfig):
         self.config = cfg
         self.schemas = get_schemas(cfg)
+        from .milestones import build_fork_schedule
+        self.fork_schedule = build_fork_schedule(cfg)
+
+    def milestone_at_slot(self, slot: int):
+        return self.fork_schedule.milestone_at_slot(slot)
+
+    def at_slot(self, slot: int):
+        """The SpecVersion governing `slot`."""
+        return self.fork_schedule.version_at_slot(slot)
 
     # -- delegation to the functional engine --
     def state_transition(self, state, signed_block, validate_result=True):

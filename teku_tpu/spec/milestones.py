@@ -1,0 +1,114 @@
+"""Milestones + fork schedule: the per-fork routing seam.
+
+Equivalent of the reference's SpecMilestone/ForkSchedule/SpecVersion
+trio (reference: ethereum/spec/src/main/java/tech/pegasys/teku/spec/
+SpecMilestone.java, ForkSchedule.java, SpecVersion.java — Spec.java:108
+routes every operation via atSlot/atEpoch/forMilestone): each milestone
+bundles its fork version, activation epoch, schema family and logic
+functions; the schedule answers "which milestone governs this slot".
+
+Phase0 logic is complete; later milestones register here as their
+logic lands (the delegation machinery is fork-count agnostic, matching
+the reference's subclass-the-previous-fork pattern).
+"""
+
+import enum
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from .config import FAR_FUTURE_EPOCH, SpecConfig
+
+
+class SpecMilestone(enum.IntEnum):
+    PHASE0 = 0
+    ALTAIR = 1
+    BELLATRIX = 2
+    CAPELLA = 3
+    DENEB = 4
+    ELECTRA = 5
+
+    def is_at_least(self, other: "SpecMilestone") -> bool:
+        return self >= other
+
+
+@dataclass
+class SpecVersion:
+    """One milestone's bundle (reference SpecVersion.java)."""
+    milestone: SpecMilestone
+    fork_version: bytes
+    fork_epoch: int
+    schemas: object
+    # logic entry points (phase0 signatures; later forks override)
+    process_block: Callable
+    process_epoch: Callable
+    upgrade_state: Optional[Callable] = None   # previous-fork state -> ours
+
+
+class ForkSchedule:
+    """Activation epochs → governing milestone (reference
+    ForkSchedule.java getSpecMilestoneAtEpoch/Slot)."""
+
+    def __init__(self, cfg: SpecConfig, versions: List[SpecVersion]):
+        self.cfg = cfg
+        # only milestones actually scheduled (epoch != FAR_FUTURE)
+        self.versions = sorted(
+            (v for v in versions if v.fork_epoch != FAR_FUTURE_EPOCH),
+            key=lambda v: v.fork_epoch)
+        assert self.versions and self.versions[0].fork_epoch == 0, (
+            "the genesis milestone must activate at epoch 0")
+
+    def milestone_at_epoch(self, epoch: int) -> SpecMilestone:
+        governing = self.versions[0]
+        for v in self.versions:
+            if v.fork_epoch <= epoch:
+                governing = v
+        return governing.milestone
+
+    def milestone_at_slot(self, slot: int) -> SpecMilestone:
+        return self.milestone_at_epoch(slot // self.cfg.SLOTS_PER_EPOCH)
+
+    def version_for(self, milestone: SpecMilestone) -> SpecVersion:
+        for v in self.versions:
+            if v.milestone == milestone:
+                return v
+        raise KeyError(f"milestone {milestone.name} not scheduled")
+
+    def version_at_slot(self, slot: int) -> SpecVersion:
+        return self.version_for(self.milestone_at_slot(slot))
+
+    def fork_at_epoch(self, epoch: int):
+        """(previous_version, current_version, fork_epoch) triple for
+        building the state Fork at an epoch."""
+        cur = self.version_for(self.milestone_at_epoch(epoch))
+        idx = self.versions.index(cur)
+        prev = self.versions[idx - 1] if idx > 0 else cur
+        return prev.fork_version, cur.fork_version, cur.fork_epoch
+
+    def upgrades_between(self, from_epoch: int, to_epoch: int
+                         ) -> List[SpecVersion]:
+        """Fork activations in (from_epoch, to_epoch] — process_slots
+        applies each version's upgrade_state when crossing its epoch."""
+        return [v for v in self.versions
+                if from_epoch < v.fork_epoch <= to_epoch
+                and v.upgrade_state is not None]
+
+
+def phase0_version(cfg: SpecConfig) -> SpecVersion:
+    from . import block as B
+    from . import epoch as E
+    from .datastructures import get_schemas
+    from .verifiers import SIMPLE
+
+    return SpecVersion(
+        milestone=SpecMilestone.PHASE0,
+        fork_version=cfg.GENESIS_FORK_VERSION,
+        fork_epoch=0,
+        schemas=get_schemas(cfg),
+        process_block=B.process_block,
+        process_epoch=E.process_epoch)
+
+
+def build_fork_schedule(cfg: SpecConfig) -> ForkSchedule:
+    """All scheduled milestones for this config (phase0 today; altair+
+    register by adding their versions with fork epochs in the config)."""
+    return ForkSchedule(cfg, [phase0_version(cfg)])

@@ -1,0 +1,59 @@
+"""Doppelganger detection: refuse to start duties if our keys are
+already attesting elsewhere.
+
+Equivalent of the reference's doppelganger detector (reference:
+validator/client/src/main/java/tech/pegasys/teku/validator/client/
+doppelganger/DoppelgangerDetector.java + slashingriskactions/
+DoppelgangerDetectionShutDown.java): watch the chain for N epochs; any
+attestation carrying one of our validator indices means another
+instance is live with our keys — abort before we equivocate.
+"""
+
+import logging
+from typing import Callable, Iterable, Optional, Set
+
+_LOG = logging.getLogger(__name__)
+
+
+class DoppelgangerDetected(RuntimeError):
+    pass
+
+
+class DoppelgangerDetector:
+    def __init__(self, watched_indices: Iterable[int],
+                 detection_epochs: int = 2,
+                 on_detected: Optional[Callable[[int], None]] = None):
+        self.watched: Set[int] = set(watched_indices)
+        self.detection_epochs = detection_epochs
+        self.on_detected = on_detected
+        self._start_epoch: Optional[int] = None
+        self.cleared = False
+        self.detected: Set[int] = set()
+
+    def begin(self, current_epoch: int) -> None:
+        self._start_epoch = current_epoch
+        self.cleared = not self.watched or self.detection_epochs == 0
+
+    def observe_attesters(self, attesting_indices: Iterable[int]) -> None:
+        """Feed every indexed attestation seen on gossip/in blocks."""
+        if self.cleared or self._start_epoch is None:
+            return
+        hits = self.watched & set(attesting_indices)
+        for index in hits:
+            self.detected.add(index)
+            _LOG.error("DOPPELGANGER: validator %d is attesting "
+                       "elsewhere — refusing duties", index)
+            if self.on_detected:
+                self.on_detected(index)
+        if hits:
+            raise DoppelgangerDetected(
+                f"validators {sorted(self.detected)} active elsewhere")
+
+    def on_epoch(self, epoch: int) -> bool:
+        """Returns True when the watch window completed cleanly and
+        duties may start."""
+        if self._start_epoch is None or self.detected:
+            return False
+        if epoch >= self._start_epoch + self.detection_epochs:
+            self.cleared = True
+        return self.cleared

@@ -1,0 +1,85 @@
+"""Discovery peer exchange, subnet management, doppelganger detection,
+milestone routing."""
+
+import asyncio
+
+import pytest
+
+from teku_tpu.networking import NetworkedNode
+from teku_tpu.networking.discovery import DiscoveryService
+from teku_tpu.networking.subnets import AttestationSubnetManager
+from teku_tpu.spec import config as C, create_spec, Spec
+from teku_tpu.spec.genesis import interop_genesis
+from teku_tpu.spec.milestones import SpecMilestone
+from teku_tpu.validator.doppelganger import (DoppelgangerDetected,
+                                             DoppelgangerDetector)
+
+
+def test_discovery_learns_peers_transitively():
+    """A knows B, B knows C; discovery connects A to C."""
+    async def run():
+        spec = create_spec("minimal")
+        state, _ = interop_genesis(spec.config, 8)
+        a, b, c = (NetworkedNode(spec, state, name=n) for n in "abc")
+        for n in (a, b, c):
+            await n.start()
+        discos = []
+        try:
+            for n in (a, b, c):
+                d = DiscoveryService(n.net, target_peers=5)
+                d.install()
+                discos.append(d)
+            await a.connect(b)
+            await b.connect(c)
+            assert len(a.net.peers) == 1
+            await discos[0]._round()       # one discovery sweep on A
+            await asyncio.sleep(0.05)
+            ports = {p.listen_port for p in a.net.peers}
+            assert c.net.port in ports, "A did not learn C from B"
+        finally:
+            for n in (a, b, c):
+                await n.stop()
+    asyncio.run(run())
+
+
+def test_subnet_manager_windows_and_persistent():
+    mgr = AttestationSubnetManager(C.MINIMAL, b"\x05" * 32)
+    persistent = mgr.persistent_subnets()
+    assert persistent and all(
+        0 <= s < C.MINIMAL.ATTESTATION_SUBNET_COUNT for s in persistent)
+    # same node id -> same persistent subnets (deterministic)
+    assert persistent == AttestationSubnetManager(
+        C.MINIMAL, b"\x05" * 32).persistent_subnets()
+    mgr.subscribe_for_duty(subnet=7, until_slot=10)
+    assert 7 in mgr.on_slot(10)
+    assert 7 not in mgr.on_slot(11) or 7 in persistent
+
+
+def test_doppelganger_detects_and_clears():
+    hits = []
+    det = DoppelgangerDetector([3, 4], detection_epochs=2,
+                               on_detected=hits.append)
+    det.begin(current_epoch=10)
+    assert not det.on_epoch(10)
+    det.observe_attesters([1, 2])          # others are fine
+    with pytest.raises(DoppelgangerDetected):
+        det.observe_attesters([2, 3])      # our index 3 seen!
+    assert hits == [3]
+    assert not det.on_epoch(12)            # never clears after detection
+
+    ok = DoppelgangerDetector([5], detection_epochs=2)
+    ok.begin(10)
+    ok.observe_attesters([1, 2])
+    assert not ok.on_epoch(11)
+    assert ok.on_epoch(12)                 # clean window -> cleared
+
+
+def test_milestone_routing():
+    spec = Spec(C.MINIMAL)
+    assert spec.milestone_at_slot(0) is SpecMilestone.PHASE0
+    assert spec.milestone_at_slot(10 ** 6) is SpecMilestone.PHASE0
+    v = spec.at_slot(5)
+    assert v.fork_version == C.MINIMAL.GENESIS_FORK_VERSION
+    assert spec.fork_schedule.fork_at_epoch(3)[2] == 0
+    assert SpecMilestone.DENEB.is_at_least(SpecMilestone.ALTAIR)
+    assert not SpecMilestone.PHASE0.is_at_least(SpecMilestone.ALTAIR)
