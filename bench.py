@@ -101,6 +101,11 @@ def _init_device():
 
 
 def _throughput_phase(jax, deadline, batches):
+    """Batches are tried IN ORDER and each fresh compile is gated on
+    the remaining budget: TPU-XLA compiles of the full kernel run tens
+    of minutes cold (hash-to-G2 alone is ~8 min), so one measured
+    number at the primary shape beats four JSON-less timeouts.  The
+    persistent compile cache makes warm reruns cheap."""
     import __graft_entry__ as ge
     from teku_tpu.ops import verify as V
 
@@ -108,8 +113,13 @@ def _throughput_phase(jax, deadline, batches):
     detail = {}
     best = 0.0
     best_batch = None
+    compiled_once = False
     for n in batches:
-        if time.time() > deadline and detail:
+        remaining = deadline - time.time()
+        # a cold compile needs a wide margin; after one shape compiled
+        # (cache siblings share most of the work server-side) be braver
+        need = 120 if compiled_once else 600
+        if remaining < need and detail:
             detail[str(n)] = "skipped: budget"
             continue
         try:
@@ -118,6 +128,7 @@ def _throughput_phase(jax, deadline, batches):
             ok, lane_ok = kernel(*args)
             ok = bool(np.asarray(ok))
             compile_s = time.time() - t0
+            compiled_once = True
             entry = {"compile_s": round(compile_s, 1)}
             detail[str(n)] = entry
             if not (ok and np.asarray(lane_ok).all()):
@@ -134,6 +145,12 @@ def _throughput_phase(jax, deadline, batches):
             entry["dispatch_ms"] = round(dt * 1e3, 2)
             if rate > best:
                 best, best_batch = rate, n
+            # keep the headline current so even a SIGTERM mid-phase
+            # reports the best number measured so far
+            OUT["detail"] = detail
+            OUT["best_batch"] = best_batch
+            OUT["value"] = round(best, 1)
+            OUT["vs_baseline"] = round(best / 50_000, 4)
         except Exception as exc:
             detail[str(n)] = {"error": f"{type(exc).__name__}: {exc}"}
     OUT["detail"] = detail
@@ -154,24 +171,23 @@ def _latency_phase(jax, deadline):
     from teku_tpu.services.signatures import (
         AggregatingSignatureVerificationService)
 
-    impl = JaxBls12381(max_batch=256)
+    # min_bucket=256 pins EVERY service dispatch to the one 256-lane
+    # shape the throughput phase already compiled — no extra kernel
+    # compiles in this phase (only the small pubkey-validation program)
+    impl = JaxBls12381(max_batch=256, min_bucket=256)
     bls.set_implementation(impl)
     try:
         sks = [keygen(bytes([i + 1]) * 32) for i in range(16)]
         pks = [impl.secret_key_to_public_key(sk) for sk in sks]
         msgs = [b"att-%d" % i for i in range(16)]
         sigs = [impl.sign(sk, m) for sk, m in zip(sks, msgs)]
-        # warm the pow-2 buckets the service will hit
-        for size in (1, 2, 4, 8, 16, 32, 64, 128, 256):
-            if time.time() > deadline:
-                break
-            triples = [([pks[i % 16]], msgs[i % 16], sigs[i % 16])
-                       for i in range(size)]
-            t0 = time.time()
-            if not impl.batch_verify(triples):
-                raise RuntimeError("warmup batch failed")
-            OUT.setdefault("warm_compile_s", {})[str(size)] = round(
-                time.time() - t0, 1)
+        # one warm dispatch (256-lane bucket + pk validation compile)
+        triples = [([pks[i % 16]], msgs[i % 16], sigs[i % 16])
+                   for i in range(256)]
+        t0 = time.time()
+        if not impl.batch_verify(triples):
+            raise RuntimeError("warmup batch failed")
+        OUT["warm_compile_s"] = round(time.time() - t0, 1)
 
         lat: list = []
 
@@ -209,8 +225,9 @@ def main():
     t_start = time.time()
     budget_s = float(os.environ.get("BENCH_BUDGET_S", "1500"))
     deadline = t_start + budget_s
+    # 256 first: it doubles as the latency phase's service bucket
     batches = [int(b) for b in
-               os.environ.get("BENCH_BATCHES", "1,64,512,4096").split(",")]
+               os.environ.get("BENCH_BATCHES", "256,4096,64,1").split(",")]
     try:
         jax = _init_device()
     except Exception as exc:

@@ -212,19 +212,19 @@ def cmd_transition(args) -> int:
     from .spec import create_spec
     from .spec.transition import state_transition, StateTransitionError
 
+    from .spec.codec import deserialize_signed_block, deserialize_state
     spec = create_spec(args.network)
-    S = spec.schemas
-    state = S.BeaconState.deserialize(Path(args.pre).read_bytes())
+    state = deserialize_state(spec.config, Path(args.pre).read_bytes())
     for blk_path in args.blocks:
-        signed = S.SignedBeaconBlock.deserialize(
-            Path(blk_path).read_bytes())
+        signed = deserialize_signed_block(spec.config,
+                                          Path(blk_path).read_bytes())
         try:
             state = state_transition(spec.config, state, signed,
                                      validate_result=not args.no_validate)
         except StateTransitionError as exc:
             print(f"invalid block {blk_path}: {exc}", file=sys.stderr)
             return 1
-    Path(args.post).write_bytes(S.BeaconState.serialize(state))
+    Path(args.post).write_bytes(type(state).serialize(state))
     print(f"post state written: slot={state.slot} root=0x"
           f"{state.htr().hex()}")
     return 0

@@ -14,6 +14,8 @@ from typing import List, Optional, Sequence
 
 from ..native import snappyc
 from ..spec import helpers as H
+from ..spec.codec import (deserialize_signed_block,
+                          serialize_signed_block)
 from ..spec.datastructures import MetadataMessage, Ping, Status
 from .transport import P2PNetwork, Peer
 
@@ -127,7 +129,6 @@ class BeaconRpc:
 
     def _blocks_by_range(self, start: int, count: int) -> List[bytes]:
         """Canonical-chain blocks in [start, start+count) by slot."""
-        S = self.node.spec.schemas
         store = self.node.store
         out = []
         head = self.node.chain.head_root
@@ -148,13 +149,12 @@ class BeaconRpc:
         for r in reversed(chain):
             signed = signed_blocks.get(r)
             if signed is not None:
-                out.append(S.SignedBeaconBlock.serialize(signed))
+                out.append(serialize_signed_block(signed))
         return out
 
     def _blocks_by_root(self, roots: Sequence[bytes]) -> List[bytes]:
-        S = self.node.spec.schemas
         signed_blocks = self.node.store.signed_blocks
-        return [S.SignedBeaconBlock.serialize(signed_blocks[r])
+        return [serialize_signed_block(signed_blocks[r])
                 for r in roots if r in signed_blocks]
 
     # -- client side ---------------------------------------------------
@@ -170,7 +170,6 @@ class BeaconRpc:
 
     async def blocks_by_range(self, peer: Peer, start: int,
                               count: int) -> List:
-        S = self.node.spec.schemas
         resp = await peer.request(
             BLOCKS_BY_RANGE,
             snappyc.compress(struct.pack("<QQ", start, count)),
@@ -178,15 +177,16 @@ class BeaconRpc:
         chunks = _unpack_chunks(resp)
         if chunks is None:
             return []
-        return [S.SignedBeaconBlock.deserialize(c) for c in chunks]
+        cfg = self.node.spec.config
+        return [deserialize_signed_block(cfg, c) for c in chunks]
 
     async def blocks_by_root(self, peer: Peer, roots: Sequence[bytes]
                              ) -> List:
-        S = self.node.spec.schemas
         resp = await peer.request(
             BLOCKS_BY_ROOT, snappyc.compress(b"".join(roots)),
             timeout=30.0)
         chunks = _unpack_chunks(resp)
         if chunks is None:
             return []
-        return [S.SignedBeaconBlock.deserialize(c) for c in chunks]
+        cfg = self.node.spec.config
+        return [deserialize_signed_block(cfg, c) for c in chunks]

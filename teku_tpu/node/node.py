@@ -111,9 +111,15 @@ class BeaconNode(Service):
     # ------------------------------------------------------------------
     def _subscribe_topics(self) -> None:
         S = self.spec.schemas
+        from ..spec.codec import deserialize_signed_block
+        cfg = self.spec.config
+
+        class _BlockWire:       # milestone-aware decode (spec/codec.py)
+            @staticmethod
+            def deserialize(data):
+                return deserialize_signed_block(cfg, data)
         self.gossip.subscribe(BEACON_BLOCK_TOPIC, SszTopicHandler(
-            S.SignedBeaconBlock, self._process_gossip_block,
-            BEACON_BLOCK_TOPIC))
+            _BlockWire, self._process_gossip_block, BEACON_BLOCK_TOPIC))
         self.gossip.subscribe(AGGREGATE_TOPIC, SszTopicHandler(
             S.SignedAggregateAndProof, self._process_gossip_aggregate,
             AGGREGATE_TOPIC))

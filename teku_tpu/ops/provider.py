@@ -185,7 +185,9 @@ class JaxBls12381(BLS12381):
         miss = list(miss.items())
         if not miss:
             return
-        n = _next_pow2(len(miss))
+        # floor of 16 keeps the validation program at very few distinct
+        # shapes (same compile-cost argument as the verify min_bucket)
+        n = max(_next_pow2(len(miss)), 16)
         xs = np.zeros((n, fp.L), dtype=np.int64)
         large = np.zeros(n, dtype=bool)
         for i, (_, (x, lg, _inf)) in enumerate(miss):

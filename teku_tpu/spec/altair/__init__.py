@@ -1,0 +1,12 @@
+"""Altair milestone: sync committees + participation-flag accounting.
+
+Equivalent of the reference's altair logic tree (reference: ethereum/
+spec/src/main/java/tech/pegasys/teku/spec/logic/versions/altair/ —
+BlockProcessorAltair, EpochProcessorAltair, helpers/
+BeaconStateAccessorsAltair, util/SyncCommitteeUtil, and the fork
+upgrade in statetransition).  Implements the public altair consensus
+spec on this repo's SSZ engine.
+"""
+
+from .datastructures import get_altair_schemas
+from .fork import upgrade_to_altair

@@ -1,0 +1,172 @@
+"""Altair block processing: flag-based attestations + sync aggregate.
+
+reference: ethereum/spec/.../logic/versions/altair/block/
+BlockProcessorAltair.java (processAttestation flag accounting,
+processSyncAggregate with the proposer/participant reward split).
+"""
+
+from typing import List
+
+from ...crypto import bls
+from .. import block as B0
+from .. import helpers as H
+from ..config import (DOMAIN_SYNC_COMMITTEE, PARTICIPATION_FLAG_WEIGHTS,
+                      PROPOSER_WEIGHT, SpecConfig, SYNC_REWARD_WEIGHT,
+                      TIMELY_TARGET_FLAG_INDEX, WEIGHT_DENOMINATOR)
+from ..verifiers import SignatureVerifier, SIMPLE
+from . import helpers as AH
+
+_require = B0._require
+
+
+def process_attestation(cfg: SpecConfig, state, attestation,
+                        verifier: SignatureVerifier):
+    data = attestation.data
+    _require(data.target.epoch in (H.get_previous_epoch(cfg, state),
+                                   H.get_current_epoch(cfg, state)),
+             "target epoch out of range")
+    _require(data.target.epoch == H.compute_epoch_at_slot(cfg, data.slot),
+             "target/slot mismatch")
+    # the upper window bound still applies in altair (dropped only at
+    # deneb): a stale attestation must invalidate the block
+    _require(data.slot + cfg.MIN_ATTESTATION_INCLUSION_DELAY
+             <= state.slot <= data.slot + cfg.SLOTS_PER_EPOCH,
+             "inclusion window")
+    _require(data.index < H.get_committee_count_per_slot(
+        cfg, state, data.target.epoch), "committee index out of range")
+    committee = H.get_beacon_committee(cfg, state, data.slot, data.index)
+    _require(len(attestation.aggregation_bits) == len(committee),
+             "bits/committee size mismatch")
+    # altair checks source matching inside the flag computation
+    justified = (state.current_justified_checkpoint
+                 if data.target.epoch == H.get_current_epoch(cfg, state)
+                 else state.previous_justified_checkpoint)
+    _require(data.source == justified, "wrong source checkpoint")
+
+    indexed = H.get_indexed_attestation(cfg, state, attestation)
+    _require(B0.is_valid_indexed_attestation(cfg, state, indexed,
+                                             verifier),
+             "bad attestation signature")
+
+    flag_indices = AH.get_attestation_participation_flag_indices(
+        cfg, state, data, state.slot - data.slot)
+    in_current = data.target.epoch == H.get_current_epoch(cfg, state)
+    participation = list(state.current_epoch_participation if in_current
+                         else state.previous_epoch_participation)
+    base_per_inc = AH.get_base_reward_per_increment(cfg, state)
+    proposer_reward_numerator = 0
+    attesting = H.get_attesting_indices(cfg, state, data,
+                                        attestation.aggregation_bits)
+    for index in attesting:
+        increments = (state.validators[index].effective_balance
+                      // cfg.EFFECTIVE_BALANCE_INCREMENT)
+        base_reward = increments * base_per_inc
+        for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+            if (flag_index in flag_indices
+                    and not AH.has_flag(participation[index], flag_index)):
+                participation[index] = AH.add_flag(participation[index],
+                                                   flag_index)
+                proposer_reward_numerator += base_reward * weight
+
+    proposer_reward = (proposer_reward_numerator
+                       // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+                       * PROPOSER_WEIGHT // WEIGHT_DENOMINATOR)
+    state = state.copy_with(**{
+        ("current_epoch_participation" if in_current
+         else "previous_epoch_participation"): tuple(participation)})
+    return H.increase_balance(
+        state, H.get_beacon_proposer_index(cfg, state), proposer_reward)
+
+
+def process_deposit(cfg: SpecConfig, state, deposit,
+                    deposit_verifier: SignatureVerifier = SIMPLE):
+    n_before = len(state.validators)
+    state = B0.process_deposit(cfg, state, deposit, deposit_verifier)
+    if len(state.validators) > n_before:
+        # fresh validator: zeroed participation + inactivity rows
+        state = state.copy_with(
+            previous_epoch_participation=(
+                tuple(state.previous_epoch_participation) + (0,)),
+            current_epoch_participation=(
+                tuple(state.current_epoch_participation) + (0,)),
+            inactivity_scores=tuple(state.inactivity_scores) + (0,))
+    return state
+
+
+def process_sync_aggregate(cfg: SpecConfig, state, sync_aggregate,
+                           verifier: SignatureVerifier):
+    """Spec process_sync_aggregate: previous-slot root signed by the
+    current sync committee; participants earn, absentees pay."""
+    committee_pubkeys = state.current_sync_committee.pubkeys
+    bits = sync_aggregate.sync_committee_bits
+    participant_pubkeys = [pk for pk, b in zip(committee_pubkeys, bits)
+                           if b]
+    previous_slot = max(state.slot, 1) - 1
+    domain = H.get_domain(cfg, state, DOMAIN_SYNC_COMMITTEE,
+                          H.compute_epoch_at_slot(cfg, previous_slot))
+    signing_root = H.compute_signing_root(
+        H.get_block_root_at_slot(cfg, state, previous_slot), domain)
+    if participant_pubkeys:
+        _require(verifier.verify(participant_pubkeys, signing_root,
+                                 sync_aggregate.sync_committee_signature),
+                 "bad sync committee signature")
+    else:
+        _require(bls.eth_fast_aggregate_verify(
+            [], signing_root, sync_aggregate.sync_committee_signature),
+            "empty sync aggregate must carry the infinity signature")
+
+    total_active_increments = (H.get_total_active_balance(cfg, state)
+                               // cfg.EFFECTIVE_BALANCE_INCREMENT)
+    base_per_inc = AH.get_base_reward_per_increment(cfg, state)
+    total_base_rewards = base_per_inc * total_active_increments
+    max_participant_rewards = (total_base_rewards * SYNC_REWARD_WEIGHT
+                               // WEIGHT_DENOMINATOR
+                               // cfg.SLOTS_PER_EPOCH)
+    participant_reward = (max_participant_rewards
+                          // cfg.SYNC_COMMITTEE_SIZE)
+    proposer_reward = (participant_reward * PROPOSER_WEIGHT
+                       // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT))
+
+    pubkey_to_index = {v.pubkey: i
+                       for i, v in enumerate(state.validators)}
+    proposer_index = H.get_beacon_proposer_index(cfg, state)
+    balances = list(state.balances)
+    for pk, participated in zip(committee_pubkeys, bits):
+        index = pubkey_to_index[pk]
+        if participated:
+            balances[index] += participant_reward
+            balances[proposer_index] += proposer_reward
+        else:
+            balances[index] = max(0, balances[index] - participant_reward)
+    return state.copy_with(balances=tuple(balances))
+
+
+def process_block(cfg: SpecConfig, state, block,
+                  verifier: SignatureVerifier,
+                  deposit_verifier: SignatureVerifier = SIMPLE):
+    state = B0.process_block_header(cfg, state, block)
+    state = B0.process_randao(cfg, state, block.body, verifier)
+    state = B0.process_eth1_data(cfg, state, block.body)
+    state = _process_operations(cfg, state, block.body, verifier,
+                                deposit_verifier)
+    state = process_sync_aggregate(cfg, state, block.body.sync_aggregate,
+                                   verifier)
+    return state
+
+
+def _process_operations(cfg, state, body, verifier, deposit_verifier):
+    expected = min(cfg.MAX_DEPOSITS,
+                   state.eth1_data.deposit_count
+                   - state.eth1_deposit_index)
+    _require(len(body.deposits) == expected, "wrong deposit count")
+    for op in body.proposer_slashings:
+        state = B0.process_proposer_slashing(cfg, state, op, verifier)
+    for op in body.attester_slashings:
+        state = B0.process_attester_slashing(cfg, state, op, verifier)
+    for op in body.attestations:
+        state = process_attestation(cfg, state, op, verifier)
+    for op in body.deposits:
+        state = process_deposit(cfg, state, op, deposit_verifier)
+    for op in body.voluntary_exits:
+        state = B0.process_voluntary_exit(cfg, state, op, verifier)
+    return state

@@ -1,0 +1,108 @@
+"""Altair containers: sync committees, participation-flag state.
+
+reference: ethereum/spec/.../spec/datastructures/state/beaconstate/
+versions/altair/BeaconStateAltair.java + blocks/versions/altair/.
+"""
+
+from functools import lru_cache
+
+from ...ssz import (Bitlist, Bitvector, boolean, Bytes4, Bytes32, Bytes48,
+                    Bytes96, Container, List, uint8, uint64, Vector)
+from ...ssz.types import _ContainerMeta
+from ..config import SpecConfig
+from ..datastructures import (AttestationData, BeaconBlockHeader,
+                              Checkpoint, Eth1Data, Fork, get_schemas,
+                              Validator)
+
+
+def _container(name, fields):
+    return _ContainerMeta(name, (Container,),
+                          {"__annotations__": dict(fields)})
+
+
+class AltairSchemas:
+    """One object per config, like the phase0 Schemas family."""
+
+    def __getattr__(self, name):
+        # anything altair doesn't redefine (Attestation, Deposit, ...)
+        # is the phase0 container
+        if name == "phase0":     # not set yet during __init__
+            raise AttributeError(name)
+        return getattr(self.phase0, name)
+
+    def __init__(self, cfg: SpecConfig):
+        self.config = cfg
+        base = get_schemas(cfg)
+        self.phase0 = base
+
+        self.SyncCommittee = _container("SyncCommittee", [
+            ("pubkeys", Vector(Bytes48, cfg.SYNC_COMMITTEE_SIZE)),
+            ("aggregate_pubkey", Bytes48),
+        ])
+        self.SyncAggregate = _container("SyncAggregate", [
+            ("sync_committee_bits", Bitvector(cfg.SYNC_COMMITTEE_SIZE)),
+            ("sync_committee_signature", Bytes96),
+        ])
+        self.BeaconBlockBody = _container("BeaconBlockBodyAltair", [
+            ("randao_reveal", Bytes96),
+            ("eth1_data", Eth1Data),
+            ("graffiti", Bytes32),
+            ("proposer_slashings",
+             base.BeaconBlockBody._ssz_fields["proposer_slashings"]),
+            ("attester_slashings",
+             base.BeaconBlockBody._ssz_fields["attester_slashings"]),
+            ("attestations",
+             base.BeaconBlockBody._ssz_fields["attestations"]),
+            ("deposits", base.BeaconBlockBody._ssz_fields["deposits"]),
+            ("voluntary_exits",
+             base.BeaconBlockBody._ssz_fields["voluntary_exits"]),
+            ("sync_aggregate", self.SyncAggregate),
+        ])
+        self.BeaconBlock = _container("BeaconBlockAltair", [
+            ("slot", uint64),
+            ("proposer_index", uint64),
+            ("parent_root", Bytes32),
+            ("state_root", Bytes32),
+            ("body", self.BeaconBlockBody),
+        ])
+        self.SignedBeaconBlock = _container("SignedBeaconBlockAltair", [
+            ("message", self.BeaconBlock),
+            ("signature", Bytes96),
+        ])
+        self.BeaconState = _container("BeaconStateAltair", [
+            ("genesis_time", uint64),
+            ("genesis_validators_root", Bytes32),
+            ("slot", uint64),
+            ("fork", Fork),
+            ("latest_block_header", BeaconBlockHeader),
+            ("block_roots", Vector(Bytes32, cfg.SLOTS_PER_HISTORICAL_ROOT)),
+            ("state_roots", Vector(Bytes32, cfg.SLOTS_PER_HISTORICAL_ROOT)),
+            ("historical_roots", List(Bytes32, cfg.HISTORICAL_ROOTS_LIMIT)),
+            ("eth1_data", Eth1Data),
+            ("eth1_data_votes",
+             List(Eth1Data, cfg.EPOCHS_PER_ETH1_VOTING_PERIOD
+                  * cfg.SLOTS_PER_EPOCH)),
+            ("eth1_deposit_index", uint64),
+            ("validators", List(Validator, cfg.VALIDATOR_REGISTRY_LIMIT)),
+            ("balances", List(uint64, cfg.VALIDATOR_REGISTRY_LIMIT)),
+            ("randao_mixes",
+             Vector(Bytes32, cfg.EPOCHS_PER_HISTORICAL_VECTOR)),
+            ("slashings", Vector(uint64, cfg.EPOCHS_PER_SLASHINGS_VECTOR)),
+            ("previous_epoch_participation",
+             List(uint8, cfg.VALIDATOR_REGISTRY_LIMIT)),
+            ("current_epoch_participation",
+             List(uint8, cfg.VALIDATOR_REGISTRY_LIMIT)),
+            ("justification_bits", Bitvector(4)),
+            ("previous_justified_checkpoint", Checkpoint),
+            ("current_justified_checkpoint", Checkpoint),
+            ("finalized_checkpoint", Checkpoint),
+            ("inactivity_scores",
+             List(uint64, cfg.VALIDATOR_REGISTRY_LIMIT)),
+            ("current_sync_committee", self.SyncCommittee),
+            ("next_sync_committee", self.SyncCommittee),
+        ])
+
+
+@lru_cache(maxsize=8)
+def get_altair_schemas(cfg: SpecConfig) -> AltairSchemas:
+    return AltairSchemas(cfg)

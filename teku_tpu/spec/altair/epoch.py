@@ -1,0 +1,156 @@
+"""Altair epoch processing: flag-based justification, inactivity
+scores, flag-deltas rewards, participation rotation, sync-committee
+period rollover.
+
+reference: ethereum/spec/.../logic/versions/altair/statetransition/
+epoch/EpochProcessorAltair.java — math follows the public altair spec.
+"""
+
+from ..config import (GENESIS_EPOCH, PARTICIPATION_FLAG_WEIGHTS,
+                      SpecConfig, TIMELY_HEAD_FLAG_INDEX,
+                      TIMELY_SOURCE_FLAG_INDEX, TIMELY_TARGET_FLAG_INDEX,
+                      WEIGHT_DENOMINATOR)
+from .. import epoch as E0
+from .. import helpers as H
+from . import helpers as AH
+
+
+def process_justification_and_finalization(cfg: SpecConfig, state):
+    if H.get_current_epoch(cfg, state) <= GENESIS_EPOCH + 1:
+        return state
+    prev = AH.get_unslashed_participating_indices(
+        cfg, state, TIMELY_TARGET_FLAG_INDEX,
+        H.get_previous_epoch(cfg, state))
+    cur = AH.get_unslashed_participating_indices(
+        cfg, state, TIMELY_TARGET_FLAG_INDEX,
+        H.get_current_epoch(cfg, state))
+    total = H.get_total_active_balance(cfg, state)
+    return E0.weigh_justification_and_finalization(
+        cfg, state, total,
+        H.get_total_balance(cfg, state, prev),
+        H.get_total_balance(cfg, state, cur))
+
+
+def process_inactivity_updates(cfg: SpecConfig, state):
+    if H.get_current_epoch(cfg, state) == GENESIS_EPOCH:
+        return state
+    scores = list(state.inactivity_scores)
+    target_idx = AH.get_unslashed_participating_indices(
+        cfg, state, TIMELY_TARGET_FLAG_INDEX,
+        H.get_previous_epoch(cfg, state))
+    leaking = E0.is_in_inactivity_leak(cfg, state)
+    for i in E0.get_eligible_validator_indices(cfg, state):
+        if i in target_idx:
+            scores[i] -= min(1, scores[i])
+        else:
+            scores[i] += cfg.INACTIVITY_SCORE_BIAS
+        if not leaking:
+            scores[i] -= min(cfg.INACTIVITY_SCORE_RECOVERY_RATE,
+                             scores[i])
+    return state.copy_with(inactivity_scores=tuple(scores))
+
+
+def get_flag_index_deltas(cfg: SpecConfig, state, flag_index: int):
+    """(rewards, penalties) for one flag (spec get_flag_index_deltas)."""
+    n = len(state.validators)
+    rewards = [0] * n
+    penalties = [0] * n
+    previous_epoch = H.get_previous_epoch(cfg, state)
+    unslashed = AH.get_unslashed_participating_indices(
+        cfg, state, flag_index, previous_epoch)
+    weight = PARTICIPATION_FLAG_WEIGHTS[flag_index]
+    inc = cfg.EFFECTIVE_BALANCE_INCREMENT
+    unslashed_increments = H.get_total_balance(cfg, state,
+                                               unslashed) // inc
+    active_increments = H.get_total_active_balance(cfg, state) // inc
+    leaking = E0.is_in_inactivity_leak(cfg, state)
+    for index in E0.get_eligible_validator_indices(cfg, state):
+        base_reward = AH.get_base_reward(cfg, state, index)
+        if index in unslashed:
+            if not leaking:
+                numer = base_reward * weight * unslashed_increments
+                rewards[index] += (numer
+                                   // (active_increments
+                                       * WEIGHT_DENOMINATOR))
+        elif flag_index != TIMELY_HEAD_FLAG_INDEX:
+            penalties[index] += (base_reward * weight
+                                 // WEIGHT_DENOMINATOR)
+    return rewards, penalties
+
+
+def get_inactivity_penalty_deltas(cfg: SpecConfig, state):
+    n = len(state.validators)
+    penalties = [0] * n
+    previous_epoch = H.get_previous_epoch(cfg, state)
+    target_idx = AH.get_unslashed_participating_indices(
+        cfg, state, TIMELY_TARGET_FLAG_INDEX, previous_epoch)
+    for index in E0.get_eligible_validator_indices(cfg, state):
+        if index not in target_idx:
+            numer = (state.validators[index].effective_balance
+                     * state.inactivity_scores[index])
+            penalties[index] += numer // (
+                cfg.INACTIVITY_SCORE_BIAS
+                * cfg.INACTIVITY_PENALTY_QUOTIENT_ALTAIR)
+    return [0] * n, penalties
+
+
+def process_rewards_and_penalties(cfg: SpecConfig, state):
+    if H.get_current_epoch(cfg, state) == GENESIS_EPOCH:
+        return state
+    deltas = [get_flag_index_deltas(cfg, state, f)
+              for f in range(len(PARTICIPATION_FLAG_WEIGHTS))]
+    deltas.append(get_inactivity_penalty_deltas(cfg, state))
+    balances = list(state.balances)
+    for rewards, penalties in deltas:
+        for i in range(len(balances)):
+            balances[i] = max(0, balances[i] + rewards[i] - penalties[i])
+    return state.copy_with(balances=tuple(balances))
+
+
+def process_slashings(cfg: SpecConfig, state):
+    """Altair: proportional multiplier 2 (spec process_slashings)."""
+    epoch = H.get_current_epoch(cfg, state)
+    total = H.get_total_active_balance(cfg, state)
+    adjusted = min(sum(state.slashings)
+                   * cfg.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR, total)
+    inc = cfg.EFFECTIVE_BALANCE_INCREMENT
+    balances = list(state.balances)
+    for i, v in enumerate(state.validators):
+        if (v.slashed and epoch + cfg.EPOCHS_PER_SLASHINGS_VECTOR // 2
+                == v.withdrawable_epoch):
+            penalty = (v.effective_balance // inc * adjusted
+                       // total * inc)
+            balances[i] = max(0, balances[i] - penalty)
+    return state.copy_with(balances=tuple(balances))
+
+
+def process_participation_flag_updates(cfg: SpecConfig, state):
+    return state.copy_with(
+        previous_epoch_participation=state.current_epoch_participation,
+        current_epoch_participation=tuple(
+            0 for _ in state.validators))
+
+
+def process_sync_committee_updates(cfg: SpecConfig, state):
+    next_epoch = H.get_current_epoch(cfg, state) + 1
+    if next_epoch % cfg.EPOCHS_PER_SYNC_COMMITTEE_PERIOD == 0:
+        return state.copy_with(
+            current_sync_committee=state.next_sync_committee,
+            next_sync_committee=AH.get_next_sync_committee(cfg, state))
+    return state
+
+
+def process_epoch(cfg: SpecConfig, state):
+    state = process_justification_and_finalization(cfg, state)
+    state = process_inactivity_updates(cfg, state)
+    state = process_rewards_and_penalties(cfg, state)
+    state = E0.process_registry_updates(cfg, state)
+    state = process_slashings(cfg, state)
+    state = E0.process_eth1_data_reset(cfg, state)
+    state = E0.process_effective_balance_updates(cfg, state)
+    state = E0.process_slashings_reset(cfg, state)
+    state = E0.process_randao_mixes_reset(cfg, state)
+    state = E0.process_historical_roots_update(cfg, state)
+    state = process_participation_flag_updates(cfg, state)
+    state = process_sync_committee_updates(cfg, state)
+    return state

@@ -1,0 +1,67 @@
+"""Phase0 → altair fork upgrade.
+
+reference: the upgrade path the reference applies at the altair
+activation epoch (spec upgrade_to_altair): carry all phase0 fields,
+zero the participation/inactivity tracks, TRANSLATE pending phase0
+attestations into participation flags, and bootstrap both sync
+committees.
+"""
+
+from ...ssz import Container
+from .. import helpers as H
+from ..config import SpecConfig
+from ..datastructures import Fork
+from . import helpers as AH
+from .datastructures import get_altair_schemas
+
+
+def translate_participation(cfg: SpecConfig, post, pending_attestations):
+    participation = list(post.previous_epoch_participation)
+    for a in pending_attestations:
+        data = a.data
+        flags = AH.get_attestation_participation_flag_indices(
+            cfg, post, data, a.inclusion_delay)
+        for index in H.get_attesting_indices(cfg, post, data,
+                                             a.aggregation_bits):
+            for f in flags:
+                participation[index] = AH.add_flag(participation[index], f)
+    return post.copy_with(previous_epoch_participation=tuple(participation))
+
+
+def upgrade_to_altair(cfg: SpecConfig, pre):
+    S = get_altair_schemas(cfg)
+    epoch = H.get_current_epoch(cfg, pre)
+    n = len(pre.validators)
+    post = S.BeaconState(
+        genesis_time=pre.genesis_time,
+        genesis_validators_root=pre.genesis_validators_root,
+        slot=pre.slot,
+        fork=Fork(previous_version=pre.fork.current_version,
+                  current_version=cfg.ALTAIR_FORK_VERSION,
+                  epoch=epoch),
+        latest_block_header=pre.latest_block_header,
+        block_roots=pre.block_roots,
+        state_roots=pre.state_roots,
+        historical_roots=pre.historical_roots,
+        eth1_data=pre.eth1_data,
+        eth1_data_votes=pre.eth1_data_votes,
+        eth1_deposit_index=pre.eth1_deposit_index,
+        validators=pre.validators,
+        balances=pre.balances,
+        randao_mixes=pre.randao_mixes,
+        slashings=pre.slashings,
+        previous_epoch_participation=tuple(0 for _ in range(n)),
+        current_epoch_participation=tuple(0 for _ in range(n)),
+        justification_bits=pre.justification_bits,
+        previous_justified_checkpoint=pre.previous_justified_checkpoint,
+        current_justified_checkpoint=pre.current_justified_checkpoint,
+        finalized_checkpoint=pre.finalized_checkpoint,
+        inactivity_scores=tuple(0 for _ in range(n)),
+    )
+    post = translate_participation(cfg, post,
+                                   pre.previous_epoch_attestations)
+    # the spec assigns get_next_sync_committee(post) to BOTH fields;
+    # the state is identical between the two calls, so compute once
+    committee = AH.get_next_sync_committee(cfg, post)
+    return post.copy_with(current_sync_committee=committee,
+                          next_sync_committee=committee)

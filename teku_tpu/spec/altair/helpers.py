@@ -1,0 +1,122 @@
+"""Altair helper functions: participation flags, sync committees,
+per-increment base rewards.
+
+reference: ethereum/spec/.../logic/versions/altair/helpers/
+BeaconStateAccessorsAltair.java, MiscHelpersAltair.java and util/
+SyncCommitteeUtil.java — the math follows the public altair spec.
+"""
+
+from typing import List, Sequence, Set
+
+from ...crypto import bls
+from .. import helpers as H
+from ..config import (DOMAIN_SYNC_COMMITTEE, PARTICIPATION_FLAG_WEIGHTS,
+                      SpecConfig, TIMELY_HEAD_FLAG_INDEX,
+                      TIMELY_SOURCE_FLAG_INDEX, TIMELY_TARGET_FLAG_INDEX)
+
+BASE_REWARD_FACTOR_DIVISOR = None   # altair uses per-increment rewards
+
+
+def add_flag(flags: int, index: int) -> int:
+    return flags | (1 << index)
+
+
+def has_flag(flags: int, index: int) -> bool:
+    return bool(flags & (1 << index))
+
+
+def get_base_reward_per_increment(cfg: SpecConfig, state) -> int:
+    return (cfg.EFFECTIVE_BALANCE_INCREMENT * cfg.BASE_REWARD_FACTOR
+            // H.integer_squareroot(H.get_total_active_balance(cfg, state)))
+
+
+def get_base_reward(cfg: SpecConfig, state, index: int) -> int:
+    increments = (state.validators[index].effective_balance
+                  // cfg.EFFECTIVE_BALANCE_INCREMENT)
+    return increments * get_base_reward_per_increment(cfg, state)
+
+
+def get_attestation_participation_flag_indices(
+        cfg: SpecConfig, state, data, inclusion_delay: int) -> List[int]:
+    """Spec get_attestation_participation_flag_indices."""
+    justified = (state.current_justified_checkpoint
+                 if data.target.epoch == H.get_current_epoch(cfg, state)
+                 else state.previous_justified_checkpoint)
+    is_matching_source = data.source == justified
+    is_matching_target = (
+        is_matching_source
+        and data.target.root == H.get_block_root(cfg, state,
+                                                 data.target.epoch))
+    is_matching_head = (
+        is_matching_target
+        and data.beacon_block_root == H.get_block_root_at_slot(
+            cfg, state, data.slot))
+    out = []
+    if (is_matching_source
+            and inclusion_delay
+            <= H.integer_squareroot(cfg.SLOTS_PER_EPOCH)):
+        out.append(TIMELY_SOURCE_FLAG_INDEX)
+    if is_matching_target and inclusion_delay <= cfg.SLOTS_PER_EPOCH:
+        out.append(TIMELY_TARGET_FLAG_INDEX)
+    if (is_matching_head
+            and inclusion_delay == cfg.MIN_ATTESTATION_INCLUSION_DELAY):
+        out.append(TIMELY_HEAD_FLAG_INDEX)
+    return out
+
+
+def get_unslashed_participating_indices(cfg: SpecConfig, state,
+                                        flag_index: int,
+                                        epoch: int) -> Set[int]:
+    assert epoch in (H.get_previous_epoch(cfg, state),
+                     H.get_current_epoch(cfg, state))
+    participation = (state.current_epoch_participation
+                     if epoch == H.get_current_epoch(cfg, state)
+                     else state.previous_epoch_participation)
+    active = H.get_active_validator_indices(state, epoch)
+    return {i for i in active
+            if has_flag(participation[i], flag_index)
+            and not state.validators[i].slashed}
+
+
+# -- sync committees -------------------------------------------------------
+
+def get_next_sync_committee_indices(cfg: SpecConfig, state) -> List[int]:
+    """Balance-weighted sampling with the sync-committee domain seed
+    (spec get_next_sync_committee_indices)."""
+    epoch = H.get_current_epoch(cfg, state) + 1
+    MAX_RANDOM_BYTE = 2 ** 8 - 1
+    active = H.get_active_validator_indices(state, epoch)
+    seed = H.get_seed(cfg, state, epoch, DOMAIN_SYNC_COMMITTEE)
+    out: List[int] = []
+    i = 0
+    n = len(active)
+    while len(out) < cfg.SYNC_COMMITTEE_SIZE:
+        shuffled = H.compute_shuffled_index(cfg, i % n, n, seed)
+        candidate = active[shuffled]
+        random_byte = H.hash32(
+            seed + (i // 32).to_bytes(8, "little"))[i % 32]
+        eff = state.validators[candidate].effective_balance
+        if eff * MAX_RANDOM_BYTE >= cfg.MAX_EFFECTIVE_BALANCE * random_byte:
+            out.append(candidate)
+        i += 1
+    return out
+
+
+def get_next_sync_committee(cfg: SpecConfig, state):
+    from .datastructures import get_altair_schemas
+    S = get_altair_schemas(cfg)
+    indices = get_next_sync_committee_indices(cfg, state)
+    pubkeys = [state.validators[i].pubkey for i in indices]
+    return S.SyncCommittee(
+        pubkeys=tuple(pubkeys),
+        aggregate_pubkey=bls.eth_aggregate_pubkeys(pubkeys))
+
+
+def sync_committee_signing_root(cfg: SpecConfig, state, slot: int) -> bytes:
+    """The message sync-committee members sign: the previous slot's
+    block root under DOMAIN_SYNC_COMMITTEE."""
+    domain = H.get_domain(cfg, state, DOMAIN_SYNC_COMMITTEE,
+                          H.compute_epoch_at_slot(
+                              cfg, max(slot, 1) - 1))
+    root = H.get_block_root_at_slot(cfg, state, max(slot, 1) - 1)
+    return H.compute_signing_root(root, domain)

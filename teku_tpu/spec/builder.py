@@ -88,27 +88,40 @@ def build_unsigned_block(cfg: SpecConfig, pre, slot: int,
                          attester_slashings: Sequence = (),
                          voluntary_exits: Sequence = (),
                          graffiti: bytes = bytes(32),
-                         proposer_index: Optional[int] = None):
+                         proposer_index: Optional[int] = None,
+                         sync_aggregate=None):
     """(unsigned block with state root filled, post_state) on an
     already-slot-advanced pre-state — the ONE body-construction recipe
     shared by local production and the validator API (reference:
-    BlockProposalUtil.createNewUnsignedBlock)."""
-    from . import block as B
-    S = get_schemas(cfg)
+    BlockProposalUtil.createNewUnsignedBlock).  Milestone-routed: an
+    altair+ body carries a sync aggregate (empty participation with the
+    infinity signature is spec-valid when none is supplied)."""
+    from .milestones import build_fork_schedule
+    version = build_fork_schedule(cfg).version_at_slot(slot)
+    S = version.schemas
     assert pre.slot == slot, "pre-state must be advanced to the slot"
     if proposer_index is None:
         proposer_index = H.get_beacon_proposer_index(cfg, pre)
-    body = S.BeaconBlockBody(
+    body_kwargs = dict(
         randao_reveal=randao_reveal,
         eth1_data=pre.eth1_data, graffiti=graffiti,
         proposer_slashings=tuple(proposer_slashings),
         attester_slashings=tuple(attester_slashings),
         attestations=tuple(attestations), deposits=tuple(deposits),
         voluntary_exits=tuple(voluntary_exits))
+    if "sync_aggregate" in S.BeaconBlockBody._ssz_fields:
+        if sync_aggregate is None:
+            from ..crypto.bls.pure_impl import G2_INFINITY
+            sync_aggregate = S.SyncAggregate(
+                sync_committee_bits=tuple(
+                    False for _ in range(cfg.SYNC_COMMITTEE_SIZE)),
+                sync_committee_signature=G2_INFINITY)
+        body_kwargs["sync_aggregate"] = sync_aggregate
+    body = S.BeaconBlockBody(**body_kwargs)
     block = S.BeaconBlock(
         slot=slot, proposer_index=proposer_index,
         parent_root=_parent_root(pre), state_root=bytes(32), body=body)
-    post = B.process_block(cfg, pre, block, _TRUSTING, _TRUSTING)
+    post = version.process_block(cfg, pre, block, _TRUSTING, _TRUSTING)
     return block.copy_with(state_root=post.htr()), post
 
 
@@ -118,13 +131,15 @@ def produce_block(cfg: SpecConfig, state, slot: int, signer: Signer,
                   proposer_slashings: Sequence = (),
                   attester_slashings: Sequence = (),
                   voluntary_exits: Sequence = (),
-                  graffiti: bytes = bytes(32)):
+                  graffiti: bytes = bytes(32),
+                  sync_aggregate=None):
     """Produce and sign a block for `slot` on top of `state`.
 
     Returns (signed_block, post_state).  The state root is computed by
     running the real transition with signature validation disabled
     (production trusts its own signatures)."""
-    S = get_schemas(cfg)
+    from .milestones import build_fork_schedule
+    S = build_fork_schedule(cfg).version_at_slot(slot).schemas
     pre = process_slots(cfg, state, slot) if state.slot < slot else state
     proposer_index = H.get_beacon_proposer_index(cfg, pre)
     epoch = H.compute_epoch_at_slot(cfg, slot)
@@ -132,7 +147,7 @@ def produce_block(cfg: SpecConfig, state, slot: int, signer: Signer,
     block, post = build_unsigned_block(
         cfg, pre, slot, reveal, attestations, deposits,
         proposer_slashings, attester_slashings, voluntary_exits, graffiti,
-        proposer_index=proposer_index)
+        proposer_index=proposer_index, sync_aggregate=sync_aggregate)
     domain = H.get_domain(cfg, pre, DOMAIN_BEACON_PROPOSER, epoch)
     root = H.compute_signing_root(block, domain)
     signed = S.SignedBeaconBlock(message=block,

@@ -1,0 +1,49 @@
+"""Milestone-aware SignedBeaconBlock wire codec.
+
+The serialization boundary problem the reference solves with
+fork-digest-scoped gossip topics and per-milestone schema registries
+(reference: networking/eth2 gossip/forks/GossipForkManager.java +
+spec/schemas/SchemaDefinitions): a phase0 decoder cannot parse an
+altair block.  Every SignedBeaconBlock variant shares the same outer
+framing — [u32 message offset][96-byte signature][message: slot is its
+first u64] — so the governing milestone can be read from the slot
+BEFORE choosing the schema, and serialization is polymorphic on the
+container class itself.
+"""
+
+import struct
+
+from .config import SpecConfig
+from .milestones import build_fork_schedule
+
+
+def serialize_signed_block(signed_block) -> bytes:
+    """Polymorphic: the instance's own class IS its schema."""
+    return type(signed_block).serialize(signed_block)
+
+
+def peek_signed_block_slot(data: bytes) -> int:
+    if len(data) < 112:
+        raise ValueError("not a signed beacon block")
+    (offset,) = struct.unpack_from("<I", data, 0)
+    if offset + 8 > len(data):
+        raise ValueError("truncated signed beacon block")
+    (slot,) = struct.unpack_from("<Q", data, offset)
+    return slot
+
+
+def deserialize_signed_block(cfg: SpecConfig, data: bytes):
+    """Route to the schema of the milestone governing the block's slot."""
+    slot = peek_signed_block_slot(data)
+    version = build_fork_schedule(cfg).version_at_slot(slot)
+    return version.schemas.SignedBeaconBlock.deserialize(data)
+
+
+def deserialize_state(cfg: SpecConfig, data: bytes):
+    """States carry their slot at byte offset 40 (genesis_time u64 +
+    genesis_validators_root 32 bytes)."""
+    if len(data) < 48:
+        raise ValueError("not a beacon state")
+    (slot,) = struct.unpack_from("<Q", data, 40)
+    version = build_fork_schedule(cfg).version_at_slot(slot)
+    return version.schemas.BeaconState.deserialize(data)

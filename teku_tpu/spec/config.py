@@ -116,12 +116,41 @@ class SpecConfig:
     EPOCHS_PER_RANDOM_SUBNET_SUBSCRIPTION: int = 256
     ATTESTATION_SUBNET_COUNT: int = 64
 
+    # --- Altair ---
+    ALTAIR_FORK_VERSION: bytes = bytes.fromhex("01000000")
+    ALTAIR_FORK_EPOCH: int = FAR_FUTURE_EPOCH
+    SYNC_COMMITTEE_SIZE: int = 512
+    EPOCHS_PER_SYNC_COMMITTEE_PERIOD: int = 256
+    MIN_SYNC_COMMITTEE_PARTICIPANTS: int = 1
+    INACTIVITY_PENALTY_QUOTIENT_ALTAIR: int = 3 * 2 ** 24
+    MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR: int = 64
+    PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR: int = 2
+    INACTIVITY_SCORE_BIAS: int = 4
+    INACTIVITY_SCORE_RECOVERY_RATE: int = 16
+
 
 MAINNET = SpecConfig()
+
+DOMAIN_SYNC_COMMITTEE_SELECTION = DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF
+
+# participation flag indices / incentive weights (altair constants)
+TIMELY_SOURCE_FLAG_INDEX = 0
+TIMELY_TARGET_FLAG_INDEX = 1
+TIMELY_HEAD_FLAG_INDEX = 2
+TIMELY_SOURCE_WEIGHT = 14
+TIMELY_TARGET_WEIGHT = 26
+TIMELY_HEAD_WEIGHT = 14
+SYNC_REWARD_WEIGHT = 2
+PROPOSER_WEIGHT = 8
+WEIGHT_DENOMINATOR = 64
+PARTICIPATION_FLAG_WEIGHTS = (TIMELY_SOURCE_WEIGHT, TIMELY_TARGET_WEIGHT,
+                              TIMELY_HEAD_WEIGHT)
 
 MINIMAL = SpecConfig(
     preset_name="minimal",
     config_name="minimal",
+    SYNC_COMMITTEE_SIZE=32,
+    EPOCHS_PER_SYNC_COMMITTEE_PERIOD=8,
     MAX_COMMITTEES_PER_SLOT=4,
     TARGET_COMMITTEE_SIZE=4,
     SHUFFLE_ROUND_COUNT=10,

@@ -418,6 +418,10 @@ def initiate_validator_exit(cfg: SpecConfig, state, index: int):
     return state.copy_with(validators=tuple(validators))
 
 
+def _is_altair(cfg: SpecConfig, state) -> bool:
+    return get_current_epoch(cfg, state) >= cfg.ALTAIR_FORK_EPOCH
+
+
 def slash_validator(cfg: SpecConfig, state, slashed_index: int,
                     whistleblower_index: Optional[int] = None):
     epoch = get_current_epoch(cfg, state)
@@ -433,16 +437,24 @@ def slash_validator(cfg: SpecConfig, state, slashed_index: int,
     slashings[epoch % cfg.EPOCHS_PER_SLASHINGS_VECTOR] += v.effective_balance
     state = state.copy_with(validators=tuple(validators),
                             slashings=tuple(slashings))
+    altair = _is_altair(cfg, state)
+    penalty_quotient = (cfg.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR if altair
+                       else cfg.MIN_SLASHING_PENALTY_QUOTIENT)
     state = decrease_balance(
-        state, slashed_index,
-        v.effective_balance // cfg.MIN_SLASHING_PENALTY_QUOTIENT)
+        state, slashed_index, v.effective_balance // penalty_quotient)
 
     proposer_index = get_beacon_proposer_index(cfg, state)
     if whistleblower_index is None:
         whistleblower_index = proposer_index
     whistleblower_reward = (v.effective_balance
                             // cfg.WHISTLEBLOWER_REWARD_QUOTIENT)
-    proposer_reward = whistleblower_reward // cfg.PROPOSER_REWARD_QUOTIENT
+    if altair:
+        from .config import PROPOSER_WEIGHT, WEIGHT_DENOMINATOR
+        proposer_reward = (whistleblower_reward * PROPOSER_WEIGHT
+                           // WEIGHT_DENOMINATOR)
+    else:
+        proposer_reward = (whistleblower_reward
+                           // cfg.PROPOSER_REWARD_QUOTIENT)
     state = increase_balance(state, proposer_index, proposer_reward)
     state = increase_balance(state, whistleblower_index,
                              whistleblower_reward - proposer_reward)

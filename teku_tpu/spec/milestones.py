@@ -108,7 +108,27 @@ def phase0_version(cfg: SpecConfig) -> SpecVersion:
         process_epoch=E.process_epoch)
 
 
+def altair_version(cfg: SpecConfig) -> SpecVersion:
+    from .altair import block as AB
+    from .altair import epoch as AE
+    from .altair.datastructures import get_altair_schemas
+    from .altair.fork import upgrade_to_altair
+
+    return SpecVersion(
+        milestone=SpecMilestone.ALTAIR,
+        fork_version=cfg.ALTAIR_FORK_VERSION,
+        fork_epoch=cfg.ALTAIR_FORK_EPOCH,
+        schemas=get_altair_schemas(cfg),
+        process_block=AB.process_block,
+        process_epoch=AE.process_epoch,
+        upgrade_state=lambda state: upgrade_to_altair(cfg, state))
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=16)
 def build_fork_schedule(cfg: SpecConfig) -> ForkSchedule:
-    """All scheduled milestones for this config (phase0 today; altair+
-    register by adding their versions with fork epochs in the config)."""
-    return ForkSchedule(cfg, [phase0_version(cfg)])
+    """All scheduled milestones for this config (phase0 + altair when
+    its fork epoch is set; later forks register the same way)."""
+    return ForkSchedule(cfg, [phase0_version(cfg), altair_version(cfg)])

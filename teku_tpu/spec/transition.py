@@ -19,6 +19,11 @@ class StateTransitionError(Exception):
     """Invalid block (the reference's StateTransitionException)."""
 
 
+def _schedule(cfg: SpecConfig):
+    from .milestones import build_fork_schedule
+    return build_fork_schedule(cfg)
+
+
 def process_slot(cfg: SpecConfig, state):
     previous_state_root = state.htr()
     roots = list(state.state_roots)
@@ -35,14 +40,26 @@ def process_slot(cfg: SpecConfig, state):
 
 
 def process_slots(cfg: SpecConfig, state, slot: int):
+    """Slot catch-up with milestone-routed epoch processing and fork
+    upgrades applied exactly at their activation boundary (reference:
+    StateTransition.processSlots + the per-fork delegation in
+    Spec.atSlot)."""
     if slot <= state.slot:
         raise StateTransitionError(
             f"cannot rewind: state at {state.slot}, asked for {slot}")
+    schedule = _schedule(cfg)
     while state.slot < slot:
         state = process_slot(cfg, state)
         if (state.slot + 1) % cfg.SLOTS_PER_EPOCH == 0:
-            state = E.process_epoch(cfg, state)
+            # the CURRENT epoch's milestone governs its own processing
+            version = schedule.version_at_slot(state.slot)
+            state = version.process_epoch(cfg, state)
         state = state.copy_with(slot=state.slot + 1)
+        if state.slot % cfg.SLOTS_PER_EPOCH == 0:
+            new_epoch = state.slot // cfg.SLOTS_PER_EPOCH
+            for version in schedule.upgrades_between(new_epoch - 1,
+                                                     new_epoch):
+                state = version.upgrade_state(state)
     return state
 
 
@@ -55,12 +72,14 @@ def state_transition(cfg: SpecConfig, state, signed_block,
     state = process_slots(cfg, state, block.slot)
     verifier: SignatureVerifier = (
         BatchSignatureVerifier() if validate_result else _ACCEPT_ALL)
+    process_block = _schedule(cfg).version_at_slot(
+        block.slot).process_block
     try:
         if validate_result and not B.verify_block_signature(
                 cfg, state, signed_block, verifier):
             raise StateTransitionError("bad proposer signature")
-        state = B.process_block(cfg, state, block, verifier,
-                                deposit_verifier=SIMPLE)
+        state = process_block(cfg, state, block, verifier,
+                              deposit_verifier=SIMPLE)
     except B.BlockProcessingError as exc:
         raise StateTransitionError(str(exc)) from exc
     if validate_result:

@@ -13,6 +13,8 @@ from typing import Dict, List, Optional, Tuple
 
 from ..native.kv import KvStore
 from ..spec import Spec
+from ..spec.codec import (deserialize_signed_block, deserialize_state,
+                          serialize_signed_block)
 from .store import Store
 
 _LOG = logging.getLogger(__name__)
@@ -38,12 +40,11 @@ class Database:
     # -- writes --------------------------------------------------------
     def save_block(self, signed_block, post_state=None) -> None:
         root = signed_block.message.htr()
-        S = self.spec.schemas
-        self._kv.put(_BLOCK + root, S.SignedBeaconBlock.serialize(
-            signed_block))
+        self._kv.put(_BLOCK + root, serialize_signed_block(signed_block))
         self._kv.put(_HOT + root, b"1")
         if post_state is not None and self.mode == ARCHIVE:
-            self._kv.put(_STATE + root, S.BeaconState.serialize(post_state))
+            self._kv.put(_STATE + root,
+                         type(post_state).serialize(post_state))
 
     def save_anchor(self, anchor_block, anchor_state) -> None:
         """Persist a full (block, state) anchor — genesis or finalized
@@ -53,9 +54,9 @@ class Database:
             anchor_block = S.SignedBeaconBlock(
                 message=anchor_block, signature=b"\x00" * 96)
         root = anchor_block.message.htr()
-        self._kv.put(_BLOCK + root,
-                     S.SignedBeaconBlock.serialize(anchor_block))
-        self._kv.put(_STATE + root, S.BeaconState.serialize(anchor_state))
+        self._kv.put(_BLOCK + root, serialize_signed_block(anchor_block))
+        self._kv.put(_STATE + root,
+                     type(anchor_state).serialize(anchor_state))
         self._kv.put(_META_ANCHOR, root)
 
     def on_finalized(self, checkpoint, state, live_roots) -> None:
@@ -63,9 +64,8 @@ class Database:
         its state, drop pruned forks (PRUNE mode keeps only the
         finalized chain + hot subtree; reference pruners in
         storage/server/pruner/)."""
-        S = self.spec.schemas
         root = checkpoint.root
-        self._kv.put(_STATE + root, S.BeaconState.serialize(state))
+        self._kv.put(_STATE + root, type(state).serialize(state))
         self._kv.put(_META_ANCHOR, root)
         self._kv.put(_META_FIN, checkpoint.epoch.to_bytes(8, "little")
                      + checkpoint.root)
@@ -85,13 +85,13 @@ class Database:
         raw = self._kv.get(_BLOCK + root)
         if raw is None:
             return None
-        return self.spec.schemas.SignedBeaconBlock.deserialize(raw)
+        return deserialize_signed_block(self.spec.config, raw)
 
     def get_state(self, root: bytes):
         raw = self._kv.get(_STATE + root)
         if raw is None:
             return None
-        return self.spec.schemas.BeaconState.deserialize(raw)
+        return deserialize_state(self.spec.config, raw)
 
     def load_anchor(self):
         """(anchor_block_message, anchor_state, hot_blocks) or None —

@@ -149,9 +149,9 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
     # -- submission ----------------------------------------------------
     async def publish_signed_block(self, signed_block) -> None:
         self.node.block_manager.import_block(signed_block)
+        from ..spec.codec import serialize_signed_block
         await self.node.gossip.publish(
-            BEACON_BLOCK_TOPIC,
-            self.spec.schemas.SignedBeaconBlock.serialize(signed_block))
+            BEACON_BLOCK_TOPIC, serialize_signed_block(signed_block))
 
     async def publish_attestation(self, attestation) -> None:
         """Locally-produced attestations run the SAME gossip validation

@@ -76,7 +76,7 @@ class ValidatorClient:
                 # (reference duties log-and-continue via SafeFuture)
                 _LOG.exception("block production failed at slot %d", slot)
                 continue
-            signed = self.spec.schemas.SignedBeaconBlock(
+            signed = self.spec.at_slot(slot).schemas.SignedBeaconBlock(
                 message=block, signature=signature)
             await self.api.publish_signed_block(signed)
             self.blocks_proposed += 1

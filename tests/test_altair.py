@@ -1,0 +1,144 @@
+"""Altair: fork upgrade at the boundary, flag-based finality across the
+fork, real sync-committee signatures, reward accounting."""
+
+import dataclasses
+
+import pytest
+
+from teku_tpu.crypto import bls
+from teku_tpu.crypto.bls.pure_impl import G2_INFINITY
+from teku_tpu.spec import config as C
+from teku_tpu.spec import helpers as H
+from teku_tpu.spec.altair import helpers as AH
+from teku_tpu.spec.altair.datastructures import get_altair_schemas
+from teku_tpu.spec.builder import (build_unsigned_block, make_local_signer,
+                                   produce_attestations, produce_block)
+from teku_tpu.spec.genesis import interop_genesis
+from teku_tpu.spec.milestones import SpecMilestone
+from teku_tpu.spec.transition import (process_slots, state_transition,
+                                      StateTransitionError)
+
+# altair activates at epoch 1 on an otherwise-minimal config
+CFG = dataclasses.replace(C.MINIMAL, ALTAIR_FORK_EPOCH=1)
+N_VALIDATORS = 32
+
+
+@pytest.fixture(scope="module")
+def chain():
+    """A chain driven from phase0 genesis THROUGH the altair fork with
+    full verification, collecting states along the way."""
+    state, sks = interop_genesis(CFG, N_VALIDATORS)
+    signer = make_local_signer(dict(enumerate(sks)))
+    atts = []
+    states = {0: state}
+    cur = state
+    for slot in range(1, 4 * CFG.SLOTS_PER_EPOCH + 1):
+        signed, post = produce_block(CFG, cur, slot, signer,
+                                     attestations=atts)
+        verified = state_transition(CFG, cur, signed,
+                                    validate_result=True)
+        assert verified.htr() == post.htr(), f"divergence at slot {slot}"
+        atts = produce_attestations(CFG, post, slot,
+                                    signed.message.htr(), signer)
+        states[slot] = post
+        cur = post
+    return states, sks
+
+
+def test_upgrade_happens_at_boundary(chain):
+    states, _ = chain
+    S = get_altair_schemas(CFG)
+    pre_fork = states[CFG.SLOTS_PER_EPOCH - 1]
+    post_fork = states[CFG.SLOTS_PER_EPOCH]
+    assert not isinstance(pre_fork, S.BeaconState)
+    assert isinstance(post_fork, S.BeaconState)
+    assert post_fork.fork.current_version == CFG.ALTAIR_FORK_VERSION
+    assert post_fork.fork.previous_version == CFG.GENESIS_FORK_VERSION
+    assert post_fork.fork.epoch == 1
+    # sync committees bootstrapped with valid aggregate keys
+    assert bls.public_key_is_valid(
+        post_fork.current_sync_committee.aggregate_pubkey)
+    assert len(post_fork.current_sync_committee.pubkeys) == \
+        CFG.SYNC_COMMITTEE_SIZE
+
+
+def test_chain_finalizes_across_fork(chain):
+    states, _ = chain
+    tip = states[4 * CFG.SLOTS_PER_EPOCH]
+    assert tip.current_justified_checkpoint.epoch >= 3
+    assert tip.finalized_checkpoint.epoch >= 2
+    # participation flags are being set for current epoch attesters
+    assert any(p != 0 for p in tip.previous_epoch_participation)
+
+
+def test_translated_participation_preserves_justification(chain):
+    """The fork-boundary state translated phase0 pending attestations
+    into flags — justification earned before the fork must not reset."""
+    states, _ = chain
+    boundary = states[CFG.SLOTS_PER_EPOCH]
+    assert sum(1 for p in boundary.previous_epoch_participation
+               if p != 0) > N_VALIDATORS // 2
+
+
+def test_real_sync_aggregate_verifies_and_rewards(chain):
+    states, sks = chain
+    S = get_altair_schemas(CFG)
+    slot = 4 * CFG.SLOTS_PER_EPOCH
+    state = states[slot]
+    pre = process_slots(CFG, state, slot + 1)
+    # every committee member signs the previous block root
+    root = AH.sync_committee_signing_root(CFG, pre, slot + 1)
+    pk_to_sk = {bls.secret_to_public_key(sk): sk for sk in sks}
+    sigs = [bls.sign(pk_to_sk[pk], root)
+            for pk in pre.current_sync_committee.pubkeys]
+    agg = S.SyncAggregate(
+        sync_committee_bits=tuple(True for _ in sigs),
+        sync_committee_signature=bls.aggregate_signatures(sigs))
+    signer = make_local_signer(dict(enumerate(sks)))
+    signed, post = produce_block(CFG, state, slot + 1, signer,
+                                 sync_aggregate=agg)
+    verified = state_transition(CFG, state, signed)
+    assert verified.htr() == post.htr()
+    # participants earned: total balance increased vs the empty-agg path
+    _, post_empty = produce_block(CFG, state, slot + 1, signer)
+    assert sum(post.balances) > sum(post_empty.balances)
+
+
+def test_bad_sync_signature_rejected(chain):
+    states, sks = chain
+    S = get_altair_schemas(CFG)
+    slot = 4 * CFG.SLOTS_PER_EPOCH
+    state = states[slot]
+    signer = make_local_signer(dict(enumerate(sks)))
+    bad_agg = S.SyncAggregate(
+        sync_committee_bits=tuple(
+            i == 0 for i in range(CFG.SYNC_COMMITTEE_SIZE)),
+        sync_committee_signature=bls.sign(sks[0], b"not the block root"))
+    # production trusts its own inputs; the IMPORT path must reject
+    signed, _ = produce_block(CFG, state, slot + 1, signer,
+                              sync_aggregate=bad_agg)
+    with pytest.raises(StateTransitionError):
+        state_transition(CFG, state, signed, validate_result=True)
+
+
+def test_empty_sync_aggregate_requires_infinity_sig(chain):
+    states, sks = chain
+    S = get_altair_schemas(CFG)
+    slot = 4 * CFG.SLOTS_PER_EPOCH
+    state = states[slot]
+    signer = make_local_signer(dict(enumerate(sks)))
+    # default production uses the infinity signature: valid
+    signed, _ = produce_block(CFG, state, slot + 1, signer)
+    assert (signed.message.body.sync_aggregate.sync_committee_signature
+            == G2_INFINITY)
+
+
+def test_milestone_routing_with_altair():
+    from teku_tpu.spec.milestones import build_fork_schedule
+    sched = build_fork_schedule(CFG)
+    assert sched.milestone_at_epoch(0) is SpecMilestone.PHASE0
+    assert sched.milestone_at_epoch(1) is SpecMilestone.ALTAIR
+    assert sched.milestone_at_epoch(99) is SpecMilestone.ALTAIR
+    # unscheduled altair stays phase0 forever
+    sched0 = build_fork_schedule(C.MINIMAL)
+    assert sched0.milestone_at_epoch(10 ** 6) is SpecMilestone.PHASE0
