@@ -68,6 +68,8 @@ class Devnet:
         for client in self.clients:
             await client.on_attestation_due(slot)
         for client in self.clients:
+            await client.on_sync_committee_due(slot)
+        for client in self.clients:
             await client.on_aggregation_due(slot)
 
     async def run_until_slot(self, last_slot: int,

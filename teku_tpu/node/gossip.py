@@ -116,5 +116,6 @@ def attestation_subnet_topic(subnet_id: int) -> str:
 BEACON_BLOCK_TOPIC = "beacon_block"
 AGGREGATE_TOPIC = "beacon_aggregate_and_proof"
 VOLUNTARY_EXIT_TOPIC = "voluntary_exit"
+SYNC_COMMITTEE_TOPIC = "sync_committee"
 PROPOSER_SLASHING_TOPIC = "proposer_slashing"
 ATTESTER_SLASHING_TOPIC = "attester_slashing"

@@ -65,7 +65,9 @@ class BeaconNode(Service):
         self.verifier = ServiceAsyncSignatureVerifier(self.sig_service)
         self.pool = AggregatingAttestationPool(spec)
         from .oppool import make_operation_pools
+        from .syncpool import SyncCommitteeMessagePool
         self.operation_pools = make_operation_pools(spec.config)
+        self.sync_pool = SyncCommitteeMessagePool(spec.config)
         self.attestation_manager = AttestationManager(
             spec, self.chain, pool=self.pool)
         self.block_manager = BlockManager(spec, self.chain, self.channels)
@@ -140,6 +142,50 @@ class BeaconNode(Service):
                  "attester_slashings")):
             self.gossip.subscribe(topic, SszTopicHandler(
                 schema, self._make_op_processor(pool_name), topic))
+        self._subscribe_sync_topic()
+
+    def _subscribe_sync_topic(self) -> None:
+        from .gossip import SYNC_COMMITTEE_TOPIC
+        from ..spec.milestones import build_fork_schedule, SpecMilestone
+        try:
+            version = build_fork_schedule(self.spec.config).version_for(
+                SpecMilestone.ALTAIR)
+        except KeyError:
+            return          # altair not scheduled on this network
+        self.gossip.subscribe(SYNC_COMMITTEE_TOPIC, SszTopicHandler(
+            version.schemas.SyncCommitteeMessage,
+            self._process_sync_message, SYNC_COMMITTEE_TOPIC))
+
+    async def _process_sync_message(self, msg) -> ValidationResult:
+        """Gossiped sync-committee message: membership + signature
+        checked (via the batcher), then pooled for the next proposer
+        (reference SyncCommitteeMessageValidator)."""
+        from ..spec.altair.helpers import sync_message_signing_root
+        state = self.chain.head_state()
+        if not hasattr(state, "current_sync_committee"):
+            return ValidationResult.IGNORE     # pre-fork
+        # only the live slot counts (reference
+        # SyncCommitteeMessageValidator: message slot == current slot);
+        # anything else would let one member spam junk (slot, root)
+        # buckets that evict the live one from the bounded pool
+        cur = self.chain.current_slot()
+        if not (cur - 1 <= msg.slot <= cur):
+            return ValidationResult.IGNORE
+        if msg.validator_index >= len(state.validators):
+            return ValidationResult.REJECT
+        pubkey = state.validators[msg.validator_index].pubkey
+        positions = [i for i, pk in enumerate(
+            state.current_sync_committee.pubkeys) if pk == pubkey]
+        if not positions:
+            return ValidationResult.REJECT     # not in the committee
+        root = sync_message_signing_root(self.spec.config, state,
+                                         msg.slot, msg.beacon_block_root)
+        if not await self.verifier.verify([pubkey], root, msg.signature):
+            return ValidationResult.REJECT
+        for pos in positions:
+            self.sync_pool.add(msg.slot, msg.beacon_block_root, pos,
+                               msg.signature)
+        return ValidationResult.ACCEPT
 
     def _make_op_processor(self, pool_name: str):
         async def process(op) -> ValidationResult:

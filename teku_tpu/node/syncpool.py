@@ -1,0 +1,49 @@
+"""Sync-committee message pool: per-slot signatures → SyncAggregate.
+
+Equivalent of the reference's sync-committee pooling (reference:
+ethereum/statetransition/src/main/java/tech/pegasys/teku/
+statetransition/synccommittee/SyncCommitteeMessagePool.java +
+SyncCommitteeContributionPool.java, reduced to the single-subnet
+shape): committee members' signatures over a slot's block root
+accumulate here; the next slot's proposer drains them into the block's
+SyncAggregate.
+"""
+
+import logging
+from typing import Dict, Optional, Tuple
+
+from ..crypto import bls
+from ..infra.collections import LimitedMap
+
+_LOG = logging.getLogger(__name__)
+
+
+class SyncCommitteeMessagePool:
+    def __init__(self, cfg):
+        self.cfg = cfg
+        # (slot, block_root) -> {committee_position: signature}
+        self._msgs: LimitedMap = LimitedMap(64)
+
+    def add(self, slot: int, block_root: bytes, committee_position: int,
+            signature: bytes) -> None:
+        key = (slot, block_root)
+        bucket = self._msgs.get(key)
+        if bucket is None:
+            bucket = {}
+            self._msgs.put(key, bucket)
+        bucket.setdefault(committee_position, signature)
+
+    def build_aggregate(self, slot: int, block_root: bytes, schemas):
+        """SyncAggregate over collected messages for (slot, root);
+        empty participation carries the infinity signature."""
+        bucket = self._msgs.get((slot, block_root)) or {}
+        size = self.cfg.SYNC_COMMITTEE_SIZE
+        bits = tuple(i in bucket for i in range(size))
+        if not bucket:
+            from ..crypto.bls.pure_impl import G2_INFINITY
+            sig = G2_INFINITY
+        else:
+            sig = bls.aggregate_signatures(
+                [bucket[i] for i in sorted(bucket)])
+        return schemas.SyncAggregate(sync_committee_bits=bits,
+                                     sync_committee_signature=sig)

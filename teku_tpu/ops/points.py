@@ -272,6 +272,23 @@ def scalar_mul_static(k: FieldKit, e: int, p):
     if n:
         runs.append((n, False))
 
+    if len(runs) > 16:
+        # DENSE exponent: the runs decomposition would inline ~one
+        # point_add per one-bit, building a graph big enough to crash
+        # XLA's compiler (observed: CPU backend segfault, TPU compile
+        # blowup).  One masked-add scan keeps the program tiny; the
+        # static-unroll fast path stays for the sparse exponents it was
+        # built for (the BLS parameter, Hamming weight 6).
+        nbits = len(bits) + 1
+        bit_arr = jnp.asarray([int(c) for c in bin(e)[2:]],
+                              dtype=jnp.int64)
+        leaf = p[0]                     # G2 coords are (c0, c1) tuples
+        while isinstance(leaf, tuple):
+            leaf = leaf[0]
+        lane_shape = leaf.shape[:-1]    # broadcast bits over the batch
+        bit_arr = jnp.broadcast_to(bit_arr, lane_shape + (nbits,))
+        return scalar_mul_bits(k, bit_arr, p)
+
     def dbl_body(acc, _):
         return point_double(k, acc), None
 

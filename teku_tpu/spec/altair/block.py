@@ -5,14 +5,11 @@ BlockProcessorAltair.java (processAttestation flag accounting,
 processSyncAggregate with the proposer/participant reward split).
 """
 
-from typing import List
-
 from ...crypto import bls
 from .. import block as B0
 from .. import helpers as H
-from ..config import (DOMAIN_SYNC_COMMITTEE, PARTICIPATION_FLAG_WEIGHTS,
-                      PROPOSER_WEIGHT, SpecConfig, SYNC_REWARD_WEIGHT,
-                      TIMELY_TARGET_FLAG_INDEX, WEIGHT_DENOMINATOR)
+from ..config import (PARTICIPATION_FLAG_WEIGHTS, PROPOSER_WEIGHT,
+                      SpecConfig, SYNC_REWARD_WEIGHT, WEIGHT_DENOMINATOR)
 from ..verifiers import SignatureVerifier, SIMPLE
 from . import helpers as AH
 
@@ -101,11 +98,7 @@ def process_sync_aggregate(cfg: SpecConfig, state, sync_aggregate,
     bits = sync_aggregate.sync_committee_bits
     participant_pubkeys = [pk for pk, b in zip(committee_pubkeys, bits)
                            if b]
-    previous_slot = max(state.slot, 1) - 1
-    domain = H.get_domain(cfg, state, DOMAIN_SYNC_COMMITTEE,
-                          H.compute_epoch_at_slot(cfg, previous_slot))
-    signing_root = H.compute_signing_root(
-        H.get_block_root_at_slot(cfg, state, previous_slot), domain)
+    signing_root = AH.sync_committee_signing_root(cfg, state, state.slot)
     if participant_pubkeys:
         _require(verifier.verify(participant_pubkeys, signing_root,
                                  sync_aggregate.sync_committee_signature),

@@ -6,13 +6,12 @@ versions/altair/BeaconStateAltair.java + blocks/versions/altair/.
 
 from functools import lru_cache
 
-from ...ssz import (Bitlist, Bitvector, boolean, Bytes4, Bytes32, Bytes48,
-                    Bytes96, Container, List, uint8, uint64, Vector)
+from ...ssz import (Bitvector, Bytes4, Bytes32, Bytes48, Bytes96,
+                    Container, List, uint8, uint64, Vector)
 from ...ssz.types import _ContainerMeta
 from ..config import SpecConfig
-from ..datastructures import (AttestationData, BeaconBlockHeader,
-                              Checkpoint, Eth1Data, Fork, get_schemas,
-                              Validator)
+from ..datastructures import (BeaconBlockHeader, Checkpoint, Eth1Data,
+                              Fork, get_schemas, Validator)
 
 
 def _container(name, fields):
@@ -42,6 +41,12 @@ class AltairSchemas:
         self.SyncAggregate = _container("SyncAggregate", [
             ("sync_committee_bits", Bitvector(cfg.SYNC_COMMITTEE_SIZE)),
             ("sync_committee_signature", Bytes96),
+        ])
+        self.SyncCommitteeMessage = _container("SyncCommitteeMessage", [
+            ("slot", uint64),
+            ("beacon_block_root", Bytes32),
+            ("validator_index", uint64),
+            ("signature", Bytes96),
         ])
         self.BeaconBlockBody = _container("BeaconBlockBodyAltair", [
             ("randao_reveal", Bytes96),

@@ -8,8 +8,7 @@ epoch/EpochProcessorAltair.java — math follows the public altair spec.
 
 from ..config import (GENESIS_EPOCH, PARTICIPATION_FLAG_WEIGHTS,
                       SpecConfig, TIMELY_HEAD_FLAG_INDEX,
-                      TIMELY_SOURCE_FLAG_INDEX, TIMELY_TARGET_FLAG_INDEX,
-                      WEIGHT_DENOMINATOR)
+                      TIMELY_TARGET_FLAG_INDEX, WEIGHT_DENOMINATOR)
 from .. import epoch as E0
 from .. import helpers as H
 from . import helpers as AH

@@ -7,7 +7,6 @@ attestations into participation flags, and bootstrap both sync
 committees.
 """
 
-from ...ssz import Container
 from .. import helpers as H
 from ..config import SpecConfig
 from ..datastructures import Fork

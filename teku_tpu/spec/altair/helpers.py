@@ -6,16 +6,13 @@ BeaconStateAccessorsAltair.java, MiscHelpersAltair.java and util/
 SyncCommitteeUtil.java — the math follows the public altair spec.
 """
 
-from typing import List, Sequence, Set
+from typing import List, Set
 
 from ...crypto import bls
 from .. import helpers as H
-from ..config import (DOMAIN_SYNC_COMMITTEE, PARTICIPATION_FLAG_WEIGHTS,
-                      SpecConfig, TIMELY_HEAD_FLAG_INDEX,
-                      TIMELY_SOURCE_FLAG_INDEX, TIMELY_TARGET_FLAG_INDEX)
-
-BASE_REWARD_FACTOR_DIVISOR = None   # altair uses per-increment rewards
-
+from ..config import (DOMAIN_SYNC_COMMITTEE, SpecConfig,
+                      TIMELY_HEAD_FLAG_INDEX, TIMELY_SOURCE_FLAG_INDEX,
+                      TIMELY_TARGET_FLAG_INDEX)
 
 def add_flag(flags: int, index: int) -> int:
     return flags | (1 << index)
@@ -112,11 +109,19 @@ def get_next_sync_committee(cfg: SpecConfig, state):
         aggregate_pubkey=bls.eth_aggregate_pubkeys(pubkeys))
 
 
-def sync_committee_signing_root(cfg: SpecConfig, state, slot: int) -> bytes:
-    """The message sync-committee members sign: the previous slot's
-    block root under DOMAIN_SYNC_COMMITTEE."""
+def sync_message_signing_root(cfg: SpecConfig, state, slot: int,
+                              block_root: bytes) -> bytes:
+    """THE sync-message signing root — one definition shared by the
+    signer, the gossip validator and sync-aggregate verification so
+    they can never drift apart."""
     domain = H.get_domain(cfg, state, DOMAIN_SYNC_COMMITTEE,
-                          H.compute_epoch_at_slot(
-                              cfg, max(slot, 1) - 1))
-    root = H.get_block_root_at_slot(cfg, state, max(slot, 1) - 1)
-    return H.compute_signing_root(root, domain)
+                          H.compute_epoch_at_slot(cfg, slot))
+    return H.compute_signing_root(block_root, domain)
+
+
+def sync_committee_signing_root(cfg: SpecConfig, state, slot: int) -> bytes:
+    """Signing root for the previous slot's block root (the aggregate
+    included at `slot`)."""
+    prev = max(slot, 1) - 1
+    return sync_message_signing_root(
+        cfg, state, prev, H.get_block_root_at_slot(cfg, state, prev))

@@ -41,6 +41,8 @@ class SpecVersion:
     # logic entry points (phase0 signatures; later forks override)
     process_block: Callable
     process_epoch: Callable
+    # justification/finalization alone (fork choice pulls up tips)
+    process_justification: Optional[Callable] = None
     upgrade_state: Optional[Callable] = None   # previous-fork state -> ours
 
 
@@ -105,7 +107,8 @@ def phase0_version(cfg: SpecConfig) -> SpecVersion:
         fork_epoch=0,
         schemas=get_schemas(cfg),
         process_block=B.process_block,
-        process_epoch=E.process_epoch)
+        process_epoch=E.process_epoch,
+        process_justification=E.process_justification_and_finalization)
 
 
 def altair_version(cfg: SpecConfig) -> SpecVersion:
@@ -121,6 +124,7 @@ def altair_version(cfg: SpecConfig) -> SpecVersion:
         schemas=get_altair_schemas(cfg),
         process_block=AB.process_block,
         process_epoch=AE.process_epoch,
+        process_justification=AE.process_justification_and_finalization,
         upgrade_state=lambda state: upgrade_to_altair(cfg, state))
 
 

@@ -49,8 +49,10 @@ class Database:
     def save_anchor(self, anchor_block, anchor_state) -> None:
         """Persist a full (block, state) anchor — genesis or finalized
         checkpoint (the restart/checkpoint-sync entry point)."""
-        S = self.spec.schemas
         if not hasattr(anchor_block, "message"):   # bare BeaconBlock
+            from ..spec.milestones import build_fork_schedule
+            S = build_fork_schedule(self.spec.config).version_at_slot(
+                anchor_block.slot).schemas
             anchor_block = S.SignedBeaconBlock(
                 message=anchor_block, signature=b"\x00" * 96)
         root = anchor_block.message.htr()

@@ -173,8 +173,9 @@ class Store:
         # state to expose justification the chain has earned but not yet
         # processed (modern spec compute_pulled_up_tip; the reference's
         # protoarray stores the same per-node "unrealized" checkpoints)
-        unrealized = E.process_justification_and_finalization(
-            self.cfg, post)
+        from ..spec.milestones import build_fork_schedule
+        unrealized = build_fork_schedule(self.cfg).version_at_slot(
+            post.slot).process_justification(self.cfg, post)
         uj = unrealized.current_justified_checkpoint
         uf = unrealized.finalized_checkpoint
         self.unrealized_justifications[root] = uj

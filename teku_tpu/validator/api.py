@@ -71,6 +71,9 @@ class ValidatorApiChannel:
         """Head state advanced to `slot` (signing context)."""
         raise NotImplementedError
 
+    def head_root(self) -> bytes:
+        raise NotImplementedError
+
 
 class BeaconNodeValidatorApi(ValidatorApiChannel):
     """In-process binding to one BeaconNode."""
@@ -120,6 +123,9 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
     def duty_state(self, slot: int):
         return self.node.advanced_head_state(slot)
 
+    def head_root(self) -> bytes:
+        return self.node.chain.head_root
+
     def get_attestation_data(self, slot: int, committee_index: int):
         state = self.node.advanced_head_state(slot)
         return attestation_data_for(self.spec.config, state, slot,
@@ -135,6 +141,17 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
         atts = self.node.pool.get_attestations_for_block(
             pre, cfg.MAX_ATTESTATIONS)
         pools = self.node.operation_pools
+        sync_aggregate = None
+        if hasattr(pre, "current_sync_committee"):
+            # drain the sync pool: messages signed the PREVIOUS slot's
+            # head root (reference SyncCommitteeContributionPool →
+            # block production)
+            from ..spec.milestones import build_fork_schedule
+            version = build_fork_schedule(cfg).version_at_slot(slot)
+            prev_root = H.get_block_root_at_slot(cfg, pre,
+                                                 max(slot, 1) - 1)
+            sync_aggregate = self.node.sync_pool.build_aggregate(
+                max(slot, 1) - 1, prev_root, version.schemas)
         block, _post = build_unsigned_block(
             cfg, pre, slot, randao_reveal, attestations=atts,
             proposer_slashings=pools["proposer_slashings"].get_for_block(
@@ -143,7 +160,7 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
                 cfg.MAX_ATTESTER_SLASHINGS, pre),
             voluntary_exits=pools["voluntary_exits"].get_for_block(
                 cfg.MAX_VOLUNTARY_EXITS, pre),
-            graffiti=graffiti)
+            graffiti=graffiti, sync_aggregate=sync_aggregate)
         return block, pre
 
     # -- submission ----------------------------------------------------
@@ -183,6 +200,17 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
 
     def get_aggregate(self, data):
         return self.node.pool.get_aggregate(data)
+
+    async def publish_sync_committee_message(self, msg) -> None:
+        """Own sync message: same validation as gossip, then pool +
+        broadcast (reference SyncCommitteeMessageValidator feed)."""
+        from ..node.gossip import SYNC_COMMITTEE_TOPIC, ValidationResult
+        result = await self.node._process_sync_message(msg)
+        if result is not ValidationResult.ACCEPT:
+            _LOG.warning("own sync message failed validation: %s", result)
+            return
+        await self.node.gossip.publish(
+            SYNC_COMMITTEE_TOPIC, type(msg).serialize(msg))
 
     async def publish_aggregate_and_proof(self, signed_aggregate) -> None:
         from ..node.gossip import ValidationResult

@@ -109,6 +109,38 @@ class ValidatorClient:
             await self.api.publish_attestation(att)
             self.attestations_sent += 1
 
+    async def on_sync_committee_due(self, slot: int) -> None:
+        """Altair sync-committee duty: members sign the head root at
+        the current slot (reference: validator/client/duties/
+        synccommittee/SyncCommitteeProductionDuty)."""
+        cfg = self.spec.config
+        state = self.api.duty_state(slot)
+        if not hasattr(state, "current_sync_committee"):
+            return          # pre-altair
+        pk_to_index = {}
+        mine = set(self.indices)
+        for i in mine:
+            pk_to_index[state.validators[i].pubkey] = i
+        members = {pk_to_index[pk]
+                   for pk in state.current_sync_committee.pubkeys
+                   if pk in pk_to_index}
+        if not members:
+            return
+        # sign the CURRENT head (the slot's block): it is included by
+        # the next proposer as previous-slot root
+        head_root = self.api.head_root()
+        version = self.spec.at_slot(slot)
+        for vi in members:
+            try:
+                sig = self.signer.sign_sync_committee_message(
+                    cfg, state, slot, head_root, vi)
+            except SigningError:
+                continue
+            msg = version.schemas.SyncCommitteeMessage(
+                slot=slot, beacon_block_root=head_root,
+                validator_index=vi, signature=sig)
+            await self.api.publish_sync_committee_message(msg)
+
     async def on_aggregation_due(self, slot: int) -> None:
         cfg = self.spec.config
         epoch = H.compute_epoch_at_slot(cfg, slot)

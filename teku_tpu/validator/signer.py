@@ -42,6 +42,11 @@ class DutySigner:
                              validator_index: int) -> bytes:
         raise NotImplementedError
 
+    def sign_sync_committee_message(self, cfg: SpecConfig, state,
+                                    slot: int, block_root: bytes,
+                                    validator_index: int) -> bytes:
+        raise NotImplementedError
+
 
 class LocalSigner(DutySigner):
     def __init__(self, secret_keys_by_index: Dict[int, int],
@@ -86,6 +91,12 @@ class LocalSigner(DutySigner):
         return self._sign(validator_index,
                           H.selection_proof_signing_root(cfg, state, slot))
 
+    def sign_sync_committee_message(self, cfg, state, slot, block_root,
+                                    validator_index) -> bytes:
+        from ..spec.altair.helpers import sync_message_signing_root
+        return self._sign(validator_index, sync_message_signing_root(
+            cfg, state, slot, block_root))
+
 
 class SlashingProtectedSigner(DutySigner):
     """Wraps a signer; block + attestation signatures consult the
@@ -128,3 +139,9 @@ class SlashingProtectedSigner(DutySigner):
     def sign_selection_proof(self, cfg, state, slot, validator_index):
         return self.inner.sign_selection_proof(cfg, state, slot,
                                                validator_index)
+
+    def sign_sync_committee_message(self, cfg, state, slot, block_root,
+                                    validator_index):
+        # sync messages carry no slashing risk
+        return self.inner.sign_sync_committee_message(
+            cfg, state, slot, block_root, validator_index)
