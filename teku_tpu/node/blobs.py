@@ -1,0 +1,109 @@
+"""Blob sidecars: containers, tracking pool, availability checking.
+
+Equivalent of the reference's blob plumbing (reference: ethereum/
+statetransition/src/main/java/tech/pegasys/teku/statetransition/blobs/
+BlockBlobSidecarsTrackersPool.java + BlobSidecarManager, and the
+fork-choice availability gate ForkChoiceBlobSidecarsAvailability
+Checker invoked from ForkChoice.onBlock): sidecars gossip per index,
+collect per block root, and a block is importable only when every
+commitment it carries has an availability-checked sidecar (KZG proof
+verified on this repo's pairing base).
+
+The deneb state/body containers land with the deneb milestone; this
+module is the milestone-independent substrate (the reference splits it
+the same way — statetransition/blobs has no fork dependency).
+"""
+
+import logging
+from typing import Dict, List, Optional, Sequence
+
+from ..crypto import kzg
+from ..infra.collections import LimitedMap
+from ..ssz import ByteList, Bytes32, Bytes48, Container, uint64
+from ..ssz.types import _ContainerMeta
+
+_LOG = logging.getLogger(__name__)
+
+MAX_BLOBS_PER_BLOCK = 6
+
+BlobSidecar = _ContainerMeta("BlobSidecar", (Container,), {
+    "__annotations__": {
+        "index": uint64,
+        "blob": ByteList(kzg.BYTES_PER_BLOB),
+        "kzg_commitment": Bytes48,
+        "kzg_proof": Bytes48,
+        "block_root": Bytes32,
+        "slot": uint64,
+    }})
+
+
+class AvailabilityResult:
+    AVAILABLE = "available"
+    PENDING = "pending"          # sidecars still missing
+    INVALID = "invalid"          # a proof failed — block unimportable
+
+
+class BlobSidecarPool:
+    """Per-block sidecar trackers (reference
+    BlockBlobSidecarsTrackersPool): sidecars arrive out of order from
+    gossip/RPC; the availability check runs once all indices are in."""
+
+    def __init__(self, setup: Optional[kzg.TrustedSetup] = None,
+                 max_blocks: int = 64):
+        self._by_block: LimitedMap = LimitedMap(max_blocks)
+        self._setup = setup
+        self._verified: LimitedMap = LimitedMap(256)
+
+    def add_sidecar(self, sidecar: BlobSidecar) -> bool:
+        """Track one gossiped sidecar (malformed ones are dropped)."""
+        if sidecar.index >= MAX_BLOBS_PER_BLOCK:
+            return False
+        if len(sidecar.blob) != kzg.BYTES_PER_BLOB:
+            return False
+        bucket = self._by_block.get(sidecar.block_root)
+        if bucket is None:
+            bucket = {}
+            self._by_block.put(sidecar.block_root, bucket)
+        if sidecar.index in bucket:
+            return False
+        bucket[sidecar.index] = sidecar
+        return True
+
+    def sidecars_for(self, block_root: bytes) -> List[BlobSidecar]:
+        bucket = self._by_block.get(block_root) or {}
+        return [bucket[i] for i in sorted(bucket)]
+
+    # -- the fork-choice gate -----------------------------------------
+    def check_availability(self, block_root: bytes,
+                           expected_commitments: Sequence[bytes]) -> str:
+        """reference ForkChoiceBlobSidecarsAvailabilityChecker: every
+        commitment needs a sidecar whose KZG proof verifies."""
+        if not expected_commitments:
+            return AvailabilityResult.AVAILABLE
+        cache_key = (block_root, bytes().join(expected_commitments))
+        cached = self._verified.get(cache_key)
+        if cached is not None:
+            return cached
+        bucket = self._by_block.get(block_root) or {}
+        if len(bucket) < len(expected_commitments):
+            return AvailabilityResult.PENDING
+        blobs, commitments, proofs = [], [], []
+        for i, commitment in enumerate(expected_commitments):
+            sidecar = bucket.get(i)
+            if sidecar is None:
+                return AvailabilityResult.PENDING
+            if sidecar.kzg_commitment != commitment:
+                self._verified.put(cache_key, AvailabilityResult.INVALID)
+                return AvailabilityResult.INVALID
+            blobs.append(bytes(sidecar.blob))
+            commitments.append(sidecar.kzg_commitment)
+            proofs.append(sidecar.kzg_proof)
+        ok = kzg.verify_blob_kzg_proof_batch(blobs, commitments, proofs,
+                                             self._setup)
+        result = (AvailabilityResult.AVAILABLE if ok
+                  else AvailabilityResult.INVALID)
+        self._verified.put(cache_key, result)
+        return result
+
+    def prune_block(self, block_root: bytes) -> None:
+        self._by_block._items.pop(block_root, None)

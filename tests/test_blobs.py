@@ -1,0 +1,78 @@
+"""Blob sidecar pool + KZG availability gate."""
+
+import random
+
+import pytest
+
+from teku_tpu.crypto import kzg
+from teku_tpu.node.blobs import (AvailabilityResult, BlobSidecar,
+                                 BlobSidecarPool, MAX_BLOBS_PER_BLOCK)
+
+SETUP = kzg.insecure_setup()
+
+
+def _blob(seed):
+    rng = random.Random(seed)
+    return b"".join(rng.randrange(kzg.R).to_bytes(32, "big")
+                    for _ in range(kzg.FIELD_ELEMENTS_PER_BLOB))
+
+
+def _sidecar(block_root, index, seed, tamper=False):
+    blob = _blob(seed)
+    commitment = kzg.blob_to_kzg_commitment(blob, SETUP)
+    proof = kzg.compute_blob_kzg_proof(blob, commitment, SETUP)
+    if tamper:
+        proof = b"\xc0" + proof[1:]
+    return BlobSidecar(index=index, blob=blob, kzg_commitment=commitment,
+                       kzg_proof=proof, block_root=block_root,
+                       slot=7), commitment
+
+
+def test_collect_and_availability():
+    pool = BlobSidecarPool(SETUP)
+    root = b"\x01" * 32
+    s0, c0 = _sidecar(root, 0, 1)
+    s1, c1 = _sidecar(root, 1, 2)
+    assert pool.check_availability(root, [c0, c1]) == \
+        AvailabilityResult.PENDING
+    assert pool.add_sidecar(s0)
+    assert not pool.add_sidecar(s0)                 # dedupe per index
+    assert pool.check_availability(root, [c0, c1]) == \
+        AvailabilityResult.PENDING                   # one still missing
+    assert pool.add_sidecar(s1)
+    assert pool.check_availability(root, [c0, c1]) == \
+        AvailabilityResult.AVAILABLE
+    assert [s.index for s in pool.sidecars_for(root)] == [0, 1]
+    # no commitments == trivially available (pre-deneb blocks)
+    assert pool.check_availability(b"\x09" * 32, []) == \
+        AvailabilityResult.AVAILABLE
+
+
+def test_bad_proof_is_invalid_not_pending():
+    pool = BlobSidecarPool(SETUP)
+    root = b"\x02" * 32
+    s0, c0 = _sidecar(root, 0, 3, tamper=True)
+    pool.add_sidecar(s0)
+    assert pool.check_availability(root, [c0]) == \
+        AvailabilityResult.INVALID
+    # verdict is cached
+    assert pool.check_availability(root, [c0]) == \
+        AvailabilityResult.INVALID
+
+
+def test_commitment_mismatch_invalid():
+    pool = BlobSidecarPool(SETUP)
+    root = b"\x03" * 32
+    s0, _ = _sidecar(root, 0, 4)
+    pool.add_sidecar(s0)
+    other_commitment = b"\xc0" + b"\x00" * 47
+    assert pool.check_availability(root, [other_commitment]) == \
+        AvailabilityResult.INVALID
+
+
+def test_malformed_sidecars_rejected():
+    pool = BlobSidecarPool(SETUP)
+    root = b"\x04" * 32
+    s, _ = _sidecar(root, 0, 5)
+    assert not pool.add_sidecar(s.copy_with(index=MAX_BLOBS_PER_BLOCK))
+    assert not pool.add_sidecar(s.copy_with(blob=b"\x00" * 100))
