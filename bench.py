@@ -109,7 +109,7 @@ def _throughput_phase(jax, deadline, batches):
     import __graft_entry__ as ge
     from teku_tpu.ops import verify as V
 
-    kernel = jax.jit(V.verify_kernel)
+    kernel = V.verify_staged     # five bounded compiles, not one monolith
     detail = {}
     best = 0.0
     best_batch = None
@@ -124,12 +124,17 @@ def _throughput_phase(jax, deadline, batches):
             continue
         try:
             args = ge._example_batch(n)
+            stage_s = {}
             t0 = time.time()
-            ok, lane_ok = kernel(*args)
+            ok, lane_ok = kernel(
+                *args,
+                on_stage=lambda nm, s: stage_s.__setitem__(
+                    nm, round(s, 1)))
             ok = bool(np.asarray(ok))
             compile_s = time.time() - t0
             compiled_once = True
-            entry = {"compile_s": round(compile_s, 1)}
+            entry = {"compile_s": round(compile_s, 1),
+                     "stage_s": stage_s}
             detail[str(n)] = entry
             if not (ok and np.asarray(lane_ok).all()):
                 entry["error"] = "batch did not verify"

@@ -61,6 +61,9 @@ class LimitedMap(Generic[K, V]):
     def __contains__(self, key: K) -> bool:
         return key in self._items
 
+    def pop(self, key: K, default: Optional[V] = None) -> Optional[V]:
+        return self._items.pop(key, default)
+
     def __len__(self) -> int:
         return len(self._items)
 

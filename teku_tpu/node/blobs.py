@@ -55,7 +55,12 @@ class BlobSidecarPool:
         self._verified: LimitedMap = LimitedMap(256)
 
     def add_sidecar(self, sidecar: BlobSidecar) -> bool:
-        """Track one gossiped sidecar (malformed ones are dropped)."""
+        """Track one gossiped sidecar.  The sidecar's OWN proof is
+        verified at the door and the bucket is keyed by
+        (index, commitment): a junk sidecar can neither occupy an index
+        (proof fails → dropped) nor shadow the honest one for the same
+        index (different commitment → separate slot) — first-wins dedup
+        on bare indices would let one bad message brick the block."""
         if sidecar.index >= MAX_BLOBS_PER_BLOCK:
             return False
         if len(sidecar.blob) != kzg.BYTES_PER_BLOB:
@@ -64,20 +69,26 @@ class BlobSidecarPool:
         if bucket is None:
             bucket = {}
             self._by_block.put(sidecar.block_root, bucket)
-        if sidecar.index in bucket:
+        key = (sidecar.index, sidecar.kzg_commitment)
+        if key in bucket:
             return False
-        bucket[sidecar.index] = sidecar
+        if not kzg.verify_blob_kzg_proof(
+                bytes(sidecar.blob), sidecar.kzg_commitment,
+                sidecar.kzg_proof, self._setup):
+            return False
+        bucket[key] = sidecar
         return True
 
     def sidecars_for(self, block_root: bytes) -> List[BlobSidecar]:
         bucket = self._by_block.get(block_root) or {}
-        return [bucket[i] for i in sorted(bucket)]
+        return [bucket[k] for k in sorted(bucket)]
 
     # -- the fork-choice gate -----------------------------------------
     def check_availability(self, block_root: bytes,
                            expected_commitments: Sequence[bytes]) -> str:
         """reference ForkChoiceBlobSidecarsAvailabilityChecker: every
-        commitment needs a sidecar whose KZG proof verifies."""
+        block commitment needs a proof-verified sidecar (verification
+        happened at add time; here we only match commitments)."""
         if not expected_commitments:
             return AvailabilityResult.AVAILABLE
         cache_key = (block_root, bytes().join(expected_commitments))
@@ -85,25 +96,13 @@ class BlobSidecarPool:
         if cached is not None:
             return cached
         bucket = self._by_block.get(block_root) or {}
-        if len(bucket) < len(expected_commitments):
-            return AvailabilityResult.PENDING
-        blobs, commitments, proofs = [], [], []
         for i, commitment in enumerate(expected_commitments):
-            sidecar = bucket.get(i)
-            if sidecar is None:
+            if (i, commitment) not in bucket:
                 return AvailabilityResult.PENDING
-            if sidecar.kzg_commitment != commitment:
-                self._verified.put(cache_key, AvailabilityResult.INVALID)
-                return AvailabilityResult.INVALID
-            blobs.append(bytes(sidecar.blob))
-            commitments.append(sidecar.kzg_commitment)
-            proofs.append(sidecar.kzg_proof)
-        ok = kzg.verify_blob_kzg_proof_batch(blobs, commitments, proofs,
-                                             self._setup)
-        result = (AvailabilityResult.AVAILABLE if ok
-                  else AvailabilityResult.INVALID)
-        self._verified.put(cache_key, result)
-        return result
+        self._verified.put(cache_key, AvailabilityResult.AVAILABLE)
+        return AvailabilityResult.AVAILABLE
 
     def prune_block(self, block_root: bytes) -> None:
-        self._by_block._items.pop(block_root, None)
+        self._by_block.pop(block_root)
+        for key in [k for k in self._verified if k[0] == block_root]:
+            self._verified.pop(key)

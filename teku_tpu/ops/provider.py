@@ -135,7 +135,9 @@ class JaxBls12381(BLS12381):
         # pk bytes -> ("ok", x_mont (L,), y_mont (L,)) | ("bad",)
         self._pk_cache: dict = {}
         self._u_cache: dict = {}
-        self._verify_jit = jax.jit(V.verify_kernel)
+        # staged dispatch: five small programs instead of one monolith
+        # whose TPU compile is unbounded (ops/verify.py staged_jits)
+        self._verify_jit = V.verify_staged
         self._pk_validate_jit = jax.jit(self._pk_validate_kernel)
 
     # ------------------------------------------------------------------

@@ -93,30 +93,19 @@ def _aggregate_lane_pks(pk_xs, pk_ys, pk_present):
 def _lane_work(pk_xs, pk_ys, pk_present, u0, u1, sig_x_plain, sig_large,
                sig_inf, r_bits, lane_valid):
     """Per-lane pipeline (shardable over the batch axis with no
-    communication): per-lane pubkey aggregation, signature parse +
-    subgroup check, hash-to-G2, random-multiplier scalar muls, per-lane
-    Miller loop.
+    communication), COMPOSED from the stage functions below so the
+    monolithic/sharded kernels and the staged dispatch can never
+    diverge.
 
     Returns (ml (N-lane Fq12 values), wsig (N weighted sig points),
     lane_ok (N,))."""
-    pk_jac, pk_inf = _aggregate_lane_pks(pk_xs, pk_ys, pk_present)
-
-    dec_ok, sig_pt = PT.g2_recover_y(sig_x_plain, sig_large)
-    in_sub = PT.g2_in_subgroup(sig_pt)
-    sig_ok = (dec_ok & in_sub) | sig_inf
-    use_inf = sig_inf | ~sig_ok | ~lane_valid
-    sig_jac = PT._select_point(
-        PT.G2_KIT, use_inf, PT.infinity_like(PT.G2_KIT, sig_pt[0]), sig_pt)
-
-    hm = h2c.hash_to_g2_device(u0, u1)
-    hm_aff = h2c.to_affine_g2(hm)
-
-    pk_r = PT.scalar_mul_bits(PT.G1_KIT, r_bits, pk_jac)
-    pk_r_aff = to_affine_g1(pk_r)
-    wsig = PT.scalar_mul_bits(PT.G2_KIT, r_bits, sig_jac)
-
-    ml = PR.miller_loop(pk_r_aff, hm_aff, mask=lane_valid & ~pk_inf)
-    return ml, wsig, sig_ok & ~pk_inf
+    pk_jac, sig_jac, lane_ok, miller_mask = stage_prepare(
+        pk_xs, pk_ys, pk_present, sig_x_plain, sig_large, sig_inf,
+        lane_valid)
+    hm_aff = stage_h2c(u0, u1)
+    pk_r_aff, wsig = stage_scalars(pk_jac, sig_jac, r_bits)
+    ml = stage_miller(pk_r_aff, hm_aff, miller_mask)
+    return ml, wsig, lane_ok
 
 
 def _finish(ml_prod, s_sum):
@@ -154,6 +143,95 @@ def verify_kernel(pk_xs, pk_ys, pk_present, u0, u1, sig_x_plain,
                                    sig_x_plain, sig_large, sig_inf,
                                    r_bits, lane_valid)
     ok = _finish(PR.batch_product(ml), point_batch_sum(PT.G2_KIT, wsig))
+    return ok, lane_ok
+
+
+# --------------------------------------------------------------------------
+# Staged variant: the SAME math as verify_kernel, split into five
+# separately-jitted programs.  The monolithic kernel's TPU-XLA compile is
+# unbounded in practice (>60 min observed on v5e); each stage compiles in
+# minutes, caches independently in the persistent compile cache, and the
+# chain keeps all intermediates on device.
+# --------------------------------------------------------------------------
+
+def stage_prepare(pk_xs, pk_ys, pk_present, sig_x_plain, sig_large,
+                  sig_inf, lane_valid):
+    """Key aggregation + signature decompression/subgroup checks."""
+    pk_jac, pk_inf = _aggregate_lane_pks(pk_xs, pk_ys, pk_present)
+    dec_ok, sig_pt = PT.g2_recover_y(sig_x_plain, sig_large)
+    in_sub = PT.g2_in_subgroup(sig_pt)
+    sig_ok = (dec_ok & in_sub) | sig_inf
+    use_inf = sig_inf | ~sig_ok | ~lane_valid
+    sig_jac = PT._select_point(
+        PT.G2_KIT, use_inf, PT.infinity_like(PT.G2_KIT, sig_pt[0]), sig_pt)
+    return pk_jac, sig_jac, sig_ok & ~pk_inf, lane_valid & ~pk_inf
+
+
+def stage_h2c(u0, u1):
+    """Hash-to-G2 map + cofactor clearing + batched affine."""
+    return h2c.to_affine_g2(h2c.hash_to_g2_device(u0, u1))
+
+
+def stage_scalars(pk_jac, sig_jac, r_bits):
+    """Random-multiplier scalar muls + batched G1 affine."""
+    pk_r_aff = to_affine_g1(PT.scalar_mul_bits(PT.G1_KIT, r_bits, pk_jac))
+    wsig = PT.scalar_mul_bits(PT.G2_KIT, r_bits, sig_jac)
+    return pk_r_aff, wsig
+
+
+def stage_miller(pk_r_aff, hm_aff, mask):
+    """Per-lane Miller loops."""
+    return PR.miller_loop(pk_r_aff, hm_aff, mask=mask)
+
+
+def stage_finish(ml, wsig):
+    """Cross-lane reduction + final exponentiation + verdict."""
+    return _finish(PR.batch_product(ml), point_batch_sum(PT.G2_KIT, wsig))
+
+
+_STAGED_JITS = None
+_STAGED_LOCK = __import__("threading").Lock()
+
+
+def staged_jits():
+    global _STAGED_JITS
+    if _STAGED_JITS is None:
+        with _STAGED_LOCK:      # batch_verify runs via asyncio.to_thread
+            if _STAGED_JITS is None:
+                _STAGED_JITS = {
+                    "prepare": jax.jit(stage_prepare),
+                    "h2c": jax.jit(stage_h2c),
+                    "scalars": jax.jit(stage_scalars),
+                    "miller": jax.jit(stage_miller),
+                    "finish": jax.jit(stage_finish),
+                }
+    return _STAGED_JITS
+
+
+def verify_staged(pk_xs, pk_ys, pk_present, u0, u1, sig_x_plain,
+                  sig_large, sig_inf, r_bits, lane_valid,
+                  on_stage=None):
+    """Same contract as verify_kernel, via the five staged programs.
+    `on_stage(name, seconds)` reports per-stage wall time (bench)."""
+    import time
+    jits = staged_jits()
+
+    def run(name, fn, *args):
+        t0 = time.time()
+        out = fn(*args)
+        if on_stage is not None:
+            jax.block_until_ready(out)
+            on_stage(name, time.time() - t0)
+        return out
+
+    pk_jac, sig_jac, lane_ok, miller_mask = run(
+        "prepare", jits["prepare"], pk_xs, pk_ys, pk_present,
+        sig_x_plain, sig_large, sig_inf, lane_valid)
+    hm_aff = run("h2c", jits["h2c"], u0, u1)
+    pk_r_aff, wsig = run("scalars", jits["scalars"], pk_jac, sig_jac,
+                         r_bits)
+    ml = run("miller", jits["miller"], pk_r_aff, hm_aff, miller_mask)
+    ok = run("finish", jits["finish"], ml, wsig)
     return ok, lane_ok
 
 

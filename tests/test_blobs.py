@@ -48,26 +48,43 @@ def test_collect_and_availability():
         AvailabilityResult.AVAILABLE
 
 
-def test_bad_proof_is_invalid_not_pending():
+def test_bad_proof_rejected_at_entry_and_cannot_brick_the_block():
     pool = BlobSidecarPool(SETUP)
     root = b"\x02" * 32
-    s0, c0 = _sidecar(root, 0, 3, tamper=True)
-    pool.add_sidecar(s0)
+    bad, c0 = _sidecar(root, 0, 3, tamper=True)
+    assert not pool.add_sidecar(bad)       # proof checked at the door
     assert pool.check_availability(root, [c0]) == \
-        AvailabilityResult.INVALID
-    # verdict is cached
+        AvailabilityResult.PENDING
+    # the honest sidecar still lands (no first-wins shadowing)
+    good, _ = _sidecar(root, 0, 3)
+    assert pool.add_sidecar(good)
     assert pool.check_availability(root, [c0]) == \
-        AvailabilityResult.INVALID
+        AvailabilityResult.AVAILABLE
 
 
-def test_commitment_mismatch_invalid():
+def test_commitment_mismatch_stays_pending():
+    """A valid sidecar for a DIFFERENT commitment must not satisfy (or
+    poison) the block's slot — without its real blob the block is
+    simply not yet available."""
     pool = BlobSidecarPool(SETUP)
     root = b"\x03" * 32
     s0, _ = _sidecar(root, 0, 4)
     pool.add_sidecar(s0)
-    other_commitment = b"\xc0" + b"\x00" * 47
+    other_commitment = kzg.blob_to_kzg_commitment(_blob(99), SETUP)
     assert pool.check_availability(root, [other_commitment]) == \
-        AvailabilityResult.INVALID
+        AvailabilityResult.PENDING
+
+
+def test_prune_clears_verdicts():
+    pool = BlobSidecarPool(SETUP)
+    root = b"\x05" * 32
+    s0, c0 = _sidecar(root, 0, 6)
+    pool.add_sidecar(s0)
+    assert pool.check_availability(root, [c0]) == \
+        AvailabilityResult.AVAILABLE
+    pool.prune_block(root)
+    assert pool.check_availability(root, [c0]) == \
+        AvailabilityResult.PENDING
 
 
 def test_malformed_sidecars_rejected():
