@@ -35,7 +35,12 @@ def _require(cond: bool, what: str):
 
 def verify_block_signature(cfg: SpecConfig, state, signed_block,
                            verifier: SignatureVerifier) -> bool:
-    proposer = state.validators[signed_block.message.proposer_index]
+    proposer_index = signed_block.message.proposer_index
+    if proposer_index >= len(state.validators):
+        # wire-controlled u64: indexing it unchecked is a remote crash
+        # (found by the fuzz harness), not a typed rejection
+        return False
+    proposer = state.validators[proposer_index]
     domain = H.get_domain(cfg, state, DOMAIN_BEACON_PROPOSER)
     root = H.compute_signing_root(signed_block.message, domain)
     return verifier.verify([proposer.pubkey], root, signed_block.signature)
@@ -116,6 +121,8 @@ def process_proposer_slashing(cfg: SpecConfig, state, slashing,
     _require(h1.proposer_index == h2.proposer_index,
              "slashing: proposers differ")
     _require(h1 != h2, "slashing: identical headers")
+    _require(h1.proposer_index < len(state.validators),
+             "slashing: unknown proposer")
     proposer = state.validators[h1.proposer_index]
     _require(H.is_slashable_validator(
         proposer, H.get_current_epoch(cfg, state)), "not slashable")
