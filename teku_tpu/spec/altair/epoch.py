@@ -77,9 +77,12 @@ def get_flag_index_deltas(cfg: SpecConfig, state, flag_index: int):
     return rewards, penalties
 
 
-def get_inactivity_penalty_deltas(cfg: SpecConfig, state):
+def get_inactivity_penalty_deltas(cfg: SpecConfig, state,
+                                  inactivity_quotient=None):
     n = len(state.validators)
     penalties = [0] * n
+    quotient = (cfg.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+                if inactivity_quotient is None else inactivity_quotient)
     previous_epoch = H.get_previous_epoch(cfg, state)
     target_idx = AH.get_unslashed_participating_indices(
         cfg, state, TIMELY_TARGET_FLAG_INDEX, previous_epoch)
@@ -88,17 +91,18 @@ def get_inactivity_penalty_deltas(cfg: SpecConfig, state):
             numer = (state.validators[index].effective_balance
                      * state.inactivity_scores[index])
             penalties[index] += numer // (
-                cfg.INACTIVITY_SCORE_BIAS
-                * cfg.INACTIVITY_PENALTY_QUOTIENT_ALTAIR)
+                cfg.INACTIVITY_SCORE_BIAS * quotient)
     return [0] * n, penalties
 
 
-def process_rewards_and_penalties(cfg: SpecConfig, state):
+def process_rewards_and_penalties(cfg: SpecConfig, state,
+                                  inactivity_quotient=None):
     if H.get_current_epoch(cfg, state) == GENESIS_EPOCH:
         return state
     deltas = [get_flag_index_deltas(cfg, state, f)
               for f in range(len(PARTICIPATION_FLAG_WEIGHTS))]
-    deltas.append(get_inactivity_penalty_deltas(cfg, state))
+    deltas.append(get_inactivity_penalty_deltas(cfg, state,
+                                                inactivity_quotient))
     balances = list(state.balances)
     for rewards, penalties in deltas:
         for i in range(len(balances)):
@@ -106,12 +110,14 @@ def process_rewards_and_penalties(cfg: SpecConfig, state):
     return state.copy_with(balances=tuple(balances))
 
 
-def process_slashings(cfg: SpecConfig, state):
-    """Altair: proportional multiplier 2 (spec process_slashings)."""
+def process_slashings(cfg: SpecConfig, state, multiplier=None):
+    """Altair: proportional multiplier 2 (spec process_slashings);
+    bellatrix overrides the multiplier to 3."""
     epoch = H.get_current_epoch(cfg, state)
     total = H.get_total_active_balance(cfg, state)
-    adjusted = min(sum(state.slashings)
-                   * cfg.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR, total)
+    if multiplier is None:
+        multiplier = cfg.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
+    adjusted = min(sum(state.slashings) * multiplier, total)
     inc = cfg.EFFECTIVE_BALANCE_INCREMENT
     balances = list(state.balances)
     for i, v in enumerate(state.validators):

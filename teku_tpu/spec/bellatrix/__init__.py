@@ -1,0 +1,11 @@
+"""Bellatrix milestone: execution payloads + the merge.
+
+Equivalent of the reference's bellatrix logic tree (reference:
+ethereum/spec/src/main/java/tech/pegasys/teku/spec/logic/versions/
+bellatrix/ — BlockProcessorBellatrix with processExecutionPayload and
+the optimistic OptimisticExecutionPayloadExecutor seam, MiscHelpers
+Bellatrix merge-transition predicates).
+"""
+
+from .datastructures import get_bellatrix_schemas
+from .fork import upgrade_to_bellatrix

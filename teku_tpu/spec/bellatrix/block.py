@@ -1,0 +1,84 @@
+"""Bellatrix block processing: altair + execution payload.
+
+reference: ethereum/spec/.../logic/versions/bellatrix/block/
+BlockProcessorBellatrix.java — processExecutionPayload verifies
+parent-hash continuity, prev_randao, timestamp, then hands the payload
+to the (optimistic) execution engine and stores its header.
+"""
+
+from .. import block as B0
+from .. import helpers as H
+from ..altair import block as AB
+from ..config import SpecConfig
+from ..verifiers import SignatureVerifier, SIMPLE
+from .datastructures import payload_to_header
+
+_require = B0._require
+
+
+# the execution-engine seam: swap in EngineJsonRpcClient-backed logic
+# at node wiring; the default accepts everything (the reference's
+# ExecutionLayerManagerStub / pre-merge behavior)
+class _AcceptAllEngine:
+    def notify_new_payload(self, payload) -> bool:
+        return True
+
+
+ACCEPT_ALL_ENGINE = _AcceptAllEngine()
+
+
+def is_merge_transition_complete(state) -> bool:
+    from .datastructures import ExecutionPayloadHeader
+    return (state.latest_execution_payload_header
+            != ExecutionPayloadHeader())
+
+
+def is_merge_transition_block(state, body) -> bool:
+    from .datastructures import ExecutionPayload
+    return (not is_merge_transition_complete(state)
+            and body.execution_payload != ExecutionPayload())
+
+
+def is_execution_enabled(state, body) -> bool:
+    return is_merge_transition_block(state, body) \
+        or is_merge_transition_complete(state)
+
+
+def compute_timestamp_at_slot(cfg: SpecConfig, state, slot: int) -> int:
+    return state.genesis_time + slot * cfg.SECONDS_PER_SLOT
+
+
+def process_execution_payload(cfg: SpecConfig, state, body,
+                              execution_engine=ACCEPT_ALL_ENGINE):
+    payload = body.execution_payload
+    if is_merge_transition_complete(state):
+        _require(payload.parent_hash
+                 == state.latest_execution_payload_header.block_hash,
+                 "payload parent hash mismatch")
+    _require(payload.prev_randao == H.get_randao_mix(
+        cfg, state, H.get_current_epoch(cfg, state)),
+        "payload prev_randao mismatch")
+    _require(payload.timestamp
+             == compute_timestamp_at_slot(cfg, state, state.slot),
+             "payload timestamp mismatch")
+    _require(execution_engine.notify_new_payload(payload),
+             "execution engine rejected the payload")
+    return state.copy_with(
+        latest_execution_payload_header=payload_to_header(payload))
+
+
+def process_block(cfg: SpecConfig, state, block,
+                  verifier: SignatureVerifier,
+                  deposit_verifier: SignatureVerifier = SIMPLE,
+                  execution_engine=ACCEPT_ALL_ENGINE):
+    state = B0.process_block_header(cfg, state, block)
+    if is_execution_enabled(state, block.body):
+        state = process_execution_payload(cfg, state, block.body,
+                                          execution_engine)
+    state = B0.process_randao(cfg, state, block.body, verifier)
+    state = B0.process_eth1_data(cfg, state, block.body)
+    state = AB._process_operations(cfg, state, block.body, verifier,
+                                   deposit_verifier)
+    state = AB.process_sync_aggregate(cfg, state,
+                                      block.body.sync_aggregate, verifier)
+    return state

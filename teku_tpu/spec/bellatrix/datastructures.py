@@ -1,0 +1,99 @@
+"""Bellatrix containers: execution payload (+header), state/body.
+
+reference: ethereum/spec/.../spec/datastructures/execution/versions/
+bellatrix/ExecutionPayload*.java and state/beaconstate/versions/
+bellatrix/.
+"""
+
+from functools import lru_cache
+
+from ...ssz import (Bitvector, ByteList, Bytes4, Bytes20, Bytes32,
+                    Bytes48, Bytes96, Container, List, uint8, uint64,
+                    uint256, Vector)
+from ...ssz.types import _ContainerMeta
+from ..config import SpecConfig
+from ..altair.datastructures import get_altair_schemas
+
+MAX_BYTES_PER_TRANSACTION = 2 ** 30
+MAX_TRANSACTIONS_PER_PAYLOAD = 2 ** 20
+BYTES_PER_LOGS_BLOOM = 256
+MAX_EXTRA_DATA_BYTES = 32
+
+
+def _container(name, fields):
+    return _ContainerMeta(name, (Container,),
+                          {"__annotations__": dict(fields)})
+
+
+_PAYLOAD_COMMON = [
+    ("parent_hash", Bytes32),
+    ("fee_recipient", Bytes20),
+    ("state_root", Bytes32),
+    ("receipts_root", Bytes32),
+    ("logs_bloom", Vector(uint8, BYTES_PER_LOGS_BLOOM)),
+    ("prev_randao", Bytes32),
+    ("block_number", uint64),
+    ("gas_limit", uint64),
+    ("gas_used", uint64),
+    ("timestamp", uint64),
+    ("extra_data", ByteList(MAX_EXTRA_DATA_BYTES)),
+    ("base_fee_per_gas", uint256),
+    ("block_hash", Bytes32),
+]
+
+ExecutionPayload = _container("ExecutionPayload", _PAYLOAD_COMMON + [
+    ("transactions", List(ByteList(MAX_BYTES_PER_TRANSACTION),
+                          MAX_TRANSACTIONS_PER_PAYLOAD)),
+])
+
+ExecutionPayloadHeader = _container(
+    "ExecutionPayloadHeader", _PAYLOAD_COMMON + [
+        ("transactions_root", Bytes32),
+    ])
+
+
+def payload_to_header(payload) -> "Container":
+    from ...ssz import List as SszList
+    tx_schema = ExecutionPayload._ssz_fields["transactions"]
+    return ExecutionPayloadHeader(
+        **{name: getattr(payload, name)
+           for name, _ in _PAYLOAD_COMMON},
+        transactions_root=tx_schema.hash_tree_root(payload.transactions))
+
+
+class BellatrixSchemas:
+    def __getattr__(self, name):
+        if name == "altair":
+            raise AttributeError(name)
+        return getattr(self.altair, name)
+
+    def __init__(self, cfg: SpecConfig):
+        self.config = cfg
+        self.altair = get_altair_schemas(cfg)
+        A = self.altair
+        self.ExecutionPayload = ExecutionPayload
+        self.ExecutionPayloadHeader = ExecutionPayloadHeader
+        self.BeaconBlockBody = _container("BeaconBlockBodyBellatrix", [
+            *A.BeaconBlockBody._ssz_fields.items(),
+            ("execution_payload", ExecutionPayload),
+        ])
+        self.BeaconBlock = _container("BeaconBlockBellatrix", [
+            ("slot", A.BeaconBlock._ssz_fields["slot"]),
+            ("proposer_index", A.BeaconBlock._ssz_fields["proposer_index"]),
+            ("parent_root", Bytes32),
+            ("state_root", Bytes32),
+            ("body", self.BeaconBlockBody),
+        ])
+        self.SignedBeaconBlock = _container("SignedBeaconBlockBellatrix", [
+            ("message", self.BeaconBlock),
+            ("signature", Bytes96),
+        ])
+        self.BeaconState = _container("BeaconStateBellatrix", [
+            *A.BeaconState._ssz_fields.items(),
+            ("latest_execution_payload_header", ExecutionPayloadHeader),
+        ])
+
+
+@lru_cache(maxsize=8)
+def get_bellatrix_schemas(cfg: SpecConfig) -> BellatrixSchemas:
+    return BellatrixSchemas(cfg)

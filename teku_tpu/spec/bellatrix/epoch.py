@@ -1,0 +1,30 @@
+"""Bellatrix epoch processing: altair flow with bellatrix quotients.
+
+reference: ethereum/spec/.../logic/versions/bellatrix/ — the epoch
+processor only swaps the inactivity-penalty quotient and proportional
+slashing multiplier (spec upgrade notes), everything else is altair's.
+"""
+
+from .. import epoch as E0
+from ..altair import epoch as AE
+from ..config import SpecConfig
+
+
+def process_epoch(cfg: SpecConfig, state):
+    state = AE.process_justification_and_finalization(cfg, state)
+    state = AE.process_inactivity_updates(cfg, state)
+    state = AE.process_rewards_and_penalties(
+        cfg, state,
+        inactivity_quotient=cfg.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX)
+    state = E0.process_registry_updates(cfg, state)
+    state = AE.process_slashings(
+        cfg, state,
+        multiplier=cfg.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX)
+    state = E0.process_eth1_data_reset(cfg, state)
+    state = E0.process_effective_balance_updates(cfg, state)
+    state = E0.process_slashings_reset(cfg, state)
+    state = E0.process_randao_mixes_reset(cfg, state)
+    state = E0.process_historical_roots_update(cfg, state)
+    state = AE.process_participation_flag_updates(cfg, state)
+    state = AE.process_sync_committee_updates(cfg, state)
+    return state

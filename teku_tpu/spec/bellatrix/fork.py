@@ -1,0 +1,19 @@
+"""Altair → bellatrix fork upgrade (spec upgrade_to_bellatrix)."""
+
+from .. import helpers as H
+from ..config import SpecConfig
+from ..datastructures import Fork
+from .datastructures import ExecutionPayloadHeader, get_bellatrix_schemas
+
+
+def upgrade_to_bellatrix(cfg: SpecConfig, pre):
+    S = get_bellatrix_schemas(cfg)
+    epoch = H.get_current_epoch(cfg, pre)
+    fields = {name: getattr(pre, name)
+              for name in type(pre)._ssz_fields}
+    fields["fork"] = Fork(previous_version=pre.fork.current_version,
+                          current_version=cfg.BELLATRIX_FORK_VERSION,
+                          epoch=epoch)
+    return S.BeaconState(
+        **fields,
+        latest_execution_payload_header=ExecutionPayloadHeader())

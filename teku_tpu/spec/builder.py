@@ -117,6 +117,10 @@ def build_unsigned_block(cfg: SpecConfig, pre, slot: int,
                     False for _ in range(cfg.SYNC_COMMITTEE_SIZE)),
                 sync_committee_signature=G2_INFINITY)
         body_kwargs["sync_aggregate"] = sync_aggregate
+    if "execution_payload" in S.BeaconBlockBody._ssz_fields:
+        # default (empty) payload = merge not yet transitioned: the
+        # processor skips execution checks (is_execution_enabled False)
+        body_kwargs["execution_payload"] = S.ExecutionPayload()
     body = S.BeaconBlockBody(**body_kwargs)
     block = S.BeaconBlock(
         slot=slot, proposer_index=proposer_index,

@@ -128,6 +128,13 @@ class SpecConfig:
     INACTIVITY_SCORE_BIAS: int = 4
     INACTIVITY_SCORE_RECOVERY_RATE: int = 16
 
+    # --- Bellatrix ---
+    BELLATRIX_FORK_VERSION: bytes = bytes.fromhex("02000000")
+    BELLATRIX_FORK_EPOCH: int = FAR_FUTURE_EPOCH
+    INACTIVITY_PENALTY_QUOTIENT_BELLATRIX: int = 2 ** 24
+    MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX: int = 32
+    PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX: int = 3
+
 
 MAINNET = SpecConfig()
 

@@ -438,8 +438,12 @@ def slash_validator(cfg: SpecConfig, state, slashed_index: int,
     state = state.copy_with(validators=tuple(validators),
                             slashings=tuple(slashings))
     altair = _is_altair(cfg, state)
-    penalty_quotient = (cfg.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR if altair
-                       else cfg.MIN_SLASHING_PENALTY_QUOTIENT)
+    if get_current_epoch(cfg, state) >= cfg.BELLATRIX_FORK_EPOCH:
+        penalty_quotient = cfg.MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX
+    elif altair:
+        penalty_quotient = cfg.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
+    else:
+        penalty_quotient = cfg.MIN_SLASHING_PENALTY_QUOTIENT
     state = decrease_balance(
         state, slashed_index, v.effective_balance // penalty_quotient)
 

@@ -128,11 +128,31 @@ def altair_version(cfg: SpecConfig) -> SpecVersion:
         upgrade_state=lambda state: upgrade_to_altair(cfg, state))
 
 
+def bellatrix_version(cfg: SpecConfig) -> SpecVersion:
+    from .altair import epoch as AE
+    from .bellatrix import block as BB
+    from .bellatrix import epoch as BE
+    from .bellatrix.datastructures import get_bellatrix_schemas
+    from .bellatrix.fork import upgrade_to_bellatrix
+
+    return SpecVersion(
+        milestone=SpecMilestone.BELLATRIX,
+        fork_version=cfg.BELLATRIX_FORK_VERSION,
+        fork_epoch=cfg.BELLATRIX_FORK_EPOCH,
+        schemas=get_bellatrix_schemas(cfg),
+        process_block=BB.process_block,
+        process_epoch=BE.process_epoch,
+        process_justification=AE.process_justification_and_finalization,
+        upgrade_state=lambda state: upgrade_to_bellatrix(cfg, state))
+
+
 from functools import lru_cache
 
 
 @lru_cache(maxsize=16)
 def build_fork_schedule(cfg: SpecConfig) -> ForkSchedule:
-    """All scheduled milestones for this config (phase0 + altair when
-    its fork epoch is set; later forks register the same way)."""
-    return ForkSchedule(cfg, [phase0_version(cfg), altair_version(cfg)])
+    """All scheduled milestones for this config (phase0 + altair +
+    bellatrix when their fork epochs are set; later forks register the
+    same way)."""
+    return ForkSchedule(cfg, [phase0_version(cfg), altair_version(cfg),
+                              bellatrix_version(cfg)])

@@ -1,0 +1,102 @@
+"""Bellatrix: full fork ladder phase0→altair→bellatrix under full
+verification, execution-payload processing, merge transition."""
+
+import dataclasses
+
+import pytest
+
+from teku_tpu.spec import config as C
+from teku_tpu.spec import helpers as H
+from teku_tpu.spec.bellatrix import block as BB
+from teku_tpu.spec.bellatrix.datastructures import (
+    get_bellatrix_schemas, payload_to_header)
+from teku_tpu.spec.builder import (make_local_signer, produce_attestations,
+                                   produce_block)
+from teku_tpu.spec.genesis import interop_genesis
+from teku_tpu.spec.milestones import build_fork_schedule, SpecMilestone
+from teku_tpu.spec.transition import process_slots, state_transition
+from teku_tpu.spec.verifiers import SIMPLE
+
+CFG = dataclasses.replace(C.MINIMAL, ALTAIR_FORK_EPOCH=1,
+                          BELLATRIX_FORK_EPOCH=2)
+
+
+@pytest.mark.slow
+def test_full_fork_ladder_finalizes():
+    state, sks = interop_genesis(CFG, 32)
+    signer = make_local_signer(dict(enumerate(sks)))
+    atts = []
+    cur = state
+    S = get_bellatrix_schemas(CFG)
+    for slot in range(1, 5 * CFG.SLOTS_PER_EPOCH + 1):
+        signed, post = produce_block(CFG, cur, slot, signer,
+                                     attestations=atts)
+        verified = state_transition(CFG, cur, signed,
+                                    validate_result=True)
+        assert verified.htr() == post.htr(), f"divergence at slot {slot}"
+        atts = produce_attestations(CFG, post, slot,
+                                    signed.message.htr(), signer)
+        cur = post
+    assert isinstance(cur, S.BeaconState)
+    assert cur.fork.current_version == CFG.BELLATRIX_FORK_VERSION
+    assert cur.fork.previous_version == CFG.ALTAIR_FORK_VERSION
+    assert cur.finalized_checkpoint.epoch >= 3
+    # pre-merge: empty payload header throughout
+    assert not BB.is_merge_transition_complete(cur)
+
+
+def test_milestone_schedule_three_forks():
+    sched = build_fork_schedule(CFG)
+    assert sched.milestone_at_epoch(0) is SpecMilestone.PHASE0
+    assert sched.milestone_at_epoch(1) is SpecMilestone.ALTAIR
+    assert sched.milestone_at_epoch(2) is SpecMilestone.BELLATRIX
+    assert sched.milestone_at_epoch(500) is SpecMilestone.BELLATRIX
+
+
+def test_payload_header_roundtrip():
+    S = get_bellatrix_schemas(CFG)
+    payload = S.ExecutionPayload(
+        parent_hash=b"\x01" * 32, block_hash=b"\x02" * 32,
+        block_number=7, gas_limit=30_000_000, timestamp=12,
+        transactions=(b"\xaa\xbb", b"\xcc" * 40))
+    header = payload_to_header(payload)
+    assert header.block_hash == payload.block_hash
+    assert header.block_number == 7
+    # transactions_root is the list HTR, not zero
+    assert header.transactions_root != bytes(32)
+
+
+@pytest.mark.slow
+def test_merge_transition_block_processes():
+    """A first real payload (correct randao/timestamp) flips the merge
+    to complete via the execution-engine seam."""
+    state, sks = interop_genesis(CFG, 32)
+    signer = make_local_signer(dict(enumerate(sks)))
+    cur = state
+    atts = []
+    for slot in range(1, 2 * CFG.SLOTS_PER_EPOCH + 1):
+        signed, cur = produce_block(CFG, cur, slot, signer,
+                                    attestations=atts)
+        atts = produce_attestations(CFG, cur, slot,
+                                    signed.message.htr(), signer)
+    assert not BB.is_merge_transition_complete(cur)
+    S = get_bellatrix_schemas(CFG)
+    slot = cur.slot + 1
+    pre = process_slots(CFG, cur, slot)
+    payload = S.ExecutionPayload(
+        parent_hash=b"\x00" * 32,
+        prev_randao=H.get_randao_mix(CFG, pre,
+                                     H.get_current_epoch(CFG, pre)),
+        timestamp=BB.compute_timestamp_at_slot(CFG, pre, slot),
+        block_hash=b"\xEE" * 32,
+        block_number=1)
+    post = BB.process_execution_payload(CFG, pre, type(
+        "B", (), {"execution_payload": payload})(), BB.ACCEPT_ALL_ENGINE)
+    assert BB.is_merge_transition_complete(post)
+    assert (post.latest_execution_payload_header.block_hash
+            == b"\xEE" * 32)
+    # wrong randao rejected
+    bad = payload.copy_with(prev_randao=b"\x13" * 32)
+    with pytest.raises(Exception):
+        BB.process_execution_payload(CFG, pre, type(
+            "B", (), {"execution_payload": bad})(), BB.ACCEPT_ALL_ENGINE)
