@@ -28,15 +28,16 @@ ACCEPT_ALL_ENGINE = _AcceptAllEngine()
 
 
 def is_merge_transition_complete(state) -> bool:
-    from .datastructures import ExecutionPayloadHeader
-    return (state.latest_execution_payload_header
-            != ExecutionPayloadHeader())
+    # compare against the state's OWN header type: a capella+ state's
+    # default header must also read as "merge not complete"
+    header = state.latest_execution_payload_header
+    return header != type(header)()
 
 
 def is_merge_transition_block(state, body) -> bool:
-    from .datastructures import ExecutionPayload
+    payload = body.execution_payload
     return (not is_merge_transition_complete(state)
-            and body.execution_payload != ExecutionPayload())
+            and payload != type(payload)())
 
 
 def is_execution_enabled(state, body) -> bool:
@@ -49,9 +50,15 @@ def compute_timestamp_at_slot(cfg: SpecConfig, state, slot: int) -> int:
 
 
 def process_execution_payload(cfg: SpecConfig, state, body,
-                              execution_engine=ACCEPT_ALL_ENGINE):
+                              execution_engine=ACCEPT_ALL_ENGINE,
+                              to_header=payload_to_header,
+                              transition_guard=True):
+    """The ONE payload-validation recipe shared by every post-merge
+    fork: later forks swap `to_header` (withdrawals/blob-gas fields)
+    and drop `transition_guard` once the merge is complete by
+    construction (deneb+)."""
     payload = body.execution_payload
-    if is_merge_transition_complete(state):
+    if not transition_guard or is_merge_transition_complete(state):
         _require(payload.parent_hash
                  == state.latest_execution_payload_header.block_hash,
                  "payload parent hash mismatch")
@@ -64,7 +71,7 @@ def process_execution_payload(cfg: SpecConfig, state, body,
     _require(execution_engine.notify_new_payload(payload),
              "execution engine rejected the payload")
     return state.copy_with(
-        latest_execution_payload_header=payload_to_header(payload))
+        latest_execution_payload_header=to_header(payload))
 
 
 def process_block(cfg: SpecConfig, state, block,

@@ -53,7 +53,6 @@ ExecutionPayloadHeader = _container(
 
 
 def payload_to_header(payload) -> "Container":
-    from ...ssz import List as SszList
     tx_schema = ExecutionPayload._ssz_fields["transactions"]
     return ExecutionPayloadHeader(
         **{name: getattr(payload, name)

@@ -118,9 +118,17 @@ def build_unsigned_block(cfg: SpecConfig, pre, slot: int,
                 sync_committee_signature=G2_INFINITY)
         body_kwargs["sync_aggregate"] = sync_aggregate
     if "execution_payload" in S.BeaconBlockBody._ssz_fields:
-        # default (empty) payload = merge not yet transitioned: the
-        # processor skips execution checks (is_execution_enabled False)
-        body_kwargs["execution_payload"] = S.ExecutionPayload()
+        if "withdrawals" in S.ExecutionPayload._ssz_fields:
+            # capella+: payload checks run unconditionally, so build a
+            # minimal payload that chains on the stored header, matches
+            # randao/timestamp, and carries the expected withdrawals
+            body_kwargs["execution_payload"] = _devnet_payload(cfg, pre,
+                                                               slot, S)
+        else:
+            # bellatrix default (empty) payload = merge not yet
+            # transitioned: the processor skips execution checks
+            # (is_execution_enabled False)
+            body_kwargs["execution_payload"] = S.ExecutionPayload()
     body = S.BeaconBlockBody(**body_kwargs)
     block = S.BeaconBlock(
         slot=slot, proposer_index=proposer_index,
@@ -157,6 +165,32 @@ def produce_block(cfg: SpecConfig, state, slot: int, signer: Signer,
     signed = S.SignedBeaconBlock(message=block,
                                  signature=signer(proposer_index, root))
     return signed, post
+
+
+def _devnet_payload(cfg: SpecConfig, pre, slot: int, S):
+    """A self-consistent execution payload with no real EL attached:
+    block hashes chain deterministically off the previous payload header
+    (the reference's stubbed EL plays the same role,
+    ExecutionLayerManagerStub)."""
+    from .bellatrix.block import compute_timestamp_at_slot
+    from .capella.block import get_expected_withdrawals
+    header = pre.latest_execution_payload_header
+    parent_hash = header.block_hash
+    block_hash = H.hash32(b"teku-tpu-devnet-exec" + parent_hash
+                          + slot.to_bytes(8, "little"))
+    kw = dict(
+        parent_hash=parent_hash,
+        prev_randao=H.get_randao_mix(cfg, pre,
+                                     H.get_current_epoch(cfg, pre)),
+        block_number=header.block_number + 1,
+        gas_limit=30_000_000,
+        timestamp=compute_timestamp_at_slot(cfg, pre, slot),
+        block_hash=block_hash,
+        withdrawals=tuple(get_expected_withdrawals(cfg, pre)))
+    if "excess_blob_gas" in S.ExecutionPayload._ssz_fields:
+        kw["blob_gas_used"] = 0
+        kw["excess_blob_gas"] = 0
+    return S.ExecutionPayload(**kw)
 
 
 def _parent_root(pre) -> bytes:

@@ -1,0 +1,6 @@
+"""Capella milestone: withdrawals, BLS-to-execution changes,
+historical summaries.
+
+reference: ethereum/spec/src/main/java/tech/pegasys/teku/spec/logic/
+versions/capella/ and datastructures/execution/versions/capella/.
+"""

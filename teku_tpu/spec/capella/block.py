@@ -1,0 +1,168 @@
+"""Capella block processing: withdrawals sweep, BLS-to-execution
+changes, post-merge-only execution payload.
+
+reference: ethereum/spec/.../logic/versions/capella/block/
+BlockProcessorCapella.java — processWithdrawals validates the payload's
+withdrawal list against the state's expected sweep, processBlsToExecutionChange
+re-keys a validator's withdrawal credential after verifying a signature
+over the GENESIS fork domain (valid across all forks, spec
+process_bls_to_execution_change).
+"""
+
+from .. import block as B0
+from .. import helpers as H
+from ..altair import block as AB
+from ..bellatrix import block as BB
+from ..config import (DOMAIN_BLS_TO_EXECUTION_CHANGE,
+                      ETH1_ADDRESS_WITHDRAWAL_PREFIX, SpecConfig)
+from ..verifiers import SignatureVerifier, SIMPLE
+from .datastructures import Withdrawal, payload_to_header_capella
+
+_require = B0._require
+
+
+# ---- withdrawal-credential predicates (spec capella helpers) ----
+
+def has_eth1_withdrawal_credential(validator) -> bool:
+    return validator.withdrawal_credentials[:1] \
+        == ETH1_ADDRESS_WITHDRAWAL_PREFIX
+
+
+def is_fully_withdrawable_validator(cfg: SpecConfig, validator,
+                                    balance: int, epoch: int) -> bool:
+    return (has_eth1_withdrawal_credential(validator)
+            and validator.withdrawable_epoch <= epoch
+            and balance > 0)
+
+
+def is_partially_withdrawable_validator(cfg: SpecConfig, validator,
+                                        balance: int) -> bool:
+    return (has_eth1_withdrawal_credential(validator)
+            and validator.effective_balance == cfg.MAX_EFFECTIVE_BALANCE
+            and balance > cfg.MAX_EFFECTIVE_BALANCE)
+
+
+# ---- withdrawals ----
+
+def get_expected_withdrawals(cfg: SpecConfig, state):
+    """The deterministic sweep: starting at next_withdrawal_validator_index,
+    visit up to MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP validators, emitting
+    full withdrawals for exited eth1-credentialed validators and skims
+    above MAX_EFFECTIVE_BALANCE, capped at MAX_WITHDRAWALS_PER_PAYLOAD."""
+    epoch = H.get_current_epoch(cfg, state)
+    withdrawal_index = state.next_withdrawal_index
+    validator_index = state.next_withdrawal_validator_index
+    withdrawals = []
+    n = len(state.validators)
+    for _ in range(min(n, cfg.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)):
+        v = state.validators[validator_index]
+        balance = state.balances[validator_index]
+        address = v.withdrawal_credentials[12:]
+        if is_fully_withdrawable_validator(cfg, v, balance, epoch):
+            withdrawals.append(Withdrawal(
+                index=withdrawal_index, validator_index=validator_index,
+                address=address, amount=balance))
+            withdrawal_index += 1
+        elif is_partially_withdrawable_validator(cfg, v, balance):
+            withdrawals.append(Withdrawal(
+                index=withdrawal_index, validator_index=validator_index,
+                address=address,
+                amount=balance - cfg.MAX_EFFECTIVE_BALANCE))
+            withdrawal_index += 1
+        if len(withdrawals) == cfg.MAX_WITHDRAWALS_PER_PAYLOAD:
+            break
+        validator_index = (validator_index + 1) % n
+    return withdrawals
+
+
+def process_withdrawals(cfg: SpecConfig, state, payload):
+    expected = get_expected_withdrawals(cfg, state)
+    _require(len(payload.withdrawals) == len(expected),
+             "withdrawals: wrong count in payload")
+    for got, want in zip(payload.withdrawals, expected):
+        _require(got == want, "withdrawals: payload/sweep mismatch")
+        state = H.decrease_balance(state, want.validator_index, want.amount)
+    n = len(state.validators)
+    updates = {}
+    if expected:
+        updates["next_withdrawal_index"] = expected[-1].index + 1
+    if len(expected) == cfg.MAX_WITHDRAWALS_PER_PAYLOAD:
+        # sweep saturated: resume right after the last withdrawn validator
+        updates["next_withdrawal_validator_index"] = \
+            (expected[-1].validator_index + 1) % n
+    else:
+        # sweep exhausted its visit budget: jump the cursor past it
+        updates["next_withdrawal_validator_index"] = \
+            (state.next_withdrawal_validator_index
+             + cfg.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP) % n
+    return state.copy_with(**updates)
+
+
+# ---- BLS to execution change ----
+
+def process_bls_to_execution_change(cfg: SpecConfig, state, signed_change,
+                                    verifier: SignatureVerifier):
+    change = signed_change.message
+    _require(change.validator_index < len(state.validators),
+             "bls change: unknown validator")
+    v = state.validators[change.validator_index]
+    _require(v.withdrawal_credentials[:1] == cfg.BLS_WITHDRAWAL_PREFIX,
+             "bls change: not a BLS credential")
+    _require(v.withdrawal_credentials[1:]
+             == H.hash32(change.from_bls_pubkey)[1:],
+             "bls change: credential does not commit to this key")
+    # deliberately fork-agnostic domain: GENESIS fork version so a
+    # change signed once stays valid after every fork
+    domain = H.compute_domain(DOMAIN_BLS_TO_EXECUTION_CHANGE,
+                              cfg.GENESIS_FORK_VERSION,
+                              state.genesis_validators_root)
+    root = H.compute_signing_root(change, domain)
+    _require(verifier.verify([change.from_bls_pubkey], root,
+                             signed_change.signature),
+             "bls change: bad signature")
+    validators = list(state.validators)
+    validators[change.validator_index] = v.copy_with(
+        withdrawal_credentials=(ETH1_ADDRESS_WITHDRAWAL_PREFIX
+                                + bytes(11)
+                                + change.to_execution_address))
+    return state.copy_with(validators=tuple(validators))
+
+
+# ---- execution payload ----
+
+def process_execution_payload(cfg: SpecConfig, state, body,
+                              execution_engine=BB.ACCEPT_ALL_ENGINE):
+    # bellatrix recipe with the capella header shape; the merge
+    # transition guard still applies (only deneb removes it)
+    return BB.process_execution_payload(
+        cfg, state, body, execution_engine,
+        to_header=payload_to_header_capella)
+
+
+def _process_operations(cfg, state, body, verifier, deposit_verifier):
+    state = AB._process_operations(cfg, state, body, verifier,
+                                   deposit_verifier)
+    for op in body.bls_to_execution_changes:
+        state = process_bls_to_execution_change(cfg, state, op, verifier)
+    return state
+
+
+def process_block(cfg: SpecConfig, state, block,
+                  verifier: SignatureVerifier,
+                  deposit_verifier: SignatureVerifier = SIMPLE,
+                  execution_engine=BB.ACCEPT_ALL_ENGINE):
+    state = B0.process_block_header(cfg, state, block)
+    # capella KEEPS the pre-merge guard (an empty-payload block on a
+    # not-yet-merged chain skips execution checks); deneb removes it
+    if BB.is_execution_enabled(state, block.body):
+        state = process_withdrawals(cfg, state,
+                                    block.body.execution_payload)
+        state = process_execution_payload(cfg, state, block.body,
+                                          execution_engine)
+    state = B0.process_randao(cfg, state, block.body, verifier)
+    state = B0.process_eth1_data(cfg, state, block.body)
+    state = _process_operations(cfg, state, block.body, verifier,
+                                deposit_verifier)
+    state = AB.process_sync_aggregate(cfg, state,
+                                      block.body.sync_aggregate, verifier)
+    return state

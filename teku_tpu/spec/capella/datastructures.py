@@ -1,0 +1,126 @@
+"""Capella containers: withdrawals-bearing execution payload,
+BLS-to-execution changes, historical summaries, state/body.
+
+reference: ethereum/spec/.../spec/datastructures/execution/versions/
+capella/ExecutionPayloadCapella*.java, operations/BlsToExecutionChange.java,
+state/versions/capella/ (BeaconStateCapella adds next_withdrawal_index,
+next_withdrawal_validator_index, historical_summaries).
+"""
+
+from functools import lru_cache
+
+from ...ssz import (Bytes20, Bytes32, Bytes48, Bytes96, Container, List,
+                    uint64)
+from ...ssz.types import _ContainerMeta
+from ..config import SpecConfig
+from ..bellatrix.datastructures import (_PAYLOAD_COMMON, _container,
+                                        MAX_BYTES_PER_TRANSACTION,
+                                        MAX_TRANSACTIONS_PER_PAYLOAD,
+                                        get_bellatrix_schemas)
+from ...ssz import ByteList
+
+
+class Withdrawal(Container):
+    index: uint64
+    validator_index: uint64
+    address: Bytes20
+    amount: uint64
+
+
+class BLSToExecutionChange(Container):
+    validator_index: uint64
+    from_bls_pubkey: Bytes48
+    to_execution_address: Bytes20
+
+
+class SignedBLSToExecutionChange(Container):
+    message: BLSToExecutionChange
+    signature: Bytes96
+
+
+class HistoricalSummary(Container):
+    """Drop-in replacement for HistoricalBatch's root: summarizes one
+    SLOTS_PER_HISTORICAL_ROOT window by the roots of the two vectors,
+    so the state stops accumulating full batches (EIP-4788 era
+    light-client friendliness)."""
+    block_summary_root: Bytes32
+    state_summary_root: Bytes32
+
+
+def _capella_payload_pair(cfg: SpecConfig):
+    """(ExecutionPayload, ExecutionPayloadHeader) with withdrawals;
+    preset-dependent because MAX_WITHDRAWALS_PER_PAYLOAD differs."""
+    payload = _container("ExecutionPayloadCapella", _PAYLOAD_COMMON + [
+        ("transactions", List(ByteList(MAX_BYTES_PER_TRANSACTION),
+                              MAX_TRANSACTIONS_PER_PAYLOAD)),
+        ("withdrawals", List(Withdrawal, cfg.MAX_WITHDRAWALS_PER_PAYLOAD)),
+    ])
+    header = _container("ExecutionPayloadHeaderCapella", _PAYLOAD_COMMON + [
+        ("transactions_root", Bytes32),
+        ("withdrawals_root", Bytes32),
+    ])
+    return payload, header
+
+
+def payload_to_header_capella(payload):
+    """Capella header: transactions and withdrawals summarized by root."""
+    schema = type(payload)._ssz_fields
+    from ..bellatrix.datastructures import _PAYLOAD_COMMON as common
+    kw = {name: getattr(payload, name) for name, _ in common}
+    kw["transactions_root"] = schema["transactions"].hash_tree_root(
+        payload.transactions)
+    kw["withdrawals_root"] = schema["withdrawals"].hash_tree_root(
+        payload.withdrawals)
+    return payload.__capella_header__(**kw)
+
+
+class CapellaSchemas:
+    def __getattr__(self, name):
+        if name == "bellatrix":
+            raise AttributeError(name)
+        return getattr(self.bellatrix, name)
+
+    def __init__(self, cfg: SpecConfig):
+        self.config = cfg
+        self.bellatrix = get_bellatrix_schemas(cfg)
+        B = self.bellatrix
+        self.Withdrawal = Withdrawal
+        self.BLSToExecutionChange = BLSToExecutionChange
+        self.SignedBLSToExecutionChange = SignedBLSToExecutionChange
+        self.HistoricalSummary = HistoricalSummary
+        payload, header = _capella_payload_pair(cfg)
+        payload.__capella_header__ = header
+        self.ExecutionPayload = payload
+        self.ExecutionPayloadHeader = header
+
+        body_fields = dict(B.BeaconBlockBody._ssz_fields.items())
+        body_fields["execution_payload"] = payload
+        body_fields["bls_to_execution_changes"] = List(
+            SignedBLSToExecutionChange, cfg.MAX_BLS_TO_EXECUTION_CHANGES)
+        self.BeaconBlockBody = _container("BeaconBlockBodyCapella",
+                                          body_fields.items())
+        self.BeaconBlock = _container("BeaconBlockCapella", [
+            ("slot", uint64),
+            ("proposer_index", uint64),
+            ("parent_root", Bytes32),
+            ("state_root", Bytes32),
+            ("body", self.BeaconBlockBody),
+        ])
+        self.SignedBeaconBlock = _container("SignedBeaconBlockCapella", [
+            ("message", self.BeaconBlock),
+            ("signature", Bytes96),
+        ])
+
+        state_fields = dict(B.BeaconState._ssz_fields.items())
+        state_fields["latest_execution_payload_header"] = header
+        state_fields["next_withdrawal_index"] = uint64
+        state_fields["next_withdrawal_validator_index"] = uint64
+        state_fields["historical_summaries"] = List(
+            HistoricalSummary, cfg.HISTORICAL_ROOTS_LIMIT)
+        self.BeaconState = _container("BeaconStateCapella",
+                                      state_fields.items())
+
+
+@lru_cache(maxsize=8)
+def get_capella_schemas(cfg: SpecConfig) -> CapellaSchemas:
+    return CapellaSchemas(cfg)

@@ -1,0 +1,49 @@
+"""Capella epoch processing: bellatrix flow, but the per-period
+historical accumulation appends a HistoricalSummary instead of a
+HistoricalBatch root (spec process_historical_summaries_update).
+
+reference: ethereum/spec/.../logic/versions/capella/statetransition/
+epoch/EpochProcessorCapella.java.
+"""
+
+from .. import epoch as E0
+from .. import helpers as H
+from ..altair import epoch as AE
+from ..config import SpecConfig
+from .datastructures import HistoricalSummary
+
+
+def process_historical_summaries_update(cfg: SpecConfig, state):
+    next_epoch = H.get_current_epoch(cfg, state) + 1
+    if next_epoch % (cfg.SLOTS_PER_HISTORICAL_ROOT
+                     // cfg.SLOTS_PER_EPOCH) == 0:
+        fields = type(state)._ssz_fields
+        summary = HistoricalSummary(
+            block_summary_root=fields["block_roots"].hash_tree_root(
+                state.block_roots),
+            state_summary_root=fields["state_roots"].hash_tree_root(
+                state.state_roots))
+        return state.copy_with(
+            historical_summaries=tuple(state.historical_summaries)
+            + (summary,))
+    return state
+
+
+def process_epoch(cfg: SpecConfig, state):
+    state = AE.process_justification_and_finalization(cfg, state)
+    state = AE.process_inactivity_updates(cfg, state)
+    state = AE.process_rewards_and_penalties(
+        cfg, state,
+        inactivity_quotient=cfg.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX)
+    state = E0.process_registry_updates(cfg, state)
+    state = AE.process_slashings(
+        cfg, state,
+        multiplier=cfg.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX)
+    state = E0.process_eth1_data_reset(cfg, state)
+    state = E0.process_effective_balance_updates(cfg, state)
+    state = E0.process_slashings_reset(cfg, state)
+    state = E0.process_randao_mixes_reset(cfg, state)
+    state = process_historical_summaries_update(cfg, state)
+    state = AE.process_participation_flag_updates(cfg, state)
+    state = AE.process_sync_committee_updates(cfg, state)
+    return state

@@ -1,0 +1,30 @@
+"""Bellatrix → capella fork upgrade (spec upgrade_to_capella):
+carry everything, re-shape the payload header with an empty
+withdrawals_root, zero the withdrawal cursors, start the summaries
+list empty."""
+
+from .. import helpers as H
+from ..config import SpecConfig
+from ..datastructures import Fork
+from .datastructures import get_capella_schemas
+
+
+def upgrade_to_capella(cfg: SpecConfig, pre):
+    S = get_capella_schemas(cfg)
+    epoch = H.get_current_epoch(cfg, pre)
+    fields = {name: getattr(pre, name)
+              for name in type(pre)._ssz_fields}
+    old = fields.pop("latest_execution_payload_header")
+    fields["fork"] = Fork(previous_version=pre.fork.current_version,
+                          current_version=cfg.CAPELLA_FORK_VERSION,
+                          epoch=epoch)
+    header = S.ExecutionPayloadHeader(
+        **{name: getattr(old, name)
+           for name in type(old)._ssz_fields},
+        withdrawals_root=bytes(32))
+    return S.BeaconState(
+        **fields,
+        latest_execution_payload_header=header,
+        next_withdrawal_index=0,
+        next_withdrawal_validator_index=0,
+        historical_summaries=())

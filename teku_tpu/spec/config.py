@@ -135,6 +135,23 @@ class SpecConfig:
     MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX: int = 32
     PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX: int = 3
 
+    # --- Capella ---
+    CAPELLA_FORK_VERSION: bytes = bytes.fromhex("03000000")
+    CAPELLA_FORK_EPOCH: int = FAR_FUTURE_EPOCH
+    MAX_BLS_TO_EXECUTION_CHANGES: int = 16
+    MAX_WITHDRAWALS_PER_PAYLOAD: int = 16
+    MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP: int = 16384
+
+    # --- Deneb ---
+    DENEB_FORK_VERSION: bytes = bytes.fromhex("04000000")
+    DENEB_FORK_EPOCH: int = FAR_FUTURE_EPOCH
+    MAX_BLOB_COMMITMENTS_PER_BLOCK: int = 4096
+    MAX_BLOBS_PER_BLOCK: int = 6
+    FIELD_ELEMENTS_PER_BLOB: int = 4096
+    MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS: int = 4096
+    MAX_REQUEST_BLOCKS_DENEB: int = 128
+    MAX_REQUEST_BLOB_SIDECARS: int = 768
+
 
 MAINNET = SpecConfig()
 
@@ -179,7 +196,19 @@ MINIMAL = SpecConfig(
     MIN_SLASHING_PENALTY_QUOTIENT=64,
     PROPORTIONAL_SLASHING_MULTIPLIER=2,
     GENESIS_FORK_VERSION=bytes.fromhex("00000001"),
+    MAX_WITHDRAWALS_PER_PAYLOAD=4,
+    MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP=16,
+    FIELD_ELEMENTS_PER_BLOB=4096,
+    MAX_BLOB_COMMITMENTS_PER_BLOCK=16,
 )
+
+# withdrawal-credential prefixes (consensus spec constants)
+BLS_WITHDRAWAL_PREFIX_BYTE = b"\x00"
+ETH1_ADDRESS_WITHDRAWAL_PREFIX = b"\x01"
+COMPOUNDING_WITHDRAWAL_PREFIX = b"\x02"
+
+# EIP-4844: versioned-hash prefix for KZG commitments
+VERSIONED_HASH_VERSION_KZG = b"\x01"
 
 NETWORKS: Dict[str, SpecConfig] = {
     "mainnet": MAINNET,

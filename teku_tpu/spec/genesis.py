@@ -73,6 +73,14 @@ def initialize_beacon_state(cfg: SpecConfig,
         validators=tuple(validators), balances=tuple(balances),
         eth1_deposit_index=len(deposits),
         genesis_validators_root=_validators_root(cfg, validators))
+    # networks may schedule later forks AT genesis (devnets routinely
+    # start in altair/capella/…): apply the upgrade chain for every
+    # milestone active at epoch 0, as the reference does when building
+    # genesis for a config whose fork epochs are 0
+    from .milestones import build_fork_schedule
+    for version in build_fork_schedule(cfg).versions:
+        if version.fork_epoch == 0 and version.upgrade_state is not None:
+            state = version.upgrade_state(state)
     return state
 
 

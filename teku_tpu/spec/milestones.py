@@ -146,6 +146,24 @@ def bellatrix_version(cfg: SpecConfig) -> SpecVersion:
         upgrade_state=lambda state: upgrade_to_bellatrix(cfg, state))
 
 
+def capella_version(cfg: SpecConfig) -> SpecVersion:
+    from .altair import epoch as AE
+    from .capella import block as CB
+    from .capella import epoch as CE
+    from .capella.datastructures import get_capella_schemas
+    from .capella.fork import upgrade_to_capella
+
+    return SpecVersion(
+        milestone=SpecMilestone.CAPELLA,
+        fork_version=cfg.CAPELLA_FORK_VERSION,
+        fork_epoch=cfg.CAPELLA_FORK_EPOCH,
+        schemas=get_capella_schemas(cfg),
+        process_block=CB.process_block,
+        process_epoch=CE.process_epoch,
+        process_justification=AE.process_justification_and_finalization,
+        upgrade_state=lambda state: upgrade_to_capella(cfg, state))
+
+
 from functools import lru_cache
 
 
@@ -155,4 +173,5 @@ def build_fork_schedule(cfg: SpecConfig) -> ForkSchedule:
     bellatrix when their fork epochs are set; later forks register the
     same way)."""
     return ForkSchedule(cfg, [phase0_version(cfg), altair_version(cfg),
-                              bellatrix_version(cfg)])
+                              bellatrix_version(cfg),
+                              capella_version(cfg)])

@@ -4,8 +4,8 @@ TPU-build equivalent of the reference's SSZ sub-framework (reference:
 infrastructure/ssz/ — SszSchema/SszContainer/TreeNode hierarchy).
 """
 
-from .hash import (ZERO_CHUNK, hash_pair, merkleize, mix_in_length,
-                   mix_in_selector, pack_bytes, zero_hash)
+from .hash import (ZERO_CHUNK, hash_pair, merkle_branch, merkleize,
+                   mix_in_length, mix_in_selector, pack_bytes, zero_hash)
 from .types import (Bitlist, BitlistType, Bitvector, BitvectorType, boolean,
                     ByteList, ByteListType, Bytes4, Bytes20, Bytes32,
                     Bytes48, Bytes96, ByteVector, ByteVectorType, Container,
@@ -14,7 +14,7 @@ from .types import (Bitlist, BitlistType, Bitvector, BitvectorType, boolean,
                     UnionType, Vector, VectorType)
 
 __all__ = [
-    "ZERO_CHUNK", "hash_pair", "merkleize", "mix_in_length",
+    "ZERO_CHUNK", "hash_pair", "merkle_branch", "merkleize", "mix_in_length",
     "mix_in_selector", "pack_bytes", "zero_hash",
     "Bitlist", "BitlistType", "Bitvector", "BitvectorType", "boolean",
     "ByteList", "ByteListType", "Bytes4", "Bytes20", "Bytes32", "Bytes48",

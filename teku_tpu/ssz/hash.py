@@ -66,6 +66,30 @@ def merkleize(chunks: Sequence[bytes], limit: Optional[int] = None) -> bytes:
     return level[0]
 
 
+def merkle_branch(chunks: Sequence[bytes], index: int,
+                  limit: Optional[int] = None) -> List[bytes]:
+    """Sibling path (bottom-up) proving chunks[index] against
+    merkleize(chunks, limit) — the proof-generation dual of merkleize,
+    with the same virtual zero-padding (reference: the backing-tree
+    branch collection infrastructure/ssz uses for light-client and
+    blob-sidecar inclusion proofs)."""
+    count = len(chunks)
+    size = max(count, 1) if limit is None else limit
+    depth = (size - 1).bit_length() if size > 1 else 0
+    if limit is not None and count > limit:
+        raise ValueError(f"{count} chunks exceed limit {limit}")
+    if index >= max(count, 1):
+        raise ValueError(f"index {index} out of range for {count} chunks")
+    branch = []
+    level = list(chunks) if chunks else [ZERO_CHUNK]
+    for d in range(depth):
+        sib = index ^ 1
+        branch.append(level[sib] if sib < len(level) else zero_hash(d))
+        level = _hash_level(level, zero_hash(d))
+        index >>= 1
+    return branch
+
+
 def mix_in_length(root: bytes, length: int) -> bytes:
     return hash_pair(root, length.to_bytes(32, "little"))
 

@@ -1,0 +1,160 @@
+"""Capella: four-fork ladder, withdrawals sweep, BLS-to-execution
+changes, historical summaries."""
+
+import dataclasses
+
+import pytest
+
+from teku_tpu.crypto import bls
+from teku_tpu.spec import config as C
+from teku_tpu.spec import helpers as H
+from teku_tpu.spec.capella import block as CB
+from teku_tpu.spec.capella.datastructures import (
+    get_capella_schemas, payload_to_header_capella)
+from teku_tpu.spec.builder import (make_local_signer, produce_attestations,
+                                   produce_block)
+from teku_tpu.spec.genesis import interop_genesis
+from teku_tpu.spec.milestones import build_fork_schedule, SpecMilestone
+from teku_tpu.spec.transition import process_slots, state_transition
+from teku_tpu.spec.verifiers import SIMPLE
+
+CFG = dataclasses.replace(C.MINIMAL, ALTAIR_FORK_EPOCH=1,
+                          BELLATRIX_FORK_EPOCH=2, CAPELLA_FORK_EPOCH=3)
+
+
+def test_milestone_schedule_four_forks():
+    sched = build_fork_schedule(CFG)
+    assert sched.milestone_at_epoch(2) is SpecMilestone.BELLATRIX
+    assert sched.milestone_at_epoch(3) is SpecMilestone.CAPELLA
+    assert sched.milestone_at_epoch(999) is SpecMilestone.CAPELLA
+
+
+@pytest.mark.slow
+def test_capella_ladder_finalizes_with_payloads():
+    state, sks = interop_genesis(CFG, 32)
+    signer = make_local_signer(dict(enumerate(sks)))
+    S = get_capella_schemas(CFG)
+    atts = []
+    cur = state
+    for slot in range(1, 6 * CFG.SLOTS_PER_EPOCH + 1):
+        signed, post = produce_block(CFG, cur, slot, signer,
+                                     attestations=atts)
+        verified = state_transition(CFG, cur, signed,
+                                    validate_result=True)
+        assert verified.htr() == post.htr(), f"divergence at slot {slot}"
+        atts = produce_attestations(CFG, post, slot,
+                                    signed.message.htr(), signer)
+        cur = post
+    assert isinstance(cur, S.BeaconState)
+    assert cur.fork.current_version == CFG.CAPELLA_FORK_VERSION
+    assert cur.finalized_checkpoint.epoch >= 3
+    # payload chain is live after the capella fork: one payload per
+    # capella slot (slots 24..48 inclusive on this schedule)
+    n_payloads = 3 * CFG.SLOTS_PER_EPOCH + 1
+    assert cur.latest_execution_payload_header.block_number == n_payloads
+    # sweep cursor moved (no withdrawable validators: BLS credentials)
+    assert cur.next_withdrawal_index == 0
+    assert cur.next_withdrawal_validator_index \
+        == n_payloads * CFG.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP % 32
+
+
+def _capella_state(n=16):
+    cfg = dataclasses.replace(CFG, ALTAIR_FORK_EPOCH=0,
+                              BELLATRIX_FORK_EPOCH=0, CAPELLA_FORK_EPOCH=0)
+    state, sks = interop_genesis(cfg, n)
+    return cfg, state, sks
+
+
+def test_expected_withdrawals_sweep():
+    cfg, state, _ = _capella_state()
+    # nobody has eth1 credentials yet -> empty sweep
+    assert CB.get_expected_withdrawals(cfg, state) == []
+    # give validator 3 an eth1 credential and an excess balance -> skim
+    validators = list(state.validators)
+    validators[3] = validators[3].copy_with(
+        withdrawal_credentials=b"\x01" + bytes(11) + b"\xaa" * 20)
+    balances = list(state.balances)
+    balances[3] = cfg.MAX_EFFECTIVE_BALANCE + 5
+    state = state.copy_with(validators=tuple(validators),
+                            balances=tuple(balances))
+    (w,) = CB.get_expected_withdrawals(cfg, state)
+    assert w.validator_index == 3 and w.amount == 5
+    assert w.address == b"\xaa" * 20
+    # exit validator 3 -> full withdrawal of the whole balance
+    validators[3] = validators[3].copy_with(withdrawable_epoch=0)
+    state = state.copy_with(validators=tuple(validators))
+    (w,) = CB.get_expected_withdrawals(cfg, state)
+    assert w.amount == cfg.MAX_EFFECTIVE_BALANCE + 5
+
+
+def test_process_withdrawals_applies_and_advances_cursor():
+    cfg, state, _ = _capella_state()
+    validators = list(state.validators)
+    validators[2] = validators[2].copy_with(
+        withdrawal_credentials=b"\x01" + bytes(11) + b"\xbb" * 20)
+    balances = list(state.balances)
+    balances[2] = cfg.MAX_EFFECTIVE_BALANCE + 7
+    state = state.copy_with(validators=tuple(validators),
+                            balances=tuple(balances))
+    S = get_capella_schemas(cfg)
+    payload = S.ExecutionPayload(
+        withdrawals=tuple(CB.get_expected_withdrawals(cfg, state)))
+    post = CB.process_withdrawals(cfg, state, payload)
+    assert post.balances[2] == cfg.MAX_EFFECTIVE_BALANCE
+    assert post.next_withdrawal_index == 1
+    # wrong withdrawal list rejected
+    with pytest.raises(Exception):
+        CB.process_withdrawals(cfg, state, S.ExecutionPayload())
+
+
+def test_bls_to_execution_change():
+    cfg, state, sks = _capella_state()
+    S = get_capella_schemas(cfg)
+    idx = 5
+    pk = bls.secret_to_public_key(sks[idx])
+    change = S.BLSToExecutionChange(validator_index=idx,
+                                    from_bls_pubkey=pk,
+                                    to_execution_address=b"\xcc" * 20)
+    domain = H.compute_domain(C.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+                              cfg.GENESIS_FORK_VERSION,
+                              state.genesis_validators_root)
+    sig = bls.sign(sks[idx], H.compute_signing_root(change, domain))
+    signed = S.SignedBLSToExecutionChange(message=change, signature=sig)
+    post = CB.process_bls_to_execution_change(cfg, state, signed, SIMPLE)
+    creds = post.validators[idx].withdrawal_credentials
+    assert creds[:1] == b"\x01" and creds[12:] == b"\xcc" * 20
+    # replay against the now-eth1 credential is rejected
+    with pytest.raises(Exception):
+        CB.process_bls_to_execution_change(cfg, post, signed, SIMPLE)
+    # a signature by the wrong key is rejected
+    bad = S.SignedBLSToExecutionChange(
+        message=change, signature=bls.sign(sks[idx + 1], H.
+                                           compute_signing_root(change,
+                                                                domain)))
+    with pytest.raises(Exception):
+        CB.process_bls_to_execution_change(cfg, state, bad, SIMPLE)
+
+
+def test_historical_summaries_replace_roots():
+    """Crossing a SLOTS_PER_HISTORICAL_ROOT boundary post-capella
+    appends to historical_summaries, never to historical_roots."""
+    cfg, state, sks = _capella_state(n=16)
+    period = cfg.SLOTS_PER_HISTORICAL_ROOT  # 64 slots on minimal
+    n_roots = len(state.historical_roots)
+    adv = process_slots(cfg, state, period)
+    assert len(adv.historical_roots) == n_roots
+    assert len(adv.historical_summaries) == 1
+    s = adv.historical_summaries[0]
+    assert s.block_summary_root != bytes(32)
+    assert s.state_summary_root != bytes(32)
+
+
+def test_capella_payload_header_has_withdrawals_root():
+    S = get_capella_schemas(CFG)
+    payload = S.ExecutionPayload(
+        block_hash=b"\x11" * 32,
+        withdrawals=(S.Withdrawal(index=0, validator_index=1,
+                                  address=b"\x22" * 20, amount=9),))
+    header = payload_to_header_capella(payload)
+    assert header.block_hash == payload.block_hash
+    assert header.withdrawals_root != bytes(32)
