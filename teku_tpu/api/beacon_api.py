@@ -53,6 +53,7 @@ class BeaconRestApi(RestApi):
         p("/eth/v1/validator/duties/attester/{epoch}", self._attester_duties)
         p("/eth/v1/beacon/pool/attestations", self._submit_attestations)
         p("/eth/v1/beacon/pool/voluntary_exits", self._submit_exit)
+        g("/eth/v1/beacon/blob_sidecars/{block_id}", self._blob_sidecars)
         g("/metrics", self._metrics)
 
     # -- resolution helpers -------------------------------------------
@@ -151,13 +152,43 @@ class BeaconRestApi(RestApi):
                 "body_root": _hex(block.body.htr())}}},
             "execution_optimistic": False, "finalized": False}
 
+    async def _blob_sidecars(self, block_id: str):
+        """Deneb blob sidecars for one block (reference: handlers/v1/
+        beacon/GetBlobSidecars.java), served from the tracking pool."""
+        root = self._resolve_block_root(block_id)
+        pool = getattr(self.node, "blob_pool", None)
+        sidecars = pool.wire_sidecars_for(root) if pool is not None else []
+        out = []
+        for sc in sidecars:
+            hdr = sc.signed_block_header.message
+            out.append({
+                "index": str(sc.index),
+                "blob": _hex(bytes(sc.blob)),
+                "kzg_commitment": _hex(sc.kzg_commitment),
+                "kzg_proof": _hex(sc.kzg_proof),
+                "signed_block_header": {
+                    "message": {
+                        "slot": str(hdr.slot),
+                        "proposer_index": str(hdr.proposer_index),
+                        "parent_root": _hex(hdr.parent_root),
+                        "state_root": _hex(hdr.state_root),
+                        "body_root": _hex(hdr.body_root),
+                    },
+                    "signature": _hex(sc.signed_block_header.signature),
+                },
+                "kzg_commitment_inclusion_proof": [
+                    _hex(h) for h in sc.kzg_commitment_inclusion_proof],
+            })
+        return {"data": out}
+
     async def _block(self, block_id: str):
         root = self._resolve_block_root(block_id)
         signed = self.node.store.signed_blocks.get(root)
         if signed is None:
             raise HttpError(404, "signed block not retained")
         block = signed.message
-        return {"version": "phase0", "data": {
+        version = self.node.spec.milestone_at_slot(block.slot).name.lower()
+        return {"version": version, "data": {
             "message": {
                 "slot": str(block.slot),
                 "proposer_index": str(block.proposer_index),

@@ -26,6 +26,8 @@ PING = "ping"
 METADATA = "metadata"
 BLOCKS_BY_RANGE = "beacon_blocks_by_range"
 BLOCKS_BY_ROOT = "beacon_blocks_by_root"
+BLOB_SIDECARS_BY_RANGE = "blob_sidecars_by_range"
+BLOB_SIDECARS_BY_ROOT = "blob_sidecars_by_root"
 
 MAX_REQUEST_BLOCKS = 64
 
@@ -114,27 +116,43 @@ class BeaconRpc:
                 start, count = struct.unpack(
                     "<QQ", snappyc.uncompress(body))
                 count = min(count, MAX_REQUEST_BLOCKS)
-                return _pack_chunks(self._blocks_by_range(start, count))
+                return _pack_chunks(
+                    [serialize_signed_block(s)
+                     for s in self._canonical_signed_in_range(start, count)])
             if method == BLOCKS_BY_ROOT:
                 roots_blob = snappyc.uncompress(body)
                 roots = [roots_blob[i:i + 32]
                          for i in range(0, min(len(roots_blob),
                                                32 * MAX_REQUEST_BLOCKS), 32)]
                 return _pack_chunks(self._blocks_by_root(roots))
+            if method == BLOB_SIDECARS_BY_RANGE:
+                start, count = struct.unpack(
+                    "<QQ", snappyc.uncompress(body))
+                cfg = self.node.spec.config
+                count = min(count, cfg.MAX_REQUEST_BLOCKS_DENEB)
+                return _pack_chunks(
+                    self._blob_sidecars_by_range(start, count))
+            if method == BLOB_SIDECARS_BY_ROOT:
+                ids_blob = snappyc.uncompress(body)
+                cap = self.node.spec.config.MAX_REQUEST_BLOB_SIDECARS
+                ids = [(ids_blob[i:i + 32],
+                        int.from_bytes(ids_blob[i + 32:i + 40], "little"))
+                       for i in range(0, min(len(ids_blob), 40 * cap),
+                                      40)]
+                return _pack_chunks(self._blob_sidecars_by_root(ids))
             if self._next_handler is not None:
                 return await self._next_handler(peer, method, body)
         except Exception:
             _LOG.exception("rpc %s failed", method)
         return _pack_chunks([], ok=False)
 
-    def _blocks_by_range(self, start: int, count: int) -> List[bytes]:
-        """Canonical-chain blocks in [start, start+count) by slot."""
+    def _canonical_roots_in_range(self, start: int,
+                                  count: int) -> List[bytes]:
+        """Canonical-chain block roots with slot in [start, start+count),
+        ascending — the shared walk for blocks and blob sidecars."""
         store = self.node.store
-        out = []
-        head = self.node.chain.head_root
-        # walk canonical chain from head down, collect in-range
         chain = []
-        root = head
+        root = self.node.chain.head_root
         while root in store.blocks:
             blk = store.blocks[root]
             if blk.slot < start:
@@ -145,17 +163,47 @@ class BeaconRpc:
             if parent == root or parent not in store.blocks:
                 break
             root = parent
-        signed_blocks = store.signed_blocks
-        for r in reversed(chain):
-            signed = signed_blocks.get(r)
-            if signed is not None:
-                out.append(serialize_signed_block(signed))
-        return out
+        chain.reverse()
+        return chain
+
+    def _canonical_signed_in_range(self, start: int, count: int) -> List:
+        signed_blocks = self.node.store.signed_blocks
+        return [s for r in self._canonical_roots_in_range(start, count)
+                if (s := signed_blocks.get(r)) is not None]
 
     def _blocks_by_root(self, roots: Sequence[bytes]) -> List[bytes]:
         signed_blocks = self.node.store.signed_blocks
         return [serialize_signed_block(signed_blocks[r])
                 for r in roots if r in signed_blocks]
+
+    # -- blob sidecars (deneb req/resp; served from the tracking pool) --
+    def _blob_pool(self):
+        return getattr(self.node, "blob_pool", None)
+
+    def _blob_sidecars_by_range(self, start: int,
+                                count: int) -> List[bytes]:
+        pool = self._blob_pool()
+        if pool is None:
+            return []
+        cap = self.node.spec.config.MAX_REQUEST_BLOB_SIDECARS
+        out = []
+        for r in self._canonical_roots_in_range(start, count):
+            for sc in pool.wire_sidecars_for(r):
+                out.append(type(sc).serialize(sc))
+                if len(out) >= cap:
+                    return out
+        return out
+
+    def _blob_sidecars_by_root(self, ids) -> List[bytes]:
+        pool = self._blob_pool()
+        if pool is None:
+            return []
+        out = []
+        for root, index in ids:
+            for sc in pool.wire_sidecars_for(root):
+                if sc.index == index:
+                    out.append(type(sc).serialize(sc))
+        return out
 
     # -- client side ---------------------------------------------------
     async def exchange_status(self, peer: Peer) -> Optional[Status]:
@@ -190,3 +238,31 @@ class BeaconRpc:
             return []
         cfg = self.node.spec.config
         return [deserialize_signed_block(cfg, c) for c in chunks]
+
+    def _sidecar_schema(self):
+        from ..spec.deneb.datastructures import get_deneb_schemas
+        return get_deneb_schemas(self.node.spec.config).BlobSidecar
+
+    async def blob_sidecars_by_range(self, peer: Peer, start: int,
+                                     count: int) -> List:
+        resp = await peer.request(
+            BLOB_SIDECARS_BY_RANGE,
+            snappyc.compress(struct.pack("<QQ", start, count)),
+            timeout=30.0)
+        chunks = _unpack_chunks(resp)
+        if chunks is None:
+            return []
+        schema = self._sidecar_schema()
+        return [schema.deserialize(c) for c in chunks]
+
+    async def blob_sidecars_by_root(self, peer: Peer, ids) -> List:
+        """ids: (block_root, index) pairs (spec BlobIdentifier)."""
+        body = b"".join(root + index.to_bytes(8, "little")
+                        for root, index in ids)
+        resp = await peer.request(BLOB_SIDECARS_BY_ROOT,
+                                  snappyc.compress(body), timeout=30.0)
+        chunks = _unpack_chunks(resp)
+        if chunks is None:
+            return []
+        schema = self._sidecar_schema()
+        return [schema.deserialize(c) for c in chunks]

@@ -55,6 +55,7 @@ class SyncService:
                     break
                 if not blocks:
                     break
+                await self._fetch_blobs_for(peer, blocks, start, count)
                 for signed in blocks:
                     if self.node.block_manager.import_block(signed):
                         self.blocks_imported += 1
@@ -66,6 +67,29 @@ class SyncService:
         finally:
             self.syncing = False
         return imported_any
+
+    async def _fetch_blobs_for(self, peer, blocks, start: int,
+                               count: int) -> None:
+        """Pull the sidecars a batch of blocks needs BEFORE importing,
+        so the availability gate passes (reference BatchDataRequester
+        requests blocks and blobs together).  Sidecars are pool-added
+        with full verification (inclusion proof + KZG)."""
+        need = [s for s in blocks
+                if getattr(s.message.body, "blob_kzg_commitments", ())]
+        if not need:
+            return
+        cfg = self.node.spec.config
+        pool = getattr(self.node, "blob_pool", None)
+        if pool is None:
+            return
+        try:
+            sidecars = await self.rpc.blob_sidecars_by_range(
+                peer, start, count)
+        except Exception as exc:
+            _LOG.warning("blob range request failed: %s", exc)
+            return
+        for sc in sidecars:
+            pool.add_spec_sidecar(cfg, sc)
 
     async def run_until_synced(self, max_rounds: int = 50) -> None:
         for _ in range(max_rounds):

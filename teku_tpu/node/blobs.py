@@ -49,19 +49,24 @@ class BlobSidecarPool:
     gossip/RPC; the availability check runs once all indices are in."""
 
     def __init__(self, setup: Optional[kzg.TrustedSetup] = None,
-                 max_blocks: int = 64):
+                 max_blocks: int = 64,
+                 max_blobs: int = MAX_BLOBS_PER_BLOCK):
         self._by_block: LimitedMap = LimitedMap(max_blocks)
         self._setup = setup
         self._verified: LimitedMap = LimitedMap(256)
+        # wire-format (deneb) sidecars retained for req/resp serving
+        self._wire: LimitedMap = LimitedMap(max_blocks)
+        self.max_blobs = max_blobs
 
-    def add_sidecar(self, sidecar: BlobSidecar) -> bool:
+    def add_sidecar(self, sidecar: BlobSidecar,
+                    proof_checked: bool = False) -> bool:
         """Track one gossiped sidecar.  The sidecar's OWN proof is
         verified at the door and the bucket is keyed by
         (index, commitment): a junk sidecar can neither occupy an index
         (proof fails → dropped) nor shadow the honest one for the same
         index (different commitment → separate slot) — first-wins dedup
         on bare indices would let one bad message brick the block."""
-        if sidecar.index >= MAX_BLOBS_PER_BLOCK:
+        if sidecar.index >= self.max_blobs:
             return False
         if len(sidecar.blob) != kzg.BYTES_PER_BLOB:
             return False
@@ -72,7 +77,7 @@ class BlobSidecarPool:
         key = (sidecar.index, sidecar.kzg_commitment)
         if key in bucket:
             return False
-        if not kzg.verify_blob_kzg_proof(
+        if not proof_checked and not kzg.verify_blob_kzg_proof(
                 bytes(sidecar.blob), sidecar.kzg_commitment,
                 sidecar.kzg_proof, self._setup):
             return False
@@ -104,5 +109,97 @@ class BlobSidecarPool:
 
     def prune_block(self, block_root: bytes) -> None:
         self._by_block.pop(block_root)
+        self._wire.pop(block_root)
         for key in [k for k in self._verified if k[0] == block_root]:
             self._verified.pop(key)
+
+    def add_spec_sidecar(self, cfg, sidecar,
+                         proof_checked: bool = False) -> bool:
+        """Track a deneb wire-format sidecar (signed header + inclusion
+        proof): the block root is derived from its own header, the
+        inclusion proof binds the commitment to that block's body, and
+        the blob proof is checked by the regular add path."""
+        from ..spec.deneb.block import max_blobs_for_slot
+        from ..spec.deneb.datastructures import (
+            verify_commitment_inclusion_proof)
+        header = sidecar.signed_block_header.message
+        # the slot's milestone is authoritative for the wire path:
+        # electra raises the cap, and the pool bound must follow so a
+        # gossip-accepted index can't be silently dropped here
+        self.max_blobs = max(self.max_blobs,
+                             max_blobs_for_slot(cfg, header.slot))
+        if not verify_commitment_inclusion_proof(cfg, sidecar):
+            return False
+        root = header.htr()
+        ok = self.add_sidecar(BlobSidecar(
+            index=sidecar.index, blob=bytes(sidecar.blob),
+            kzg_commitment=sidecar.kzg_commitment,
+            kzg_proof=sidecar.kzg_proof,
+            block_root=root, slot=header.slot),
+            proof_checked=proof_checked)
+        if ok:
+            bucket = self._wire.get(root)
+            if bucket is None:
+                bucket = {}
+                self._wire.put(root, bucket)
+            bucket[sidecar.index] = sidecar
+        return ok
+
+    def wire_sidecars_for(self, block_root: bytes) -> List:
+        """Deneb wire-format sidecars for one block, index order (the
+        req/resp serving shape, reference BlobSidecarsByRoot/Range)."""
+        bucket = self._wire.get(block_root) or {}
+        return [bucket[i] for i in sorted(bucket)]
+
+
+def validate_spec_sidecar(cfg, sidecar, state=None,
+                          setup: Optional[kzg.TrustedSetup] = None,
+                          seen: Optional[set] = None) -> str:
+    """Gossip-grade validation of a deneb BlobSidecar (reference:
+    statetransition/validation/BlobSidecarGossipValidator — index
+    bound, dedup, inclusion proof, proposer header signature, KZG
+    proof).  `state` enables the proposer-signature check (any state
+    whose shuffling covers the sidecar's slot); returns an
+    "accept"/"ignore"/"reject" string matching ValidationResult values.
+    """
+    from ..spec import helpers as H
+    from ..spec.config import DOMAIN_BEACON_PROPOSER
+    from ..spec.deneb.datastructures import (
+        verify_commitment_inclusion_proof)
+    from ..crypto import bls
+    from ..spec.deneb.block import max_blobs_for_slot
+    header = sidecar.signed_block_header.message
+    if sidecar.index >= max_blobs_for_slot(cfg, header.slot):
+        return "reject"
+    key = (header.htr(), sidecar.index)
+    if seen is not None and key in seen:
+        return "ignore"
+    if not verify_commitment_inclusion_proof(cfg, sidecar):
+        return "reject"
+    if state is not None:
+        try:
+            proposer = state.validators[header.proposer_index]
+        except IndexError:
+            return "reject"
+        # the claimed proposer must BE the slot's expected proposer —
+        # otherwise any validator could sign headers for junk sidecars
+        try:
+            expected = H.get_beacon_proposer_index(cfg, state,
+                                                   slot=header.slot)
+        except ValueError:
+            return "ignore"   # state can't answer for this epoch
+        if header.proposer_index != expected:
+            return "reject"
+        domain = H.get_domain(cfg, state, DOMAIN_BEACON_PROPOSER,
+                              header.slot // cfg.SLOTS_PER_EPOCH)
+        root = H.compute_signing_root(header, domain)
+        if not bls.verify(proposer.pubkey, root,
+                          sidecar.signed_block_header.signature):
+            return "reject"
+    if not kzg.verify_blob_kzg_proof(bytes(sidecar.blob),
+                                     sidecar.kzg_commitment,
+                                     sidecar.kzg_proof, setup):
+        return "reject"
+    if seen is not None:
+        seen.add(key)
+    return "accept"

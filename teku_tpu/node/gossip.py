@@ -119,3 +119,10 @@ VOLUNTARY_EXIT_TOPIC = "voluntary_exit"
 SYNC_COMMITTEE_TOPIC = "sync_committee"
 PROPOSER_SLASHING_TOPIC = "proposer_slashing"
 ATTESTER_SLASHING_TOPIC = "attester_slashing"
+BLS_TO_EXECUTION_CHANGE_TOPIC = "bls_to_execution_change"
+
+
+def blob_sidecar_topic(subnet_id: int) -> str:
+    """Deneb blob sidecars gossip per index subnet (spec
+    blob_sidecar_{subnet_id})."""
+    return f"blob_sidecar_{subnet_id}"

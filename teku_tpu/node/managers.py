@@ -80,15 +80,17 @@ class AttestationManager:
 class BlockManager:
     def __init__(self, spec: Spec, chain: RecentChainData,
                  channels: Optional[EventChannels] = None,
-                 max_pending: int = 256):
+                 max_pending: int = 256, blob_pool=None):
         self.spec = spec
         self.chain = chain
         self._channels = channels or EventChannels()
         self._pending_by_parent: Dict[bytes, List] = defaultdict(list)
         self._future_by_slot: Dict[int, List] = defaultdict(list)
+        self._awaiting_blobs: Dict[bytes, object] = {}
         self._max_pending = max_pending
         self._n_pending = 0
         self.on_imported: List[Callable[[bytes], None]] = []
+        self.blob_pool = blob_pool
 
     def import_block(self, signed_block) -> bool:
         """Import into fork choice; returns True if now in the store.
@@ -105,6 +107,24 @@ class BlockManager:
             self._enqueue(self._pending_by_parent[block.parent_root],
                           signed_block)
             return False
+        # deneb availability gate (reference ForkChoice.onBlock →
+        # BlobSidecarsAvailabilityChecker): a block whose commitments
+        # lack proof-verified sidecars waits, an invalid set rejects
+        commitments = getattr(block.body, "blob_kzg_commitments", ())
+        if commitments and self.blob_pool is not None:
+            from .blobs import AvailabilityResult
+            verdict = self.blob_pool.check_availability(
+                root, list(commitments))
+            if verdict != AvailabilityResult.AVAILABLE:
+                # absence is only ever PENDING (proof failures are
+                # dropped at pool-add time, so "provably invalid"
+                # cannot be observed here); parked blocks expire in
+                # on_slot if the sidecars never arrive
+                if root not in self._awaiting_blobs \
+                        and self._n_pending < self._max_pending:
+                    self._awaiting_blobs[root] = signed_block
+                    self._n_pending += 1
+                return False
         # step-timed like the reference's BlockImportPerformance
         # (invoked at ForkChoice.java:221,455,462)
         from ..infra.perf import StepTimer
@@ -135,8 +155,31 @@ class BlockManager:
         bucket.append(signed_block)
         self._n_pending += 1
 
+    def retry_pending_blobs(self) -> None:
+        """Re-attempt blocks parked on blob availability (called when a
+        new sidecar lands)."""
+        for root in list(self._awaiting_blobs):
+            signed = self._awaiting_blobs.pop(root)
+            self._n_pending -= 1
+            self.import_block(signed)
+
     def on_slot(self, slot: int) -> None:
         for s in [s for s in self._future_by_slot if s <= slot]:
             for blk in self._future_by_slot.pop(s):
                 self._n_pending -= 1
                 self.import_block(blk)
+        # blob-parked blocks: retry each slot (sidecars may have come
+        # in via sync RPC), and give up after an epoch of waiting so a
+        # withheld sidecar can't pin the pending budget forever
+        horizon = self.spec.config.SLOTS_PER_EPOCH
+        for root in list(self._awaiting_blobs):
+            signed = self._awaiting_blobs[root]
+            if signed.message.slot + horizon < slot:
+                del self._awaiting_blobs[root]
+                self._n_pending -= 1
+                _LOG.warning("block %s dropped: blobs never arrived",
+                             root.hex()[:8])
+            else:
+                del self._awaiting_blobs[root]
+                self._n_pending -= 1
+                self.import_block(signed)

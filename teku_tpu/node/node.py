@@ -53,9 +53,14 @@ class BeaconNode(Service):
         S = spec.schemas
         self.channels = EventChannels()
         if store is None:
-            anchor = S.BeaconBlock(
+            # the anchor block must use the schemas of the milestone
+            # governing the anchor slot — otherwise its root disagrees
+            # with the state's own latest_block_header and nothing can
+            # ever chain onto genesis on a later-fork-at-genesis net
+            A = spec.at_slot(genesis_state.slot).schemas
+            anchor = A.BeaconBlock(
                 slot=genesis_state.slot, parent_root=bytes(32),
-                state_root=genesis_state.htr(), body=S.BeaconBlockBody())
+                state_root=genesis_state.htr(), body=A.BeaconBlockBody())
             store = Store(spec.config, genesis_state, anchor)
         self.store = store
         self.chain = RecentChainData(spec, self.store, self.channels)
@@ -70,7 +75,13 @@ class BeaconNode(Service):
         self.sync_pool = SyncCommitteeMessagePool(spec.config)
         self.attestation_manager = AttestationManager(
             spec, self.chain, pool=self.pool)
-        self.block_manager = BlockManager(spec, self.chain, self.channels)
+        from .blobs import BlobSidecarPool
+        self.blob_pool = BlobSidecarPool(
+            max_blobs=spec.config.MAX_BLOBS_PER_BLOCK_ELECTRA)
+        from ..infra.collections import LimitedSet
+        self._seen_blob_sidecars = LimitedSet(16384)
+        self.block_manager = BlockManager(spec, self.chain, self.channels,
+                                          blob_pool=self.blob_pool)
         self.block_manager.on_imported.append(
             self.attestation_manager.on_block_imported)
         self.block_manager.on_imported.append(self._prune_included_ops)
@@ -112,7 +123,12 @@ class BeaconNode(Service):
 
     # ------------------------------------------------------------------
     def _subscribe_topics(self) -> None:
-        S = self.spec.schemas
+        # schema family of the milestone governing the chain's head:
+        # a devnet starting at altair/deneb/electra must decode that
+        # fork's gossip shapes (mid-run fork transitions would need the
+        # reference's GossipForkManager resubscription — the in-memory
+        # topics carry no fork digest yet)
+        S = self.spec.at_slot(self.chain.head_slot()).schemas
         from ..spec.codec import deserialize_signed_block
         cfg = self.spec.config
 
@@ -143,6 +159,54 @@ class BeaconNode(Service):
             self.gossip.subscribe(topic, SszTopicHandler(
                 schema, self._make_op_processor(pool_name), topic))
         self._subscribe_sync_topic()
+        self._subscribe_blob_topics()
+
+    def _subscribe_blob_topics(self) -> None:
+        from ..spec.config import FAR_FUTURE_EPOCH
+        from ..spec.deneb.block import max_blobs_for_slot
+        from ..spec.deneb.datastructures import get_deneb_schemas
+        from .gossip import blob_sidecar_topic
+        cfg = self.spec.config
+        if cfg.DENEB_FORK_EPOCH == FAR_FUTURE_EPOCH:
+            return          # no blobs on this network
+        schema = get_deneb_schemas(cfg).BlobSidecar
+        n_subnets = max(cfg.MAX_BLOBS_PER_BLOCK,
+                        cfg.MAX_BLOBS_PER_BLOCK_ELECTRA)
+        for subnet in range(n_subnets):
+            self.gossip.subscribe(
+                blob_sidecar_topic(subnet), SszTopicHandler(
+                    schema, self._process_gossip_blob_sidecar,
+                    f"blob_sidecar_{subnet}"))
+
+    async def _process_gossip_blob_sidecar(self, sidecar
+                                           ) -> ValidationResult:
+        """reference BlobSidecarGossipValidator → tracking pool: the
+        proposer-signature check runs against a same-epoch state when
+        the chain has one."""
+        from .blobs import validate_spec_sidecar
+        cfg = self.spec.config
+        slot = sidecar.signed_block_header.message.slot
+        # slot window FIRST — the slot is wire-controlled, and state
+        # advancement below must stay bounded by the wall clock
+        current = self.chain.current_slot()
+        if slot > current:
+            return ValidationResult.SAVE_FOR_FUTURE
+        if slot + cfg.ATTESTATION_PROPAGATION_SLOT_RANGE < current:
+            return ValidationResult.IGNORE
+        try:
+            state = self.advanced_head_state(slot)
+        except Exception:
+            state = None
+        verdict = validate_spec_sidecar(cfg, sidecar, state=state,
+                                        setup=self.blob_pool._setup,
+                                        seen=self._seen_blob_sidecars)
+        if verdict == "accept":
+            # proof already verified just above — don't pay the
+            # multi-pairing twice on the gossip hot path
+            self.blob_pool.add_spec_sidecar(cfg, sidecar,
+                                            proof_checked=True)
+            self.block_manager.retry_pending_blobs()
+        return ValidationResult(verdict)
 
     def _subscribe_sync_topic(self) -> None:
         from .gossip import SYNC_COMMITTEE_TOPIC

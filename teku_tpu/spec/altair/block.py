@@ -17,17 +17,21 @@ _require = B0._require
 
 
 def process_attestation(cfg: SpecConfig, state, attestation,
-                        verifier: SignatureVerifier):
+                        verifier: SignatureVerifier,
+                        enforce_upper_window: bool = True):
     data = attestation.data
     _require(data.target.epoch in (H.get_previous_epoch(cfg, state),
                                    H.get_current_epoch(cfg, state)),
              "target epoch out of range")
     _require(data.target.epoch == H.compute_epoch_at_slot(cfg, data.slot),
              "target/slot mismatch")
-    # the upper window bound still applies in altair (dropped only at
-    # deneb): a stale attestation must invalidate the block
+    # the upper window bound applies through capella; deneb (EIP-7045)
+    # keeps only the min-delay lower bound — the target-epoch check
+    # above then caps staleness at ~2 epochs
     _require(data.slot + cfg.MIN_ATTESTATION_INCLUSION_DELAY
-             <= state.slot <= data.slot + cfg.SLOTS_PER_EPOCH,
+             <= state.slot, "inclusion delay")
+    _require(not enforce_upper_window
+             or state.slot <= data.slot + cfg.SLOTS_PER_EPOCH,
              "inclusion window")
     _require(data.index < H.get_committee_count_per_slot(
         cfg, state, data.target.epoch), "committee index out of range")
@@ -45,16 +49,27 @@ def process_attestation(cfg: SpecConfig, state, attestation,
                                              verifier),
              "bad attestation signature")
 
+    attesting = H.get_attesting_indices(cfg, state, data,
+                                        attestation.aggregation_bits)
+    return _apply_participation_rewards(
+        cfg, state, data, attesting,
+        cap_target_delay=enforce_upper_window)
+
+
+def _apply_participation_rewards(cfg: SpecConfig, state, data,
+                                 attesting_indices,
+                                 cap_target_delay: bool = True):
+    """The flag-accounting tail of process_attestation, shared with
+    electra (which resolves the attesting set via committee bits)."""
     flag_indices = AH.get_attestation_participation_flag_indices(
-        cfg, state, data, state.slot - data.slot)
+        cfg, state, data, state.slot - data.slot,
+        cap_target_delay=cap_target_delay)
     in_current = data.target.epoch == H.get_current_epoch(cfg, state)
     participation = list(state.current_epoch_participation if in_current
                          else state.previous_epoch_participation)
     base_per_inc = AH.get_base_reward_per_increment(cfg, state)
     proposer_reward_numerator = 0
-    attesting = H.get_attesting_indices(cfg, state, data,
-                                        attestation.aggregation_bits)
-    for index in attesting:
+    for index in attesting_indices:
         increments = (state.validators[index].effective_balance
                       // cfg.EFFECTIVE_BALANCE_INCREMENT)
         base_reward = increments * base_per_inc
@@ -147,7 +162,9 @@ def process_block(cfg: SpecConfig, state, block,
     return state
 
 
-def _process_operations(cfg, state, body, verifier, deposit_verifier):
+def _process_operations(cfg, state, body, verifier, deposit_verifier,
+                        enforce_attestation_window: bool = True,
+                        exit_fork_version=None):
     expected = min(cfg.MAX_DEPOSITS,
                    state.eth1_data.deposit_count
                    - state.eth1_deposit_index)
@@ -157,9 +174,13 @@ def _process_operations(cfg, state, body, verifier, deposit_verifier):
     for op in body.attester_slashings:
         state = B0.process_attester_slashing(cfg, state, op, verifier)
     for op in body.attestations:
-        state = process_attestation(cfg, state, op, verifier)
+        state = process_attestation(
+            cfg, state, op, verifier,
+            enforce_upper_window=enforce_attestation_window)
     for op in body.deposits:
         state = process_deposit(cfg, state, op, deposit_verifier)
     for op in body.voluntary_exits:
-        state = B0.process_voluntary_exit(cfg, state, op, verifier)
+        state = B0.process_voluntary_exit(
+            cfg, state, op, verifier,
+            exit_fork_version=exit_fork_version)
     return state

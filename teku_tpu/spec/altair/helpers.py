@@ -34,8 +34,11 @@ def get_base_reward(cfg: SpecConfig, state, index: int) -> int:
 
 
 def get_attestation_participation_flag_indices(
-        cfg: SpecConfig, state, data, inclusion_delay: int) -> List[int]:
-    """Spec get_attestation_participation_flag_indices."""
+        cfg: SpecConfig, state, data, inclusion_delay: int,
+        cap_target_delay: bool = True) -> List[int]:
+    """Spec get_attestation_participation_flag_indices.  Deneb
+    (EIP-7045) drops the SLOTS_PER_EPOCH cap on the target flag —
+    `cap_target_delay=False` selects that behavior."""
     justified = (state.current_justified_checkpoint
                  if data.target.epoch == H.get_current_epoch(cfg, state)
                  else state.previous_justified_checkpoint)
@@ -53,7 +56,8 @@ def get_attestation_participation_flag_indices(
             and inclusion_delay
             <= H.integer_squareroot(cfg.SLOTS_PER_EPOCH)):
         out.append(TIMELY_SOURCE_FLAG_INDEX)
-    if is_matching_target and inclusion_delay <= cfg.SLOTS_PER_EPOCH:
+    if is_matching_target and (not cap_target_delay
+                               or inclusion_delay <= cfg.SLOTS_PER_EPOCH):
         out.append(TIMELY_TARGET_FLAG_INDEX)
     if (is_matching_head
             and inclusion_delay == cfg.MIN_ATTESTATION_INCLUSION_DELAY):

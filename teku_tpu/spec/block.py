@@ -249,7 +249,8 @@ def process_deposit(cfg: SpecConfig, state, deposit,
 
 
 def process_voluntary_exit(cfg: SpecConfig, state, signed_exit,
-                           verifier: SignatureVerifier):
+                           verifier: SignatureVerifier,
+                           exit_fork_version=None):
     exit_msg = signed_exit.message
     _require(exit_msg.validator_index < len(state.validators),
              "exit: unknown validator")
@@ -260,7 +261,15 @@ def process_voluntary_exit(cfg: SpecConfig, state, signed_exit,
     _require(now >= exit_msg.epoch, "exit: future epoch")
     _require(now >= v.activation_epoch + cfg.SHARD_COMMITTEE_PERIOD,
              "exit: too young")
-    domain = H.get_domain(cfg, state, DOMAIN_VOLUNTARY_EXIT, exit_msg.epoch)
+    if exit_fork_version is not None:
+        # deneb+ (EIP-7044): exits verify against a PINNED fork version
+        # so a signed exit never goes stale across future forks
+        domain = H.compute_domain(DOMAIN_VOLUNTARY_EXIT,
+                                  exit_fork_version,
+                                  state.genesis_validators_root)
+    else:
+        domain = H.get_domain(cfg, state, DOMAIN_VOLUNTARY_EXIT,
+                              exit_msg.epoch)
     root = H.compute_signing_root(exit_msg, domain)
     _require(verifier.verify([v.pubkey], root, signed_exit.signature),
              "exit: bad signature")

@@ -59,8 +59,13 @@ def produce_attestations(cfg: SpecConfig, state, slot: int,
                          committee_indices: Optional[Sequence[int]] = None,
                          ) -> List:
     """One fully-aggregated attestation per committee at `slot` (every
-    member signs; bits all set) — the shape a perfect devnet produces."""
-    S = get_schemas(cfg)
+    member signs; bits all set) — the shape a perfect devnet produces.
+    Electra attestations carry the committee in committee_bits with
+    data.index == 0 (EIP-7549)."""
+    from .milestones import build_fork_schedule, SpecMilestone
+    version = build_fork_schedule(cfg).version_at_slot(slot)
+    S = version.schemas
+    electra = version.milestone >= SpecMilestone.ELECTRA
     epoch = H.compute_epoch_at_slot(cfg, slot)
     out = []
     n_committees = H.get_committee_count_per_slot(cfg, state, epoch)
@@ -70,13 +75,17 @@ def produce_attestations(cfg: SpecConfig, state, slot: int,
         committee = H.get_beacon_committee(cfg, state, slot, ci)
         if not committee:
             continue
-        data = attestation_data_for(cfg, state, slot, ci, head_root)
+        data = attestation_data_for(cfg, state, slot,
+                                    0 if electra else ci, head_root)
         domain = H.get_domain(cfg, state, DOMAIN_BEACON_ATTESTER, epoch)
         root = H.compute_signing_root(data, domain)
         sigs = [signer(v, root) for v in committee]
-        out.append(S.Attestation(
-            aggregation_bits=tuple(True for _ in committee), data=data,
-            signature=bls.aggregate_signatures(sigs)))
+        kw = dict(aggregation_bits=tuple(True for _ in committee),
+                  data=data, signature=bls.aggregate_signatures(sigs))
+        if electra:
+            kw["committee_bits"] = tuple(
+                i == ci for i in range(cfg.MAX_COMMITTEES_PER_SLOT))
+        out.append(S.Attestation(**kw))
     return out
 
 
@@ -102,6 +111,12 @@ def build_unsigned_block(cfg: SpecConfig, pre, slot: int,
     assert pre.slot == slot, "pre-state must be advanced to the slot"
     if proposer_index is None:
         proposer_index = H.get_beacon_proposer_index(cfg, pre)
+    # at a fork that reshapes the attestation container (electra), the
+    # previous slot's attestations can't ride in the new body — drop
+    # the mismatched shapes, as clients do across the fork boundary
+    att_elem = S.BeaconBlockBody._ssz_fields["attestations"].elem
+    att_cls = getattr(att_elem, "cls", att_elem)
+    attestations = [a for a in attestations if isinstance(a, att_cls)]
     body_kwargs = dict(
         randao_reveal=randao_reveal,
         eth1_data=pre.eth1_data, graffiti=graffiti,
@@ -173,7 +188,14 @@ def _devnet_payload(cfg: SpecConfig, pre, slot: int, S):
     (the reference's stubbed EL plays the same role,
     ExecutionLayerManagerStub)."""
     from .bellatrix.block import compute_timestamp_at_slot
-    from .capella.block import get_expected_withdrawals
+    if hasattr(pre, "pending_partial_withdrawals"):
+        # electra: the sweep drains the partial queue and uses the
+        # compounding-aware predicates
+        from .electra.block import get_expected_withdrawals
+        withdrawals, _ = get_expected_withdrawals(cfg, pre)
+    else:
+        from .capella.block import get_expected_withdrawals
+        withdrawals = get_expected_withdrawals(cfg, pre)
     header = pre.latest_execution_payload_header
     parent_hash = header.block_hash
     block_hash = H.hash32(b"teku-tpu-devnet-exec" + parent_hash
@@ -186,7 +208,7 @@ def _devnet_payload(cfg: SpecConfig, pre, slot: int, S):
         gas_limit=30_000_000,
         timestamp=compute_timestamp_at_slot(cfg, pre, slot),
         block_hash=block_hash,
-        withdrawals=tuple(get_expected_withdrawals(cfg, pre)))
+        withdrawals=tuple(withdrawals))
     if "excess_blob_gas" in S.ExecutionPayload._ssz_fields:
         kw["blob_gas_used"] = 0
         kw["excess_blob_gas"] = 0

@@ -139,9 +139,13 @@ def process_execution_payload(cfg: SpecConfig, state, body,
         to_header=payload_to_header_capella)
 
 
-def _process_operations(cfg, state, body, verifier, deposit_verifier):
-    state = AB._process_operations(cfg, state, body, verifier,
-                                   deposit_verifier)
+def _process_operations(cfg, state, body, verifier, deposit_verifier,
+                        enforce_attestation_window: bool = True,
+                        exit_fork_version=None):
+    state = AB._process_operations(
+        cfg, state, body, verifier, deposit_verifier,
+        enforce_attestation_window=enforce_attestation_window,
+        exit_fork_version=exit_fork_version)
     for op in body.bls_to_execution_changes:
         state = process_bls_to_execution_change(cfg, state, op, verifier)
     return state

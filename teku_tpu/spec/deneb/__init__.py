@@ -1,0 +1,6 @@
+"""Deneb milestone (EIP-4844 blobs, EIP-7044 pinned exit domains,
+EIP-7045 extended attestation inclusion, EIP-7514 churn cap).
+
+reference: ethereum/spec/src/main/java/tech/pegasys/teku/spec/logic/
+versions/deneb/ and datastructures/blobs/versions/deneb/.
+"""

@@ -1,0 +1,83 @@
+"""Deneb block processing: blob-commitment-aware execution payload,
+pinned exit domains (EIP-7044), extended attestation inclusion
+(EIP-7045).
+
+reference: ethereum/spec/.../logic/versions/deneb/block/
+BlockProcessorDeneb.java (processExecutionPayload passes the blob
+versioned hashes to the engine; MiscHelpersDeneb
+kzgCommitmentToVersionedHash) and util/AttestationUtilDeneb.
+"""
+
+from .. import block as B0
+from .. import helpers as H
+from ..altair import block as AB
+from ..bellatrix import block as BB
+from ..capella import block as CB
+from ..config import SpecConfig, VERSIONED_HASH_VERSION_KZG
+from ..verifiers import SignatureVerifier, SIMPLE
+from .datastructures import payload_to_header_deneb
+
+_require = B0._require
+
+
+def kzg_commitment_to_versioned_hash(commitment: bytes) -> bytes:
+    return VERSIONED_HASH_VERSION_KZG + H.hash32(commitment)[1:]
+
+
+def max_blobs_for_slot(cfg: SpecConfig, slot: int) -> int:
+    """The blob-count cap governing `slot` (electra raises it) — the
+    one lookup gossip validation, pools, and RPC should all share."""
+    from ..milestones import build_fork_schedule, SpecMilestone
+    ms = build_fork_schedule(cfg).milestone_at_slot(slot)
+    return (cfg.MAX_BLOBS_PER_BLOCK_ELECTRA
+            if ms >= SpecMilestone.ELECTRA else cfg.MAX_BLOBS_PER_BLOCK)
+
+
+def process_execution_payload(cfg: SpecConfig, state, body,
+                              execution_engine=BB.ACCEPT_ALL_ENGINE):
+    # deneb adds: the block's blob load must fit, and the engine gets
+    # the versioned hashes to check against the payload's blob txs
+    _require(len(body.blob_kzg_commitments) <= cfg.MAX_BLOBS_PER_BLOCK,
+             "too many blob commitments")
+    versioned_hashes = [kzg_commitment_to_versioned_hash(c)
+                        for c in body.blob_kzg_commitments]
+    engine = _VersionedHashEngine(execution_engine, versioned_hashes)
+    # merge complete by construction at deneb: guard dropped
+    return BB.process_execution_payload(
+        cfg, state, body, engine,
+        to_header=payload_to_header_deneb, transition_guard=False)
+
+
+class _VersionedHashEngine:
+    """Adapter handing the engine the blob versioned hashes alongside
+    the payload (the reference's engine_newPayloadV3 carries them)."""
+
+    def __init__(self, engine, versioned_hashes):
+        self._engine = engine
+        self.versioned_hashes = versioned_hashes
+
+    def notify_new_payload(self, payload) -> bool:
+        notify = getattr(self._engine, "notify_new_payload_deneb", None)
+        if notify is not None:
+            return notify(payload, self.versioned_hashes)
+        return self._engine.notify_new_payload(payload)
+
+
+def process_block(cfg: SpecConfig, state, block,
+                  verifier: SignatureVerifier,
+                  deposit_verifier: SignatureVerifier = SIMPLE,
+                  execution_engine=BB.ACCEPT_ALL_ENGINE):
+    state = B0.process_block_header(cfg, state, block)
+    state = CB.process_withdrawals(cfg, state,
+                                   block.body.execution_payload)
+    state = process_execution_payload(cfg, state, block.body,
+                                      execution_engine)
+    state = B0.process_randao(cfg, state, block.body, verifier)
+    state = B0.process_eth1_data(cfg, state, block.body)
+    state = CB._process_operations(
+        cfg, state, block.body, verifier, deposit_verifier,
+        enforce_attestation_window=False,          # EIP-7045
+        exit_fork_version=cfg.CAPELLA_FORK_VERSION)  # EIP-7044
+    state = AB.process_sync_aggregate(cfg, state,
+                                      block.body.sync_aggregate, verifier)
+    return state

@@ -1,0 +1,202 @@
+"""Deneb containers: blob-gas execution payload, blob commitments in
+the block body, BlobSidecar with its commitment inclusion proof.
+
+reference: ethereum/spec/.../spec/datastructures/execution/versions/
+deneb/ExecutionPayloadDeneb*.java, blobs/versions/deneb/BlobSidecar.java
+(+ MiscHelpersDeneb.verifyBlobSidecarMerkleProof), state/versions/deneb/.
+"""
+
+from functools import lru_cache
+
+from ...ssz import (Bytes32, Bytes48, Bytes96, ByteVector, Container,
+                    List, merkle_branch, mix_in_length, uint64, Vector)
+from ...ssz.hash import hash_pair
+from ..config import SpecConfig
+from ..datastructures import SignedBeaconBlockHeader
+from ..bellatrix.datastructures import _PAYLOAD_COMMON, _container
+from ..capella.datastructures import (Withdrawal, get_capella_schemas)
+from ...ssz import ByteList
+from ..bellatrix.datastructures import (MAX_BYTES_PER_TRANSACTION,
+                                        MAX_TRANSACTIONS_PER_PAYLOAD)
+
+BYTES_PER_FIELD_ELEMENT = 32
+
+_DENEB_PAYLOAD_EXTRA = [("blob_gas_used", uint64),
+                        ("excess_blob_gas", uint64)]
+
+
+def _deneb_payload_pair(cfg: SpecConfig):
+    payload = _container("ExecutionPayloadDeneb", _PAYLOAD_COMMON + [
+        ("transactions", List(ByteList(MAX_BYTES_PER_TRANSACTION),
+                              MAX_TRANSACTIONS_PER_PAYLOAD)),
+        ("withdrawals", List(Withdrawal, cfg.MAX_WITHDRAWALS_PER_PAYLOAD)),
+    ] + _DENEB_PAYLOAD_EXTRA)
+    header = _container("ExecutionPayloadHeaderDeneb", _PAYLOAD_COMMON + [
+        ("transactions_root", Bytes32),
+        ("withdrawals_root", Bytes32),
+    ] + _DENEB_PAYLOAD_EXTRA)
+    return payload, header
+
+
+def payload_to_header_deneb(payload):
+    schema = type(payload)._ssz_fields
+    kw = {name: getattr(payload, name) for name, _ in _PAYLOAD_COMMON}
+    kw["transactions_root"] = schema["transactions"].hash_tree_root(
+        payload.transactions)
+    kw["withdrawals_root"] = schema["withdrawals"].hash_tree_root(
+        payload.withdrawals)
+    kw["blob_gas_used"] = payload.blob_gas_used
+    kw["excess_blob_gas"] = payload.excess_blob_gas
+    return payload.__deneb_header__(**kw)
+
+
+def kzg_commitment_inclusion_proof_depth(cfg: SpecConfig) -> int:
+    """Total depth of the proof from one commitment to the body root:
+    commitments-list subtree + the length mix-in + the body field tree
+    (17 on mainnet: 12 + 1 + 4)."""
+    commitments_depth = max(
+        1, (cfg.MAX_BLOB_COMMITMENTS_PER_BLOCK - 1).bit_length())
+    n_fields = 12  # deneb BeaconBlockBody field count
+    body_depth = (n_fields - 1).bit_length()
+    return commitments_depth + 1 + body_depth
+
+
+class DenebSchemas:
+    def __getattr__(self, name):
+        if name == "capella":
+            raise AttributeError(name)
+        return getattr(self.capella, name)
+
+    def __init__(self, cfg: SpecConfig):
+        self.config = cfg
+        self.capella = get_capella_schemas(cfg)
+        C = self.capella
+        payload, header = _deneb_payload_pair(cfg)
+        payload.__deneb_header__ = header
+        self.ExecutionPayload = payload
+        self.ExecutionPayloadHeader = header
+        self.Blob = ByteVector(cfg.FIELD_ELEMENTS_PER_BLOB
+                               * BYTES_PER_FIELD_ELEMENT)
+        self.KZGCommitment = Bytes48
+        self.KZGProof = Bytes48
+
+        body_fields = dict(C.BeaconBlockBody._ssz_fields.items())
+        body_fields["execution_payload"] = payload
+        body_fields["blob_kzg_commitments"] = List(
+            Bytes48, cfg.MAX_BLOB_COMMITMENTS_PER_BLOCK)
+        self.BeaconBlockBody = _container("BeaconBlockBodyDeneb",
+                                          body_fields.items())
+        self.BeaconBlock = _container("BeaconBlockDeneb", [
+            ("slot", uint64),
+            ("proposer_index", uint64),
+            ("parent_root", Bytes32),
+            ("state_root", Bytes32),
+            ("body", self.BeaconBlockBody),
+        ])
+        self.SignedBeaconBlock = _container("SignedBeaconBlockDeneb", [
+            ("message", self.BeaconBlock),
+            ("signature", Bytes96),
+        ])
+
+        state_fields = dict(C.BeaconState._ssz_fields.items())
+        state_fields["latest_execution_payload_header"] = header
+        self.BeaconState = _container("BeaconStateDeneb",
+                                      state_fields.items())
+
+        depth = kzg_commitment_inclusion_proof_depth(cfg)
+        self.BlobSidecar = _container("BlobSidecar", [
+            ("index", uint64),
+            ("blob", self.Blob),
+            ("kzg_commitment", Bytes48),
+            ("kzg_proof", Bytes48),
+            ("signed_block_header", SignedBeaconBlockHeader),
+            ("kzg_commitment_inclusion_proof", Vector(Bytes32, depth)),
+        ])
+        self.BlobIdentifier = _container("BlobIdentifier", [
+            ("block_root", Bytes32),
+            ("index", uint64),
+        ])
+
+
+@lru_cache(maxsize=8)
+def get_deneb_schemas(cfg: SpecConfig) -> DenebSchemas:
+    return DenebSchemas(cfg)
+
+
+# ---- commitment inclusion proofs (build + verify) ----
+
+def compute_commitment_inclusion_proof(cfg: SpecConfig, body,
+                                       index: int):
+    """Sibling path from body.blob_kzg_commitments[index] to the body
+    root: branch inside the commitments subtree, then the list-length
+    chunk, then the body-level field siblings."""
+    fields = type(body)._ssz_fields
+    limit = cfg.MAX_BLOB_COMMITMENTS_PER_BLOCK
+    leaves = [Bytes48.hash_tree_root(c)
+              for c in body.blob_kzg_commitments]
+    inner = merkle_branch(leaves, index, limit)
+    length_chunk = len(leaves).to_bytes(32, "little")
+    field_roots = []
+    field_idx = None
+    for i, (name, schema) in enumerate(fields.items()):
+        from ...ssz.types import _schema as _sch
+        field_roots.append(_sch(schema).hash_tree_root(
+            getattr(body, name)))
+        if name == "blob_kzg_commitments":
+            field_idx = i
+    outer = merkle_branch(field_roots, field_idx)
+    return inner + [length_chunk] + outer, field_idx
+
+
+def make_blob_sidecars(cfg: SpecConfig, signed_block, blobs, proofs):
+    """Sidecars for one signed block (the producer side the reference
+    implements in BlobSidecarSchema.create / MiscHelpersDeneb
+    constructBlobSidecars): one per commitment, each carrying the
+    signed header and its commitment's inclusion proof."""
+    from ..datastructures import BeaconBlockHeader
+    S = get_deneb_schemas(cfg)
+    block = signed_block.message
+    body = block.body
+    n = len(body.blob_kzg_commitments)
+    assert len(blobs) == n and len(proofs) == n, \
+        "one blob+proof per commitment"
+    signed_header = SignedBeaconBlockHeader(
+        message=BeaconBlockHeader(
+            slot=block.slot, proposer_index=block.proposer_index,
+            parent_root=block.parent_root, state_root=block.state_root,
+            body_root=body.htr()),
+        signature=signed_block.signature)
+    out = []
+    for i in range(n):
+        branch, _ = compute_commitment_inclusion_proof(cfg, body, i)
+        out.append(S.BlobSidecar(
+            index=i, blob=blobs[i],
+            kzg_commitment=body.blob_kzg_commitments[i],
+            kzg_proof=proofs[i],
+            signed_block_header=signed_header,
+            kzg_commitment_inclusion_proof=tuple(branch)))
+    return out
+
+
+def verify_commitment_inclusion_proof(cfg: SpecConfig, sidecar) -> bool:
+    """Spec verify_blob_sidecar_inclusion_proof: walk the branch from
+    hash_tree_root(commitment) up to the claimed body_root."""
+    depth = kzg_commitment_inclusion_proof_depth(cfg)
+    commitments_depth = max(
+        1, (cfg.MAX_BLOB_COMMITMENTS_PER_BLOCK - 1).bit_length())
+    # generalized position: index within subtree, subtree under the
+    # length mix (bit 0 at level commitments_depth), field slot above
+    field_idx = 11  # blob_kzg_commitments is the 12th deneb body field
+    gindex = sidecar.index + (field_idx << (commitments_depth + 1))
+    value = Bytes48.hash_tree_root(sidecar.kzg_commitment)
+    branch = sidecar.kzg_commitment_inclusion_proof
+    if len(branch) != depth:
+        return False
+    idx = gindex
+    for sib in branch:
+        if idx & 1:
+            value = hash_pair(sib, value)
+        else:
+            value = hash_pair(value, sib)
+        idx >>= 1
+    return value == sidecar.signed_block_header.message.body_root

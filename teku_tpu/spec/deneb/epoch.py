@@ -1,0 +1,41 @@
+"""Deneb epoch processing: capella's flow, with the registry-update
+churn cap of EIP-7514 applied inside get_validator_churn_limit's
+activation side (the reference handles it in EpochProcessorDeneb via
+getActivationChurnLimit)."""
+
+from .. import epoch as E0
+from .. import helpers as H
+from ..capella import epoch as CE
+from ..config import SpecConfig
+
+def get_activation_churn_limit(cfg: SpecConfig, state) -> int:
+    """EIP-7514: activations per epoch are capped regardless of set
+    growth (preset-dependent: 8 mainnet, 4 minimal)."""
+    return min(cfg.MAX_PER_EPOCH_ACTIVATION_CHURN_LIMIT,
+               H.get_validator_churn_limit(cfg, state))
+
+
+def process_registry_updates(cfg: SpecConfig, state):
+    return E0.process_registry_updates(
+        cfg, state, activation_limit=get_activation_churn_limit(cfg, state))
+
+
+def process_epoch(cfg: SpecConfig, state):
+    from ..altair import epoch as AE
+    state = AE.process_justification_and_finalization(cfg, state)
+    state = AE.process_inactivity_updates(cfg, state)
+    state = AE.process_rewards_and_penalties(
+        cfg, state,
+        inactivity_quotient=cfg.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX)
+    state = process_registry_updates(cfg, state)
+    state = AE.process_slashings(
+        cfg, state,
+        multiplier=cfg.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX)
+    state = E0.process_eth1_data_reset(cfg, state)
+    state = E0.process_effective_balance_updates(cfg, state)
+    state = E0.process_slashings_reset(cfg, state)
+    state = E0.process_randao_mixes_reset(cfg, state)
+    state = CE.process_historical_summaries_update(cfg, state)
+    state = AE.process_participation_flag_updates(cfg, state)
+    state = AE.process_sync_committee_updates(cfg, state)
+    return state

@@ -1,0 +1,24 @@
+"""Capella → deneb fork upgrade (spec upgrade_to_deneb): reshape the
+payload header with zeroed blob-gas fields."""
+
+from .. import helpers as H
+from ..config import SpecConfig
+from ..datastructures import Fork
+from .datastructures import get_deneb_schemas
+
+
+def upgrade_to_deneb(cfg: SpecConfig, pre):
+    S = get_deneb_schemas(cfg)
+    epoch = H.get_current_epoch(cfg, pre)
+    fields = {name: getattr(pre, name)
+              for name in type(pre)._ssz_fields}
+    old = fields.pop("latest_execution_payload_header")
+    fields["fork"] = Fork(previous_version=pre.fork.current_version,
+                          current_version=cfg.DENEB_FORK_VERSION,
+                          epoch=epoch)
+    header = S.ExecutionPayloadHeader(
+        **{name: getattr(old, name)
+           for name in type(old)._ssz_fields},
+        blob_gas_used=0, excess_blob_gas=0)
+    return S.BeaconState(**fields,
+                         latest_execution_payload_header=header)

@@ -1,0 +1,8 @@
+"""Electra milestone: EIP-7251 max-effective-balance increase with
+balance-denominated churn, EIP-7002 execution-layer withdrawal
+requests, EIP-6110 in-protocol deposit requests, EIP-7549 committee
+bits on attestations.
+
+reference: ethereum/spec/src/main/java/tech/pegasys/teku/spec/logic/
+versions/electra/ and datastructures/.../versions/electra/.
+"""

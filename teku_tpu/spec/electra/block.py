@@ -1,0 +1,477 @@
+"""Electra block processing: committee-bits attestations (EIP-7549),
+pending-queue deposits (EIP-6110/7251), execution-layer withdrawal and
+consolidation requests (EIP-7002/7251), partial-withdrawal-aware sweep.
+
+reference: ethereum/spec/.../logic/versions/electra/block/
+BlockProcessorElectra.java (processDepositRequest,
+processWithdrawalRequest, processConsolidationRequest,
+processAttestation with committee bits) and util/AttestationUtilElectra.
+"""
+
+from ...crypto import bls
+from .. import block as B0
+from .. import helpers as H
+from ..altair import block as AB
+from ..bellatrix import block as BB
+from ..capella import block as CB
+from ..capella.datastructures import Withdrawal
+from ..config import (DOMAIN_BEACON_ATTESTER, DOMAIN_DEPOSIT,
+                      DOMAIN_VOLUNTARY_EXIT, FAR_FUTURE_EPOCH,
+                      FULL_EXIT_REQUEST_AMOUNT, GENESIS_SLOT,
+                      UNSET_DEPOSIT_REQUESTS_START_INDEX, SpecConfig)
+from ..datastructures import DepositMessage
+from ..deneb import block as DB
+from ..deneb.datastructures import payload_to_header_deneb
+from ..verifiers import SignatureVerifier, SIMPLE
+from . import helpers as EH
+from .datastructures import PendingDeposit, PendingPartialWithdrawal, \
+    PendingConsolidation, get_electra_schemas
+
+_require = B0._require
+
+
+# ---- attestations (EIP-7549) ----
+
+def process_attestation(cfg: SpecConfig, state, attestation,
+                        verifier: SignatureVerifier):
+    """Committee-bits shape checks, then altair's flag accounting with
+    the electra-resolved attesting set."""
+    data = attestation.data
+    _require(data.index == 0, "electra attestations carry index 0")
+    _require(data.target.epoch in (H.get_previous_epoch(cfg, state),
+                                   H.get_current_epoch(cfg, state)),
+             "target epoch out of range")
+    _require(data.target.epoch == H.compute_epoch_at_slot(cfg, data.slot),
+             "target/slot mismatch")
+    _require(data.slot + cfg.MIN_ATTESTATION_INCLUSION_DELAY
+             <= state.slot, "inclusion delay")
+    committee_indices = EH.get_committee_indices(
+        attestation.committee_bits)
+    _require(committee_indices, "no committee bit set")
+    per_slot = H.get_committee_count_per_slot(cfg, state,
+                                              data.target.epoch)
+    offset = 0
+    for ci in committee_indices:
+        _require(ci < per_slot, "committee index out of range")
+        committee = H.get_beacon_committee(cfg, state, data.slot, ci)
+        bits = attestation.aggregation_bits
+        _require(any(bits[offset + j] for j in range(len(committee))),
+                 "a selected committee has no attester")
+        offset += len(committee)
+    _require(len(attestation.aggregation_bits) == offset,
+             "bits length != sum of selected committees")
+
+    justified = (state.current_justified_checkpoint
+                 if data.target.epoch == H.get_current_epoch(cfg, state)
+                 else state.previous_justified_checkpoint)
+    _require(data.source == justified, "wrong source checkpoint")
+
+    indexed = get_indexed_attestation(cfg, state, attestation)
+    _require(B0.is_valid_indexed_attestation(cfg, state, indexed,
+                                             verifier),
+             "bad attestation signature")
+    return AB._apply_participation_rewards(
+        cfg, state, data, EH.get_attesting_indices(cfg, state,
+                                                   attestation),
+        cap_target_delay=False)
+
+
+def get_indexed_attestation(cfg: SpecConfig, state, attestation):
+    S = get_electra_schemas(cfg)
+    indices = sorted(EH.get_attesting_indices(cfg, state, attestation))
+    return S.IndexedAttestation(attesting_indices=tuple(indices),
+                                data=attestation.data,
+                                signature=attestation.signature)
+
+
+# ---- deposits: the pending queue (EIP-6110 + EIP-7251) ----
+
+def add_validator_to_registry(cfg: SpecConfig, state, pubkey: bytes,
+                              withdrawal_credentials: bytes, amount: int):
+    """New registry row (+ the altair participation/inactivity rows)."""
+    state = state.copy_with(
+        validators=tuple(state.validators)
+        + (B0.get_validator_from_deposit(
+            cfg, pubkey, withdrawal_credentials, amount),),
+        balances=tuple(state.balances) + (amount,),
+        previous_epoch_participation=(
+            tuple(state.previous_epoch_participation) + (0,)),
+        current_epoch_participation=(
+            tuple(state.current_epoch_participation) + (0,)),
+        inactivity_scores=tuple(state.inactivity_scores) + (0,))
+    return state
+
+
+def is_valid_deposit_signature(cfg: SpecConfig, pubkey, creds, amount,
+                               signature,
+                               deposit_verifier: SignatureVerifier) -> bool:
+    msg = DepositMessage(pubkey=pubkey, withdrawal_credentials=creds,
+                         amount=amount)
+    domain = H.compute_domain(DOMAIN_DEPOSIT, cfg.GENESIS_FORK_VERSION,
+                              bytes(32))
+    root = H.compute_signing_root(msg, domain)
+    return deposit_verifier.verify([pubkey], root, signature)
+
+
+def apply_deposit(cfg: SpecConfig, state, pubkey, creds, amount,
+                  signature,
+                  deposit_verifier: SignatureVerifier = SIMPLE):
+    """Electra apply_deposit: balances only ever move through the
+    pending-deposit queue; a brand-new pubkey still needs its eager
+    proof-of-possession before a zero-balance registry row is added."""
+    pubkeys = [v.pubkey for v in state.validators]
+    if pubkey not in pubkeys:
+        if not is_valid_deposit_signature(cfg, pubkey, creds, amount,
+                                          signature, deposit_verifier):
+            return state
+        state = add_validator_to_registry(cfg, state, pubkey, creds, 0)
+    return state.copy_with(
+        pending_deposits=tuple(state.pending_deposits)
+        + (PendingDeposit(pubkey=pubkey, withdrawal_credentials=creds,
+                          amount=amount, signature=signature,
+                          slot=GENESIS_SLOT),))
+
+
+def process_deposit(cfg: SpecConfig, state, deposit,
+                    deposit_verifier: SignatureVerifier = SIMPLE):
+    _require(H.is_valid_merkle_branch(
+        deposit.data.htr(), deposit.proof,
+        cfg.DEPOSIT_CONTRACT_TREE_DEPTH + 1, state.eth1_deposit_index,
+        state.eth1_data.deposit_root), "bad deposit proof")
+    state = state.copy_with(
+        eth1_deposit_index=state.eth1_deposit_index + 1)
+    return apply_deposit(cfg, state, deposit.data.pubkey,
+                         deposit.data.withdrawal_credentials,
+                         deposit.data.amount, deposit.data.signature,
+                         deposit_verifier)
+
+
+def process_deposit_request(cfg: SpecConfig, state, request):
+    """EIP-6110: deposits surface straight from the payload."""
+    if state.deposit_requests_start_index \
+            == UNSET_DEPOSIT_REQUESTS_START_INDEX:
+        state = state.copy_with(
+            deposit_requests_start_index=request.index)
+    return state.copy_with(
+        pending_deposits=tuple(state.pending_deposits)
+        + (PendingDeposit(pubkey=request.pubkey,
+                          withdrawal_credentials=request
+                          .withdrawal_credentials,
+                          amount=request.amount,
+                          signature=request.signature,
+                          slot=state.slot),))
+
+
+# ---- EL-triggered withddrawals / consolidations ----
+
+def process_withdrawal_request(cfg: SpecConfig, state, request):
+    """EIP-7002: the EL can exit (amount=0) or skim (amount>0) any
+    validator whose 0x01/0x02 credential commits to the caller."""
+    amount = request.amount
+    is_full_exit = amount == FULL_EXIT_REQUEST_AMOUNT
+    # partial withdrawals only for compounding validators
+    pubkeys = [v.pubkey for v in state.validators]
+    if request.validator_pubkey not in pubkeys:
+        return state
+    index = pubkeys.index(request.validator_pubkey)
+    v = state.validators[index]
+    if not (is_full_exit
+            or EH.has_compounding_withdrawal_credential(v)):
+        return state
+    if len(state.pending_partial_withdrawals) \
+            >= cfg.PENDING_PARTIAL_WITHDRAWALS_LIMIT and not is_full_exit:
+        return state
+    if not EH.has_execution_withdrawal_credential(v):
+        return state
+    if v.withdrawal_credentials[12:] != request.source_address:
+        return state
+    now = H.get_current_epoch(cfg, state)
+    if not H.is_active_validator(v, now):
+        return state
+    if v.exit_epoch != FAR_FUTURE_EPOCH:
+        return state
+    if now < v.activation_epoch + cfg.SHARD_COMMITTEE_PERIOD:
+        return state
+
+    pending_balance = EH.get_pending_balance_to_withdraw(state, index)
+    if is_full_exit:
+        # only exit when nothing is still queued to withdraw
+        if pending_balance == 0:
+            state = EH.initiate_validator_exit(cfg, state, index)
+        return state
+    has_sufficient = v.effective_balance >= cfg.MIN_ACTIVATION_BALANCE
+    has_excess = (state.balances[index]
+                  > cfg.MIN_ACTIVATION_BALANCE + pending_balance)
+    if not (has_sufficient and has_excess):
+        return state
+    to_withdraw = min(state.balances[index]
+                      - cfg.MIN_ACTIVATION_BALANCE - pending_balance,
+                      amount)
+    state, withdrawable_epoch = EH.compute_exit_epoch_and_update_churn(
+        cfg, state, to_withdraw)
+    withdrawable_epoch += cfg.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    return state.copy_with(
+        pending_partial_withdrawals=tuple(
+            state.pending_partial_withdrawals)
+        + (PendingPartialWithdrawal(validator_index=index,
+                                    amount=to_withdraw,
+                                    withdrawable_epoch=withdrawable_epoch),))
+
+
+def process_consolidation_request(cfg: SpecConfig, state, request):
+    if _is_valid_switch_to_compounding(cfg, state, request):
+        pubkeys = [v.pubkey for v in state.validators]
+        index = pubkeys.index(request.source_pubkey)
+        return EH.switch_to_compounding_validator(cfg, state, index)
+    # churn must leave room for at least one increment
+    if EH.get_consolidation_churn_limit(cfg, state) \
+            <= cfg.MIN_ACTIVATION_BALANCE:
+        return state
+    if len(state.pending_consolidations) \
+            >= cfg.PENDING_CONSOLIDATIONS_LIMIT:
+        return state
+    pubkeys = [v.pubkey for v in state.validators]
+    if (request.source_pubkey not in pubkeys
+            or request.target_pubkey not in pubkeys):
+        return state
+    source_index = pubkeys.index(request.source_pubkey)
+    target_index = pubkeys.index(request.target_pubkey)
+    if source_index == target_index:
+        return state
+    source = state.validators[source_index]
+    target = state.validators[target_index]
+    if not EH.has_execution_withdrawal_credential(source):
+        return state
+    if not EH.has_compounding_withdrawal_credential(target):
+        return state
+    if source.withdrawal_credentials[12:] != request.source_address:
+        return state
+    now = H.get_current_epoch(cfg, state)
+    if not (H.is_active_validator(source, now)
+            and H.is_active_validator(target, now)):
+        return state
+    if source.exit_epoch != FAR_FUTURE_EPOCH \
+            or target.exit_epoch != FAR_FUTURE_EPOCH:
+        return state
+    # the source must have been active a full shard-committee period,
+    # like any exit
+    if now < source.activation_epoch + cfg.SHARD_COMMITTEE_PERIOD:
+        return state
+    if EH.get_pending_balance_to_withdraw(state, source_index) > 0:
+        return state
+    state, exit_epoch = EH.compute_consolidation_epoch_and_update_churn(
+        cfg, state, source.effective_balance)
+    validators = list(state.validators)
+    validators[source_index] = validators[source_index].copy_with(
+        exit_epoch=exit_epoch,
+        withdrawable_epoch=exit_epoch
+        + cfg.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+    return state.copy_with(
+        validators=tuple(validators),
+        pending_consolidations=tuple(state.pending_consolidations)
+        + (PendingConsolidation(source_index=source_index,
+                                target_index=target_index),))
+
+
+def _is_valid_switch_to_compounding(cfg, state, request) -> bool:
+    """Self-consolidation = credential upgrade in place."""
+    if request.source_pubkey != request.target_pubkey:
+        return False
+    pubkeys = [v.pubkey for v in state.validators]
+    if request.source_pubkey not in pubkeys:
+        return False
+    source = state.validators[pubkeys.index(request.source_pubkey)]
+    if not EH.has_eth1_withdrawal_credential(source):
+        return False
+    if source.withdrawal_credentials[12:] != request.source_address:
+        return False
+    now = H.get_current_epoch(cfg, state)
+    return (H.is_active_validator(source, now)
+            and source.exit_epoch == FAR_FUTURE_EPOCH)
+
+
+# ---- exits ----
+
+def process_voluntary_exit(cfg: SpecConfig, state, signed_exit,
+                           verifier: SignatureVerifier):
+    exit_msg = signed_exit.message
+    _require(exit_msg.validator_index < len(state.validators),
+             "exit: unknown validator")
+    v = state.validators[exit_msg.validator_index]
+    now = H.get_current_epoch(cfg, state)
+    _require(H.is_active_validator(v, now), "exit: not active")
+    _require(v.exit_epoch == FAR_FUTURE_EPOCH, "exit: already exiting")
+    _require(now >= exit_msg.epoch, "exit: future epoch")
+    _require(now >= v.activation_epoch + cfg.SHARD_COMMITTEE_PERIOD,
+             "exit: too young")
+    # EIP-7251: nothing may still be queued for partial withdrawal
+    _require(EH.get_pending_balance_to_withdraw(
+        state, exit_msg.validator_index) == 0,
+        "exit: pending partial withdrawals")
+    # EIP-7044 pinned domain, carried over from deneb
+    domain = H.compute_domain(DOMAIN_VOLUNTARY_EXIT,
+                              cfg.CAPELLA_FORK_VERSION,
+                              state.genesis_validators_root)
+    root = H.compute_signing_root(exit_msg, domain)
+    _require(verifier.verify([v.pubkey], root, signed_exit.signature),
+             "exit: bad signature")
+    return EH.initiate_validator_exit(cfg, state,
+                                      exit_msg.validator_index)
+
+
+# ---- withdrawals (partial queue + electra-predicate sweep) ----
+
+def is_fully_withdrawable_validator(cfg, validator, balance, epoch):
+    return (EH.has_execution_withdrawal_credential(validator)
+            and validator.withdrawable_epoch <= epoch and balance > 0)
+
+
+def is_partially_withdrawable_validator(cfg, validator, balance):
+    max_eb = EH.get_max_effective_balance(cfg, validator)
+    return (EH.has_execution_withdrawal_credential(validator)
+            and validator.effective_balance == max_eb
+            and balance > max_eb)
+
+
+def get_expected_withdrawals(cfg: SpecConfig, state):
+    """(withdrawals, processed_partials_count): the pending partial
+    queue drains first (bounded), then the capella-style sweep with
+    electra balance predicates."""
+    epoch = H.get_current_epoch(cfg, state)
+    withdrawal_index = state.next_withdrawal_index
+    withdrawals = []
+    processed_partials = 0
+    for w in state.pending_partial_withdrawals:
+        if (w.withdrawable_epoch > epoch
+                or len(withdrawals)
+                == cfg.MAX_PENDING_PARTIALS_PER_WITHDRAWALS_SWEEP):
+            break
+        v = state.validators[w.validator_index]
+        balance = state.balances[w.validator_index]
+        if (v.exit_epoch == FAR_FUTURE_EPOCH
+                and v.effective_balance >= cfg.MIN_ACTIVATION_BALANCE
+                and balance > cfg.MIN_ACTIVATION_BALANCE):
+            withdrawals.append(Withdrawal(
+                index=withdrawal_index,
+                validator_index=w.validator_index,
+                address=v.withdrawal_credentials[12:],
+                amount=min(balance - cfg.MIN_ACTIVATION_BALANCE,
+                           w.amount)))
+            withdrawal_index += 1
+        processed_partials += 1
+
+    validator_index = state.next_withdrawal_validator_index
+    n = len(state.validators)
+    for _ in range(min(n, cfg.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)):
+        v = state.validators[validator_index]
+        partially_withdrawn = sum(
+            w.amount for w in withdrawals
+            if w.validator_index == validator_index)
+        balance = state.balances[validator_index] - partially_withdrawn
+        address = v.withdrawal_credentials[12:]
+        if is_fully_withdrawable_validator(cfg, v, balance, epoch):
+            withdrawals.append(Withdrawal(
+                index=withdrawal_index, validator_index=validator_index,
+                address=address, amount=balance))
+            withdrawal_index += 1
+        elif is_partially_withdrawable_validator(cfg, v, balance):
+            withdrawals.append(Withdrawal(
+                index=withdrawal_index, validator_index=validator_index,
+                address=address,
+                amount=balance - EH.get_max_effective_balance(cfg, v)))
+            withdrawal_index += 1
+        if len(withdrawals) == cfg.MAX_WITHDRAWALS_PER_PAYLOAD:
+            break
+        validator_index = (validator_index + 1) % n
+    return withdrawals, processed_partials
+
+
+def process_withdrawals(cfg: SpecConfig, state, payload):
+    expected, processed_partials = get_expected_withdrawals(cfg, state)
+    _require(len(payload.withdrawals) == len(expected),
+             "withdrawals: wrong count in payload")
+    for got, want in zip(payload.withdrawals, expected):
+        _require(got == want, "withdrawals: payload/sweep mismatch")
+        state = H.decrease_balance(state, want.validator_index,
+                                   want.amount)
+    state = state.copy_with(
+        pending_partial_withdrawals=tuple(
+            state.pending_partial_withdrawals)[processed_partials:])
+    n = len(state.validators)
+    updates = {}
+    if expected:
+        updates["next_withdrawal_index"] = expected[-1].index + 1
+    if len(expected) == cfg.MAX_WITHDRAWALS_PER_PAYLOAD:
+        updates["next_withdrawal_validator_index"] = \
+            (expected[-1].validator_index + 1) % n
+    else:
+        updates["next_withdrawal_validator_index"] = \
+            (state.next_withdrawal_validator_index
+             + cfg.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP) % n
+    return state.copy_with(**updates)
+
+
+# ---- execution payload / operations / block ----
+
+def process_execution_payload(cfg: SpecConfig, state, body,
+                              execution_engine=BB.ACCEPT_ALL_ENGINE):
+    _require(len(body.blob_kzg_commitments)
+             <= cfg.MAX_BLOBS_PER_BLOCK_ELECTRA,
+             "too many blob commitments")
+    versioned_hashes = [DB.kzg_commitment_to_versioned_hash(c)
+                        for c in body.blob_kzg_commitments]
+    engine = DB._VersionedHashEngine(execution_engine, versioned_hashes)
+    return BB.process_execution_payload(
+        cfg, state, body, engine,
+        to_header=payload_to_header_deneb, transition_guard=False)
+
+
+def _process_operations(cfg, state, body, verifier, deposit_verifier):
+    # EIP-6110 transition formula: eth1-bridge deposits stop at
+    # deposit_requests_start_index
+    limit = min(state.eth1_data.deposit_count,
+                state.deposit_requests_start_index)
+    if state.eth1_deposit_index < limit:
+        expected = min(cfg.MAX_DEPOSITS,
+                       limit - state.eth1_deposit_index)
+    else:
+        expected = 0
+    _require(len(body.deposits) == expected, "wrong deposit count")
+
+    for op in body.proposer_slashings:
+        state = B0.process_proposer_slashing(cfg, state, op, verifier)
+    for op in body.attester_slashings:
+        state = B0.process_attester_slashing(cfg, state, op, verifier)
+    for op in body.attestations:
+        state = process_attestation(cfg, state, op, verifier)
+    for op in body.deposits:
+        state = process_deposit(cfg, state, op, deposit_verifier)
+    for op in body.voluntary_exits:
+        state = process_voluntary_exit(cfg, state, op, verifier)
+    for op in body.bls_to_execution_changes:
+        state = CB.process_bls_to_execution_change(cfg, state, op,
+                                                   verifier)
+    for op in body.execution_requests.deposits:
+        state = process_deposit_request(cfg, state, op)
+    for op in body.execution_requests.withdrawals:
+        state = process_withdrawal_request(cfg, state, op)
+    for op in body.execution_requests.consolidations:
+        state = process_consolidation_request(cfg, state, op)
+    return state
+
+
+def process_block(cfg: SpecConfig, state, block,
+                  verifier: SignatureVerifier,
+                  deposit_verifier: SignatureVerifier = SIMPLE,
+                  execution_engine=BB.ACCEPT_ALL_ENGINE):
+    state = B0.process_block_header(cfg, state, block)
+    state = process_withdrawals(cfg, state, block.body.execution_payload)
+    state = process_execution_payload(cfg, state, block.body,
+                                      execution_engine)
+    state = B0.process_randao(cfg, state, block.body, verifier)
+    state = B0.process_eth1_data(cfg, state, block.body)
+    state = _process_operations(cfg, state, block.body, verifier,
+                                deposit_verifier)
+    state = AB.process_sync_aggregate(cfg, state,
+                                      block.body.sync_aggregate, verifier)
+    return state

@@ -1,0 +1,164 @@
+"""Electra containers: pending queues on the state, execution
+requests in the body, committee-bits attestations.
+
+reference: ethereum/spec/.../spec/datastructures/ — operations/versions/
+electra/AttestationElectra.java, execution/versions/electra/
+{DepositRequest,WithdrawalRequest,ConsolidationRequest,ExecutionRequests}
+.java, state/versions/electra/BeaconStateElectra.java (pending_deposits /
+pending_partial_withdrawals / pending_consolidations + churn cursors).
+"""
+
+from functools import lru_cache
+
+from ...ssz import (Bitlist, Bitvector, Bytes20, Bytes32, Bytes48,
+                    Bytes96, Container, List, uint64)
+from ..config import SpecConfig
+from ..datastructures import AttestationData, Checkpoint
+from ..bellatrix.datastructures import _container
+from ..deneb.datastructures import get_deneb_schemas
+
+
+class PendingDeposit(Container):
+    pubkey: Bytes48
+    withdrawal_credentials: Bytes32
+    amount: uint64
+    signature: Bytes96
+    slot: uint64
+
+
+class PendingPartialWithdrawal(Container):
+    validator_index: uint64
+    amount: uint64
+    withdrawable_epoch: uint64
+
+
+class PendingConsolidation(Container):
+    source_index: uint64
+    target_index: uint64
+
+
+class DepositRequest(Container):
+    pubkey: Bytes48
+    withdrawal_credentials: Bytes32
+    amount: uint64
+    signature: Bytes96
+    index: uint64
+
+
+class WithdrawalRequest(Container):
+    source_address: Bytes20
+    validator_pubkey: Bytes48
+    amount: uint64
+
+
+class ConsolidationRequest(Container):
+    source_address: Bytes20
+    source_pubkey: Bytes48
+    target_pubkey: Bytes48
+
+
+class ElectraSchemas:
+    def __getattr__(self, name):
+        if name == "deneb":
+            raise AttributeError(name)
+        return getattr(self.deneb, name)
+
+    def __init__(self, cfg: SpecConfig):
+        self.config = cfg
+        self.deneb = get_deneb_schemas(cfg)
+        D = self.deneb
+        self.PendingDeposit = PendingDeposit
+        self.PendingPartialWithdrawal = PendingPartialWithdrawal
+        self.PendingConsolidation = PendingConsolidation
+        self.DepositRequest = DepositRequest
+        self.WithdrawalRequest = WithdrawalRequest
+        self.ConsolidationRequest = ConsolidationRequest
+        self.ExecutionRequests = _container("ExecutionRequests", [
+            ("deposits", List(DepositRequest,
+                              cfg.MAX_DEPOSIT_REQUESTS_PER_PAYLOAD)),
+            ("withdrawals", List(WithdrawalRequest,
+                                 cfg.MAX_WITHDRAWAL_REQUESTS_PER_PAYLOAD)),
+            ("consolidations", List(
+                ConsolidationRequest,
+                cfg.MAX_CONSOLIDATION_REQUESTS_PER_PAYLOAD)),
+        ])
+
+        # EIP-7549 attestation shapes: bits span all selected committees
+        max_agg_bits = (cfg.MAX_VALIDATORS_PER_COMMITTEE
+                        * cfg.MAX_COMMITTEES_PER_SLOT)
+        self.Attestation = _container("AttestationElectra", [
+            ("aggregation_bits", Bitlist(max_agg_bits)),
+            ("data", AttestationData),
+            ("signature", Bytes96),
+            ("committee_bits", Bitvector(cfg.MAX_COMMITTEES_PER_SLOT)),
+        ])
+        self.IndexedAttestation = _container("IndexedAttestationElectra", [
+            ("attesting_indices", List(uint64, max_agg_bits)),
+            ("data", AttestationData),
+            ("signature", Bytes96),
+        ])
+        self.AggregateAndProof = _container("AggregateAndProofElectra", [
+            ("aggregator_index", uint64),
+            ("aggregate", self.Attestation),
+            ("selection_proof", Bytes96),
+        ])
+        self.SignedAggregateAndProof = _container(
+            "SignedAggregateAndProofElectra", [
+                ("message", self.AggregateAndProof),
+                ("signature", Bytes96),
+            ])
+        # gossip-only single attestation (replaces the one-bit
+        # aggregate on attestation subnets)
+        self.SingleAttestation = _container("SingleAttestation", [
+            ("committee_index", uint64),
+            ("attester_index", uint64),
+            ("data", AttestationData),
+            ("signature", Bytes96),
+        ])
+
+        body_fields = dict(D.BeaconBlockBody._ssz_fields.items())
+        body_fields["attestations"] = List(self.Attestation,
+                                           cfg.MAX_ATTESTATIONS_ELECTRA)
+        body_fields["attester_slashings"] = List(
+            _container("AttesterSlashingElectra", [
+                ("attestation_1", self.IndexedAttestation),
+                ("attestation_2", self.IndexedAttestation),
+            ]), cfg.MAX_ATTESTER_SLASHINGS_ELECTRA)
+        body_fields["execution_requests"] = self.ExecutionRequests
+        self.BeaconBlockBody = _container("BeaconBlockBodyElectra",
+                                          body_fields.items())
+        self.BeaconBlock = _container("BeaconBlockElectra", [
+            ("slot", uint64),
+            ("proposer_index", uint64),
+            ("parent_root", Bytes32),
+            ("state_root", Bytes32),
+            ("body", self.BeaconBlockBody),
+        ])
+        self.SignedBeaconBlock = _container("SignedBeaconBlockElectra", [
+            ("message", self.BeaconBlock),
+            ("signature", Bytes96),
+        ])
+
+        state_fields = dict(D.BeaconState._ssz_fields.items())
+        state_fields.update([
+            ("deposit_requests_start_index", uint64),
+            ("deposit_balance_to_consume", uint64),
+            ("exit_balance_to_consume", uint64),
+            ("earliest_exit_epoch", uint64),
+            ("consolidation_balance_to_consume", uint64),
+            ("earliest_consolidation_epoch", uint64),
+            ("pending_deposits", List(PendingDeposit,
+                                      cfg.PENDING_DEPOSITS_LIMIT)),
+            ("pending_partial_withdrawals", List(
+                PendingPartialWithdrawal,
+                cfg.PENDING_PARTIAL_WITHDRAWALS_LIMIT)),
+            ("pending_consolidations", List(
+                PendingConsolidation, cfg.PENDING_CONSOLIDATIONS_LIMIT)),
+        ])
+        self.BeaconState = _container("BeaconStateElectra",
+                                      state_fields.items())
+
+
+@lru_cache(maxsize=8)
+def get_electra_schemas(cfg: SpecConfig) -> ElectraSchemas:
+    return ElectraSchemas(cfg)

@@ -1,0 +1,184 @@
+"""Electra epoch processing: churn-free activations (deposits are the
+churned resource), pending deposit/consolidation queues, per-validator
+max effective balances.
+
+reference: ethereum/spec/.../logic/versions/electra/statetransition/
+epoch/EpochProcessorElectra.java (processPendingDeposits,
+processPendingConsolidations, registry updates without the activation
+queue cap).
+"""
+
+from .. import epoch as E0
+from .. import helpers as H
+from ..altair import epoch as AE
+from ..capella import epoch as CE
+from ..config import FAR_FUTURE_EPOCH, SpecConfig
+from . import block as EB
+from . import helpers as EH
+
+
+def process_registry_updates(cfg: SpecConfig, state):
+    """Electra: eligibility needs MIN_ACTIVATION_BALANCE; ejections use
+    the balance-churn exit; every finalized-eligible validator
+    activates (the churn was already paid at deposit time)."""
+    current_epoch = H.get_current_epoch(cfg, state)
+    validators = list(state.validators)
+    changed = False
+    for i, v in enumerate(validators):
+        if (v.activation_eligibility_epoch == FAR_FUTURE_EPOCH
+                and v.effective_balance >= cfg.MIN_ACTIVATION_BALANCE):
+            validators[i] = v.copy_with(
+                activation_eligibility_epoch=current_epoch + 1)
+            changed = True
+    if changed:
+        state = state.copy_with(validators=tuple(validators))
+    for i, v in enumerate(state.validators):
+        if (H.is_active_validator(v, current_epoch)
+                and v.effective_balance <= cfg.EJECTION_BALANCE):
+            state = EH.initiate_validator_exit(cfg, state, i)
+    target_epoch = H.compute_activation_exit_epoch(cfg, current_epoch)
+    validators = list(state.validators)
+    changed = False
+    for i, v in enumerate(validators):
+        if H.is_eligible_for_activation(state, v):
+            validators[i] = v.copy_with(activation_epoch=target_epoch)
+            changed = True
+    if changed:
+        state = state.copy_with(validators=tuple(validators))
+    return state
+
+
+def apply_pending_deposit(cfg: SpecConfig, state, deposit,
+                          index_by_pubkey):
+    """Add a finalized pending deposit to its validator (creating the
+    registry row for an unknown pubkey after the eager signature
+    check).  `index_by_pubkey` is the caller's pubkey→index map,
+    updated in place when a validator is added."""
+    index = index_by_pubkey.get(deposit.pubkey)
+    if index is None:
+        from ..verifiers import SIMPLE
+        if EB.is_valid_deposit_signature(
+                cfg, deposit.pubkey, deposit.withdrawal_credentials,
+                deposit.amount, deposit.signature, SIMPLE):
+            state = EB.add_validator_to_registry(
+                cfg, state, deposit.pubkey,
+                deposit.withdrawal_credentials, deposit.amount)
+            index_by_pubkey[deposit.pubkey] = len(state.validators) - 1
+        return state
+    return H.increase_balance(state, index, deposit.amount)
+
+
+def process_pending_deposits(cfg: SpecConfig, state):
+    next_epoch = H.get_current_epoch(cfg, state) + 1
+    available = (state.deposit_balance_to_consume
+                 + EH.get_activation_exit_churn_limit(cfg, state))
+    processed_amount = 0
+    next_index = 0
+    postponed = []
+    churn_reached = False
+    finalized_slot = H.compute_start_slot_at_epoch(
+        cfg, state.finalized_checkpoint.epoch)
+    # one pubkey→index map for the whole queue, not a rebuild per
+    # deposit (epoch cost stays O(V + D))
+    index_by_pubkey = {v.pubkey: i
+                       for i, v in enumerate(state.validators)}
+
+    for deposit in state.pending_deposits:
+        # eth1-bridge deposits drain before any request-sourced ones
+        if (deposit.slot > 0 and state.eth1_deposit_index
+                < state.deposit_requests_start_index):
+            break
+        if deposit.slot > finalized_slot:
+            break
+        if next_index >= cfg.MAX_PENDING_DEPOSITS_PER_EPOCH:
+            break
+        exited = withdrawn = False
+        known = index_by_pubkey.get(deposit.pubkey)
+        if known is not None:
+            v = state.validators[known]
+            exited = v.exit_epoch < FAR_FUTURE_EPOCH
+            withdrawn = v.withdrawable_epoch < next_epoch
+        if withdrawn:
+            # never becomes active again: pay out without churn
+            state = apply_pending_deposit(cfg, state, deposit,
+                                          index_by_pubkey)
+        elif exited:
+            postponed.append(deposit)
+        else:
+            churn_reached = (processed_amount + deposit.amount
+                             > available)
+            if churn_reached:
+                break
+            processed_amount += deposit.amount
+            state = apply_pending_deposit(cfg, state, deposit,
+                                          index_by_pubkey)
+        next_index += 1
+
+    remaining = tuple(state.pending_deposits)[next_index:]
+    state = state.copy_with(
+        pending_deposits=remaining + tuple(postponed),
+        deposit_balance_to_consume=(available - processed_amount
+                                    if churn_reached else 0))
+    return state
+
+
+def process_pending_consolidations(cfg: SpecConfig, state):
+    next_epoch = H.get_current_epoch(cfg, state) + 1
+    done = 0
+    for pc in state.pending_consolidations:
+        source = state.validators[pc.source_index]
+        if source.slashed:
+            done += 1
+            continue
+        if source.withdrawable_epoch > next_epoch:
+            break
+        # move the active balance (not the skimmed excess)
+        balance = min(state.balances[pc.source_index],
+                      source.effective_balance)
+        state = H.decrease_balance(state, pc.source_index, balance)
+        state = H.increase_balance(state, pc.target_index, balance)
+        done += 1
+    return state.copy_with(
+        pending_consolidations=tuple(state.pending_consolidations)[done:])
+
+
+def process_effective_balance_updates(cfg: SpecConfig, state):
+    """Hysteresis against the per-validator (compounding-aware) cap."""
+    validators = list(state.validators)
+    changed = False
+    inc = cfg.EFFECTIVE_BALANCE_INCREMENT
+    down = inc * cfg.HYSTERESIS_DOWNWARD_MULTIPLIER // cfg.HYSTERESIS_QUOTIENT
+    up = inc * cfg.HYSTERESIS_UPWARD_MULTIPLIER // cfg.HYSTERESIS_QUOTIENT
+    for i, v in enumerate(validators):
+        balance = state.balances[i]
+        max_eb = EH.get_max_effective_balance(cfg, v)
+        if (balance + down < v.effective_balance
+                or v.effective_balance + up < balance):
+            validators[i] = v.copy_with(effective_balance=min(
+                balance - balance % inc, max_eb))
+            changed = True
+    if changed:
+        return state.copy_with(validators=tuple(validators))
+    return state
+
+
+def process_epoch(cfg: SpecConfig, state):
+    state = AE.process_justification_and_finalization(cfg, state)
+    state = AE.process_inactivity_updates(cfg, state)
+    state = AE.process_rewards_and_penalties(
+        cfg, state,
+        inactivity_quotient=cfg.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX)
+    state = process_registry_updates(cfg, state)
+    state = AE.process_slashings(
+        cfg, state,
+        multiplier=cfg.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX)
+    state = E0.process_eth1_data_reset(cfg, state)
+    state = process_pending_deposits(cfg, state)
+    state = process_pending_consolidations(cfg, state)
+    state = process_effective_balance_updates(cfg, state)
+    state = E0.process_slashings_reset(cfg, state)
+    state = E0.process_randao_mixes_reset(cfg, state)
+    state = CE.process_historical_summaries_update(cfg, state)
+    state = AE.process_participation_flag_updates(cfg, state)
+    state = AE.process_sync_committee_updates(cfg, state)
+    return state

@@ -1,0 +1,72 @@
+"""Deneb → electra fork upgrade (spec upgrade_to_electra): initialize
+the churn cursors from the live exit queue, convert not-yet-activated
+validators into pending deposits, queue compounding validators'
+excess balance."""
+
+from .. import helpers as H
+from ..config import (FAR_FUTURE_EPOCH, SpecConfig,
+                      UNSET_DEPOSIT_REQUESTS_START_INDEX)
+from ..datastructures import Fork
+from . import helpers as EH
+from .datastructures import PendingDeposit, get_electra_schemas
+
+
+def upgrade_to_electra(cfg: SpecConfig, pre):
+    from ...crypto.bls.pure_impl import G2_INFINITY
+    S = get_electra_schemas(cfg)
+    epoch = H.get_current_epoch(cfg, pre)
+    earliest_exit_epoch = H.compute_activation_exit_epoch(cfg, epoch)
+    for v in pre.validators:
+        if v.exit_epoch != FAR_FUTURE_EPOCH:
+            earliest_exit_epoch = max(earliest_exit_epoch, v.exit_epoch)
+    earliest_exit_epoch += 1
+
+    fields = {name: getattr(pre, name)
+              for name in type(pre)._ssz_fields}
+    fields["fork"] = Fork(previous_version=pre.fork.current_version,
+                          current_version=cfg.ELECTRA_FORK_VERSION,
+                          epoch=epoch)
+    post = S.BeaconState(
+        **fields,
+        deposit_requests_start_index=UNSET_DEPOSIT_REQUESTS_START_INDEX,
+        deposit_balance_to_consume=0,
+        exit_balance_to_consume=0,
+        earliest_exit_epoch=earliest_exit_epoch,
+        consolidation_balance_to_consume=0,
+        earliest_consolidation_epoch=H.compute_activation_exit_epoch(
+            cfg, epoch),
+        pending_deposits=(), pending_partial_withdrawals=(),
+        pending_consolidations=())
+    post = post.copy_with(
+        exit_balance_to_consume=EH.get_activation_exit_churn_limit(
+            cfg, post),
+        consolidation_balance_to_consume=EH.get_consolidation_churn_limit(
+            cfg, post))
+
+    # validators still waiting for activation re-enter via the queue
+    pre_activation = sorted(
+        (i for i, v in enumerate(post.validators)
+         if v.activation_epoch == FAR_FUTURE_EPOCH),
+        key=lambda i: (post.validators[i].activation_eligibility_epoch,
+                       i))
+    if pre_activation:
+        validators = list(post.validators)
+        balances = list(post.balances)
+        pending = list(post.pending_deposits)
+        for i in pre_activation:
+            v = validators[i]
+            pending.append(PendingDeposit(
+                pubkey=v.pubkey,
+                withdrawal_credentials=v.withdrawal_credentials,
+                amount=balances[i], signature=G2_INFINITY, slot=0))
+            balances[i] = 0
+            validators[i] = v.copy_with(
+                effective_balance=0,
+                activation_eligibility_epoch=FAR_FUTURE_EPOCH)
+        post = post.copy_with(validators=tuple(validators),
+                              balances=tuple(balances),
+                              pending_deposits=tuple(pending))
+    for i, v in enumerate(post.validators):
+        if EH.has_compounding_withdrawal_credential(v):
+            post = EH.queue_excess_active_balance(cfg, post, i)
+    return post

@@ -1,0 +1,179 @@
+"""Electra helper functions: compounding credentials, gwei-denominated
+churn, balance-scheduled exits and consolidations.
+
+reference: ethereum/spec/.../logic/versions/electra/helpers/
+{PredicatesElectra,BeaconStateAccessorsElectra,BeaconStateMutatorsElectra,
+MiscHelpersElectra}.java.
+"""
+
+from .. import helpers as H
+from ..config import (COMPOUNDING_WITHDRAWAL_PREFIX,
+                      ETH1_ADDRESS_WITHDRAWAL_PREFIX, FAR_FUTURE_EPOCH,
+                      SpecConfig)
+
+
+# ---- credential predicates ----
+
+def is_compounding_withdrawal_credential(creds: bytes) -> bool:
+    return creds[:1] == COMPOUNDING_WITHDRAWAL_PREFIX
+
+
+def has_compounding_withdrawal_credential(validator) -> bool:
+    return is_compounding_withdrawal_credential(
+        validator.withdrawal_credentials)
+
+
+def has_eth1_withdrawal_credential(validator) -> bool:
+    return validator.withdrawal_credentials[:1] \
+        == ETH1_ADDRESS_WITHDRAWAL_PREFIX
+
+
+def has_execution_withdrawal_credential(validator) -> bool:
+    """0x01 or 0x02 credential: the validator can be reached by
+    execution-layer triggered operations."""
+    return (has_compounding_withdrawal_credential(validator)
+            or has_eth1_withdrawal_credential(validator))
+
+
+def get_max_effective_balance(cfg: SpecConfig, validator) -> int:
+    return (cfg.MAX_EFFECTIVE_BALANCE_ELECTRA
+            if has_compounding_withdrawal_credential(validator)
+            else cfg.MIN_ACTIVATION_BALANCE)
+
+
+# ---- gwei-denominated churn (replaces the validator-count churn) ----
+
+def get_balance_churn_limit(cfg: SpecConfig, state) -> int:
+    churn = max(cfg.MIN_PER_EPOCH_CHURN_LIMIT_ELECTRA,
+                H.get_total_active_balance(cfg, state)
+                // cfg.CHURN_LIMIT_QUOTIENT)
+    return churn - churn % cfg.EFFECTIVE_BALANCE_INCREMENT
+
+
+def get_activation_exit_churn_limit(cfg: SpecConfig, state) -> int:
+    return min(cfg.MAX_PER_EPOCH_ACTIVATION_EXIT_CHURN_LIMIT,
+               get_balance_churn_limit(cfg, state))
+
+
+def get_consolidation_churn_limit(cfg: SpecConfig, state) -> int:
+    return (get_balance_churn_limit(cfg, state)
+            - get_activation_exit_churn_limit(cfg, state))
+
+
+def get_pending_balance_to_withdraw(state, validator_index: int) -> int:
+    return sum(w.amount for w in state.pending_partial_withdrawals
+               if w.validator_index == validator_index)
+
+
+# ---- balance-scheduled exits / consolidations ----
+
+def compute_exit_epoch_and_update_churn(cfg: SpecConfig, state,
+                                        exit_balance: int):
+    """(state', exit_epoch): schedule `exit_balance` gwei of exits,
+    rolling the queue forward by whole epochs of churn (spec
+    compute_exit_epoch_and_update_churn — the state carries the
+    running earliest_exit_epoch / exit_balance_to_consume pair)."""
+    earliest = max(state.earliest_exit_epoch,
+                   H.compute_activation_exit_epoch(
+                       cfg, H.get_current_epoch(cfg, state)))
+    per_epoch = get_activation_exit_churn_limit(cfg, state)
+    if state.earliest_exit_epoch < earliest:
+        to_consume = per_epoch
+    else:
+        to_consume = state.exit_balance_to_consume
+    if exit_balance > to_consume:
+        extra = exit_balance - to_consume
+        additional_epochs = (extra - 1) // per_epoch + 1
+        earliest += additional_epochs
+        to_consume += additional_epochs * per_epoch
+    state = state.copy_with(exit_balance_to_consume=to_consume
+                            - exit_balance,
+                            earliest_exit_epoch=earliest)
+    return state, earliest
+
+
+def compute_consolidation_epoch_and_update_churn(cfg: SpecConfig, state,
+                                                 balance: int):
+    earliest = max(state.earliest_consolidation_epoch,
+                   H.compute_activation_exit_epoch(
+                       cfg, H.get_current_epoch(cfg, state)))
+    per_epoch = get_consolidation_churn_limit(cfg, state)
+    if state.earliest_consolidation_epoch < earliest:
+        to_consume = per_epoch
+    else:
+        to_consume = state.consolidation_balance_to_consume
+    if balance > to_consume:
+        extra = balance - to_consume
+        additional_epochs = (extra - 1) // per_epoch + 1
+        earliest += additional_epochs
+        to_consume += additional_epochs * per_epoch
+    state = state.copy_with(
+        consolidation_balance_to_consume=to_consume - balance,
+        earliest_consolidation_epoch=earliest)
+    return state, earliest
+
+
+def initiate_validator_exit(cfg: SpecConfig, state, index: int):
+    """Electra initiate_validator_exit: the exit epoch comes from the
+    balance churn, not the per-validator-count queue."""
+    v = state.validators[index]
+    if v.exit_epoch != FAR_FUTURE_EPOCH:
+        return state
+    state, exit_epoch = compute_exit_epoch_and_update_churn(
+        cfg, state, v.effective_balance)
+    validators = list(state.validators)
+    validators[index] = v.copy_with(
+        exit_epoch=exit_epoch,
+        withdrawable_epoch=exit_epoch
+        + cfg.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+    return state.copy_with(validators=tuple(validators))
+
+
+def switch_to_compounding_validator(cfg: SpecConfig, state, index: int):
+    v = state.validators[index]
+    validators = list(state.validators)
+    validators[index] = v.copy_with(
+        withdrawal_credentials=COMPOUNDING_WITHDRAWAL_PREFIX
+        + v.withdrawal_credentials[1:])
+    state = state.copy_with(validators=tuple(validators))
+    return queue_excess_active_balance(cfg, state, index)
+
+
+def queue_excess_active_balance(cfg: SpecConfig, state, index: int):
+    """Balance above MIN_ACTIVATION_BALANCE re-enters via the pending
+    deposit queue when a validator turns compounding."""
+    balance = state.balances[index]
+    if balance <= cfg.MIN_ACTIVATION_BALANCE:
+        return state
+    from .datastructures import PendingDeposit
+    from ...crypto.bls.pure_impl import G2_INFINITY
+    excess = balance - cfg.MIN_ACTIVATION_BALANCE
+    v = state.validators[index]
+    balances = list(state.balances)
+    balances[index] = cfg.MIN_ACTIVATION_BALANCE
+    return state.copy_with(
+        balances=tuple(balances),
+        pending_deposits=tuple(state.pending_deposits) + (PendingDeposit(
+            pubkey=v.pubkey,
+            withdrawal_credentials=v.withdrawal_credentials,
+            amount=excess, signature=G2_INFINITY, slot=0),))
+
+
+def get_committee_indices(committee_bits) -> list:
+    return [i for i, bit in enumerate(committee_bits) if bit]
+
+
+def get_attesting_indices(cfg: SpecConfig, state, attestation) -> set:
+    """EIP-7549: aggregation bits span the concatenation of the slot's
+    committees selected in committee_bits."""
+    out = set()
+    offset = 0
+    bits = attestation.aggregation_bits
+    for ci in get_committee_indices(attestation.committee_bits):
+        committee = H.get_beacon_committee(cfg, state,
+                                           attestation.data.slot, ci)
+        for j, validator_index in enumerate(committee):
+            if bits[offset + j]:
+                out.add(validator_index)
+        offset += len(committee)
+    return out

@@ -236,7 +236,10 @@ def process_rewards_and_penalties(cfg: SpecConfig, state):
 # Registry updates / slashings / final updates
 # --------------------------------------------------------------------------
 
-def process_registry_updates(cfg: SpecConfig, state):
+def process_registry_updates(cfg: SpecConfig, state,
+                             activation_limit=None):
+    """`activation_limit` overrides the churn-derived activation cap
+    (deneb's EIP-7514 activation churn limit routes through here)."""
     current_epoch = H.get_current_epoch(cfg, state)
     validators = list(state.validators)
     changed = False
@@ -256,7 +259,8 @@ def process_registry_updates(cfg: SpecConfig, state):
         (i for i, v in enumerate(state.validators)
          if H.is_eligible_for_activation(state, v)),
         key=lambda i: (state.validators[i].activation_eligibility_epoch, i))
-    churn = H.get_validator_churn_limit(cfg, state)
+    churn = (H.get_validator_churn_limit(cfg, state)
+             if activation_limit is None else activation_limit)
     if queue:
         validators = list(state.validators)
         target_epoch = H.compute_activation_exit_epoch(cfg, current_epoch)

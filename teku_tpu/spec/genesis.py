@@ -78,9 +78,23 @@ def initialize_beacon_state(cfg: SpecConfig,
     # milestone active at epoch 0, as the reference does when building
     # genesis for a config whose fork epochs are 0
     from .milestones import build_fork_schedule
+    upgraded = False
     for version in build_fork_schedule(cfg).versions:
         if version.fork_epoch == 0 and version.upgrade_state is not None:
             state = version.upgrade_state(state)
+            upgraded = True
+    if upgraded:
+        # at genesis the spec sets previous == current (there was no
+        # prior fork on this chain), unlike a live upgrade — and the
+        # empty-body header root must be the ACTIVE fork's body shape
+        active = build_fork_schedule(cfg).version_at_slot(0)
+        state = state.copy_with(
+            fork=Fork(
+                previous_version=state.fork.current_version,
+                current_version=state.fork.current_version,
+                epoch=GENESIS_EPOCH),
+            latest_block_header=BeaconBlockHeader(
+                body_root=active.schemas.BeaconBlockBody().htr()))
     return state
 
 

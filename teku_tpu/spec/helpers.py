@@ -232,11 +232,23 @@ def get_beacon_committee(cfg: SpecConfig, state, slot: int,
     return [int(x) for x in shuffled[start:end]]
 
 
-def get_beacon_proposer_index(cfg: SpecConfig, state) -> int:
-    epoch = get_current_epoch(cfg, state)
+def get_beacon_proposer_index(cfg: SpecConfig, state,
+                              slot: Optional[int] = None) -> int:
+    """Proposer for `slot` (default: the state's own slot).  An
+    explicit slot must be in the state's current epoch — the randao
+    seed is epoch-scoped, so gossip validators can check a claimed
+    proposer with any same-epoch state (reference
+    BeaconStateAccessors.getBeaconProposerIndex)."""
+    slot = state.slot if slot is None else slot
+    epoch = compute_epoch_at_slot(cfg, slot)
+    if epoch != get_current_epoch(cfg, state):
+        # a real exception (not assert): callers route on it, and -O
+        # must not turn a wrong-epoch lookup into a wrong answer
+        raise ValueError("proposer lookup needs a state in the "
+                         "slot's epoch")
     from .config import DOMAIN_BEACON_PROPOSER
     seed = hash32(get_seed(cfg, state, epoch, DOMAIN_BEACON_PROPOSER)
-                  + uint_to_bytes(state.slot, 8))
+                  + uint_to_bytes(slot, 8))
     indices = get_active_validator_indices(state, epoch)
     return compute_proposer_index(cfg, state, indices, seed)
 
@@ -422,10 +434,19 @@ def _is_altair(cfg: SpecConfig, state) -> bool:
     return get_current_epoch(cfg, state) >= cfg.ALTAIR_FORK_EPOCH
 
 
+def _is_electra(cfg: SpecConfig, state) -> bool:
+    return get_current_epoch(cfg, state) >= cfg.ELECTRA_FORK_EPOCH
+
+
 def slash_validator(cfg: SpecConfig, state, slashed_index: int,
                     whistleblower_index: Optional[int] = None):
     epoch = get_current_epoch(cfg, state)
-    state = initiate_validator_exit(cfg, state, slashed_index)
+    electra = _is_electra(cfg, state)
+    if electra:
+        from .electra.helpers import initiate_validator_exit as _init
+        state = _init(cfg, state, slashed_index)
+    else:
+        state = initiate_validator_exit(cfg, state, slashed_index)
     v = state.validators[slashed_index]
     v = v.copy_with(
         slashed=True,
@@ -438,7 +459,9 @@ def slash_validator(cfg: SpecConfig, state, slashed_index: int,
     state = state.copy_with(validators=tuple(validators),
                             slashings=tuple(slashings))
     altair = _is_altair(cfg, state)
-    if get_current_epoch(cfg, state) >= cfg.BELLATRIX_FORK_EPOCH:
+    if electra:
+        penalty_quotient = cfg.MIN_SLASHING_PENALTY_QUOTIENT_ELECTRA
+    elif get_current_epoch(cfg, state) >= cfg.BELLATRIX_FORK_EPOCH:
         penalty_quotient = cfg.MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX
     elif altair:
         penalty_quotient = cfg.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
@@ -450,8 +473,10 @@ def slash_validator(cfg: SpecConfig, state, slashed_index: int,
     proposer_index = get_beacon_proposer_index(cfg, state)
     if whistleblower_index is None:
         whistleblower_index = proposer_index
-    whistleblower_reward = (v.effective_balance
-                            // cfg.WHISTLEBLOWER_REWARD_QUOTIENT)
+    whistleblower_quotient = (cfg.WHISTLEBLOWER_REWARD_QUOTIENT_ELECTRA
+                              if electra
+                              else cfg.WHISTLEBLOWER_REWARD_QUOTIENT)
+    whistleblower_reward = (v.effective_balance // whistleblower_quotient)
     if altair:
         from .config import PROPOSER_WEIGHT, WEIGHT_DENOMINATOR
         proposer_reward = (whistleblower_reward * PROPOSER_WEIGHT

@@ -164,14 +164,51 @@ def capella_version(cfg: SpecConfig) -> SpecVersion:
         upgrade_state=lambda state: upgrade_to_capella(cfg, state))
 
 
+def deneb_version(cfg: SpecConfig) -> SpecVersion:
+    from .altair import epoch as AE
+    from .deneb import block as DB
+    from .deneb import epoch as DE
+    from .deneb.datastructures import get_deneb_schemas
+    from .deneb.fork import upgrade_to_deneb
+
+    return SpecVersion(
+        milestone=SpecMilestone.DENEB,
+        fork_version=cfg.DENEB_FORK_VERSION,
+        fork_epoch=cfg.DENEB_FORK_EPOCH,
+        schemas=get_deneb_schemas(cfg),
+        process_block=DB.process_block,
+        process_epoch=DE.process_epoch,
+        process_justification=AE.process_justification_and_finalization,
+        upgrade_state=lambda state: upgrade_to_deneb(cfg, state))
+
+
+def electra_version(cfg: SpecConfig) -> SpecVersion:
+    from .altair import epoch as AE
+    from .electra import block as XB
+    from .electra import epoch as XE
+    from .electra.datastructures import get_electra_schemas
+    from .electra.fork import upgrade_to_electra
+
+    return SpecVersion(
+        milestone=SpecMilestone.ELECTRA,
+        fork_version=cfg.ELECTRA_FORK_VERSION,
+        fork_epoch=cfg.ELECTRA_FORK_EPOCH,
+        schemas=get_electra_schemas(cfg),
+        process_block=XB.process_block,
+        process_epoch=XE.process_epoch,
+        process_justification=AE.process_justification_and_finalization,
+        upgrade_state=lambda state: upgrade_to_electra(cfg, state))
+
+
 from functools import lru_cache
 
 
 @lru_cache(maxsize=16)
 def build_fork_schedule(cfg: SpecConfig) -> ForkSchedule:
-    """All scheduled milestones for this config (phase0 + altair +
-    bellatrix when their fork epochs are set; later forks register the
-    same way)."""
+    """All scheduled milestones for this config: phase0 plus every
+    later fork whose epoch is set."""
     return ForkSchedule(cfg, [phase0_version(cfg), altair_version(cfg),
                               bellatrix_version(cfg),
-                              capella_version(cfg)])
+                              capella_version(cfg),
+                              deneb_version(cfg),
+                              electra_version(cfg)])

@@ -1,0 +1,228 @@
+"""Deneb: five-fork ladder, blob commitments + sidecar inclusion
+proofs, EIP-7044 pinned exit domains, EIP-7045 extended inclusion."""
+
+import dataclasses
+
+import pytest
+
+from teku_tpu.crypto import bls, kzg
+from teku_tpu.spec import config as C
+from teku_tpu.spec import helpers as H
+from teku_tpu.spec.altair.block import process_attestation
+from teku_tpu.spec.builder import (make_local_signer, produce_attestations,
+                                   produce_block)
+from teku_tpu.spec.deneb import block as DB
+from teku_tpu.spec.deneb.datastructures import (
+    compute_commitment_inclusion_proof, get_deneb_schemas,
+    kzg_commitment_inclusion_proof_depth, make_blob_sidecars,
+    payload_to_header_deneb, verify_commitment_inclusion_proof)
+from teku_tpu.spec.genesis import interop_genesis
+from teku_tpu.spec.milestones import build_fork_schedule, SpecMilestone
+from teku_tpu.spec.transition import process_slots, state_transition
+from teku_tpu.spec.verifiers import SIMPLE
+
+CFG = dataclasses.replace(C.MINIMAL, ALTAIR_FORK_EPOCH=1,
+                          BELLATRIX_FORK_EPOCH=2, CAPELLA_FORK_EPOCH=3,
+                          DENEB_FORK_EPOCH=4)
+
+
+def _deneb_state(n=16):
+    cfg = dataclasses.replace(CFG, ALTAIR_FORK_EPOCH=0,
+                              BELLATRIX_FORK_EPOCH=0, CAPELLA_FORK_EPOCH=0,
+                              DENEB_FORK_EPOCH=0)
+    state, sks = interop_genesis(cfg, n)
+    return cfg, state, sks
+
+
+def test_milestone_schedule_five_forks():
+    sched = build_fork_schedule(CFG)
+    assert sched.milestone_at_epoch(3) is SpecMilestone.CAPELLA
+    assert sched.milestone_at_epoch(4) is SpecMilestone.DENEB
+    assert sched.milestone_at_epoch(10 ** 6) is SpecMilestone.DENEB
+
+
+@pytest.mark.slow
+def test_deneb_ladder_finalizes():
+    state, sks = interop_genesis(CFG, 32)
+    signer = make_local_signer(dict(enumerate(sks)))
+    S = get_deneb_schemas(CFG)
+    atts, cur = [], state
+    for slot in range(1, 7 * CFG.SLOTS_PER_EPOCH + 1):
+        signed, post = produce_block(CFG, cur, slot, signer,
+                                     attestations=atts)
+        verified = state_transition(CFG, cur, signed,
+                                    validate_result=True)
+        assert verified.htr() == post.htr(), f"divergence at slot {slot}"
+        atts = produce_attestations(CFG, post, slot,
+                                    signed.message.htr(), signer)
+        cur = post
+    assert isinstance(cur, S.BeaconState)
+    assert cur.fork.current_version == CFG.DENEB_FORK_VERSION
+    assert cur.fork.previous_version == CFG.CAPELLA_FORK_VERSION
+    assert cur.finalized_checkpoint.epoch >= 4
+    hdr = cur.latest_execution_payload_header
+    assert hdr.excess_blob_gas == 0 and hdr.blob_gas_used == 0
+    assert hdr.block_number > 0
+
+
+def test_versioned_hash():
+    vh = DB.kzg_commitment_to_versioned_hash(b"\x07" * 48)
+    assert len(vh) == 32 and vh[:1] == b"\x01"
+    assert vh[1:] == H.hash32(b"\x07" * 48)[1:]
+
+
+def test_eip7045_extended_attestation_inclusion():
+    """An attestation older than one epoch (but with a previous-epoch
+    target) is valid deneb-style and invalid capella-style."""
+    cfg, state, sks = _deneb_state(n=16)
+    signer = make_local_signer(dict(enumerate(sks)))
+    atts, cur = [], state
+    att_slot = cfg.SLOTS_PER_EPOCH  # first slot of epoch 1
+    for slot in range(1, att_slot + 1):
+        signed, cur = produce_block(cfg, cur, slot, signer,
+                                    attestations=atts)
+        atts = []
+    old_atts = produce_attestations(cfg, cur, att_slot,
+                                    cur.latest_block_header.copy_with(
+                                        state_root=cur.htr()).htr(),
+                                    signer)
+    # advance deep into epoch 2: > att_slot + SLOTS_PER_EPOCH
+    target_slot = 2 * cfg.SLOTS_PER_EPOCH + 6
+    adv = process_slots(cfg, cur, target_slot)
+    assert target_slot > att_slot + cfg.SLOTS_PER_EPOCH
+    att = old_atts[0]
+    post = process_attestation(cfg, adv, att, SIMPLE,
+                               enforce_upper_window=False)
+    assert post is not adv  # accepted, participation applied
+    with pytest.raises(Exception):
+        process_attestation(cfg, adv, att, SIMPLE,
+                            enforce_upper_window=True)
+
+
+def test_eip7044_exit_domain_pinned_to_capella():
+    cfg, state, sks = _deneb_state(n=16)
+    # age the validators enough to exit
+    state = state.copy_with(slot=(cfg.SHARD_COMMITTEE_PERIOD + 1)
+                            * cfg.SLOTS_PER_EPOCH)
+    S = get_deneb_schemas(cfg)
+    idx = 2
+    exit_msg = S.VoluntaryExit(epoch=0, validator_index=idx)
+    capella_domain = H.compute_domain(C.DOMAIN_VOLUNTARY_EXIT,
+                                      cfg.CAPELLA_FORK_VERSION,
+                                      state.genesis_validators_root)
+    good = S.SignedVoluntaryExit(
+        message=exit_msg,
+        signature=bls.sign(sks[idx], H.compute_signing_root(
+            exit_msg, capella_domain)))
+    from teku_tpu.spec.block import process_voluntary_exit
+    post = process_voluntary_exit(cfg, state, good, SIMPLE,
+                                  exit_fork_version=cfg.CAPELLA_FORK_VERSION)
+    assert post.validators[idx].exit_epoch != C.FAR_FUTURE_EPOCH
+    # signed over the CURRENT (deneb) fork domain -> rejected under the pin
+    deneb_domain = H.get_domain(cfg, state, C.DOMAIN_VOLUNTARY_EXIT, 0)
+    assert deneb_domain != capella_domain
+    bad = S.SignedVoluntaryExit(
+        message=exit_msg,
+        signature=bls.sign(sks[idx], H.compute_signing_root(
+            exit_msg, deneb_domain)))
+    with pytest.raises(Exception):
+        process_voluntary_exit(cfg, state, bad, SIMPLE,
+                               exit_fork_version=cfg.CAPELLA_FORK_VERSION)
+
+
+def test_commitment_inclusion_proof_roundtrip():
+    cfg, state, sks = _deneb_state()
+    S = get_deneb_schemas(cfg)
+    depth = kzg_commitment_inclusion_proof_depth(cfg)
+    assert depth == 4 + 1 + 4  # minimal: 16-limit subtree + mix + body
+    commitments = tuple(bytes([i]) * 48 for i in range(3))
+    body = S.BeaconBlockBody(blob_kzg_commitments=commitments)
+    block = S.BeaconBlock(slot=5, proposer_index=1,
+                          parent_root=b"\x01" * 32,
+                          state_root=b"\x02" * 32, body=body)
+    signed = S.SignedBeaconBlock(message=block, signature=b"\x03" * 96)
+    blobs = [bytes(32 * cfg.FIELD_ELEMENTS_PER_BLOB)] * 3
+    proofs = [bytes(48)] * 3
+    sidecars = make_blob_sidecars(cfg, signed, blobs, proofs)
+    assert len(sidecars) == 3
+    for sc in sidecars:
+        assert verify_commitment_inclusion_proof(cfg, sc)
+    # tampering with the commitment, index, or proof breaks it
+    sc = sidecars[1]
+    assert not verify_commitment_inclusion_proof(
+        cfg, sc.copy_with(kzg_commitment=b"\xff" * 48))
+    assert not verify_commitment_inclusion_proof(
+        cfg, sc.copy_with(index=2))
+    branch = list(sc.kzg_commitment_inclusion_proof)
+    branch[0] = b"\x00" * 32
+    assert not verify_commitment_inclusion_proof(
+        cfg, sc.copy_with(kzg_commitment_inclusion_proof=tuple(branch)))
+
+
+def test_mainnet_inclusion_proof_depth_is_17():
+    assert kzg_commitment_inclusion_proof_depth(C.MAINNET) == 17
+
+
+def test_blob_commitment_cap_enforced():
+    cfg, state, sks = _deneb_state()
+    S = get_deneb_schemas(cfg)
+    pre = process_slots(cfg, state, 1)
+    too_many = tuple(bytes([i]) * 48
+                     for i in range(cfg.MAX_BLOBS_PER_BLOCK + 1))
+    body = S.BeaconBlockBody(blob_kzg_commitments=too_many)
+    with pytest.raises(Exception):
+        DB.process_execution_payload(cfg, pre, body)
+
+
+def test_deneb_payload_header_carries_blob_gas():
+    S = get_deneb_schemas(CFG)
+    p = S.ExecutionPayload(blob_gas_used=7, excess_blob_gas=9,
+                           block_hash=b"\x0a" * 32)
+    h = payload_to_header_deneb(p)
+    assert h.blob_gas_used == 7 and h.excess_blob_gas == 9
+    assert h.block_hash == p.block_hash
+
+
+def test_fork_at_genesis_has_equal_versions():
+    """Spec: genesis states of later-fork configs set previous ==
+    current (no prior fork existed on chain)."""
+    cfg, state, _ = _deneb_state()
+    assert state.fork.current_version == cfg.DENEB_FORK_VERSION
+    assert state.fork.previous_version == cfg.DENEB_FORK_VERSION
+
+
+def test_sidecar_gossip_rejects_wrong_proposer():
+    from teku_tpu.crypto import kzg
+    from teku_tpu.node.blobs import validate_spec_sidecar
+    from teku_tpu.spec.deneb.datastructures import make_blob_sidecars
+    cfg, state, sks = _deneb_state()
+    S = get_deneb_schemas(cfg)
+    setup = kzg.insecure_setup()
+    blob = b"\x00" * (32 * cfg.FIELD_ELEMENTS_PER_BLOB)
+    commitment = kzg.blob_to_kzg_commitment(blob, setup)
+    proof = kzg.compute_blob_kzg_proof(blob, commitment, setup)
+    slot = 1
+    expected = H.get_beacon_proposer_index(cfg, state, slot=slot)
+    body = S.BeaconBlockBody(blob_kzg_commitments=(commitment,))
+
+    def signed_block_by(index):
+        block = S.BeaconBlock(slot=slot, proposer_index=index,
+                              parent_root=b"\x01" * 32,
+                              state_root=b"\x02" * 32, body=body)
+        header = type(state.latest_block_header)(
+            slot=slot, proposer_index=index,
+            parent_root=block.parent_root, state_root=block.state_root,
+            body_root=body.htr())
+        domain = H.get_domain(cfg, state, C.DOMAIN_BEACON_PROPOSER, 0)
+        sig = bls.sign(sks[index], H.compute_signing_root(header, domain))
+        return S.SignedBeaconBlock(message=block, signature=sig)
+
+    good = make_blob_sidecars(cfg, signed_block_by(expected),
+                              [blob], [proof])[0]
+    assert validate_spec_sidecar(cfg, good, state=state,
+                                 setup=setup) == "accept"
+    wrong = (expected + 1) % len(state.validators)
+    forged = make_blob_sidecars(cfg, signed_block_by(wrong),
+                                [blob], [proof])[0]
+    assert validate_spec_sidecar(cfg, forged, state=state,
+                                 setup=setup) == "reject"

@@ -110,3 +110,35 @@ def test_devnet_rejects_invalid_gossip_block():
         finally:
             await net.stop()
     asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_devnet_deneb_at_genesis_finalizes():
+    """Two nodes on a deneb-at-genesis network: capella payload chain +
+    deneb schemas over gossip, chain still finalizes."""
+    import dataclasses
+    from teku_tpu.spec import config as C, Spec
+
+    cfg = dataclasses.replace(C.MINIMAL, ALTAIR_FORK_EPOCH=0,
+                              BELLATRIX_FORK_EPOCH=0,
+                              CAPELLA_FORK_EPOCH=0, DENEB_FORK_EPOCH=0)
+
+    async def run():
+        net = Devnet(n_nodes=2, n_validators=32, spec=Spec(cfg))
+        await net.start()
+        try:
+            epochs = 4
+            await net.run_until_slot(
+                epochs * cfg.SLOTS_PER_EPOCH)
+            assert net.heads_converged(), "nodes diverged"
+            assert net.min_justified_epoch() >= epochs - 2
+            assert net.min_finalized_epoch() >= 1
+            # the payload chain advanced on every node
+            for node in net.nodes:
+                hdr = node.chain.head_state() \
+                    .latest_execution_payload_header
+                assert hdr.block_number > 0
+                assert hdr.excess_blob_gas == 0
+        finally:
+            await net.stop()
+    asyncio.run(run())

@@ -1,0 +1,356 @@
+"""Electra: six-fork ladder, balance churn, execution requests,
+pending queues, committee-bits attestations."""
+
+import dataclasses
+
+import pytest
+
+from teku_tpu.crypto import bls
+from teku_tpu.spec import config as C
+from teku_tpu.spec import helpers as H
+from teku_tpu.spec.builder import (make_local_signer, produce_attestations,
+                                   produce_block)
+from teku_tpu.spec.electra import block as XB
+from teku_tpu.spec.electra import epoch as XE
+from teku_tpu.spec.electra import helpers as EH
+from teku_tpu.spec.electra.datastructures import (PendingDeposit,
+                                                  get_electra_schemas)
+from teku_tpu.spec.genesis import interop_genesis
+from teku_tpu.spec.milestones import build_fork_schedule, SpecMilestone
+from teku_tpu.spec.transition import process_slots, state_transition
+from teku_tpu.spec.verifiers import SIMPLE
+
+CFG = dataclasses.replace(C.MINIMAL, ALTAIR_FORK_EPOCH=1,
+                          BELLATRIX_FORK_EPOCH=2, CAPELLA_FORK_EPOCH=3,
+                          DENEB_FORK_EPOCH=4, ELECTRA_FORK_EPOCH=5)
+
+
+def _electra_state(n=16):
+    cfg = dataclasses.replace(CFG, ALTAIR_FORK_EPOCH=0,
+                              BELLATRIX_FORK_EPOCH=0, CAPELLA_FORK_EPOCH=0,
+                              DENEB_FORK_EPOCH=0, ELECTRA_FORK_EPOCH=0)
+    state, sks = interop_genesis(cfg, n)
+    return cfg, state, sks
+
+
+def _with_compounding(state, idx, effective=None, balance=None):
+    v = state.validators[idx]
+    validators = list(state.validators)
+    validators[idx] = v.copy_with(
+        withdrawal_credentials=b"\x02" + v.withdrawal_credentials[1:11]
+        + b"\x00" + b"\xaa" * 20,
+        **({"effective_balance": effective} if effective else {}))
+    state = state.copy_with(validators=tuple(validators))
+    if balance is not None:
+        balances = list(state.balances)
+        balances[idx] = balance
+        state = state.copy_with(balances=tuple(balances))
+    return state
+
+
+def test_milestone_schedule_six_forks():
+    sched = build_fork_schedule(CFG)
+    assert sched.milestone_at_epoch(4) is SpecMilestone.DENEB
+    assert sched.milestone_at_epoch(5) is SpecMilestone.ELECTRA
+    assert sched.milestone_at_epoch(10 ** 9) is SpecMilestone.ELECTRA
+
+
+@pytest.mark.slow
+def test_electra_ladder_finalizes():
+    state, sks = interop_genesis(CFG, 32)
+    signer = make_local_signer(dict(enumerate(sks)))
+    S = get_electra_schemas(CFG)
+    atts, cur = [], state
+    for slot in range(1, 8 * CFG.SLOTS_PER_EPOCH + 1):
+        signed, post = produce_block(CFG, cur, slot, signer,
+                                     attestations=atts)
+        verified = state_transition(CFG, cur, signed,
+                                    validate_result=True)
+        assert verified.htr() == post.htr(), f"divergence at slot {slot}"
+        atts = produce_attestations(CFG, post, slot,
+                                    signed.message.htr(), signer)
+        cur = post
+    assert isinstance(cur, S.BeaconState)
+    assert cur.fork.current_version == CFG.ELECTRA_FORK_VERSION
+    assert cur.fork.previous_version == CFG.DENEB_FORK_VERSION
+    assert cur.finalized_checkpoint.epoch >= 5
+    assert cur.deposit_requests_start_index \
+        == C.UNSET_DEPOSIT_REQUESTS_START_INDEX
+
+
+def test_electra_attestation_requires_committee_bits_shape():
+    cfg, state, sks = _electra_state(n=16)
+    signer = make_local_signer(dict(enumerate(sks)))
+    signed, cur = produce_block(cfg, state, 1, signer)
+    atts = produce_attestations(cfg, cur, 1, signed.message.htr(),
+                                signer)
+    assert atts and atts[0].data.index == 0
+    assert sum(atts[0].committee_bits) == 1
+    adv = process_slots(cfg, cur, 2)
+    post = XB.process_attestation(cfg, adv, atts[0], SIMPLE)
+    # attesters earned their flags
+    assert post.current_epoch_participation \
+        != adv.current_epoch_participation
+    # nonzero data.index rejected
+    bad = atts[0].copy_with(data=atts[0].data.copy_with(index=1))
+    with pytest.raises(Exception):
+        XB.process_attestation(cfg, adv, bad, SIMPLE)
+    # committee bit must match the aggregation bits length
+    wrong_bits = atts[0].copy_with(
+        aggregation_bits=tuple(atts[0].aggregation_bits) + (True,))
+    with pytest.raises(Exception):
+        XB.process_attestation(cfg, adv, wrong_bits, SIMPLE)
+
+
+def test_withdrawal_request_full_exit_and_partial():
+    cfg, state, _ = _electra_state()
+    state = state.copy_with(slot=(cfg.SHARD_COMMITTEE_PERIOD + 1)
+                            * cfg.SLOTS_PER_EPOCH)
+    S = get_electra_schemas(cfg)
+    # compounding validator 3 with excess balance
+    state = _with_compounding(state, 3,
+                              effective=cfg.MIN_ACTIVATION_BALANCE,
+                              balance=cfg.MIN_ACTIVATION_BALANCE
+                              + 7 * 10 ** 9)
+    v = state.validators[3]
+    addr = v.withdrawal_credentials[12:]
+    # partial skim of 5 gwei-billions
+    req = S.WithdrawalRequest(source_address=addr,
+                              validator_pubkey=v.pubkey,
+                              amount=5 * 10 ** 9)
+    post = XB.process_withdrawal_request(cfg, state, req)
+    (w,) = post.pending_partial_withdrawals
+    assert w.validator_index == 3 and w.amount == 5 * 10 ** 9
+    # full exit blocked while a partial is pending
+    full = S.WithdrawalRequest(source_address=addr,
+                               validator_pubkey=v.pubkey,
+                               amount=C.FULL_EXIT_REQUEST_AMOUNT)
+    post2 = XB.process_withdrawal_request(cfg, post, full)
+    assert post2.validators[3].exit_epoch == C.FAR_FUTURE_EPOCH
+    # full exit on the clean state initiates a churned exit
+    post3 = XB.process_withdrawal_request(cfg, state, full)
+    assert post3.validators[3].exit_epoch != C.FAR_FUTURE_EPOCH
+    # wrong source address is a no-op
+    bad = S.WithdrawalRequest(source_address=b"\x0f" * 20,
+                              validator_pubkey=v.pubkey, amount=0)
+    assert XB.process_withdrawal_request(cfg, state, bad) == state
+
+
+def test_partial_withdrawals_drain_through_sweep():
+    cfg, state, _ = _electra_state()
+    state = state.copy_with(slot=(cfg.SHARD_COMMITTEE_PERIOD + 1)
+                            * cfg.SLOTS_PER_EPOCH)
+    S = get_electra_schemas(cfg)
+    state = _with_compounding(state, 2,
+                              effective=cfg.MIN_ACTIVATION_BALANCE,
+                              balance=cfg.MIN_ACTIVATION_BALANCE
+                              + 9 * 10 ** 9)
+    v = state.validators[2]
+    req = S.WithdrawalRequest(source_address=v.withdrawal_credentials[12:],
+                              validator_pubkey=v.pubkey,
+                              amount=9 * 10 ** 9)
+    state = XB.process_withdrawal_request(cfg, state, req)
+    (pw,) = state.pending_partial_withdrawals
+    # once withdrawable, the expected-withdrawals list pays it out
+    state = state.copy_with(
+        slot=(pw.withdrawable_epoch + 1) * cfg.SLOTS_PER_EPOCH)
+    withdrawals, processed = XB.get_expected_withdrawals(cfg, state)
+    assert processed == 1
+    assert withdrawals[0].validator_index == 2
+    assert withdrawals[0].amount == 9 * 10 ** 9
+    payload = S.ExecutionPayload(withdrawals=tuple(withdrawals))
+    post = XB.process_withdrawals(cfg, state, payload)
+    assert post.pending_partial_withdrawals == ()
+    assert post.balances[2] == cfg.MIN_ACTIVATION_BALANCE
+
+
+def test_consolidation_request_switch_to_compounding():
+    cfg, state, _ = _electra_state()
+    S = get_electra_schemas(cfg)
+    # validator 4 gets an eth1 credential first
+    validators = list(state.validators)
+    validators[4] = validators[4].copy_with(
+        withdrawal_credentials=b"\x01" + bytes(11) + b"\xbb" * 20)
+    balances = list(state.balances)
+    balances[4] = cfg.MIN_ACTIVATION_BALANCE + 3 * 10 ** 9
+    state = state.copy_with(validators=tuple(validators),
+                            balances=tuple(balances))
+    v = state.validators[4]
+    req = S.ConsolidationRequest(source_address=b"\xbb" * 20,
+                                 source_pubkey=v.pubkey,
+                                 target_pubkey=v.pubkey)
+    post = XB.process_consolidation_request(cfg, state, req)
+    assert EH.has_compounding_withdrawal_credential(post.validators[4])
+    # excess above MIN_ACTIVATION_BALANCE was queued as a deposit
+    assert post.balances[4] == cfg.MIN_ACTIVATION_BALANCE
+    (pd,) = post.pending_deposits
+    assert pd.amount == 3 * 10 ** 9 and pd.pubkey == v.pubkey
+
+
+def test_cross_consolidation_and_pending_processing():
+    cfg, state, _ = _electra_state()
+    state = state.copy_with(slot=(cfg.SHARD_COMMITTEE_PERIOD + 1)
+                            * cfg.SLOTS_PER_EPOCH)
+    S = get_electra_schemas(cfg)
+    # boost total balance so the consolidation churn is non-trivial
+    # (balance churn must exceed the 256-ETH activation/exit cap):
+    # five compounding validators at 2048 ETH
+    for i in (6, 7, 8, 9, 10):
+        state = _with_compounding(
+            state, i, effective=cfg.MAX_EFFECTIVE_BALANCE_ELECTRA,
+            balance=cfg.MAX_EFFECTIVE_BALANCE_ELECTRA)
+    assert EH.get_consolidation_churn_limit(cfg, state) \
+        > cfg.MIN_ACTIVATION_BALANCE
+    # source: eth1-credentialed validator 5; target: compounding 6
+    validators = list(state.validators)
+    validators[5] = validators[5].copy_with(
+        withdrawal_credentials=b"\x01" + bytes(11) + b"\xcc" * 20)
+    state = state.copy_with(validators=tuple(validators))
+    src, tgt = state.validators[5], state.validators[6]
+    req = S.ConsolidationRequest(source_address=b"\xcc" * 20,
+                                 source_pubkey=src.pubkey,
+                                 target_pubkey=tgt.pubkey)
+    post = XB.process_consolidation_request(cfg, state, req)
+    (pc,) = post.pending_consolidations
+    assert (pc.source_index, pc.target_index) == (5, 6)
+    exit_epoch = post.validators[5].exit_epoch
+    assert exit_epoch != C.FAR_FUTURE_EPOCH
+    # not withdrawable yet: pending consolidation waits
+    waited = XE.process_pending_consolidations(cfg, post)
+    assert len(waited.pending_consolidations) == 1
+    # once the source is withdrawable, the balance moves to the target
+    adv = post.copy_with(
+        slot=(post.validators[5].withdrawable_epoch + 1)
+        * cfg.SLOTS_PER_EPOCH)
+    src_balance = adv.balances[5]
+    done = XE.process_pending_consolidations(cfg, adv)
+    assert done.pending_consolidations == ()
+    assert done.balances[5] == src_balance - min(
+        src_balance, post.validators[5].effective_balance)
+    assert done.balances[6] == adv.balances[6] + min(
+        src_balance, post.validators[5].effective_balance)
+
+
+def test_deposit_request_and_pending_deposit_flow():
+    cfg, state, sks = _electra_state()
+    S = get_electra_schemas(cfg)
+    # a deposit request for a brand-new key
+    sk = 12345
+    pk = bls.secret_to_public_key(sk)
+    creds = b"\x01" + bytes(11) + b"\xdd" * 20
+    amount = cfg.MIN_ACTIVATION_BALANCE
+    from teku_tpu.spec.datastructures import DepositMessage
+    msg = DepositMessage(pubkey=pk, withdrawal_credentials=creds,
+                         amount=amount)
+    domain = H.compute_domain(C.DOMAIN_DEPOSIT, cfg.GENESIS_FORK_VERSION,
+                              bytes(32))
+    sig = bls.sign(sk, H.compute_signing_root(msg, domain))
+    req = S.DepositRequest(pubkey=pk, withdrawal_credentials=creds,
+                           amount=amount, signature=sig, index=0)
+    state = XB.process_deposit_request(cfg, state, req)
+    assert state.deposit_requests_start_index == 0
+    (pd,) = state.pending_deposits
+    assert pd.slot == state.slot
+    # finalize far enough and run the epoch queue: validator appears
+    state = state.copy_with(
+        finalized_checkpoint=state.finalized_checkpoint.copy_with(
+            epoch=2),
+        eth1_deposit_index=state.deposit_requests_start_index)
+    n_before = len(state.validators)
+    post = XE.process_pending_deposits(cfg, state)
+    assert len(post.validators) == n_before + 1
+    assert post.validators[-1].pubkey == pk
+    assert post.balances[-1] == amount
+    assert post.pending_deposits == ()
+    # top-up of an existing validator skips the signature check
+    top_up = PendingDeposit(pubkey=state.validators[0].pubkey,
+                            withdrawal_credentials=bytes(32),
+                            amount=10 ** 9, signature=b"\x00" * 96,
+                            slot=0)
+    state2 = state.copy_with(pending_deposits=(top_up,))
+    post2 = XE.process_pending_deposits(cfg, state2)
+    assert post2.balances[0] == state2.balances[0] + 10 ** 9
+
+
+def test_pending_deposits_respect_finality_and_churn():
+    cfg, state, _ = _electra_state()
+    pd = PendingDeposit(pubkey=b"\x01" * 48,
+                        withdrawal_credentials=bytes(32),
+                        amount=10 ** 9, signature=b"\x00" * 96,
+                        slot=10 * cfg.SLOTS_PER_EPOCH)
+    state = state.copy_with(pending_deposits=(pd,))
+    # not finalized yet: nothing processed
+    post = XE.process_pending_deposits(cfg, state)
+    assert len(post.pending_deposits) == 1
+    # churn cap: huge deposits roll balance into the next epoch
+    huge = PendingDeposit(pubkey=state.validators[1].pubkey,
+                          withdrawal_credentials=bytes(32),
+                          amount=10 * cfg.MAX_PER_EPOCH_ACTIVATION_EXIT_CHURN_LIMIT,
+                          signature=b"\x00" * 96, slot=0)
+    state2 = state.copy_with(pending_deposits=(huge,),
+                             finalized_checkpoint=state.
+                             finalized_checkpoint.copy_with(epoch=1))
+    post2 = XE.process_pending_deposits(cfg, state2)
+    assert len(post2.pending_deposits) == 1      # still queued
+    assert post2.deposit_balance_to_consume > 0  # churn accumulated
+
+
+def test_exit_churn_schedules_by_balance():
+    cfg, state, _ = _electra_state()
+    limit = EH.get_activation_exit_churn_limit(cfg, state)
+    state2, epoch1 = EH.compute_exit_epoch_and_update_churn(
+        cfg, state, limit)
+    # a second full-churn exit in the same epoch pushes one epoch out
+    state3, epoch2 = EH.compute_exit_epoch_and_update_churn(
+        cfg, state2, limit)
+    assert epoch2 == epoch1 + 1
+
+
+def test_effective_balance_cap_per_credential():
+    cfg, state, _ = _electra_state()
+    # compounding validator accrues above 32 ETH
+    state = _with_compounding(state, 1,
+                              balance=40 * 10 ** 9)
+    post = XE.process_effective_balance_updates(cfg, state)
+    assert post.validators[1].effective_balance == 40 * 10 ** 9
+    # eth1-credentialed validator stays capped at MIN_ACTIVATION_BALANCE
+    validators = list(state.validators)
+    validators[9] = validators[9].copy_with(
+        withdrawal_credentials=b"\x01" + bytes(11) + b"\x01" * 20)
+    balances = list(state.balances)
+    balances[9] = 40 * 10 ** 9
+    state = state.copy_with(validators=tuple(validators),
+                            balances=tuple(balances))
+    post = XE.process_effective_balance_updates(cfg, state)
+    assert post.validators[9].effective_balance \
+        == cfg.MIN_ACTIVATION_BALANCE
+
+
+def test_upgrade_queues_pre_activation_validators():
+    """A deneb validator still waiting to activate crosses the fork as
+    a pending deposit with zeroed balance."""
+    cfg = dataclasses.replace(CFG, ALTAIR_FORK_EPOCH=0,
+                              BELLATRIX_FORK_EPOCH=0, CAPELLA_FORK_EPOCH=0,
+                              DENEB_FORK_EPOCH=0, ELECTRA_FORK_EPOCH=1)
+    state, sks = interop_genesis(cfg, 16)
+    # add a pending (not yet activated) validator pre-fork
+    from teku_tpu.spec.block import get_validator_from_deposit
+    newcomer = get_validator_from_deposit(
+        cfg, b"\x22" * 48, b"\x00" + b"\x11" * 31,
+        cfg.MAX_EFFECTIVE_BALANCE)
+    state = state.copy_with(
+        validators=tuple(state.validators) + (newcomer,),
+        balances=tuple(state.balances) + (cfg.MAX_EFFECTIVE_BALANCE,),
+        previous_epoch_participation=tuple(
+            state.previous_epoch_participation) + (0,),
+        current_epoch_participation=tuple(
+            state.current_epoch_participation) + (0,),
+        inactivity_scores=tuple(state.inactivity_scores) + (0,))
+    post = process_slots(cfg, state, cfg.SLOTS_PER_EPOCH)
+    S = get_electra_schemas(cfg)
+    assert isinstance(post, S.BeaconState)
+    assert post.balances[-1] == 0
+    assert post.validators[-1].effective_balance == 0
+    (pd,) = post.pending_deposits
+    assert pd.pubkey == b"\x22" * 48
+    assert pd.amount == cfg.MAX_EFFECTIVE_BALANCE
