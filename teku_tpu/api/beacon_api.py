@@ -54,6 +54,16 @@ class BeaconRestApi(RestApi):
         p("/eth/v1/beacon/pool/attestations", self._submit_attestations)
         p("/eth/v1/beacon/pool/voluntary_exits", self._submit_exit)
         g("/eth/v1/beacon/blob_sidecars/{block_id}", self._blob_sidecars)
+        # the remote-VC surface (reference: handlers/v1/validator/* and
+        # the debug state endpoint checkpoint sync reads)
+        g("/eth/v2/debug/beacon/states/{state_id}", self._state_ssz)
+        g("/eth/v1/validator/attestation_data", self._attestation_data)
+        g("/eth/v1/validator/aggregate_attestation",
+          self._aggregate_attestation)
+        g("/eth/v3/validator/blocks/{slot}", self._produce_block)
+        p("/eth/v2/beacon/blocks", self._publish_block_ssz)
+        p("/eth/v1/validator/aggregate_and_proofs",
+          self._submit_aggregate_ssz)
         g("/metrics", self._metrics)
 
     # -- resolution helpers -------------------------------------------
@@ -201,6 +211,86 @@ class BeaconRestApi(RestApi):
             },
             "signature": _hex(signed.signature)}}
 
+    async def _state_ssz(self, state_id: str):
+        """Full state as SSZ (reference GetState debug handler) — the
+        fetch behind checkpoint sync and the remote VC's duty states."""
+        state = self._resolve_state(state_id)
+        return type(state).serialize(state), "application/octet-stream"
+
+    async def _attestation_data(self, query=None):
+        if self.validator_api is None:
+            raise HttpError(503, "validator api not wired")
+        try:
+            slot = int((query or {})["slot"])
+            ci = int((query or {})["committee_index"])
+        except (KeyError, ValueError):
+            raise HttpError(400, "slot and committee_index required")
+        data = self.validator_api.get_attestation_data(slot, ci)
+        return {"data": {
+            "slot": str(data.slot), "index": str(data.index),
+            "beacon_block_root": _hex(data.beacon_block_root),
+            "source": {"epoch": str(data.source.epoch),
+                       "root": _hex(data.source.root)},
+            "target": {"epoch": str(data.target.epoch),
+                       "root": _hex(data.target.root)}}}
+
+    async def _aggregate_attestation(self, query=None):
+        try:
+            root = bytes.fromhex(
+                (query or {})["attestation_data_root"][2:])
+        except (KeyError, ValueError):
+            raise HttpError(400, "attestation_data_root required")
+        aggregate = self.node.pool.get_aggregate_by_root(root)
+        if aggregate is None:
+            raise HttpError(404, "no aggregate for this data")
+        return type(aggregate).serialize(aggregate), \
+            "application/octet-stream"
+
+    async def _produce_block(self, slot: str, query=None):
+        """Unsigned block production for the remote VC (reference
+        produceBlockV3) — SSZ response; the VC signs and POSTs back."""
+        if self.validator_api is None:
+            raise HttpError(503, "validator api not wired")
+        try:
+            reveal = bytes.fromhex((query or {})["randao_reveal"][2:])
+        except (KeyError, ValueError):
+            raise HttpError(400, "randao_reveal required")
+        graffiti = bytes(32)
+        if query and "graffiti" in query:
+            graffiti = bytes.fromhex(query["graffiti"][2:]).ljust(32,
+                                                                  b"\x00")
+        try:
+            block, _pre = await self.validator_api.produce_unsigned_block(
+                int(slot), reveal, graffiti)
+        except Exception as exc:
+            raise HttpError(500, f"block production failed: {exc}")
+        return type(block).serialize(block), "application/octet-stream"
+
+    async def _publish_block_ssz(self, raw_body=None):
+        if not raw_body:
+            raise HttpError(400, "SSZ SignedBeaconBlock body required")
+        from ..spec.codec import deserialize_signed_block
+        try:
+            signed = deserialize_signed_block(self.node.spec.config,
+                                              raw_body)
+        except Exception as exc:
+            raise HttpError(400, f"malformed block: {exc}")
+        if self.validator_api is not None:
+            await self.validator_api.publish_signed_block(signed)
+        else:
+            self.node.block_manager.import_block(signed)
+        return {}
+
+    async def _submit_aggregate_ssz(self, raw_body=None):
+        if not raw_body:
+            raise HttpError(400, "SSZ SignedAggregateAndProof required")
+        signed = self._decode_versioned("SignedAggregateAndProof",
+                                        raw_body)
+        if self.validator_api is None:
+            raise HttpError(503, "validator api not wired")
+        await self.validator_api.publish_aggregate_and_proof(signed)
+        return {}
+
     async def _state_root(self, state_id: str):
         state = self._resolve_state(state_id)
         return {"data": {"root": _hex(state.htr())}}
@@ -281,7 +371,34 @@ class BeaconRestApi(RestApi):
              "validator_committee_index": str(d.committee_position),
              "slot": str(d.slot)} for d in duties]}
 
-    async def _submit_attestations(self, body=None):
+    def _decode_versioned(self, attr: str, raw: bytes):
+        """Decode raw SSZ against each scheduled milestone's schema,
+        newest first — strict decoding makes cross-family false
+        positives fail, so the wire shape picks its own fork."""
+        from ..spec.milestones import build_fork_schedule
+        last = None
+        for version in reversed(
+                build_fork_schedule(self.node.spec.config).versions):
+            try:
+                return getattr(version.schemas, attr).deserialize(raw)
+            except Exception as exc:
+                last = exc
+        raise HttpError(400, f"malformed {attr}: {last}")
+
+    async def _submit_attestations(self, body=None, raw_body=None):
+        if body is None and raw_body:
+            # SSZ alternative (application/octet-stream): ONE
+            # attestation per request, the remote VC's submit shape
+            att = self._decode_versioned("Attestation", raw_body)
+            if self.validator_api is not None:
+                await self.validator_api.publish_attestation(att)
+                return {}
+            from ..node.gossip import ValidationResult
+            result = await self.node.attestation_validator.validate(att)
+            if result is ValidationResult.REJECT:
+                raise HttpError(400, "attestation rejected")
+            self.node.attestation_manager.add_attestation(att)
+            return {}
         if not isinstance(body, list):
             raise HttpError(400, "expected a list of attestations")
         S = self.node.spec.schemas

@@ -262,6 +262,60 @@ def cmd_slashing_protection(args) -> int:
     return 0
 
 
+def cmd_validator_client(args) -> int:
+    """VC-only process: duties over the REST API of a remote beacon
+    node (reference `validator-client` subcommand /
+    ValidatorClientCommand.java with RemoteValidatorApiHandler)."""
+    import time
+    from .spec import create_spec
+    from .spec.genesis import interop_secret_keys
+    from .validator import (LocalSigner, RemoteValidatorApi,
+                            SlashingProtectedSigner, ValidatorClient)
+    from .validator.slashing_protection import SlashingProtector
+
+    spec = create_spec(args.network or "minimal")
+    remote = RemoteValidatorApi(spec, args.beacon_node)
+    genesis = remote._get_json("/eth/v1/beacon/genesis")["data"]
+    genesis_time = int(genesis["genesis_time"])
+    sks = interop_secret_keys(args.interop_total)
+    first = args.interop_start
+    if first + args.interop_validators > args.interop_total:
+        print("error: --interop-start + --interop-validators exceeds "
+              "--interop-total", file=sys.stderr)
+        return 2
+    keys = {i: sks[i] for i in range(first,
+                                     first + args.interop_validators)}
+    signer = SlashingProtectedSigner(
+        LocalSigner(keys),
+        SlashingProtector(Path(args.data_dir) / "slashing"
+                          if args.data_dir else None))
+    client = ValidatorClient(spec, remote, signer, sorted(keys))
+    print(f"validator client up: {len(keys)} validators "
+          f"[{first}..{first + len(keys) - 1}] -> {args.beacon_node}")
+
+    async def run():
+        third = spec.config.SECONDS_PER_SLOT / 3
+        while True:
+            now = int(time.time())
+            slot = max(0, (now - genesis_time)
+                       // spec.config.SECONDS_PER_SLOT)
+            if slot > 0:
+                try:
+                    await client.on_slot_start(slot)
+                    await asyncio.sleep(third)
+                    await client.on_attestation_due(slot)
+                    await asyncio.sleep(third)
+                    await client.on_aggregation_due(slot)
+                except Exception:
+                    logging.exception("duty loop error at slot %d", slot)
+            next_slot_time = genesis_time + (slot + 1) * \
+                spec.config.SECONDS_PER_SLOT
+            await asyncio.sleep(max(0.1, next_slot_time - time.time()))
+
+    asyncio.run(run())
+    return 0
+
+
 def cmd_peer(args) -> int:
     """Generate a node identity (reference `peer generate`)."""
     import secrets
@@ -322,6 +376,18 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--file", required=True)
     s.add_argument("--genesis-validators-root", default="00" * 32)
     s.set_defaults(fn=cmd_slashing_protection)
+
+    vc = sub.add_parser("validator-client",
+                        help="VC-only process against a remote node")
+    vc.add_argument("--network", default=None)
+    vc.add_argument("--beacon-node", default="http://127.0.0.1:5051",
+                    help="REST base URL of the beacon node")
+    vc.add_argument("--interop-validators", type=int, default=8)
+    vc.add_argument("--interop-start", type=int, default=0,
+                    help="first interop key index this VC owns")
+    vc.add_argument("--interop-total", type=int, default=64)
+    vc.add_argument("--data-dir", default=None)
+    vc.set_defaults(fn=cmd_validator_client)
 
     pe = sub.add_parser("peer", help="generate a node identity")
     pe.set_defaults(fn=cmd_peer)

@@ -116,11 +116,16 @@ class RestApi:
                 try:
                     kwargs = dict(match.groupdict())
                     accepted = inspect.signature(handler).parameters
+                    if body and "raw_body" in accepted:
+                        # SSZ/binary endpoints take the bytes verbatim
+                        kwargs["raw_body"] = body
                     if body and "body" in accepted:
                         try:
                             kwargs["body"] = json.loads(body)
-                        except json.JSONDecodeError:
-                            raise HttpError(400, "invalid JSON body")
+                        except (json.JSONDecodeError, ValueError,
+                                UnicodeDecodeError):
+                            if "raw_body" not in accepted:
+                                raise HttpError(400, "invalid JSON body")
                     if params and "query" in accepted:
                         kwargs["query"] = params
                     result = await handler(**kwargs)

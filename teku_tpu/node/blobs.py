@@ -123,11 +123,10 @@ class BlobSidecarPool:
         from ..spec.deneb.datastructures import (
             verify_commitment_inclusion_proof)
         header = sidecar.signed_block_header.message
-        # the slot's milestone is authoritative for the wire path:
-        # electra raises the cap, and the pool bound must follow so a
-        # gossip-accepted index can't be silently dropped here
-        self.max_blobs = max(self.max_blobs,
-                             max_blobs_for_slot(cfg, header.slot))
+        # per-sidecar bound from the slot's OWN milestone — never
+        # ratchet pool-wide state off a wire-controlled header slot
+        if sidecar.index >= max_blobs_for_slot(cfg, header.slot):
+            return False
         if not verify_commitment_inclusion_proof(cfg, sidecar):
             return False
         root = header.htr()

@@ -70,7 +70,12 @@ class AggregatingAttestationPool:
     def get_aggregate(self, data) -> Optional[object]:
         """Best current aggregate for the given AttestationData (the
         aggregator duty's getAggregate)."""
-        group = self._groups.get(data.htr())
+        return self.get_aggregate_by_root(data.htr())
+
+    def get_aggregate_by_root(self, data_root: bytes) -> Optional[object]:
+        """Aggregate keyed by AttestationData root — the REST
+        aggregate_attestation endpoint's lookup shape."""
+        group = self._groups.get(data_root)
         if group is None:
             return None
         return group.best_aggregate(self.spec.schemas.Attestation)

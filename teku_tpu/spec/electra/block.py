@@ -164,16 +164,24 @@ def process_deposit_request(cfg: SpecConfig, state, request):
 
 # ---- EL-triggered withddrawals / consolidations ----
 
-def process_withdrawal_request(cfg: SpecConfig, state, request):
+def _pubkey_index_map(state):
+    """One pubkey→index map per block's request batch; request handlers
+    take it instead of scanning the registry per request."""
+    return {v.pubkey: i for i, v in enumerate(state.validators)}
+
+
+def process_withdrawal_request(cfg: SpecConfig, state, request,
+                               index_by_pubkey=None):
     """EIP-7002: the EL can exit (amount=0) or skim (amount>0) any
     validator whose 0x01/0x02 credential commits to the caller."""
     amount = request.amount
     is_full_exit = amount == FULL_EXIT_REQUEST_AMOUNT
     # partial withdrawals only for compounding validators
-    pubkeys = [v.pubkey for v in state.validators]
-    if request.validator_pubkey not in pubkeys:
+    if index_by_pubkey is None:
+        index_by_pubkey = _pubkey_index_map(state)
+    index = index_by_pubkey.get(request.validator_pubkey)
+    if index is None:
         return state
-    index = pubkeys.index(request.validator_pubkey)
     v = state.validators[index]
     if not (is_full_exit
             or EH.has_compounding_withdrawal_credential(v)):
@@ -218,10 +226,13 @@ def process_withdrawal_request(cfg: SpecConfig, state, request):
                                     withdrawable_epoch=withdrawable_epoch),))
 
 
-def process_consolidation_request(cfg: SpecConfig, state, request):
-    if _is_valid_switch_to_compounding(cfg, state, request):
-        pubkeys = [v.pubkey for v in state.validators]
-        index = pubkeys.index(request.source_pubkey)
+def process_consolidation_request(cfg: SpecConfig, state, request,
+                                  index_by_pubkey=None):
+    if index_by_pubkey is None:
+        index_by_pubkey = _pubkey_index_map(state)
+    if _is_valid_switch_to_compounding(cfg, state, request,
+                                       index_by_pubkey):
+        index = index_by_pubkey[request.source_pubkey]
         return EH.switch_to_compounding_validator(cfg, state, index)
     # churn must leave room for at least one increment
     if EH.get_consolidation_churn_limit(cfg, state) \
@@ -230,12 +241,10 @@ def process_consolidation_request(cfg: SpecConfig, state, request):
     if len(state.pending_consolidations) \
             >= cfg.PENDING_CONSOLIDATIONS_LIMIT:
         return state
-    pubkeys = [v.pubkey for v in state.validators]
-    if (request.source_pubkey not in pubkeys
-            or request.target_pubkey not in pubkeys):
+    source_index = index_by_pubkey.get(request.source_pubkey)
+    target_index = index_by_pubkey.get(request.target_pubkey)
+    if source_index is None or target_index is None:
         return state
-    source_index = pubkeys.index(request.source_pubkey)
-    target_index = pubkeys.index(request.target_pubkey)
     if source_index == target_index:
         return state
     source = state.validators[source_index]
@@ -273,14 +282,15 @@ def process_consolidation_request(cfg: SpecConfig, state, request):
                                 target_index=target_index),))
 
 
-def _is_valid_switch_to_compounding(cfg, state, request) -> bool:
+def _is_valid_switch_to_compounding(cfg, state, request,
+                                    index_by_pubkey) -> bool:
     """Self-consolidation = credential upgrade in place."""
     if request.source_pubkey != request.target_pubkey:
         return False
-    pubkeys = [v.pubkey for v in state.validators]
-    if request.source_pubkey not in pubkeys:
+    index = index_by_pubkey.get(request.source_pubkey)
+    if index is None:
         return False
-    source = state.validators[pubkeys.index(request.source_pubkey)]
+    source = state.validators[index]
     if not EH.has_eth1_withdrawal_credential(source):
         return False
     if source.withdrawal_credentials[12:] != request.source_address:
@@ -451,12 +461,19 @@ def _process_operations(cfg, state, body, verifier, deposit_verifier):
     for op in body.bls_to_execution_changes:
         state = CB.process_bls_to_execution_change(cfg, state, op,
                                                    verifier)
-    for op in body.execution_requests.deposits:
+    requests = body.execution_requests
+    for op in requests.deposits:
         state = process_deposit_request(cfg, state, op)
-    for op in body.execution_requests.withdrawals:
-        state = process_withdrawal_request(cfg, state, op)
-    for op in body.execution_requests.consolidations:
-        state = process_consolidation_request(cfg, state, op)
+    if requests.withdrawals or requests.consolidations:
+        # registry scan once per batch, not per request (deposit
+        # requests don't consult it, so build only when needed)
+        index_by_pubkey = _pubkey_index_map(state)
+        for op in requests.withdrawals:
+            state = process_withdrawal_request(cfg, state, op,
+                                               index_by_pubkey)
+        for op in requests.consolidations:
+            state = process_consolidation_request(cfg, state, op,
+                                                  index_by_pubkey)
     return state
 
 

@@ -7,6 +7,7 @@ Reference: /root/reference/validator/ (client, api, remote) and
 from .api import (AttesterDuty, BeaconNodeValidatorApi, ProposerDuty,
                   ValidatorApiChannel)
 from .client import ValidatorClient
+from .remote import RemoteValidatorApi
 from .signer import (DutySigner, LocalSigner, SigningError,
                      SlashingProtectedSigner)
 from .slashing_protection import SigningRecord, SlashingProtector

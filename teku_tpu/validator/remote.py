@@ -1,0 +1,166 @@
+"""Remote validator client mode: the ValidatorApiChannel over the
+beacon REST API, so a VC process can drive duties against any beacon
+node it can reach over HTTP.
+
+Equivalent of the reference's remote VC (reference: validator/remote/
+src/main/java/tech/pegasys/teku/validator/remote/
+RemoteValidatorApiHandler.java over the typedef OkHttp client; the
+in-process path is validator/eventadapter/InProcessBeaconNodeApi.java):
+duties and attestation data come from the standard JSON endpoints,
+states for signing context from the SSZ debug-state endpoint, and
+productions/submissions ride SSZ octet-stream bodies.
+
+The HTTP client is deliberately synchronous (urllib over localhost/LAN,
+millisecond round trips): duty_state and the duty queries are sync on
+the channel interface, and a VC process has nothing else to run while
+its one duty blocks.
+"""
+
+import json
+import logging
+import urllib.error
+import urllib.request
+from typing import List, Optional
+
+from ..spec import helpers as H
+from ..spec import Spec
+from ..spec.codec import deserialize_state, serialize_signed_block
+from ..spec.milestones import build_fork_schedule
+from .api import AttesterDuty, ProposerDuty, ValidatorApiChannel
+
+_LOG = logging.getLogger(__name__)
+
+
+class RemoteValidatorApi(ValidatorApiChannel):
+    def __init__(self, spec: Spec, base_url: str, timeout: float = 10.0):
+        self.spec = spec
+        self.base = base_url.rstrip("/")
+        self.timeout = timeout
+        # (head_root_hex, slot) -> advanced state, one entry
+        self._state_cache: Optional[tuple] = None
+
+    # -- transport -----------------------------------------------------
+    def _get_json(self, path: str) -> dict:
+        with urllib.request.urlopen(self.base + path,
+                                    timeout=self.timeout) as resp:
+            return json.loads(resp.read())
+
+    def _get_bytes(self, path: str) -> bytes:
+        with urllib.request.urlopen(self.base + path,
+                                    timeout=self.timeout) as resp:
+            return resp.read()
+
+    def _post(self, path: str, data: bytes,
+              ctype: str = "application/octet-stream") -> None:
+        req = urllib.request.Request(
+            self.base + path, data=data, method="POST",
+            headers={"Content-Type": ctype})
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            resp.read()
+
+    # -- duties --------------------------------------------------------
+    def get_proposer_duties(self, epoch: int) -> List[ProposerDuty]:
+        out = self._get_json(f"/eth/v1/validator/duties/proposer/{epoch}")
+        return [ProposerDuty(validator_index=int(d["validator_index"]),
+                             slot=int(d["slot"]))
+                for d in out["data"]]
+
+    def get_attester_duties(self, epoch: int,
+                            indices: List[int]) -> List[AttesterDuty]:
+        body = json.dumps([str(i) for i in indices]).encode()
+        req = urllib.request.Request(
+            self.base + f"/eth/v1/validator/duties/attester/{epoch}",
+            data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            out = json.loads(resp.read())
+        return [AttesterDuty(
+            validator_index=int(d["validator_index"]),
+            slot=int(d["slot"]),
+            committee_index=int(d["committee_index"]),
+            committee_position=int(d["validator_committee_index"]),
+            committee_size=int(d["committee_length"]),
+            committees_at_slot=int(d["committees_at_slot"]))
+            for d in out["data"]]
+
+    # -- chain context -------------------------------------------------
+    def head_root(self) -> bytes:
+        out = self._get_json("/eth/v1/beacon/headers/head")
+        return bytes.fromhex(out["data"]["root"][2:])
+
+    def duty_state(self, slot: int):
+        """Head state fetched over the debug SSZ endpoint, advanced to
+        `slot` locally, cached until the head or slot moves."""
+        head = self.head_root()
+        key = (head, slot)
+        if self._state_cache is not None \
+                and self._state_cache[0] == key:
+            return self._state_cache[1]
+        raw = self._get_bytes("/eth/v2/debug/beacon/states/head")
+        state = deserialize_state(self.spec.config, raw)
+        if state.slot < slot:
+            state = self.spec.process_slots(state, slot)
+        self._state_cache = (key, state)
+        return state
+
+    def get_attestation_data(self, slot: int, committee_index: int):
+        from ..spec.datastructures import (AttestationData, Checkpoint)
+        out = self._get_json(
+            f"/eth/v1/validator/attestation_data?slot={slot}"
+            f"&committee_index={committee_index}")["data"]
+        return AttestationData(
+            slot=int(out["slot"]), index=int(out["index"]),
+            beacon_block_root=bytes.fromhex(
+                out["beacon_block_root"][2:]),
+            source=Checkpoint(epoch=int(out["source"]["epoch"]),
+                              root=bytes.fromhex(
+                                  out["source"]["root"][2:])),
+            target=Checkpoint(epoch=int(out["target"]["epoch"]),
+                              root=bytes.fromhex(
+                                  out["target"]["root"][2:])))
+
+    # -- production / submission ---------------------------------------
+    async def produce_unsigned_block(self, slot: int, randao_reveal: bytes,
+                                     graffiti: bytes = bytes(32)):
+        raw = self._get_bytes(
+            f"/eth/v3/validator/blocks/{slot}"
+            f"?randao_reveal=0x{randao_reveal.hex()}"
+            f"&graffiti=0x{graffiti.hex()}")
+        version = build_fork_schedule(self.spec.config).version_at_slot(
+            slot)
+        block = version.schemas.BeaconBlock.deserialize(raw)
+        # the signing context: same head state the node built against
+        pre = self.duty_state(slot)
+        return block, pre
+
+    async def publish_signed_block(self, signed_block) -> None:
+        self._post("/eth/v2/beacon/blocks",
+                   serialize_signed_block(signed_block))
+
+    async def publish_attestation(self, attestation) -> None:
+        self._post("/eth/v1/beacon/pool/attestations",
+                   type(attestation).serialize(attestation))
+
+    def get_aggregate(self, data):
+        root = data.htr()
+        try:
+            raw = self._get_bytes(
+                f"/eth/v1/validator/aggregate_attestation"
+                f"?attestation_data_root=0x{root.hex()}"
+                f"&slot={data.slot}")
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                return None
+            raise
+        version = build_fork_schedule(self.spec.config).version_at_slot(
+            data.slot)
+        return version.schemas.Attestation.deserialize(raw)
+
+    async def publish_aggregate_and_proof(self, signed_aggregate) -> None:
+        self._post("/eth/v1/validator/aggregate_and_proofs",
+                   type(signed_aggregate).serialize(signed_aggregate))
+
+    async def publish_sync_committee_message(self, msg) -> None:
+        # not yet exposed over REST; the in-process channel covers the
+        # sync-committee duty path
+        _LOG.debug("sync message dropped (no REST endpoint yet)")
