@@ -191,11 +191,19 @@ class BeaconRestApi(RestApi):
             })
         return {"data": out}
 
-    async def _block(self, block_id: str):
+    async def _block(self, block_id: str, query=None, headers=None):
         root = self._resolve_block_root(block_id)
         signed = self.node.store.signed_blocks.get(root)
         if signed is None:
             raise HttpError(404, "signed block not retained")
+        wants_ssz = ("application/octet-stream"
+                     in (headers or {}).get("accept", "")
+                     or (query or {}).get("format") == "ssz")
+        if wants_ssz:
+            # octet-stream variant per the standard Accept negotiation
+            # — checkpoint sync's block fetch
+            return type(signed).serialize(signed), \
+                "application/octet-stream"
         block = signed.message
         version = self.node.spec.milestone_at_slot(block.slot).name.lower()
         return {"version": version, "data": {

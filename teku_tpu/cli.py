@@ -93,7 +93,10 @@ def cmd_node(args) -> int:
         db = Database(Path(data_dir) / "chain.db", spec)
         storage = PersistentChainStorage(db)
         restored = storage.restore_store(spec)
+    from_db = restored is not None
 
+    ckpt_url = layered_value("checkpoint-sync-url",
+                             args.checkpoint_sync_url, yaml_cfg)
     if restored is not None:
         anchor_state = db.get_state(db.load_anchor()[0].htr())
         genesis_state = anchor_state
@@ -102,6 +105,16 @@ def cmd_node(args) -> int:
                               genesis_time)[1] if n_interop else []
         print(f"resumed from data dir: head slot "
               f"{restored.blocks[restored.get_head()].slot}")
+    elif ckpt_url:
+        from .node.checkpoint import checkpoint_sync_store
+        restored = checkpoint_sync_store(spec, ckpt_url)
+        anchor_root = restored.justified_checkpoint.root
+        genesis_state = restored.block_states[anchor_root]
+        genesis_time = restored.genesis_time
+        sks = (interop_genesis(spec.config, total_interop,
+                               genesis_time)[1] if n_interop else [])
+        print(f"checkpoint-synced from {ckpt_url}: anchor slot "
+              f"{genesis_state.slot}")
     else:
         # interop devnets anchor genesis at "now" unless pinned — every
         # node on the devnet must pass the SAME value to share a chain
@@ -113,7 +126,9 @@ def cmd_node(args) -> int:
         from .infra.events import FinalizedCheckpointChannel
         nn = NetworkedNode(spec, genesis_state, port=port, store=restored)
         if db is not None:
-            if restored is None:
+            if not from_db:
+                # fresh genesis OR checkpoint-synced anchor: persist it
+                # so a restart resumes from here
                 anchor = nn.node.store.blocks[
                     nn.node.store.justified_checkpoint.root]
                 db.save_anchor(anchor,
@@ -346,6 +361,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "must agree)")
     n.add_argument("--peer", action="append",
                    help="host:port to dial (repeatable)")
+    n.add_argument("--checkpoint-sync-url", default=None,
+                   help="REST base URL of a trusted node to anchor "
+                        "from (finalized state + block)")
     n.set_defaults(fn=cmd_node)
 
     d = sub.add_parser("devnet", help="in-process fast devnet")

@@ -86,7 +86,8 @@ class RestApi:
                 if n:
                     body = await reader.readexactly(n)
                 keep = headers.get("connection", "").lower() != "close"
-                await self._dispatch(writer, method, target, body)
+                await self._dispatch(writer, method, target, body,
+                                     headers)
                 if not keep:
                     break
         except (ConnectionError, asyncio.IncompleteReadError):
@@ -100,7 +101,8 @@ class RestApi:
                 pass
 
     async def _dispatch(self, writer, method: str, target: str,
-                        body: bytes) -> None:
+                        body: bytes, headers: Optional[Dict[str, str]]
+                        = None) -> None:
         path, _, query = target.partition("?")
         params = {}
         for kv in query.split("&"):
@@ -128,6 +130,8 @@ class RestApi:
                                 raise HttpError(400, "invalid JSON body")
                     if params and "query" in accepted:
                         kwargs["query"] = params
+                    if "headers" in accepted:
+                        kwargs["headers"] = headers or {}
                     result = await handler(**kwargs)
                     if isinstance(result, tuple):       # (payload, ctype)
                         payload, ctype = result

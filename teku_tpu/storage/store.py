@@ -12,7 +12,7 @@ transition; answers get_head through the proto-array.
 from typing import Dict, List, Optional, Tuple
 
 from ..spec.config import SpecConfig
-from ..spec.datastructures import Checkpoint, get_schemas
+from ..spec.datastructures import Checkpoint
 from ..spec import epoch as E
 from ..spec import helpers as H
 from ..spec.transition import (process_slots, state_transition,
@@ -46,9 +46,12 @@ class Store:
         self.blocks: Dict[bytes, object] = {anchor_root: anchor_block}
         # full signed envelopes, retained to serve req/resp block syncs;
         # the anchor gets a zero-signature envelope (its signature is
-        # not part of the anchor trust model) so RPC can serve it too
-        from ..spec.datastructures import get_schemas
-        S = get_schemas(cfg)
+        # not part of the anchor trust model) so RPC can serve it too —
+        # in the anchor slot's OWN fork family (a checkpoint-sync
+        # anchor can be any milestone)
+        from ..spec.milestones import build_fork_schedule
+        S = build_fork_schedule(cfg).version_at_slot(
+            anchor_block.slot).schemas
         self.signed_blocks: Dict[bytes, object] = {
             anchor_root: S.SignedBeaconBlock(message=anchor_block,
                                              signature=b"\x00" * 96)}

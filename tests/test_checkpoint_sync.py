@@ -1,0 +1,69 @@
+"""Checkpoint sync: a fresh node anchors at another node's finalized
+checkpoint over REST and follows the chain from there."""
+
+import asyncio
+
+import pytest
+
+from teku_tpu.api import BeaconRestApi
+from teku_tpu.node import Devnet
+from teku_tpu.node.checkpoint import (checkpoint_sync_store,
+                                      fetch_checkpoint_anchor)
+from teku_tpu.node.gossip import InMemoryGossipNetwork
+from teku_tpu.node.node import BeaconNode
+
+
+@pytest.mark.slow
+def test_checkpoint_sync_anchors_and_extends():
+    async def run():
+        net = Devnet(n_nodes=1, n_validators=16)
+        await net.start()
+        api = BeaconRestApi(net.nodes[0])
+        await api.start()
+        try:
+            cfg = net.spec.config
+            await net.run_until_slot(5 * cfg.SLOTS_PER_EPOCH)
+            src = net.nodes[0]
+            fin = src.store.finalized_checkpoint
+            assert fin.epoch >= 2
+            loop = asyncio.get_running_loop()
+            url = f"http://127.0.0.1:{api.port}"
+
+            # fetch runs in a thread: urllib blocks, the server is here
+            state, signed = await loop.run_in_executor(
+                None, fetch_checkpoint_anchor, net.spec, url)
+            assert signed.message.htr() == fin.root
+            assert state.slot == signed.message.slot
+
+            now = state.genesis_time + cfg.SECONDS_PER_SLOT * (
+                src.chain.head_slot() + 1)
+            store = await loop.run_in_executor(
+                None, lambda: checkpoint_sync_store(net.spec, url,
+                                                    now=now))
+            assert store.finalized_checkpoint.root == fin.root
+            # the anchored node never saw genesis, yet extends the
+            # chain: replay the source's post-checkpoint blocks
+            fresh = BeaconNode(net.spec, state,
+                               InMemoryGossipNetwork().endpoint(),
+                               store=store)
+            anchor_slot = signed.message.slot
+            chain = []
+            root = src.chain.head_root
+            while root in src.store.blocks:
+                blk = src.store.blocks[root]
+                if blk.slot <= anchor_slot:
+                    break
+                chain.append(src.store.signed_blocks[root])
+                root = blk.parent_root
+            assert chain, "source should have post-checkpoint blocks"
+            for signed_block in reversed(chain):
+                # tick the clock to the block's slot (a live node's
+                # slot timer does this)
+                await fresh.on_slot(signed_block.message.slot)
+                assert fresh.block_manager.import_block(signed_block)
+            assert fresh.chain.head_root == src.chain.head_root
+        finally:
+            await api.stop()
+            await net.stop()
+
+    asyncio.run(run())
