@@ -248,7 +248,13 @@ class BeaconRestApi(RestApi):
                 (query or {})["attestation_data_root"][2:])
         except (KeyError, ValueError):
             raise HttpError(400, "attestation_data_root required")
-        aggregate = self.node.pool.get_aggregate_by_root(root)
+        ci = None
+        if query and "committee_index" in query:
+            try:
+                ci = int(query["committee_index"])
+            except ValueError:
+                raise HttpError(400, "invalid committee_index")
+        aggregate = self.node.pool.get_aggregate_by_root(root, ci)
         if aggregate is None:
             raise HttpError(404, "no aggregate for this data")
         return type(aggregate).serialize(aggregate), \

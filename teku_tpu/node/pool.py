@@ -31,9 +31,11 @@ class _Group:
         self._seen_bits.add(bits)
         self.attestations.append(attestation)
 
-    def best_aggregate(self, schema):
+    def best_aggregate(self):
         """Greedy OR of non-overlapping bitlists, largest first
-        (reference AggregateAttestationBuilder.aggregateAttestations)."""
+        (reference AggregateAttestationBuilder.aggregateAttestations).
+        The aggregate keeps the stored attestations' own container
+        family (electra shapes carry their committee_bits through)."""
         if not self.attestations:
             return None
         by_size = sorted(self.attestations,
@@ -46,10 +48,14 @@ class _Group:
                 continue
             acc_bits = [a or b for a, b in zip(acc_bits, bits)]
             sigs.append(att.signature)
-        return schema(
+        cls = type(by_size[0])
+        kw = dict(
             aggregation_bits=tuple(acc_bits), data=self.data,
             signature=sigs[0] if len(sigs) == 1
             else bls.aggregate_signatures(sigs))
+        if "committee_bits" in cls._ssz_fields:
+            kw["committee_bits"] = by_size[0].committee_bits
+        return cls(**kw)
 
 
 class AggregatingAttestationPool:
@@ -58,8 +64,20 @@ class AggregatingAttestationPool:
         self._groups: Dict[bytes, _Group] = {}
         self._max_groups = max_groups
 
-    def add(self, attestation) -> None:
+    @staticmethod
+    def _group_key(attestation) -> bytes:
+        """Pre-electra: one group per AttestationData.  Electra: the
+        data no longer names the committee, so groups are scoped by
+        (data, committee_bits) — bitlists from different committees
+        must never OR together."""
         key = attestation.data.htr()
+        cb = getattr(attestation, "committee_bits", None)
+        if cb is not None:
+            key += bytes(int(b) for b in cb)
+        return key
+
+    def add(self, attestation) -> None:
+        key = self._group_key(attestation)
         group = self._groups.get(key)
         if group is None:
             if len(self._groups) >= self._max_groups:
@@ -67,18 +85,38 @@ class AggregatingAttestationPool:
             group = self._groups[key] = _Group(attestation.data)
         group.add(attestation)
 
-    def get_aggregate(self, data) -> Optional[object]:
+    def get_aggregate(self, data,
+                      committee_index: Optional[int] = None
+                      ) -> Optional[object]:
         """Best current aggregate for the given AttestationData (the
-        aggregator duty's getAggregate)."""
-        return self.get_aggregate_by_root(data.htr())
+        aggregator duty's getAggregate).  Electra duties pass their
+        committee_index, since the data alone no longer scopes one."""
+        return self.get_aggregate_by_root(data.htr(), committee_index)
 
-    def get_aggregate_by_root(self, data_root: bytes) -> Optional[object]:
+    def get_aggregate_by_root(self, data_root: bytes,
+                              committee_index: Optional[int] = None
+                              ) -> Optional[object]:
         """Aggregate keyed by AttestationData root — the REST
-        aggregate_attestation endpoint's lookup shape."""
+        aggregate_attestation endpoint's lookup shape.  For electra
+        groups (root + committee_bits keys) an explicit committee
+        narrows the lookup; otherwise the first matching group wins."""
         group = self._groups.get(data_root)
+        if group is None and committee_index is not None:
+            # an explicit committee narrows the lookup — and a miss is
+            # a miss (falling back to another committee's group would
+            # hand the aggregator a wrong-committee aggregate)
+            cb = tuple(i == committee_index for i in range(
+                self.spec.config.MAX_COMMITTEES_PER_SLOT))
+            group = self._groups.get(data_root
+                                     + bytes(int(b) for b in cb))
+        elif group is None:
+            for key, g in self._groups.items():
+                if key.startswith(data_root):
+                    group = g
+                    break
         if group is None:
             return None
-        return group.best_aggregate(self.spec.schemas.Attestation)
+        return group.best_aggregate()
 
     def get_attestations_for_block(self, state, limit: int) -> List:
         """Includable aggregates for a block on `state` (reference
@@ -87,13 +125,26 @@ class AggregatingAttestationPool:
         out = []
         current = H.get_current_epoch(cfg, state)
         previous = H.get_previous_epoch(cfg, state)
+        from ..spec.milestones import SpecMilestone
+        milestone = self.spec.milestone_at_slot(state.slot)
+        no_upper_window = milestone >= SpecMilestone.DENEB   # EIP-7045
+        want_committee_bits = milestone >= SpecMilestone.ELECTRA
         for group in sorted(self._groups.values(),
                             key=lambda g: -g.data.slot):
             data = group.data
+            # across the electra fork boundary the container family
+            # changes: a block body only carries its own fork's shape
+            has_cb = hasattr(group.attestations[0], "committee_bits") \
+                if group.attestations else False
+            if has_cb != want_committee_bits:
+                continue
             if data.target.epoch not in (current, previous):
                 continue
-            if not (data.slot + cfg.MIN_ATTESTATION_INCLUSION_DELAY
-                    <= state.slot <= data.slot + cfg.SLOTS_PER_EPOCH):
+            if data.slot + cfg.MIN_ATTESTATION_INCLUSION_DELAY \
+                    > state.slot:
+                continue
+            if not no_upper_window \
+                    and state.slot > data.slot + cfg.SLOTS_PER_EPOCH:
                 continue
             # source must match the state the block will execute on
             expected_source = (state.current_justified_checkpoint
@@ -101,7 +152,7 @@ class AggregatingAttestationPool:
                                else state.previous_justified_checkpoint)
             if data.source != expected_source:
                 continue
-            agg = group.best_aggregate(self.spec.schemas.Attestation)
+            agg = group.best_aggregate()
             if agg is not None:
                 out.append(agg)
             if len(out) >= limit:

@@ -34,6 +34,21 @@ REJECT = ValidationResult.REJECT
 SAVE_FOR_FUTURE = ValidationResult.SAVE_FOR_FUTURE
 
 
+def _committee_index_of(attestation):
+    """The committee an attestation addresses: data.index pre-electra;
+    the single set committee bit (with data.index pinned to 0) for the
+    electra shape.  None = malformed electra shape (REJECT)."""
+    cb = getattr(attestation, "committee_bits", None)
+    if cb is None:
+        return attestation.data.index
+    if attestation.data.index != 0:
+        return None
+    set_bits = [i for i, b in enumerate(cb) if b]
+    if len(set_bits) != 1:
+        return None
+    return set_bits[0]
+
+
 class AttestationValidator:
     """Single (unaggregated) attestation gossip rules + batched sig."""
 
@@ -52,6 +67,9 @@ class AttestationValidator:
         # exactly one bit set (gossip rule)
         if sum(1 for b in bits if b) != 1:
             return REJECT
+        committee_index = _committee_index_of(attestation)
+        if committee_index is None:
+            return REJECT   # electra shape rules violated
         if data.target.epoch != H.compute_epoch_at_slot(cfg, data.slot):
             return REJECT
         # propagation slot window (with clock disparity handled by ticks)
@@ -66,11 +84,11 @@ class AttestationValidator:
             target_state = self.chain.store.get_checkpoint_state(data.target)
         except Exception:
             return IGNORE
-        if data.index >= H.get_committee_count_per_slot(
+        if committee_index >= H.get_committee_count_per_slot(
                 cfg, target_state, data.target.epoch):
             return REJECT
         committee = H.get_beacon_committee(cfg, target_state, data.slot,
-                                           data.index)
+                                           committee_index)
         if len(bits) != len(committee):
             return REJECT
         validator_index = committee[next(i for i, b in enumerate(bits) if b)]
@@ -119,19 +137,23 @@ class AggregateValidator:
         key = (data.slot, msg.aggregator_index)
         if key in self._seen_aggregators:
             return IGNORE
+        committee_index = _committee_index_of(aggregate)
+        if committee_index is None:
+            return REJECT
         try:
             state = self.chain.store.get_checkpoint_state(data.target)
         except Exception:
             return IGNORE
-        if data.index >= H.get_committee_count_per_slot(
+        if committee_index >= H.get_committee_count_per_slot(
                 cfg, state, data.target.epoch):
             return REJECT   # out-of-range index would alias another slot
-        committee = H.get_beacon_committee(cfg, state, data.slot, data.index)
+        committee = H.get_beacon_committee(cfg, state, data.slot,
+                                           committee_index)
         if len(aggregate.aggregation_bits) != len(committee):
             return REJECT
         if msg.aggregator_index not in committee:
             return REJECT
-        if not is_aggregator(cfg, state, data.slot, data.index,
+        if not is_aggregator(cfg, state, data.slot, committee_index,
                              msg.selection_proof):
             return REJECT
 

@@ -375,6 +375,10 @@ def get_attesting_indices(cfg: SpecConfig, state, data: AttestationData,
 
 
 def get_indexed_attestation(cfg: SpecConfig, state, attestation):
+    if hasattr(attestation, "committee_bits"):
+        # electra shape: bits span the committees in committee_bits
+        from .electra.block import get_indexed_attestation as _electra
+        return _electra(cfg, state, attestation)
     from .datastructures import get_schemas
     S = get_schemas(cfg)
     indices = get_attesting_indices(

@@ -61,7 +61,9 @@ class ValidatorApiChannel:
     async def publish_attestation(self, attestation) -> None:
         raise NotImplementedError
 
-    def get_aggregate(self, data):
+    def get_aggregate(self, data, committee_index=None):
+        """Best pooled aggregate for `data` (electra duties pass their
+        committee_index — the data alone no longer names one)."""
         raise NotImplementedError
 
     async def publish_aggregate_and_proof(self, signed_aggregate) -> None:
@@ -128,6 +130,11 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
 
     def get_attestation_data(self, slot: int, committee_index: int):
         state = self.node.advanced_head_state(slot)
+        from ..spec.milestones import SpecMilestone
+        # EIP-7549: electra attestation data pins index to 0 (the
+        # committee rides in committee_bits)
+        if self.spec.milestone_at_slot(slot) >= SpecMilestone.ELECTRA:
+            committee_index = 0
         return attestation_data_for(self.spec.config, state, slot,
                                     committee_index,
                                     self.node.chain.head_root)
@@ -138,8 +145,11 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
         signs.  Mirrors ValidatorApiHandler.createUnsignedBlock."""
         cfg = self.spec.config
         pre = self.node.advanced_head_state(slot)
-        atts = self.node.pool.get_attestations_for_block(
-            pre, cfg.MAX_ATTESTATIONS)
+        from ..spec.milestones import SpecMilestone
+        att_limit = (cfg.MAX_ATTESTATIONS_ELECTRA
+                     if self.spec.milestone_at_slot(slot)
+                     >= SpecMilestone.ELECTRA else cfg.MAX_ATTESTATIONS)
+        atts = self.node.pool.get_attestations_for_block(pre, att_limit)
         pools = self.node.operation_pools
         sync_aggregate = None
         if hasattr(pre, "current_sync_committee"):
@@ -192,14 +202,16 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
         state = self.node.advanced_head_state(max(data.slot, 1))
         committees = H.get_committee_count_per_slot(cfg, state,
                                                     data.target.epoch)
+        from ..node.validators import _committee_index_of
+        ci = _committee_index_of(attestation)
         subnet = compute_subnet_for_attestation(
-            cfg, committees, data.slot, data.index)
+            cfg, committees, data.slot, ci if ci is not None else 0)
         await self.node.gossip.publish(
             attestation_subnet_topic(subnet),
-            self.spec.schemas.Attestation.serialize(attestation))
+            type(attestation).serialize(attestation))
 
-    def get_aggregate(self, data):
-        return self.node.pool.get_aggregate(data)
+    def get_aggregate(self, data, committee_index=None):
+        return self.node.pool.get_aggregate(data, committee_index)
 
     async def publish_sync_committee_message(self, msg) -> None:
         """Own sync message: same validation as gossip, then pool +

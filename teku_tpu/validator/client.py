@@ -81,11 +81,17 @@ class ValidatorClient:
             await self.api.publish_signed_block(signed)
             self.blocks_proposed += 1
 
+    def _slot_version(self, slot: int):
+        from ..spec.milestones import SpecMilestone
+        version = self.spec.at_slot(slot)
+        return version, version.milestone >= SpecMilestone.ELECTRA
+
     async def on_attestation_due(self, slot: int) -> None:
         cfg = self.spec.config
         epoch = H.compute_epoch_at_slot(cfg, slot)
         self._duties_for_epoch(epoch)
-        S = self.spec.schemas
+        version, electra = self._slot_version(slot)
+        S = version.schemas
         data_by_committee = {}
         for duty in self._attester_duties[epoch]:
             if duty.slot != slot:
@@ -104,8 +110,13 @@ class ValidatorClient:
                 continue
             bits = tuple(i == duty.committee_position
                          for i in range(duty.committee_size))
-            att = S.Attestation(aggregation_bits=bits, data=data,
-                                signature=sig)
+            kw = dict(aggregation_bits=bits, data=data, signature=sig)
+            if electra:
+                # EIP-7549 shape: index 0 + one-hot committee bits
+                kw["committee_bits"] = tuple(
+                    i == duty.committee_index
+                    for i in range(cfg.MAX_COMMITTEES_PER_SLOT))
+            att = S.Attestation(**kw)
             await self.api.publish_attestation(att)
             self.attestations_sent += 1
 
@@ -145,7 +156,8 @@ class ValidatorClient:
         cfg = self.spec.config
         epoch = H.compute_epoch_at_slot(cfg, slot)
         self._duties_for_epoch(epoch)
-        S = self.spec.schemas
+        version, electra = self._slot_version(slot)
+        S = version.schemas
         aggregated_committees = set()
         for duty in self._attester_duties[epoch]:
             if duty.slot != slot:
@@ -162,7 +174,9 @@ class ValidatorClient:
                                  proof):
                 continue
             data = self.api.get_attestation_data(slot, duty.committee_index)
-            aggregate = self.api.get_aggregate(data)
+            aggregate = self.api.get_aggregate(
+                data, duty.committee_index) if electra \
+                else self.api.get_aggregate(data)
             if aggregate is None:
                 continue
             msg = S.AggregateAndProof(

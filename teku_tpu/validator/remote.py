@@ -141,13 +141,15 @@ class RemoteValidatorApi(ValidatorApiChannel):
         self._post("/eth/v1/beacon/pool/attestations",
                    type(attestation).serialize(attestation))
 
-    def get_aggregate(self, data):
+    def get_aggregate(self, data, committee_index=None):
         root = data.htr()
+        extra = (f"&committee_index={committee_index}"
+                 if committee_index is not None else "")
         try:
             raw = self._get_bytes(
                 f"/eth/v1/validator/aggregate_attestation"
                 f"?attestation_data_root=0x{root.hex()}"
-                f"&slot={data.slot}")
+                f"&slot={data.slot}" + extra)
         except urllib.error.HTTPError as exc:
             if exc.code == 404:
                 return None

@@ -142,3 +142,68 @@ def test_devnet_deneb_at_genesis_finalizes():
         finally:
             await net.stop()
     asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_devnet_electra_at_genesis_finalizes():
+    """Two nodes on an electra-at-genesis network: committee-bits
+    attestations over gossip, electra aggregation, chain finalizes."""
+    import dataclasses
+    from teku_tpu.spec import config as C, Spec
+
+    cfg = dataclasses.replace(C.MINIMAL, ALTAIR_FORK_EPOCH=0,
+                              BELLATRIX_FORK_EPOCH=0,
+                              CAPELLA_FORK_EPOCH=0, DENEB_FORK_EPOCH=0,
+                              ELECTRA_FORK_EPOCH=0)
+
+    async def run():
+        net = Devnet(n_nodes=2, n_validators=32, spec=Spec(cfg))
+        await net.start()
+        try:
+            epochs = 4
+            await net.run_until_slot(epochs * cfg.SLOTS_PER_EPOCH)
+            assert net.heads_converged(), "nodes diverged"
+            assert net.min_justified_epoch() >= epochs - 2
+            assert net.min_finalized_epoch() >= 1
+            # blocks really carried electra attestation shapes
+            for node in net.nodes:
+                head = node.store.blocks[node.chain.head_root]
+                atts = head.body.attestations
+                assert atts, "head block carries no attestations"
+                assert hasattr(atts[0], "committee_bits")
+        finally:
+            await net.stop()
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_devnet_crosses_electra_fork_live():
+    """The electra fork activates mid-run: attestation containers
+    change shape across the boundary and the chain keeps finalizing."""
+    import dataclasses
+    from teku_tpu.spec import config as C, Spec
+
+    cfg = dataclasses.replace(C.MINIMAL, ALTAIR_FORK_EPOCH=0,
+                              BELLATRIX_FORK_EPOCH=0,
+                              CAPELLA_FORK_EPOCH=0, DENEB_FORK_EPOCH=0,
+                              ELECTRA_FORK_EPOCH=2)
+
+    async def run():
+        net = Devnet(n_nodes=2, n_validators=32, spec=Spec(cfg))
+        await net.start()
+        try:
+            epochs = 5
+            await net.run_until_slot(epochs * cfg.SLOTS_PER_EPOCH)
+            assert net.heads_converged(), "nodes diverged"
+            assert net.min_justified_epoch() >= epochs - 2
+            assert net.min_finalized_epoch() >= 2
+            for node in net.nodes:
+                state = node.chain.head_state()
+                assert state.fork.current_version \
+                    == cfg.ELECTRA_FORK_VERSION
+                head = node.store.blocks[node.chain.head_root]
+                atts = head.body.attestations
+                assert atts and hasattr(atts[0], "committee_bits")
+        finally:
+            await net.stop()
+    asyncio.run(run())
