@@ -64,6 +64,10 @@ class BeaconRestApi(RestApi):
         p("/eth/v2/beacon/blocks", self._publish_block_ssz)
         p("/eth/v1/validator/aggregate_and_proofs",
           self._submit_aggregate_ssz)
+        g("/eth/v1/beacon/light_client/bootstrap/{block_id}",
+          self._lc_bootstrap)
+        g("/eth/v1/beacon/light_client/finality_update",
+          self._lc_finality_update)
         g("/metrics", self._metrics)
 
     # -- resolution helpers -------------------------------------------
@@ -469,5 +473,82 @@ class BeaconRestApi(RestApi):
         return {}
 
     # -- metrics -------------------------------------------------------
+    # -- light client (reference: handlers/v1/beacon/lightclient/) -----
+    @staticmethod
+    def _lc_header_json(header):
+        return {"beacon": {
+            "slot": str(header.slot),
+            "proposer_index": str(header.proposer_index),
+            "parent_root": _hex(header.parent_root),
+            "state_root": _hex(header.state_root),
+            "body_root": _hex(header.body_root)}}
+
+    @staticmethod
+    def _lc_committee_json(committee):
+        return {"pubkeys": [_hex(pk) for pk in committee.pubkeys],
+                "aggregate_pubkey": _hex(committee.aggregate_pubkey)}
+
+    async def _lc_bootstrap(self, block_id: str):
+        from ..spec.altair.light_client import create_bootstrap
+        root = self._resolve_block_root(block_id)
+        block = self.node.store.blocks.get(root)
+        state = self.node.store.block_states.get(root)
+        if block is None or state is None:
+            raise HttpError(404, "block/state not retained")
+        if not hasattr(state, "current_sync_committee"):
+            raise HttpError(400, "pre-altair state has no light client")
+        b = create_bootstrap(self.node.spec.config, state, block)
+        return {"data": {
+            "header": self._lc_header_json(b.header),
+            "current_sync_committee": self._lc_committee_json(
+                b.current_sync_committee),
+            "current_sync_committee_branch": [
+                _hex(h) for h in b.current_sync_committee_branch]}}
+
+    async def _lc_finality_update(self):
+        """Latest finality-bearing update derivable from the hot chain:
+        newest (attested, child-with-aggregate) pair whose attested
+        state names a known finalized block."""
+        from ..spec.altair.light_client import (block_to_header,
+                                                create_update)
+        store = self.node.store
+        cfg = self.node.spec.config
+        root = self.node.chain.head_root
+        for _ in range(2 * cfg.SLOTS_PER_EPOCH):
+            blk = store.blocks.get(root)
+            if blk is None or not hasattr(blk.body, "sync_aggregate"):
+                break
+            parent = blk.parent_root
+            pblk = store.blocks.get(parent)
+            pstate = store.block_states.get(parent)
+            agg = blk.body.sync_aggregate
+            if (pblk is not None and pstate is not None
+                    and pblk.slot == blk.slot - 1
+                    and sum(agg.sync_committee_bits) > 0):
+                fin_root = pstate.finalized_checkpoint.root
+                fin_blk = store.blocks.get(fin_root)
+                if fin_blk is not None:
+                    u = create_update(
+                        cfg, pstate, pblk, block_to_header(fin_blk),
+                        agg, blk.slot, include_next_committee=False)
+                    return {"data": {
+                        "attested_header": self._lc_header_json(
+                            u.attested_header),
+                        "finalized_header": self._lc_header_json(
+                            u.finalized_header),
+                        "finality_branch": [
+                            _hex(h) for h in u.finality_branch],
+                        "sync_aggregate": {
+                            # packed SSZ bitvector hex, per the API spec
+                            "sync_committee_bits": _hex(
+                                type(agg)._ssz_fields[
+                                    "sync_committee_bits"].serialize(
+                                    agg.sync_committee_bits)),
+                            "sync_committee_signature": _hex(
+                                agg.sync_committee_signature)},
+                        "signature_slot": str(u.signature_slot)}}
+            root = parent
+        raise HttpError(404, "no finality update available")
+
     async def _metrics(self):
         return GLOBAL_REGISTRY.expose(), "text/plain; version=0.0.4"

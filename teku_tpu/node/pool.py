@@ -118,9 +118,29 @@ class AggregatingAttestationPool:
             return None
         return group.best_aggregate()
 
+    def _includable(self, data, state, current, previous,
+                    no_upper_window) -> bool:
+        cfg = self.spec.config
+        if data.target.epoch not in (current, previous):
+            return False
+        if data.slot + cfg.MIN_ATTESTATION_INCLUSION_DELAY > state.slot:
+            return False
+        if not no_upper_window \
+                and state.slot > data.slot + cfg.SLOTS_PER_EPOCH:
+            return False
+        # source must match the state the block will execute on
+        expected_source = (state.current_justified_checkpoint
+                           if data.target.epoch == current
+                           else state.previous_justified_checkpoint)
+        return data.source == expected_source
+
     def get_attestations_for_block(self, state, limit: int) -> List:
         """Includable aggregates for a block on `state` (reference
-        AggregatingAttestationPool.getAttestationsForBlock)."""
+        AggregatingAttestationPool.getAttestationsForBlock).  Electra
+        merges every committee with the same AttestationData into ONE
+        on-chain attestation (multi-bit committee_bits, concatenated
+        aggregation_bits) — EIP-7549 lowered the per-block cap to 8 on
+        the premise that a slot's committees share one entry."""
         cfg = self.spec.config
         out = []
         current = H.get_current_epoch(cfg, state)
@@ -128,33 +148,69 @@ class AggregatingAttestationPool:
         from ..spec.milestones import SpecMilestone
         milestone = self.spec.milestone_at_slot(state.slot)
         no_upper_window = milestone >= SpecMilestone.DENEB   # EIP-7045
-        want_committee_bits = milestone >= SpecMilestone.ELECTRA
+        if milestone >= SpecMilestone.ELECTRA:
+            return self._electra_attestations_for_block(
+                state, limit, current, previous, no_upper_window)
         for group in sorted(self._groups.values(),
                             key=lambda g: -g.data.slot):
             data = group.data
-            # across the electra fork boundary the container family
-            # changes: a block body only carries its own fork's shape
-            has_cb = hasattr(group.attestations[0], "committee_bits") \
-                if group.attestations else False
-            if has_cb != want_committee_bits:
+            # pre-electra packing never includes electra shapes
+            if group.attestations and hasattr(group.attestations[0],
+                                              "committee_bits"):
                 continue
-            if data.target.epoch not in (current, previous):
-                continue
-            if data.slot + cfg.MIN_ATTESTATION_INCLUSION_DELAY \
-                    > state.slot:
-                continue
-            if not no_upper_window \
-                    and state.slot > data.slot + cfg.SLOTS_PER_EPOCH:
-                continue
-            # source must match the state the block will execute on
-            expected_source = (state.current_justified_checkpoint
-                               if data.target.epoch == current
-                               else state.previous_justified_checkpoint)
-            if data.source != expected_source:
+            if not self._includable(data, state, current, previous,
+                                    no_upper_window):
                 continue
             agg = group.best_aggregate()
             if agg is not None:
                 out.append(agg)
+            if len(out) >= limit:
+                break
+        return out
+
+    def _electra_attestations_for_block(self, state, limit: int,
+                                        current, previous,
+                                        no_upper_window) -> List:
+        by_data: Dict[bytes, List[_Group]] = defaultdict(list)
+        for group in self._groups.values():
+            if not group.attestations or not hasattr(
+                    group.attestations[0], "committee_bits"):
+                continue
+            if not self._includable(group.data, state, current,
+                                    previous, no_upper_window):
+                continue
+            by_data[group.data.htr()].append(group)
+        out = []
+        for groups in sorted(by_data.values(),
+                             key=lambda gs: -gs[0].data.slot):
+            per_committee = []
+            for g in groups:
+                agg = g.best_aggregate()
+                if agg is None:
+                    continue
+                set_bits = [i for i, b in enumerate(agg.committee_bits)
+                            if b]
+                if len(set_bits) != 1:
+                    continue    # pool stores one-hot groups only
+                per_committee.append((set_bits[0], agg))
+            if not per_committee:
+                continue
+            per_committee.sort(key=lambda t: t[0])
+            cls = type(per_committee[0][1])
+            committees = {ci for ci, _ in per_committee}
+            merged_bits: List[bool] = []
+            sigs = []
+            for ci, agg in per_committee:
+                merged_bits.extend(agg.aggregation_bits)
+                sigs.append(agg.signature)
+            out.append(cls(
+                aggregation_bits=tuple(merged_bits),
+                data=per_committee[0][1].data,
+                signature=sigs[0] if len(sigs) == 1
+                else bls.aggregate_signatures(sigs),
+                committee_bits=tuple(
+                    i in committees for i in range(
+                        self.spec.config.MAX_COMMITTEES_PER_SLOT))))
             if len(out) >= limit:
                 break
         return out

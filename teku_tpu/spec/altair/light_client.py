@@ -1,0 +1,266 @@
+"""Altair light-client sync protocol: bootstrap, updates, and the
+minimal verifying store.
+
+Equivalent of the reference's light-client support (reference:
+ethereum/spec/.../logic/common/util/LightClientUtil.java and
+spec/datastructures/lightclient/ — LightClientBootstrap,
+LightClientUpdate, the beacon REST light_client handlers): a light
+client trusts one block root, verifies the current sync committee
+against it, then follows finality by checking sync-committee
+supermajority signatures plus two merkle proofs per update.
+
+Proof generation uses the SSZ engine's merkle_branch over the state's
+field roots, so the generalized indices adapt to every fork's state
+shape automatically (electra's larger state gets depth-6 branches, the
+reference's FINALIZED_ROOT_GINDEX_ELECTRA split handled structurally).
+"""
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from ...crypto import bls
+from ...ssz import Bytes32, Container, merkle_branch
+from ...ssz.hash import hash_pair
+from ...ssz.types import _schema
+from .. import helpers as H
+from ..config import DOMAIN_SYNC_COMMITTEE, SpecConfig
+from ..datastructures import BeaconBlockHeader
+
+
+# ---- proof plumbing ------------------------------------------------------
+
+def _state_field_roots(state) -> List[bytes]:
+    fields = type(state)._ssz_fields
+    return [_schema(schema).hash_tree_root(getattr(state, name))
+            for name, schema in fields.items()]
+
+
+def _field_position(state, name: str) -> int:
+    for i, fname in enumerate(type(state)._ssz_fields):
+        if fname == name:
+            return i
+    raise KeyError(name)
+
+
+def sync_committee_branch(state, which: str) -> Tuple[List[bytes], int]:
+    """(branch, gindex) proving state.{current,next}_sync_committee
+    against the state root."""
+    roots = _state_field_roots(state)
+    idx = _field_position(state, f"{which}_sync_committee")
+    branch = merkle_branch(roots, idx)
+    return branch, (1 << len(branch)) + idx
+
+
+def finality_branch(state) -> Tuple[List[bytes], int]:
+    """(branch, gindex) proving state.finalized_checkpoint.root: the
+    checkpoint's epoch chunk, then the state-level siblings."""
+    roots = _state_field_roots(state)
+    idx = _field_position(state, "finalized_checkpoint")
+    outer = merkle_branch(roots, idx)
+    epoch_chunk = state.finalized_checkpoint.epoch.to_bytes(32, "little")
+    branch = [epoch_chunk] + outer
+    # root is leaf 1 inside the 2-leaf checkpoint subtree
+    gindex = ((1 << len(outer)) + idx) * 2 + 1
+    return branch, gindex
+
+
+def verify_merkle_proof(leaf: bytes, branch, gindex: int,
+                        root: bytes) -> bool:
+    value = leaf
+    idx = gindex
+    for sibling in branch:
+        if idx & 1:
+            value = hash_pair(sibling, value)
+        else:
+            value = hash_pair(value, sibling)
+        idx >>= 1
+    return idx == 1 and value == root
+
+
+# ---- containers (dataclasses: these never ride consensus gossip) ---------
+
+@dataclass
+class LightClientBootstrap:
+    header: BeaconBlockHeader
+    current_sync_committee: object
+    current_sync_committee_branch: list
+    current_sync_committee_gindex: int
+
+
+@dataclass
+class LightClientUpdate:
+    attested_header: BeaconBlockHeader
+    next_sync_committee: Optional[object]
+    next_sync_committee_branch: list
+    next_sync_committee_gindex: int
+    finalized_header: Optional[BeaconBlockHeader]
+    finality_branch: list
+    finality_gindex: int
+    sync_aggregate: object
+    signature_slot: int
+
+
+# ---- producer side (the beacon node serving light clients) ---------------
+
+def block_to_header(block) -> BeaconBlockHeader:
+    return BeaconBlockHeader(
+        slot=block.slot, proposer_index=block.proposer_index,
+        parent_root=block.parent_root, state_root=block.state_root,
+        body_root=block.body.htr())
+
+
+def create_bootstrap(cfg: SpecConfig, state, block) -> LightClientBootstrap:
+    branch, gindex = sync_committee_branch(state, "current")
+    return LightClientBootstrap(
+        header=block_to_header(block),
+        current_sync_committee=state.current_sync_committee,
+        current_sync_committee_branch=branch,
+        current_sync_committee_gindex=gindex)
+
+
+def create_update(cfg: SpecConfig, attested_state, attested_block,
+                  finalized_block_header, sync_aggregate,
+                  signature_slot: int,
+                  include_next_committee: bool = True
+                  ) -> LightClientUpdate:
+    """An update proving the attested block's view: its finalized
+    checkpoint (finality branch) and, at period boundaries, the next
+    sync committee.  `sync_aggregate` is the aggregate a LATER block
+    carried over the attested root; signature_slot is that block's
+    slot."""
+    next_branch: list = []
+    next_gindex = 0
+    next_committee = None
+    if include_next_committee:
+        next_branch, next_gindex = sync_committee_branch(attested_state,
+                                                         "next")
+        next_committee = attested_state.next_sync_committee
+    fin_branch, fin_gindex = finality_branch(attested_state)
+    return LightClientUpdate(
+        attested_header=block_to_header(attested_block),
+        next_sync_committee=next_committee,
+        next_sync_committee_branch=next_branch,
+        next_sync_committee_gindex=next_gindex,
+        finalized_header=finalized_block_header,
+        finality_branch=fin_branch,
+        finality_gindex=fin_gindex,
+        sync_aggregate=sync_aggregate,
+        signature_slot=signature_slot)
+
+
+# ---- verifying store (the light client itself) ---------------------------
+
+class LightClientError(ValueError):
+    pass
+
+
+@dataclass
+class LightClientStore:
+    finalized_header: BeaconBlockHeader
+    current_sync_committee: object
+    next_sync_committee: Optional[object]
+    optimistic_header: BeaconBlockHeader
+
+
+def initialize_light_client_store(cfg: SpecConfig, trusted_root: bytes,
+                                  bootstrap: LightClientBootstrap
+                                  ) -> LightClientStore:
+    if bootstrap.header.htr() != trusted_root:
+        raise LightClientError("bootstrap header != trusted root")
+    committee_root = bootstrap.current_sync_committee.htr()
+    if not verify_merkle_proof(
+            committee_root, bootstrap.current_sync_committee_branch,
+            bootstrap.current_sync_committee_gindex,
+            bootstrap.header.state_root):
+        raise LightClientError("bad current sync committee proof")
+    return LightClientStore(
+        finalized_header=bootstrap.header,
+        current_sync_committee=bootstrap.current_sync_committee,
+        next_sync_committee=None,
+        optimistic_header=bootstrap.header)
+
+
+def sync_committee_period(cfg: SpecConfig, slot: int) -> int:
+    return (slot // cfg.SLOTS_PER_EPOCH
+            // cfg.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+
+
+def process_light_client_update(cfg: SpecConfig,
+                                store: LightClientStore,
+                                update: LightClientUpdate,
+                                genesis_validators_root: bytes
+                                ) -> LightClientStore:
+    """Spec validate_light_client_update + apply, for the happy path a
+    finality-following client needs (no force-update timeout logic)."""
+    attested = update.attested_header
+    if not update.signature_slot > attested.slot:
+        raise LightClientError("signature slot must follow attested")
+    # which committee signed?
+    sig_period = sync_committee_period(cfg, update.signature_slot)
+    store_period = sync_committee_period(cfg,
+                                         store.finalized_header.slot)
+    if sig_period == store_period:
+        committee = store.current_sync_committee
+    elif sig_period == store_period + 1 \
+            and store.next_sync_committee is not None:
+        committee = store.next_sync_committee
+    else:
+        raise LightClientError("update outside known committee periods")
+
+    bits = update.sync_aggregate.sync_committee_bits
+    participants = [pk for pk, b in zip(committee.pubkeys, bits) if b]
+    if len(participants) < cfg.MIN_SYNC_COMMITTEE_PARTICIPANTS:
+        raise LightClientError("insufficient participation")
+
+    # finality proof: the attested state really finalizes this header
+    if update.finalized_header is not None:
+        if not verify_merkle_proof(
+                update.finalized_header.htr(), update.finality_branch,
+                update.finality_gindex, attested.state_root):
+            raise LightClientError("bad finality proof")
+    # next-committee proof
+    if update.next_sync_committee is not None:
+        if not verify_merkle_proof(
+                update.next_sync_committee.htr(),
+                update.next_sync_committee_branch,
+                update.next_sync_committee_gindex,
+                attested.state_root):
+            raise LightClientError("bad next sync committee proof")
+
+    # the signature: the committee signed the attested block root at
+    # signature_slot - 1's domain
+    epoch = H.compute_epoch_at_slot(cfg,
+                                    max(update.signature_slot, 1) - 1)
+    # fork version at that epoch (the light client knows the schedule)
+    from ..milestones import build_fork_schedule
+    version = build_fork_schedule(cfg).version_for(
+        build_fork_schedule(cfg).milestone_at_epoch(epoch))
+    domain = H.compute_domain(DOMAIN_SYNC_COMMITTEE,
+                              version.fork_version,
+                              genesis_validators_root)
+    signing_root = H.compute_signing_root(attested.htr(), domain)
+    if not bls.fast_aggregate_verify(
+            participants, signing_root,
+            update.sync_aggregate.sync_committee_signature):
+        raise LightClientError("bad sync committee signature")
+
+    # apply: supermajority advances finality, any participation
+    # advances the optimistic head
+    if attested.slot > store.optimistic_header.slot:
+        store.optimistic_header = attested
+    if update.finalized_header is not None \
+            and 3 * len(participants) >= 2 * len(bits):
+        if update.finalized_header.slot > store.finalized_header.slot:
+            old_period = sync_committee_period(
+                cfg, store.finalized_header.slot)
+            new_period = sync_committee_period(
+                cfg, update.finalized_header.slot)
+            if new_period > old_period \
+                    and store.next_sync_committee is not None:
+                store.current_sync_committee = store.next_sync_committee
+                store.next_sync_committee = None
+            store.finalized_header = update.finalized_header
+    if update.next_sync_committee is not None \
+            and store.next_sync_committee is None:
+        store.next_sync_committee = update.next_sync_committee
+    return store

@@ -1,0 +1,150 @@
+"""Light-client sync protocol: bootstrap verification, finality
+updates over real devnet sync aggregates, proof soundness."""
+
+import asyncio
+import dataclasses
+
+import pytest
+
+from teku_tpu.node import Devnet
+from teku_tpu.spec import config as C, Spec
+from teku_tpu.spec.altair.light_client import (
+    LightClientError, block_to_header, create_bootstrap, create_update,
+    finality_branch, initialize_light_client_store,
+    process_light_client_update, sync_committee_branch,
+    verify_merkle_proof)
+from teku_tpu.spec.genesis import interop_genesis
+
+ALTAIR_CFG = dataclasses.replace(C.MINIMAL, ALTAIR_FORK_EPOCH=0)
+
+
+def test_state_proofs_verify_and_bind():
+    state, _ = interop_genesis(ALTAIR_CFG, 16)
+    root = state.htr()
+    branch, gindex = sync_committee_branch(state, "current")
+    leaf = state.current_sync_committee.htr()
+    assert verify_merkle_proof(leaf, branch, gindex, root)
+    # a tampered leaf or branch fails
+    assert not verify_merkle_proof(b"\x01" * 32, branch, gindex, root)
+    bad = list(branch)
+    bad[0] = b"\x00" * 32
+    assert not verify_merkle_proof(leaf, bad, gindex, root)
+    fb, fg = finality_branch(state)
+    assert verify_merkle_proof(state.finalized_checkpoint.root, fb, fg,
+                               root)
+
+
+def test_electra_state_proofs_use_deeper_tree():
+    cfg = dataclasses.replace(ALTAIR_CFG, BELLATRIX_FORK_EPOCH=0,
+                              CAPELLA_FORK_EPOCH=0, DENEB_FORK_EPOCH=0,
+                              ELECTRA_FORK_EPOCH=0)
+    state, _ = interop_genesis(cfg, 16)
+    branch, gindex = sync_committee_branch(state, "current")
+    # electra's 37-field state needs a depth-6 branch (gindex 86,
+    # the reference's CURRENT_SYNC_COMMITTEE_GINDEX_ELECTRA)
+    assert len(branch) == 6
+    assert gindex == 86
+    assert verify_merkle_proof(state.current_sync_committee.htr(),
+                               branch, gindex, state.htr())
+
+
+@pytest.mark.slow
+def test_light_client_follows_devnet_finality():
+    async def run():
+        net = Devnet(n_nodes=2, n_validators=32, spec=Spec(ALTAIR_CFG))
+        await net.start()
+        try:
+            cfg = ALTAIR_CFG
+            # cross the epoch-4 boundary (in-state finality lands
+            # there) plus two slots so a CHILD aggregate signs a
+            # finality-bearing attested header
+            await net.run_until_slot(4 * cfg.SLOTS_PER_EPOCH + 2)
+            node = net.nodes[0]
+            store = node.store
+            anchor_root = min(store.blocks,
+                              key=lambda r: store.blocks[r].slot)
+            anchor_block = store.blocks[anchor_root]
+            anchor_state = store.block_states[anchor_root]
+
+            bootstrap = create_bootstrap(cfg, anchor_state, anchor_block)
+            lc = initialize_light_client_store(
+                cfg, anchor_block.htr(), bootstrap)
+            assert lc.finalized_header.slot == anchor_block.slot
+            # wrong trusted root rejected
+            with pytest.raises(LightClientError):
+                initialize_light_client_store(cfg, b"\x13" * 32,
+                                              bootstrap)
+
+            # find a block whose sync aggregate signs its parent
+            root = node.chain.head_root
+            update = None
+            while root in store.blocks:
+                blk = store.blocks[root]
+                parent = blk.parent_root
+                agg = blk.body.sync_aggregate
+                if (parent in store.blocks
+                        and store.blocks[parent].slot == blk.slot - 1
+                        and sum(agg.sync_committee_bits)
+                        * 3 >= len(agg.sync_committee_bits) * 2):
+                    attested_block = store.blocks[parent]
+                    attested_state = store.block_states[parent]
+                    fin_root = attested_state.finalized_checkpoint.root
+                    if fin_root in store.blocks:
+                        update = create_update(
+                            cfg, attested_state, attested_block,
+                            block_to_header(store.blocks[fin_root]),
+                            agg, blk.slot)
+                        break
+                root = parent
+            assert update is not None, "no usable sync aggregate found"
+
+            lc = process_light_client_update(
+                cfg, lc, update,
+                anchor_state.genesis_validators_root)
+            assert lc.optimistic_header.htr() \
+                == update.attested_header.htr()
+            assert lc.finalized_header.htr() \
+                == update.finalized_header.htr()
+            assert lc.finalized_header.slot > anchor_block.slot
+            assert lc.next_sync_committee is not None
+
+            # a flipped signature bit must be rejected
+            bad_agg = update.sync_aggregate.copy_with(
+                sync_committee_signature=b"\xaa" * 96)
+            bad = dataclasses.replace(update, sync_aggregate=bad_agg)
+            with pytest.raises(LightClientError):
+                process_light_client_update(
+                    cfg, lc, bad, anchor_state.genesis_validators_root)
+
+            # the REST surface serves both light-client shapes
+            import json
+            import urllib.request
+            from teku_tpu.api import BeaconRestApi
+            api = BeaconRestApi(node)
+            await api.start()
+            try:
+                loop = asyncio.get_running_loop()
+
+                def fetch(path):
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{api.port}{path}",
+                            timeout=5) as r:
+                        return json.loads(r.read())
+
+                boot = await loop.run_in_executor(
+                    None, fetch,
+                    "/eth/v1/beacon/light_client/bootstrap/0x"
+                    + anchor_block.htr().hex())
+                assert len(boot["data"]["current_sync_committee"]
+                           ["pubkeys"]) == cfg.SYNC_COMMITTEE_SIZE
+                fin = await loop.run_in_executor(
+                    None, fetch,
+                    "/eth/v1/beacon/light_client/finality_update")
+                assert int(fin["data"]["signature_slot"]) > 0
+                assert fin["data"]["finality_branch"]
+            finally:
+                await api.stop()
+        finally:
+            await net.stop()
+
+    asyncio.run(run())
