@@ -53,6 +53,7 @@ class BeaconRestApi(RestApi):
         p("/eth/v1/validator/duties/attester/{epoch}", self._attester_duties)
         p("/eth/v1/beacon/pool/attestations", self._submit_attestations)
         p("/eth/v1/beacon/pool/voluntary_exits", self._submit_exit)
+        p("/eth/v1/beacon/pool/sync_committees", self._submit_sync_messages)
         g("/eth/v1/beacon/blob_sidecars/{block_id}", self._blob_sidecars)
         # the remote-VC surface (reference: handlers/v1/validator/* and
         # the debug state endpoint checkpoint sync reads)
@@ -473,6 +474,34 @@ class BeaconRestApi(RestApi):
         return {}
 
     # -- metrics -------------------------------------------------------
+    async def _submit_sync_messages(self, body=None):
+        """Sync-committee messages (reference handlers/v1/beacon/
+        PostSyncCommittees) — the remote VC's sync-duty submission."""
+        if not isinstance(body, list):
+            raise HttpError(400, "expected a list of sync messages")
+        from ..spec.milestones import build_fork_schedule, SpecMilestone
+        try:
+            version = build_fork_schedule(
+                self.node.spec.config).version_for(SpecMilestone.ALTAIR)
+        except KeyError:
+            raise HttpError(400, "altair not scheduled on this network")
+        accepted = 0
+        for m in body:
+            try:
+                msg = version.schemas.SyncCommitteeMessage(
+                    slot=int(m["slot"]),
+                    beacon_block_root=bytes.fromhex(
+                        m["beacon_block_root"][2:]),
+                    validator_index=int(m["validator_index"]),
+                    signature=bytes.fromhex(m["signature"][2:]))
+            except (KeyError, ValueError, TypeError) as exc:
+                raise HttpError(400, f"malformed sync message: {exc}")
+            if self.validator_api is not None:
+                await self.validator_api.publish_sync_committee_message(
+                    msg)
+                accepted += 1
+        return {"accepted": accepted}
+
     # -- light client (reference: handlers/v1/beacon/lightclient/) -----
     @staticmethod
     def _lc_header_json(header):

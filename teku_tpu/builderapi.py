@@ -1,0 +1,276 @@
+"""Builder (MEV relay) flow: blinded blocks, bids, registrations,
+circuit-broken fallback to local payloads.
+
+Equivalent of the reference's builder stack (reference: ethereum/
+executionclient/.../BuilderClient.java + builder bid validation
+BuilderBidValidatorImpl.java, BuilderCircuitBreakerImpl.java, and the
+blinded-block flow in spec/logic/common/util/BlindBlockUtil.java with
+beacon/validator/.../ExecutionLayerBlockProductionManager): the
+proposer asks a builder for a payload HEADER, signs a blinded block
+over it, and only after the signed blinded block is submitted does the
+builder reveal the payload body.
+
+The blinding identity that makes this safe: an execution payload
+header carries its variable fields by root, so
+ExecutionPayloadHeader.htr() == ExecutionPayload.htr() and a blinded
+block's root equals the full block's root — one proposer signature
+covers both shapes.
+"""
+
+import logging
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from .crypto import bls
+from .spec import helpers as H
+from .spec.config import DOMAIN_APPLICATION_MASK, SpecConfig
+from .spec.milestones import build_fork_schedule
+from .ssz import Bytes20, Bytes32, Bytes48, Bytes96, Container, uint64
+from .ssz.types import _ContainerMeta
+
+_LOG = logging.getLogger(__name__)
+
+# the builder spec's application domain (DomainType 0x00000001, domain
+# computed WITHOUT fork data so registrations survive forks)
+BUILDER_DOMAIN = H.compute_domain(DOMAIN_APPLICATION_MASK)
+
+
+class ValidatorRegistration(Container):
+    fee_recipient: Bytes20
+    gas_limit: uint64
+    timestamp: uint64
+    pubkey: Bytes48
+
+
+class SignedValidatorRegistration(Container):
+    message: ValidatorRegistration
+    signature: Bytes96
+
+
+def sign_registration(sk: int, registration: ValidatorRegistration
+                      ) -> SignedValidatorRegistration:
+    root = H.compute_signing_root(registration, BUILDER_DOMAIN)
+    return SignedValidatorRegistration(message=registration,
+                                       signature=bls.sign(sk, root))
+
+
+def verify_registration(signed: SignedValidatorRegistration) -> bool:
+    root = H.compute_signing_root(signed.message, BUILDER_DOMAIN)
+    return bls.verify(signed.message.pubkey, root, signed.signature)
+
+
+# ---- blinded blocks ------------------------------------------------------
+
+def _blinded_schemas(cfg: SpecConfig, slot: int):
+    """(BlindedBeaconBlock, SignedBlindedBeaconBlock) for the milestone
+    governing `slot`: the fork's body with execution_payload swapped
+    for its header (reference SchemaDefinitionsBellatrix
+    getBlindedBeaconBlockBodySchema)."""
+    version = build_fork_schedule(cfg).version_at_slot(slot)
+    S = version.schemas
+    if "execution_payload" not in S.BeaconBlockBody._ssz_fields:
+        raise ValueError("pre-merge fork has no blinded blocks")
+    body_fields = dict(S.BeaconBlockBody._ssz_fields.items())
+    body_fields["execution_payload"] = None  # placeholder, replaced now
+    fields = []
+    for name, schema in body_fields.items():
+        if name == "execution_payload":
+            fields.append(("execution_payload_header",
+                           S.ExecutionPayloadHeader))
+        else:
+            fields.append((name, schema))
+    body = _ContainerMeta(
+        f"Blinded{S.BeaconBlockBody.__name__}", (Container,),
+        {"__annotations__": dict(fields)})
+    block = _ContainerMeta(
+        f"Blinded{S.BeaconBlock.__name__}", (Container,),
+        {"__annotations__": {
+            "slot": uint64, "proposer_index": uint64,
+            "parent_root": Bytes32, "state_root": Bytes32,
+            "body": body}})
+    signed = _ContainerMeta(
+        f"SignedBlinded{S.BeaconBlock.__name__}", (Container,),
+        {"__annotations__": {"message": block, "signature": Bytes96}})
+    return block, signed
+
+
+_BLINDED_CACHE: Dict = {}
+
+
+def blinded_schemas(cfg: SpecConfig, slot: int):
+    version = build_fork_schedule(cfg).version_at_slot(slot)
+    key = (cfg, version.milestone)
+    if key not in _BLINDED_CACHE:
+        _BLINDED_CACHE[key] = _blinded_schemas(cfg, slot)
+    return _BLINDED_CACHE[key]
+
+
+def _payload_to_header(payload):
+    from .spec.bellatrix.datastructures import payload_to_header
+    from .spec.capella.datastructures import payload_to_header_capella
+    from .spec.deneb.datastructures import payload_to_header_deneb
+    fields = type(payload)._ssz_fields
+    if "blob_gas_used" in fields:
+        return payload_to_header_deneb(payload)
+    if "withdrawals" in fields:
+        return payload_to_header_capella(payload)
+    return payload_to_header(payload)
+
+
+def blind_block(cfg: SpecConfig, block):
+    """Full BeaconBlock → BlindedBeaconBlock with the same htr."""
+    BlindedBlock, _ = blinded_schemas(cfg, block.slot)
+    body = block.body
+    kw = {}
+    for name in BlindedBlock._ssz_fields["body"]._ssz_fields:
+        if name == "execution_payload_header":
+            kw[name] = _payload_to_header(body.execution_payload)
+        else:
+            kw[name] = getattr(body, name)
+    return BlindedBlock(
+        slot=block.slot, proposer_index=block.proposer_index,
+        parent_root=block.parent_root, state_root=block.state_root,
+        body=BlindedBlock._ssz_fields["body"](**kw))
+
+
+def unblind_block(cfg: SpecConfig, signed_blinded, payload):
+    """SignedBlindedBeaconBlock + revealed payload → full
+    SignedBeaconBlock; rejects a payload that doesn't match the header
+    the proposer signed."""
+    blinded = signed_blinded.message
+    header = blinded.body.execution_payload_header
+    if _payload_to_header(payload) != header:
+        raise ValueError("revealed payload does not match signed header")
+    version = build_fork_schedule(cfg).version_at_slot(blinded.slot)
+    S = version.schemas
+    kw = {}
+    for name in S.BeaconBlockBody._ssz_fields:
+        if name == "execution_payload":
+            kw[name] = payload
+        else:
+            kw[name] = getattr(blinded.body, name)
+    block = S.BeaconBlock(
+        slot=blinded.slot, proposer_index=blinded.proposer_index,
+        parent_root=blinded.parent_root, state_root=blinded.state_root,
+        body=S.BeaconBlockBody(**kw))
+    assert block.htr() == blinded.htr(), "blinding identity violated"
+    return S.SignedBeaconBlock(message=block,
+                               signature=signed_blinded.signature)
+
+
+# ---- bids ----------------------------------------------------------------
+
+@dataclass
+class BuilderBid:
+    header: object          # the fork's ExecutionPayloadHeader
+    value: int              # wei offered to the proposer
+    pubkey: bytes           # builder's BLS key
+    signature: bytes = b""
+
+    def signing_root(self) -> bytes:
+        # bid root over (header root, value, pubkey) under the builder
+        # domain — structural stand-in for the SSZ BuilderBid container
+        import hashlib
+        payload = (self.header.htr() + self.value.to_bytes(32, "little")
+                   + self.pubkey)
+        return H.compute_signing_root(hashlib.sha256(payload).digest(),
+                                      BUILDER_DOMAIN)
+
+
+def sign_bid(sk: int, bid: BuilderBid) -> BuilderBid:
+    bid.signature = bls.sign(sk, bid.signing_root())
+    return bid
+
+
+def validate_bid(bid: BuilderBid, parent_hash: bytes,
+                 min_value: int = 0) -> bool:
+    """reference BuilderBidValidatorImpl: builder signature, payload
+    continuity, acceptable value."""
+    if bid.value < min_value:
+        return False
+    if bid.header.parent_hash != parent_hash:
+        return False
+    return bls.verify(bid.pubkey, bid.signing_root(), bid.signature)
+
+
+# ---- the client seam + circuit breaker -----------------------------------
+
+class BuilderClient:
+    """What a relay connection provides (reference BuilderClient.java);
+    implementations may be HTTP or in-process."""
+
+    async def register_validators(self, registrations) -> None:
+        raise NotImplementedError
+
+    async def get_header(self, slot: int, parent_hash: bytes,
+                         pubkey: bytes) -> Optional[BuilderBid]:
+        raise NotImplementedError
+
+    async def get_payload(self, signed_blinded_block):
+        raise NotImplementedError
+
+
+class BuilderCircuitBreaker:
+    """reference BuilderCircuitBreakerImpl: consecutive faults disable
+    the builder for a cooldown window of slots."""
+
+    def __init__(self, fault_limit: int = 3, cooldown_slots: int = 8):
+        self.fault_limit = fault_limit
+        self.cooldown_slots = cooldown_slots
+        self._faults = 0
+        self._disabled_until = -1
+
+    def record_fault(self, slot: int) -> None:
+        self._faults += 1
+        if self._faults >= self.fault_limit:
+            self._disabled_until = slot + self.cooldown_slots
+            self._faults = 0
+            _LOG.warning("builder circuit OPEN until slot %d",
+                         self._disabled_until)
+
+    def record_success(self) -> None:
+        self._faults = 0
+
+    def is_engaged(self, slot: int) -> bool:
+        return slot > self._disabled_until
+
+
+class BuilderFlow:
+    """Chooses builder vs local payload for a proposal (reference
+    ExecutionLayerBlockProductionManager): ask the builder for a bid
+    when the circuit is closed and the bid validates; otherwise fall
+    back to the local payload path."""
+
+    def __init__(self, cfg: SpecConfig, builder: Optional[BuilderClient],
+                 breaker: Optional[BuilderCircuitBreaker] = None,
+                 min_bid_value: int = 0):
+        self.cfg = cfg
+        self.builder = builder
+        self.breaker = breaker or BuilderCircuitBreaker()
+        self.min_bid_value = min_bid_value
+
+    async def select_header(self, slot: int, parent_hash: bytes,
+                            proposer_pubkey: bytes):
+        """The builder's payload header, or None → build locally."""
+        if self.builder is None or not self.breaker.is_engaged(slot):
+            return None
+        try:
+            bid = await self.builder.get_header(slot, parent_hash,
+                                                proposer_pubkey)
+        except Exception:
+            _LOG.exception("builder get_header failed")
+            self.breaker.record_fault(slot)
+            return None
+        if bid is None:
+            return None
+        if not validate_bid(bid, parent_hash, self.min_bid_value):
+            self.breaker.record_fault(slot)
+            return None
+        self.breaker.record_success()
+        return bid.header
+
+    async def reveal(self, signed_blinded_block):
+        """Submit the signed blinded block; the builder reveals the
+        payload, which must match the signed header."""
+        payload = await self.builder.get_payload(signed_blinded_block)
+        return unblind_block(self.cfg, signed_blinded_block, payload)

@@ -42,19 +42,22 @@ def _field_position(state, name: str) -> int:
     raise KeyError(name)
 
 
-def sync_committee_branch(state, which: str) -> Tuple[List[bytes], int]:
+def sync_committee_branch(state, which: str,
+                          roots: Optional[List[bytes]] = None
+                          ) -> Tuple[List[bytes], int]:
     """(branch, gindex) proving state.{current,next}_sync_committee
     against the state root."""
-    roots = _state_field_roots(state)
+    roots = _state_field_roots(state) if roots is None else roots
     idx = _field_position(state, f"{which}_sync_committee")
     branch = merkle_branch(roots, idx)
     return branch, (1 << len(branch)) + idx
 
 
-def finality_branch(state) -> Tuple[List[bytes], int]:
+def finality_branch(state, roots: Optional[List[bytes]] = None
+                    ) -> Tuple[List[bytes], int]:
     """(branch, gindex) proving state.finalized_checkpoint.root: the
     checkpoint's epoch chunk, then the state-level siblings."""
-    roots = _state_field_roots(state)
+    roots = _state_field_roots(state) if roots is None else roots
     idx = _field_position(state, "finalized_checkpoint")
     outer = merkle_branch(roots, idx)
     epoch_chunk = state.finalized_checkpoint.epoch.to_bytes(32, "little")
@@ -62,6 +65,25 @@ def finality_branch(state) -> Tuple[List[bytes], int]:
     # root is leaf 1 inside the 2-leaf checkpoint subtree
     gindex = ((1 << len(outer)) + idx) * 2 + 1
     return branch, gindex
+
+
+def expected_gindices(cfg: SpecConfig, slot: int) -> Tuple[int, int, int]:
+    """(current_committee, next_committee, finalized_root) generalized
+    indices for the fork governing `slot`, derived from that fork's
+    OWN state schema — the verifier-side pins (spec
+    CURRENT_SYNC_COMMITTEE_GINDEX / NEXT / FINALIZED_ROOT_GINDEX and
+    their _ELECTRA variants).  A prover cannot choose where in the
+    tree its leaf is checked."""
+    from ..milestones import build_fork_schedule
+    schema = build_fork_schedule(cfg).version_at_slot(
+        slot).schemas.BeaconState
+    fields = list(schema._ssz_fields)
+    depth = (len(fields) - 1).bit_length()
+    base = 1 << depth
+    cur = base + fields.index("current_sync_committee")
+    nxt = base + fields.index("next_sync_committee")
+    fin = (base + fields.index("finalized_checkpoint")) * 2 + 1
+    return cur, nxt, fin
 
 
 def verify_merkle_proof(leaf: bytes, branch, gindex: int,
@@ -128,14 +150,15 @@ def create_update(cfg: SpecConfig, attested_state, attested_block,
     sync committee.  `sync_aggregate` is the aggregate a LATER block
     carried over the attested root; signature_slot is that block's
     slot."""
+    roots = _state_field_roots(attested_state)   # hashed ONCE, shared
     next_branch: list = []
     next_gindex = 0
     next_committee = None
     if include_next_committee:
-        next_branch, next_gindex = sync_committee_branch(attested_state,
-                                                         "next")
+        next_branch, next_gindex = sync_committee_branch(
+            attested_state, "next", roots)
         next_committee = attested_state.next_sync_committee
-    fin_branch, fin_gindex = finality_branch(attested_state)
+    fin_branch, fin_gindex = finality_branch(attested_state, roots)
     return LightClientUpdate(
         attested_header=block_to_header(attested_block),
         next_sync_committee=next_committee,
@@ -168,10 +191,12 @@ def initialize_light_client_store(cfg: SpecConfig, trusted_root: bytes,
     if bootstrap.header.htr() != trusted_root:
         raise LightClientError("bootstrap header != trusted root")
     committee_root = bootstrap.current_sync_committee.htr()
+    # the gindex is PINNED by the verifier from the fork schedule —
+    # a server-chosen position could prove a different field
+    expected_cur, _, _ = expected_gindices(cfg, bootstrap.header.slot)
     if not verify_merkle_proof(
             committee_root, bootstrap.current_sync_committee_branch,
-            bootstrap.current_sync_committee_gindex,
-            bootstrap.header.state_root):
+            expected_cur, bootstrap.header.state_root):
         raise LightClientError("bad current sync committee proof")
     return LightClientStore(
         finalized_header=bootstrap.header,
@@ -212,19 +237,21 @@ def process_light_client_update(cfg: SpecConfig,
     if len(participants) < cfg.MIN_SYNC_COMMITTEE_PARTICIPANTS:
         raise LightClientError("insufficient participation")
 
+    # gindices PINNED by the verifier from the attested slot's fork
+    _, expected_next, expected_fin = expected_gindices(cfg,
+                                                       attested.slot)
     # finality proof: the attested state really finalizes this header
     if update.finalized_header is not None:
         if not verify_merkle_proof(
                 update.finalized_header.htr(), update.finality_branch,
-                update.finality_gindex, attested.state_root):
+                expected_fin, attested.state_root):
             raise LightClientError("bad finality proof")
     # next-committee proof
     if update.next_sync_committee is not None:
         if not verify_merkle_proof(
                 update.next_sync_committee.htr(),
                 update.next_sync_committee_branch,
-                update.next_sync_committee_gindex,
-                attested.state_root):
+                expected_next, attested.state_root):
             raise LightClientError("bad next sync committee proof")
 
     # the signature: the committee signed the attested block root at
@@ -233,8 +260,8 @@ def process_light_client_update(cfg: SpecConfig,
                                     max(update.signature_slot, 1) - 1)
     # fork version at that epoch (the light client knows the schedule)
     from ..milestones import build_fork_schedule
-    version = build_fork_schedule(cfg).version_for(
-        build_fork_schedule(cfg).milestone_at_epoch(epoch))
+    schedule = build_fork_schedule(cfg)
+    version = schedule.version_for(schedule.milestone_at_epoch(epoch))
     domain = H.compute_domain(DOMAIN_SYNC_COMMITTEE,
                               version.fork_version,
                               genesis_validators_root)
@@ -261,6 +288,11 @@ def process_light_client_update(cfg: SpecConfig,
                 store.next_sync_committee = None
             store.finalized_header = update.finalized_header
     if update.next_sync_committee is not None \
-            and store.next_sync_committee is None:
+            and store.next_sync_committee is None \
+            and sync_committee_period(cfg, attested.slot) \
+            == sync_committee_period(cfg, store.finalized_header.slot):
+        # spec guard: only a SAME-period attested view names the next
+        # committee correctly; a period-boundary update would smuggle
+        # the current committee in as "next" and wedge rotation
         store.next_sync_committee = update.next_sync_committee
     return store

@@ -163,6 +163,10 @@ class RemoteValidatorApi(ValidatorApiChannel):
                    type(signed_aggregate).serialize(signed_aggregate))
 
     async def publish_sync_committee_message(self, msg) -> None:
-        # not yet exposed over REST; the in-process channel covers the
-        # sync-committee duty path
-        _LOG.debug("sync message dropped (no REST endpoint yet)")
+        body = json.dumps([{
+            "slot": str(msg.slot),
+            "beacon_block_root": "0x" + msg.beacon_block_root.hex(),
+            "validator_index": str(msg.validator_index),
+            "signature": "0x" + msg.signature.hex()}]).encode()
+        self._post("/eth/v1/beacon/pool/sync_committees", body,
+                   ctype="application/json")

@@ -1,0 +1,97 @@
+"""Historical backfill: a checkpoint-synced node reconstructs the
+chain back to genesis over req/resp, hash-linked and batch-verified."""
+
+import asyncio
+
+import pytest
+
+from teku_tpu.networking import NetworkedNode
+from teku_tpu.spec import create_spec
+from teku_tpu.spec.builder import make_local_signer, produce_attestations, \
+    produce_block
+from teku_tpu.spec.genesis import interop_genesis
+from teku_tpu.storage.store import Store
+
+
+@pytest.mark.slow
+def test_backfill_to_genesis_over_rpc():
+    spec = create_spec("minimal")
+    state, sks = interop_genesis(spec.config, 16)
+    signer = make_local_signer(dict(enumerate(sks)))
+
+    async def run():
+        a = NetworkedNode(spec, state, name="source")
+        await a.start()
+        b = None
+        try:
+            # grow a 12-block chain on the source
+            atts, cur = [], state
+            for slot in range(1, 13):
+                await a.node.on_slot(slot)
+                signed, post = produce_block(spec.config, cur, slot,
+                                             signer, attestations=atts)
+                assert a.node.block_manager.import_block(signed)
+                atts = produce_attestations(spec.config, post, slot,
+                                            signed.message.htr(), signer)
+                cur = post
+
+            # node B anchors mid-chain (checkpoint-sync shape) with no
+            # history below slot 8
+            anchor_root = a.node.store.proto.ancestor_at_slot(
+                a.node.chain.head_root, 8)
+            anchor_block = a.node.store.blocks[anchor_root]
+            anchor_state = a.node.store.block_states[anchor_root]
+            b = NetworkedNode(spec, anchor_state, name="late",
+                              store=Store(spec.config, anchor_state,
+                                          anchor_block))
+            await b.start()
+            await b.connect(a)
+            await asyncio.sleep(0.05)
+
+            oldest = min(b.node.store.blocks[r].slot
+                         for r in b.node.store.blocks)
+            assert oldest == 8
+            n = await b.sync.backfill_to_genesis()
+            assert n == 8          # slots 0..7 recovered
+            # full linkage from the anchor down to genesis
+            root = anchor_root
+            blocks = b.node.store.blocks
+            while blocks[root].slot > 0:
+                parent = blocks[root].parent_root
+                assert parent in blocks, "linkage gap"
+                assert blocks[parent].htr() == parent
+                root = parent
+            assert blocks[root].slot == 0
+
+            # a tampered historical block would break the hash link:
+            # re-run against a source serving a corrupted envelope
+            bad_root = a.node.store.proto.ancestor_at_slot(
+                a.node.chain.head_root, 4)
+            signed_bad = a.node.store.signed_blocks[bad_root]
+            a.node.store.signed_blocks[bad_root] = signed_bad.copy_with(
+                message=signed_bad.message.copy_with(
+                    proposer_index=13))
+            c = NetworkedNode(spec, anchor_state, name="late2",
+                              store=Store(spec.config, anchor_state,
+                                          anchor_block))
+            await c.start()
+            try:
+                await c.connect(a)
+                await asyncio.sleep(0.05)
+                await c.sync.backfill_to_genesis()
+                blocks = c.node.store.blocks
+                slots = sorted(blocks[r].slot for r in blocks)
+                # linkage stops at the corruption: slot 4's true block
+                # never arrives, so nothing below slot 5 authenticates
+                assert 4 not in slots[:-1] or all(
+                    blocks[r].htr() == r for r in blocks)
+                for r, blk in blocks.items():
+                    assert blk.htr() == r
+            finally:
+                await c.stop()
+        finally:
+            if b is not None:
+                await b.stop()
+            await a.stop()
+
+    asyncio.run(run())

@@ -1,0 +1,123 @@
+"""Builder/MEV flow: blinding identity, bid validation, circuit
+breaker, registrations."""
+
+import asyncio
+import dataclasses
+
+import pytest
+
+from teku_tpu import builderapi as B
+from teku_tpu.crypto import bls
+from teku_tpu.spec import config as C
+from teku_tpu.spec.builder import make_local_signer, produce_block
+from teku_tpu.spec.genesis import interop_genesis
+
+CFG = dataclasses.replace(C.MINIMAL, ALTAIR_FORK_EPOCH=0,
+                          BELLATRIX_FORK_EPOCH=0, CAPELLA_FORK_EPOCH=0)
+
+
+def _capella_signed_block():
+    state, sks = interop_genesis(CFG, 16)
+    signer = make_local_signer(dict(enumerate(sks)))
+    signed, post = produce_block(CFG, state, 1, signer)
+    return signed, post
+
+
+def test_blinding_identity_round_trip():
+    signed, _ = _capella_signed_block()
+    block = signed.message
+    blinded = B.blind_block(CFG, block)
+    # the whole point: one signature covers both shapes
+    assert blinded.htr() == block.htr()
+    assert blinded.body.htr() == block.body.htr()
+    _, SignedBlinded = B.blinded_schemas(CFG, block.slot)
+    signed_blinded = SignedBlinded(message=blinded,
+                                   signature=signed.signature)
+    full = B.unblind_block(CFG, signed_blinded,
+                           block.body.execution_payload)
+    assert full == signed
+    # a substituted payload is rejected
+    tampered = block.body.execution_payload.copy_with(gas_used=1234)
+    with pytest.raises(ValueError):
+        B.unblind_block(CFG, signed_blinded, tampered)
+
+
+def test_bid_validation():
+    signed, _ = _capella_signed_block()
+    payload = signed.message.body.execution_payload
+    header = B._payload_to_header(payload)
+    builder_sk = 777
+    bid = B.sign_bid(builder_sk, B.BuilderBid(
+        header=header, value=10 ** 18,
+        pubkey=bls.secret_to_public_key(builder_sk)))
+    assert B.validate_bid(bid, payload.parent_hash)
+    # wrong parent, low value, bad signature all fail
+    assert not B.validate_bid(bid, b"\x55" * 32)
+    assert not B.validate_bid(bid, payload.parent_hash,
+                              min_value=10 ** 19)
+    forged = B.BuilderBid(header=header, value=bid.value,
+                          pubkey=bid.pubkey,
+                          signature=b"\xbb" * 96)
+    assert not B.validate_bid(forged, payload.parent_hash)
+
+
+def test_registration_sign_verify():
+    sk = 4242
+    reg = B.ValidatorRegistration(
+        fee_recipient=b"\x01" * 20, gas_limit=30_000_000,
+        timestamp=1700000000, pubkey=bls.secret_to_public_key(sk))
+    signed = B.sign_registration(sk, reg)
+    assert B.verify_registration(signed)
+    assert not B.verify_registration(
+        signed.copy_with(signature=b"\xcc" * 96))
+
+
+def test_builder_flow_and_circuit_breaker():
+    signed, _ = _capella_signed_block()
+    payload = signed.message.body.execution_payload
+    header = B._payload_to_header(payload)
+    builder_sk = 777
+    good_bid = B.sign_bid(builder_sk, B.BuilderBid(
+        header=header, value=1,
+        pubkey=bls.secret_to_public_key(builder_sk)))
+
+    class FlakyBuilder(B.BuilderClient):
+        def __init__(self):
+            self.fail = False
+
+        async def get_header(self, slot, parent_hash, pubkey):
+            if self.fail:
+                raise ConnectionError("relay down")
+            return good_bid
+
+        async def get_payload(self, signed_blinded_block):
+            return payload
+
+    async def run():
+        builder = FlakyBuilder()
+        flow = B.BuilderFlow(CFG, builder,
+                             B.BuilderCircuitBreaker(fault_limit=2,
+                                                     cooldown_slots=5))
+        got = await flow.select_header(1, payload.parent_hash, b"")
+        assert got == header
+        # two faults open the circuit: local fallback (None) until the
+        # cooldown passes, even after the relay recovers
+        builder.fail = True
+        assert await flow.select_header(2, payload.parent_hash, b"") \
+            is None
+        assert await flow.select_header(3, payload.parent_hash, b"") \
+            is None
+        builder.fail = False
+        assert await flow.select_header(4, payload.parent_hash, b"") \
+            is None      # circuit still open
+        assert await flow.select_header(9, payload.parent_hash, b"") \
+            == header    # cooldown over
+
+        # reveal path: signed blinded block -> full signed block
+        blinded = B.blind_block(CFG, signed.message)
+        _, SignedBlinded = B.blinded_schemas(CFG, 1)
+        sb = SignedBlinded(message=blinded, signature=signed.signature)
+        full = await flow.reveal(sb)
+        assert full == signed
+
+    asyncio.run(run())

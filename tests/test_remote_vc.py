@@ -20,7 +20,12 @@ from teku_tpu.validator.slashing_protection import SlashingProtector
 
 @pytest.mark.slow
 def test_remote_vc_drives_chain_to_justification():
-    spec = create_spec("minimal")
+    # altair at genesis: the remote VC also exercises the
+    # sync-committee submission endpoint
+    import dataclasses
+    from teku_tpu.spec import config as C
+    from teku_tpu.spec import Spec
+    spec = Spec(dataclasses.replace(C.MINIMAL, ALTAIR_FORK_EPOCH=0))
     state, sks = interop_genesis(spec.config, 16)
 
     async def run():
@@ -47,6 +52,7 @@ def test_remote_vc_drives_chain_to_justification():
                 # its blocking HTTP can be served by THIS loop
                 for phase in (client.on_slot_start,
                               client.on_attestation_due,
+                              client.on_sync_committee_due,
                               client.on_aggregation_due):
                     await loop.run_in_executor(
                         None, lambda p=phase: asyncio.run(p(slot)))
