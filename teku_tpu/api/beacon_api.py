@@ -485,22 +485,26 @@ class BeaconRestApi(RestApi):
                 self.node.spec.config).version_for(SpecMilestone.ALTAIR)
         except KeyError:
             raise HttpError(400, "altair not scheduled on this network")
-        accepted = 0
+        # parse the WHOLE batch before publishing anything: a 400 must
+        # not leave earlier messages already gossiped
+        msgs = []
         for m in body:
             try:
-                msg = version.schemas.SyncCommitteeMessage(
+                msgs.append(version.schemas.SyncCommitteeMessage(
                     slot=int(m["slot"]),
                     beacon_block_root=bytes.fromhex(
                         m["beacon_block_root"][2:]),
                     validator_index=int(m["validator_index"]),
-                    signature=bytes.fromhex(m["signature"][2:]))
+                    signature=bytes.fromhex(m["signature"][2:])))
             except (KeyError, ValueError, TypeError) as exc:
                 raise HttpError(400, f"malformed sync message: {exc}")
+        for msg in msgs:
             if self.validator_api is not None:
                 await self.validator_api.publish_sync_committee_message(
                     msg)
-                accepted += 1
-        return {"accepted": accepted}
+            else:
+                await self.node._process_sync_message(msg)
+        return {"accepted": len(msgs)}
 
     # -- light client (reference: handlers/v1/beacon/lightclient/) -----
     @staticmethod

@@ -30,9 +30,13 @@ from .ssz.types import _ContainerMeta
 
 _LOG = logging.getLogger(__name__)
 
-# the builder spec's application domain (DomainType 0x00000001, domain
-# computed WITHOUT fork data so registrations survive forks)
-BUILDER_DOMAIN = H.compute_domain(DOMAIN_APPLICATION_MASK)
+def builder_domain(cfg: SpecConfig) -> bytes:
+    """The builder spec's application domain: DomainType 0x00000001
+    over the network's GENESIS fork version and an empty root (no
+    per-fork rotation, so registrations survive forks — but the
+    network IS part of the domain, per mev-boost's ComputeDomain)."""
+    return H.compute_domain(DOMAIN_APPLICATION_MASK,
+                            cfg.GENESIS_FORK_VERSION)
 
 
 class ValidatorRegistration(Container):
@@ -47,15 +51,17 @@ class SignedValidatorRegistration(Container):
     signature: Bytes96
 
 
-def sign_registration(sk: int, registration: ValidatorRegistration
+def sign_registration(cfg: SpecConfig, sk: int,
+                      registration: ValidatorRegistration
                       ) -> SignedValidatorRegistration:
-    root = H.compute_signing_root(registration, BUILDER_DOMAIN)
+    root = H.compute_signing_root(registration, builder_domain(cfg))
     return SignedValidatorRegistration(message=registration,
                                        signature=bls.sign(sk, root))
 
 
-def verify_registration(signed: SignedValidatorRegistration) -> bool:
-    root = H.compute_signing_root(signed.message, BUILDER_DOMAIN)
+def verify_registration(cfg: SpecConfig,
+                        signed: SignedValidatorRegistration) -> bool:
+    root = H.compute_signing_root(signed.message, builder_domain(cfg))
     return bls.verify(signed.message.pubkey, root, signed.signature)
 
 
@@ -70,10 +76,8 @@ def _blinded_schemas(cfg: SpecConfig, slot: int):
     S = version.schemas
     if "execution_payload" not in S.BeaconBlockBody._ssz_fields:
         raise ValueError("pre-merge fork has no blinded blocks")
-    body_fields = dict(S.BeaconBlockBody._ssz_fields.items())
-    body_fields["execution_payload"] = None  # placeholder, replaced now
     fields = []
-    for name, schema in body_fields.items():
+    for name, schema in S.BeaconBlockBody._ssz_fields.items():
         if name == "execution_payload":
             fields.append(("execution_payload_header",
                            S.ExecutionPayloadHeader))
@@ -167,22 +171,22 @@ class BuilderBid:
     pubkey: bytes           # builder's BLS key
     signature: bytes = b""
 
-    def signing_root(self) -> bytes:
+    def signing_root(self, cfg: SpecConfig) -> bytes:
         # bid root over (header root, value, pubkey) under the builder
         # domain — structural stand-in for the SSZ BuilderBid container
         import hashlib
         payload = (self.header.htr() + self.value.to_bytes(32, "little")
                    + self.pubkey)
         return H.compute_signing_root(hashlib.sha256(payload).digest(),
-                                      BUILDER_DOMAIN)
+                                      builder_domain(cfg))
 
 
-def sign_bid(sk: int, bid: BuilderBid) -> BuilderBid:
-    bid.signature = bls.sign(sk, bid.signing_root())
+def sign_bid(cfg: SpecConfig, sk: int, bid: BuilderBid) -> BuilderBid:
+    bid.signature = bls.sign(sk, bid.signing_root(cfg))
     return bid
 
 
-def validate_bid(bid: BuilderBid, parent_hash: bytes,
+def validate_bid(cfg: SpecConfig, bid: BuilderBid, parent_hash: bytes,
                  min_value: int = 0) -> bool:
     """reference BuilderBidValidatorImpl: builder signature, payload
     continuity, acceptable value."""
@@ -190,7 +194,7 @@ def validate_bid(bid: BuilderBid, parent_hash: bytes,
         return False
     if bid.header.parent_hash != parent_hash:
         return False
-    return bls.verify(bid.pubkey, bid.signing_root(), bid.signature)
+    return bls.verify(bid.pubkey, bid.signing_root(cfg), bid.signature)
 
 
 # ---- the client seam + circuit breaker -----------------------------------
@@ -263,7 +267,8 @@ class BuilderFlow:
             return None
         if bid is None:
             return None
-        if not validate_bid(bid, parent_hash, self.min_bid_value):
+        if not validate_bid(self.cfg, bid, parent_hash,
+                            self.min_bid_value):
             self.breaker.record_fault(slot)
             return None
         self.breaker.record_success()

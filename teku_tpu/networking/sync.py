@@ -97,19 +97,22 @@ class SyncService:
         root = min(store.blocks, key=lambda r: store.blocks[r].slot)
         return root, store.blocks[root]
 
-    async def backfill_once(self, peer=None, batch: int = 32) -> int:
+    async def backfill_once(self, peer=None, batch: int = 32,
+                            frontier=None) -> int:
         """Extend the chain BACKWARD from the oldest known block: fetch
         the preceding range, authenticate purely by parent-root hash
         linkage up to the trusted anchor, batch-verify proposer
         signatures against the anchor validator set, and retain the
         blocks for serving.  Returns blocks accepted (0 = done/stuck).
-        """
+        `frontier` (a block) skips the oldest-block rescan when the
+        caller already tracks it."""
         peer = peer or self._best_peer() or next(
             iter(self.net.peers), None)
         if peer is None:
             return 0
         store = self.node.store
-        oldest_root, oldest = self._oldest_known()
+        oldest = frontier if frontier is not None \
+            else self._oldest_known()[1]
         if oldest.slot == 0:
             return 0
         expected_parent = oldest.parent_root
@@ -117,7 +120,8 @@ class SyncService:
         bottom = oldest.slot
         # walk the request window downward past empty-slot gaps: an
         # empty chunk means the parent lives further back; a non-empty
-        # chunk that doesn't link means forked/corrupt data — stop
+        # chunk that doesn't link means forked/corrupt data (the break
+        # below covers both that and success)
         while bottom > 0:
             start = max(0, bottom - batch)
             try:
@@ -133,7 +137,7 @@ class SyncService:
                     continue
                 accepted.append((root, signed))
                 expected_parent = block.parent_root
-            if accepted or (blocks and not accepted) or start == 0:
+            if blocks or start == 0:
                 break
             bottom = start
         if not accepted:
@@ -145,6 +149,8 @@ class SyncService:
         for root, signed in accepted:
             store.blocks[root] = signed.message
             store.signed_blocks[root] = signed
+        # the deepest block accepted = the next round's frontier
+        self._last_accepted = accepted[-1][1].message
         self.blocks_imported += len(accepted)
         return len(accepted)
 
@@ -180,13 +186,17 @@ class SyncService:
                 root, signed.signature))
         return bls.batch_verify(triples)
 
-    async def backfill_to_genesis(self, max_rounds: int = 1000) -> int:
+    async def backfill_to_genesis(self, max_rounds: int = 100000) -> int:
         total = 0
+        frontier = self._oldest_known()[1]
         for _ in range(max_rounds):
-            n = await self.backfill_once()
+            n = await self.backfill_once(frontier=frontier)
             if n == 0:
                 break
             total += n
+            # the deepest block just accepted is the new frontier —
+            # no O(chain) rescan per round
+            frontier = self._last_accepted
         return total
 
     async def run_until_synced(self, max_rounds: int = 50) -> None:

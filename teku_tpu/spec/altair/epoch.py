@@ -63,8 +63,10 @@ def get_flag_index_deltas(cfg: SpecConfig, state, flag_index: int):
                                                unslashed) // inc
     active_increments = H.get_total_active_balance(cfg, state) // inc
     leaking = E0.is_in_inactivity_leak(cfg, state)
+    base_per_inc = AH.get_base_reward_per_increment(cfg, state)
     for index in E0.get_eligible_validator_indices(cfg, state):
-        base_reward = AH.get_base_reward(cfg, state, index)
+        base_reward = AH.get_base_reward(cfg, state, index,
+                                         base_per_inc)
         if index in unslashed:
             if not leaking:
                 numer = base_reward * weight * unslashed_increments

@@ -27,10 +27,16 @@ def get_base_reward_per_increment(cfg: SpecConfig, state) -> int:
             // H.integer_squareroot(H.get_total_active_balance(cfg, state)))
 
 
-def get_base_reward(cfg: SpecConfig, state, index: int) -> int:
+def get_base_reward(cfg: SpecConfig, state, index: int,
+                    base_per_increment: int = None) -> int:
+    """`base_per_increment` lets per-validator loops hoist the
+    total-active-balance scan (O(V)) out of the loop — without it an
+    epoch's reward pass is O(V^2)."""
+    if base_per_increment is None:
+        base_per_increment = get_base_reward_per_increment(cfg, state)
     increments = (state.validators[index].effective_balance
                   // cfg.EFFECTIVE_BALANCE_INCREMENT)
-    return increments * get_base_reward_per_increment(cfg, state)
+    return increments * base_per_increment
 
 
 def get_attestation_participation_flag_indices(

@@ -66,6 +66,13 @@ class ValidatorApiChannel:
         committee_index — the data alone no longer names one)."""
         raise NotImplementedError
 
+    async def publish_sync_committee_messages(self, msgs) -> None:
+        """One slot's sync messages as a batch; the default fans out to
+        the singular publish (remote implementations override to send
+        ONE request per slot instead of one per validator)."""
+        for msg in msgs:
+            await self.publish_sync_committee_message(msg)
+
     async def publish_aggregate_and_proof(self, signed_aggregate) -> None:
         raise NotImplementedError
 

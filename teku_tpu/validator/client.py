@@ -141,16 +141,18 @@ class ValidatorClient:
         # the next proposer as previous-slot root
         head_root = self.api.head_root()
         version = self.spec.at_slot(slot)
+        msgs = []
         for vi in members:
             try:
                 sig = self.signer.sign_sync_committee_message(
                     cfg, state, slot, head_root, vi)
             except SigningError:
                 continue
-            msg = version.schemas.SyncCommitteeMessage(
+            msgs.append(version.schemas.SyncCommitteeMessage(
                 slot=slot, beacon_block_root=head_root,
-                validator_index=vi, signature=sig)
-            await self.api.publish_sync_committee_message(msg)
+                validator_index=vi, signature=sig))
+        if msgs:
+            await self.api.publish_sync_committee_messages(msgs)
 
     async def on_aggregation_due(self, slot: int) -> None:
         cfg = self.spec.config

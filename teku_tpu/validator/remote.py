@@ -163,10 +163,16 @@ class RemoteValidatorApi(ValidatorApiChannel):
                    type(signed_aggregate).serialize(signed_aggregate))
 
     async def publish_sync_committee_message(self, msg) -> None:
+        await self.publish_sync_committee_messages([msg])
+
+    async def publish_sync_committee_messages(self, msgs) -> None:
+        """One POST per slot, not per validator: the endpoint takes the
+        whole batch."""
         body = json.dumps([{
-            "slot": str(msg.slot),
-            "beacon_block_root": "0x" + msg.beacon_block_root.hex(),
-            "validator_index": str(msg.validator_index),
-            "signature": "0x" + msg.signature.hex()}]).encode()
+            "slot": str(m.slot),
+            "beacon_block_root": "0x" + m.beacon_block_root.hex(),
+            "validator_index": str(m.validator_index),
+            "signature": "0x" + m.signature.hex()}
+            for m in msgs]).encode()
         self._post("/eth/v1/beacon/pool/sync_committees", body,
                    ctype="application/json")

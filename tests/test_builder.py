@@ -47,18 +47,18 @@ def test_bid_validation():
     payload = signed.message.body.execution_payload
     header = B._payload_to_header(payload)
     builder_sk = 777
-    bid = B.sign_bid(builder_sk, B.BuilderBid(
+    bid = B.sign_bid(CFG, builder_sk, B.BuilderBid(
         header=header, value=10 ** 18,
         pubkey=bls.secret_to_public_key(builder_sk)))
-    assert B.validate_bid(bid, payload.parent_hash)
+    assert B.validate_bid(CFG, bid, payload.parent_hash)
     # wrong parent, low value, bad signature all fail
-    assert not B.validate_bid(bid, b"\x55" * 32)
-    assert not B.validate_bid(bid, payload.parent_hash,
+    assert not B.validate_bid(CFG, bid, b"\x55" * 32)
+    assert not B.validate_bid(CFG, bid, payload.parent_hash,
                               min_value=10 ** 19)
     forged = B.BuilderBid(header=header, value=bid.value,
                           pubkey=bid.pubkey,
                           signature=b"\xbb" * 96)
-    assert not B.validate_bid(forged, payload.parent_hash)
+    assert not B.validate_bid(CFG, forged, payload.parent_hash)
 
 
 def test_registration_sign_verify():
@@ -66,10 +66,10 @@ def test_registration_sign_verify():
     reg = B.ValidatorRegistration(
         fee_recipient=b"\x01" * 20, gas_limit=30_000_000,
         timestamp=1700000000, pubkey=bls.secret_to_public_key(sk))
-    signed = B.sign_registration(sk, reg)
-    assert B.verify_registration(signed)
+    signed = B.sign_registration(CFG, sk, reg)
+    assert B.verify_registration(CFG, signed)
     assert not B.verify_registration(
-        signed.copy_with(signature=b"\xcc" * 96))
+        CFG, signed.copy_with(signature=b"\xcc" * 96))
 
 
 def test_builder_flow_and_circuit_breaker():
@@ -77,7 +77,7 @@ def test_builder_flow_and_circuit_breaker():
     payload = signed.message.body.execution_payload
     header = B._payload_to_header(payload)
     builder_sk = 777
-    good_bid = B.sign_bid(builder_sk, B.BuilderBid(
+    good_bid = B.sign_bid(CFG, builder_sk, B.BuilderBid(
         header=header, value=1,
         pubkey=bls.secret_to_public_key(builder_sk)))
 
