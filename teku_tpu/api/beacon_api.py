@@ -408,10 +408,31 @@ class BeaconRestApi(RestApi):
         if body is None and raw_body:
             # SSZ alternative (application/octet-stream): ONE
             # attestation per request, the remote VC's submit shape
-            att = self._decode_versioned("Attestation", raw_body)
+            # (electra wire = SingleAttestation); the shared codec
+            # policy disambiguates by slot
+            from ..spec.codec import deserialize_attestation_wire
+            try:
+                att = deserialize_attestation_wire(
+                    self.node.spec.config, raw_body,
+                    self.node.chain.current_slot())
+            except Exception as exc:
+                raise HttpError(400, f"malformed attestation: {exc}")
             if self.validator_api is not None:
                 await self.validator_api.publish_attestation(att)
                 return {}
+            if hasattr(att, "attester_index"):
+                from ..node.validators import normalize_attestation
+                try:
+                    # same advanced state the gossip path uses: the
+                    # committee shuffle needs the slot's epoch applied
+                    state = self.node.advanced_head_state(
+                        min(att.data.slot,
+                            self.node.chain.current_slot()))
+                except Exception:
+                    raise HttpError(503, "no state for this slot yet")
+                att = normalize_attestation(self.node.spec, state, att)
+                if att is None:
+                    raise HttpError(400, "attester not in committee")
             from ..node.gossip import ValidationResult
             result = await self.node.attestation_validator.validate(att)
             if result is ValidationResult.REJECT:

@@ -130,6 +130,7 @@ class BeaconNode(Service):
         # topics carry no fork digest yet)
         S = self.spec.at_slot(self.chain.head_slot()).schemas
         from ..spec.codec import deserialize_signed_block
+        from ..spec.milestones import build_fork_schedule
         cfg = self.spec.config
 
         class _BlockWire:       # milestone-aware decode (spec/codec.py)
@@ -141,10 +142,21 @@ class BeaconNode(Service):
         self.gossip.subscribe(AGGREGATE_TOPIC, SszTopicHandler(
             S.SignedAggregateAndProof, self._process_gossip_aggregate,
             AGGREGATE_TOPIC))
+        node = self
+
+        class _AttestationWire:
+            """Subnet wire decode, slot-validated per milestone (the
+            shared spec/codec.py policy)."""
+            @staticmethod
+            def deserialize(data):
+                from ..spec.codec import deserialize_attestation_wire
+                return deserialize_attestation_wire(
+                    cfg, data, node.chain.current_slot())
+
         for subnet in range(self.spec.config.ATTESTATION_SUBNET_COUNT):
             self.gossip.subscribe(
                 attestation_subnet_topic(subnet), SszTopicHandler(
-                    S.Attestation, self._process_gossip_attestation,
+                    _AttestationWire, self._process_gossip_attestation,
                     f"attestation_{subnet}"))
         # operation gossip feeds the pools (reference: the per-type
         # validators in statetransition/validation/*Validator.java —
@@ -269,6 +281,18 @@ class BeaconNode(Service):
         return result
 
     async def _process_gossip_attestation(self, att) -> ValidationResult:
+        # electra single attestations (the wire shape) normalize to the
+        # one-hot committee-bits form everything downstream handles
+        if hasattr(att, "attester_index"):
+            from .validators import normalize_attestation
+            try:
+                state = self.advanced_head_state(
+                    min(att.data.slot, self.chain.current_slot()))
+            except Exception:
+                return ValidationResult.IGNORE
+            att = normalize_attestation(self.spec, state, att)
+            if att is None:
+                return ValidationResult.REJECT
         result = await self.attestation_validator.validate(att)
         if result is ValidationResult.ACCEPT:
             self.attestation_manager.add_attestation(att)

@@ -37,7 +37,10 @@ SAVE_FOR_FUTURE = ValidationResult.SAVE_FOR_FUTURE
 def _committee_index_of(attestation):
     """The committee an attestation addresses: data.index pre-electra;
     the single set committee bit (with data.index pinned to 0) for the
-    electra shape.  None = malformed electra shape (REJECT)."""
+    electra aggregate shape; the explicit field on SingleAttestation.
+    None = malformed electra shape (REJECT)."""
+    if hasattr(attestation, "attester_index"):   # SingleAttestation
+        return attestation.committee_index
     cb = getattr(attestation, "committee_bits", None)
     if cb is None:
         return attestation.data.index
@@ -47,6 +50,36 @@ def _committee_index_of(attestation):
     if len(set_bits) != 1:
         return None
     return set_bits[0]
+
+
+def normalize_attestation(spec: Spec, state, attestation):
+    """Electra SingleAttestation (the subnet WIRE shape) → the one-hot
+    committee-bits Attestation everything downstream pools and applies
+    (reference: SingleAttestation conversion in AttestationValidator /
+    ValidatableAttestation.convertFromSingleAttestation).  Pass-through
+    for every other shape; None = the claimed attester is not in the
+    claimed committee (REJECT)."""
+    if not hasattr(attestation, "attester_index"):
+        return attestation
+    cfg = spec.config
+    data = attestation.data
+    if data.index != 0:
+        return None     # electra data pins index to 0 (wire rule)
+    committee = H.get_beacon_committee(cfg, state, data.slot,
+                                       attestation.committee_index)
+    if attestation.attester_index not in committee:
+        return None
+    from ..spec.electra.datastructures import get_electra_schemas
+    S = get_electra_schemas(cfg)
+    position = committee.index(attestation.attester_index)
+    return S.Attestation(
+        aggregation_bits=tuple(i == position
+                               for i in range(len(committee))),
+        data=data,
+        signature=attestation.signature,
+        committee_bits=tuple(
+            i == attestation.committee_index
+            for i in range(cfg.MAX_COMMITTEES_PER_SLOT)))
 
 
 class AttestationValidator:

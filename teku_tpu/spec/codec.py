@@ -47,3 +47,40 @@ def deserialize_state(cfg: SpecConfig, data: bytes):
     (slot,) = struct.unpack_from("<Q", data, 40)
     version = build_fork_schedule(cfg).version_at_slot(slot)
     return version.schemas.BeaconState.deserialize(data)
+
+
+def deserialize_attestation_wire(cfg: SpecConfig, data: bytes,
+                                 current_slot=None):
+    """Decode a subnet attestation message; the wire container changes
+    at electra (SingleAttestation replaces the one-bit Attestation).
+
+    Length alone cannot disambiguate — a pre-electra attestation over
+    an 88-95 member committee is exactly SingleAttestation's 240 fixed
+    bytes — so a candidate decode is accepted only if its OWN data.slot
+    maps back to the candidate's milestone AND sits near the wall clock
+    (a misparse reads 8 root bytes as the slot: astronomically far
+    future).  This is the codec-level dual of the per-topic schema the
+    reference gets from fork-digest-scoped topics."""
+    schedule = build_fork_schedule(cfg)
+    from .milestones import SpecMilestone
+    last = None
+    for version in reversed(schedule.versions):
+        if version.milestone >= SpecMilestone.ELECTRA:
+            schema = version.schemas.SingleAttestation
+        else:
+            schema = version.schemas.Attestation
+        try:
+            msg = schema.deserialize(data)
+        except Exception as exc:
+            last = exc
+            continue
+        slot = msg.data.slot
+        if schedule.milestone_at_slot(slot) is not version.milestone:
+            last = ValueError("attestation slot outside this fork")
+            continue
+        if current_slot is not None \
+                and slot > current_slot + cfg.SLOTS_PER_EPOCH * 2:
+            last = ValueError("implausibly distant attestation slot")
+            continue
+        return msg
+    raise last if last is not None else ValueError("empty message")

@@ -193,6 +193,19 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
         reference marks them producedLocally but still validates) — a
         signer bug or stale duty must not poison block production."""
         from ..node.gossip import ValidationResult
+        wire = attestation
+        if hasattr(attestation, "attester_index"):
+            # electra single attestation: normalize for local
+            # validation/pooling, publish the wire shape
+            from ..node.validators import normalize_attestation
+            state = self.node.advanced_head_state(
+                min(attestation.data.slot,
+                    self.node.chain.current_slot()))
+            attestation = normalize_attestation(self.spec, state,
+                                                attestation)
+            if attestation is None:
+                _LOG.warning("own single attestation malformed")
+                return
         result = await self.node.attestation_validator.validate(attestation)
         if result is ValidationResult.ACCEPT:
             self.node.attestation_manager.add_attestation(attestation)
@@ -215,7 +228,7 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
             cfg, committees, data.slot, ci if ci is not None else 0)
         await self.node.gossip.publish(
             attestation_subnet_topic(subnet),
-            type(attestation).serialize(attestation))
+            type(wire).serialize(wire))
 
     def get_aggregate(self, data, committee_index=None):
         return self.node.pool.get_aggregate(data, committee_index)

@@ -108,15 +108,17 @@ class ValidatorClient:
             except SigningError as exc:
                 _LOG.warning("attestation duty refused: %s", exc)
                 continue
-            bits = tuple(i == duty.committee_position
-                         for i in range(duty.committee_size))
-            kw = dict(aggregation_bits=bits, data=data, signature=sig)
             if electra:
-                # EIP-7549 shape: index 0 + one-hot committee bits
-                kw["committee_bits"] = tuple(
-                    i == duty.committee_index
-                    for i in range(cfg.MAX_COMMITTEES_PER_SLOT))
-            att = S.Attestation(**kw)
+                # EIP-7549 wire shape for subnets: SingleAttestation
+                att = S.SingleAttestation(
+                    committee_index=duty.committee_index,
+                    attester_index=duty.validator_index,
+                    data=data, signature=sig)
+            else:
+                bits = tuple(i == duty.committee_position
+                             for i in range(duty.committee_size))
+                att = S.Attestation(aggregation_bits=bits, data=data,
+                                    signature=sig)
             await self.api.publish_attestation(att)
             self.attestations_sent += 1
 

@@ -354,3 +354,37 @@ def test_upgrade_queues_pre_activation_validators():
     (pd,) = post.pending_deposits
     assert pd.pubkey == b"\x22" * 48
     assert pd.amount == cfg.MAX_EFFECTIVE_BALANCE
+
+
+def test_single_attestation_normalization():
+    """The electra subnet wire shape converts to the pooled one-hot
+    form; wrong committee membership or nonzero index is rejected."""
+    from teku_tpu.spec import Spec
+    from teku_tpu.node.validators import normalize_attestation
+    cfg, state, sks = _electra_state(n=16)
+    spec = Spec(cfg)
+    S = get_electra_schemas(cfg)
+    slot, ci = 1, 0
+    adv = process_slots(cfg, state, slot)
+    committee = H.get_beacon_committee(cfg, adv, slot, ci)
+    attester = committee[1]
+    data = S.AttestationData(slot=slot, index=0,
+                             beacon_block_root=b"\x01" * 32,
+                             source=adv.current_justified_checkpoint,
+                             target=S.Checkpoint(epoch=0,
+                                                 root=b"\x02" * 32))
+    single = S.SingleAttestation(committee_index=ci,
+                                 attester_index=attester,
+                                 data=data, signature=b"\x03" * 96)
+    att = normalize_attestation(spec, adv, single)
+    assert att is not None
+    assert sum(att.aggregation_bits) == 1
+    assert att.aggregation_bits[1]
+    assert sum(att.committee_bits) == 1 and att.committee_bits[ci]
+    # attester not in the claimed committee
+    outsider = next(i for i in range(16) if i not in committee)
+    bad = single.copy_with(attester_index=outsider)
+    assert normalize_attestation(spec, adv, bad) is None
+    # nonzero data.index violates the wire rule
+    bad2 = single.copy_with(data=data.copy_with(index=1))
+    assert normalize_attestation(spec, adv, bad2) is None
