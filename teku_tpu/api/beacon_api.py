@@ -65,6 +65,7 @@ class BeaconRestApi(RestApi):
         p("/eth/v2/beacon/blocks", self._publish_block_ssz)
         p("/eth/v1/validator/aggregate_and_proofs",
           self._submit_aggregate_ssz)
+        g("/eth/v1/events", self._events)
         g("/eth/v1/beacon/light_client/bootstrap/{block_id}",
           self._lc_bootstrap)
         g("/eth/v1/beacon/light_client/finality_update",
@@ -526,6 +527,83 @@ class BeaconRestApi(RestApi):
             else:
                 await self.node._process_sync_message(msg)
         return {"accepted": len(msgs)}
+
+    async def _events(self, query=None):
+        """SSE events stream (reference: handlers/v1/events/GetEvents +
+        EventSubscriptionManager): head / block / finalized_checkpoint
+        topics, one subscriber per connection, detached on close."""
+        import asyncio as _asyncio
+        from ..infra.events import (BlockImportChannel, ChainHeadChannel,
+                                    FinalizedCheckpointChannel)
+        from ..infra.restapi import SseStream
+        topics = set((query or {}).get(
+            "topics", "head,block,finalized_checkpoint").split(","))
+        known = {"head", "block", "finalized_checkpoint"}
+        if not topics <= known:
+            raise HttpError(400, f"unknown topics {topics - known}")
+        queue: _asyncio.Queue = _asyncio.Queue(maxsize=256)
+
+        def _offer(item):
+            try:
+                queue.put_nowait(item)
+            except _asyncio.QueueFull:
+                pass    # slow client: drop rather than grow unbounded
+
+        api = self
+
+        class _Sink:
+            def on_block_imported(self, signed_block, post_state):
+                if "block" not in topics:
+                    return
+                block = signed_block.message
+                _offer(("block", {
+                    "slot": str(block.slot),
+                    "block": _hex(block.htr()),
+                    "execution_optimistic": False}))
+
+            def on_chain_head_updated(self, slot, root, reorg=False):
+                # FORK-CHOICE head changes only — an imported
+                # non-canonical block must not masquerade as head
+                if "head" not in topics:
+                    return
+                block = api.node.store.blocks.get(root)
+                _offer(("head", {
+                    "slot": str(slot), "block": _hex(root),
+                    "state": _hex(block.state_root)
+                    if block is not None else _hex(bytes(32)),
+                    "epoch_transition": slot
+                    % api.node.spec.config.SLOTS_PER_EPOCH == 0,
+                    "previous_duty_dependent_root": _hex(bytes(32)),
+                    "current_duty_dependent_root": _hex(bytes(32)),
+                    "execution_optimistic": False}))
+
+            def on_new_finalized_checkpoint(self, checkpoint,
+                                            from_optimistic_api=False):
+                if "finalized_checkpoint" in topics:
+                    _offer(("finalized_checkpoint", {
+                        "block": _hex(checkpoint.root),
+                        "epoch": str(checkpoint.epoch),
+                        "execution_optimistic": False}))
+
+        channels = self.node.channels
+
+        async def gen():
+            # subscribe INSIDE the generator so attach/detach are
+            # symmetric: a stream torn down before its first event
+            # (or never started at all) leaves no dead sink behind
+            sink = _Sink()
+            channels.subscribe(BlockImportChannel, sink)
+            channels.subscribe(ChainHeadChannel, sink)
+            channels.subscribe(FinalizedCheckpointChannel, sink)
+            try:
+                while True:
+                    yield await queue.get()
+            finally:
+                channels.unsubscribe(BlockImportChannel, sink)
+                channels.unsubscribe(ChainHeadChannel, sink)
+                channels.unsubscribe(FinalizedCheckpointChannel, sink)
+
+        return SseStream(gen())
 
     # -- light client (reference: handlers/v1/beacon/lightclient/) -----
     @staticmethod

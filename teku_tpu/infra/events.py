@@ -76,6 +76,13 @@ class EventChannels:
         self._subscribers.setdefault(iface, []).append(subscriber)
         return self
 
+    def unsubscribe(self, iface: Type[T], subscriber: T) -> None:
+        """Detach a subscriber (SSE connections come and go; a
+        permanent registration would leak one sink per client)."""
+        subs = self._subscribers.get(iface)
+        if subs is not None and subscriber in subs:
+            subs.remove(subscriber)
+
     def publisher(self, iface: Type[T], async_delivery: bool = False) -> T:
         return _Proxy(self, iface, async_delivery)  # type: ignore
 

@@ -19,6 +19,16 @@ _LOG = logging.getLogger(__name__)
 Handler = Callable[..., Awaitable]
 
 
+class SseStream:
+    """A handler returns one of these to take over the response as a
+    server-sent-events stream: `gen` is an async generator yielding
+    (event_name, json_payload) pairs; the connection closes when the
+    generator ends or the client disconnects."""
+
+    def __init__(self, gen):
+        self.gen = gen
+
+
 class HttpError(Exception):
     def __init__(self, status: int, message: str):
         super().__init__(message)
@@ -32,6 +42,7 @@ class RestApi:
         self.port = port
         self._routes: List[Tuple[str, "re.Pattern", Handler]] = []
         self._server: Optional[asyncio.AbstractServer] = None
+        self._clients: set = set()
 
     def route(self, method: str, pattern: str, handler: Handler) -> None:
         regex = re.compile(
@@ -52,12 +63,23 @@ class RestApi:
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
+            # 3.12's wait_closed blocks until every client handler ends
+            # — a long-lived SSE stream would hold shutdown forever, so
+            # cancel them first
+            for task in list(self._clients):
+                task.cancel()
+            for task in list(self._clients):
+                try:
+                    await task
+                except (asyncio.CancelledError, Exception):
+                    pass
             await self._server.wait_closed()
             self._server = None
 
     # ------------------------------------------------------------------
     async def _client(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        self._clients.add(asyncio.current_task())
         try:
             while True:
                 line = await reader.readline()
@@ -86,15 +108,16 @@ class RestApi:
                 if n:
                     body = await reader.readexactly(n)
                 keep = headers.get("connection", "").lower() != "close"
-                await self._dispatch(writer, method, target, body,
-                                     headers)
-                if not keep:
+                streamed = await self._dispatch(writer, method, target,
+                                                body, headers)
+                if streamed or not keep:
                     break
         except (ConnectionError, asyncio.IncompleteReadError):
             pass
         except Exception:
             _LOG.exception("http client loop failed")
         finally:
+            self._clients.discard(asyncio.current_task())
             try:
                 writer.close()
             except Exception:
@@ -133,6 +156,9 @@ class RestApi:
                     if "headers" in accepted:
                         kwargs["headers"] = headers or {}
                     result = await handler(**kwargs)
+                    if isinstance(result, SseStream):
+                        await self._stream_sse(writer, result)
+                        return True
                     if isinstance(result, tuple):       # (payload, ctype)
                         payload, ctype = result
                     else:
@@ -147,6 +173,48 @@ class RestApi:
                     payload = {"code": 500, "message": str(exc)}
                 break
         await self._respond(writer, status, payload, ctype)
+        return False
+
+    @staticmethod
+    async def _stream_sse(writer, stream: SseStream) -> None:
+        """SSE per the events-API spec: one `event:`/`data:` block per
+        event, connection held open until either side ends it."""
+        writer.write(b"HTTP/1.1 200 X\r\n"
+                     b"Content-Type: text/event-stream\r\n"
+                     b"Cache-Control: no-cache\r\n"
+                     b"Connection: close\r\n\r\n")
+        await writer.drain()
+        nxt = None
+        try:
+            agen = stream.gen.__aiter__()
+            while True:
+                if nxt is None:
+                    nxt = asyncio.ensure_future(agen.__anext__())
+                try:
+                    event, data = await asyncio.wait_for(
+                        asyncio.shield(nxt), timeout=15.0)
+                    nxt = None
+                except asyncio.TimeoutError:
+                    # SSE comment keepalive — also how a dead client
+                    # gets discovered (the write fails)
+                    writer.write(b": keepalive\n\n")
+                    await writer.drain()
+                    continue
+                writer.write(f"event: {event}\n"
+                             f"data: {json.dumps(data)}\n\n".encode())
+                await writer.drain()
+        except (StopAsyncIteration, ConnectionError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            if nxt is not None:
+                nxt.cancel()
+            close = getattr(stream.gen, "aclose", None)
+            if close is not None:
+                try:
+                    await close()
+                except Exception:
+                    pass
 
     @staticmethod
     async def _respond(writer, status: int, payload,

@@ -1,0 +1,104 @@
+"""Deposit provider: the eth1 deposit merkle tree and block-production
+proofs.
+
+Equivalent of the reference's deposit plumbing (reference: beacon/
+validator/.../coordinator/DepositProvider.java fed by beacon/pow's
+deposit-log follower; the tree math matches the deposit contract's
+incremental merkle tree): deposits observed on the execution chain
+accumulate in a depth-32 merkle tree whose root (with the count mixed
+in) is what eth1_data commits to; a proposer must include the next
+`min(MAX_DEPOSITS, pending)` deposits WITH branches proving them into
+that root, and process_deposit re-verifies each branch.
+
+Post-electra (EIP-6110) deposit requests arrive straight from the
+payload and this path winds down once the eth1 bridge drains.
+"""
+
+from typing import List, Optional
+
+from ..spec.config import SpecConfig
+from ..ssz import merkle_branch, merkleize, zero_hash
+from ..ssz.hash import hash_pair
+
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+
+
+class DepositTree:
+    """The deposit contract's accumulator, with proof generation."""
+
+    def __init__(self):
+        self._leaves: List[bytes] = []
+
+    def push(self, deposit_data) -> int:
+        """Append one DepositData; returns its index."""
+        self._leaves.append(deposit_data.htr())
+        return len(self._leaves) - 1
+
+    @property
+    def count(self) -> int:
+        return len(self._leaves)
+
+    def root(self) -> bytes:
+        """hash(merkle_root_over_2^32_leaves, count) — the deposit
+        contract's get_deposit_root / spec deposit_root."""
+        inner = merkleize(self._leaves,
+                          1 << DEPOSIT_CONTRACT_TREE_DEPTH) \
+            if self._leaves else zero_hash(DEPOSIT_CONTRACT_TREE_DEPTH)
+        return hash_pair(inner,
+                         self.count.to_bytes(32, "little"))
+
+    def proof(self, index: int) -> List[bytes]:
+        """33-element branch: 32 tree siblings + the count mix-in (the
+        shape process_deposit verifies with depth+1)."""
+        branch = merkle_branch(self._leaves, index,
+                               1 << DEPOSIT_CONTRACT_TREE_DEPTH)
+        return branch + [self.count.to_bytes(32, "little")]
+
+
+class DepositProvider:
+    """Serves the deposits a block at `state` must include (reference
+    DepositProvider.getDeposits: from state.eth1_deposit_index up to
+    eth1_data.deposit_count, capped at MAX_DEPOSITS)."""
+
+    def __init__(self, cfg: SpecConfig):
+        self.cfg = cfg
+        self.tree = DepositTree()
+        self._data: List[object] = []
+
+    def on_deposit(self, deposit_data) -> int:
+        """A new deposit observed on the execution chain."""
+        self._data.append(deposit_data)
+        return self.tree.push(deposit_data)
+
+    def eth1_data(self, block_hash: bytes = bytes(32)):
+        from ..spec.datastructures import Eth1Data
+        return Eth1Data(deposit_root=self.tree.root(),
+                        deposit_count=self.tree.count,
+                        block_hash=block_hash)
+
+    def get_deposits_for_block(self, state) -> List[object]:
+        """Proof-carrying deposits the next block MUST include."""
+        start = state.eth1_deposit_index
+        # electra: the eth1 bridge stops at deposit_requests_start_index
+        limit = state.eth1_data.deposit_count
+        if hasattr(state, "deposit_requests_start_index"):
+            limit = min(limit, state.deposit_requests_start_index)
+        due = min(limit, start + self.cfg.MAX_DEPOSITS)
+        end = min(due, self.tree.count)
+        if end < due:
+            # the consensus check will reject an under-filled block —
+            # make the data gap loud instead of a silent missed slot
+            import logging
+            logging.getLogger(__name__).warning(
+                "deposit tree behind eth1_data: have %d, block needs "
+                "deposits %d..%d", self.tree.count, start, due)
+        if end <= start:
+            return []
+        from ..spec.milestones import build_fork_schedule
+        S = build_fork_schedule(self.cfg).version_at_slot(
+            state.slot).schemas
+        out = []
+        for i in range(start, end):
+            out.append(S.Deposit(proof=tuple(self.tree.proof(i)),
+                                 data=self._data[i]))
+        return out

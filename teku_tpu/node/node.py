@@ -78,6 +78,9 @@ class BeaconNode(Service):
         from .blobs import BlobSidecarPool
         self.blob_pool = BlobSidecarPool(
             max_blobs=spec.config.MAX_BLOBS_PER_BLOCK_ELECTRA)
+        # optional eth1-bridge deposit source (node/deposits.py); when
+        # set, block production includes proof-carrying deposits
+        self.deposit_provider = None
         from ..infra.collections import LimitedSet
         self._seen_blob_sidecars = LimitedSet(16384)
         self.block_manager = BlockManager(spec, self.chain, self.channels,

@@ -169,8 +169,12 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
                                                  max(slot, 1) - 1)
             sync_aggregate = self.node.sync_pool.build_aggregate(
                 max(slot, 1) - 1, prev_root, version.schemas)
+        deposit_provider = getattr(self.node, "deposit_provider", None)
+        deposits = (deposit_provider.get_deposits_for_block(pre)
+                    if deposit_provider is not None else ())
         block, _post = build_unsigned_block(
             cfg, pre, slot, randao_reveal, attestations=atts,
+            deposits=deposits,
             proposer_slashings=pools["proposer_slashings"].get_for_block(
                 cfg.MAX_PROPOSER_SLASHINGS, pre),
             attester_slashings=pools["attester_slashings"].get_for_block(
@@ -198,9 +202,13 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
             # electra single attestation: normalize for local
             # validation/pooling, publish the wire shape
             from ..node.validators import normalize_attestation
-            state = self.node.advanced_head_state(
-                min(attestation.data.slot,
-                    self.node.chain.current_slot()))
+            try:
+                state = self.node.advanced_head_state(
+                    min(attestation.data.slot,
+                        self.node.chain.current_slot()))
+            except Exception:
+                _LOG.warning("no state to normalize own attestation")
+                return
             attestation = normalize_attestation(self.spec, state,
                                                 attestation)
             if attestation is None:

@@ -131,3 +131,56 @@ def test_rest_api_over_http():
             await api.stop()
             await net.stop()
     asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_events_sse_stream():
+    """/eth/v1/events streams head/block events as the chain advances."""
+    from teku_tpu.api import BeaconRestApi
+    from teku_tpu.node import Devnet
+
+    async def run():
+        net = Devnet(n_nodes=1, n_validators=16)
+        await net.start()
+        api = BeaconRestApi(net.nodes[0])
+        await api.start()
+        try:
+            import socket
+            loop = asyncio.get_running_loop()
+            lines = []
+
+            def reader():
+                s = socket.create_connection(
+                    ("127.0.0.1", api.port), timeout=10)
+                s.sendall(b"GET /eth/v1/events?topics=head,block "
+                          b"HTTP/1.1\r\nHost: x\r\n\r\n")
+                buf = b""
+                s.settimeout(10)
+                try:
+                    while buf.count(b"\n\n") < 5:
+                        chunk = s.recv(4096)
+                        if not chunk:
+                            break
+                        buf += chunk
+                except socket.timeout:
+                    pass
+                finally:
+                    s.close()
+                lines.extend(buf.decode(errors="replace").splitlines())
+
+            task = loop.run_in_executor(None, reader)
+            await asyncio.sleep(0.2)       # let the GET register
+            await net.run_until_slot(3)
+            await task
+            events = [l for l in lines if l.startswith("event: ")]
+            datas = [l for l in lines if l.startswith("data: ")]
+            assert any("head" in e for e in events), lines[:10]
+            assert any("block" in e for e in events)
+            head = json.loads(next(
+                d for e, d in zip(events, datas) if "head" in e)[6:])
+            assert int(head["slot"]) >= 1
+            assert head["block"].startswith("0x")
+        finally:
+            await api.stop()
+            await net.stop()
+    asyncio.run(run())

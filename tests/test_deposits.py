@@ -1,0 +1,95 @@
+"""Deposit lifecycle: execution-chain deposit → merkle tree → block
+inclusion with proof → registry entry → activation."""
+
+import dataclasses
+
+import pytest
+
+from teku_tpu.crypto import bls
+from teku_tpu.node.deposits import DepositProvider, DepositTree
+from teku_tpu.spec import config as C
+from teku_tpu.spec import helpers as H
+from teku_tpu.spec.builder import make_local_signer, produce_attestations, \
+    produce_block
+from teku_tpu.spec.datastructures import DepositData, DepositMessage
+from teku_tpu.spec.genesis import interop_genesis
+from teku_tpu.spec.transition import state_transition
+
+CFG = C.MINIMAL
+
+
+def _deposit_data(cfg, sk, amount=None):
+    pk = bls.secret_to_public_key(sk)
+    creds = b"\x00" + H.hash32(pk)[1:]
+    amount = cfg.MAX_EFFECTIVE_BALANCE if amount is None else amount
+    msg = DepositMessage(pubkey=pk, withdrawal_credentials=creds,
+                         amount=amount)
+    domain = H.compute_domain(C.DOMAIN_DEPOSIT, cfg.GENESIS_FORK_VERSION,
+                              bytes(32))
+    sig = bls.sign(sk, H.compute_signing_root(msg, domain))
+    return DepositData(pubkey=pk, withdrawal_credentials=creds,
+                       amount=amount, signature=sig)
+
+
+def test_deposit_tree_proofs_verify():
+    cfg = CFG
+    tree = DepositTree()
+    datas = [_deposit_data(cfg, 1000 + i) for i in range(5)]
+    for d in datas:
+        tree.push(d)
+    root = tree.root()
+    for i, d in enumerate(datas):
+        assert H.is_valid_merkle_branch(
+            d.htr(), tree.proof(i), cfg.DEPOSIT_CONTRACT_TREE_DEPTH + 1,
+            i, root), f"proof {i} failed"
+    # proofs bind to the index and the leaf
+    assert not H.is_valid_merkle_branch(
+        datas[0].htr(), tree.proof(0),
+        cfg.DEPOSIT_CONTRACT_TREE_DEPTH + 1, 1, root)
+
+
+@pytest.mark.slow
+def test_new_deposit_joins_and_activates():
+    cfg = dataclasses.replace(CFG, SHARD_COMMITTEE_PERIOD=4)
+    state, sks = interop_genesis(cfg, 16)
+    signer = make_local_signer(dict(enumerate(sks)))
+    provider = DepositProvider(cfg)
+    # genesis deposits enter the tree so indices line up
+    for sk in sks:
+        provider.on_deposit(_deposit_data(cfg, sk))
+    newcomer_sk = 999_999
+    provider.on_deposit(_deposit_data(cfg, newcomer_sk))
+    # the chain learns the new deposit root via eth1_data (the voting
+    # period is compressed to "already agreed" for the test)
+    state = state.copy_with(eth1_data=provider.eth1_data())
+    assert state.eth1_data.deposit_count == 17
+
+    deposits = provider.get_deposits_for_block(state)
+    assert len(deposits) == 1
+    signed, post = produce_block(cfg, state, 1, signer,
+                                 deposits=deposits)
+    verified = state_transition(cfg, state, signed, validate_result=True)
+    assert verified.htr() == post.htr()
+    assert len(post.validators) == 17
+    newcomer_pk = bls.secret_to_public_key(newcomer_sk)
+    assert post.validators[16].pubkey == newcomer_pk
+    assert post.balances[16] == cfg.MAX_EFFECTIVE_BALANCE
+    assert post.eth1_deposit_index == 17
+    # a block OMITTING the due deposit is invalid
+    import teku_tpu.spec.block  # noqa
+    with pytest.raises(Exception):
+        bad, _ = produce_block(cfg, state, 1, signer, deposits=())
+
+    # run ~3 epochs: the newcomer becomes eligible and activates
+    cur = state
+    atts = []
+    for slot in range(1, 4 * cfg.SLOTS_PER_EPOCH + 1):
+        dep = provider.get_deposits_for_block(
+            cur if cur.slot >= slot - 1 else cur)
+        signed, cur = produce_block(cfg, cur, slot, signer,
+                                    attestations=atts, deposits=dep)
+        atts = produce_attestations(cfg, cur, slot,
+                                    signed.message.htr(), signer)
+    v = cur.validators[16]
+    assert v.activation_eligibility_epoch < C.FAR_FUTURE_EPOCH
+    assert v.activation_epoch < C.FAR_FUTURE_EPOCH
