@@ -567,14 +567,33 @@ class BeaconRestApi(RestApi):
                 if "head" not in topics:
                     return
                 block = api.node.store.blocks.get(root)
+                cfg = api.node.spec.config
+                # duty dependent roots: last block before the epoch's
+                # (and previous epoch's) first slot — consumers refetch
+                # duties when these change across a reorg
+                prev_dep = cur_dep = bytes(32)
+                try:
+                    from ..spec import helpers as _H
+                    state = api.node.chain.head_state()
+                    epoch = slot // cfg.SLOTS_PER_EPOCH
+                    cur_start = epoch * cfg.SLOTS_PER_EPOCH
+                    prev_start = max(epoch - 1, 0) * cfg.SLOTS_PER_EPOCH
+                    if cur_start > 0:
+                        cur_dep = _H.get_block_root_at_slot(
+                            cfg, state, cur_start - 1)
+                    if prev_start > 0:
+                        prev_dep = _H.get_block_root_at_slot(
+                            cfg, state, prev_start - 1)
+                except Exception:
+                    pass
                 _offer(("head", {
                     "slot": str(slot), "block": _hex(root),
                     "state": _hex(block.state_root)
                     if block is not None else _hex(bytes(32)),
                     "epoch_transition": slot
-                    % api.node.spec.config.SLOTS_PER_EPOCH == 0,
-                    "previous_duty_dependent_root": _hex(bytes(32)),
-                    "current_duty_dependent_root": _hex(bytes(32)),
+                    % cfg.SLOTS_PER_EPOCH == 0,
+                    "previous_duty_dependent_root": _hex(prev_dep),
+                    "current_duty_dependent_root": _hex(cur_dep),
                     "execution_optimistic": False}))
 
             def on_new_finalized_checkpoint(self, checkpoint,

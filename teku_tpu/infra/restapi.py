@@ -128,10 +128,15 @@ class RestApi:
                         = None) -> None:
         path, _, query = target.partition("?")
         params = {}
+        from urllib.parse import unquote
         for kv in query.split("&"):
             if "=" in kv:
                 k, v = kv.split("=", 1)
-                params[k] = v
+                v = unquote(v)
+                # the beacon API's repeatable array form
+                # (topics=a&topics=b) folds to the comma-joined value
+                # handlers already parse
+                params[k] = params[k] + "," + v if k in params else v
         status, payload, ctype = 404, {"code": 404,
                                        "message": "not found"}, None
         import inspect

@@ -17,7 +17,7 @@ payload and this path winds down once the eth1 bridge drains.
 from typing import List, Optional
 
 from ..spec.config import SpecConfig
-from ..ssz import merkle_branch, merkleize, zero_hash
+from ..ssz import merkleize, zero_hash
 from ..ssz.hash import hash_pair
 
 DEPOSIT_CONTRACT_TREE_DEPTH = 32
@@ -38,21 +38,53 @@ class DepositTree:
     def count(self) -> int:
         return len(self._leaves)
 
-    def root(self) -> bytes:
+    def root(self, count: Optional[int] = None) -> bytes:
         """hash(merkle_root_over_2^32_leaves, count) — the deposit
-        contract's get_deposit_root / spec deposit_root."""
-        inner = merkleize(self._leaves,
-                          1 << DEPOSIT_CONTRACT_TREE_DEPTH) \
-            if self._leaves else zero_hash(DEPOSIT_CONTRACT_TREE_DEPTH)
-        return hash_pair(inner,
-                         self.count.to_bytes(32, "little"))
+        contract's get_deposit_root / spec deposit_root.  `count`
+        snapshots the tree at an earlier length (the committed
+        eth1_data may trail deposits the provider has already seen)."""
+        count = self.count if count is None else count
+        leaves = self._leaves[:count]
+        inner = merkleize(leaves, 1 << DEPOSIT_CONTRACT_TREE_DEPTH) \
+            if leaves else zero_hash(DEPOSIT_CONTRACT_TREE_DEPTH)
+        return hash_pair(inner, count.to_bytes(32, "little"))
 
-    def proof(self, index: int) -> List[bytes]:
-        """33-element branch: 32 tree siblings + the count mix-in (the
-        shape process_deposit verifies with depth+1)."""
-        branch = merkle_branch(self._leaves, index,
-                               1 << DEPOSIT_CONTRACT_TREE_DEPTH)
-        return branch + [self.count.to_bytes(32, "little")]
+    def _levels(self, count: int) -> List[List[bytes]]:
+        """All populated tree levels over leaves[:count], cached per
+        count: proofs for a whole block's deposits then cost O(log n)
+        each instead of re-hashing the tree per proof."""
+        cached = getattr(self, "_levels_cache", None)
+        if cached is not None and cached[0] == count:
+            return cached[1]
+        from ..ssz.hash import _hash_level
+        level = list(self._leaves[:count]) or [zero_hash(0)]
+        levels = [level]
+        for d in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            level = _hash_level(level, zero_hash(d))
+            levels.append(level)
+        self._levels_cache = (count, levels)
+        return levels
+
+    def proof(self, index: int, count: Optional[int] = None
+              ) -> List[bytes]:
+        """33-element branch proving leaf `index` into the tree
+        SNAPSHOT at `count` leaves: 32 tree siblings + the count
+        mix-in (the shape process_deposit verifies with depth+1).
+        Proving against the live tree would break whenever deposits
+        arrive after the eth1_data the state committed to."""
+        count = self.count if count is None else count
+        if index >= count:
+            raise IndexError("deposit index beyond snapshot")
+        levels = self._levels(count)
+        branch = []
+        idx = index
+        for d in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            level = levels[d]
+            sib = idx ^ 1
+            branch.append(level[sib] if sib < len(level)
+                          else zero_hash(d))
+            idx >>= 1
+        return branch + [count.to_bytes(32, "little")]
 
 
 class DepositProvider:
@@ -76,11 +108,18 @@ class DepositProvider:
                         deposit_count=self.tree.count,
                         block_hash=block_hash)
 
-    def get_deposits_for_block(self, state) -> List[object]:
-        """Proof-carrying deposits the next block MUST include."""
+    def get_deposits_for_block(self, state,
+                               eth1_data=None) -> List[object]:
+        """Proof-carrying deposits the next block MUST include.
+        `eth1_data` is the eth1 vote the block will carry — if the vote
+        reaches majority it adopts WITHIN the block, before
+        process_operations counts expected deposits, so production must
+        anticipate it (reference BlockOperationSelectorFactory passes
+        the vote result into DepositProvider.getDeposits)."""
+        eth1_data = state.eth1_data if eth1_data is None else eth1_data
         start = state.eth1_deposit_index
         # electra: the eth1 bridge stops at deposit_requests_start_index
-        limit = state.eth1_data.deposit_count
+        limit = eth1_data.deposit_count
         if hasattr(state, "deposit_requests_start_index"):
             limit = min(limit, state.deposit_requests_start_index)
         due = min(limit, start + self.cfg.MAX_DEPOSITS)
@@ -97,8 +136,12 @@ class DepositProvider:
         from ..spec.milestones import build_fork_schedule
         S = build_fork_schedule(self.cfg).version_at_slot(
             state.slot).schemas
+        # proofs prove into the SNAPSHOT the block's eth1_data commits
+        # to, not the live tree
+        snapshot = eth1_data.deposit_count
         out = []
         for i in range(start, end):
-            out.append(S.Deposit(proof=tuple(self.tree.proof(i)),
-                                 data=self._data[i]))
+            out.append(S.Deposit(
+                proof=tuple(self.tree.proof(i, snapshot)),
+                data=self._data[i]))
         return out

@@ -98,7 +98,8 @@ def build_unsigned_block(cfg: SpecConfig, pre, slot: int,
                          voluntary_exits: Sequence = (),
                          graffiti: bytes = bytes(32),
                          proposer_index: Optional[int] = None,
-                         sync_aggregate=None):
+                         sync_aggregate=None,
+                         eth1_vote=None):
     """(unsigned block with state root filled, post_state) on an
     already-slot-advanced pre-state — the ONE body-construction recipe
     shared by local production and the validator API (reference:
@@ -119,7 +120,10 @@ def build_unsigned_block(cfg: SpecConfig, pre, slot: int,
     attestations = [a for a in attestations if isinstance(a, att_cls)]
     body_kwargs = dict(
         randao_reveal=randao_reveal,
-        eth1_data=pre.eth1_data, graffiti=graffiti,
+        # the proposer's eth1 vote (reference Eth1DataCache majority
+        # vote); default = re-vote the current committed eth1_data
+        eth1_data=eth1_vote if eth1_vote is not None else pre.eth1_data,
+        graffiti=graffiti,
         proposer_slashings=tuple(proposer_slashings),
         attester_slashings=tuple(attester_slashings),
         attestations=tuple(attestations), deposits=tuple(deposits),

@@ -170,11 +170,24 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
             sync_aggregate = self.node.sync_pool.build_aggregate(
                 max(slot, 1) - 1, prev_root, version.schemas)
         deposit_provider = getattr(self.node, "deposit_provider", None)
-        deposits = (deposit_provider.get_deposits_for_block(pre)
-                    if deposit_provider is not None else ())
+        eth1_vote = None
+        deposits = ()
+        if deposit_provider is not None:
+            # vote the provider's deposit-chain view; if THIS vote
+            # reaches the period majority it adopts inside the block,
+            # so the deposit list must be computed against it
+            eth1_vote = deposit_provider.eth1_data()
+            votes = list(pre.eth1_data_votes) + [eth1_vote]
+            period = (cfg.EPOCHS_PER_ETH1_VOTING_PERIOD
+                      * cfg.SLOTS_PER_EPOCH)
+            effective = (eth1_vote
+                         if votes.count(eth1_vote) * 2 > period
+                         else pre.eth1_data)
+            deposits = deposit_provider.get_deposits_for_block(
+                pre, effective)
         block, _post = build_unsigned_block(
             cfg, pre, slot, randao_reveal, attestations=atts,
-            deposits=deposits,
+            deposits=deposits, eth1_vote=eth1_vote,
             proposer_slashings=pools["proposer_slashings"].get_for_block(
                 cfg.MAX_PROPOSER_SLASHINGS, pre),
             attester_slashings=pools["attester_slashings"].get_for_block(

@@ -84,8 +84,7 @@ def test_new_deposit_joins_and_activates():
     cur = state
     atts = []
     for slot in range(1, 4 * cfg.SLOTS_PER_EPOCH + 1):
-        dep = provider.get_deposits_for_block(
-            cur if cur.slot >= slot - 1 else cur)
+        dep = provider.get_deposits_for_block(cur)
         signed, cur = produce_block(cfg, cur, slot, signer,
                                     attestations=atts, deposits=dep)
         atts = produce_attestations(cfg, cur, slot,
@@ -93,3 +92,68 @@ def test_new_deposit_joins_and_activates():
     v = cur.validators[16]
     assert v.activation_eligibility_epoch < C.FAR_FUTURE_EPOCH
     assert v.activation_epoch < C.FAR_FUTURE_EPOCH
+
+
+@pytest.mark.slow
+def test_eth1_voting_adopts_new_deposits_on_devnet():
+    """End to end without manual eth1_data injection: proposers VOTE
+    the provider's deposit root; once a majority of the voting period
+    agrees, deposits flow and the newcomer joins the registry."""
+    import asyncio
+    from teku_tpu.node import Devnet
+    from teku_tpu.spec import Spec
+
+    cfg = CFG
+    net = Devnet(n_nodes=1, n_validators=16, spec=Spec(cfg))
+    node = net.nodes[0]
+    provider = DepositProvider(cfg)
+    sks = [s for s in range(1, 17)]
+    from teku_tpu.spec.genesis import interop_secret_keys
+    for sk in interop_secret_keys(16):
+        provider.on_deposit(_deposit_data(cfg, sk))
+    provider.on_deposit(_deposit_data(cfg, 777_777))
+    node.deposit_provider = provider
+
+    async def run():
+        await net.start()
+        try:
+            period = cfg.EPOCHS_PER_ETH1_VOTING_PERIOD \
+                * cfg.SLOTS_PER_EPOCH
+            await net.run_until_slot(period // 2 + 4)
+            state = node.chain.head_state()
+            # the vote carried: eth1_data switched to the new root
+            assert state.eth1_data.deposit_count == 17
+            assert len(state.validators) == 17
+            assert state.validators[16].pubkey \
+                == bls.secret_to_public_key(777_777)
+        finally:
+            await net.stop()
+    asyncio.run(run())
+
+
+def test_proofs_snapshot_at_committed_count():
+    """A deposit arriving AFTER the committed eth1_data must not break
+    the proofs for deposits the state already expects."""
+    cfg = CFG
+    tree = DepositTree()
+    datas = [_deposit_data(cfg, 2000 + i) for i in range(6)]
+    for d in datas[:4]:
+        tree.push(d)
+    committed_root = tree.root()          # snapshot at 4
+    for d in datas[4:]:
+        tree.push(d)                      # tree grows to 6
+    assert tree.count == 6
+    # proof for index 3 against the 4-leaf snapshot still verifies
+    proof = tree.proof(3, count=4)
+    assert H.is_valid_merkle_branch(
+        datas[3].htr(), proof, cfg.DEPOSIT_CONTRACT_TREE_DEPTH + 1, 3,
+        committed_root)
+    # the live-tree proof would NOT (different count mix-in)
+    live = tree.proof(3)
+    assert not H.is_valid_merkle_branch(
+        datas[3].htr(), live, cfg.DEPOSIT_CONTRACT_TREE_DEPTH + 1, 3,
+        committed_root)
+    # snapshot must bound the index
+    import pytest as _pytest
+    with _pytest.raises(IndexError):
+        tree.proof(5, count=4)
