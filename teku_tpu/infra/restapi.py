@@ -128,15 +128,12 @@ class RestApi:
                         = None) -> None:
         path, _, query = target.partition("?")
         params = {}
-        from urllib.parse import unquote
-        for kv in query.split("&"):
-            if "=" in kv:
-                k, v = kv.split("=", 1)
-                v = unquote(v)
-                # the beacon API's repeatable array form
-                # (topics=a&topics=b) folds to the comma-joined value
-                # handlers already parse
-                params[k] = params[k] + "," + v if k in params else v
+        from urllib.parse import parse_qsl
+        for k, v in parse_qsl(query, keep_blank_values=True):
+            # the beacon API's repeatable array form (topics=a&topics=b)
+            # folds to the comma-joined value handlers already parse
+            # (none of our list-valued params legally contain commas)
+            params[k] = params[k] + "," + v if k in params else v
         status, payload, ctype = 404, {"code": 404,
                                        "message": "not found"}, None
         import inspect

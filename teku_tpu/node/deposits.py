@@ -17,7 +17,7 @@ payload and this path winds down once the eth1 bridge drains.
 from typing import List, Optional
 
 from ..spec.config import SpecConfig
-from ..ssz import merkleize, zero_hash
+from ..ssz import zero_hash
 from ..ssz.hash import hash_pair
 
 DEPOSIT_CONTRACT_TREE_DEPTH = 32
@@ -42,11 +42,12 @@ class DepositTree:
         """hash(merkle_root_over_2^32_leaves, count) — the deposit
         contract's get_deposit_root / spec deposit_root.  `count`
         snapshots the tree at an earlier length (the committed
-        eth1_data may trail deposits the provider has already seen)."""
+        eth1_data may trail deposits the provider has already seen).
+        Shares the per-snapshot level cache with proof()."""
         count = self.count if count is None else count
-        leaves = self._leaves[:count]
-        inner = merkleize(leaves, 1 << DEPOSIT_CONTRACT_TREE_DEPTH) \
-            if leaves else zero_hash(DEPOSIT_CONTRACT_TREE_DEPTH)
+        # _levels runs all 32 contract levels (zero-padded), so the
+        # top level holds the full virtual-tree root
+        inner = self._levels(count)[-1][0]
         return hash_pair(inner, count.to_bytes(32, "little"))
 
     def _levels(self, count: int) -> List[List[bytes]]:
@@ -124,21 +125,23 @@ class DepositProvider:
             limit = min(limit, state.deposit_requests_start_index)
         due = min(limit, start + self.cfg.MAX_DEPOSITS)
         end = min(due, self.tree.count)
-        if end < due:
-            # the consensus check will reject an under-filled block —
-            # make the data gap loud instead of a silent missed slot
+        snapshot = eth1_data.deposit_count
+        if end < due or snapshot > self.tree.count:
+            # the consensus check will reject an under-filled block and
+            # a truncated snapshot can't produce valid proofs — make
+            # the data gap loud instead of a silent missed slot
             import logging
             logging.getLogger(__name__).warning(
-                "deposit tree behind eth1_data: have %d, block needs "
-                "deposits %d..%d", self.tree.count, start, due)
-        if end <= start:
+                "deposit tree behind eth1_data: have %d, snapshot %d, "
+                "block needs deposits %d..%d", self.tree.count,
+                snapshot, start, due)
+        if snapshot > self.tree.count or end <= start:
             return []
         from ..spec.milestones import build_fork_schedule
         S = build_fork_schedule(self.cfg).version_at_slot(
             state.slot).schemas
         # proofs prove into the SNAPSHOT the block's eth1_data commits
         # to, not the live tree
-        snapshot = eth1_data.deposit_count
         out = []
         for i in range(start, end):
             out.append(S.Deposit(

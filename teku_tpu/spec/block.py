@@ -104,13 +104,22 @@ def process_randao(cfg: SpecConfig, state, body,
     return state.copy_with(randao_mixes=tuple(mixes))
 
 
-def process_eth1_data(cfg: SpecConfig, state, body):
-    votes = list(state.eth1_data_votes) + [body.eth1_data]
-    state = state.copy_with(eth1_data_votes=tuple(votes))
+def eth1_vote_outcome(cfg: SpecConfig, state, vote):
+    """The eth1_data in force AFTER a block carrying `vote` processes —
+    the ONE statement of the adoption rule, shared by the transition
+    and by block production (which must anticipate same-block adoption
+    when selecting deposits)."""
+    votes = list(state.eth1_data_votes) + [vote]
     period = cfg.EPOCHS_PER_ETH1_VOTING_PERIOD * cfg.SLOTS_PER_EPOCH
-    if votes.count(body.eth1_data) * 2 > period:
-        state = state.copy_with(eth1_data=body.eth1_data)
-    return state
+    return vote if votes.count(vote) * 2 > period else state.eth1_data
+
+
+def process_eth1_data(cfg: SpecConfig, state, body):
+    outcome = eth1_vote_outcome(cfg, state, body.eth1_data)
+    return state.copy_with(
+        eth1_data_votes=tuple(state.eth1_data_votes)
+        + (body.eth1_data,),
+        eth1_data=outcome)
 
 
 def process_proposer_slashing(cfg: SpecConfig, state, slashing,

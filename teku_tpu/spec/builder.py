@@ -99,7 +99,8 @@ def build_unsigned_block(cfg: SpecConfig, pre, slot: int,
                          graffiti: bytes = bytes(32),
                          proposer_index: Optional[int] = None,
                          sync_aggregate=None,
-                         eth1_vote=None):
+                         eth1_vote=None,
+                         blob_kzg_commitments: Sequence = ()):
     """(unsigned block with state root filled, post_state) on an
     already-slot-advanced pre-state — the ONE body-construction recipe
     shared by local production and the validator API (reference:
@@ -148,6 +149,10 @@ def build_unsigned_block(cfg: SpecConfig, pre, slot: int,
             # transitioned: the processor skips execution checks
             # (is_execution_enabled False)
             body_kwargs["execution_payload"] = S.ExecutionPayload()
+    if "blob_kzg_commitments" in S.BeaconBlockBody._ssz_fields:
+        body_kwargs["blob_kzg_commitments"] = tuple(blob_kzg_commitments)
+    elif blob_kzg_commitments:
+        raise ValueError("blob commitments need a deneb+ fork")
     body = S.BeaconBlockBody(**body_kwargs)
     block = S.BeaconBlock(
         slot=slot, proposer_index=proposer_index,

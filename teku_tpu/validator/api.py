@@ -175,19 +175,32 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
         if deposit_provider is not None:
             # vote the provider's deposit-chain view; if THIS vote
             # reaches the period majority it adopts inside the block,
-            # so the deposit list must be computed against it
+            # so the deposit list must be computed against the outcome
+            from ..spec.block import eth1_vote_outcome
             eth1_vote = deposit_provider.eth1_data()
-            votes = list(pre.eth1_data_votes) + [eth1_vote]
-            period = (cfg.EPOCHS_PER_ETH1_VOTING_PERIOD
-                      * cfg.SLOTS_PER_EPOCH)
-            effective = (eth1_vote
-                         if votes.count(eth1_vote) * 2 > period
-                         else pre.eth1_data)
+            effective = eth1_vote_outcome(cfg, pre, eth1_vote)
             deposits = deposit_provider.get_deposits_for_block(
                 pre, effective)
+        # blob source seam (reference: the EL's getPayload blobs
+        # bundle): blobs ride as sidecars, only commitments in-body
+        commitments: tuple = ()
+        blob_source = getattr(self.node, "blob_source", None)
+        if blob_source is not None:
+            from ..spec.milestones import SpecMilestone
+            if self.spec.milestone_at_slot(slot) >= SpecMilestone.DENEB:
+                bundle = blob_source(slot)
+                if bundle is not None:
+                    blobs, commitments, proofs = bundle
+                    self._pending_blob_bundles = getattr(
+                        self, "_pending_blob_bundles", {})
+                    self._pending_blob_bundles = {
+                        k: v for k, v in
+                        self._pending_blob_bundles.items()
+                        if v[0] >= slot - 2}   # keep only fresh ones
         block, _post = build_unsigned_block(
             cfg, pre, slot, randao_reveal, attestations=atts,
             deposits=deposits, eth1_vote=eth1_vote,
+            blob_kzg_commitments=commitments,
             proposer_slashings=pools["proposer_slashings"].get_for_block(
                 cfg.MAX_PROPOSER_SLASHINGS, pre),
             attester_slashings=pools["attester_slashings"].get_for_block(
@@ -195,14 +208,38 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
             voluntary_exits=pools["voluntary_exits"].get_for_block(
                 cfg.MAX_VOLUNTARY_EXITS, pre),
             graffiti=graffiti, sync_aggregate=sync_aggregate)
+        if commitments:
+            # keyed by body root: the signed envelope isn't known yet
+            self._pending_blob_bundles[block.body.htr()] = (
+                slot, blobs, proofs)
         return block, pre
 
     # -- submission ----------------------------------------------------
     async def publish_signed_block(self, signed_block) -> None:
+        # a blob-carrying block's sidecars go out FIRST (they embed the
+        # signed header, buildable only now) so peers' availability
+        # gates can admit the block (reference publishes sidecars and
+        # block together from BlockPublisherDeneb)
+        bundle = getattr(self, "_pending_blob_bundles", {}).pop(
+            signed_block.message.body.htr(), None)
+        if bundle is not None:
+            await self._publish_blob_sidecars(signed_block, bundle)
         self.node.block_manager.import_block(signed_block)
         from ..spec.codec import serialize_signed_block
         await self.node.gossip.publish(
             BEACON_BLOCK_TOPIC, serialize_signed_block(signed_block))
+
+    async def _publish_blob_sidecars(self, signed_block, bundle) -> None:
+        from ..node.gossip import blob_sidecar_topic
+        from ..spec.deneb.datastructures import make_blob_sidecars
+        _slot, blobs, proofs = bundle
+        cfg = self.spec.config
+        sidecars = make_blob_sidecars(cfg, signed_block, blobs, proofs)
+        for sc in sidecars:
+            # own sidecars: pool directly (proofs are ours), gossip out
+            self.node.blob_pool.add_spec_sidecar(cfg, sc)
+            await self.node.gossip.publish(
+                blob_sidecar_topic(sc.index), type(sc).serialize(sc))
 
     async def publish_attestation(self, attestation) -> None:
         """Locally-produced attestations run the SAME gossip validation

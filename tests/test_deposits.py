@@ -107,7 +107,6 @@ def test_eth1_voting_adopts_new_deposits_on_devnet():
     net = Devnet(n_nodes=1, n_validators=16, spec=Spec(cfg))
     node = net.nodes[0]
     provider = DepositProvider(cfg)
-    sks = [s for s in range(1, 17)]
     from teku_tpu.spec.genesis import interop_secret_keys
     for sk in interop_secret_keys(16):
         provider.on_deposit(_deposit_data(cfg, sk))

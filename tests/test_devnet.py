@@ -207,3 +207,48 @@ def test_devnet_crosses_electra_fork_live():
         finally:
             await net.stop()
     asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_devnet_deneb_blocks_carry_blobs_live():
+    """Proposers attach real KZG commitments; sidecars gossip ahead of
+    blocks and peers import through the availability gate."""
+    import dataclasses
+    from teku_tpu.crypto import kzg
+    from teku_tpu.spec import config as C, Spec
+
+    cfg = dataclasses.replace(C.MINIMAL, ALTAIR_FORK_EPOCH=0,
+                              BELLATRIX_FORK_EPOCH=0,
+                              CAPELLA_FORK_EPOCH=0, DENEB_FORK_EPOCH=0)
+    setup = kzg.insecure_setup()
+    blob = b"\x00" * (32 * cfg.FIELD_ELEMENTS_PER_BLOB)
+    commitment = kzg.blob_to_kzg_commitment(blob, setup)
+    proof = kzg.compute_blob_kzg_proof(blob, commitment, setup)
+
+    async def run():
+        net = Devnet(n_nodes=2, n_validators=32, spec=Spec(cfg))
+        for node in net.nodes:
+            node.blob_pool._setup = setup
+            node.blob_source = (
+                lambda slot: ([blob], (commitment,), [proof]))
+        await net.start()
+        try:
+            epochs = 3
+            await net.run_until_slot(epochs * cfg.SLOTS_PER_EPOCH)
+            assert net.heads_converged(), "nodes diverged"
+            assert net.min_justified_epoch() >= 1
+            # every head-chain block carried the commitment, and BOTH
+            # nodes' pools hold proof-verified sidecars for the head
+            # (the non-proposer only imports after the gate passes)
+            for node in net.nodes:
+                head_root = node.chain.head_root
+                head = node.store.blocks[head_root]
+                assert tuple(head.body.blob_kzg_commitments) \
+                    == (commitment,)
+                assert node.blob_pool.check_availability(
+                    head_root, [commitment]) == "available"
+                wire = node.blob_pool.wire_sidecars_for(head_root)
+                assert len(wire) == 1 and wire[0].index == 0
+        finally:
+            await net.stop()
+    asyncio.run(run())
