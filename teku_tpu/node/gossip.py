@@ -120,6 +120,7 @@ SYNC_COMMITTEE_TOPIC = "sync_committee"
 PROPOSER_SLASHING_TOPIC = "proposer_slashing"
 ATTESTER_SLASHING_TOPIC = "attester_slashing"
 BLS_TO_EXECUTION_CHANGE_TOPIC = "bls_to_execution_change"
+SYNC_CONTRIBUTION_TOPIC = "sync_committee_contribution_and_proof"
 
 
 def blob_sidecar_topic(subnet_id: int) -> str:

@@ -94,6 +94,9 @@ class BeaconNode(Service):
             spec, self.chain, self.verifier)
         self.block_validator = BlockGossipValidator(
             spec, self.chain, self.verifier)
+        from .validators import ContributionValidator
+        self.contribution_validator = ContributionValidator(
+            spec, self.chain, self.verifier)
         self.gossip = gossip
         # one slot-advanced head state shared by all duty phases
         self._advanced_cache: Optional[tuple] = None
@@ -234,6 +237,17 @@ class BeaconNode(Service):
         self.gossip.subscribe(SYNC_COMMITTEE_TOPIC, SszTopicHandler(
             version.schemas.SyncCommitteeMessage,
             self._process_sync_message, SYNC_COMMITTEE_TOPIC))
+        from .gossip import SYNC_CONTRIBUTION_TOPIC
+        self.gossip.subscribe(SYNC_CONTRIBUTION_TOPIC, SszTopicHandler(
+            version.schemas.SignedContributionAndProof,
+            self._process_sync_contribution, SYNC_CONTRIBUTION_TOPIC))
+
+    async def _process_sync_contribution(self, signed
+                                         ) -> ValidationResult:
+        result = await self.contribution_validator.validate(signed)
+        if result is ValidationResult.ACCEPT:
+            self.sync_pool.add_contribution(signed.message.contribution)
+        return result
 
     async def _process_sync_message(self, msg) -> ValidationResult:
         """Gossiped sync-committee message: membership + signature

@@ -33,17 +33,74 @@ class SyncCommitteeMessagePool:
             self._msgs.put(key, bucket)
         bucket.setdefault(committee_position, signature)
 
-    def build_aggregate(self, slot: int, block_root: bytes, schemas):
-        """SyncAggregate over collected messages for (slot, root);
-        empty participation carries the infinity signature."""
+    def add_contribution(self, contribution) -> None:
+        """A validated per-subcommittee contribution (reference
+        SyncCommitteeContributionPool): the best (most participation)
+        contribution per (slot, root, subcommittee) wins."""
+        key = ("contrib", contribution.slot,
+               contribution.beacon_block_root)
+        bucket = self._msgs.get(key)
+        if bucket is None:
+            bucket = {}
+            self._msgs.put(key, bucket)
+        held = bucket.get(contribution.subcommittee_index)
+        if held is None or sum(contribution.aggregation_bits) \
+                > sum(held.aggregation_bits):
+            bucket[contribution.subcommittee_index] = contribution
+
+    def build_contribution(self, slot: int, block_root: bytes,
+                           subcommittee_index: int, schemas):
+        """Aggregate THIS subcommittee's pooled messages (the sync
+        aggregator duty's production shape)."""
         bucket = self._msgs.get((slot, block_root)) or {}
-        size = self.cfg.SYNC_COMMITTEE_SIZE
-        bits = tuple(i in bucket for i in range(size))
-        if not bucket:
+        cfg = self.cfg
+        from ..spec.altair.helpers import sync_subcommittee_size
+        sub_size = sync_subcommittee_size(cfg)
+        start = subcommittee_index * sub_size
+        positions = [p for p in bucket if start <= p < start + sub_size]
+        if not positions:
+            return None
+        bits = tuple(start + i in bucket for i in range(sub_size))
+        sig = bls.aggregate_signatures(
+            [bucket[p] for p in sorted(positions)])
+        return schemas.SyncCommitteeContribution(
+            slot=slot, beacon_block_root=block_root,
+            subcommittee_index=subcommittee_index,
+            aggregation_bits=bits, signature=sig)
+
+    def build_aggregate(self, slot: int, block_root: bytes, schemas):
+        """SyncAggregate for (slot, root): contributions cover their
+        whole subcommittee; the raw message pool fills subcommittees
+        with no contribution.  A position must never be signed twice —
+        the aggregate would then contain a key the bitfield names only
+        once, and verification fails."""
+        cfg = self.cfg
+        size = cfg.SYNC_COMMITTEE_SIZE
+        from ..spec.altair.helpers import sync_subcommittee_size
+        sub_size = sync_subcommittee_size(cfg)
+        contribs = self._msgs.get(("contrib", slot, block_root)) or {}
+        bucket = self._msgs.get((slot, block_root)) or {}
+        bits = [False] * size
+        sigs = []
+        for sub, contribution in sorted(contribs.items()):
+            start = sub * sub_size
+            any_bit = False
+            for i, b in enumerate(contribution.aggregation_bits):
+                if b:
+                    bits[start + i] = True
+                    any_bit = True
+            if any_bit:
+                sigs.append(contribution.signature)
+        for position in sorted(bucket):
+            if bits[position]:
+                continue    # a contribution already carries this seat
+            bits[position] = True
+            sigs.append(bucket[position])
+        if not sigs:
             from ..crypto.bls.pure_impl import G2_INFINITY
             sig = G2_INFINITY
         else:
-            sig = bls.aggregate_signatures(
-                [bucket[i] for i in sorted(bucket)])
-        return schemas.SyncAggregate(sync_committee_bits=bits,
+            sig = sigs[0] if len(sigs) == 1 \
+                else bls.aggregate_signatures(sigs)
+        return schemas.SyncAggregate(sync_committee_bits=tuple(bits),
                                      sync_committee_signature=sig)

@@ -218,6 +218,77 @@ class AggregateValidator:
         return ACCEPT
 
 
+class ContributionValidator:
+    """SignedContributionAndProof gossip rules (reference
+    statetransition/synccommittee/SignedContributionAndProofValidator):
+    live slot, valid subcommittee, aggregator is a member, selection
+    proof selects them — then the three signatures (selection proof,
+    envelope, contribution aggregate) verify as ONE atomic batch."""
+
+    def __init__(self, spec: Spec, chain: RecentChainData,
+                 verifier: AsyncSignatureVerifier):
+        self.spec = spec
+        self.chain = chain
+        self.verifier = verifier
+        self._seen: LimitedSet = LimitedSet(8192)
+
+    async def validate(self, signed) -> ValidationResult:
+        from ..spec.altair import helpers as AH
+        cfg = self.spec.config
+        msg = signed.message
+        contribution = msg.contribution
+        slot = contribution.slot
+        cur = self.chain.current_slot()
+        if slot > cur:
+            return SAVE_FOR_FUTURE
+        if slot < cur - 1:
+            return IGNORE
+        if contribution.subcommittee_index \
+                >= cfg.SYNC_COMMITTEE_SUBNET_COUNT:
+            return REJECT
+        if not any(contribution.aggregation_bits):
+            return REJECT
+        key = (slot, msg.aggregator_index,
+               contribution.subcommittee_index)
+        if key in self._seen:
+            return IGNORE
+        state = self.chain.head_state()
+        if not hasattr(state, "current_sync_committee"):
+            return IGNORE
+        if msg.aggregator_index >= len(state.validators):
+            return REJECT
+        agg_pubkey = state.validators[msg.aggregator_index].pubkey
+        positions, pubkeys = AH.sync_subcommittee_members(
+            cfg, state, contribution.subcommittee_index)
+        if agg_pubkey not in pubkeys:
+            return REJECT
+        if not AH.is_sync_committee_aggregator(cfg,
+                                               msg.selection_proof):
+            return REJECT
+
+        batch = AsyncBatchSignatureVerifier(self.verifier)
+        batch.verify([agg_pubkey],
+                     AH.sync_selection_proof_signing_root(
+                         cfg, state, slot,
+                         contribution.subcommittee_index),
+                     msg.selection_proof)
+        batch.verify([agg_pubkey],
+                     AH.contribution_and_proof_signing_root(cfg, state,
+                                                            msg),
+                     signed.signature)
+        participants = [pk for pk, b in zip(
+            pubkeys, contribution.aggregation_bits) if b]
+        batch.verify(participants,
+                     AH.sync_message_signing_root(
+                         cfg, state, slot,
+                         contribution.beacon_block_root),
+                     contribution.signature)
+        if not await batch.batch_verify():
+            return REJECT
+        self._seen.add(key)
+        return ACCEPT
+
+
 class BlockGossipValidator:
     """Block gossip rules (reference BlockGossipValidator.java): slot
     not from the future/too old, first block per (slot, proposer),

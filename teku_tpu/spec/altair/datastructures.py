@@ -48,6 +48,32 @@ class AltairSchemas:
             ("validator_index", uint64),
             ("signature", Bytes96),
         ])
+        # per-subcommittee aggregation (validator spec: 4 sync subnets)
+        sub_size = (cfg.SYNC_COMMITTEE_SIZE
+                    // cfg.SYNC_COMMITTEE_SUBNET_COUNT)
+        self.SyncCommitteeContribution = _container(
+            "SyncCommitteeContribution", [
+                ("slot", uint64),
+                ("beacon_block_root", Bytes32),
+                ("subcommittee_index", uint64),
+                ("aggregation_bits", Bitvector(sub_size)),
+                ("signature", Bytes96),
+            ])
+        self.ContributionAndProof = _container("ContributionAndProof", [
+            ("aggregator_index", uint64),
+            ("contribution", self.SyncCommitteeContribution),
+            ("selection_proof", Bytes96),
+        ])
+        self.SignedContributionAndProof = _container(
+            "SignedContributionAndProof", [
+                ("message", self.ContributionAndProof),
+                ("signature", Bytes96),
+            ])
+        self.SyncAggregatorSelectionData = _container(
+            "SyncAggregatorSelectionData", [
+                ("slot", uint64),
+                ("subcommittee_index", uint64),
+            ])
         self.BeaconBlockBody = _container("BeaconBlockBodyAltair", [
             ("randao_reveal", Bytes96),
             ("eth1_data", Eth1Data),

@@ -129,6 +129,56 @@ def sync_message_signing_root(cfg: SpecConfig, state, slot: int,
     return H.compute_signing_root(block_root, domain)
 
 
+def sync_selection_proof_signing_root(cfg: SpecConfig, state, slot: int,
+                                      subcommittee_index: int) -> bytes:
+    """SyncAggregatorSelectionData under
+    DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF (validator spec
+    get_sync_committee_selection_proof)."""
+    from ..config import DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF
+    from .datastructures import get_altair_schemas
+    S = get_altair_schemas(cfg)
+    data = S.SyncAggregatorSelectionData(
+        slot=slot, subcommittee_index=subcommittee_index)
+    domain = H.get_domain(cfg, state,
+                          DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+                          H.compute_epoch_at_slot(cfg, slot))
+    return H.compute_signing_root(data, domain)
+
+
+def is_sync_committee_aggregator(cfg: SpecConfig, proof: bytes) -> bool:
+    """Validator spec is_sync_committee_aggregator."""
+    modulo = max(1, cfg.SYNC_COMMITTEE_SIZE
+                 // cfg.SYNC_COMMITTEE_SUBNET_COUNT
+                 // cfg.TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE)
+    return int.from_bytes(H.hash32(proof)[:8], "little") % modulo == 0
+
+
+def contribution_and_proof_signing_root(cfg: SpecConfig, state,
+                                        message) -> bytes:
+    from ..config import DOMAIN_CONTRIBUTION_AND_PROOF
+    domain = H.get_domain(cfg, state, DOMAIN_CONTRIBUTION_AND_PROOF,
+                          H.compute_epoch_at_slot(
+                              cfg, message.contribution.slot))
+    return H.compute_signing_root(message, domain)
+
+
+def sync_subcommittee_size(cfg: SpecConfig) -> int:
+    """Members per sync subnet — THE definition, shared by schemas,
+    pools, duties and validators."""
+    return cfg.SYNC_COMMITTEE_SIZE // cfg.SYNC_COMMITTEE_SUBNET_COUNT
+
+
+def sync_subcommittee_members(cfg: SpecConfig, state,
+                              subcommittee_index: int):
+    """The committee POSITIONS covered by one subcommittee, with their
+    pubkeys (duplicate pubkeys possible on tiny sets — positions are
+    the unit of participation)."""
+    sub_size = sync_subcommittee_size(cfg)
+    start = subcommittee_index * sub_size
+    pubkeys = state.current_sync_committee.pubkeys[start:start + sub_size]
+    return list(range(start, start + sub_size)), list(pubkeys)
+
+
 def sync_committee_signing_root(cfg: SpecConfig, state, slot: int) -> bytes:
     """Signing root for the previous slot's block root (the aggregate
     included at `slot`)."""

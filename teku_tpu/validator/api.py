@@ -302,6 +302,29 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
         await self.node.gossip.publish(
             SYNC_COMMITTEE_TOPIC, type(msg).serialize(msg))
 
+    def build_sync_contribution(self, slot: int, block_root: bytes,
+                                subcommittee_index: int):
+        """This subcommittee's pooled messages as a contribution (the
+        sync aggregator duty's getter)."""
+        from ..spec.milestones import build_fork_schedule
+        S = build_fork_schedule(self.spec.config).version_at_slot(
+            slot).schemas
+        return self.node.sync_pool.build_contribution(
+            slot, block_root, subcommittee_index, S)
+
+    async def publish_contribution_and_proof(self, signed) -> None:
+        """Own contribution: same validation as gossip, then pool +
+        broadcast."""
+        from ..node.gossip import SYNC_CONTRIBUTION_TOPIC, \
+            ValidationResult
+        result = await self.node._process_sync_contribution(signed)
+        if result is not ValidationResult.ACCEPT:
+            _LOG.warning("own sync contribution failed validation: %s",
+                         result)
+            return
+        await self.node.gossip.publish(
+            SYNC_CONTRIBUTION_TOPIC, type(signed).serialize(signed))
+
     async def publish_aggregate_and_proof(self, signed_aggregate) -> None:
         from ..node.gossip import ValidationResult
         result = await self.node.aggregate_validator.validate(

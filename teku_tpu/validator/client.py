@@ -140,8 +140,11 @@ class ValidatorClient:
         if not members:
             return
         # sign the CURRENT head (the slot's block): it is included by
-        # the next proposer as previous-slot root
+        # the next proposer as previous-slot root — and remembered so
+        # the aggregation phase targets the SAME root even if the head
+        # moves mid-slot
         head_root = self.api.head_root()
+        self._sync_duty_root = (slot, head_root)
         version = self.spec.at_slot(slot)
         msgs = []
         for vi in members:
@@ -156,10 +159,78 @@ class ValidatorClient:
         if msgs:
             await self.api.publish_sync_committee_messages(msgs)
 
+    async def on_sync_aggregation_due(self, slot: int) -> None:
+        """Sync-committee contribution duty (reference duties/
+        synccommittee/SyncCommitteeAggregationDuty): members with a
+        winning selection proof aggregate their subcommittee's pooled
+        messages and broadcast a SignedContributionAndProof."""
+        cfg = self.spec.config
+        state = self.api.duty_state(slot)
+        if not hasattr(state, "current_sync_committee"):
+            return
+        from ..spec.altair.helpers import is_sync_committee_aggregator
+        build = getattr(self.api, "build_sync_contribution", None)
+        publish = getattr(self.api, "publish_contribution_and_proof",
+                          None)
+        if build is None or publish is None:
+            return      # channel without the contribution surface
+        pk_to_index = {}
+        for i in set(self.indices):
+            pk_to_index[state.validators[i].pubkey] = i
+        from ..spec.altair.helpers import sync_subcommittee_size
+        sub_size = sync_subcommittee_size(cfg)
+        # aggregate the root the slot's messages actually signed — a
+        # mid-slot head change must not orphan the pooled messages
+        duty = getattr(self, "_sync_duty_root", None)
+        head_root = (duty[1] if duty is not None and duty[0] == slot
+                     else self.api.head_root())
+        version = self.spec.at_slot(slot)
+        # EVERY validator with a winning selection proof broadcasts its
+        # own contribution (the redundancy is the point of selecting
+        # ~TARGET aggregators per subcommittee); dedupe only per
+        # (validator, subcommittee) across duplicate committee seats
+        done: set = set()
+        for position, pk in enumerate(
+                state.current_sync_committee.pubkeys):
+            vi = pk_to_index.get(pk)
+            if vi is None:
+                continue
+            sub = position // sub_size
+            if (vi, sub) in done:
+                continue
+            done.add((vi, sub))
+            try:
+                proof = self.signer.sign_sync_selection_proof(
+                    cfg, state, slot, sub, vi)
+            except SigningError:
+                continue
+            if not is_sync_committee_aggregator(cfg, proof):
+                continue
+            contribution = build(slot, head_root, sub)
+            if contribution is None:
+                continue
+            msg = version.schemas.ContributionAndProof(
+                aggregator_index=vi, contribution=contribution,
+                selection_proof=proof)
+            try:
+                sig = self.signer.sign_contribution_and_proof(
+                    cfg, state, msg)
+            except SigningError:
+                continue
+            await publish(version.schemas.SignedContributionAndProof(
+                message=msg, signature=sig))
+
     async def on_aggregation_due(self, slot: int) -> None:
         cfg = self.spec.config
         epoch = H.compute_epoch_at_slot(cfg, slot)
         self._duties_for_epoch(epoch)
+        try:
+            await self.on_sync_aggregation_due(slot)
+        except Exception:
+            # a failed sync contribution must never take down the
+            # attestation aggregation below (or the whole duty loop)
+            _LOG.exception("sync aggregation duty failed at slot %d",
+                           slot)
         version, electra = self._slot_version(slot)
         S = version.schemas
         aggregated_committees = set()

@@ -47,6 +47,15 @@ class DutySigner:
                                     validator_index: int) -> bytes:
         raise NotImplementedError
 
+    def sign_sync_selection_proof(self, cfg: SpecConfig, state,
+                                  slot: int, subcommittee_index: int,
+                                  validator_index: int) -> bytes:
+        raise NotImplementedError
+
+    def sign_contribution_and_proof(self, cfg: SpecConfig, state,
+                                    msg) -> bytes:
+        raise NotImplementedError
+
 
 class LocalSigner(DutySigner):
     def __init__(self, secret_keys_by_index: Dict[int, int],
@@ -97,6 +106,22 @@ class LocalSigner(DutySigner):
         return self._sign(validator_index, sync_message_signing_root(
             cfg, state, slot, block_root))
 
+    def sign_sync_selection_proof(self, cfg, state, slot,
+                                  subcommittee_index,
+                                  validator_index) -> bytes:
+        from ..spec.altair.helpers import (
+            sync_selection_proof_signing_root)
+        return self._sign(validator_index,
+                          sync_selection_proof_signing_root(
+                              cfg, state, slot, subcommittee_index))
+
+    def sign_contribution_and_proof(self, cfg, state, msg) -> bytes:
+        from ..spec.altair.helpers import (
+            contribution_and_proof_signing_root)
+        return self._sign(msg.aggregator_index,
+                          contribution_and_proof_signing_root(
+                              cfg, state, msg))
+
 
 class SlashingProtectedSigner(DutySigner):
     """Wraps a signer; block + attestation signatures consult the
@@ -145,3 +170,11 @@ class SlashingProtectedSigner(DutySigner):
         # sync messages carry no slashing risk
         return self.inner.sign_sync_committee_message(
             cfg, state, slot, block_root, validator_index)
+
+    def sign_sync_selection_proof(self, cfg, state, slot,
+                                  subcommittee_index, validator_index):
+        return self.inner.sign_sync_selection_proof(
+            cfg, state, slot, subcommittee_index, validator_index)
+
+    def sign_contribution_and_proof(self, cfg, state, msg):
+        return self.inner.sign_contribution_and_proof(cfg, state, msg)
