@@ -65,6 +65,10 @@ class BeaconRestApi(RestApi):
         p("/eth/v2/beacon/blocks", self._publish_block_ssz)
         p("/eth/v1/validator/aggregate_and_proofs",
           self._submit_aggregate_ssz)
+        g("/eth/v1/validator/sync_committee_contribution",
+          self._sync_contribution)
+        p("/eth/v1/validator/contribution_and_proofs",
+          self._submit_contribution_ssz)
         g("/eth/v1/events", self._events)
         g("/eth/v1/beacon/light_client/bootstrap/{block_id}",
           self._lc_bootstrap)
@@ -299,6 +303,43 @@ class BeaconRestApi(RestApi):
             await self.validator_api.publish_signed_block(signed)
         else:
             self.node.block_manager.import_block(signed)
+        return {}
+
+    async def _sync_contribution(self, query=None):
+        """Produce a sync-committee contribution (reference
+        GetSyncCommitteeContribution) — SSZ response."""
+        if self.validator_api is None:
+            raise HttpError(503, "validator api not wired")
+        try:
+            slot = int((query or {})["slot"])
+            sub = int((query or {})["subcommittee_index"])
+            root = bytes.fromhex(
+                (query or {})["beacon_block_root"][2:])
+        except (KeyError, ValueError):
+            raise HttpError(
+                400, "slot, subcommittee_index, beacon_block_root "
+                     "required")
+        build = getattr(self.validator_api, "build_sync_contribution",
+                        None)
+        if build is None:
+            raise HttpError(503, "contributions not supported")
+        contribution = build(slot, root, sub)
+        if contribution is None:
+            raise HttpError(404, "no messages pooled for this root")
+        return type(contribution).serialize(contribution), \
+            "application/octet-stream"
+
+    async def _submit_contribution_ssz(self, raw_body=None):
+        if not raw_body:
+            raise HttpError(400, "SSZ SignedContributionAndProof "
+                                 "required")
+        signed = self._decode_versioned("SignedContributionAndProof",
+                                        raw_body)
+        publish = getattr(self.validator_api,
+                          "publish_contribution_and_proof", None)
+        if publish is None:
+            raise HttpError(503, "contributions not supported")
+        await publish(signed)
         return {}
 
     async def _submit_aggregate_ssz(self, raw_body=None):

@@ -162,6 +162,25 @@ class RemoteValidatorApi(ValidatorApiChannel):
         self._post("/eth/v1/validator/aggregate_and_proofs",
                    type(signed_aggregate).serialize(signed_aggregate))
 
+    def build_sync_contribution(self, slot: int, block_root: bytes,
+                                subcommittee_index: int):
+        try:
+            raw = self._get_bytes(
+                f"/eth/v1/validator/sync_committee_contribution"
+                f"?slot={slot}&subcommittee_index={subcommittee_index}"
+                f"&beacon_block_root=0x{block_root.hex()}")
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                return None
+            raise
+        S = build_fork_schedule(self.spec.config).version_at_slot(
+            slot).schemas
+        return S.SyncCommitteeContribution.deserialize(raw)
+
+    async def publish_contribution_and_proof(self, signed) -> None:
+        self._post("/eth/v1/validator/contribution_and_proofs",
+                   type(signed).serialize(signed))
+
     async def publish_sync_committee_message(self, msg) -> None:
         await self.publish_sync_committee_messages([msg])
 

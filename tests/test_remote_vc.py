@@ -62,6 +62,12 @@ def test_remote_vc_drives_chain_to_justification():
             assert node.chain.head_slot() \
                 >= epochs * spec.config.SLOTS_PER_EPOCH - 1
             assert node.store.justified_checkpoint.epoch >= 1
+            # the remote sync-aggregation duty used the REST
+            # contribution endpoints: contributions reached the pool
+            contrib_keys = [k for k in node.sync_pool._msgs
+                            if isinstance(k, tuple)
+                            and k and k[0] == "contrib"]
+            assert contrib_keys, "no remote contributions pooled"
         finally:
             await api.stop()
             await controller.stop()
