@@ -25,7 +25,8 @@ from .crypto import bls
 from .spec import helpers as H
 from .spec.config import DOMAIN_APPLICATION_MASK, SpecConfig
 from .spec.milestones import build_fork_schedule
-from .ssz import Bytes20, Bytes32, Bytes48, Bytes96, Container, uint64
+from .ssz import (Bytes20, Bytes32, Bytes48, Bytes96, Container, uint64,
+                  uint256)
 from .ssz.types import _ContainerMeta
 
 _LOG = logging.getLogger(__name__)
@@ -164,20 +165,60 @@ def unblind_block(cfg: SpecConfig, signed_blinded, payload):
 
 # ---- bids ----------------------------------------------------------------
 
+_BID_SCHEMA_CACHE: Dict = {}
+
+
+def _bid_container(cfg: SpecConfig, header_type, requests_type):
+    """The builder-spec SSZ BuilderBid for this header's fork: deneb+
+    headers (they carry blob_gas_used) add blob_kzg_commitments, and
+    electra bids (they carry an ExecutionRequests the builder must
+    reveal) add execution_requests between the commitments and the
+    value (builder-specs deneb/electra BuilderBid; reference
+    SchemaDefinitionsDeneb/Electra builder bid schemas)."""
+    key = (cfg, header_type, requests_type)
+    if key not in _BID_SCHEMA_CACHE:
+        fields = {"header": header_type}
+        if "blob_gas_used" in header_type._ssz_fields:
+            from .ssz.types import List
+            fields["blob_kzg_commitments"] = List(
+                Bytes48, cfg.MAX_BLOB_COMMITMENTS_PER_BLOCK)
+        if requests_type is not None:
+            fields["execution_requests"] = requests_type
+        fields["value"] = uint256
+        fields["pubkey"] = Bytes48
+        _BID_SCHEMA_CACHE[key] = _ContainerMeta(
+            "BuilderBid", (Container,), {"__annotations__": fields})
+    return _BID_SCHEMA_CACHE[key]
+
+
 @dataclass
 class BuilderBid:
     header: object          # the fork's ExecutionPayloadHeader
     value: int              # wei offered to the proposer
     pubkey: bytes           # builder's BLS key
     signature: bytes = b""
+    blob_kzg_commitments: tuple = ()   # deneb+: covered by the signature
+    # electra+: the fork's ExecutionRequests (deneb and electra share a
+    # header type, so the requests object — not header sniffing — is
+    # what selects the electra bid shape; producers at electra slots
+    # MUST set it, empty requests included)
+    execution_requests: object = None
+
+    def to_ssz(self, cfg: SpecConfig):
+        schema = _bid_container(
+            cfg, type(self.header),
+            None if self.execution_requests is None
+            else type(self.execution_requests))
+        kw = {"header": self.header, "value": self.value,
+              "pubkey": self.pubkey}
+        if "blob_kzg_commitments" in schema._ssz_fields:
+            kw["blob_kzg_commitments"] = tuple(self.blob_kzg_commitments)
+        if self.execution_requests is not None:
+            kw["execution_requests"] = self.execution_requests
+        return schema(**kw)
 
     def signing_root(self, cfg: SpecConfig) -> bytes:
-        # bid root over (header root, value, pubkey) under the builder
-        # domain — structural stand-in for the SSZ BuilderBid container
-        import hashlib
-        payload = (self.header.htr() + self.value.to_bytes(32, "little")
-                   + self.pubkey)
-        return H.compute_signing_root(hashlib.sha256(payload).digest(),
+        return H.compute_signing_root(self.to_ssz(cfg),
                                       builder_domain(cfg))
 
 

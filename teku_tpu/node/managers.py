@@ -111,7 +111,8 @@ class BlockManager:
         # BlobSidecarsAvailabilityChecker): a block whose commitments
         # lack proof-verified sidecars waits, an invalid set rejects
         commitments = getattr(block.body, "blob_kzg_commitments", ())
-        if commitments and self.blob_pool is not None:
+        if commitments and self.blob_pool is not None \
+                and self._within_da_window(block.slot):
             from .blobs import AvailabilityResult
             verdict = self.blob_pool.check_availability(
                 root, list(commitments))
@@ -148,6 +149,19 @@ class BlockManager:
             self._n_pending -= 1
             self.import_block(child)
         return True
+
+    def _within_da_window(self, slot: int) -> bool:
+        """Blob data-availability is only required inside the retention
+        window (spec is_data_available applies only within
+        MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS of current).  Peers prune
+        older sidecars, so gating historical blocks on availability
+        would wedge any sync from >window behind (reference
+        DataAvailabilityChecker's da-check horizon)."""
+        cfg = self.spec.config
+        block_epoch = slot // cfg.SLOTS_PER_EPOCH
+        current_epoch = self.chain.current_slot() // cfg.SLOTS_PER_EPOCH
+        return (block_epoch + cfg.MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS
+                >= current_epoch)
 
     def _enqueue(self, bucket: List, signed_block) -> None:
         if self._n_pending >= self._max_pending:

@@ -162,6 +162,31 @@ def process_effective_balance_updates(cfg: SpecConfig, state):
     return state
 
 
+def process_slashings(cfg: SpecConfig, state):
+    """EIP-7251 slashing penalty: quantise the correlation penalty to a
+    per-effective-balance-increment rate first, then scale by the
+    validator's increments.  Rounds differently from the altair formula
+    (eb//inc * adjusted // total * inc), so electra must not reuse it.
+
+    reference: ethereum/spec/.../logic/versions/electra/statetransition/
+    epoch/EpochProcessorElectra.java (processSlashings override).
+    """
+    epoch = H.get_current_epoch(cfg, state)
+    total = H.get_total_active_balance(cfg, state)
+    adjusted = min(
+        sum(state.slashings) * cfg.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX,
+        total)
+    inc = cfg.EFFECTIVE_BALANCE_INCREMENT
+    penalty_per_increment = adjusted // (total // inc)
+    balances = list(state.balances)
+    for i, v in enumerate(state.validators):
+        if (v.slashed and epoch + cfg.EPOCHS_PER_SLASHINGS_VECTOR // 2
+                == v.withdrawable_epoch):
+            penalty = penalty_per_increment * (v.effective_balance // inc)
+            balances[i] = max(0, balances[i] - penalty)
+    return state.copy_with(balances=tuple(balances))
+
+
 def process_epoch(cfg: SpecConfig, state):
     state = AE.process_justification_and_finalization(cfg, state)
     state = AE.process_inactivity_updates(cfg, state)
@@ -169,9 +194,7 @@ def process_epoch(cfg: SpecConfig, state):
         cfg, state,
         inactivity_quotient=cfg.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX)
     state = process_registry_updates(cfg, state)
-    state = AE.process_slashings(
-        cfg, state,
-        multiplier=cfg.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX)
+    state = process_slashings(cfg, state)
     state = E0.process_eth1_data_reset(cfg, state)
     state = process_pending_deposits(cfg, state)
     state = process_pending_consolidations(cfg, state)

@@ -233,3 +233,54 @@ def test_block_import_gated_on_blob_availability():
     # unparked: availability passed (the import itself then fails on
     # the junk payload, which is the transition's job, not the gate's)
     assert root not in bm._awaiting_blobs
+
+
+def test_da_gate_skipped_outside_retention_window():
+    """Blocks in epochs older than MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS
+    import without sidecars — peers prune wire sidecars, so gating
+    historical blocks would wedge any deep sync (spec is_data_available
+    horizon, epoch-granular like the reference's availability check)."""
+    import dataclasses
+    from teku_tpu.spec import config as C
+    from teku_tpu.spec import Spec
+    from teku_tpu.spec.genesis import interop_genesis
+    from teku_tpu.node.node import BeaconNode
+    from teku_tpu.node.gossip import InMemoryGossipNetwork
+
+    cfg = dataclasses.replace(C.MINIMAL, ALTAIR_FORK_EPOCH=0,
+                              BELLATRIX_FORK_EPOCH=0, CAPELLA_FORK_EPOCH=0,
+                              DENEB_FORK_EPOCH=0,
+                              MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS=2)
+    spec = Spec(cfg)
+    state, sks = interop_genesis(cfg, 16)
+    net = InMemoryGossipNetwork()
+    node = BeaconNode(spec, state, net.endpoint())
+    node.blob_pool._setup = SETUP
+    bm = node.block_manager
+
+    S = spec.at_slot(0).schemas
+    signed, _ = _wire_sidecars(cfg, [31])
+    block = signed.message.copy_with(parent_root=node.chain.head_root,
+                                     slot=0)
+    signed = S.SignedBeaconBlock(message=block,
+                                 signature=signed.signature)
+    root = block.htr()
+    # boundary epoch (epoch 0 + window >= current epoch): still gated
+    window_epochs = cfg.MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS
+    boundary = window_epochs * cfg.SLOTS_PER_EPOCH
+    node.chain.store.on_tick(state.genesis_time
+                             + boundary * cfg.SECONDS_PER_SLOT)
+    assert bm._within_da_window(0)
+    assert not bm.import_block(signed)
+    assert root in bm._awaiting_blobs        # parked on availability
+    bm._awaiting_blobs.pop(root)
+    bm._n_pending -= 1
+    # one epoch past the boundary: the gate is skipped entirely — the
+    # block reaches the transition (which rejects its junk payload)
+    # instead of parking for sidecars that no peer still serves
+    node.chain.store.on_tick(
+        state.genesis_time
+        + (boundary + cfg.SLOTS_PER_EPOCH) * cfg.SECONDS_PER_SLOT)
+    assert not bm._within_da_window(0)
+    assert not bm.import_block(signed)
+    assert root not in bm._awaiting_blobs    # not parked: gate skipped

@@ -121,3 +121,48 @@ def test_builder_flow_and_circuit_breaker():
         assert full == signed
 
     asyncio.run(run())
+
+
+def test_bid_signing_root_is_ssz_and_covers_blob_commitments():
+    """Builder-spec BuilderBid is an SSZ container; deneb+ bids bind
+    blob_kzg_commitments under the builder signature (builder-specs
+    deneb BuilderBid; reference SchemaDefinitionsDeneb builder bid)."""
+    deneb_cfg = dataclasses.replace(CFG, DENEB_FORK_EPOCH=0)
+    from teku_tpu.spec.deneb.datastructures import get_deneb_schemas
+    S = get_deneb_schemas(deneb_cfg)
+    header = S.ExecutionPayloadHeader()
+    commitment = b"\xc5" * 48
+    builder_sk = 777
+    bid = B.sign_bid(deneb_cfg, builder_sk, B.BuilderBid(
+        header=header, value=10 ** 18,
+        pubkey=bls.secret_to_public_key(builder_sk),
+        blob_kzg_commitments=(commitment,)))
+    ssz_bid = bid.to_ssz(deneb_cfg)
+    assert "blob_kzg_commitments" in type(ssz_bid)._ssz_fields
+    assert bls.verify(bid.pubkey, bid.signing_root(deneb_cfg),
+                      bid.signature)
+    # dropping / swapping a commitment changes the signing root
+    stripped = B.BuilderBid(header=header, value=bid.value,
+                            pubkey=bid.pubkey,
+                            blob_kzg_commitments=())
+    assert stripped.signing_root(deneb_cfg) != bid.signing_root(deneb_cfg)
+    # pre-deneb headers still sign the (header, value, pubkey) shape
+    signed, _ = _capella_signed_block()
+    cap_header = B._payload_to_header(
+        signed.message.body.execution_payload)
+    cap_bid = B.BuilderBid(header=cap_header, value=1, pubkey=b"\x01" * 48)
+    assert "blob_kzg_commitments" not in type(
+        cap_bid.to_ssz(CFG))._ssz_fields
+    # electra bids carry execution_requests under the signature
+    # (builder-specs electra BuilderBid; deneb and electra share the
+    # header type, so the requests object selects the shape)
+    electra_cfg = dataclasses.replace(deneb_cfg, ELECTRA_FORK_EPOCH=0)
+    from teku_tpu.spec.electra.datastructures import get_electra_schemas
+    SE = get_electra_schemas(electra_cfg)
+    el_bid = B.BuilderBid(header=header, value=1, pubkey=bid.pubkey,
+                          blob_kzg_commitments=(commitment,),
+                          execution_requests=SE.ExecutionRequests())
+    fields = list(type(el_bid.to_ssz(electra_cfg))._ssz_fields)
+    assert fields == ["header", "blob_kzg_commitments",
+                      "execution_requests", "value", "pubkey"]
+    assert el_bid.signing_root(electra_cfg) != bid.signing_root(deneb_cfg)

@@ -134,7 +134,7 @@ def test_commitment_inclusion_proof_roundtrip():
     cfg, state, sks = _deneb_state()
     S = get_deneb_schemas(cfg)
     depth = kzg_commitment_inclusion_proof_depth(cfg)
-    assert depth == 4 + 1 + 4  # minimal: 16-limit subtree + mix + body
+    assert depth == 5 + 1 + 4  # minimal: 32-limit subtree + mix + body
     commitments = tuple(bytes([i]) * 48 for i in range(3))
     body = S.BeaconBlockBody(blob_kzg_commitments=commitments)
     block = S.BeaconBlock(slot=5, proposer_index=1,

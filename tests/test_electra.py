@@ -388,3 +388,35 @@ def test_single_attestation_normalization():
     # nonzero data.index violates the wire rule
     bad2 = single.copy_with(data=data.copy_with(index=1))
     assert normalize_attestation(spec, adv, bad2) is None
+
+
+def test_electra_slashing_penalty_per_increment():
+    """EIP-7251 rounds per increment FIRST (adjusted // (total//inc)),
+    diverging from the altair formula whenever adjusted < total//inc
+    rounds to a different quantum."""
+    from teku_tpu.spec.altair import epoch as AE
+    cfg, state, _ = _electra_state(16)
+    epoch = H.get_current_epoch(cfg, state)
+    inc = cfg.EFFECTIVE_BALANCE_INCREMENT
+    target = epoch + cfg.EPOCHS_PER_SLASHINGS_VECTOR // 2
+    validators = list(state.validators)
+    validators[0] = validators[0].copy_with(slashed=True,
+                                            withdrawable_epoch=target)
+    slashings = list(state.slashings)
+    slashings[0] = 3 * inc   # small enough that rounding modes differ
+    state = state.copy_with(validators=tuple(validators),
+                            slashings=tuple(slashings))
+    total = H.get_total_active_balance(cfg, state)
+    adjusted = min(sum(state.slashings)
+                   * cfg.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX,
+                   total)
+    per_increment = adjusted // (total // inc)
+    eb = state.validators[0].effective_balance
+    expected = per_increment * (eb // inc)
+    out = XE.process_slashings(cfg, state)
+    assert state.balances[0] - out.balances[0] == expected
+    # and the altair formula would have charged a different amount
+    old = AE.process_slashings(
+        cfg, state,
+        multiplier=cfg.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX)
+    assert (state.balances[0] - old.balances[0]) != expected
