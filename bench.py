@@ -11,9 +11,16 @@ Prints ONE JSON line:
    "sigs/sec/chip", "vs_baseline": <value / 50_000>, "p50_ms": ...,
    ...detail...}
 
-Hardened bring-up (round 2 failed with rc=1 and no JSON at all):
-- device init is retried with backoff, then falls back to CPU so a JSON
-  line ALWAYS comes out (flagged via "device"/"fallback");
+Hardened bring-up (round 2: rc=1, no JSON; round 3: in-process
+jax.devices() probes hung ~25 min EACH before the fallback fired):
+- backend init is probed in a kill-able SUBPROCESS with a hard deadline
+  (BENCH_PROBE_TIMEOUT_S, default 60s); on timeout/failure the process
+  falls back to CPU immediately so a JSON line ALWAYS comes out
+  (flagged via "device"/"fallback");
+- a watchdog thread force-emits the JSON and exits if any armed phase
+  wedges inside the TPU runtime where signal handlers cannot run;
+- every phase transition appends to BENCH_HEARTBEAT.json and stderr so
+  even a SIGKILL leaves evidence of where time went;
 - every phase is fenced: a failure records an "error" field for that
   phase instead of crashing the process;
 - a wall-clock budget (BENCH_BUDGET_S) gates each extra compile.
@@ -29,7 +36,9 @@ benchmarks/BLSBenchmark.java:37-80 and ethereum/statetransition/src/jmh/
 import json
 import os
 import signal
+import subprocess
 import sys
+import threading
 import time
 import traceback
 
@@ -41,6 +50,9 @@ OUT = {
     "unit": "sigs/sec/chip",
     "vs_baseline": 0.0,
 }
+
+_HEARTBEAT_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_HEARTBEAT.json")
 
 _emitted = False
 
@@ -54,6 +66,26 @@ def _emit():
     sys.stdout.flush()
 
 
+def _beat(stage: str, **extra) -> None:
+    """Progress evidence that survives ANY exit: a heartbeat file beside
+    the repo root plus a stderr JSON line (stdout stays reserved for the
+    ONE result line the driver parses).  Round 3 lost 80 minutes of
+    wall clock with zero evidence of where; this makes every phase
+    transition observable post-mortem."""
+    beat = {"stage": stage, "t": round(time.time(), 1), **extra,
+            "out_so_far": {k: OUT[k] for k in
+                           ("value", "device", "fallback", "error")
+                           if k in OUT}}
+    line = json.dumps(beat)
+    try:
+        with open(_HEARTBEAT_PATH, "a") as fh:
+            fh.write(line + "\n")
+    except OSError:
+        pass
+    print(line, file=sys.stderr)
+    sys.stderr.flush()
+
+
 def _on_term(signum, frame):  # pragma: no cover - signal path
     """An external timeout (driver harness) must still get the JSON
     line: a TPU-side compile can block past any soft deadline, and
@@ -64,39 +96,127 @@ def _on_term(signum, frame):  # pragma: no cover - signal path
     os._exit(1)
 
 
-signal.signal(signal.SIGTERM, _on_term)
-signal.signal(signal.SIGINT, _on_term)
+class _Watchdog:
+    """A hung TPU runtime call blocks the main thread inside C, where
+    Python signal handlers cannot run — round 3's jax.devices() probes
+    hung ~25 minutes EACH.  This daemon thread force-emits the JSON and
+    exits the process when an armed phase overruns its deadline."""
+
+    def __init__(self):
+        self._deadline = None
+        self._label = ""
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def arm(self, seconds: float, label: str) -> None:
+        self._label = label
+        self._deadline = time.time() + seconds
+
+    def disarm(self) -> None:
+        self._deadline = None
+
+    def _run(self):  # pragma: no cover - failure path
+        while True:
+            time.sleep(1.0)
+            d = self._deadline
+            if d is not None and time.time() > d:
+                OUT["error"] = (f"watchdog: {self._label} exceeded "
+                                "deadline (backend hang)")
+                _beat("watchdog_fired", label=self._label)
+                _emit()
+                os._exit(1)
+
+
+# initialized by main(): importing this module (tests do) must not
+# install process-wide signal handlers or spawn the watchdog thread
+WD = None
+
+
+def _arm_process_guards() -> None:
+    global WD
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    if WD is None:
+        WD = _Watchdog()
+
+
+_PROBE_CODE = ("import jax, json, sys\n"
+               "d = jax.devices()[0]\n"
+               "print(json.dumps({'platform': d.platform, "
+               "'device': str(d)}))\n")
+
+
+def _probe_backend(timeout_s: float, code: str = _PROBE_CODE):
+    """Ask a SUBPROCESS what jax.devices() says, with a hard deadline.
+
+    The probe owns the hang risk: if the axon tunnel is wedged the child
+    is killed at timeout_s and this process never touches the TPU
+    runtime — round 3 lost 3 x ~25 min to in-process probes that could
+    not be interrupted.  Returns (platform, device_str) or (None, why)."""
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            start_new_session=True, text=True)
+    except OSError as exc:
+        return None, f"probe spawn failed: {exc}"
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except OSError:
+            proc.kill()
+        proc.wait()
+        return None, f"probe timeout after {timeout_s:.0f}s"
+    if proc.returncode != 0:
+        tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
+        return None, f"probe rc={proc.returncode}: {tail[0][:200]}"
+    try:
+        info = json.loads(out.strip().splitlines()[-1])
+        return info["platform"], info["device"]
+    except (ValueError, KeyError, IndexError):
+        return None, f"probe emitted garbage: {out[:120]!r}"
 
 
 def _init_device():
-    """Initialize a JAX backend, retrying the TPU tunnel with backoff and
-    falling back to CPU rather than dying (round 2's failure mode)."""
+    """Bring up a JAX backend without ever letting a wedged TPU tunnel
+    eat the budget: subprocess probe with a hard deadline first, CPU
+    fallback immediately on probe failure, watchdog on the in-process
+    init that follows a successful probe."""
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "60"))
+    t0 = time.time()
+    _beat("probe_start", timeout_s=probe_timeout)
+    platform, detail = _probe_backend(probe_timeout)
+    OUT["probe_s"] = round(time.time() - t0, 1)
+    if platform is None:
+        # fast-fail to CPU: the env var must be set BEFORE jax imports
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        OUT["fallback"] = f"tpu init failed: {detail}"
+        _beat("probe_failed", why=detail)
+    else:
+        _beat("probe_ok", platform=platform, device=detail)
+
+    # the probe proved (or disproved) the backend in a disposable
+    # process; the in-process init after a good probe should be quick,
+    # but the tunnel can still wedge between the two — watchdog it
+    WD.arm(max(probe_timeout * 2, 120), "in-process backend init")
     import jax
 
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
     # persistent compile cache: repeat bench invocations skip the
     # 20-40s-per-bucket XLA compiles (one definition, shared with the
     # driver entry hooks)
     from __graft_entry__ import _wire_compile_cache
     _wire_compile_cache()
-
-    last = None
-    for attempt in range(3):
-        try:
-            devs = jax.devices()
-            OUT["device"] = str(devs[0])
-            return jax
-        except Exception as exc:  # backend init failure
-            last = exc
-            time.sleep(15 * (attempt + 1))
-    # fall back to CPU so the harness still produces a number
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    try:
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
     devs = jax.devices()
+    WD.disarm()
     OUT["device"] = str(devs[0])
-    OUT["fallback"] = f"tpu init failed: {type(last).__name__}: {last}"
+    _beat("device_ready", device=OUT["device"])
     return jax
 
 
@@ -125,12 +245,20 @@ def _throughput_phase(jax, deadline, batches):
         try:
             args = ge._example_batch(n)
             stage_s = {}
+
+            def _on_stage(nm, s, _n=n, _st=stage_s):
+                _st[nm] = round(s, 1)
+                _beat("stage_done", batch=_n, stage_name=nm,
+                      s=round(s, 1))
+
+            # stage-by-stage warm/compile, watchdogged: each of the five
+            # staged programs must land within the phase's own margin
+            _beat("compile_start", batch=n)
+            WD.arm(max(remaining, need) + 120, f"compile batch {n}")
             t0 = time.time()
-            ok, lane_ok = kernel(
-                *args,
-                on_stage=lambda nm, s: stage_s.__setitem__(
-                    nm, round(s, 1)))
+            ok, lane_ok = kernel(*args, on_stage=_on_stage)
             ok = bool(np.asarray(ok))
+            WD.disarm()
             compile_s = time.time() - t0
             compiled_once = True
             entry = {"compile_s": round(compile_s, 1),
@@ -140,14 +268,19 @@ def _throughput_phase(jax, deadline, batches):
                 entry["error"] = "batch did not verify"
                 continue
             iters = max(1, min(30, int(200 / max(n / 64, 1))))
+            WD.arm(max(deadline - time.time(), 60) + 120,
+                   f"measure batch {n}")
             t0 = time.time()
             for _ in range(iters):
                 ok, lane_ok = kernel(*args)
             jax.block_until_ready((ok, lane_ok))
+            WD.disarm()
             dt = (time.time() - t0) / iters
             rate = n / dt
             entry["sigs_per_sec"] = round(rate, 1)
             entry["dispatch_ms"] = round(dt * 1e3, 2)
+            _beat("batch_measured", batch=n,
+                  sigs_per_sec=entry["sigs_per_sec"])
             if rate > best:
                 best, best_batch = rate, n
             # keep the headline current so even a SIGTERM mid-phase
@@ -230,6 +363,12 @@ def main():
     t_start = time.time()
     budget_s = float(os.environ.get("BENCH_BUDGET_S", "1500"))
     deadline = t_start + budget_s
+    _arm_process_guards()
+    try:
+        os.unlink(_HEARTBEAT_PATH)   # fresh evidence trail per run
+    except OSError:
+        pass
+    _beat("bench_start", budget_s=budget_s)
     # 256 first: it doubles as the latency phase's service bucket
     batches = [int(b) for b in
                os.environ.get("BENCH_BATCHES", "256,4096,64,1").split(",")]
@@ -246,10 +385,14 @@ def main():
         OUT["trace"] = traceback.format_exc(limit=3)
     if os.environ.get("BENCH_P50", "1") != "0" and time.time() < deadline:
         try:
+            _beat("latency_phase_start")
+            WD.arm(max(deadline - time.time(), 60) + 300, "latency phase")
             _latency_phase(jax, deadline)
+            WD.disarm()
         except Exception as exc:
             OUT["p50_error"] = f"{type(exc).__name__}: {exc}"
     OUT["total_s"] = round(time.time() - t_start, 1)
+    _beat("bench_done", total_s=OUT["total_s"])
     _emit()
 
 

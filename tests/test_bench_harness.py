@@ -1,0 +1,66 @@
+"""bench.py bring-up hardening: the probe must fail FAST and loudly.
+
+Round 3 post-mortem: three in-process jax.devices() probes hung ~25
+minutes each before the CPU fallback fired, eating the driver's whole
+budget with zero evidence.  The probe now runs in a kill-able
+subprocess with a hard deadline, and every phase transition appends to
+a heartbeat file (reference keeps its benchmarks honest the same way —
+JMH timeouts in eth-benchmark-tests/.../BLSBenchmark.java).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def test_probe_kills_hung_backend_within_deadline():
+    t0 = time.time()
+    platform, why = bench._probe_backend(
+        1.5, code="import time\ntime.sleep(600)\n")
+    elapsed = time.time() - t0
+    assert platform is None
+    assert "timeout" in why
+    assert elapsed < 30          # seconds, not round 3's 25 minutes
+
+
+def test_probe_reports_crash_and_garbage():
+    platform, why = bench._probe_backend(
+        30, code="import sys\nsys.exit(3)\n")
+    assert platform is None and "rc=3" in why
+    platform, why = bench._probe_backend(
+        30, code="print('not json')\n")
+    assert platform is None and "garbage" in why
+
+
+def test_probe_parses_healthy_backend():
+    code = ("import json\n"
+            "print(json.dumps({'platform': 'tpu', "
+            "'device': 'TPU_0(process=0,(0,0,0,0))'}))\n")
+    platform, device = bench._probe_backend(30, code=code)
+    assert platform == "tpu"
+    assert device.startswith("TPU_0")
+
+
+def test_heartbeat_file_records_stages(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "_HEARTBEAT_PATH",
+                        str(tmp_path / "hb.json"))
+    bench._beat("unit_stage", batch=7)
+    bench._beat("unit_stage_2")
+    lines = (tmp_path / "hb.json").read_text().strip().splitlines()
+    assert len(lines) == 2
+    first = json.loads(lines[0])
+    assert first["stage"] == "unit_stage" and first["batch"] == 7
+
+
+def test_watchdog_arm_disarm_bookkeeping():
+    wd = bench._Watchdog()
+    wd.arm(3600, "never fires in-test")
+    assert wd._deadline is not None and wd._label.startswith("never")
+    wd.disarm()
+    assert wd._deadline is None
