@@ -100,6 +100,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_char_p, ctypes.c_uint64,
             ctypes.POINTER(ctypes.c_uint64)]
         lib.teku_snappy_uncompressed_length.restype = ctypes.c_int
+        lib.teku_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.teku_crc32c.restype = ctypes.c_uint32
         _lib = lib
         _LOG.info("native library loaded (sha-ni=%s)",
                   bool(lib.teku_sha_uses_shani()))

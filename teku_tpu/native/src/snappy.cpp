@@ -189,3 +189,60 @@ uint64_t teku_snappy_uncompress(const uint8_t* in, uint64_t n, uint8_t* out,
 }
 
 }  // extern "C"
+
+// ---- CRC32C (Castagnoli) --------------------------------------------------
+// The snappy FRAMING format's chunk checksums (masked CRC32C) — needed
+// for the spec's ssz_snappy req/resp streams.  Hardware _mm_crc32 when
+// SSE4.2 is present, table fallback otherwise.
+
+#include <cpuid.h>
+
+extern "C" {
+
+static uint32_t crc32c_table[256];
+static bool crc32c_table_ready = false;
+
+static void crc32c_init_table() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+    crc32c_table[i] = c;
+  }
+  crc32c_table_ready = true;
+}
+
+static bool crc32c_have_sse42() {
+  unsigned eax, ebx, ecx, edx;
+  if (!__get_cpuid(1, &eax, &ebx, &ecx, &edx)) return false;
+  return (ecx >> 20) & 1;  // SSE4.2
+}
+
+__attribute__((target("sse4.2")))
+static uint32_t crc32c_hw(uint32_t crc, const uint8_t* data, uint64_t n) {
+  uint64_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    uint64_t v;
+    __builtin_memcpy(&v, data + i, 8);
+    crc = (uint32_t)__builtin_ia32_crc32di(crc, v);
+  }
+  for (; i < n; i++) crc = __builtin_ia32_crc32qi(crc, data[i]);
+  return crc;
+}
+
+static uint32_t crc32c_sw(uint32_t crc, const uint8_t* data, uint64_t n) {
+  if (!crc32c_table_ready) crc32c_init_table();
+  for (uint64_t i = 0; i < n; i++)
+    crc = crc32c_table[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return crc;
+}
+
+uint32_t teku_crc32c(const uint8_t* data, uint64_t n) {
+  static int use_hw = -1;
+  if (use_hw < 0) use_hw = crc32c_have_sse42() ? 1 : 0;
+  uint32_t crc = 0xFFFFFFFFu;
+  crc = use_hw ? crc32c_hw(crc, data, n) : crc32c_sw(crc, data, n);
+  return crc ^ 0xFFFFFFFFu;
+}
+
+}  // extern "C"

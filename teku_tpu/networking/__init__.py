@@ -29,6 +29,9 @@ class NetworkedNode:
         self.sync = SyncService(self.net, self.rpc, self.node)
 
         async def _on_connect(peer):
+            # gossipsub sends the full subscription set on connect so
+            # the peer can graft us into topic meshes
+            self.gossip.announce_subscriptions(peer)
             try:
                 await self.rpc.exchange_status(peer)
             except Exception:
@@ -37,10 +40,12 @@ class NetworkedNode:
 
     async def start(self) -> None:
         await self.net.start()
+        await self.gossip.start()
         await self.node.start()
 
     async def stop(self) -> None:
         await self.node.stop()
+        await self.gossip.stop()
         await self.net.stop()
 
     async def connect(self, other: "NetworkedNode"):

@@ -1,17 +1,28 @@
-"""Gossip router over the TCP transport: topics, dedupe, forwarding.
+"""Gossipsub router over the TCP transport: mesh, gossip, scoring.
 
-The gossipsub role (reference: networking/p2p libp2p gossip +
-networking/eth2/.../gossip/encoding/SszSnappyEncoding.java): messages
-are ssz_snappy-encoded, identified by sha256(topic || data), seen-cache
-suppressed, delivered to the local TopicHandler, and FORWARDED only on
-ACCEPT (gossipsub validation gating).  Mesh = all connected peers
-(flood-publish within the peer set; peer scoring trims misbehavers).
+The gossipsub v1.1 role (reference: networking/p2p/.../gossip/config/
+GossipConfig.java:51-163 for the parameter set — D=8, D_low=6,
+D_high=12, D_lazy=6, 700ms heartbeat, mcache 6 windows gossiping 3 —
+and networking/eth2/.../gossip/encoding/SszSnappyEncoding.java for the
+payload codec): each topic keeps a bounded MESH of peers receiving
+full messages eagerly; everyone else hears message IDs via IHAVE
+gossip and pulls what they miss with IWANT.  Egress per message is
+O(D), not O(peers) — the property flood-publish lacks.
+
+Message IDs follow the altair spec: SHA256(MESSAGE_DOMAIN_VALID_SNAPPY
+++ uint64_le(len(topic)) ++ topic ++ uncompressed_data)[:20].
+
+Control plane (SUBSCRIBE/GRAFT/PRUNE/IHAVE/IWANT) rides the same
+KIND_GOSSIP transport lane with a leading envelope byte; data messages
+are snappy block-compressed like the spec's gossip payloads.
 """
 
+import asyncio
 import hashlib
 import logging
+import random
 import struct
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..infra.collections import LimitedSet
 from ..native import snappyc
@@ -20,56 +31,276 @@ from .transport import KIND_GOSSIP, P2PNetwork, Peer
 
 _LOG = logging.getLogger(__name__)
 
+# reference GossipConfig.java defaults
+D = 8
+D_LOW = 6
+D_HIGH = 12
+D_LAZY = 6
+HEARTBEAT_S = 0.7
+MCACHE_LEN = 6           # history windows kept for IWANT serving
+MCACHE_GOSSIP = 3        # windows advertised via IHAVE
+MAX_IHAVE_PER_HEARTBEAT = 5000
+MAX_IWANT_PER_CONTROL = 500
+
+# mainnet does ~31k attestations/slot; the dedupe window must cover
+# several slots of them (round 3's 65k cache was ~2 slots deep)
+SEEN_CACHE_SIZE = 1 << 19
+
+MESSAGE_DOMAIN_VALID_SNAPPY = b"\x01\x00\x00\x00"
+
 REJECT_SCORE = -10
 IGNORE_SCORE = -1
+GRAFT_SCORE_FLOOR = -30   # gossipsub v1.1 score gate for mesh admission
+
+ENV_DATA = 0
+ENV_CONTROL = 1
+
+
+def spec_msg_id(topic: str, data: bytes) -> bytes:
+    """Altair gossip message-id over the UNCOMPRESSED payload."""
+    tb = topic.encode()
+    return hashlib.sha256(
+        MESSAGE_DOMAIN_VALID_SNAPPY
+        + struct.pack("<Q", len(tb)) + tb + data).digest()[:20]
+
+
+# -- control-message codec --------------------------------------------------
+#
+# [u16 n_subs][{u8 subscribed, u8 tlen, topic}...]
+# [u16 n_graft][{u8 tlen, topic}...]
+# [u16 n_prune][{u8 tlen, topic}...]
+# [u16 n_ihave][{u8 tlen, topic, u16 n_ids, 20B ids...}...]
+# [u16 n_iwant][20B ids...]
+
+def encode_control(subs: Sequence[Tuple[bool, str]] = (),
+                   graft: Sequence[str] = (),
+                   prune: Sequence[str] = (),
+                   ihave: Sequence[Tuple[str, Sequence[bytes]]] = (),
+                   iwant: Sequence[bytes] = ()) -> bytes:
+    out = [struct.pack("<H", len(subs))]
+    for on, topic in subs:
+        tb = topic.encode()
+        out.append(struct.pack("<BB", 1 if on else 0, len(tb)) + tb)
+    for topics in (graft, prune):
+        out.append(struct.pack("<H", len(topics)))
+        for topic in topics:
+            tb = topic.encode()
+            out.append(struct.pack("<B", len(tb)) + tb)
+    out.append(struct.pack("<H", len(ihave)))
+    for topic, mids in ihave:
+        tb = topic.encode()
+        out.append(struct.pack("<B", len(tb)) + tb
+                   + struct.pack("<H", len(mids)) + b"".join(mids))
+    out.append(struct.pack("<H", len(iwant)) + b"".join(iwant))
+    return bytes([ENV_CONTROL]) + b"".join(out)
+
+
+def decode_control(payload: bytes):
+    """payload WITHOUT the envelope byte → (subs, graft, prune, ihave,
+    iwant); raises on malformed input (caller punishes)."""
+    pos = 0
+
+    def take(n):
+        nonlocal pos
+        if pos + n > len(payload):
+            raise ValueError("truncated control")
+        chunk = payload[pos:pos + n]
+        pos += n
+        return chunk
+
+    def u16():
+        return struct.unpack("<H", take(2))[0]
+
+    def topic():
+        (tlen,) = take(1)
+        return take(tlen).decode()
+
+    subs = []
+    for _ in range(u16()):
+        (on,) = take(1)
+        subs.append((bool(on), topic()))
+    graft = [topic() for _ in range(u16())]
+    prune = [topic() for _ in range(u16())]
+    ihave = []
+    for _ in range(u16()):
+        t = topic()
+        n = u16()
+        ihave.append((t, [take(20) for _ in range(n)]))
+    iwant = [take(20) for _ in range(u16())]
+    return subs, graft, prune, ihave, iwant
+
+
+class MessageCache:
+    """Sliding history of recent full messages (gossipsub mcache):
+    IWANT is served from all MCACHE_LEN windows, IHAVE advertises the
+    newest MCACHE_GOSSIP.  Windows are indexed per topic so the 700ms
+    heartbeat's gossip_ids is O(ids in that topic), not O(topics x
+    total cache) — at mainnet attestation rates the flat scan would
+    stall the event loop."""
+
+    def __init__(self, history: int = MCACHE_LEN,
+                 gossip: int = MCACHE_GOSSIP):
+        # window = {topic: {mid: data}}; plus a flat mid index for get()
+        self._windows: List[Dict[str, Dict[bytes, bytes]]] = [
+            {} for _ in range(history)]
+        self._by_mid: List[Dict[bytes, Tuple[str, bytes]]] = [
+            {} for _ in range(history)]
+        self._gossip = gossip
+
+    def put(self, mid: bytes, topic: str, data: bytes) -> None:
+        self._windows[0].setdefault(topic, {})[mid] = data
+        self._by_mid[0][mid] = (topic, data)
+
+    def get(self, mid: bytes) -> Optional[Tuple[str, bytes]]:
+        for w in self._by_mid:
+            if mid in w:
+                return w[mid]
+        return None
+
+    def gossip_ids(self, topic: str) -> List[bytes]:
+        return [mid for w in self._windows[:self._gossip]
+                for mid in w.get(topic, ())]
+
+    def shift(self) -> None:
+        self._windows.insert(0, {})
+        self._windows.pop()
+        self._by_mid.insert(0, {})
+        self._by_mid.pop()
 
 
 class TcpGossipNetwork(GossipNetwork):
     """GossipNetwork implementation the BeaconNode subscribes through —
-    same interface as the in-memory devnet bus, real wire underneath."""
+    same interface as the in-memory devnet bus, gossipsub underneath."""
 
-    def __init__(self, net: P2PNetwork):
+    def __init__(self, net: P2PNetwork, rng: Optional[random.Random] = None):
         self.net = net
         self.net.on_gossip = self._on_gossip
+        self.net.on_peer_disconnected = self._on_peer_gone
         self._handlers: Dict[str, TopicHandler] = {}
-        self._seen: LimitedSet = LimitedSet(65536)
-        self._scores: Dict[bytes, int] = {}
+        self._seen: LimitedSet = LimitedSet(SEEN_CACHE_SIZE)
+        self._scores: Dict[bytes, float] = {}
+        self._peer_topics: Dict[bytes, Set[str]] = {}
+        self._mesh: Dict[str, Set[Peer]] = {}
+        self._mcache = MessageCache()
+        self._rng = rng or random.Random()
+        self._heartbeat_task: Optional[asyncio.Task] = None
+        # strong refs to in-flight control sends: asyncio holds tasks
+        # weakly, and a GC'd task mid-drain = a GRAFT that never left
+        self._control_tasks: set = set()
+        # per-peer ids already served via IWANT (gossipsub v1.1 bounds
+        # IWANT retries to stop bandwidth amplification)
+        self._iwant_served: Dict[bytes, LimitedSet] = {}
+        # observability (the O(D) egress assertion hangs off these)
         self.messages_forwarded = 0
+        self.data_frames_sent = 0
+        self.control_frames_sent = 0
+        self.iwant_served = 0
 
-    # -- GossipNetwork interface --------------------------------------
+    # -- lifecycle ------------------------------------------------------
+    async def start(self) -> None:
+        if self._heartbeat_task is None:
+            self._heartbeat_task = asyncio.create_task(
+                self._heartbeat_loop())
+
+    async def stop(self) -> None:
+        if self._heartbeat_task is not None:
+            self._heartbeat_task.cancel()
+            try:
+                await self._heartbeat_task
+            except asyncio.CancelledError:
+                pass
+            self._heartbeat_task = None
+
+    # -- GossipNetwork interface ---------------------------------------
     def subscribe(self, topic: str, handler: TopicHandler) -> None:
         self._handlers[topic] = handler
+        self._mesh.setdefault(topic, set())
+        # announce to whoever is already connected; mesh fills via
+        # heartbeat grafting (and peers grafting us)
+        frame = encode_control(subs=[(True, topic)])
+        for peer in list(self.net.peers):
+            self._send_control(peer, frame)
 
     async def publish(self, topic: str, data: bytes) -> None:
-        frame = self._encode(topic, data)
-        self._seen.add(self._msg_id(topic, data))
-        await self._fanout(frame, exclude=None)
+        mid = spec_msg_id(topic, data)
+        self._seen.add(mid)
+        self._mcache.put(mid, topic, data)
+        frame = self._encode_data(topic, data)
+        targets = self._eager_targets(topic)
+        await self._send_data(frame, targets, exclude=None)
 
-    async def _fanout(self, frame: bytes, exclude) -> None:
-        """Concurrent sends: one slow peer's TCP backpressure must not
-        head-of-line-block propagation to the others."""
-        import asyncio
-        sends = [peer.send_frame(KIND_GOSSIP, frame)
-                 for peer in list(self.net.peers) if peer is not exclude]
-        if sends:
-            await asyncio.gather(*sends, return_exceptions=True)
+    # -- peer bookkeeping ----------------------------------------------
+    def announce_subscriptions(self, peer: Peer) -> None:
+        """Tell a fresh peer which topics we're in (gossipsub sends the
+        full subscription set on connect)."""
+        if self._handlers:
+            self._send_control(peer, encode_control(
+                subs=[(True, t) for t in self._handlers]))
+
+    async def _on_peer_gone(self, peer: Peer) -> None:
+        self._peer_topics.pop(peer.node_id, None)
+        self._iwant_served.pop(peer.node_id, None)
+        for mesh in self._mesh.values():
+            mesh.discard(peer)
+
+    def _topic_peers(self, topic: str) -> List[Peer]:
+        return [p for p in self.net.peers
+                if topic in self._peer_topics.get(p.node_id, ())]
+
+    def _eager_targets(self, topic: str) -> List[Peer]:
+        """Mesh peers; if the mesh is empty (just subscribed, or we
+        publish without subscribing) fall back to D random topic peers
+        (gossipsub fanout), or — when nobody has announced the topic
+        yet — all peers, so bootstrap-sized devnets still propagate."""
+        mesh = [p for p in self._mesh.get(topic, ()) if p.connected]
+        if mesh:
+            return mesh
+        candidates = self._topic_peers(topic)
+        if not candidates:
+            candidates = list(self.net.peers)
+        self._rng.shuffle(candidates)
+        return candidates[:D]
 
     # -- wire ----------------------------------------------------------
     @staticmethod
-    def _encode(topic: str, data: bytes) -> bytes:
+    def _encode_data(topic: str, data: bytes) -> bytes:
         tb = topic.encode()
-        return (struct.pack("<B", len(tb)) + tb
+        return (bytes([ENV_DATA]) + struct.pack("<B", len(tb)) + tb
                 + snappyc.compress(data))
 
-    @staticmethod
-    def _msg_id(topic: str, data: bytes) -> bytes:
-        tb = topic.encode()
-        # length-prefix the topic so (topic, data) boundaries can't be
-        # shifted to forge a colliding id that poisons seen-caches
-        return hashlib.sha256(
-            len(tb).to_bytes(4, "little") + tb + data).digest()[:20]
+    async def _send_data(self, frame: bytes, targets: Sequence[Peer],
+                         exclude) -> None:
+        """Concurrent sends: one slow peer's TCP backpressure must not
+        head-of-line-block propagation to the others."""
+        sends = [peer.send_frame(KIND_GOSSIP, frame)
+                 for peer in targets
+                 if peer is not exclude and peer.connected]
+        self.data_frames_sent += len(sends)
+        if sends:
+            await asyncio.gather(*sends, return_exceptions=True)
 
+    def _send_control(self, peer: Peer, frame: bytes) -> None:
+        if not peer.connected:
+            return
+        self.control_frames_sent += 1
+        task = asyncio.ensure_future(peer.send_frame(KIND_GOSSIP, frame))
+        self._control_tasks.add(task)
+        task.add_done_callback(self._control_tasks.discard)
+
+    # -- inbound -------------------------------------------------------
     async def _on_gossip(self, peer: Peer, payload: bytes) -> None:
+        if not payload:
+            self._punish(peer, REJECT_SCORE)
+            return
+        kind = payload[0]
+        if kind == ENV_DATA:
+            await self._on_data(peer, payload[1:])
+        elif kind == ENV_CONTROL:
+            await self._on_control(peer, payload[1:])
+        else:
+            self._punish(peer, REJECT_SCORE)
+
+    async def _on_data(self, peer: Peer, payload: bytes) -> None:
         try:
             tlen = payload[0]
             topic = payload[1:1 + tlen].decode()
@@ -77,7 +308,7 @@ class TcpGossipNetwork(GossipNetwork):
         except Exception:
             self._punish(peer, REJECT_SCORE)
             return
-        mid = self._msg_id(topic, data)
+        mid = spec_msg_id(topic, data)
         if not self._seen.add(mid):
             return                      # duplicate
         handler = self._handlers.get(topic)
@@ -85,16 +316,125 @@ class TcpGossipNetwork(GossipNetwork):
             return
         result = await handler.handle_message(data)
         if result is ValidationResult.ACCEPT:
-            # forward to everyone but the sender (gossipsub propagation
-            # only after validation)
+            # eager-push into the mesh only after validation (gossipsub
+            # propagation gating); everyone else learns the id via the
+            # next heartbeat's IHAVE
             self.messages_forwarded += 1
-            await self._fanout(self._encode(topic, data), exclude=peer)
+            self._mcache.put(mid, topic, data)
+            await self._send_data(self._encode_data(topic, data),
+                                  self._eager_targets(topic),
+                                  exclude=peer)
         elif result is ValidationResult.REJECT:
             self._punish(peer, REJECT_SCORE)
         elif result is ValidationResult.IGNORE:
             self._punish(peer, IGNORE_SCORE)
 
-    def _punish(self, peer: Peer, delta: int) -> None:
+    async def _on_control(self, peer: Peer, payload: bytes) -> None:
+        try:
+            subs, graft, prune, ihave, iwant = decode_control(payload)
+        except ValueError:
+            self._punish(peer, REJECT_SCORE)
+            return
+        topics = self._peer_topics.setdefault(peer.node_id, set())
+        for on, topic in subs:
+            (topics.add if on else topics.discard)(topic)
+            if not on and topic in self._mesh:
+                self._mesh[topic].discard(peer)
+        prune_back = []
+        for topic in graft:
+            # mesh admission: must be subscribed ourselves and the
+            # peer's score above the gate (gossipsub v1.1)
+            if (topic in self._handlers
+                    and self._scores.get(peer.node_id, 0)
+                    > GRAFT_SCORE_FLOOR):
+                self._mesh.setdefault(topic, set()).add(peer)
+            else:
+                prune_back.append(topic)
+        for topic in prune:
+            if topic in self._mesh:
+                self._mesh[topic].discard(peer)
+        if prune_back:
+            self._send_control(peer, encode_control(prune=prune_back))
+        # IHAVE → IWANT for ids we miss
+        want = []
+        for topic, mids in ihave:
+            if topic not in self._handlers:
+                continue
+            for mid in mids:
+                if mid not in self._seen and len(want) < \
+                        MAX_IWANT_PER_CONTROL:
+                    want.append(mid)
+        if want:
+            self._send_control(peer, encode_control(iwant=want))
+        # IWANT → serve full messages from the cache, once per peer per
+        # id: repeat IWANTs are a bandwidth-amplification lever (spend
+        # 20 bytes, receive a full block), so re-asks cost score instead
+        served = 0
+        already = self._iwant_served.setdefault(peer.node_id,
+                                                LimitedSet(4096))
+        for mid in iwant[:MAX_IWANT_PER_CONTROL]:
+            if not already.add(mid):
+                self._punish(peer, IGNORE_SCORE)
+                continue
+            entry = self._mcache.get(mid)
+            if entry is not None:
+                topic, data = entry
+                await self._send_data(self._encode_data(topic, data),
+                                      [peer], exclude=None)
+                served += 1
+        self.iwant_served += served
+
+    # -- heartbeat ------------------------------------------------------
+    async def _heartbeat_loop(self) -> None:
+        while True:
+            await asyncio.sleep(HEARTBEAT_S)
+            try:
+                self.heartbeat()
+            except Exception:
+                _LOG.exception("gossip heartbeat failed")
+
+    def heartbeat(self) -> None:
+        """One mesh-maintenance pass (callable directly from tests —
+        deterministic, no awaits: control sends are fire-and-forget)."""
+        for topic in self._handlers:
+            mesh = self._mesh.setdefault(topic, set())
+            for p in [p for p in mesh if not p.connected]:
+                mesh.discard(p)
+            if len(mesh) < D_LOW:
+                candidates = [
+                    p for p in self._topic_peers(topic)
+                    if p not in mesh
+                    and self._scores.get(p.node_id, 0) > GRAFT_SCORE_FLOOR]
+                self._rng.shuffle(candidates)
+                for p in candidates[:D - len(mesh)]:
+                    mesh.add(p)
+                    self._send_control(p, encode_control(graft=[topic]))
+            elif len(mesh) > D_HIGH:
+                excess = self._rng.sample(sorted(mesh, key=id),
+                                          len(mesh) - D)
+                for p in excess:
+                    mesh.discard(p)
+                    self._send_control(p, encode_control(prune=[topic]))
+            # gossip: IHAVE recent ids to D_lazy non-mesh topic peers
+            mids = self._mcache.gossip_ids(topic)[
+                :MAX_IHAVE_PER_HEARTBEAT]
+            if mids:
+                lazy = [p for p in self._topic_peers(topic)
+                        if p not in mesh]
+                self._rng.shuffle(lazy)
+                for p in lazy[:D_LAZY]:
+                    self._send_control(
+                        p, encode_control(ihave=[(topic, mids)]))
+        self._mcache.shift()
+        # score decay toward zero (gossipsub counters decay each
+        # heartbeat so old sins are forgiven)
+        for nid in list(self._scores):
+            self._scores[nid] *= 0.9
+            if abs(self._scores[nid]) < 0.1:
+                del self._scores[nid]
+
+    # -- scoring --------------------------------------------------------
+    def _punish(self, peer: Peer, delta: float) -> None:
         score = self._scores.get(peer.node_id, 0) + delta
         self._scores[peer.node_id] = score
         if score <= -100:

@@ -1,22 +1,25 @@
 """Req/resp RPC: status, ping/metadata, blocks by range/root.
 
-The reference's beacon-chain RPC methods over length-prefixed
-snappy-framed SSZ (reference: networking/eth2/src/main/java/tech/
-pegasys/teku/networking/eth2/rpc/beaconchain/methods/ — Status,
-Goodbye, Ping, Metadata, BeaconBlocksByRange/RootMessageHandler;
-framing per rpc/core/encodings/).  Responses here are one frame
-carrying [u8 ok][count:u32][u32-len-prefixed ssz_snappy chunks].
+The reference's beacon-chain RPC methods over spec ssz_snappy streams
+(reference: networking/eth2/src/main/java/tech/pegasys/teku/networking/
+eth2/rpc/beaconchain/methods/ — Status, Goodbye, Ping, Metadata,
+BeaconBlocksByRange/RootMessageHandler; framing per
+rpc/core/encodings/).  Every request body and response chunk follows
+the spec byte shapes — uvarint length prefix + snappy FRAMING-format
+stream, responses as [result byte || payload] chunks — validated down
+to chunk checksums (encoding.py).  The transport multiplexes whole
+messages where libp2p uses streams; the payload bytes are identical.
 """
 
 import logging
 import struct
 from typing import List, Optional, Sequence
 
-from ..native import snappyc
 from ..spec import helpers as H
 from ..spec.codec import (deserialize_signed_block,
                           serialize_signed_block)
 from ..spec.datastructures import MetadataMessage, Ping, Status
+from . import encoding as E
 from .transport import P2PNetwork, Peer
 
 _LOG = logging.getLogger(__name__)
@@ -36,41 +39,32 @@ MAX_RESPONSE_BYTES = (1 << 24) - 4096     # fits one transport frame
 
 
 def _pack_chunks(chunks: Sequence[bytes], ok: bool = True) -> bytes:
-    """Truncates (never splits) at the frame budget: a shorter valid
-    response lets the requester re-request the rest, an oversized frame
-    would get the whole connection torn down."""
+    """Spec response body: concatenated [result || uvarint || framed]
+    chunks.  Truncates (never splits) at the frame budget: a shorter
+    valid response lets the requester re-request the rest, an oversized
+    frame would get the whole connection torn down."""
+    if not ok:
+        return E.encode_response_chunk(b"server error",
+                                       result=E.RESULT_SERVER_ERROR)
     body = []
     total = 0
-    n = 0
     for c in chunks:
-        comp = snappyc.compress(c)
-        if total + len(comp) + 4 > MAX_RESPONSE_BYTES:
+        enc = E.encode_response_chunk(c)
+        if total + len(enc) > MAX_RESPONSE_BYTES:
             break
-        body.append(struct.pack("<I", len(comp)))
-        body.append(comp)
-        total += len(comp) + 4
-        n += 1
-    return struct.pack("<BI", 1 if ok else 0, n) + b"".join(body)
+        body.append(enc)
+        total += len(enc)
+    return b"".join(body)       # zero chunks = valid empty response
 
 
 def _unpack_chunks(data: bytes) -> Optional[List[bytes]]:
-    if len(data) < 5:
+    try:
+        parsed = E.decode_response(data)
+    except E.EncodingError:
         return None
-    ok, count = struct.unpack("<BI", data[:5])
-    if not ok or count > 4096:
+    if any(result != E.RESULT_SUCCESS for result, _ in parsed):
         return None
-    pos = 5
-    chunks = []
-    for _ in range(count):
-        if pos + 4 > len(data):
-            return None
-        (n,) = struct.unpack("<I", data[pos:pos + 4])
-        pos += 4
-        if pos + n > len(data):
-            return None
-        chunks.append(snappyc.uncompress(data[pos:pos + n]))
-        pos += n
-    return chunks
+    return [ssz for _, ssz in parsed]
 
 
 class BeaconRpc:
@@ -103,7 +97,7 @@ class BeaconRpc:
     async def _handle(self, peer: Peer, method: str, body: bytes) -> bytes:
         try:
             if method == STATUS:
-                peer.status = Status.deserialize(snappyc.uncompress(body))
+                peer.status = Status.deserialize(E.decode_payload(body)[0])
                 return _pack_chunks(
                     [Status.serialize(self._local_status())])
             if method == PING:
@@ -114,26 +108,26 @@ class BeaconRpc:
                     MetadataMessage(seq_number=self.seq_number))])
             if method == BLOCKS_BY_RANGE:
                 start, count = struct.unpack(
-                    "<QQ", snappyc.uncompress(body))
+                    "<QQ", E.decode_payload(body)[0])
                 count = min(count, MAX_REQUEST_BLOCKS)
                 return _pack_chunks(
                     [serialize_signed_block(s)
                      for s in self._canonical_signed_in_range(start, count)])
             if method == BLOCKS_BY_ROOT:
-                roots_blob = snappyc.uncompress(body)
+                roots_blob = E.decode_payload(body)[0]
                 roots = [roots_blob[i:i + 32]
                          for i in range(0, min(len(roots_blob),
                                                32 * MAX_REQUEST_BLOCKS), 32)]
                 return _pack_chunks(self._blocks_by_root(roots))
             if method == BLOB_SIDECARS_BY_RANGE:
                 start, count = struct.unpack(
-                    "<QQ", snappyc.uncompress(body))
+                    "<QQ", E.decode_payload(body)[0])
                 cfg = self.node.spec.config
                 count = min(count, cfg.MAX_REQUEST_BLOCKS_DENEB)
                 return _pack_chunks(
                     self._blob_sidecars_by_range(start, count))
             if method == BLOB_SIDECARS_BY_ROOT:
-                ids_blob = snappyc.uncompress(body)
+                ids_blob = E.decode_payload(body)[0]
                 cap = self.node.spec.config.MAX_REQUEST_BLOB_SIDECARS
                 ids = [(ids_blob[i:i + 32],
                         int.from_bytes(ids_blob[i + 32:i + 40], "little"))
@@ -209,7 +203,7 @@ class BeaconRpc:
     async def exchange_status(self, peer: Peer) -> Optional[Status]:
         resp = await peer.request(
             STATUS,
-            snappyc.compress(Status.serialize(self._local_status())))
+            E.encode_payload(Status.serialize(self._local_status())))
         chunks = _unpack_chunks(resp)
         if not chunks:
             return None
@@ -220,7 +214,7 @@ class BeaconRpc:
                               count: int) -> List:
         resp = await peer.request(
             BLOCKS_BY_RANGE,
-            snappyc.compress(struct.pack("<QQ", start, count)),
+            E.encode_payload(struct.pack("<QQ", start, count)),
             timeout=30.0)
         chunks = _unpack_chunks(resp)
         if chunks is None:
@@ -231,7 +225,7 @@ class BeaconRpc:
     async def blocks_by_root(self, peer: Peer, roots: Sequence[bytes]
                              ) -> List:
         resp = await peer.request(
-            BLOCKS_BY_ROOT, snappyc.compress(b"".join(roots)),
+            BLOCKS_BY_ROOT, E.encode_payload(b"".join(roots)),
             timeout=30.0)
         chunks = _unpack_chunks(resp)
         if chunks is None:
@@ -247,7 +241,7 @@ class BeaconRpc:
                                      count: int) -> List:
         resp = await peer.request(
             BLOB_SIDECARS_BY_RANGE,
-            snappyc.compress(struct.pack("<QQ", start, count)),
+            E.encode_payload(struct.pack("<QQ", start, count)),
             timeout=30.0)
         chunks = _unpack_chunks(resp)
         if chunks is None:
@@ -260,7 +254,7 @@ class BeaconRpc:
         body = b"".join(root + index.to_bytes(8, "little")
                         for root, index in ids)
         resp = await peer.request(BLOB_SIDECARS_BY_ROOT,
-                                  snappyc.compress(body), timeout=30.0)
+                                  E.encode_payload(body), timeout=30.0)
         chunks = _unpack_chunks(resp)
         if chunks is None:
             return []

@@ -40,13 +40,19 @@ class Peer:
         self._req_id = 0
         self._pending: Dict[int, asyncio.Future] = {}
         self.connected = True
+        # egress accounting per wire lane (kind): the gossipsub O(D)
+        # bandwidth property is asserted against these
+        self.bytes_out: Dict[int, int] = {}
 
     async def send_frame(self, kind: int, payload: bytes) -> None:
         if not self.connected:
             return
         try:
-            self.writer.write(struct.pack("<IB", len(payload) + 1, kind)
-                              + payload)
+            frame = (struct.pack("<IB", len(payload) + 1, kind)
+                     + payload)
+            self.bytes_out[kind] = (self.bytes_out.get(kind, 0)
+                                    + len(frame))
+            self.writer.write(frame)
             await self.writer.drain()
         except (ConnectionError, OSError):
             self.connected = False
@@ -112,6 +118,8 @@ class P2PNetwork:
                                            Awaitable[bytes]]] = None
         self.on_peer_connected: Optional[Callable[[Peer],
                                                   Awaitable[None]]] = None
+        self.on_peer_disconnected: Optional[
+            Callable[[Peer], Awaitable[None]]] = None
 
     # -- lifecycle -----------------------------------------------------
     async def start(self) -> None:
@@ -206,3 +214,8 @@ class P2PNetwork:
         peer.close()
         if peer in self.peers:
             self.peers.remove(peer)
+        if self.on_peer_disconnected is not None:
+            try:
+                await self.on_peer_disconnected(peer)
+            except Exception:
+                _LOG.exception("peer-disconnect hook failed")

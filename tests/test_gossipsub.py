@@ -1,0 +1,349 @@
+"""Gossipsub mesh behavior: control codec, graft/prune bounds, IHAVE/
+IWANT recovery, O(D) egress — the properties flood-publish lacks.
+
+reference: networking/p2p/.../gossip/config/GossipConfig.java:51-163
+(D/D_low/D_high/D_lazy/heartbeat/mcache parameters).
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from teku_tpu.networking import gossip as G
+from teku_tpu.node.gossip import TopicHandler, ValidationResult
+
+
+class _AcceptHandler(TopicHandler):
+    def __init__(self):
+        self.received = []
+
+    async def handle_message(self, data: bytes) -> ValidationResult:
+        self.received.append(data)
+        return ValidationResult.ACCEPT
+
+
+class _FakePeer:
+    """Transport-free peer: records every gossip frame sent to it."""
+
+    def __init__(self, nid: int):
+        self.node_id = bytes([nid]) * 32
+        self.connected = True
+        self.frames = []
+        self.bytes_out = {}
+
+    async def send_frame(self, kind: int, payload: bytes) -> None:
+        self.frames.append((kind, payload))
+
+    def close(self):
+        self.connected = False
+
+
+class _FakeNet:
+    def __init__(self, n_peers: int):
+        self.peers = [_FakePeer(i + 1) for i in range(n_peers)]
+        self.on_gossip = None
+        self.on_peer_disconnected = None
+
+
+def _router(n_peers: int, topic="beacon_block", subscribe_peers=True):
+    net = _FakeNet(n_peers)
+    router = G.TcpGossipNetwork(net, rng=random.Random(42))
+    handler = _AcceptHandler()
+    router.subscribe(topic, handler)
+    if subscribe_peers:
+        for p in net.peers:
+            router._peer_topics[p.node_id] = {topic}
+    return net, router, handler
+
+
+def _data_frames(peer):
+    return [f for _, f in peer.frames if f and f[0] == G.ENV_DATA]
+
+
+def _control_frames(peer):
+    return [f for _, f in peer.frames if f and f[0] == G.ENV_CONTROL]
+
+
+def _decoded_controls(peer):
+    return [G.decode_control(f[1:]) for f in _control_frames(peer)]
+
+
+def _got_graft(peer, topic):
+    return any(topic in graft
+               for _, graft, _, _, _ in _decoded_controls(peer))
+
+
+def _got_ihave(peer):
+    return [ih for _, _, _, ihave, _ in _decoded_controls(peer)
+            for ih in ihave]
+
+
+def test_control_codec_roundtrip():
+    frame = G.encode_control(
+        subs=[(True, "a"), (False, "bb")], graft=["topic_x"],
+        prune=["topic_y", "z"],
+        ihave=[("t", [b"\x01" * 20, b"\x02" * 20])],
+        iwant=[b"\x03" * 20])
+    assert frame[0] == G.ENV_CONTROL
+    subs, graft, prune, ihave, iwant = G.decode_control(frame[1:])
+    assert subs == [(True, "a"), (False, "bb")]
+    assert graft == ["topic_x"] and prune == ["topic_y", "z"]
+    assert ihave == [("t", [b"\x01" * 20, b"\x02" * 20])]
+    assert iwant == [b"\x03" * 20]
+    with pytest.raises(ValueError):
+        G.decode_control(frame[1:-3])    # truncated
+
+
+def test_spec_message_id_altair_shape():
+    import hashlib
+    import struct
+    topic = "/eth2/abcd1234/beacon_block/ssz_snappy"
+    data = b"payload"
+    tb = topic.encode()
+    expected = hashlib.sha256(
+        b"\x01\x00\x00\x00" + struct.pack("<Q", len(tb)) + tb
+        + data).digest()[:20]
+    assert G.spec_msg_id(topic, data) == expected
+
+
+def test_heartbeat_grafts_to_d_and_bounds_at_d_high():
+    async def run():
+        net, router, _ = _router(20)
+        router.heartbeat()
+        mesh = router._mesh["beacon_block"]
+        assert len(mesh) == G.D             # grafted up from empty
+        await asyncio.sleep(0)              # flush control sends
+        grafted = [p for p in net.peers if _got_graft(p, "beacon_block")]
+        assert len(grafted) == G.D
+        # overstuffed mesh prunes down to D
+        mesh.clear()
+        mesh.update(net.peers[:G.D_HIGH + 3])
+        router.heartbeat()
+        assert len(mesh) == G.D
+    asyncio.run(run())
+
+
+def test_publish_egress_is_mesh_not_flood():
+    async def run():
+        net, router, _ = _router(20)
+        router.heartbeat()                  # fill the mesh
+        await router.publish("beacon_block", b"block-bytes")
+        receivers = [p for p in net.peers if _data_frames(p)]
+        # O(D), not O(peers): 20 connected, only the mesh gets data
+        assert len(receivers) == G.D
+        assert router.data_frames_sent == G.D
+    asyncio.run(run())
+
+
+def test_publish_falls_back_to_fanout_without_mesh():
+    async def run():
+        net, router, _ = _router(20)
+        # no heartbeat yet → mesh empty → fanout to D topic peers
+        await router.publish("beacon_block", b"x")
+        receivers = [p for p in net.peers if _data_frames(p)]
+        assert len(receivers) == G.D
+    asyncio.run(run())
+
+
+def test_forward_only_after_accept_and_mesh_only():
+    async def run():
+        net, router, handler = _router(20)
+        router.heartbeat()
+        sender = next(iter(router._mesh["beacon_block"]))
+        frame = router._encode_data("beacon_block", b"msg")
+        await router._on_gossip(sender, frame)
+        assert handler.received == [b"msg"]
+        # forwarded into the mesh minus the sender
+        receivers = [p for p in net.peers if _data_frames(p)]
+        assert sender not in receivers
+        assert len(receivers) == G.D - 1
+        # duplicate suppressed: no re-forward, handler not re-invoked
+        before = router.data_frames_sent
+        await router._on_gossip(sender, frame)
+        assert handler.received == [b"msg"]
+        assert router.data_frames_sent == before
+    asyncio.run(run())
+
+
+def test_heartbeat_emits_ihave_to_lazy_peers():
+    async def run():
+        net, router, _ = _router(20)
+        router.heartbeat()
+        for p in net.peers:
+            p.frames.clear()
+        await router.publish("beacon_block", b"recent-message")
+        router.heartbeat()
+        await asyncio.sleep(0)
+        mesh = router._mesh["beacon_block"]
+        lazy = [p for p in net.peers if p not in mesh and _got_ihave(p)]
+        assert 0 < len(lazy) <= G.D_LAZY
+        assert not any(_got_ihave(p) for p in mesh)
+        # the IHAVE advertises the published message id
+        mid = G.spec_msg_id("beacon_block", b"recent-message")
+        assert any(mid in mids for _, mids in _got_ihave(lazy[0]))
+    asyncio.run(run())
+
+
+def test_ihave_triggers_iwant_and_serves_from_mcache():
+    async def run():
+        net, router, handler = _router(4)
+        peer = net.peers[0]
+        mid = G.spec_msg_id("beacon_block", b"missing-data")
+        # peer advertises a message we don't have → we IWANT it
+        await router._on_gossip(peer, G.encode_control(
+            ihave=[("beacon_block", [mid])]))
+        await asyncio.sleep(0)
+        ctl = _control_frames(peer)
+        assert ctl, "no IWANT sent"
+        _, _, _, _, iwant = G.decode_control(ctl[-1][1:])
+        assert iwant == [mid]
+        # now the reverse: we HAVE a message, peer IWANTs it
+        await router.publish("beacon_block", b"cached-data")
+        cached_mid = G.spec_msg_id("beacon_block", b"cached-data")
+        peer.frames.clear()
+        await router._on_gossip(peer, G.encode_control(
+            iwant=[cached_mid]))
+        data = _data_frames(peer)
+        assert len(data) == 1
+        assert router.iwant_served == 1
+    asyncio.run(run())
+
+
+def test_unsubscribed_graft_gets_pruned_back():
+    async def run():
+        net, router, _ = _router(3)
+        peer = net.peers[0]
+        await router._on_gossip(peer, G.encode_control(
+            graft=["unknown_topic"]))
+        await asyncio.sleep(0)
+        _, _, prune, _, _ = G.decode_control(
+            _control_frames(peer)[-1][1:])
+        assert prune == ["unknown_topic"]
+        assert peer not in router._mesh.get("unknown_topic", set())
+    asyncio.run(run())
+
+
+def test_low_score_peer_refused_mesh_admission():
+    async def run():
+        net, router, _ = _router(3)
+        peer = net.peers[0]
+        router._scores[peer.node_id] = G.GRAFT_SCORE_FLOOR - 1
+        await router._on_gossip(peer, G.encode_control(
+            graft=["beacon_block"]))
+        assert peer not in router._mesh["beacon_block"]
+        # heartbeat grafting also skips it
+        router.heartbeat()
+        assert peer not in router._mesh["beacon_block"]
+    asyncio.run(run())
+
+
+def test_disconnect_cleans_mesh_and_scores_decay():
+    async def run():
+        net, router, _ = _router(10)
+        router.heartbeat()
+        gone = next(iter(router._mesh["beacon_block"]))
+        await router._on_peer_gone(gone)
+        assert gone not in router._mesh["beacon_block"]
+        assert gone.node_id not in router._peer_topics
+        router._scores[b"\x09" * 32] = -50.0
+        for _ in range(80):
+            router.heartbeat()
+        assert b"\x09" * 32 not in router._scores   # decayed away
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_sixteen_node_tcp_propagation_o_of_d_egress():
+    """16 real-TCP routers, full peer graph: a published message
+    reaches everyone (mesh push + IHAVE/IWANT recovery) while the
+    publisher's gossip egress stays O(D), not O(peers)."""
+    from teku_tpu.networking.transport import NetworkConfig, P2PNetwork
+
+    N = 16
+    TOPIC = "bench_topic"
+
+    async def run():
+        nets, routers, handlers = [], [], []
+        for i in range(N):
+            net = P2PNetwork(NetworkConfig(port=0), b"\x11\x22\x33\x44")
+            router = G.TcpGossipNetwork(net, rng=random.Random(i))
+            handler = _AcceptHandler()
+            router.subscribe(TOPIC, handler)
+            await net.start()
+            nets.append(net)
+            routers.append(router)
+            handlers.append(handler)
+        # announce-on-connect, as NetworkedNode wires it
+        for net, router in zip(nets, routers):
+            async def _hook(peer, _r=router):
+                _r.announce_subscriptions(peer)
+            net.on_peer_connected = _hook
+        try:
+            # full graph: every pair connected (worst case for flood)
+            for i in range(N):
+                for j in range(i + 1, N):
+                    await nets[i].connect("127.0.0.1", nets[j].port)
+            await asyncio.sleep(0.1)        # subscriptions propagate
+            for router in routers:
+                router.heartbeat()          # meshes form
+            await asyncio.sleep(0.1)
+            payload = b"\xab" * 2048
+            await routers[0].publish(TOPIC, payload)
+            # eager push floods the overlapping meshes quickly; run
+            # heartbeats until IHAVE/IWANT patches any remaining gaps
+            for _ in range(10):
+                await asyncio.sleep(0.05)
+                for router in routers:
+                    router.heartbeat()
+                if all(h.received for h in handlers[1:]):
+                    break
+            await asyncio.sleep(0.2)
+            # every node except the publisher (no local loopback — same
+            # semantics as the in-memory devnet bus) got the message
+            got = sum(1 for h in handlers[1:] if h.received)
+            assert got == N - 1, f"only {got}/{N - 1} received"
+            # the publisher pushed data to its mesh only: O(D) frames,
+            # where flood would have been N-1=15 with D=8
+            assert routers[0].data_frames_sent <= G.D_HIGH
+            from teku_tpu.networking.transport import KIND_GOSSIP
+            data_egress = sum(p.bytes_out.get(KIND_GOSSIP, 0)
+                              for p in nets[0].peers)
+            flood_egress = len(payload) * (N - 1)
+            assert data_egress < flood_egress
+        finally:
+            for router in routers:
+                await router.stop()
+            for net in nets:
+                await net.stop()
+    asyncio.run(run())
+
+
+def test_repeat_iwant_not_served_twice_and_costs_score():
+    async def run():
+        net, router, _ = _router(3)
+        peer = net.peers[0]
+        await router.publish("beacon_block", b"amplify-me")
+        mid = G.spec_msg_id("beacon_block", b"amplify-me")
+        peer.frames.clear()              # drop the publish fanout frame
+        await router._on_gossip(peer, G.encode_control(iwant=[mid]))
+        assert len(_data_frames(peer)) == 1
+        score_before = router._scores.get(peer.node_id, 0)
+        await router._on_gossip(peer, G.encode_control(iwant=[mid]))
+        assert len(_data_frames(peer)) == 1          # not re-served
+        assert router._scores.get(peer.node_id, 0) < score_before
+    asyncio.run(run())
+
+
+def test_mcache_per_topic_index_and_eviction():
+    mc = G.MessageCache(history=3, gossip=2)
+    mc.put(b"\x01" * 20, "a", b"da")
+    mc.put(b"\x02" * 20, "b", b"db")
+    assert mc.gossip_ids("a") == [b"\x01" * 20]
+    assert mc.get(b"\x02" * 20) == ("b", b"db")
+    mc.shift()
+    mc.shift()
+    assert mc.gossip_ids("a") == []       # out of the gossip windows
+    assert mc.get(b"\x01" * 20) is not None   # still IWANT-servable
+    mc.shift()
+    assert mc.get(b"\x01" * 20) is None   # evicted from history

@@ -1,0 +1,114 @@
+"""Spec ssz_snappy wire encoding: uvarint, CRC32C, snappy framing
+format, response chunking — frame-level conformance vectors.
+
+reference: networking/eth2/.../rpc/core/encodings/ (LengthPrefixed
+Encoding, SnappyFrameDecoder) — the same byte shapes the spec mandates
+for req/resp streams.
+"""
+
+import pytest
+
+from teku_tpu.networking import encoding as E
+
+
+# -- uvarint ---------------------------------------------------------------
+
+def test_uvarint_vectors():
+    # protobuf varint test vectors
+    cases = [(0, b"\x00"), (1, b"\x01"), (127, b"\x7f"),
+             (128, b"\x80\x01"), (300, b"\xac\x02"),
+             (16384, b"\x80\x80\x01"), (2 ** 32, b"\x80\x80\x80\x80\x10")]
+    for value, wire in cases:
+        assert E.write_uvarint(value) == wire
+        got, pos = E.read_uvarint(wire)
+        assert got == value and pos == len(wire)
+
+
+def test_uvarint_truncated_and_oversized():
+    with pytest.raises(E.EncodingError):
+        E.read_uvarint(b"\x80")          # continuation bit, no next byte
+    with pytest.raises(E.EncodingError):
+        E.read_uvarint(b"\xff" * 11)     # > 10 bytes
+
+
+# -- CRC32C ----------------------------------------------------------------
+
+def test_crc32c_known_vector():
+    # RFC 3720 test vector: crc32c("123456789") = 0xE3069283
+    assert E.crc32c(b"123456789") == 0xE3069283
+    assert E.crc32c(b"") == 0
+    # python fallback agrees with whatever implementation is active
+    assert E._crc32c_py(b"123456789") == 0xE3069283
+
+
+def test_masked_crc_matches_snappy_mask_definition():
+    c = E.crc32c(b"abc")
+    expected = (((c >> 15) | (c << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+    assert E.masked_crc32c(b"abc") == expected
+
+
+# -- framing format --------------------------------------------------------
+
+def test_frame_stream_identifier_prefix():
+    out = E.frame_compress(b"hello world")
+    assert out.startswith(b"\xff\x06\x00\x00sNaPpY")
+
+
+def test_frame_roundtrip_small_and_multi_chunk():
+    for payload in (b"", b"x", b"hello" * 100,
+                    bytes(range(256)) * 600):     # >64KiB → 3 chunks
+        assert E.frame_uncompress(E.frame_compress(payload)) == payload
+
+
+def test_frame_checksum_corruption_detected():
+    out = bytearray(E.frame_compress(b"payload under test" * 10))
+    out[-1] ^= 0xFF                       # flip a data byte
+    with pytest.raises(E.EncodingError):
+        E.frame_uncompress(bytes(out))
+
+
+def test_frame_rejects_missing_identifier():
+    with pytest.raises(E.EncodingError):
+        E.frame_uncompress(b"\x01\x08\x00\x00AAAAAAAA")
+
+
+# -- request/response payload shapes ---------------------------------------
+
+def test_payload_roundtrip_and_length_prefix_enforced():
+    ssz = b"\x2a" * 1000
+    wire = E.encode_payload(ssz)
+    # prefix is the UNCOMPRESSED length as uvarint
+    want, pos = E.read_uvarint(wire)
+    assert want == 1000
+    got, end = E.decode_payload(wire)
+    assert got == ssz and end == len(wire)
+    # lying length prefix is rejected
+    forged = E.write_uvarint(999) + wire[pos:]
+    with pytest.raises(E.EncodingError):
+        E.decode_payload(forged)
+
+
+def test_payload_over_limit_rejected():
+    wire = E.encode_payload(b"abc")
+    with pytest.raises(E.EncodingError):
+        E.decode_payload(E.write_uvarint(E.MAX_PAYLOAD + 1) + wire[1:])
+
+
+def test_response_chunks_roundtrip_with_result_codes():
+    chunks = [b"first-ssz", b"second" * 50, b""]
+    body = b"".join(E.encode_response_chunk(c) for c in chunks)
+    parsed = E.decode_response(body)
+    assert [ssz for _, ssz in parsed] == chunks
+    assert all(result == E.RESULT_SUCCESS for result, _ in parsed)
+    err = E.encode_response_chunk(b"nope", result=E.RESULT_SERVER_ERROR)
+    parsed = E.decode_response(err)
+    assert parsed == [(E.RESULT_SERVER_ERROR, b"nope")]
+
+
+def test_multiple_payloads_back_to_back_consume_exact_bytes():
+    a = E.encode_payload(b"A" * 70000)   # multi-chunk stream
+    b = E.encode_payload(b"BB")
+    ssz_a, pos = E.decode_payload(a + b)
+    assert ssz_a == b"A" * 70000
+    ssz_b, end = E.decode_payload(a + b, pos)
+    assert ssz_b == b"BB" and end == len(a + b)
