@@ -218,7 +218,10 @@ class BeaconRpc:
             timeout=30.0)
         chunks = _unpack_chunks(resp)
         if chunks is None:
-            return []
+            # malformed/error responses must FAIL, not read as an empty
+            # chain — sync treats an exception as peer misbehaviour and
+            # backs the peer off, but an empty list as honest truth
+            raise ConnectionError("malformed blocks_by_range response")
         cfg = self.node.spec.config
         return [deserialize_signed_block(cfg, c) for c in chunks]
 

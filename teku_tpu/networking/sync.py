@@ -1,69 +1,151 @@
-"""Forward sync: catch up to the best peer via blocks-by-range.
+"""Multipeer forward sync: batched parallel downloads, per-peer
+backoff, stall detection with chain switching.
 
-The reference's multipeer forward sync, reduced to its spine
-(reference: beacon/sync/src/main/java/tech/pegasys/teku/beacon/sync/
-forward/multipeer/ — chain selection by peer-claimed head, batched
-range requests, import through the standard block pipeline): pick the
-peer claiming the highest head above ours, pull batches, import each
-through the BlockManager (full verification), repeat until caught up.
+The reference's multipeer forward sync (reference: beacon/sync/src/
+main/java/tech/pegasys/teku/beacon/sync/forward/multipeer/
+BatchSync.java:43 — contiguous batches downloaded from several peers
+in parallel, imported strictly in order through the standard block
+pipeline; SyncStallDetector.java:34 — no-progress passes demote the
+chain being followed so the node re-targets an honest head; peer
+failures back the peer off rather than ending the sync).
 """
 
 import asyncio
 import logging
-from typing import Optional
+from typing import Dict, List, Optional, Tuple
 
 from .reqresp import BeaconRpc, MAX_REQUEST_BLOCKS
-from .transport import P2PNetwork
+from .transport import P2PNetwork, Peer
 
 _LOG = logging.getLogger(__name__)
 
+# passes a peer sits out after a failed/garbage response, doubling per
+# repeat offense (reference peer scorer's cooldown role)
+BACKOFF_BASE_PASSES = 2
+MAX_PARALLEL_BATCHES = 4
+STALL_PASSES_GIVE_UP = 3
+
 
 class SyncService:
-    def __init__(self, net: P2PNetwork, rpc: BeaconRpc, node):
+    def __init__(self, net: P2PNetwork, rpc: BeaconRpc, node,
+                 parallelism: int = MAX_PARALLEL_BATCHES):
         self.net = net
         self.rpc = rpc
         self.node = node
+        self.parallelism = parallelism
         self.syncing = False
         self.blocks_imported = 0
+        self.batches_requested = 0
+        self.stalls_detected = 0
+        self.chain_switches = 0
+        self._pass_no = 0
+        # node_id -> (banned_until_pass, consecutive_failures)
+        self._backoff: Dict[bytes, Tuple[int, int]] = {}
+
+    # -- source selection ----------------------------------------------
+    def _available(self, peer: Peer) -> bool:
+        until, _ = self._backoff.get(peer.node_id, (0, 0))
+        return peer.connected and self._pass_no >= until
+
+    def _sync_sources(self) -> List[Peer]:
+        """Peers claiming a head above ours, best claim first, backed
+        off offenders excluded (reference chain selection: the target
+        chain is the best claimed head with willing suppliers)."""
+        ours = self.node.chain.head_slot()
+        sources = [p for p in self.net.peers
+                   if p.status is not None
+                   and p.status.head_slot > ours
+                   and self._available(p)]
+        sources.sort(key=lambda p: p.status.head_slot, reverse=True)
+        return sources
 
     def _best_peer(self):
-        best, best_slot = None, self.node.chain.head_slot()
-        for peer in self.net.peers:
-            if peer.status is not None and peer.status.head_slot > best_slot:
-                best, best_slot = peer, peer.status.head_slot
-        return best
+        sources = self._sync_sources()
+        return sources[0] if sources else None
+
+    def _penalize(self, peer: Peer) -> None:
+        until, fails = self._backoff.get(peer.node_id, (0, 0))
+        fails += 1
+        self._backoff[peer.node_id] = (
+            self._pass_no + BACKOFF_BASE_PASSES * (2 ** (fails - 1)),
+            fails)
+        _LOG.info("sync: peer backed off (%d failures)", fails)
+
+    def _reward(self, peer: Peer) -> None:
+        self._backoff.pop(peer.node_id, None)
+
+    # -- batched parallel download -------------------------------------
+    async def _fetch_batch(self, peer: Peer, start: int, count: int):
+        """(peer, start, count, blocks|None) — None = request failed;
+        blocks are pre-screened to the requested window and ascending
+        (a Byzantine peer cannot use the batch to smuggle other slots)."""
+        self.batches_requested += 1
+        try:
+            blocks = await self.rpc.blocks_by_range(peer, start, count)
+        except Exception as exc:
+            _LOG.warning("range request failed: %s", exc)
+            return peer, start, count, None
+        kept = []
+        last_slot = -1
+        for signed in blocks:
+            slot = signed.message.slot
+            if not (start <= slot < start + count) or slot <= last_slot:
+                return peer, start, count, None   # out-of-window/order
+            kept.append(signed)
+            last_slot = slot
+        return peer, start, count, kept
 
     async def sync_once(self) -> bool:
-        """One pass: returns True if any block was imported (the driver
-        loops until a pass imports nothing — caught up)."""
-        peer = self._best_peer()
-        if peer is None:
+        """One pass toward the best claimed head: contiguous batches
+        fanned out across available peers in parallel, imported in
+        order.  Returns True if any block was imported."""
+        self._pass_no += 1
+        sources = self._sync_sources()
+        if not sources:
             return False
         self.syncing = True
-        start = self.node.chain.head_slot() + 1
-        target = peer.status.head_slot
         imported_any = False
         try:
-            while start <= target:
-                count = min(MAX_REQUEST_BLOCKS, target - start + 1)
-                try:
-                    blocks = await self.rpc.blocks_by_range(
-                        peer, start, count)
-                except Exception as exc:
-                    # one bad/silent peer must not kill the service
-                    _LOG.warning("range request failed: %s", exc)
+            target = sources[0].status.head_slot
+            cursor = self.node.chain.head_slot() + 1
+            while cursor <= target:
+                sources = [p for p in self._sync_sources()]
+                if not sources:
                     break
-                if not blocks:
-                    break
-                await self._fetch_blobs_for(peer, blocks, start, count)
-                for signed in blocks:
-                    if self.node.block_manager.import_block(signed):
-                        self.blocks_imported += 1
-                        imported_any = True
-                # the cursor must STRICTLY advance regardless of what
-                # slots the peer claims, or a Byzantine peer replaying
-                # old blocks pins the loop forever
-                start = max(start + 1, blocks[-1].message.slot + 1)
+                # up to `parallelism` contiguous batches in flight,
+                # round-robin across the available source peers
+                window = []
+                s = cursor
+                for i in range(self.parallelism):
+                    if s > target:
+                        break
+                    count = min(MAX_REQUEST_BLOCKS, target - s + 1)
+                    window.append((sources[i % len(sources)], s, count))
+                    s += count
+                results = await asyncio.gather(
+                    *[self._fetch_batch(p, st, c)
+                      for p, st, c in window])
+                for peer, st, count, blocks in results:
+                    if blocks is None:
+                        # failed batch: back the peer off and re-pull
+                        # this window from someone else next loop —
+                        # later already-fetched batches still import
+                        # via the pending-parent pool
+                        self._penalize(peer)
+                        continue
+                    self._reward(peer)
+                    await self._fetch_blobs_for(peer, blocks, st, count)
+                    for signed in blocks:
+                        if self.node.block_manager.import_block(signed):
+                            self.blocks_imported += 1
+                            imported_any = True
+                # the cursor tracks actual chain progress, so garbage
+                # batches (imports all fail) re-request the same window
+                # from other peers instead of silently skipping it
+                new_cursor = self.node.chain.head_slot() + 1
+                if new_cursor <= cursor:
+                    break    # no movement this window — pass stalls
+                cursor = new_cursor
         finally:
             self.syncing = False
         return imported_any
@@ -200,6 +282,13 @@ class SyncService:
         return total
 
     async def run_until_synced(self, max_rounds: int = 50) -> None:
+        """Sync passes until a pass makes no progress AND no credible
+        better head remains.  A pass that stalls (peers claim more than
+        we can import) demotes the best claimant — reference
+        SyncStallDetector.java:34 switching target chains — so a peer
+        advertising a phantom head cannot pin the node below the
+        honest chain."""
+        stalled_passes = 0
         for _ in range(max_rounds):
             # refresh statuses so the target tracks the peer's progress
             for peer in list(self.net.peers):
@@ -207,5 +296,21 @@ class SyncService:
                     await self.rpc.exchange_status(peer)
                 except Exception:
                     continue
-            if not await self.sync_once():
+            before = self.node.chain.head_slot()
+            imported = await self.sync_once()
+            if imported and self.node.chain.head_slot() > before:
+                stalled_passes = 0
+                continue
+            best = self._best_peer()
+            if best is None:
+                return               # nobody claims better — synced
+            # someone still claims a higher head but the pass moved
+            # nothing: stall — demote the claimant and re-target
+            self.stalls_detected += 1
+            stalled_passes += 1
+            self._penalize(best)
+            self.chain_switches += 1
+            _LOG.warning("sync stalled below claimed head %d; "
+                         "switching source chains", best.status.head_slot)
+            if stalled_passes >= STALL_PASSES_GIVE_UP:
                 return

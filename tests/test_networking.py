@@ -118,3 +118,94 @@ def test_wrong_fork_digest_rejected():
             await a.stop()
             await b.stop()
     asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_multipeer_sync_survives_garbage_and_silent_peers():
+    """The best-claiming peer serves garbage, another claims much and
+    serves nothing: the node must back both off and still reach the
+    honest head (reference BatchSync + SyncStallDetector)."""
+    from teku_tpu.networking import encoding as E
+    from teku_tpu.networking.reqresp import BeaconRpc
+    from teku_tpu.spec.datastructures import Status
+
+    async def run():
+        spec, state, sks, honest, fresh = _make_pair()
+        evil = NetworkedNode(spec, state, name="evil")
+        silent = NetworkedNode(spec, state, name="silent")
+        await honest.start()
+        try:
+            client = _client(spec, honest, dict(enumerate(sks)))
+            await _run_slots(spec, [honest], [client], 1, 12)
+            assert honest.node.chain.head_slot() == 12
+
+            # evil claims slot 50 and serves junk block batches
+            await evil.start()
+            real_status = evil.rpc._local_status()
+            evil.rpc._local_status = lambda: Status(
+                fork_digest=real_status.fork_digest,
+                finalized_root=b"\xee" * 32, finalized_epoch=5,
+                head_root=b"\xee" * 32, head_slot=50)
+            junk = E.encode_response_chunk(b"\xff" * 120)
+
+            async def evil_handler(peer, method, body,
+                                   _orig=evil.net.on_request):
+                if method == "beacon_blocks_by_range":
+                    return junk
+                return await _orig(peer, method, body)
+            evil.net.on_request = evil_handler
+
+            # silent claims slot 40 and times out every block request
+            await silent.start()
+            real2 = silent.rpc._local_status()
+            silent.rpc._local_status = lambda: Status(
+                fork_digest=real2.fork_digest,
+                finalized_root=b"\xaa" * 32, finalized_epoch=4,
+                head_root=b"\xaa" * 32, head_slot=40)
+
+            async def silent_handler(peer, method, body,
+                                     _orig=silent.net.on_request):
+                if method == "beacon_blocks_by_range":
+                    await asyncio.sleep(3600)
+                return await _orig(peer, method, body)
+            silent.net.on_request = silent_handler
+
+            await fresh.start()
+            for slot in range(1, 13):
+                await fresh.node.on_slot(slot)
+            await fresh.connect(evil)
+            await fresh.connect(silent)
+            await fresh.connect(honest)
+            # short client timeout so the silent peer costs seconds
+            orig = BeaconRpc.blocks_by_range
+
+            async def fast_timeout(self, peer, start, count):
+                resp = await peer.request(
+                    "beacon_blocks_by_range",
+                    E.encode_payload(
+                        __import__("struct").pack("<QQ", start, count)),
+                    timeout=1.0)
+                from teku_tpu.networking.reqresp import _unpack_chunks
+                chunks = _unpack_chunks(resp)
+                if chunks is None:
+                    raise ConnectionError("bad response")
+                from teku_tpu.spec.codec import deserialize_signed_block
+                return [deserialize_signed_block(self.node.spec.config, c)
+                        for c in chunks]
+            BeaconRpc.blocks_by_range = fast_timeout
+            try:
+                await fresh.sync.run_until_synced()
+            finally:
+                BeaconRpc.blocks_by_range = orig
+            assert fresh.node.chain.head_slot() == 12
+            assert fresh.node.chain.head_root == \
+                honest.node.chain.head_root
+            # the liars were detected and backed off
+            assert fresh.sync.stalls_detected >= 1 or \
+                len(fresh.sync._backoff) >= 1
+        finally:
+            await honest.stop()
+            await evil.stop()
+            await silent.stop()
+            await fresh.stop()
+    asyncio.run(run())
