@@ -190,6 +190,11 @@ class TcpGossipNetwork(GossipNetwork):
         # per-peer ids already served via IWANT (gossipsub v1.1 bounds
         # IWANT retries to stop bandwidth amplification)
         self._iwant_served: Dict[bytes, LimitedSet] = {}
+        # mid -> heartbeat count when our own outstanding IWANT expires:
+        # without this, every IHAVE advertiser is asked for the same
+        # missing message and the payload arrives D_lazy times
+        self._iwant_pending: Dict[bytes, int] = {}
+        self._heartbeats = 0
         # observability (the O(D) egress assertion hangs off these)
         self.messages_forwarded = 0
         self.data_frames_sent = 0
@@ -309,6 +314,7 @@ class TcpGossipNetwork(GossipNetwork):
             self._punish(peer, REJECT_SCORE)
             return
         mid = spec_msg_id(topic, data)
+        self._iwant_pending.pop(mid, None)
         if not self._seen.add(mid):
             return                      # duplicate
         handler = self._handlers.get(topic)
@@ -355,25 +361,34 @@ class TcpGossipNetwork(GossipNetwork):
                 self._mesh[topic].discard(peer)
         if prune_back:
             self._send_control(peer, encode_control(prune=prune_back))
-        # IHAVE → IWANT for ids we miss
+        # IHAVE → IWANT for ids we miss — one outstanding request per
+        # id (re-askable after the pending window expires), not one per
+        # advertiser
         want = []
         for topic, mids in ihave:
             if topic not in self._handlers:
                 continue
             for mid in mids:
-                if mid not in self._seen and len(want) < \
+                if mid in self._seen or len(want) >= \
                         MAX_IWANT_PER_CONTROL:
-                    want.append(mid)
+                    continue
+                expiry = self._iwant_pending.get(mid)
+                if expiry is not None and expiry > self._heartbeats:
+                    continue        # already asked someone recently
+                self._iwant_pending[mid] = self._heartbeats + 2
+                want.append(mid)
         if want:
             self._send_control(peer, encode_control(iwant=want))
         # IWANT → serve full messages from the cache, once per peer per
         # id: repeat IWANTs are a bandwidth-amplification lever (spend
-        # 20 bytes, receive a full block), so re-asks cost score instead
+        # 20 bytes, receive a full block), so re-asks of DELIVERED ids
+        # cost score instead.  Ids we no longer have (mcache evicted)
+        # are not marked served — a retry for those is protocol-honest.
         served = 0
         already = self._iwant_served.setdefault(peer.node_id,
                                                 LimitedSet(4096))
         for mid in iwant[:MAX_IWANT_PER_CONTROL]:
-            if not already.add(mid):
+            if mid in already:
                 self._punish(peer, IGNORE_SCORE)
                 continue
             entry = self._mcache.get(mid)
@@ -381,6 +396,7 @@ class TcpGossipNetwork(GossipNetwork):
                 topic, data = entry
                 await self._send_data(self._encode_data(topic, data),
                                       [peer], exclude=None)
+                already.add(mid)
                 served += 1
         self.iwant_served += served
 
@@ -426,6 +442,11 @@ class TcpGossipNetwork(GossipNetwork):
                     self._send_control(
                         p, encode_control(ihave=[(topic, mids)]))
         self._mcache.shift()
+        self._heartbeats += 1
+        if self._iwant_pending:
+            self._iwant_pending = {
+                mid: exp for mid, exp in self._iwant_pending.items()
+                if exp > self._heartbeats}
         # score decay toward zero (gossipsub counters decay each
         # heartbeat so old sins are forgiven)
         for nid in list(self._scores):
