@@ -51,6 +51,12 @@ class BeaconRestApi(RestApi):
         g("/eth/v1/config/spec", self._spec_config)
         g("/eth/v1/validator/duties/proposer/{epoch}", self._proposer_duties)
         p("/eth/v1/validator/duties/attester/{epoch}", self._attester_duties)
+        p("/eth/v1/validator/duties/sync/{epoch}", self._sync_duties)
+        p("/eth/v1/validator/liveness/{epoch}", self._liveness)
+        g("/eth/v1/beacon/states/{state_id}/committees", self._committees)
+        g("/eth/v1/beacon/states/{state_id}/sync_committees",
+          self._state_sync_committees)
+        g("/eth/v1/config/fork_schedule", self._fork_schedule)
         p("/eth/v1/beacon/pool/attestations", self._submit_attestations)
         p("/eth/v1/beacon/pool/voluntary_exits", self._submit_exit)
         p("/eth/v1/beacon/pool/sync_committees", self._submit_sync_messages)
@@ -431,6 +437,112 @@ class BeaconRestApi(RestApi):
              "committees_at_slot": str(d.committees_at_slot),
              "validator_committee_index": str(d.committee_position),
              "slot": str(d.slot)} for d in duties]}
+
+    async def _sync_duties(self, epoch: str, body=None):
+        """Sync-committee duties (reference handlers/v1/validator/
+        PostSyncDuties.java:43) — what lets the remote VC run sync
+        duties without downloading states."""
+        if self.validator_api is None:
+            raise HttpError(503, "validator api not wired")
+        indices = [int(i) for i in (body or [])]
+        duties = self.validator_api.get_sync_duties(int(epoch), indices)
+        return {"execution_optimistic": False, "data": [
+            {"pubkey": _hex(d.pubkey),
+             "validator_index": str(d.validator_index),
+             "validator_sync_committee_indices":
+                 [str(p) for p in d.positions]}
+            for d in duties]}
+
+    async def _liveness(self, epoch: str, body=None):
+        """Per-validator liveness from the epoch's participation flags
+        (reference handlers/v1/validator/PostValidatorLiveness.java —
+        there from a seen-attestation cache; here the participation
+        registry IS that record for current/previous epoch)."""
+        epoch = int(epoch)
+        state = self.node.chain.head_state()
+        cfg = self.node.spec.config
+        current = H.get_current_epoch(cfg, state)
+        if epoch == current:
+            participation = getattr(state, "current_epoch_participation",
+                                    None)
+        elif epoch == current - 1:
+            participation = getattr(state, "previous_epoch_participation",
+                                    None)
+        else:
+            raise HttpError(400, "liveness only for current/previous "
+                                 "epoch")
+        if participation is None:
+            raise HttpError(501, "pre-altair state has no participation "
+                                 "registry")
+        out = []
+        for i in (body or []):
+            vi = int(i)
+            live = (vi < len(participation)
+                    and participation[vi] != 0)
+            out.append({"index": str(vi), "is_live": live})
+        return {"data": out}
+
+    async def _committees(self, state_id: str, query=None):
+        """Beacon committees (reference handlers/v1/beacon/
+        GetStateCommittees.java): all committees for an epoch, or
+        filtered by slot/index."""
+        query = query or {}
+        state = self._resolve_state(state_id)
+        cfg = self.node.spec.config
+        epoch = (int(query["epoch"]) if "epoch" in query
+                 else H.get_current_epoch(cfg, state))
+        want_slot = int(query["slot"]) if "slot" in query else None
+        want_index = int(query["index"]) if "index" in query else None
+        committees = H.get_committee_count_per_slot(cfg, state, epoch)
+        first = H.compute_start_slot_at_epoch(cfg, epoch)
+        out = []
+        for slot in range(first, first + cfg.SLOTS_PER_EPOCH):
+            if want_slot is not None and slot != want_slot:
+                continue
+            for ci in range(committees):
+                if want_index is not None and ci != want_index:
+                    continue
+                try:
+                    members = H.get_beacon_committee(cfg, state, slot, ci)
+                except Exception:
+                    raise HttpError(400, "epoch out of shuffling range")
+                out.append({"index": str(ci), "slot": str(slot),
+                            "validators": [str(v) for v in members]})
+        return {"execution_optimistic": False, "data": out}
+
+    async def _state_sync_committees(self, state_id: str, query=None):
+        """Current sync committee of a state as validator indices
+        (reference handlers/v1/beacon/GetStateSyncCommittees.java)."""
+        state = self._resolve_state(state_id)
+        if not hasattr(state, "current_sync_committee"):
+            raise HttpError(400, "pre-altair state")
+        by_pubkey = {v.pubkey: i for i, v in enumerate(state.validators)}
+        indices = [by_pubkey.get(pk)
+                   for pk in state.current_sync_committee.pubkeys]
+        if any(i is None for i in indices):
+            raise HttpError(500, "committee pubkey not in registry")
+        from ..spec.altair.helpers import sync_subcommittee_size
+        sub = sync_subcommittee_size(self.node.spec.config)
+        return {"execution_optimistic": False, "data": {
+            "validators": [str(i) for i in indices],
+            "validator_aggregates": [
+                [str(i) for i in indices[off:off + sub]]
+                for off in range(0, len(indices), sub)]}}
+
+    async def _fork_schedule(self):
+        """All scheduled forks (reference handlers/v1/config/
+        GetForkSchedule.java) — lets a remote VC build signing domains
+        for any epoch without a state."""
+        from ..spec.milestones import build_fork_schedule
+        schedule = build_fork_schedule(self.node.spec.config)
+        out = []
+        for i, v in enumerate(schedule.versions):
+            prev = schedule.versions[i - 1] if i > 0 else v
+            out.append({
+                "previous_version": _hex(prev.fork_version),
+                "current_version": _hex(v.fork_version),
+                "epoch": str(v.fork_epoch)})
+        return {"data": out}
 
     def _decode_versioned(self, attr: str, raw: bytes):
         """Decode raw SSZ against each scheduled milestone's schema,

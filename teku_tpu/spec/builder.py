@@ -247,12 +247,20 @@ def get_selection_proof(cfg: SpecConfig, state, slot: int,
                   H.selection_proof_signing_root(cfg, state, slot))
 
 
+def is_aggregator_by_size(cfg: SpecConfig, committee_size: int,
+                          selection_proof: bytes) -> bool:
+    """Spec is_aggregator given the committee LENGTH — what a remote VC
+    knows from its attester duty without any state (the duty carries
+    committee_length precisely so this check needs no shuffling)."""
+    modulo = max(1, committee_size // cfg.TARGET_AGGREGATORS_PER_COMMITTEE)
+    return (int.from_bytes(H.hash32(selection_proof)[:8], "little")
+            % modulo == 0)
+
+
 def is_aggregator(cfg: SpecConfig, state, slot: int, index: int,
                   selection_proof: bytes) -> bool:
     committee = H.get_beacon_committee(cfg, state, slot, index)
-    modulo = max(1, len(committee) // cfg.TARGET_AGGREGATORS_PER_COMMITTEE)
-    return (int.from_bytes(H.hash32(selection_proof)[:8], "little")
-            % modulo == 0)
+    return is_aggregator_by_size(cfg, len(committee), selection_proof)
 
 
 def produce_aggregate_and_proof(cfg: SpecConfig, state, aggregate,

@@ -38,6 +38,17 @@ class ProposerDuty:
     slot: int
 
 
+@dataclass
+class SyncDuty:
+    """One validator's sync-committee membership for an epoch
+    (reference validator/api SyncCommitteeDuty /
+    PostSyncDuties.java:43): the committee positions double as the
+    subcommittee assignment (position // subcommittee_size)."""
+    validator_index: int
+    pubkey: bytes
+    positions: tuple          # indices into the sync committee
+
+
 class ValidatorApiChannel:
     """The full duty surface the VC consumes."""
 
@@ -46,6 +57,10 @@ class ValidatorApiChannel:
 
     def get_attester_duties(self, epoch: int,
                             indices: Sequence[int]) -> List[AttesterDuty]:
+        raise NotImplementedError
+
+    def get_sync_duties(self, epoch: int,
+                        indices: Sequence[int]) -> List[SyncDuty]:
         raise NotImplementedError
 
     def get_attestation_data(self, slot: int, committee_index: int):
@@ -127,6 +142,30 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
                             committee_size=len(committee),
                             committees_at_slot=committees))
         return out
+
+    def get_sync_duties(self, epoch: int,
+                        indices: Sequence[int]) -> List[SyncDuty]:
+        """Membership in the sync committee covering `epoch`
+        (reference ValidatorApiHandler.getSyncCommitteeDuties)."""
+        cfg = self.spec.config
+        first = H.compute_start_slot_at_epoch(cfg, epoch)
+        state = self.node.advanced_head_state(max(first, 1))
+        if not hasattr(state, "current_sync_committee"):
+            return []
+        wanted = set(indices)
+        by_pubkey: Dict[bytes, int] = {}
+        for vi in wanted:
+            if vi < len(state.validators):
+                by_pubkey[state.validators[vi].pubkey] = vi
+        positions: Dict[int, list] = {}
+        for pos, pk in enumerate(state.current_sync_committee.pubkeys):
+            vi = by_pubkey.get(pk)
+            if vi is not None:
+                positions.setdefault(vi, []).append(pos)
+        return [SyncDuty(validator_index=vi,
+                         pubkey=state.validators[vi].pubkey,
+                         positions=tuple(pos_list))
+                for vi, pos_list in sorted(positions.items())]
 
     # -- production ----------------------------------------------------
     def duty_state(self, slot: int):

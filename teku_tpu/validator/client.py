@@ -16,8 +16,9 @@ from typing import Dict, List, Optional
 
 from ..spec import helpers as H
 from ..spec import Spec
-from ..spec.builder import is_aggregator
-from .api import AttesterDuty, ProposerDuty, ValidatorApiChannel
+from ..spec.builder import is_aggregator_by_size
+from .api import (AttesterDuty, ProposerDuty, SyncDuty,
+                  ValidatorApiChannel)
 from .signer import DutySigner, SigningError
 
 _LOG = logging.getLogger(__name__)
@@ -36,6 +37,7 @@ class ValidatorClient:
         self.graffiti = graffiti
         self._proposer_duties: Dict[int, List[ProposerDuty]] = {}
         self._attester_duties: Dict[int, List[AttesterDuty]] = {}
+        self._sync_duties: Dict[int, List[SyncDuty]] = {}
         self.blocks_proposed = 0
         self.attestations_sent = 0
         self.aggregates_sent = 0
@@ -49,9 +51,15 @@ class ValidatorClient:
                 if d.validator_index in mine]
             self._attester_duties[epoch] = self.api.get_attester_duties(
                 epoch, self.indices)
+            try:
+                self._sync_duties[epoch] = self.api.get_sync_duties(
+                    epoch, self.indices)
+            except NotImplementedError:
+                self._sync_duties[epoch] = []
             for old in [e for e in self._proposer_duties if e < epoch - 1]:
                 del self._proposer_duties[old]
                 del self._attester_duties[old]
+                self._sync_duties.pop(old, None)
 
     # -- slot phases ---------------------------------------------------
     async def on_slot_start(self, slot: int) -> None:
@@ -125,20 +133,15 @@ class ValidatorClient:
     async def on_sync_committee_due(self, slot: int) -> None:
         """Altair sync-committee duty: members sign the head root at
         the current slot (reference: validator/client/duties/
-        synccommittee/SyncCommitteeProductionDuty)."""
+        synccommittee/SyncCommitteeProductionDuty).  Membership comes
+        from the sync-duties query — no state needed."""
         cfg = self.spec.config
-        state = self.api.duty_state(slot)
-        if not hasattr(state, "current_sync_committee"):
-            return          # pre-altair
-        pk_to_index = {}
-        mine = set(self.indices)
-        for i in mine:
-            pk_to_index[state.validators[i].pubkey] = i
-        members = {pk_to_index[pk]
-                   for pk in state.current_sync_committee.pubkeys
-                   if pk in pk_to_index}
+        epoch = H.compute_epoch_at_slot(cfg, slot)
+        self._duties_for_epoch(epoch)
+        members = {d.validator_index for d in self._sync_duties[epoch]}
         if not members:
             return
+        state = self.api.duty_state(slot)
         # sign the CURRENT head (the slot's block): it is included by
         # the next proposer as previous-slot root — and remembered so
         # the aggregation phase targets the SAME root even if the head
@@ -163,10 +166,14 @@ class ValidatorClient:
         """Sync-committee contribution duty (reference duties/
         synccommittee/SyncCommitteeAggregationDuty): members with a
         winning selection proof aggregate their subcommittee's pooled
-        messages and broadcast a SignedContributionAndProof."""
+        messages and broadcast a SignedContributionAndProof.
+        Subcommittee assignment comes from the sync duty's committee
+        positions — no state needed."""
         cfg = self.spec.config
-        state = self.api.duty_state(slot)
-        if not hasattr(state, "current_sync_committee"):
+        epoch = H.compute_epoch_at_slot(cfg, slot)
+        self._duties_for_epoch(epoch)
+        duties = self._sync_duties[epoch]
+        if not duties:
             return
         from ..spec.altair.helpers import is_sync_committee_aggregator
         build = getattr(self.api, "build_sync_contribution", None)
@@ -174,9 +181,7 @@ class ValidatorClient:
                           None)
         if build is None or publish is None:
             return      # channel without the contribution surface
-        pk_to_index = {}
-        for i in set(self.indices):
-            pk_to_index[state.validators[i].pubkey] = i
+        state = self.api.duty_state(slot)
         from ..spec.altair.helpers import sync_subcommittee_size
         sub_size = sync_subcommittee_size(cfg)
         # aggregate the root the slot's messages actually signed — a
@@ -190,12 +195,17 @@ class ValidatorClient:
         # ~TARGET aggregators per subcommittee); dedupe only per
         # (validator, subcommittee) across duplicate committee seats
         done: set = set()
-        for position, pk in enumerate(
-                state.current_sync_committee.pubkeys):
-            vi = pk_to_index.get(pk)
-            if vi is None:
-                continue
-            sub = position // sub_size
+        for sync_duty in duties:
+            vi = sync_duty.validator_index
+            subs = {pos // sub_size for pos in sync_duty.positions}
+            await self._contribute_for(
+                cfg, state, slot, vi, subs, done, head_root, version,
+                build, publish, is_sync_committee_aggregator)
+
+    async def _contribute_for(self, cfg, state, slot, vi, subs, done,
+                              head_root, version, build, publish,
+                              is_sync_committee_aggregator) -> None:
+        for sub in sorted(subs):
             if (vi, sub) in done:
                 continue
             done.add((vi, sub))
@@ -245,8 +255,9 @@ class ValidatorClient:
                     cfg, state, slot, duty.validator_index)
             except SigningError:
                 continue
-            if not is_aggregator(cfg, state, slot, duty.committee_index,
-                                 proof):
+            # the duty carries committee_length so this needs no
+            # shuffling (what lets a remote VC skip state downloads)
+            if not is_aggregator_by_size(cfg, duty.committee_size, proof):
                 continue
             data = self.api.get_attestation_data(slot, duty.committee_index)
             aggregate = self.api.get_aggregate(

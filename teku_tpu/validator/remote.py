@@ -6,9 +6,12 @@ Equivalent of the reference's remote VC (reference: validator/remote/
 src/main/java/tech/pegasys/teku/validator/remote/
 RemoteValidatorApiHandler.java over the typedef OkHttp client; the
 in-process path is validator/eventadapter/InProcessBeaconNodeApi.java):
-duties and attestation data come from the standard JSON endpoints,
-states for signing context from the SSZ debug-state endpoint, and
-productions/submissions ride SSZ octet-stream bodies.
+duties and attestation data come from the standard JSON duty endpoints,
+productions/submissions ride SSZ octet-stream bodies, and the signing
+context is a light DutyContext built from /eth/v1/beacon/genesis plus
+the fork schedule — the remote VC NEVER downloads a beacon state
+(mainnet states are hundreds of MB; the duty endpoints exist precisely
+so it doesn't have to).
 
 The HTTP client is deliberately synchronous (urllib over localhost/LAN,
 millisecond round trips): duty_state and the duty queries are sync on
@@ -24,11 +27,27 @@ from typing import List, Optional
 
 from ..spec import helpers as H
 from ..spec import Spec
-from ..spec.codec import deserialize_state, serialize_signed_block
+from ..spec.codec import serialize_signed_block
+from ..spec.datastructures import Fork
 from ..spec.milestones import build_fork_schedule
-from .api import AttesterDuty, ProposerDuty, ValidatorApiChannel
+from .api import (AttesterDuty, ProposerDuty, SyncDuty,
+                  ValidatorApiChannel)
 
 _LOG = logging.getLogger(__name__)
+
+
+class DutyContext:
+    """Everything the signers consume from a 'state' — slot, fork,
+    genesis_validators_root (H.get_domain's full read set) — in a few
+    dozen bytes instead of a downloaded BeaconState."""
+
+    __slots__ = ("slot", "fork", "genesis_validators_root")
+
+    def __init__(self, slot: int, fork: Fork,
+                 genesis_validators_root: bytes):
+        self.slot = slot
+        self.fork = fork
+        self.genesis_validators_root = genesis_validators_root
 
 
 class RemoteValidatorApi(ValidatorApiChannel):
@@ -36,8 +55,7 @@ class RemoteValidatorApi(ValidatorApiChannel):
         self.spec = spec
         self.base = base_url.rstrip("/")
         self.timeout = timeout
-        # (head_root_hex, slot) -> advanced state, one entry
-        self._state_cache: Optional[tuple] = None
+        self._genesis_root: Optional[bytes] = None
 
     # -- transport -----------------------------------------------------
     def _get_json(self, path: str) -> dict:
@@ -83,25 +101,48 @@ class RemoteValidatorApi(ValidatorApiChannel):
             committees_at_slot=int(d["committees_at_slot"]))
             for d in out["data"]]
 
+    def get_sync_duties(self, epoch: int,
+                        indices: List[int]) -> List[SyncDuty]:
+        body = json.dumps([str(i) for i in indices]).encode()
+        req = urllib.request.Request(
+            self.base + f"/eth/v1/validator/duties/sync/{epoch}",
+            data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            out = json.loads(resp.read())
+        return [SyncDuty(
+            validator_index=int(d["validator_index"]),
+            pubkey=bytes.fromhex(d["pubkey"][2:]),
+            positions=tuple(
+                int(p) for p in d["validator_sync_committee_indices"]))
+            for d in out["data"]]
+
     # -- chain context -------------------------------------------------
     def head_root(self) -> bytes:
         out = self._get_json("/eth/v1/beacon/headers/head")
         return bytes.fromhex(out["data"]["root"][2:])
 
+    def genesis_validators_root(self) -> bytes:
+        if self._genesis_root is None:
+            out = self._get_json("/eth/v1/beacon/genesis")
+            self._genesis_root = bytes.fromhex(
+                out["data"]["genesis_validators_root"][2:])
+        return self._genesis_root
+
     def duty_state(self, slot: int):
-        """Head state fetched over the debug SSZ endpoint, advanced to
-        `slot` locally, cached until the head or slot moves."""
-        head = self.head_root()
-        key = (head, slot)
-        if self._state_cache is not None \
-                and self._state_cache[0] == key:
-            return self._state_cache[1]
-        raw = self._get_bytes("/eth/v2/debug/beacon/states/head")
-        state = deserialize_state(self.spec.config, raw)
-        if state.slot < slot:
-            state = self.spec.process_slots(state, slot)
-        self._state_cache = (key, state)
-        return state
+        """Signing context WITHOUT a state download: genesis root from
+        the genesis endpoint (cached forever — it never changes), fork
+        from the locally-known schedule.  The debug-state pull this
+        replaces moved hundreds of MB per epoch at mainnet scale."""
+        cfg = self.spec.config
+        epoch = H.compute_epoch_at_slot(cfg, slot)
+        prev, cur, fork_epoch = build_fork_schedule(cfg).fork_at_epoch(
+            epoch)
+        return DutyContext(
+            slot=slot,
+            fork=Fork(previous_version=prev, current_version=cur,
+                      epoch=fork_epoch),
+            genesis_validators_root=self.genesis_validators_root())
 
     def get_attestation_data(self, slot: int, committee_index: int):
         from ..spec.datastructures import (AttestationData, Checkpoint)

@@ -184,3 +184,81 @@ def test_events_sse_stream():
             await api.stop()
             await net.stop()
     asyncio.run(run())
+
+
+def test_duty_and_committee_endpoints():
+    """The endpoints a remote VC lives off (reference handlers/v1/
+    validator/PostSyncDuties.java:43, PostValidatorLiveness.java,
+    v1/beacon/GetStateCommittees.java, v1/config/GetForkSchedule)."""
+    import dataclasses
+    from teku_tpu.api import BeaconRestApi
+    from teku_tpu.node.gossip import InMemoryGossipNetwork
+    from teku_tpu.node.node import BeaconNode
+    from teku_tpu.spec import config as C, Spec
+    from teku_tpu.spec.genesis import interop_genesis
+    from teku_tpu.validator import BeaconNodeValidatorApi
+
+    spec = Spec(dataclasses.replace(C.MINIMAL, ALTAIR_FORK_EPOCH=0))
+    state, sks = interop_genesis(spec.config, 16)
+
+    async def run():
+        net = InMemoryGossipNetwork()
+        node = BeaconNode(spec, state, net.endpoint())
+        api = BeaconRestApi(node,
+                            validator_api=BeaconNodeValidatorApi(node))
+        await api.start()
+        try:
+            base = f"http://127.0.0.1:{api.port}"
+            loop = asyncio.get_running_loop()
+
+            def post(path, payload):
+                req = urllib.request.Request(
+                    base + path, data=json.dumps(payload).encode(),
+                    method="POST",
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    return json.loads(r.read())
+
+            def get(path):
+                with urllib.request.urlopen(base + path, timeout=5) as r:
+                    return json.loads(r.read())
+
+            sync = await loop.run_in_executor(
+                None, post, "/eth/v1/validator/duties/sync/0",
+                [str(i) for i in range(16)])
+            # minimal preset: committee of 32 seats over 16 validators —
+            # everyone sits somewhere, positions are seat indices
+            assert len(sync["data"]) == 16
+            seats = sum(len(d["validator_sync_committee_indices"])
+                        for d in sync["data"])
+            assert seats == spec.config.SYNC_COMMITTEE_SIZE
+
+            committees = await loop.run_in_executor(
+                None, get, "/eth/v1/beacon/states/head/committees")
+            assert committees["data"]
+            one = committees["data"][0]
+            assert {"index", "slot", "validators"} <= set(one)
+            filtered = await loop.run_in_executor(
+                None, get,
+                f"/eth/v1/beacon/states/head/committees"
+                f"?slot={one['slot']}&index={one['index']}")
+            assert filtered["data"] == [one]
+
+            sc = await loop.run_in_executor(
+                None, get, "/eth/v1/beacon/states/head/sync_committees")
+            assert len(sc["data"]["validators"]) == \
+                spec.config.SYNC_COMMITTEE_SIZE
+
+            live = await loop.run_in_executor(
+                None, post, "/eth/v1/validator/liveness/0",
+                ["0", "1"])
+            assert [d["index"] for d in live["data"]] == ["0", "1"]
+            assert all(d["is_live"] is False for d in live["data"])
+
+            forks = await loop.run_in_executor(
+                None, get, "/eth/v1/config/fork_schedule")
+            assert forks["data"][0]["epoch"] == "0"
+            assert forks["data"][0]["current_version"].startswith("0x")
+        finally:
+            await api.stop()
+    asyncio.run(run())
