@@ -11,19 +11,25 @@ from ..config import (GENESIS_EPOCH, PARTICIPATION_FLAG_WEIGHTS,
                       TIMELY_TARGET_FLAG_INDEX, WEIGHT_DENOMINATOR)
 from .. import epoch as E0
 from .. import helpers as H
+from .. import vectorized as _V
 from . import helpers as AH
 
 
 def process_justification_and_finalization(cfg: SpecConfig, state):
     if H.get_current_epoch(cfg, state) <= GENESIS_EPOCH + 1:
         return state
+    if len(state.validators) >= _V.VECTOR_THRESHOLD:
+        prev_bal, cur_bal = _V.target_participation_balances(cfg, state)
+        return E0.weigh_justification_and_finalization(
+            cfg, state, _V.total_active_balance(cfg, state),
+            prev_bal, cur_bal)
+    total = H.get_total_active_balance(cfg, state)
     prev = AH.get_unslashed_participating_indices(
         cfg, state, TIMELY_TARGET_FLAG_INDEX,
         H.get_previous_epoch(cfg, state))
     cur = AH.get_unslashed_participating_indices(
         cfg, state, TIMELY_TARGET_FLAG_INDEX,
         H.get_current_epoch(cfg, state))
-    total = H.get_total_active_balance(cfg, state)
     return E0.weigh_justification_and_finalization(
         cfg, state, total,
         H.get_total_balance(cfg, state, prev),
@@ -33,6 +39,8 @@ def process_justification_and_finalization(cfg: SpecConfig, state):
 def process_inactivity_updates(cfg: SpecConfig, state):
     if H.get_current_epoch(cfg, state) == GENESIS_EPOCH:
         return state
+    if len(state.validators) >= _V.VECTOR_THRESHOLD:
+        return _V.process_inactivity_updates(cfg, state)
     scores = list(state.inactivity_scores)
     target_idx = AH.get_unslashed_participating_indices(
         cfg, state, TIMELY_TARGET_FLAG_INDEX,
@@ -101,6 +109,12 @@ def process_rewards_and_penalties(cfg: SpecConfig, state,
                                   inactivity_quotient=None):
     if H.get_current_epoch(cfg, state) == GENESIS_EPOCH:
         return state
+    if len(state.validators) >= _V.VECTOR_THRESHOLD:
+        try:
+            return _V.process_rewards_and_penalties(
+                cfg, state, inactivity_quotient)
+        except _V.OverflowRisk:
+            pass     # exact big-int scalar path below
     deltas = [get_flag_index_deltas(cfg, state, f)
               for f in range(len(PARTICIPATION_FLAG_WEIGHTS))]
     deltas.append(get_inactivity_penalty_deltas(cfg, state,
@@ -115,10 +129,12 @@ def process_rewards_and_penalties(cfg: SpecConfig, state,
 def process_slashings(cfg: SpecConfig, state, multiplier=None):
     """Altair: proportional multiplier 2 (spec process_slashings);
     bellatrix overrides the multiplier to 3."""
-    epoch = H.get_current_epoch(cfg, state)
-    total = H.get_total_active_balance(cfg, state)
     if multiplier is None:
         multiplier = cfg.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
+    if len(state.validators) >= _V.VECTOR_THRESHOLD:
+        return _V.process_slashings(cfg, state, multiplier)
+    epoch = H.get_current_epoch(cfg, state)
+    total = H.get_total_active_balance(cfg, state)
     adjusted = min(sum(state.slashings) * multiplier, total)
     inc = cfg.EFFECTIVE_BALANCE_INCREMENT
     balances = list(state.balances)

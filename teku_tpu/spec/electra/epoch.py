@@ -144,6 +144,10 @@ def process_pending_consolidations(cfg: SpecConfig, state):
 
 def process_effective_balance_updates(cfg: SpecConfig, state):
     """Hysteresis against the per-validator (compounding-aware) cap."""
+    from .. import vectorized as _V
+    if len(state.validators) >= _V.VECTOR_THRESHOLD:
+        return _V.process_effective_balance_updates(
+            cfg, state, max_eb_fn=EH.get_max_effective_balance)
     validators = list(state.validators)
     changed = False
     inc = cfg.EFFECTIVE_BALANCE_INCREMENT
@@ -171,6 +175,11 @@ def process_slashings(cfg: SpecConfig, state):
     reference: ethereum/spec/.../logic/versions/electra/statetransition/
     epoch/EpochProcessorElectra.java (processSlashings override).
     """
+    from .. import vectorized as _V
+    if len(state.validators) >= _V.VECTOR_THRESHOLD:
+        return _V.process_slashings(
+            cfg, state, cfg.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX,
+            per_increment=True)
     epoch = H.get_current_epoch(cfg, state)
     total = H.get_total_active_balance(cfg, state)
     adjusted = min(

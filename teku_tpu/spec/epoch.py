@@ -240,6 +240,9 @@ def process_registry_updates(cfg: SpecConfig, state,
                              activation_limit=None):
     """`activation_limit` overrides the churn-derived activation cap
     (deneb's EIP-7514 activation churn limit routes through here)."""
+    from . import vectorized as _V
+    if len(state.validators) >= _V.VECTOR_THRESHOLD:
+        return _V.process_registry_updates(cfg, state, activation_limit)
     current_epoch = H.get_current_epoch(cfg, state)
     validators = list(state.validators)
     changed = False
@@ -272,6 +275,10 @@ def process_registry_updates(cfg: SpecConfig, state,
 
 
 def process_slashings(cfg: SpecConfig, state):
+    from . import vectorized as _V
+    if len(state.validators) >= _V.VECTOR_THRESHOLD:
+        return _V.process_slashings(
+            cfg, state, cfg.PROPORTIONAL_SLASHING_MULTIPLIER)
     epoch = H.get_current_epoch(cfg, state)
     total_balance = H.get_total_active_balance(cfg, state)
     adjusted = min(sum(state.slashings)
@@ -295,6 +302,9 @@ def process_eth1_data_reset(cfg: SpecConfig, state):
 
 
 def process_effective_balance_updates(cfg: SpecConfig, state):
+    from . import vectorized as _V
+    if len(state.validators) >= _V.VECTOR_THRESHOLD:
+        return _V.process_effective_balance_updates(cfg, state)
     validators = list(state.validators)
     changed = False
     inc = cfg.EFFECTIVE_BALANCE_INCREMENT

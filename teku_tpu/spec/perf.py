@@ -1,0 +1,97 @@
+"""Synthetic large-validator states for state-transition benchmarks.
+
+The reference benchmarks epoch transitions against generated states of
+300k+ validators (reference: eth-benchmark-tests/.../
+EpochTransitionBenchmark.java and its .ssz state resources); this
+module builds the equivalent in-memory — real containers, plausible
+balances/participation, NO BLS work (pubkeys are synthetic: epoch
+processing never checks signatures, so keygen would be pure waste on
+the hot path we're measuring).
+"""
+
+import dataclasses
+import random
+
+from . import config as C
+from . import helpers as H
+from .config import FAR_FUTURE_EPOCH, SpecConfig
+from .datastructures import (BeaconBlockHeader, Checkpoint, Eth1Data,
+                             Fork, Validator)
+
+
+def perf_config(base: SpecConfig = None) -> SpecConfig:
+    """Mainnet-preset config with altair live at genesis."""
+    return dataclasses.replace(base or C.MAINNET, ALTAIR_FORK_EPOCH=0)
+
+
+def make_synthetic_altair_state(cfg: SpecConfig, n_validators: int,
+                                epoch: int = 5,
+                                participation_rate: float = 0.99,
+                                seed: int = 1234):
+    """An altair BeaconState at the LAST slot of `epoch` (the slot
+    process_epoch runs for), with `participation_rate` of validators
+    carrying all three timely flags and the rest absent."""
+    from .altair.datastructures import get_altair_schemas
+
+    assert cfg.ALTAIR_FORK_EPOCH == 0, "build against an altair config"
+    S = get_altair_schemas(cfg)
+    rng = random.Random(seed)
+    max_eb = cfg.MAX_EFFECTIVE_BALANCE
+    validators = tuple(
+        Validator(
+            pubkey=i.to_bytes(6, "little") * 8,
+            withdrawal_credentials=b"\x01" + bytes(11)
+            + i.to_bytes(20, "little"),
+            effective_balance=max_eb,
+            activation_eligibility_epoch=0, activation_epoch=0,
+            exit_epoch=FAR_FUTURE_EPOCH,
+            withdrawable_epoch=FAR_FUTURE_EPOCH)
+        for i in range(n_validators))
+    balances = tuple(
+        max_eb + rng.randrange(-10 ** 9, 10 ** 9)
+        for _ in range(n_validators))
+    full = (1 << 0) | (1 << 1) | (1 << 2)        # all timely flags
+    participation = tuple(
+        full if rng.random() < participation_rate else 0
+        for _ in range(n_validators))
+    slot = (epoch + 1) * cfg.SLOTS_PER_EPOCH - 1
+    root = b"\x5b" * 32
+    committee_pubkeys = tuple(
+        validators[i % n_validators].pubkey
+        for i in range(cfg.SYNC_COMMITTEE_SIZE))
+    sync_committee = S.SyncCommittee(
+        pubkeys=committee_pubkeys,
+        aggregate_pubkey=b"\xc0" + bytes(47))
+    return S.BeaconState(
+        genesis_time=0,
+        genesis_validators_root=b"\x33" * 32,
+        slot=slot,
+        fork=Fork(previous_version=cfg.GENESIS_FORK_VERSION,
+                  current_version=cfg.ALTAIR_FORK_VERSION,
+                  epoch=0),
+        latest_block_header=BeaconBlockHeader(body_root=b"\x44" * 32),
+        block_roots=tuple(root
+                          for _ in range(cfg.SLOTS_PER_HISTORICAL_ROOT)),
+        state_roots=tuple(bytes(32)
+                          for _ in range(cfg.SLOTS_PER_HISTORICAL_ROOT)),
+        eth1_data=Eth1Data(deposit_root=bytes(32),
+                           deposit_count=n_validators,
+                           block_hash=b"\x42" * 32),
+        eth1_deposit_index=n_validators,
+        validators=validators,
+        balances=balances,
+        randao_mixes=tuple(
+            b"\x77" * 32 for _ in range(cfg.EPOCHS_PER_HISTORICAL_VECTOR)),
+        slashings=tuple(0 for _ in range(cfg.EPOCHS_PER_SLASHINGS_VECTOR)),
+        previous_epoch_participation=participation,
+        current_epoch_participation=participation,
+        justification_bits=(True, True, True, True),
+        previous_justified_checkpoint=Checkpoint(epoch=epoch - 2,
+                                                 root=root),
+        current_justified_checkpoint=Checkpoint(epoch=epoch - 1,
+                                                root=root),
+        finalized_checkpoint=Checkpoint(epoch=epoch - 2, root=root),
+        inactivity_scores=tuple(0 for _ in range(n_validators)),
+        current_sync_committee=sync_committee,
+        next_sync_committee=sync_committee,
+    )

@@ -1,0 +1,309 @@
+"""Vectorized (numpy) epoch-processing hot loops.
+
+The per-validator Python loops in altair epoch processing are O(V)
+interpreter iterations each; at mainnet scale (300k-1M validators)
+that's seconds per epoch.  These numpy passes compute the same exact
+integer math over flat arrays — the TPU-framework shape (struct-of-
+arrays, batch math) applied to the state transition's own hot path,
+mirroring how the reference leans on optimized batch processing for
+exactly these loops (reference: eth-benchmark-tests/src/jmh/java/
+tech/pegasys/teku/benchmarks/EpochTransitionBenchmark.java measures
+them; ethereum/spec/.../epoch/RewardsAndPenaltiesCalculatorAltair.java
+is the scalar source of truth).
+
+Every function here is an exact drop-in for its scalar twin: all
+arithmetic is integer, floor-division ordering is preserved, and the
+scalar implementations remain the differential-test oracle
+(tests/test_vectorized_epoch.py).  int64 overflow is checked up front;
+states that could overflow (pathological inactivity scores) fall back
+to the scalar path.
+"""
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .config import (PARTICIPATION_FLAG_WEIGHTS, SpecConfig,
+                     TIMELY_HEAD_FLAG_INDEX, TIMELY_TARGET_FLAG_INDEX,
+                     WEIGHT_DENOMINATOR)
+from . import helpers as H
+
+# below this the numpy fixed costs beat the loop they replace
+VECTOR_THRESHOLD = 256
+
+
+class OverflowRisk(Exception):
+    """Raised when int64 headroom cannot be guaranteed — callers fall
+    back to exact big-int scalar code."""
+
+
+# (validators_tuple, arrays) pairs, newest first: the registry tuple
+# is immutable and shared across most of an epoch's passes, so one
+# O(V) attribute-extraction pass serves them all.  Identity-keyed —
+# any registry change produces a new tuple.
+_ARRAY_CACHE: list = []
+_ARRAY_CACHE_MAX = 4
+
+
+def validator_arrays(state):
+    """Struct-of-arrays view of the validator registry (one O(V) pass;
+    everything downstream is array math)."""
+    vals = state.validators
+    for entry in _ARRAY_CACHE:
+        if entry[0] is vals:
+            return entry[1]
+    n = len(vals)
+    eb = np.empty(n, dtype=np.int64)
+    slashed = np.empty(n, dtype=bool)
+    activation = np.empty(n, dtype=np.int64)
+    exit_epoch = np.empty(n, dtype=np.int64)
+    withdrawable = np.empty(n, dtype=np.int64)
+    eligibility = np.empty(n, dtype=np.int64)
+    far = np.iinfo(np.int64).max
+    for i, v in enumerate(vals):
+        eb[i] = v.effective_balance
+        slashed[i] = v.slashed
+        activation[i] = min(v.activation_epoch, far)
+        exit_epoch[i] = min(v.exit_epoch, far)
+        withdrawable[i] = min(v.withdrawable_epoch, far)
+        eligibility[i] = min(v.activation_eligibility_epoch, far)
+    arrays = (eb, slashed, activation, exit_epoch, withdrawable,
+              eligibility)
+    _ARRAY_CACHE.insert(0, (vals, arrays))
+    del _ARRAY_CACHE[_ARRAY_CACHE_MAX:]
+    return arrays
+
+
+def total_active_balance(cfg: SpecConfig, state) -> int:
+    """Exact twin of H.get_total_active_balance without the index-set
+    build (O(V) python loop → one masked array sum)."""
+    cur = H.get_current_epoch(cfg, state)
+    eb, _, activation, exit_epoch, _, _ = validator_arrays(state)
+    active = (activation <= cur) & (cur < exit_epoch)
+    return max(cfg.EFFECTIVE_BALANCE_INCREMENT, int(eb[active].sum()))
+
+
+def _epoch_masks(cfg: SpecConfig, state):
+    """(eligible, active_prev, prev_participation) shared by the reward
+    and inactivity passes."""
+    prev_epoch = H.get_previous_epoch(cfg, state)
+    eb, slashed, activation, exit_epoch, withdrawable, _ = \
+        validator_arrays(state)
+    active_prev = (activation <= prev_epoch) & (prev_epoch < exit_epoch)
+    eligible = active_prev | (slashed & (prev_epoch + 1 < withdrawable))
+    part = np.fromiter(state.previous_epoch_participation,
+                       dtype=np.int64, count=len(eb))
+    return eb, slashed, active_prev, eligible, part
+
+
+def _unslashed_flag_mask(active_prev, slashed, part, flag_index):
+    return active_prev & ~slashed & ((part >> flag_index) & 1 == 1)
+
+
+def process_rewards_and_penalties(cfg: SpecConfig, state,
+                                  inactivity_quotient=None):
+    """Altair+ rewards/penalties: all flag deltas plus inactivity
+    penalties in one array pass (scalar twin:
+    altair/epoch.py get_flag_index_deltas +
+    get_inactivity_penalty_deltas + process_rewards_and_penalties)."""
+    from .altair import helpers as AH
+
+    eb, slashed, active_prev, eligible, part = _epoch_masks(cfg, state)
+    inc = cfg.EFFECTIVE_BALANCE_INCREMENT
+    total_active = total_active_balance(cfg, state)
+    active_increments = total_active // inc
+    base_per_inc = (inc * cfg.BASE_REWARD_FACTOR
+                    // H.integer_squareroot(total_active))
+    base_reward = (eb // inc) * base_per_inc
+    from . import epoch as E0
+    leaking = E0.is_in_inactivity_leak(cfg, state)
+
+    # int64 headroom: base_reward * weight * unslashed_increments
+    if int(base_reward.max(initial=0)) * 64 * max(active_increments, 1) \
+            >= 2 ** 62:
+        raise OverflowRisk("flag delta product")
+
+    # the scalar oracle clamps at zero after EACH delta list (one per
+    # flag, then inactivity) — a drained balance zeroed by one list's
+    # penalty then re-credited by the next differs from a single net
+    # clamp, so the application order IS consensus-relevant
+    balances = np.fromiter(state.balances, dtype=np.int64,
+                           count=len(eb))
+    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        unslashed = _unslashed_flag_mask(active_prev, slashed, part,
+                                         flag_index)
+        unslashed_increments = max(
+            inc, int(eb[unslashed].sum())) // inc
+        rewards = np.zeros(len(eb), dtype=np.int64)
+        penalties = np.zeros(len(eb), dtype=np.int64)
+        if not leaking:
+            flag_rewards = (base_reward * weight * unslashed_increments
+                            // (active_increments * WEIGHT_DENOMINATOR))
+            rewards = np.where(eligible & unslashed, flag_rewards, 0)
+        if flag_index != TIMELY_HEAD_FLAG_INDEX:
+            flag_pens = base_reward * weight // WEIGHT_DENOMINATOR
+            penalties = np.where(eligible & ~unslashed, flag_pens, 0)
+        balances = np.maximum(0, balances + rewards - penalties)
+
+    # inactivity penalties (their own delta list, own clamp)
+    quotient = (cfg.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+                if inactivity_quotient is None else inactivity_quotient)
+    scores = np.fromiter(state.inactivity_scores, dtype=np.int64,
+                         count=len(eb))
+    if int(eb.max(initial=0)) * int(scores.max(initial=0)) >= 2 ** 62:
+        raise OverflowRisk("inactivity product")
+    not_target = ~_unslashed_flag_mask(active_prev, slashed, part,
+                                       TIMELY_TARGET_FLAG_INDEX)
+    divisor = cfg.INACTIVITY_SCORE_BIAS * quotient
+    inact = np.where(eligible & not_target, eb * scores // divisor, 0)
+    balances = np.maximum(0, balances - inact)
+    return state.copy_with(balances=tuple(balances.tolist()))
+
+
+def process_inactivity_updates(cfg: SpecConfig, state):
+    """Scalar twin: altair/epoch.py process_inactivity_updates."""
+    from . import epoch as E0
+
+    eb, slashed, active_prev, eligible, part = _epoch_masks(cfg, state)
+    scores = np.fromiter(state.inactivity_scores, dtype=np.int64,
+                         count=len(eb))
+    participated = _unslashed_flag_mask(active_prev, slashed, part,
+                                        TIMELY_TARGET_FLAG_INDEX)
+    scores = np.where(eligible & participated,
+                      scores - np.minimum(1, scores), scores)
+    scores = np.where(eligible & ~participated,
+                      scores + cfg.INACTIVITY_SCORE_BIAS, scores)
+    if not E0.is_in_inactivity_leak(cfg, state):
+        scores = np.where(
+            eligible,
+            scores - np.minimum(cfg.INACTIVITY_SCORE_RECOVERY_RATE,
+                                scores),
+            scores)
+    return state.copy_with(inactivity_scores=tuple(scores.tolist()))
+
+
+def process_effective_balance_updates(cfg: SpecConfig, state,
+                                      max_eb_fn=None):
+    """Hysteresis sweep: numpy finds the (typically few) validators
+    whose effective balance moves; only those objects are rebuilt
+    (scalar twin: epoch.py process_effective_balance_updates; electra
+    passes max_eb_fn for per-credential caps)."""
+    n = len(state.validators)
+    eb = validator_arrays(state)[0]
+    balances = np.fromiter(state.balances, dtype=np.int64, count=n)
+    inc = cfg.EFFECTIVE_BALANCE_INCREMENT
+    down = inc * cfg.HYSTERESIS_DOWNWARD_MULTIPLIER \
+        // cfg.HYSTERESIS_QUOTIENT
+    up = inc * cfg.HYSTERESIS_UPWARD_MULTIPLIER // cfg.HYSTERESIS_QUOTIENT
+    moved = (balances + down < eb) | (eb + up < balances)
+    idx = np.nonzero(moved)[0]
+    if not len(idx):
+        return state
+    validators = list(state.validators)
+    for i in idx.tolist():
+        v = validators[i]
+        cap = (cfg.MAX_EFFECTIVE_BALANCE if max_eb_fn is None
+               else max_eb_fn(cfg, v))
+        validators[i] = v.copy_with(effective_balance=min(
+            int(balances[i]) - int(balances[i]) % inc, cap))
+    return state.copy_with(validators=tuple(validators))
+
+
+_FAR_I64 = np.iinfo(np.int64).max    # FAR_FUTURE_EPOCH clipped
+
+
+def process_slashings(cfg: SpecConfig, state, multiplier: int,
+                      per_increment: bool = False):
+    """Correlation-penalty sweep: array detection of the (rare)
+    validators slashed half a slashings-vector ago, exact big-int math
+    per hit.  `per_increment` selects the EIP-7251 electra rounding
+    (scalar twins: epoch.py/altair/electra process_slashings)."""
+    epoch = H.get_current_epoch(cfg, state)
+    _, slashed, _, _, withdrawable, _ = validator_arrays(state)
+    target = slashed & (withdrawable
+                        == epoch + cfg.EPOCHS_PER_SLASHINGS_VECTOR // 2)
+    idx = np.nonzero(target)[0]
+    if not len(idx):
+        return state.copy_with(balances=state.balances)
+    total = total_active_balance(cfg, state)
+    adjusted = min(sum(state.slashings) * multiplier, total)
+    inc = cfg.EFFECTIVE_BALANCE_INCREMENT
+    balances = list(state.balances)
+    for i in idx.tolist():
+        eb = state.validators[i].effective_balance
+        if per_increment:
+            penalty = (adjusted // (total // inc)) * (eb // inc)
+        else:
+            penalty = eb // inc * adjusted // total * inc
+        balances[i] = max(0, balances[i] - penalty)
+    return state.copy_with(balances=tuple(balances))
+
+
+def process_registry_updates(cfg: SpecConfig, state,
+                             activation_limit=None):
+    """Phase0 registry sweep with array candidate detection; the
+    per-validator object work happens only on actual hits (scalar
+    twin: epoch.py process_registry_updates)."""
+    current_epoch = H.get_current_epoch(cfg, state)
+    eb, slashed, activation, exit_epoch, withdrawable, eligibility = \
+        validator_arrays(state)
+
+    # entry into the activation queue
+    enter = (eligibility == _FAR_I64) & (eb == cfg.MAX_EFFECTIVE_BALANCE)
+    enter_idx = np.nonzero(enter)[0]
+    if len(enter_idx):
+        validators = list(state.validators)
+        for i in enter_idx.tolist():
+            validators[i] = validators[i].copy_with(
+                activation_eligibility_epoch=current_epoch + 1)
+        state = state.copy_with(validators=tuple(validators))
+
+    # ejections (exit-queue helper mutates sequentially — keep scalar
+    # per hit; hits are rare)
+    active_now = (activation <= current_epoch) \
+        & (current_epoch < exit_epoch)
+    eject = active_now & (eb <= cfg.EJECTION_BALANCE)
+    for i in np.nonzero(eject)[0].tolist():
+        state = H.initiate_validator_exit(cfg, state, i)
+
+    # dequeue up to the churn limit, ordered by (eligibility, index);
+    # NOTE: arrays above predate the entry/ejection edits, but entry
+    # this epoch sets eligibility=current+1 > finalized so those rows
+    # can't be dequeued, and ejection touches exit fields only
+    finalized_epoch = state.finalized_checkpoint.epoch
+    if len(enter_idx):   # registry changed: refresh the dequeue view
+        _, _, activation, _, _, eligibility = validator_arrays(state)
+    ready = (eligibility <= finalized_epoch) & (activation == _FAR_I64)
+    queue = sorted(np.nonzero(ready)[0].tolist(),
+                   key=lambda i: (int(eligibility[i]), i))
+    churn = ((max(cfg.MIN_PER_EPOCH_CHURN_LIMIT,
+                  int(active_now.sum()) // cfg.CHURN_LIMIT_QUOTIENT))
+             if activation_limit is None else activation_limit)
+    if queue:
+        validators = list(state.validators)
+        target_epoch = H.compute_activation_exit_epoch(cfg, current_epoch)
+        for i in queue[:churn]:
+            validators[i] = validators[i].copy_with(
+                activation_epoch=target_epoch)
+        state = state.copy_with(validators=tuple(validators))
+    return state
+
+
+def target_participation_balances(cfg: SpecConfig, state
+                                  ) -> Tuple[int, int]:
+    """(previous_target_balance, current_target_balance) for altair
+    justification — array sums instead of building index sets (scalar
+    twin: altair/epoch.py process_justification_and_finalization)."""
+    prev_epoch = H.get_previous_epoch(cfg, state)
+    cur_epoch = H.get_current_epoch(cfg, state)
+    eb, slashed, activation, exit_epoch, _, _ = validator_arrays(state)
+    inc = cfg.EFFECTIVE_BALANCE_INCREMENT
+    out = []
+    for epoch, raw in ((prev_epoch, state.previous_epoch_participation),
+                       (cur_epoch, state.current_epoch_participation)):
+        part = np.fromiter(raw, dtype=np.int64, count=len(eb))
+        active = (activation <= epoch) & (epoch < exit_epoch)
+        mask = active & ~slashed & (
+            (part >> TIMELY_TARGET_FLAG_INDEX) & 1 == 1)
+        out.append(max(inc, int(eb[mask].sum())))
+    return out[0], out[1]

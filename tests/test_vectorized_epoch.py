@@ -1,0 +1,196 @@
+"""Differential tests: vectorized epoch hot loops vs the scalar spec
+implementations — exact integer equality on a messy registry (slashed,
+exited, partially-participating, leaking validators)."""
+
+import dataclasses
+import random
+
+from teku_tpu.spec import config as C
+from teku_tpu.spec import helpers as H
+from teku_tpu.spec import perf as P
+from teku_tpu.spec import vectorized as V
+from teku_tpu.spec.altair import epoch as AE
+from teku_tpu.spec import epoch as E0
+
+CFG = P.perf_config(C.MINIMAL)
+N = 600
+
+
+def _messy_state(leaking=False, seed=7):
+    rng = random.Random(seed)
+    epoch = 5
+    state = P.make_synthetic_altair_state(CFG, N, epoch=epoch,
+                                          participation_rate=0.0,
+                                          seed=seed)
+    validators = list(state.validators)
+    participation = []
+    scores = []
+    for i in range(N):
+        flags = 0
+        for f in range(3):
+            if rng.random() < 0.8:
+                flags |= 1 << f
+        participation.append(flags)
+        scores.append(rng.randrange(0, 50))
+        if rng.random() < 0.05:       # slashed, pending withdrawal
+            validators[i] = validators[i].copy_with(
+                slashed=True,
+                withdrawable_epoch=epoch
+                + CFG.EPOCHS_PER_SLASHINGS_VECTOR // 2)
+        elif rng.random() < 0.05:     # exited
+            validators[i] = validators[i].copy_with(
+                exit_epoch=epoch - 1, withdrawable_epoch=epoch + 1)
+    slashings = list(state.slashings)
+    slashings[0] = 7 * CFG.EFFECTIVE_BALANCE_INCREMENT
+    # near-zero balances make the per-delta-list clamp ordering
+    # observable (a net-sum clamp diverges exactly there)
+    balances = list(state.balances)
+    for i in range(0, N, 9):
+        balances[i] = rng.randrange(0, 200_000)
+    state = state.copy_with(
+        balances=tuple(balances),
+        validators=tuple(validators),
+        previous_epoch_participation=tuple(participation),
+        current_epoch_participation=tuple(
+            reversed(participation)),
+        inactivity_scores=tuple(scores),
+        slashings=tuple(slashings))
+    if leaking:
+        # finality far behind → is_in_inactivity_leak
+        state = state.copy_with(
+            finalized_checkpoint=state.finalized_checkpoint.copy_with(
+                epoch=0),
+            justification_bits=(False, False, False, False))
+    return state
+
+
+def _scalar(fn, *args, **kw):
+    """Run `fn` with vectorization forced off."""
+    saved = V.VECTOR_THRESHOLD
+    V.VECTOR_THRESHOLD = 10 ** 9
+    try:
+        return fn(*args, **kw)
+    finally:
+        V.VECTOR_THRESHOLD = saved
+
+
+def test_rewards_and_penalties_exact_match():
+    for leaking in (False, True):
+        state = _messy_state(leaking=leaking)
+        scalar = _scalar(AE.process_rewards_and_penalties, CFG, state)
+        vec = V.process_rewards_and_penalties(CFG, state)
+        assert scalar.balances == vec.balances
+
+
+def test_rewards_with_bellatrix_quotient_match():
+    state = _messy_state()
+    q = CFG.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
+    scalar = _scalar(AE.process_rewards_and_penalties, CFG, state,
+                     inactivity_quotient=q)
+    vec = V.process_rewards_and_penalties(CFG, state,
+                                          inactivity_quotient=q)
+    assert scalar.balances == vec.balances
+
+
+def test_inactivity_updates_exact_match():
+    for leaking in (False, True):
+        state = _messy_state(leaking=leaking, seed=11)
+        scalar = _scalar(AE.process_inactivity_updates, CFG, state)
+        vec = V.process_inactivity_updates(CFG, state)
+        assert scalar.inactivity_scores == vec.inactivity_scores
+
+
+def test_effective_balance_updates_exact_match():
+    state = _messy_state(seed=13)
+    # skew balances so hysteresis moves a subset
+    rng = random.Random(3)
+    balances = [b + rng.randrange(-3 * 10 ** 9, 3 * 10 ** 9)
+                for b in state.balances]
+    state = state.copy_with(balances=tuple(balances))
+    scalar = _scalar(E0.process_effective_balance_updates, CFG, state)
+    vec = V.process_effective_balance_updates(CFG, state)
+    assert scalar.validators == vec.validators
+
+
+def test_justification_balances_match():
+    state = _messy_state(seed=17)
+    from teku_tpu.spec.altair import helpers as AH
+    from teku_tpu.spec.config import TIMELY_TARGET_FLAG_INDEX
+    prev = AH.get_unslashed_participating_indices(
+        CFG, state, TIMELY_TARGET_FLAG_INDEX,
+        H.get_previous_epoch(CFG, state))
+    cur = AH.get_unslashed_participating_indices(
+        CFG, state, TIMELY_TARGET_FLAG_INDEX,
+        H.get_current_epoch(CFG, state))
+    want = (H.get_total_balance(CFG, state, prev),
+            H.get_total_balance(CFG, state, cur))
+    assert V.target_participation_balances(CFG, state) == want
+
+
+def test_full_epoch_matches_scalar_end_to_end():
+    state = _messy_state(seed=23)
+    scalar = _scalar(AE.process_epoch, CFG, state)
+    vec = AE.process_epoch(CFG, state)      # dispatches (N >= 256)
+    assert scalar.balances == vec.balances
+    assert scalar.inactivity_scores == vec.inactivity_scores
+    assert scalar.validators == vec.validators
+    assert scalar.htr() == vec.htr()
+
+
+def test_overflow_risk_falls_back_to_scalar():
+    state = _messy_state(seed=29)
+    state = state.copy_with(inactivity_scores=tuple(
+        2 ** 55 for _ in range(N)))
+    import pytest
+    with pytest.raises(V.OverflowRisk):
+        V.process_rewards_and_penalties(CFG, state)
+    # the dispatching wrapper survives via the big-int path
+    out = AE.process_rewards_and_penalties(CFG, state)
+    assert len(out.balances) == N
+
+
+def test_registry_updates_exact_match():
+    from teku_tpu.spec.config import FAR_FUTURE_EPOCH
+    rng = random.Random(41)
+    state = _messy_state(seed=41)
+    validators = list(state.validators)
+    for i in range(N):
+        r = rng.random()
+        if r < 0.1:      # fresh deposit: waiting to enter the queue
+            validators[i] = validators[i].copy_with(
+                activation_eligibility_epoch=FAR_FUTURE_EPOCH,
+                activation_epoch=FAR_FUTURE_EPOCH)
+        elif r < 0.2:    # queued: eligibility finalized, not yet active
+            validators[i] = validators[i].copy_with(
+                activation_eligibility_epoch=rng.randrange(0, 3),
+                activation_epoch=FAR_FUTURE_EPOCH)
+        elif r < 0.25:   # ejectable
+            validators[i] = validators[i].copy_with(
+                effective_balance=CFG.EJECTION_BALANCE)
+    state = state.copy_with(validators=tuple(validators))
+    scalar = _scalar(E0.process_registry_updates, CFG, state)
+    vec = V.process_registry_updates(CFG, state)
+    assert scalar.validators == vec.validators
+    assert scalar.htr() == vec.htr()
+    # deneb's explicit activation cap routes through the same path
+    scalar2 = _scalar(E0.process_registry_updates, CFG, state,
+                      activation_limit=3)
+    vec2 = V.process_registry_updates(CFG, state, activation_limit=3)
+    assert scalar2.validators == vec2.validators
+
+
+def test_slashings_exact_match_all_modes():
+    state = _messy_state(seed=43)
+    from teku_tpu.spec.electra import epoch as XE
+    for mult in (CFG.PROPORTIONAL_SLASHING_MULTIPLIER,
+                 CFG.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR,
+                 CFG.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX):
+        scalar = _scalar(AE.process_slashings, CFG, state,
+                         multiplier=mult)
+        vec = V.process_slashings(CFG, state, mult)
+        assert scalar.balances == vec.balances
+    scalar_e = _scalar(XE.process_slashings, CFG, state)
+    vec_e = V.process_slashings(
+        CFG, state, CFG.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX,
+        per_increment=True)
+    assert scalar_e.balances == vec_e.balances
