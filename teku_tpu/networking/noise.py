@@ -1,0 +1,298 @@
+"""Noise XX handshake + transport encryption for the TCP stack.
+
+The role of the reference's libp2p noise security upgrade (reference:
+networking/p2p/.../libp2p/LibP2PNetworkBuilder.java:219 — there
+jvm-libp2p's Noise_XX_25519_ChaChaPoly_SHA256; here the same protocol
+implemented directly per the Noise Protocol Framework spec rev 34):
+
+    -> e
+    <- e, ee, s, es
+    -> s, se
+
+Both sides authenticate with a static X25519 key transmitted
+encrypted inside the handshake; the static public key IS the peer's
+wire identity (libp2p derives peer ids from it the same way).  After
+the handshake, split() yields one CipherState per direction and every
+byte on the socket is ChaCha20-Poly1305 AEAD inside u16-length-
+prefixed noise messages (<= 65535 bytes each, the noise cap).
+
+AEAD/X25519/HMAC primitives come from the `cryptography` library; the
+handshake state machine below is the Noise spec's, written against
+its section 5 pseudocode.
+"""
+
+import hashlib
+import hmac as _hmac
+import struct
+from typing import Optional, Tuple
+
+from cryptography.hazmat.primitives.asymmetric.x25519 import (
+    X25519PrivateKey, X25519PublicKey)
+from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
+
+PROTOCOL_NAME = b"Noise_XX_25519_ChaChaPoly_SHA256"
+MAX_NOISE_MESSAGE = 65535
+MAX_NOISE_PLAINTEXT = MAX_NOISE_MESSAGE - 16      # AEAD tag
+
+
+class NoiseError(Exception):
+    pass
+
+
+def _hash(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def _hmac_sha256(key: bytes, data: bytes) -> bytes:
+    return _hmac.new(key, data, hashlib.sha256).digest()
+
+
+def _hkdf(chaining_key: bytes, ikm: bytes, n: int) -> Tuple[bytes, ...]:
+    """Noise HKDF (spec 4.3): temp = HMAC(ck, ikm); out1 = HMAC(temp,
+    0x01); out2 = HMAC(temp, out1 || 0x02); ..."""
+    temp = _hmac_sha256(chaining_key, ikm)
+    outputs = []
+    prev = b""
+    for i in range(1, n + 1):
+        prev = _hmac_sha256(temp, prev + bytes([i]))
+        outputs.append(prev)
+    return tuple(outputs)
+
+
+def generate_static_keypair() -> Tuple[X25519PrivateKey, bytes]:
+    sk = X25519PrivateKey.generate()
+    return sk, sk.public_key().public_bytes_raw()
+
+
+class CipherState:
+    """Noise spec 5.1: a ChaCha20-Poly1305 key and a nonce counter
+    (96-bit nonce = 4 zero bytes || u64 little-endian n)."""
+
+    def __init__(self, key: Optional[bytes] = None):
+        self.k = key
+        self.n = 0
+        # key import happens once; encrypt/decrypt run per frame chunk
+        self._cipher = None if key is None else ChaCha20Poly1305(key)
+
+    def has_key(self) -> bool:
+        return self.k is not None
+
+    def _nonce(self) -> bytes:
+        return bytes(4) + struct.pack("<Q", self.n)
+
+    def encrypt_with_ad(self, ad: bytes, plaintext: bytes) -> bytes:
+        if self._cipher is None:
+            return plaintext
+        if self.n >= 2 ** 64 - 1:
+            raise NoiseError("nonce exhausted")
+        ct = self._cipher.encrypt(self._nonce(), plaintext, ad)
+        self.n += 1
+        return ct
+
+    def decrypt_with_ad(self, ad: bytes, ciphertext: bytes) -> bytes:
+        if self._cipher is None:
+            return ciphertext
+        if self.n >= 2 ** 64 - 1:
+            raise NoiseError("nonce exhausted")
+        try:
+            pt = self._cipher.decrypt(self._nonce(), ciphertext, ad)
+        except Exception:
+            raise NoiseError("AEAD decryption failed")
+        self.n += 1
+        return pt
+
+
+class SymmetricState:
+    """Noise spec 5.2: chaining key + handshake hash."""
+
+    def __init__(self):
+        if len(PROTOCOL_NAME) <= 32:
+            self.h = PROTOCOL_NAME.ljust(32, b"\x00")
+        else:
+            self.h = _hash(PROTOCOL_NAME)
+        self.ck = self.h
+        self.cipher = CipherState()
+
+    def mix_key(self, ikm: bytes) -> None:
+        self.ck, temp_k = _hkdf(self.ck, ikm, 2)
+        self.cipher = CipherState(temp_k)
+
+    def mix_hash(self, data: bytes) -> None:
+        self.h = _hash(self.h + data)
+
+    def encrypt_and_hash(self, plaintext: bytes) -> bytes:
+        ct = self.cipher.encrypt_with_ad(self.h, plaintext)
+        self.mix_hash(ct)
+        return ct
+
+    def decrypt_and_hash(self, ciphertext: bytes) -> bytes:
+        pt = self.cipher.decrypt_with_ad(self.h, ciphertext)
+        self.mix_hash(ciphertext)
+        return pt
+
+    def split(self) -> Tuple[CipherState, CipherState]:
+        k1, k2 = _hkdf(self.ck, b"", 2)
+        return CipherState(k1), CipherState(k2)
+
+
+class XXHandshake:
+    """The three XX messages.  Drive with write_message_*/
+    read_message_* in pattern order; `remote_static` is available
+    after message 2 (initiator) / message 3 (responder)."""
+
+    def __init__(self, initiator: bool,
+                 static_key: X25519PrivateKey,
+                 prologue: bytes = b""):
+        self.initiator = initiator
+        self.s = static_key
+        self.s_pub = static_key.public_key().public_bytes_raw()
+        self.e: Optional[X25519PrivateKey] = None
+        self.re: Optional[bytes] = None
+        self.rs: Optional[bytes] = None
+        self.ss = SymmetricState()
+        self.ss.mix_hash(prologue)
+
+    # -- DH helpers ----------------------------------------------------
+    def _dh(self, sk: X25519PrivateKey, pub: bytes) -> bytes:
+        return sk.exchange(X25519PublicKey.from_public_bytes(pub))
+
+    # -- message 1: -> e -----------------------------------------------
+    def write_message_1(self) -> bytes:
+        assert self.initiator
+        self.e = X25519PrivateKey.generate()
+        e_pub = self.e.public_key().public_bytes_raw()
+        self.ss.mix_hash(e_pub)
+        return e_pub + self.ss.encrypt_and_hash(b"")
+
+    def read_message_1(self, msg: bytes) -> None:
+        assert not self.initiator
+        if len(msg) != 32:
+            raise NoiseError("message 1 must be a bare ephemeral key")
+        self.re = msg[:32]
+        self.ss.mix_hash(self.re)
+        self.ss.decrypt_and_hash(msg[32:])
+
+    # -- message 2: <- e, ee, s, es --------------------------------------
+    def write_message_2(self) -> bytes:
+        assert not self.initiator
+        self.e = X25519PrivateKey.generate()
+        e_pub = self.e.public_key().public_bytes_raw()
+        self.ss.mix_hash(e_pub)
+        self.ss.mix_key(self._dh(self.e, self.re))          # ee
+        s_ct = self.ss.encrypt_and_hash(self.s_pub)         # s
+        self.ss.mix_key(self._dh(self.s, self.re))          # es
+        payload_ct = self.ss.encrypt_and_hash(b"")
+        return e_pub + s_ct + payload_ct
+
+    def read_message_2(self, msg: bytes) -> None:
+        assert self.initiator
+        if len(msg) != 32 + 48 + 16:
+            raise NoiseError("bad message 2 length")
+        self.re = msg[:32]
+        self.ss.mix_hash(self.re)
+        self.ss.mix_key(self._dh(self.e, self.re))          # ee
+        self.rs = self.ss.decrypt_and_hash(msg[32:80])      # s
+        self.ss.mix_key(self._dh(self.e, self.rs))          # es
+        self.ss.decrypt_and_hash(msg[80:])
+
+    # -- message 3: -> s, se ---------------------------------------------
+    def write_message_3(self) -> Tuple[bytes, CipherState, CipherState]:
+        assert self.initiator
+        s_ct = self.ss.encrypt_and_hash(self.s_pub)         # s
+        self.ss.mix_key(self._dh(self.s, self.re))          # se
+        payload_ct = self.ss.encrypt_and_hash(b"")
+        tx, rx = self.ss.split()
+        return s_ct + payload_ct, tx, rx
+
+    def read_message_3(self, msg: bytes
+                       ) -> Tuple[CipherState, CipherState]:
+        assert not self.initiator
+        if len(msg) != 48 + 16:
+            raise NoiseError("bad message 3 length")
+        self.rs = self.ss.decrypt_and_hash(msg[:48])        # s
+        self.ss.mix_key(self._dh(self.e, self.rs))          # se
+        self.ss.decrypt_and_hash(msg[48:])
+        rx, tx = self.ss.split()
+        return tx, rx
+
+
+# -- asyncio stream integration ---------------------------------------------
+
+async def _read_noise_message(reader) -> bytes:
+    head = await reader.readexactly(2)
+    (n,) = struct.unpack(">H", head)
+    return await reader.readexactly(n)
+
+
+def _write_noise_message(writer, msg: bytes) -> None:
+    if len(msg) > MAX_NOISE_MESSAGE:
+        raise NoiseError("noise message too large")
+    writer.write(struct.pack(">H", len(msg)) + msg)
+
+
+async def initiator_handshake(reader, writer,
+                              static_key: X25519PrivateKey,
+                              prologue: bytes = b""):
+    """→ (tx, rx, remote_static_pub)."""
+    hs = XXHandshake(True, static_key, prologue)
+    _write_noise_message(writer, hs.write_message_1())
+    await writer.drain()
+    hs.read_message_2(await _read_noise_message(reader))
+    msg3, tx, rx = hs.write_message_3()
+    _write_noise_message(writer, msg3)
+    await writer.drain()
+    return tx, rx, hs.rs
+
+
+async def responder_handshake(reader, writer,
+                              static_key: X25519PrivateKey,
+                              prologue: bytes = b""):
+    """→ (tx, rx, remote_static_pub)."""
+    hs = XXHandshake(False, static_key, prologue)
+    hs.read_message_1(await _read_noise_message(reader))
+    _write_noise_message(writer, hs.write_message_2())
+    await writer.drain()
+    tx, rx = hs.read_message_3(await _read_noise_message(reader))
+    return tx, rx, hs.rs
+
+
+class NoiseWriter:
+    """Write side of the encrypted transport: plaintext is chunked to
+    the noise cap and AEAD-sealed per chunk."""
+
+    def __init__(self, writer, tx: CipherState):
+        self._writer = writer
+        self._tx = tx
+
+    def write(self, data: bytes) -> None:
+        for off in range(0, len(data), MAX_NOISE_PLAINTEXT):
+            chunk = data[off:off + MAX_NOISE_PLAINTEXT]
+            _write_noise_message(self._writer,
+                                 self._tx.encrypt_with_ad(b"", chunk))
+
+    async def drain(self) -> None:
+        await self._writer.drain()
+
+    def close(self) -> None:
+        self._writer.close()
+
+    def get_extra_info(self, *a, **kw):
+        return self._writer.get_extra_info(*a, **kw)
+
+
+class NoiseReader:
+    """Read side: decrypts noise messages and re-buffers plaintext so
+    readexactly() keeps its semantics."""
+
+    def __init__(self, reader, rx: CipherState):
+        self._reader = reader
+        self._rx = rx
+        self._buf = bytearray()
+
+    async def readexactly(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            ct = await _read_noise_message(self._reader)
+            self._buf += self._rx.decrypt_with_ad(b"", ct)
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out

@@ -58,6 +58,7 @@ class Peer:
             self.connected = False
 
     async def read_frame(self) -> Optional[Tuple[int, bytes]]:
+        from .noise import NoiseError
         try:
             head = await self.reader.readexactly(4)
             (n,) = struct.unpack("<I", head)
@@ -65,7 +66,11 @@ class Peer:
                 return None
             body = await self.reader.readexactly(n)
             return body[0], body[1:]
-        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+        except (asyncio.IncompleteReadError, ConnectionError, OSError,
+                NoiseError):
+            # NoiseError = garbage/tampered ciphertext after a good
+            # handshake: treat like a dead connection so the read loop
+            # cleans the peer up instead of dying mid-task
             return None
 
     async def request(self, method: str, payload: bytes,
@@ -97,17 +102,39 @@ class NetworkConfig:
     host: str = "127.0.0.1"
     port: int = 0                    # 0 = ephemeral
     max_peers: int = 32
+    # noise XX encryption (reference LibP2PNetworkBuilder.java:219 —
+    # the libp2p noise security upgrade); off only for tests that
+    # inspect raw frames
+    noise: bool = True
+
+
+# the noise prologue binds both sides to the same protocol framing
+_NOISE_PROLOGUE = b"teku-tpu/p2p/1"
 
 
 class P2PNetwork:
     """Listens + dials; owns per-peer read loops; hands decoded frames
-    to the gossip router and req/resp handler."""
+    to the gossip router and req/resp handler.  With noise enabled the
+    node's identity IS its noise static key: node_id == the X25519
+    static public key proven during the handshake."""
 
     def __init__(self, config: NetworkConfig, fork_digest: bytes,
-                 node_id: Optional[bytes] = None):
+                 node_id: Optional[bytes] = None, static_key=None):
         self.config = config
         self.fork_digest = fork_digest
-        self.node_id = node_id or secrets.token_bytes(32)
+        if config.noise:
+            if node_id is not None:
+                raise ValueError(
+                    "with noise enabled the node id IS the static key;"
+                    " pass static_key= to persist an identity")
+            from .noise import generate_static_keypair
+            if static_key is None:
+                static_key, _ = generate_static_keypair()
+            self.static_key = static_key
+            self.node_id = static_key.public_key().public_bytes_raw()
+        else:
+            self.static_key = None
+            self.node_id = node_id or secrets.token_bytes(32)
         self.peers: List[Peer] = []
         self._server: Optional[asyncio.AbstractServer] = None
         self.port: int = config.port
@@ -141,8 +168,18 @@ class P2PNetwork:
         if len(self.peers) >= self.config.max_peers:
             return None
         reader, writer = await asyncio.open_connection(host, port)
+        noise_id = None
+        if self.static_key is not None:
+            try:
+                reader, writer, noise_id = await self._secure(
+                    reader, writer, initiator=True)
+            except Exception:
+                _LOG.info("noise handshake failed (dialing %s:%d)",
+                          host, port)
+                writer.close()
+                return None
         peer = Peer(reader, writer, outbound=True)
-        await self._handshake(peer)
+        await self._handshake(peer, noise_id)
         if not peer.connected:
             return None
         self.peers.append(peer)
@@ -152,8 +189,18 @@ class P2PNetwork:
         return peer
 
     async def _accept(self, reader, writer) -> None:
+        noise_id = None
+        if self.static_key is not None:
+            try:
+                reader, writer, noise_id = await self._secure(
+                    reader, writer, initiator=False)
+            except Exception:
+                # plaintext or malformed-handshake peer: reject
+                _LOG.info("noise handshake failed (inbound)")
+                writer.close()
+                return
         peer = Peer(reader, writer, outbound=False)
-        await self._handshake(peer)
+        await self._handshake(peer, noise_id)
         if not peer.connected:
             return
         if len(self.peers) >= self.config.max_peers:
@@ -165,11 +212,32 @@ class P2PNetwork:
         if self.on_peer_connected:
             await self.on_peer_connected(peer)
 
-    async def _handshake(self, peer: Peer) -> None:
+    async def _secure(self, reader, writer, initiator: bool):
+        """Noise XX upgrade; returns (reader, writer, remote_static)
+        with AEAD framing underneath."""
+        from . import noise as N
+        handshake = (N.initiator_handshake if initiator
+                     else N.responder_handshake)
+        tx, rx, remote_static = await asyncio.wait_for(
+            handshake(reader, writer, self.static_key,
+                      prologue=_NOISE_PROLOGUE),
+            timeout=10.0)
+        return N.NoiseReader(reader, rx), N.NoiseWriter(writer, tx), \
+            remote_static
+
+    async def _handshake(self, peer: Peer,
+                         noise_id: Optional[bytes] = None) -> None:
         hello = (self.node_id + self.fork_digest
                  + struct.pack("<H", self.port))
         await peer.send_frame(KIND_HELLO, hello)
-        frame = await peer.read_frame()
+        try:
+            # bounded: a peer speaking another protocol (e.g. noise to
+            # our plaintext, or vice versa) must not hang the dial
+            frame = await asyncio.wait_for(peer.read_frame(),
+                                           timeout=10.0)
+        except asyncio.TimeoutError:
+            peer.close()
+            return
         if frame is None or frame[0] != KIND_HELLO or len(frame[1]) < 38:
             peer.close()
             return
@@ -177,6 +245,12 @@ class P2PNetwork:
         peer.node_id = data[:32]
         peer.fork_digest = data[32:36]
         (peer.listen_port,) = struct.unpack("<H", data[36:38])
+        if noise_id is not None and peer.node_id != noise_id:
+            # the hello id must BE the key the peer just proved —
+            # otherwise ids are spoofable despite the encryption
+            _LOG.info("peer hello id does not match noise identity")
+            peer.close()
+            return
         if peer.fork_digest != self.fork_digest:
             _LOG.info("peer on a different fork, disconnecting")
             await peer.send_frame(KIND_GOODBYE, b"\x03")  # irrelevant net

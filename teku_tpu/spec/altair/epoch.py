@@ -19,10 +19,14 @@ def process_justification_and_finalization(cfg: SpecConfig, state):
     if H.get_current_epoch(cfg, state) <= GENESIS_EPOCH + 1:
         return state
     if len(state.validators) >= _V.VECTOR_THRESHOLD:
-        prev_bal, cur_bal = _V.target_participation_balances(cfg, state)
-        return E0.weigh_justification_and_finalization(
-            cfg, state, _V.total_active_balance(cfg, state),
-            prev_bal, cur_bal)
+        try:
+            prev_bal, cur_bal = _V.target_participation_balances(
+                cfg, state)
+            return E0.weigh_justification_and_finalization(
+                cfg, state, _V.total_active_balance(cfg, state),
+                prev_bal, cur_bal)
+        except (_V.OverflowRisk, OverflowError):
+            pass     # exact big-int scalar path below
     total = H.get_total_active_balance(cfg, state)
     prev = AH.get_unslashed_participating_indices(
         cfg, state, TIMELY_TARGET_FLAG_INDEX,
@@ -40,7 +44,10 @@ def process_inactivity_updates(cfg: SpecConfig, state):
     if H.get_current_epoch(cfg, state) == GENESIS_EPOCH:
         return state
     if len(state.validators) >= _V.VECTOR_THRESHOLD:
-        return _V.process_inactivity_updates(cfg, state)
+        try:
+            return _V.process_inactivity_updates(cfg, state)
+        except (_V.OverflowRisk, OverflowError):
+            pass     # exact big-int scalar path below
     scores = list(state.inactivity_scores)
     target_idx = AH.get_unslashed_participating_indices(
         cfg, state, TIMELY_TARGET_FLAG_INDEX,
@@ -113,7 +120,7 @@ def process_rewards_and_penalties(cfg: SpecConfig, state,
         try:
             return _V.process_rewards_and_penalties(
                 cfg, state, inactivity_quotient)
-        except _V.OverflowRisk:
+        except (_V.OverflowRisk, OverflowError):
             pass     # exact big-int scalar path below
     deltas = [get_flag_index_deltas(cfg, state, f)
               for f in range(len(PARTICIPATION_FLAG_WEIGHTS))]
@@ -132,7 +139,10 @@ def process_slashings(cfg: SpecConfig, state, multiplier=None):
     if multiplier is None:
         multiplier = cfg.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
     if len(state.validators) >= _V.VECTOR_THRESHOLD:
-        return _V.process_slashings(cfg, state, multiplier)
+        try:
+            return _V.process_slashings(cfg, state, multiplier)
+        except (_V.OverflowRisk, OverflowError):
+            pass     # exact big-int scalar path below
     epoch = H.get_current_epoch(cfg, state)
     total = H.get_total_active_balance(cfg, state)
     adjusted = min(sum(state.slashings) * multiplier, total)

@@ -146,8 +146,11 @@ def process_effective_balance_updates(cfg: SpecConfig, state):
     """Hysteresis against the per-validator (compounding-aware) cap."""
     from .. import vectorized as _V
     if len(state.validators) >= _V.VECTOR_THRESHOLD:
-        return _V.process_effective_balance_updates(
-            cfg, state, max_eb_fn=EH.get_max_effective_balance)
+        try:
+            return _V.process_effective_balance_updates(
+                cfg, state, max_eb_fn=EH.get_max_effective_balance)
+        except (_V.OverflowRisk, OverflowError):
+            pass     # exact big-int scalar path below
     validators = list(state.validators)
     changed = False
     inc = cfg.EFFECTIVE_BALANCE_INCREMENT
@@ -177,9 +180,13 @@ def process_slashings(cfg: SpecConfig, state):
     """
     from .. import vectorized as _V
     if len(state.validators) >= _V.VECTOR_THRESHOLD:
-        return _V.process_slashings(
-            cfg, state, cfg.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX,
-            per_increment=True)
+        try:
+            return _V.process_slashings(
+                cfg, state,
+                cfg.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX,
+                per_increment=True)
+        except (_V.OverflowRisk, OverflowError):
+            pass     # exact big-int scalar path below
     epoch = H.get_current_epoch(cfg, state)
     total = H.get_total_active_balance(cfg, state)
     adjusted = min(

@@ -242,7 +242,11 @@ def process_registry_updates(cfg: SpecConfig, state,
     (deneb's EIP-7514 activation churn limit routes through here)."""
     from . import vectorized as _V
     if len(state.validators) >= _V.VECTOR_THRESHOLD:
-        return _V.process_registry_updates(cfg, state, activation_limit)
+        try:
+            return _V.process_registry_updates(cfg, state,
+                                               activation_limit)
+        except (_V.OverflowRisk, OverflowError):
+            pass     # exact big-int scalar path below
     current_epoch = H.get_current_epoch(cfg, state)
     validators = list(state.validators)
     changed = False
@@ -277,8 +281,11 @@ def process_registry_updates(cfg: SpecConfig, state,
 def process_slashings(cfg: SpecConfig, state):
     from . import vectorized as _V
     if len(state.validators) >= _V.VECTOR_THRESHOLD:
-        return _V.process_slashings(
-            cfg, state, cfg.PROPORTIONAL_SLASHING_MULTIPLIER)
+        try:
+            return _V.process_slashings(
+                cfg, state, cfg.PROPORTIONAL_SLASHING_MULTIPLIER)
+        except (_V.OverflowRisk, OverflowError):
+            pass     # exact big-int scalar path below
     epoch = H.get_current_epoch(cfg, state)
     total_balance = H.get_total_active_balance(cfg, state)
     adjusted = min(sum(state.slashings)
@@ -304,7 +311,10 @@ def process_eth1_data_reset(cfg: SpecConfig, state):
 def process_effective_balance_updates(cfg: SpecConfig, state):
     from . import vectorized as _V
     if len(state.validators) >= _V.VECTOR_THRESHOLD:
-        return _V.process_effective_balance_updates(cfg, state)
+        try:
+            return _V.process_effective_balance_updates(cfg, state)
+        except (_V.OverflowRisk, OverflowError):
+            pass     # exact big-int scalar path below
     validators = list(state.validators)
     changed = False
     inc = cfg.EFFECTIVE_BALANCE_INCREMENT

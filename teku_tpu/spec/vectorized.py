@@ -42,7 +42,10 @@ class OverflowRisk(Exception):
 # O(V) attribute-extraction pass serves them all.  Identity-keyed —
 # any registry change produces a new tuple.
 _ARRAY_CACHE: list = []
-_ARRAY_CACHE_MAX = 4
+# strong refs pin whole registries (tuples aren't weakref-able): keep
+# the cache just deep enough for one epoch's passes over the current
+# and one predecessor registry
+_ARRAY_CACHE_MAX = 2
 
 
 def validator_arrays(state):

@@ -1,0 +1,205 @@
+"""Noise XX: handshake state machine, AEAD transport framing, and the
+security properties the encrypted transport exists for (reference
+LibP2PNetworkBuilder.java:219 — libp2p noise upgrade)."""
+
+import asyncio
+
+import pytest
+
+from teku_tpu.networking import noise as N
+
+
+def _run_handshake():
+    a_sk, a_pub = N.generate_static_keypair()
+    b_sk, b_pub = N.generate_static_keypair()
+    ini = N.XXHandshake(True, a_sk, prologue=b"p")
+    res = N.XXHandshake(False, b_sk, prologue=b"p")
+    res.read_message_1(ini.write_message_1())
+    ini.read_message_2(res.write_message_2())
+    msg3, itx, irx = ini.write_message_3()
+    rtx, rrx = res.read_message_3(msg3)
+    return (a_pub, b_pub, ini, res, itx, irx, rtx, rrx)
+
+
+def test_xx_handshake_authenticates_both_statics():
+    a_pub, b_pub, ini, res, itx, irx, rtx, rrx = _run_handshake()
+    assert ini.rs == b_pub            # initiator learned responder id
+    assert res.rs == a_pub            # responder learned initiator id
+    assert ini.ss.h == res.ss.h       # transcripts agree
+    # transport keys work both ways
+    ct = itx.encrypt_with_ad(b"", b"ping")
+    assert rrx.decrypt_with_ad(b"", ct) == b"ping"
+    ct2 = rtx.encrypt_with_ad(b"", b"pong")
+    assert irx.decrypt_with_ad(b"", ct2) == b"pong"
+
+
+def test_tampered_ciphertext_rejected():
+    *_, itx, irx, rtx, rrx = _run_handshake()
+    ct = bytearray(itx.encrypt_with_ad(b"", b"payload"))
+    ct[0] ^= 0xFF
+    with pytest.raises(N.NoiseError):
+        rrx.decrypt_with_ad(b"", bytes(ct))
+
+
+def test_tampered_handshake_message_fails():
+    a_sk, _ = N.generate_static_keypair()
+    b_sk, _ = N.generate_static_keypair()
+    ini = N.XXHandshake(True, a_sk)
+    res = N.XXHandshake(False, b_sk)
+    res.read_message_1(ini.write_message_1())
+    msg2 = bytearray(res.write_message_2())
+    msg2[40] ^= 0x01                  # inside the encrypted static
+    with pytest.raises(N.NoiseError):
+        ini.read_message_2(bytes(msg2))
+
+
+def test_prologue_mismatch_fails():
+    a_sk, _ = N.generate_static_keypair()
+    b_sk, _ = N.generate_static_keypair()
+    ini = N.XXHandshake(True, a_sk, prologue=b"one")
+    res = N.XXHandshake(False, b_sk, prologue=b"two")
+    res.read_message_1(ini.write_message_1())
+    with pytest.raises(N.NoiseError):
+        ini.read_message_2(res.write_message_2())
+
+
+def test_stream_transport_roundtrip_with_chunking():
+    async def run():
+        a_sk, _ = N.generate_static_keypair()
+        b_sk, b_pub = N.generate_static_keypair()
+        server_done = asyncio.get_running_loop().create_future()
+
+        async def serve(reader, writer):
+            tx, rx, remote = await N.responder_handshake(
+                reader, writer, b_sk)
+            nr, nw = N.NoiseReader(reader, rx), N.NoiseWriter(writer, tx)
+            got = await nr.readexactly(200_000)   # > 3 noise messages
+            nw.write(got[::-1])
+            await nw.drain()
+            server_done.set_result(remote)
+            writer.close()    # py3.12 Server.wait_closed waits on this
+
+        server = await asyncio.start_server(serve, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        tx, rx, remote = await N.initiator_handshake(reader, writer,
+                                                     a_sk)
+        assert remote == b_pub
+        nr, nw = N.NoiseReader(reader, rx), N.NoiseWriter(writer, tx)
+        payload = bytes(range(256)) * 782 + b"xy"     # 200,194... trim
+        payload = payload[:200_000]
+        nw.write(payload)
+        await nw.drain()
+        echoed = await nr.readexactly(200_000)
+        assert echoed == payload[::-1]
+        await server_done
+        writer.close()
+        server.close()
+        await server.wait_closed()
+    asyncio.run(run())
+
+
+def test_plaintext_peer_rejected_by_noise_node():
+    """A node speaking the old cleartext framing cannot connect to an
+    encrypted node — and vice versa the dial fails cleanly."""
+    from teku_tpu.networking.transport import NetworkConfig, P2PNetwork
+
+    async def run():
+        secure = P2PNetwork(NetworkConfig(port=0), b"\x01\x02\x03\x04")
+        plain = P2PNetwork(
+            NetworkConfig(port=0, noise=False), b"\x01\x02\x03\x04")
+        await secure.start()
+        await plain.start()
+        try:
+            peer = await plain.connect("127.0.0.1", secure.port)
+            await asyncio.sleep(0.1)
+            assert peer is None or not peer.connected
+            assert not secure.peers
+            # and a secure dial of a plaintext node fails cleanly too
+            peer2 = await secure.connect("127.0.0.1", plain.port)
+            assert peer2 is None or not peer2.connected
+        finally:
+            await secure.stop()
+            await plain.stop()
+    asyncio.run(run())
+
+
+def test_hello_id_must_match_noise_identity():
+    from teku_tpu.networking.transport import NetworkConfig, P2PNetwork
+
+    async def run():
+        a = P2PNetwork(NetworkConfig(port=0), b"\x01\x02\x03\x04")
+        b = P2PNetwork(NetworkConfig(port=0), b"\x01\x02\x03\x04")
+        # a lies in its hello: claims an id other than its noise key
+        a.node_id = b"\xee" * 32
+        await a.start()
+        await b.start()
+        try:
+            await a.connect("127.0.0.1", b.port)
+            await asyncio.sleep(0.1)
+            assert not b.peers            # b rejected the spoofed hello
+        finally:
+            await a.stop()
+            await b.stop()
+    asyncio.run(run())
+
+
+def test_encrypted_nodes_interoperate():
+    from teku_tpu.networking.transport import NetworkConfig, P2PNetwork
+
+    async def run():
+        a = P2PNetwork(NetworkConfig(port=0), b"\x01\x02\x03\x04")
+        b = P2PNetwork(NetworkConfig(port=0), b"\x01\x02\x03\x04")
+        await a.start()
+        await b.start()
+        try:
+            got = []
+
+            async def on_gossip(peer, payload):
+                got.append(payload)
+            b.on_gossip = on_gossip
+            peer = await a.connect("127.0.0.1", b.port)
+            assert peer is not None and peer.connected
+            # identity = noise static key on both sides
+            assert peer.node_id == b.node_id
+            from teku_tpu.networking.transport import KIND_GOSSIP
+            await peer.send_frame(KIND_GOSSIP, b"\x00secret-bytes")
+            await asyncio.sleep(0.1)
+            assert got == [b"\x00secret-bytes"]
+        finally:
+            await a.stop()
+            await b.stop()
+    asyncio.run(run())
+
+
+def test_garbage_ciphertext_cleans_up_peer():
+    """Post-handshake AEAD garbage must tear the peer down through the
+    normal disconnect path, not kill the read loop mid-task."""
+    from teku_tpu.networking.transport import NetworkConfig, P2PNetwork
+
+    async def run():
+        a = P2PNetwork(NetworkConfig(port=0), b"\x01\x02\x03\x04")
+        b = P2PNetwork(NetworkConfig(port=0), b"\x01\x02\x03\x04")
+        gone = []
+
+        async def on_gone(peer):
+            gone.append(peer)
+        b.on_peer_disconnected = on_gone
+        await a.start()
+        await b.start()
+        try:
+            peer = await a.connect("127.0.0.1", b.port)
+            assert peer is not None and peer.connected
+            await asyncio.sleep(0.05)
+            assert len(b.peers) == 1
+            # bypass the noise writer: raw garbage noise message
+            raw = peer.writer._writer
+            raw.write(b"\x00\x10" + b"\xab" * 16)
+            await raw.drain()
+            await asyncio.sleep(0.2)
+            assert not b.peers            # cleaned up, slot freed
+            assert gone                   # disconnect hook fired
+        finally:
+            await a.stop()
+            await b.stop()
+    asyncio.run(run())

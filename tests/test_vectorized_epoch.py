@@ -194,3 +194,44 @@ def test_slashings_exact_match_all_modes():
         CFG, state, CFG.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX,
         per_increment=True)
     assert scalar_e.balances == vec_e.balances
+
+
+def test_electra_effective_balance_updates_exact_match():
+    """The electra path caps per credential (compounding 2048 ETH vs
+    0x01 creds 32 ETH) via max_eb_fn — its own differential test."""
+    from teku_tpu.spec.electra import epoch as XE
+    from teku_tpu.spec.electra import helpers as EH
+    ecfg = dataclasses.replace(
+        CFG, BELLATRIX_FORK_EPOCH=0, CAPELLA_FORK_EPOCH=0,
+        DENEB_FORK_EPOCH=0, ELECTRA_FORK_EPOCH=0)
+    rng = random.Random(51)
+    state = _messy_state(seed=51)
+    validators = list(state.validators)
+    balances = []
+    for i in range(N):
+        creds = (b"\x02" if rng.random() < 0.5 else b"\x01") + bytes(31)
+        validators[i] = validators[i].copy_with(
+            withdrawal_credentials=creds)
+        # balances straddling both caps, forcing hysteresis both ways
+        balances.append(rng.randrange(10 ** 9,
+                                      ecfg.MAX_EFFECTIVE_BALANCE_ELECTRA
+                                      + 5 * 10 ** 9))
+    state = state.copy_with(validators=tuple(validators),
+                            balances=tuple(balances))
+    scalar = _scalar(XE.process_effective_balance_updates, ecfg, state)
+    vec = V.process_effective_balance_updates(
+        ecfg, state, max_eb_fn=EH.get_max_effective_balance)
+    assert scalar.validators == vec.validators
+
+
+def test_uint64_range_values_fall_back_without_crashing():
+    """uint64-representable extremes (>= 2^63) must degrade to the
+    scalar big-int path, not crash the numpy one."""
+    state = _messy_state(seed=53)
+    huge = 2 ** 63 + 5
+    state = state.copy_with(inactivity_scores=tuple(
+        huge for _ in range(N)))
+    out = AE.process_inactivity_updates(CFG, state)   # no crash
+    assert len(out.inactivity_scores) == N
+    out2 = AE.process_rewards_and_penalties(CFG, state)
+    assert len(out2.balances) == N
