@@ -145,6 +145,23 @@ def cmd_node(args) -> int:
             nn.node.channels.subscribe(FinalizedCheckpointChannel,
                                        _FinalizedSink())
         await nn.start()
+        eth1_task = None
+        eth1_endpoint = layered_value("eth1-endpoint",
+                                      args.eth1_endpoint, yaml_cfg)
+        if eth1_endpoint:
+            from .node.deposits import DepositProvider
+            from .node.eth1 import (Eth1DepositFollower,
+                                    JsonRpcEth1Provider)
+            host, _, p = eth1_endpoint.rpartition(":")
+            provider = DepositProvider(spec.config)
+            follower = Eth1DepositFollower(
+                provider,
+                JsonRpcEth1Provider(host or "127.0.0.1", int(p)),
+                follow_distance=int(layered_value(
+                    "eth1-follow-distance", args.eth1_follow_distance,
+                    yaml_cfg, 8, int)))
+            nn.node.deposit_provider = provider
+            eth1_task = asyncio.create_task(follower.run())
         api_channel = BeaconNodeValidatorApi(nn.node)
         rest_api = BeaconRestApi(nn.node, nn, port=rest_port,
                                  validator_api=api_channel)
@@ -186,6 +203,8 @@ def cmd_node(args) -> int:
                     spec.config.SECONDS_PER_SLOT
                 await asyncio.sleep(max(0.1, next_slot_time - time.time()))
         finally:
+            if eth1_task is not None:
+                eth1_task.cancel()
             await rest_api.stop()
             await nn.stop()
             if db is not None:
@@ -361,6 +380,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "must agree)")
     n.add_argument("--peer", action="append",
                    help="host:port to dial (repeatable)")
+    n.add_argument("--eth1-endpoint", default=None,
+                   help="eth1 JSON-RPC host:port for the deposit "
+                        "follower")
+    n.add_argument("--eth1-follow-distance", type=int, default=None)
     n.add_argument("--checkpoint-sync-url", default=None,
                    help="REST base URL of a trusted node to anchor "
                         "from (finalized state + block)")

@@ -92,27 +92,12 @@ class EngineJsonRpcClient(ExecutionLayerChannel):
         self._id = 0
 
     async def _call(self, method: str, params) -> Dict[str, Any]:
+        from .infra.jsonrpc import http_json_rpc
         self._id += 1
-        body = json.dumps({"jsonrpc": "2.0", "id": self._id,
-                           "method": method, "params": params}).encode()
         token = _jwt_token(self.jwt_secret)
-        req = (f"POST / HTTP/1.1\r\nHost: {self.host}\r\n"
-               f"Authorization: Bearer {token}\r\n"
-               f"Content-Type: application/json\r\n"
-               f"Content-Length: {len(body)}\r\nConnection: close\r\n"
-               f"\r\n").encode() + body
-        reader, writer = await asyncio.open_connection(self.host, self.port)
-        try:
-            writer.write(req)
-            await writer.drain()
-            raw = await reader.read()
-        finally:
-            writer.close()
-        head, _, payload = raw.partition(b"\r\n\r\n")
-        out = json.loads(payload)
-        if "error" in out:
-            raise RuntimeError(f"engine error: {out['error']}")
-        return out["result"]
+        return await http_json_rpc(
+            self.host, self.port, method, params, request_id=self._id,
+            headers={"Authorization": f"Bearer {token}"})
 
     async def new_payload(self, payload) -> PayloadStatus:
         result = await self._call("engine_newPayloadV1", [payload])

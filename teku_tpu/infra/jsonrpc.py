@@ -1,0 +1,70 @@
+"""Minimal asyncio JSON-RPC-over-HTTP client shared by the engine-API
+and eth1 clients (reference: both ride the same Web3j/OkHttp plumbing
+in ethereum/executionclient).
+
+One implementation of the raw HTTP mechanics — status-line checking,
+content-length and chunked transfer decoding, JSON-RPC error
+unwrapping — so the two callers cannot drift apart.
+"""
+
+import asyncio
+import json
+from typing import Any, Dict, Optional
+
+
+class JsonRpcError(RuntimeError):
+    pass
+
+
+def _decode_body(head: bytes, payload: bytes) -> bytes:
+    headers = {}
+    for line in head.split(b"\r\n")[1:]:
+        name, _, value = line.partition(b":")
+        headers[name.strip().lower()] = value.strip()
+    if headers.get(b"transfer-encoding", b"").lower() == b"chunked":
+        out = bytearray()
+        pos = 0
+        while pos < len(payload):
+            eol = payload.find(b"\r\n", pos)
+            if eol < 0:
+                break
+            size = int(payload[pos:eol].split(b";")[0], 16)
+            if size == 0:
+                break
+            out += payload[eol + 2:eol + 2 + size]
+            pos = eol + 2 + size + 2
+        return bytes(out)
+    return payload
+
+
+async def http_json_rpc(host: str, port: int, method: str, params,
+                        request_id: int = 1,
+                        headers: Optional[Dict[str, str]] = None,
+                        timeout: float = 10.0) -> Any:
+    """One JSON-RPC call; raises JsonRpcError on HTTP or RPC errors."""
+    body = json.dumps({"jsonrpc": "2.0", "id": request_id,
+                       "method": method, "params": params}).encode()
+    extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
+    req = (f"POST / HTTP/1.1\r\nHost: {host}\r\n{extra}"
+           f"Content-Type: application/json\r\n"
+           f"Content-Length: {len(body)}\r\nConnection: close\r\n"
+           f"\r\n").encode() + body
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, port), timeout)
+    try:
+        writer.write(req)
+        await writer.drain()
+        raw = await asyncio.wait_for(reader.read(), timeout)
+    finally:
+        writer.close()
+    head, _, payload = raw.partition(b"\r\n\r\n")
+    status_line = head.split(b"\r\n", 1)[0]
+    parts = status_line.split()
+    if len(parts) < 2 or not parts[1].isdigit():
+        raise JsonRpcError(f"malformed HTTP response: {status_line!r}")
+    if int(parts[1]) != 200:
+        raise JsonRpcError(f"HTTP {int(parts[1])} from {method}")
+    out = json.loads(_decode_body(head, payload))
+    if "error" in out:
+        raise JsonRpcError(f"{method} error: {out['error']}")
+    return out["result"]

@@ -16,6 +16,8 @@ import struct
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
+from .noise import NoiseError
+
 _LOG = logging.getLogger(__name__)
 
 KIND_HELLO = 0
@@ -58,7 +60,6 @@ class Peer:
             self.connected = False
 
     async def read_frame(self) -> Optional[Tuple[int, bytes]]:
-        from .noise import NoiseError
         try:
             head = await self.reader.readexactly(4)
             (n,) = struct.unpack("<I", head)

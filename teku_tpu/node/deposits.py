@@ -97,14 +97,38 @@ class DepositProvider:
         self.cfg = cfg
         self.tree = DepositTree()
         self._data: List[object] = []
+        self._canonical: object = None
+        self._rebuilding = False
 
     def on_deposit(self, deposit_data) -> int:
         """A new deposit observed on the execution chain."""
         self._data.append(deposit_data)
         return self.tree.push(deposit_data)
 
+    def reset(self) -> None:
+        """Discard the tree (eth1 reorg beyond the follow distance —
+        the follower re-feeds everything from the canonical chain).
+        Until the rebuild lands, eth1_data() abstains (returns None)
+        rather than voting an empty-tree root."""
+        self.tree = DepositTree()
+        self._data = []
+        self._canonical = None
+        self._rebuilding = True
+
+    def set_canonical_eth1_data(self, eth1_data) -> None:
+        """The follower's voting view: the deposit root/count at the
+        block ETH1_FOLLOW_DISTANCE behind head (reference
+        Eth1DataCache feeding Eth1VotingPeriod)."""
+        self._canonical = eth1_data
+        self._rebuilding = False
+
     def eth1_data(self, block_hash: bytes = bytes(32)):
         from ..spec.datastructures import Eth1Data
+        if self._canonical is not None:
+            return self._canonical
+        if self._rebuilding:
+            return None      # abstain: caller repeats state.eth1_data
+        # no follower wired (devnets): vote the live tree view
         return Eth1Data(deposit_root=self.tree.root(),
                         deposit_count=self.tree.count,
                         block_hash=block_hash)

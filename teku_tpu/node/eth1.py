@@ -1,0 +1,252 @@
+"""Eth1 deposit follower: JSON-RPC log polling with reorg-safe follow
+distance, feeding the DepositTree and the eth1 voting data.
+
+Equivalent of the reference's pow module (reference: beacon/pow/src/
+main/java/tech/pegasys/teku/beacon/pow/Eth1DepositManager.java:38 —
+DepositFetcher pulling DepositEvent logs over eth_getLogs,
+Eth1HeadTracker following the chain ETH1_FOLLOW_DISTANCE behind head,
+ValidatingEth1EventsPublisher asserting deposit-index contiguity, and
+reorg handling by replay): every poll advances the follow target,
+appends the new deposit events to the provider's tree in log order,
+and publishes the candidate eth1_data (root/count at the followed
+block) that proposers vote on.
+
+DepositEvent log data is the deposit contract's ABI encoding — five
+dynamic `bytes` fields (pubkey 48, withdrawal_credentials 32, amount 8
+little-endian, signature 96, index 8 little-endian); the parser here
+decodes that exact shape.
+"""
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from ..spec.datastructures import DepositData, Eth1Data
+from .deposits import DepositProvider
+
+_LOG = logging.getLogger(__name__)
+
+# keccak256("DepositEvent(bytes,bytes,bytes,bytes,bytes)") — the
+# deposit contract's only event topic (public constant)
+DEPOSIT_EVENT_TOPIC = ("0x649bbc62d0e31342afea4e5cd82d4049e7e1ee912fc0"
+                       "889aa790803be39038c5")
+
+
+@dataclass
+class DepositEvent:
+    data: DepositData
+    index: int
+    block_number: int
+    block_hash: bytes
+
+
+@dataclass
+class Eth1Block:
+    number: int
+    hash: bytes
+    parent_hash: bytes
+    timestamp: int
+
+
+class Eth1Provider:
+    """What the follower needs from an execution client (reference
+    Eth1Provider.java)."""
+
+    async def get_latest_block_number(self) -> int:
+        raise NotImplementedError
+
+    async def get_block(self, number: int) -> Optional[Eth1Block]:
+        raise NotImplementedError
+
+    async def get_deposit_events(self, from_block: int,
+                                 to_block: int) -> List[DepositEvent]:
+        raise NotImplementedError
+
+
+# -- ABI codec for DepositEvent --------------------------------------------
+
+def abi_encode_deposit_event(data: DepositData, index: int) -> bytes:
+    """The deposit contract's log data layout: head of five 32-byte
+    offsets, then per-field [length word || right-padded bytes]."""
+    fields = [bytes(data.pubkey), bytes(data.withdrawal_credentials),
+              int(data.amount).to_bytes(8, "little"),
+              bytes(data.signature), index.to_bytes(8, "little")]
+    head = b""
+    tail = b""
+    offset = 32 * len(fields)
+    for f in fields:
+        head += offset.to_bytes(32, "big")
+        padded = f.ljust((len(f) + 31) // 32 * 32, b"\x00")
+        tail += len(f).to_bytes(32, "big") + padded
+        offset += 32 + len(padded)
+    return head + tail
+
+
+def abi_decode_deposit_event(raw: bytes) -> Tuple[DepositData, int]:
+    def field(i: int) -> bytes:
+        off = int.from_bytes(raw[32 * i:32 * i + 32], "big")
+        n = int.from_bytes(raw[off:off + 32], "big")
+        out = raw[off + 32:off + 32 + n]
+        if len(out) != n:
+            raise ValueError("truncated ABI field")
+        return out
+
+    pubkey, creds, amount, signature, index = (field(i)
+                                               for i in range(5))
+    if (len(pubkey), len(creds), len(amount), len(signature),
+            len(index)) != (48, 32, 8, 96, 8):
+        raise ValueError("bad DepositEvent field sizes")
+    return DepositData(
+        pubkey=pubkey, withdrawal_credentials=creds,
+        amount=int.from_bytes(amount, "little"),
+        signature=signature), int.from_bytes(index, "little")
+
+
+# -- JSON-RPC provider ------------------------------------------------------
+
+class JsonRpcEth1Provider(Eth1Provider):
+    """eth_blockNumber / eth_getBlockByNumber / eth_getLogs over plain
+    HTTP JSON-RPC (reference Web3JEth1Provider)."""
+
+    def __init__(self, host: str, port: int,
+                 deposit_contract: str = "0x" + "00" * 20,
+                 timeout: float = 10.0):
+        self.host = host
+        self.port = port
+        self.deposit_contract = deposit_contract
+        self.timeout = timeout
+        self._id = 0
+
+    async def _call(self, method: str, params):
+        from ..infra.jsonrpc import http_json_rpc
+        self._id += 1
+        return await http_json_rpc(self.host, self.port, method, params,
+                                   request_id=self._id,
+                                   timeout=self.timeout)
+
+    async def get_latest_block_number(self) -> int:
+        return int(await self._call("eth_blockNumber", []), 16)
+
+    async def get_block(self, number: int) -> Optional[Eth1Block]:
+        out = await self._call("eth_getBlockByNumber",
+                               [hex(number), False])
+        if out is None:
+            return None
+        return Eth1Block(
+            number=int(out["number"], 16),
+            hash=bytes.fromhex(out["hash"][2:]),
+            parent_hash=bytes.fromhex(out["parentHash"][2:]),
+            timestamp=int(out["timestamp"], 16))
+
+    async def get_deposit_events(self, from_block: int,
+                                 to_block: int) -> List[DepositEvent]:
+        logs = await self._call("eth_getLogs", [{
+            "fromBlock": hex(from_block), "toBlock": hex(to_block),
+            "address": self.deposit_contract,
+            "topics": [DEPOSIT_EVENT_TOPIC]}])
+        events = []
+        for log in logs:
+            data, index = abi_decode_deposit_event(
+                bytes.fromhex(log["data"][2:]))
+            events.append(DepositEvent(
+                data=data, index=index,
+                block_number=int(log["blockNumber"], 16),
+                block_hash=bytes.fromhex(log["blockHash"][2:])))
+        # eth_getLogs orders within a block but the spec needs global
+        # deposit-index order
+        events.sort(key=lambda e: e.index)
+        return events
+
+
+# -- the follower -----------------------------------------------------------
+
+class Eth1DepositFollower:
+    """Polls the eth1 provider, keeps the DepositProvider's tree in
+    sync ETH1_FOLLOW_DISTANCE behind head, and publishes the voting
+    eth1_data.  Reorg-safe: the previously-followed block's hash is
+    re-checked each poll; a mismatch (reorg deeper than the follow
+    distance) rebuilds the tree from scratch, exactly as the reference
+    resubscribes from the last valid block."""
+
+    def __init__(self, provider: DepositProvider, eth1: Eth1Provider,
+                 follow_distance: int = 8, chunk: int = 1000):
+        self.provider = provider
+        self.eth1 = eth1
+        self.follow_distance = follow_distance
+        self.chunk = chunk
+        self._followed: Optional[Eth1Block] = None
+        self.rebuilds = 0
+        self.polls = 0
+
+    async def poll_once(self) -> bool:
+        """One follow step; returns True if new deposits were added or
+        the voting data advanced."""
+        self.polls += 1
+        head = await self.eth1.get_latest_block_number()
+        target = head - self.follow_distance
+        if target < 0:
+            return False
+        if self._followed is not None:
+            prior = await self.eth1.get_block(self._followed.number)
+            if prior is None or prior.hash != self._followed.hash:
+                # reorg crossed the follow distance: the appended log
+                # history is no longer canonical — rebuild
+                _LOG.warning("eth1 reorg beyond follow distance; "
+                             "rebuilding deposit tree")
+                self.rebuilds += 1
+                self.provider.reset()
+                self._followed = None
+        start = 0 if self._followed is None else self._followed.number + 1
+        if self._followed is not None and target <= self._followed.number:
+            return False
+        # ATOMIC poll: gather everything first, mutate only at the end.
+        # (a) a transient RPC failure mid-fetch leaves the tree
+        #     untouched instead of half-appended (which the contiguity
+        #     check would escalate into a full rebuild);
+        # (b) the target hash is sampled before AND after the log fetch
+        #     — a reorg racing the fetch could otherwise anchor
+        #     old-branch deposits under the new branch's block hash,
+        #     invisible to the next poll's reorg check
+        block_before = await self.eth1.get_block(target)
+        if block_before is None:
+            return False
+        pending: List[DepositEvent] = []
+        for frm in range(start, target + 1, self.chunk):
+            to = min(frm + self.chunk - 1, target)
+            pending.extend(await self.eth1.get_deposit_events(frm, to))
+        block_after = await self.eth1.get_block(target)
+        if block_after is None or block_after.hash != block_before.hash:
+            _LOG.info("eth1 reorg raced the log fetch; retrying")
+            return False
+        expected = self.provider.tree.count
+        for ev in pending:
+            if ev.index != expected:
+                # gap or duplicate: corrupt view — rebuild next poll
+                # (reference ValidatingEth1EventsPublisher throws on
+                # non-contiguous indices); nothing was applied yet
+                _LOG.warning(
+                    "non-contiguous deposit index %d (expected %d)",
+                    ev.index, expected)
+                self.provider.reset()
+                self._followed = None
+                return False
+            expected += 1
+        for ev in pending:
+            self.provider.on_deposit(ev.data)
+        self._followed = block_after
+        self.provider.set_canonical_eth1_data(Eth1Data(
+            deposit_root=self.provider.tree.root(),
+            deposit_count=self.provider.tree.count,
+            block_hash=block_after.hash))
+        return True
+
+    async def run(self, poll_interval: float = 2.0) -> None:
+        while True:
+            try:
+                await self.poll_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                _LOG.exception("eth1 poll failed; retrying")
+            await asyncio.sleep(poll_interval)

@@ -121,9 +121,13 @@ def process_rewards_and_penalties(cfg: SpecConfig, state,
     from . import epoch as E0
     leaking = E0.is_in_inactivity_leak(cfg, state)
 
-    # int64 headroom: base_reward * weight * unslashed_increments
-    if int(base_reward.max(initial=0)) * 64 * max(active_increments, 1) \
-            >= 2 ** 62:
+    # int64 headroom for base_reward * weight * unslashed_increments:
+    # bound with the REGISTRY-WIDE increment total — per-flag
+    # unslashed_increments can exceed active_increments (mass exits:
+    # last epoch's participants dwarf the current active set), so the
+    # guard must cover the worst multiplicand, not the current one
+    max_increments = max(1, int(eb.sum()) // inc)
+    if int(base_reward.max(initial=0)) * 64 * max_increments >= 2 ** 62:
         raise OverflowRisk("flag delta product")
 
     # the scalar oracle clamps at zero after EACH delta list (one per

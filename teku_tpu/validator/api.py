@@ -217,6 +217,11 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
             # so the deposit list must be computed against the outcome
             from ..spec.block import eth1_vote_outcome
             eth1_vote = deposit_provider.eth1_data()
+            if eth1_vote is None:
+                # provider rebuilding after an eth1 reorg: abstain by
+                # repeating the committed data instead of voting an
+                # empty-tree root
+                eth1_vote = pre.eth1_data
             effective = eth1_vote_outcome(cfg, pre, eth1_vote)
             deposits = deposit_provider.get_deposits_for_block(
                 pre, effective)
