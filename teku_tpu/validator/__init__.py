@@ -7,6 +7,8 @@ Reference: /root/reference/validator/ (client, api, remote) and
 from .api import (AttesterDuty, BeaconNodeValidatorApi, ProposerDuty,
                   ValidatorApiChannel)
 from .client import ValidatorClient
+from .external import (ExternalSigner, FailoverError,
+                       FailoverValidatorApi)
 from .remote import RemoteValidatorApi
 from .signer import (DutySigner, LocalSigner, SigningError,
                      SlashingProtectedSigner)

@@ -1,0 +1,280 @@
+"""External (Web3Signer-style) remote signing + multi-BN failover.
+
+Equivalent of the reference's remote-signing and failover stack
+(reference: validator/client/src/main/java/tech/pegasys/teku/validator/
+client/signer/ExternalSigner.java:68 — HTTP POST
+/api/v1/eth2/sign/{pubkey} with a typed body and the locally-computed
+signing root; validator/remote/.../FailoverValidatorApiHandler.java:69
+— an ordered list of beacon nodes, requests start at the last healthy
+one and fail over on error, sticky until the next failure).
+
+The signing ROOT is always computed locally (the same SigningRootUtil
+math as LocalSigner), so a compromised signer service can be detected
+by verifying returned signatures and can never trick the VC into
+signing a different message than its duty.
+"""
+
+import json
+import logging
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Sequence
+
+from ..spec import helpers as H
+from ..spec.config import (DOMAIN_AGGREGATE_AND_PROOF,
+                           DOMAIN_BEACON_ATTESTER,
+                           DOMAIN_BEACON_PROPOSER, SpecConfig)
+from .api import ValidatorApiChannel
+from .signer import DutySigner, SigningError
+
+_LOG = logging.getLogger(__name__)
+
+
+class ExternalSigner(DutySigner):
+    """Signs duties through a Web3Signer-compatible HTTP API.
+
+    `pubkeys_by_index` maps validator indices to the BLS public keys
+    the signing service holds; every response signature is verified
+    against the locally-computed root before it is used."""
+
+    def __init__(self, base_url: str,
+                 pubkeys_by_index: Dict[int, bytes],
+                 timeout: float = 10.0, verify: bool = True):
+        self.base = base_url.rstrip("/")
+        self.pubkeys = dict(pubkeys_by_index)
+        self.timeout = timeout
+        self.verify = verify
+
+    # -- HTTP ----------------------------------------------------------
+    def _sign(self, validator_index: int, root: bytes,
+              duty_type: str) -> bytes:
+        pubkey = self.pubkeys.get(validator_index)
+        if pubkey is None:
+            raise SigningError(f"no pubkey for validator "
+                               f"{validator_index}")
+        body = json.dumps({"type": duty_type,
+                           "signingRoot": "0x" + root.hex()}).encode()
+        req = urllib.request.Request(
+            f"{self.base}/api/v1/eth2/sign/0x{pubkey.hex()}",
+            data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout) as resp:
+                out = json.loads(resp.read())
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                raise SigningError("signer does not hold this key")
+            if exc.code == 412:
+                # Web3Signer's own slashing protection refused
+                raise SigningError("external signer refused "
+                                   "(slashing risk)")
+            raise SigningError(f"external signer HTTP {exc.code}")
+        except OSError as exc:
+            raise SigningError(f"external signer unreachable: {exc}")
+        try:
+            raw = out["signature"]
+            signature = bytes.fromhex(
+                raw[2:] if raw.startswith("0x") else raw)
+            if len(signature) != 96:
+                raise ValueError("wrong signature length")
+        except (KeyError, ValueError, TypeError, AttributeError) as exc:
+            raise SigningError(f"malformed signer response: {exc}")
+        if self.verify:
+            from ..crypto import bls
+            if not bls.verify(pubkey, root, signature):
+                raise SigningError(
+                    "external signer returned an invalid signature")
+        return signature
+
+    def upcheck(self) -> bool:
+        try:
+            with urllib.request.urlopen(f"{self.base}/upcheck",
+                                        timeout=self.timeout) as resp:
+                return resp.status == 200
+        except OSError:
+            return False
+
+    def public_keys(self) -> List[bytes]:
+        with urllib.request.urlopen(
+                f"{self.base}/api/v1/eth2/publicKeys",
+                timeout=self.timeout) as resp:
+            return [bytes.fromhex(k[2:]) for k in
+                    json.loads(resp.read())]
+
+    # -- DutySigner surface (roots computed locally) -------------------
+    def sign_block(self, cfg: SpecConfig, state, block) -> bytes:
+        domain = H.get_domain(cfg, state, DOMAIN_BEACON_PROPOSER,
+                              H.compute_epoch_at_slot(cfg, block.slot))
+        return self._sign(block.proposer_index,
+                          H.compute_signing_root(block, domain),
+                          "BLOCK_V2")
+
+    def sign_attestation_data(self, cfg, state, data,
+                              validator_index) -> bytes:
+        domain = H.get_domain(cfg, state, DOMAIN_BEACON_ATTESTER,
+                              data.target.epoch)
+        return self._sign(validator_index,
+                          H.compute_signing_root(data, domain),
+                          "ATTESTATION")
+
+    def sign_randao_reveal(self, cfg, state, epoch,
+                           validator_index) -> bytes:
+        return self._sign(validator_index,
+                          H.randao_signing_root(cfg, state, epoch),
+                          "RANDAO_REVEAL")
+
+    def sign_aggregate_and_proof(self, cfg, state, msg) -> bytes:
+        domain = H.get_domain(
+            cfg, state, DOMAIN_AGGREGATE_AND_PROOF,
+            H.compute_epoch_at_slot(cfg, msg.aggregate.data.slot))
+        return self._sign(msg.aggregator_index,
+                          H.compute_signing_root(msg, domain),
+                          "AGGREGATE_AND_PROOF")
+
+    def sign_selection_proof(self, cfg, state, slot,
+                             validator_index) -> bytes:
+        return self._sign(
+            validator_index,
+            H.selection_proof_signing_root(cfg, state, slot),
+            "AGGREGATION_SLOT")
+
+    def sign_sync_committee_message(self, cfg, state, slot, block_root,
+                                    validator_index) -> bytes:
+        from ..spec.altair.helpers import sync_message_signing_root
+        return self._sign(validator_index,
+                          sync_message_signing_root(cfg, state, slot,
+                                                    block_root),
+                          "SYNC_COMMITTEE_MESSAGE")
+
+    def sign_sync_selection_proof(self, cfg, state, slot,
+                                  subcommittee_index,
+                                  validator_index) -> bytes:
+        from ..spec.altair.helpers import (
+            sync_selection_proof_signing_root)
+        return self._sign(
+            validator_index,
+            sync_selection_proof_signing_root(cfg, state, slot,
+                                              subcommittee_index),
+            "SYNC_COMMITTEE_SELECTION_PROOF")
+
+    def sign_contribution_and_proof(self, cfg, state, msg) -> bytes:
+        from ..spec.altair.helpers import (
+            contribution_and_proof_signing_root)
+        return self._sign(
+            msg.aggregator_index,
+            contribution_and_proof_signing_root(cfg, state, msg),
+            "SYNC_COMMITTEE_CONTRIBUTION_AND_PROOF")
+
+
+class FailoverError(Exception):
+    pass
+
+
+class FailoverValidatorApi(ValidatorApiChannel):
+    """Wraps an ordered list of ValidatorApiChannels: requests go to
+    the last-known-healthy node first and fail over in order on ANY
+    error, sticky until the next failure (reference
+    FailoverValidatorApiHandler.java:69)."""
+
+    def __init__(self, channels: Sequence[ValidatorApiChannel]):
+        assert channels, "need at least one beacon node"
+        self.channels = list(channels)
+        self._current = 0
+        self.failovers = 0
+
+    def _iter(self):
+        # snapshot: a concurrent request's failover mid-iteration must
+        # not make THIS request revisit a node it already saw (and
+        # never reach the healthy one)
+        start = self._current
+        n = len(self.channels)
+        for k in range(n):
+            yield (start + k) % n
+
+    def _sync(self, name, *args, **kw):
+        errors = []
+        for idx in self._iter():
+            try:
+                out = getattr(self.channels[idx], name)(*args, **kw)
+                if idx != self._current:
+                    _LOG.warning("failover: switched to beacon node %d",
+                                 idx)
+                    self.failovers += 1
+                    self._current = idx
+                return out
+            except Exception as exc:
+                errors.append((idx, exc))
+        raise FailoverError(f"{name} failed on every beacon node: "
+                            f"{errors}")
+
+    async def _async(self, name, *args, **kw):
+        errors = []
+        for idx in self._iter():
+            try:
+                out = await getattr(self.channels[idx], name)(*args,
+                                                              **kw)
+                if idx != self._current:
+                    _LOG.warning("failover: switched to beacon node %d",
+                                 idx)
+                    self.failovers += 1
+                    self._current = idx
+                return out
+            except Exception as exc:
+                errors.append((idx, exc))
+        raise FailoverError(f"{name} failed on every beacon node: "
+                            f"{errors}")
+
+    # -- sync surface --------------------------------------------------
+    def get_proposer_duties(self, epoch):
+        return self._sync("get_proposer_duties", epoch)
+
+    def get_attester_duties(self, epoch, indices):
+        return self._sync("get_attester_duties", epoch, indices)
+
+    def get_sync_duties(self, epoch, indices):
+        return self._sync("get_sync_duties", epoch, indices)
+
+    def get_attestation_data(self, slot, committee_index):
+        return self._sync("get_attestation_data", slot, committee_index)
+
+    def get_aggregate(self, data, committee_index=None):
+        return self._sync("get_aggregate", data, committee_index)
+
+    def duty_state(self, slot):
+        return self._sync("duty_state", slot)
+
+    def head_root(self):
+        return self._sync("head_root")
+
+    def build_sync_contribution(self, slot, block_root,
+                                subcommittee_index):
+        return self._sync("build_sync_contribution", slot, block_root,
+                          subcommittee_index)
+
+    # -- async surface -------------------------------------------------
+    async def produce_unsigned_block(self, slot, randao_reveal,
+                                     graffiti=bytes(32)):
+        return await self._async("produce_unsigned_block", slot,
+                                 randao_reveal, graffiti)
+
+    async def publish_signed_block(self, signed_block):
+        return await self._async("publish_signed_block", signed_block)
+
+    async def publish_attestation(self, attestation):
+        return await self._async("publish_attestation", attestation)
+
+    async def publish_aggregate_and_proof(self, signed_aggregate):
+        return await self._async("publish_aggregate_and_proof",
+                                 signed_aggregate)
+
+    async def publish_sync_committee_messages(self, msgs):
+        return await self._async("publish_sync_committee_messages",
+                                 msgs)
+
+    async def publish_sync_committee_message(self, msg):
+        return await self._async("publish_sync_committee_message", msg)
+
+    async def publish_contribution_and_proof(self, signed):
+        return await self._async("publish_contribution_and_proof",
+                                 signed)

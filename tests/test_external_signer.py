@@ -1,0 +1,241 @@
+"""External signer (Web3Signer-style) + multi-BN failover.
+
+reference: validator/client/.../signer/ExternalSigner.java:68,
+validator/remote/.../FailoverValidatorApiHandler.java:69.
+"""
+
+import asyncio
+import dataclasses
+import json
+import threading
+
+import pytest
+
+from teku_tpu.crypto import bls
+from teku_tpu.node.gossip import InMemoryGossipNetwork
+from teku_tpu.node.node import BeaconNode
+from teku_tpu.spec import config as C
+from teku_tpu.spec import Spec
+from teku_tpu.spec.genesis import interop_genesis
+from teku_tpu.validator import (BeaconNodeValidatorApi, ExternalSigner,
+                                FailoverError, FailoverValidatorApi,
+                                LocalSigner, SigningError,
+                                SlashingProtectedSigner, ValidatorClient)
+from teku_tpu.validator.slashing_protection import SlashingProtector
+
+
+class StubWeb3Signer:
+    """A Web3Signer lookalike over plain HTTP (threaded, so the VC's
+    blocking urllib calls don't deadlock the test's event loop):
+    POST /api/v1/eth2/sign/{pubkey}, GET /upcheck, GET publicKeys."""
+
+    def __init__(self, secret_keys):
+        self.by_pubkey = {bls.secret_to_public_key(sk): sk
+                          for sk in secret_keys}
+        self.requests = []
+        self.refuse = False
+        self.corrupt = False
+        import http.server
+
+        stub = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/upcheck":
+                    self._json(200, "OK")
+                elif self.path == "/api/v1/eth2/publicKeys":
+                    self._json(200, ["0x" + pk.hex()
+                                     for pk in stub.by_pubkey])
+                else:
+                    self._json(404, {})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                req = json.loads(self.rfile.read(n))
+                pubkey = bytes.fromhex(
+                    self.path.rsplit("/0x", 1)[1])
+                stub.requests.append((req.get("type"), pubkey))
+                if stub.refuse:
+                    self._json(412, {"error": "slashing"})
+                    return
+                sk = stub.by_pubkey.get(pubkey)
+                if sk is None:
+                    self._json(404, {})
+                    return
+                root = bytes.fromhex(req["signingRoot"][2:])
+                sig = bls.sign(sk, root)
+                if stub.corrupt:
+                    sig = b"\x0c" + sig[1:]
+                self._json(200, {"signature": "0x" + sig.hex()})
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), Handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+
+
+CFG = dataclasses.replace(C.MINIMAL, ALTAIR_FORK_EPOCH=0)
+
+
+def test_external_signer_signs_same_roots_as_local():
+    spec = Spec(CFG)
+    state, sks = interop_genesis(CFG, 16)
+    stub = StubWeb3Signer(sks)
+    try:
+        pubkeys = {i: bls.secret_to_public_key(sk)
+                   for i, sk in enumerate(sks)}
+        ext = ExternalSigner(f"http://127.0.0.1:{stub.port}", pubkeys)
+        local = LocalSigner(dict(enumerate(sks)))
+        assert ext.upcheck()
+        assert set(ext.public_keys()) == set(pubkeys.values())
+        # randao + attestation + selection proof match local exactly
+        assert ext.sign_randao_reveal(CFG, state, 0, 3) \
+            == local.sign_randao_reveal(CFG, state, 0, 3)
+        from teku_tpu.spec.datastructures import (AttestationData,
+                                                  Checkpoint)
+        data = AttestationData(
+            slot=1, index=0, beacon_block_root=b"\x01" * 32,
+            source=Checkpoint(epoch=0, root=b"\x02" * 32),
+            target=Checkpoint(epoch=0, root=b"\x03" * 32))
+        assert ext.sign_attestation_data(CFG, state, data, 5) \
+            == local.sign_attestation_data(CFG, state, data, 5)
+        assert ext.sign_selection_proof(CFG, state, 7, 2) \
+            == local.sign_selection_proof(CFG, state, 7, 2)
+        assert ("ATTESTATION", pubkeys[5]) in stub.requests
+    finally:
+        stub.stop()
+
+
+def test_external_signer_error_paths():
+    spec = Spec(CFG)
+    state, sks = interop_genesis(CFG, 4)
+    stub = StubWeb3Signer(sks[:2])      # holds only keys 0,1
+    try:
+        pubkeys = {i: bls.secret_to_public_key(sk)
+                   for i, sk in enumerate(sks)}
+        ext = ExternalSigner(f"http://127.0.0.1:{stub.port}", pubkeys)
+        with pytest.raises(SigningError):     # key not held → 404
+            ext.sign_randao_reveal(CFG, state, 0, 3)
+        stub.refuse = True                    # 412 slashing refusal
+        with pytest.raises(SigningError):
+            ext.sign_randao_reveal(CFG, state, 0, 0)
+        stub.refuse = False
+        stub.corrupt = True                   # bad signature detected
+        with pytest.raises(SigningError):
+            ext.sign_randao_reveal(CFG, state, 0, 0)
+        # unreachable signer
+        dead = ExternalSigner("http://127.0.0.1:1", pubkeys,
+                              timeout=0.5)
+        with pytest.raises(SigningError):
+            dead.sign_randao_reveal(CFG, state, 0, 0)
+        assert not dead.upcheck()
+    finally:
+        stub.stop()
+
+
+class _FlakyChannel:
+    """Wraps a real channel; raises on everything while down."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.down = False
+        self.calls = 0
+
+    def __getattr__(self, name):
+        real = getattr(self.inner, name)
+        if not callable(real):
+            return real
+
+        if asyncio.iscoroutinefunction(real):
+            async def wrapper(*a, **kw):
+                self.calls += 1
+                if self.down:
+                    raise ConnectionError("beacon node down")
+                return await real(*a, **kw)
+            return wrapper
+
+        def wrapper(*a, **kw):
+            self.calls += 1
+            if self.down:
+                raise ConnectionError("beacon node down")
+            return real(*a, **kw)
+        return wrapper
+
+
+@pytest.mark.slow
+def test_vc_survives_primary_bn_death_mid_epoch():
+    """Two beacon nodes on a devnet; the VC drives duties through a
+    failover channel and its external signer.  The primary dies
+    mid-epoch; duties continue via the secondary and the chain still
+    advances with blocks from the externally-signed VC."""
+    spec = Spec(CFG)
+    state, sks = interop_genesis(CFG, 8)
+    stub = StubWeb3Signer(sks)
+
+    async def run():
+        net = InMemoryGossipNetwork()
+        node_a = BeaconNode(spec, state, net.endpoint(), name="a")
+        node_b = BeaconNode(spec, state, net.endpoint(), name="b")
+        await node_a.start()
+        await node_b.start()
+        primary = _FlakyChannel(BeaconNodeValidatorApi(node_a))
+        secondary = BeaconNodeValidatorApi(node_b)
+        failover = FailoverValidatorApi([primary, secondary])
+        pubkeys = {i: bls.secret_to_public_key(sk)
+                   for i, sk in enumerate(sks)}
+        # verify=False here: response verification has its own unit
+        # test, and the oracle BLS re-check would double this devnet's
+        # runtime on one core
+        signer = SlashingProtectedSigner(
+            ExternalSigner(f"http://127.0.0.1:{stub.port}", pubkeys,
+                           verify=False),
+            SlashingProtector())
+        client = ValidatorClient(spec, failover, signer,
+                                 list(range(8)))
+        last = CFG.SLOTS_PER_EPOCH
+        half = last // 2
+        # phases run on THIS loop (the channels are in-process and the
+        # stub signer serves from its own thread, so the VC's blocking
+        # HTTP never deadlocks the node's services)
+        for slot in range(1, last + 1):
+            if slot == half:
+                primary.down = True      # primary dies mid-epoch
+            await node_a.on_slot(slot)
+            await node_b.on_slot(slot)
+            await client.on_slot_start(slot)
+            await client.on_attestation_due(slot)
+            await client.on_aggregation_due(slot)
+        assert failover.failovers >= 1
+        assert client.blocks_proposed >= last - 2
+        # the secondary's chain kept growing after the primary died
+        assert node_b.chain.head_slot() >= last - 1
+        # every signature came from the external signer
+        assert len(stub.requests) > last
+        await node_a.stop()
+        await node_b.stop()
+    asyncio.run(run())
+
+
+def test_failover_exhaustion_raises():
+    class _Chan:
+        def head_root(self):
+            raise ConnectionError("down")
+    fo = FailoverValidatorApi([_Chan(), _Chan()])
+    with pytest.raises(FailoverError):
+        fo.head_root()
