@@ -29,11 +29,14 @@ class BeaconRestApi(RestApi):
     """Routes bound to one BeaconNode (and optionally its p2p net)."""
 
     def __init__(self, node, networked=None, host: str = "127.0.0.1",
-                 port: int = 0, validator_api=None):
+                 port: int = 0, validator_api=None, database=None):
         super().__init__(host, port)
         self.node = node
         self.networked = networked
         self.validator_api = validator_api
+        # archive database: serves historical blocks/states the hot
+        # store has moved past (regenerating states from snapshots)
+        self.database = database
         g = self.get
         p = self.post
         g("/eth/v1/node/health", self._health)
@@ -100,20 +103,37 @@ class BeaconRestApi(RestApi):
                 raise HttpError(400, "root must be 32 bytes")
             if chain.contains_block(root):
                 return root
+            if self.database is not None \
+                    and self.database.has_block(root):
+                return root
             raise HttpError(404, "block not found")
         try:
             slot = int(block_id)
         except ValueError:
             raise HttpError(400, f"invalid block id {block_id!r}")
+        if slot < 0:
+            raise HttpError(400, "slot must be non-negative")
         root = self.node.store.proto.ancestor_at_slot(chain.head_root, slot)
         if root is None or self.node.store.blocks[root].slot != slot:
+            # historical: the finalized slot index in the archive
+            if self.database is not None:
+                db_root = self.database.canonical_root_at_slot(slot)
+                if db_root is not None:
+                    return db_root
             raise HttpError(404, "no canonical block at slot")
         return root
 
-    def _resolve_state(self, state_id: str):
+    async def _resolve_state_async(self, state_id: str):
         root = self._resolve_block_root(
             "head" if state_id == "head" else state_id)
         state = self.node.chain.get_state(root)
+        if state is None and self.database is not None:
+            # archive: snapshot hit or snapshot + block replay — the
+            # replay can be ~interval state transitions, so it must
+            # not stall duty queries on the event loop
+            import asyncio
+            state = await asyncio.get_running_loop().run_in_executor(
+                None, self.database.get_or_regenerate_state, root)
         if state is None:
             raise HttpError(404, "state not available")
         return state
@@ -164,9 +184,20 @@ class BeaconRestApi(RestApi):
             "genesis_fork_version": _hex(
                 self.node.spec.config.GENESIS_FORK_VERSION)}}
 
+    def _block_by_root(self, root: bytes):
+        """Hot store first, then the archive (the resolver may return
+        roots only the database holds)."""
+        block = self.node.store.blocks.get(root)
+        if block is None and self.database is not None:
+            signed = self.database.get_block(root)
+            block = signed.message if signed is not None else None
+        if block is None:
+            raise HttpError(404, "block not found")
+        return block
+
     async def _header(self, block_id: str):
         root = self._resolve_block_root(block_id)
-        block = self.node.store.blocks[root]
+        block = self._block_by_root(root)
         return {"data": {
             "root": _hex(root),
             "canonical": True,
@@ -210,6 +241,8 @@ class BeaconRestApi(RestApi):
     async def _block(self, block_id: str, query=None, headers=None):
         root = self._resolve_block_root(block_id)
         signed = self.node.store.signed_blocks.get(root)
+        if signed is None and self.database is not None:
+            signed = self.database.get_block(root)
         if signed is None:
             raise HttpError(404, "signed block not retained")
         wants_ssz = ("application/octet-stream"
@@ -238,7 +271,7 @@ class BeaconRestApi(RestApi):
     async def _state_ssz(self, state_id: str):
         """Full state as SSZ (reference GetState debug handler) — the
         fetch behind checkpoint sync and the remote VC's duty states."""
-        state = self._resolve_state(state_id)
+        state = await self._resolve_state_async(state_id)
         return type(state).serialize(state), "application/octet-stream"
 
     async def _attestation_data(self, query=None):
@@ -359,11 +392,11 @@ class BeaconRestApi(RestApi):
         return {}
 
     async def _state_root(self, state_id: str):
-        state = self._resolve_state(state_id)
+        state = await self._resolve_state_async(state_id)
         return {"data": {"root": _hex(state.htr())}}
 
     async def _finality(self, state_id: str):
-        state = self._resolve_state(state_id)
+        state = await self._resolve_state_async(state_id)
         def cp(c):
             return {"epoch": str(c.epoch), "root": _hex(c.root)}
         return {"data": {
@@ -372,7 +405,7 @@ class BeaconRestApi(RestApi):
             "finalized": cp(state.finalized_checkpoint)}}
 
     async def _validators(self, state_id: str, query=None):
-        state = self._resolve_state(state_id)
+        state = await self._resolve_state_async(state_id)
         cfg = self.node.spec.config
         epoch = H.get_current_epoch(cfg, state)
         from ..spec.config import FAR_FUTURE_EPOCH
@@ -487,7 +520,7 @@ class BeaconRestApi(RestApi):
         GetStateCommittees.java): all committees for an epoch, or
         filtered by slot/index."""
         query = query or {}
-        state = self._resolve_state(state_id)
+        state = await self._resolve_state_async(state_id)
         cfg = self.node.spec.config
         epoch = (int(query["epoch"]) if "epoch" in query
                  else H.get_current_epoch(cfg, state))
@@ -513,7 +546,7 @@ class BeaconRestApi(RestApi):
     async def _state_sync_committees(self, state_id: str, query=None):
         """Current sync committee of a state as validator indices
         (reference handlers/v1/beacon/GetStateSyncCommittees.java)."""
-        state = self._resolve_state(state_id)
+        state = await self._resolve_state_async(state_id)
         if not hasattr(state, "current_sync_committee"):
             raise HttpError(400, "pre-altair state")
         by_pubkey = {v.pubkey: i for i, v in enumerate(state.validators)}

@@ -88,9 +88,15 @@ def cmd_node(args) -> int:
     db = None
     storage = None
     restored = None
+    storage_mode = layered_value("storage-mode", args.storage_mode,
+                                 yaml_cfg, "prune")
+    if storage_mode not in ("archive", "prune"):
+        raise SystemExit(f"invalid storage-mode {storage_mode!r} "
+                         "(use archive or prune)")
     if data_dir:
         Path(data_dir).mkdir(parents=True, exist_ok=True)
-        db = Database(Path(data_dir) / "chain.db", spec)
+        db = Database(Path(data_dir) / "chain.db", spec,
+                      mode=storage_mode)
         storage = PersistentChainStorage(db)
         restored = storage.restore_store(spec)
     from_db = restored is not None
@@ -164,7 +170,8 @@ def cmd_node(args) -> int:
             eth1_task = asyncio.create_task(follower.run())
         api_channel = BeaconNodeValidatorApi(nn.node)
         rest_api = BeaconRestApi(nn.node, nn, port=rest_port,
-                                 validator_api=api_channel)
+                                 validator_api=api_channel,
+                                 database=db)
         await rest_api.start()
         clients = []
         if n_interop:
@@ -371,6 +378,10 @@ def build_parser() -> argparse.ArgumentParser:
     n.add_argument("--p2p-port", type=int, default=None)
     n.add_argument("--rest-port", type=int, default=None)
     n.add_argument("--data-dir", default=None)
+    n.add_argument("--storage-mode", default=None,
+                   choices=["archive", "prune"],
+                   help="archive keeps the full chain with state "
+                        "snapshots; prune keeps finalized + hot")
     n.add_argument("--interop-validators", type=int, default=None,
                    help="run the first N interop validators locally")
     n.add_argument("--interop-total", type=int, default=None,

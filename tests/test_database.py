@@ -69,7 +69,8 @@ def test_persist_restart_resume(tmp_path):
 
 def test_archive_mode_keeps_states(tmp_path):
     spec, store, blocks, anchor, genesis_state = _build_chain(3)
-    db = Database(tmp_path / "arch.db", spec, mode=ARCHIVE)
+    db = Database(tmp_path / "arch.db", spec, mode=ARCHIVE,
+                  state_snapshot_interval=1)     # snapshot every slot
     db.save_anchor(anchor, genesis_state)
     for signed, post in blocks:
         db.save_block(signed, post)
@@ -79,9 +80,113 @@ def test_archive_mode_keeps_states(tmp_path):
     db.close()
 
 
+@pytest.mark.slow
+def test_archive_snapshots_bound_storage_and_regenerate(tmp_path):
+    """Archive mode stores ~1/N full states; everything between comes
+    back byte-exact by snapshot + block replay (reference
+    StateCacheLoader / store regeneration)."""
+    N = 8
+    n_slots = 2 * CFG.SLOTS_PER_EPOCH
+    spec, store, blocks, anchor, genesis_state = _build_chain(n_slots)
+    db = Database(tmp_path / "arch.db", spec, mode=ARCHIVE,
+                  state_snapshot_interval=N)
+    storage = PersistentChainStorage(db)
+    db.save_anchor(anchor, genesis_state)
+    for signed, post in blocks:
+        storage.on_block_imported(signed, post)
+    # stored full states: snapshot slots + the anchor only
+    stored = sum(1 for signed, _ in blocks
+                 if db.get_state(signed.message.htr()) is not None)
+    assert stored <= n_slots // N
+    # every non-snapshot state regenerates exactly
+    for signed, post in blocks:
+        got = db.get_or_regenerate_state(signed.message.htr())
+        assert got is not None
+        assert got.htr() == post.htr(), signed.message.slot
+    assert db.states_regenerated >= n_slots - stored
+    db.close()
+
+
+@pytest.mark.slow
+def test_archive_restart_serves_any_historical_state_over_rest(tmp_path):
+    """After a restart the hot store only holds the finalized anchor
+    onward — the REST API must still serve any historical state (by
+    slot) from the archive via regeneration."""
+    import asyncio
+    import json
+    import urllib.request
+    from teku_tpu.api import BeaconRestApi
+    from teku_tpu.node.gossip import InMemoryGossipNetwork
+    from teku_tpu.node.node import BeaconNode
+    from teku_tpu.spec import Spec
+
+    n_slots = 4 * CFG.SLOTS_PER_EPOCH
+    spec, store, blocks, anchor, genesis_state = _build_chain(n_slots)
+    db = Database(tmp_path / "arch.db", spec, mode=ARCHIVE,
+                  state_snapshot_interval=8)
+    storage = PersistentChainStorage(db)
+    db.save_anchor(anchor, genesis_state)
+    for signed, post in blocks:
+        storage.on_block_imported(signed, post)
+    assert store.finalized_checkpoint.epoch >= 1
+    storage.on_finalized(store, store.finalized_checkpoint)
+    db.close()
+
+    # restart from disk
+    db2 = Database(tmp_path / "arch.db", spec, mode=ARCHIVE,
+                   state_snapshot_interval=8)
+    restored = PersistentChainStorage(db2).restore_store(spec)
+    assert restored is not None
+    node = BeaconNode(Spec(CFG), genesis_state,
+                      InMemoryGossipNetwork().endpoint(),
+                      store=restored)
+
+    async def run():
+        api = BeaconRestApi(node, database=db2)
+        await api.start()
+        try:
+            base = f"http://127.0.0.1:{api.port}"
+            loop = asyncio.get_running_loop()
+
+            def fetch(path):
+                with urllib.request.urlopen(base + path,
+                                            timeout=30) as r:
+                    return json.loads(r.read())
+            # historical slots BELOW the finalized anchor, none of
+            # them snapshot slots — regeneration must kick in
+            for slot in (3, 7, 13):
+                post = next(p for s, p in blocks
+                            if s.message.slot == slot)
+                out = await loop.run_in_executor(
+                    None, fetch, f"/eth/v1/beacon/states/{slot}/root")
+                assert out["data"]["root"] == "0x" + post.htr().hex()
+            assert db2.states_regenerated >= 1
+        finally:
+            await api.stop()
+    asyncio.run(run())
+    db2.close()
+
+
 def test_empty_database_returns_no_anchor(tmp_path):
     spec = create_spec("minimal")
     db = Database(tmp_path / "empty.db", spec)
     assert db.load_anchor() is None
     assert PersistentChainStorage(db).restore_store(spec) is None
+    db.close()
+
+
+def test_prune_mode_writes_no_slot_index(tmp_path):
+    """PRUNE deletes historical blocks, so it must not leave dangling
+    slot-index entries the REST fallback would resolve into 500s."""
+    spec, store, blocks, anchor, genesis_state = _build_chain(
+        2 * CFG.SLOTS_PER_EPOCH)
+    db = Database(tmp_path / "p.db", spec, mode=PRUNE)
+    storage = PersistentChainStorage(db)
+    db.save_anchor(anchor, genesis_state)
+    for signed, post in blocks:
+        storage.on_block_imported(signed, post)
+    storage.on_finalized(store, store.finalized_checkpoint)
+    for signed, _ in blocks:
+        assert db.canonical_root_at_slot(signed.message.slot) is None
+    assert db.canonical_root_at_slot(-1) is None
     db.close()
