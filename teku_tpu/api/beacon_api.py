@@ -60,6 +60,12 @@ class BeaconRestApi(RestApi):
         g("/eth/v1/beacon/states/{state_id}/sync_committees",
           self._state_sync_committees)
         g("/eth/v1/config/fork_schedule", self._fork_schedule)
+        g("/eth/v1/beacon/rewards/blocks/{block_id}",
+          self._block_rewards)
+        p("/eth/v1/beacon/rewards/attestations/{epoch}",
+          self._attestation_rewards)
+        p("/eth/v1/beacon/rewards/sync_committee/{block_id}",
+          self._sync_committee_rewards)
         p("/eth/v1/beacon/pool/attestations", self._submit_attestations)
         p("/eth/v1/beacon/pool/voluntary_exits", self._submit_exit)
         p("/eth/v1/beacon/pool/sync_committees", self._submit_sync_messages)
@@ -123,17 +129,21 @@ class BeaconRestApi(RestApi):
             raise HttpError(404, "no canonical block at slot")
         return root
 
-    async def _resolve_state_async(self, state_id: str):
-        root = self._resolve_block_root(
-            "head" if state_id == "head" else state_id)
+    async def _state_by_root_async(self, root: bytes):
+        """Hot store, else archive regeneration in an executor (the
+        replay can be ~snapshot_interval state transitions — it must
+        not stall duty queries on the event loop); None if unknown."""
         state = self.node.chain.get_state(root)
         if state is None and self.database is not None:
-            # archive: snapshot hit or snapshot + block replay — the
-            # replay can be ~interval state transitions, so it must
-            # not stall duty queries on the event loop
             import asyncio
             state = await asyncio.get_running_loop().run_in_executor(
                 None, self.database.get_or_regenerate_state, root)
+        return state
+
+    async def _resolve_state_async(self, state_id: str):
+        root = self._resolve_block_root(
+            "head" if state_id == "head" else state_id)
+        state = await self._state_by_root_async(root)
         if state is None:
             raise HttpError(404, "state not available")
         return state
@@ -576,6 +586,110 @@ class BeaconRestApi(RestApi):
                 "current_version": _hex(v.fork_version),
                 "epoch": str(v.fork_epoch)})
         return {"data": out}
+
+    async def _pre_post_states(self, root: bytes):
+        """(pre_state_at_block_slot, post_state, block) for a block —
+        the reward endpoints' shared setup."""
+        from ..spec.transition import process_slots
+        block = self._block_by_root(root)
+        post = await self._state_by_root_async(root)
+        parent_state = await self._state_by_root_async(
+            block.parent_root)
+        if post is None or parent_state is None:
+            raise HttpError(404, "states not available for rewards")
+        pre = parent_state
+        if pre.slot < block.slot:
+            pre = process_slots(self.node.spec.config, pre, block.slot)
+        return pre, post, block
+
+    def _validator_indices(self, state, body) -> list:
+        """The beacon-API 'validator index or pubkey' body shape."""
+        by_pubkey = None
+        out = []
+        for item in (body or []):
+            item = str(item)
+            if item.startswith("0x"):
+                if by_pubkey is None:
+                    by_pubkey = {v.pubkey: i
+                                 for i, v in enumerate(state.validators)}
+                try:
+                    index = by_pubkey.get(bytes.fromhex(item[2:]))
+                except ValueError:
+                    raise HttpError(400, f"bad pubkey {item!r}")
+                if index is None:
+                    raise HttpError(404, f"unknown validator {item!r}")
+                out.append(index)
+            else:
+                try:
+                    out.append(int(item))
+                except ValueError:
+                    raise HttpError(400, f"bad validator id {item!r}")
+        return out
+
+    async def _block_rewards(self, block_id: str):
+        """reference handlers/v1/rewards/GetBlockRewards.java."""
+        from . import rewards as R
+        root = self._resolve_block_root(block_id)
+        pre, post, block = await self._pre_post_states(root)
+        out = R.block_rewards(self.node.spec.config, pre, post, block)
+        return {"execution_optimistic": False, "finalized": False,
+                "data": {k: str(v) for k, v in out.items()}}
+
+    async def _attestation_rewards(self, epoch: str, body=None):
+        """reference handlers/v1/rewards/PostAttestationRewards.java —
+        rewards for `epoch` read from a state one epoch later (whose
+        previous-epoch participation covers it)."""
+        from . import rewards as R
+        cfg = self.node.spec.config
+        epoch = int(epoch)
+        head_state = self.node.chain.head_state()
+        current = H.get_current_epoch(cfg, head_state)
+        if epoch + 2 > current:
+            # attestations for `epoch` are includable through ALL of
+            # epoch+1 — rewards only settle once epoch+1 closes
+            raise HttpError(400, "rewards settle after epoch+1 closes")
+        # the LAST canonical block of epoch+1: its post-state holds the
+        # final participation for `epoch` (rotated away at the next
+        # boundary)
+        start = H.compute_start_slot_at_epoch(cfg, epoch + 1)
+        state = None
+        for slot in range(start + cfg.SLOTS_PER_EPOCH - 1, start - 1,
+                          -1):
+            try:
+                root = self._resolve_block_root(str(slot))
+            except HttpError:
+                continue
+            state = await self._state_by_root_async(root)
+            break
+        if state is None:
+            raise HttpError(404, "no state covering that epoch")
+        indices = self._validator_indices(state, body) or None
+        out = R.attestation_rewards(cfg, state, indices)
+        return {"execution_optimistic": False, "finalized": False,
+                "data": {
+                    "ideal_rewards": [
+                        {k: str(v) for k, v in row.items()}
+                        for row in out["ideal_rewards"]],
+                    "total_rewards": [
+                        {k: str(v) for k, v in row.items()}
+                        for row in out["total_rewards"]]}}
+
+    async def _sync_committee_rewards(self, block_id: str, body=None):
+        """reference handlers/v1/rewards/PostSyncCommitteeRewards."""
+        from . import rewards as R
+        root = self._resolve_block_root(block_id)
+        pre, post, block = await self._pre_post_states(root)
+        if not hasattr(block.body, "sync_aggregate") \
+                or not hasattr(pre, "current_sync_committee"):
+            raise HttpError(400, "pre-altair block has no sync rewards")
+        _, _, deltas = R.sync_aggregate_rewards(
+            self.node.spec.config, pre, block.body.sync_aggregate)
+        wanted = set(self._validator_indices(pre, body)) or None
+        return {"execution_optimistic": False, "finalized": False,
+                "data": [
+                    {"validator_index": str(i), "reward": str(d)}
+                    for i, d in deltas
+                    if wanted is None or i in wanted]}
 
     def _decode_versioned(self, attr: str, raw: bytes):
         """Decode raw SSZ against each scheduled milestone's schema,

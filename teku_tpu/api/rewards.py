@@ -1,0 +1,259 @@
+"""Reward calculation for the REST rewards endpoints.
+
+Equivalent of the reference's rewards providers (reference: data/
+beaconrestapi/.../handlers/v1/rewards/ GetBlockRewards /
+PostAttestationRewards / PostSyncCommitteeRewards backed by
+validator/coordinator/RewardCalculator.java): block proposer reward
+decomposition, per-validator attestation rewards for an epoch, and
+per-participant sync-committee rewards for a block.
+
+All math reuses the spec modules' own formulas; the proposer's
+attestation component is derived exactly as
+(post - pre balance delta) - sync component - slashing components,
+which is the identity the transition guarantees.
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+from ..spec import helpers as H
+from ..spec.config import (PARTICIPATION_FLAG_WEIGHTS, PROPOSER_WEIGHT,
+                           SpecConfig, SYNC_REWARD_WEIGHT,
+                           TIMELY_HEAD_FLAG_INDEX,
+                           TIMELY_SOURCE_FLAG_INDEX,
+                           TIMELY_TARGET_FLAG_INDEX, WEIGHT_DENOMINATOR)
+
+
+def sync_aggregate_rewards(cfg: SpecConfig, pre_state,
+                           sync_aggregate
+                           ) -> Tuple[int, int, List[Tuple[int, int]]]:
+    """(proposer_total, participant_reward, [(validator_index, delta)])
+    for one block's sync aggregate, from the block's PRE-state (same
+    math as altair process_sync_aggregate)."""
+    from ..spec.altair import helpers as AH
+    total_active_increments = (H.get_total_active_balance(cfg, pre_state)
+                               // cfg.EFFECTIVE_BALANCE_INCREMENT)
+    base_per_inc = AH.get_base_reward_per_increment(cfg, pre_state)
+    total_base_rewards = base_per_inc * total_active_increments
+    max_participant_rewards = (total_base_rewards * SYNC_REWARD_WEIGHT
+                               // WEIGHT_DENOMINATOR
+                               // cfg.SLOTS_PER_EPOCH)
+    participant_reward = (max_participant_rewards
+                          // cfg.SYNC_COMMITTEE_SIZE)
+    proposer_per = (participant_reward * PROPOSER_WEIGHT
+                    // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT))
+    pubkey_to_index = {v.pubkey: i
+                       for i, v in enumerate(pre_state.validators)}
+    deltas = []
+    proposer_total = 0
+    for pk, participated in zip(
+            pre_state.current_sync_committee.pubkeys,
+            sync_aggregate.sync_committee_bits):
+        index = pubkey_to_index[pk]
+        if participated:
+            deltas.append((index, participant_reward))
+            proposer_total += proposer_per
+        else:
+            deltas.append((index, -participant_reward))
+    return proposer_total, participant_reward, deltas
+
+
+def slashing_rewards(cfg: SpecConfig, pre_state, body
+                     ) -> Tuple[int, int]:
+    """(proposer_slashing_reward, attester_slashing_reward) the block's
+    proposer earns for included slashings.  In-protocol slashings pass
+    whistleblower_index=None, so the proposer collects the FULL
+    whistleblower reward (spec slash_validator: proposer_reward plus
+    the whistleblower remainder both land on the proposer)."""
+    epoch = H.get_current_epoch(cfg, pre_state)
+    electra = hasattr(pre_state, "deposit_requests_start_index")
+    quotient = (cfg.WHISTLEBLOWER_REWARD_QUOTIENT_ELECTRA if electra
+                else cfg.WHISTLEBLOWER_REWARD_QUOTIENT)
+
+    def full_whistleblower(validator_index: int) -> int:
+        v = pre_state.validators[validator_index]
+        # only slashable validators are slashed (and rewarded for)
+        if v.slashed or not (v.activation_epoch <= epoch
+                             < v.withdrawable_epoch):
+            return 0
+        return v.effective_balance // quotient
+
+    proposer_total = 0
+    for slashing in body.proposer_slashings:
+        proposer_total += full_whistleblower(
+            slashing.signed_header_1.message.proposer_index)
+    attester_total = 0
+    for slashing in body.attester_slashings:
+        a = set(slashing.attestation_1.attesting_indices)
+        b = set(slashing.attestation_2.attesting_indices)
+        for index in sorted(a & b):
+            attester_total += full_whistleblower(index)
+    return proposer_total, attester_total
+
+
+def block_rewards(cfg: SpecConfig, pre_state, post_state, block
+                  ) -> Dict[str, int]:
+    """The GetBlockRewards decomposition.  `pre_state` must already be
+    advanced to block.slot (pre-block), `post_state` is the block's
+    post-state."""
+    proposer = block.proposer_index
+    total = int(post_state.balances[proposer]) \
+        - int(pre_state.balances[proposer])
+    body = block.body
+    # the raw delta includes non-reward balance movement: withdrawals
+    # debiting the proposer (capella+ sweep) and deposits crediting it
+    # — normalize them out so the decomposition reports REWARDS only
+    payload = getattr(body, "execution_payload", None)
+    for w in getattr(payload, "withdrawals", ()) or ():
+        if w.validator_index == proposer:
+            total += int(w.amount)
+    proposer_pubkey = pre_state.validators[proposer].pubkey
+    for deposit in getattr(body, "deposits", ()) or ():
+        if deposit.data.pubkey == proposer_pubkey:
+            total -= int(deposit.data.amount)
+    sync_total = 0
+    if hasattr(body, "sync_aggregate") \
+            and hasattr(pre_state, "current_sync_committee"):
+        sync_total, _, deltas = sync_aggregate_rewards(
+            cfg, pre_state, body.sync_aggregate)
+        # the proposer may itself sit in the committee: its own
+        # participant delta lands in `total` but is not proposer income
+        # from PROPOSING — the endpoint counts it under sync_aggregate
+        # per the reference's calculator
+        sync_total += sum(d for i, d in deltas if i == proposer)
+    prop_slash, att_slash = slashing_rewards(cfg, pre_state, body)
+    attestations = total - sync_total - prop_slash - att_slash
+    return {
+        "proposer_index": proposer,
+        "total": total,
+        "attestations": attestations,
+        "sync_aggregate": sync_total,
+        "proposer_slashings": prop_slash,
+        "attester_slashings": att_slash,
+    }
+
+
+def phase0_attestation_rewards(cfg: SpecConfig, state,
+                               indices: Optional[List[int]] = None
+                               ) -> Dict:
+    """Phase0 shape of the rewards decomposition (pending-attestation
+    component deltas + inclusion delay + leak penalties — the same
+    parts get_attestation_deltas sums)."""
+    from ..spec import epoch as E0
+
+    n = len(state.validators)
+    wanted = set(indices) if indices else None
+    total_balance = H.get_total_active_balance(cfg, state)
+    eligible = E0.get_eligible_validator_indices(cfg, state)
+    prev = H.get_previous_epoch(cfg, state)
+    src = E0.get_matching_source_attestations(cfg, state, prev)
+    tgt = E0.get_matching_target_attestations(cfg, state, prev)
+    head = E0.get_matching_head_attestations(cfg, state, prev)
+    parts = {}
+    for name, atts in (("source", src), ("target", tgt),
+                       ("head", head)):
+        r, p = E0._component_deltas(cfg, state, atts, n, total_balance,
+                                    eligible)
+        parts[name] = [r[i] - p[i] for i in range(n)]
+    # inclusion delay (attester part only; the proposer part is block
+    # income, reported by the block-rewards endpoint)
+    incl = [0] * n
+    att_cache = {}
+    for a in src:
+        for i in H.get_attesting_indices(cfg, state, a.data,
+                                         a.aggregation_bits):
+            cached = att_cache.get(i)
+            if cached is None or a.inclusion_delay < \
+                    cached.inclusion_delay:
+                att_cache[i] = a
+    for index in E0.get_unslashed_attesting_indices(cfg, state, src):
+        a = att_cache[index]
+        base = E0.get_base_reward(cfg, state, index, total_balance)
+        proposer_reward = base // cfg.PROPOSER_REWARD_QUOTIENT
+        incl[index] += (base - proposer_reward) // a.inclusion_delay
+    inactivity = [0] * n
+    if E0.is_in_inactivity_leak(cfg, state):
+        tgt_unslashed = E0.get_unslashed_attesting_indices(cfg, state,
+                                                           tgt)
+        delay = E0.get_finality_delay(cfg, state)
+        for index in eligible:
+            base = E0.get_base_reward(cfg, state, index, total_balance)
+            inactivity[index] -= (E0.BASE_REWARDS_PER_EPOCH * base
+                                  - base // cfg.PROPOSER_REWARD_QUOTIENT)
+            if index not in tgt_unslashed:
+                eff = state.validators[index].effective_balance
+                inactivity[index] -= (eff * delay
+                                      // cfg.INACTIVITY_PENALTY_QUOTIENT)
+    totals = []
+    for i in range(n):
+        if wanted is not None and i not in wanted:
+            continue
+        totals.append({"validator_index": i,
+                       "head": parts["head"][i],
+                       "target": parts["target"][i],
+                       "source": parts["source"][i],
+                       "inclusion_delay": incl[i],
+                       "inactivity": inactivity[i]})
+    return {"ideal_rewards": [], "total_rewards": totals}
+
+
+def attestation_rewards(cfg: SpecConfig, state,
+                        indices: Optional[List[int]] = None) -> Dict:
+    """Per-validator attestation rewards for the epoch the state's
+    PREVIOUS participation covers (call with a state in epoch+1, as the
+    reference's PostAttestationRewards does): actual head/target/source
+    rewards-or-penalties plus the ideal table per effective balance."""
+    from ..spec import epoch as E0
+    from ..spec.altair import epoch as AE
+    from ..spec.altair import helpers as AH
+
+    if not hasattr(state, "previous_epoch_participation"):
+        return phase0_attestation_rewards(cfg, state, indices)
+
+    n = len(state.validators)
+    wanted = set(indices) if indices else None
+    flag_names = {TIMELY_SOURCE_FLAG_INDEX: "source",
+                  TIMELY_TARGET_FLAG_INDEX: "target",
+                  TIMELY_HEAD_FLAG_INDEX: "head"}
+    totals = {i: {"head": 0, "target": 0, "source": 0, "inactivity": 0}
+              for i in range(n)
+              if wanted is None or i in wanted}
+    for flag_index in range(len(PARTICIPATION_FLAG_WEIGHTS)):
+        rewards, penalties = AE.get_flag_index_deltas(cfg, state,
+                                                      flag_index)
+        name = flag_names[flag_index]
+        for i in totals:
+            totals[i][name] = rewards[i] - penalties[i]
+    _, inactivity = AE.get_inactivity_penalty_deltas(cfg, state)
+    for i in totals:
+        totals[i]["inactivity"] = -inactivity[i]
+
+    # ideal rewards per effective-balance increment tier (a perfect
+    # attester with every timely flag, not leaking)
+    inc = cfg.EFFECTIVE_BALANCE_INCREMENT
+    active_increments = H.get_total_active_balance(cfg, state) // inc
+    base_per_inc = AH.get_base_reward_per_increment(cfg, state)
+    leaking = E0.is_in_inactivity_leak(cfg, state)
+    unslashed_incs = {}
+    for flag_index in range(len(PARTICIPATION_FLAG_WEIGHTS)):
+        participating = AH.get_unslashed_participating_indices(
+            cfg, state, flag_index, H.get_previous_epoch(cfg, state))
+        unslashed_incs[flag_index] = H.get_total_balance(
+            cfg, state, participating) // inc
+    max_eb = max((v.effective_balance for v in state.validators),
+                 default=cfg.MAX_EFFECTIVE_BALANCE)
+    ideal = []
+    for tiers in range(1, max_eb // inc + 1):
+        eb = tiers * inc
+        base_reward = tiers * base_per_inc
+        row = {"effective_balance": eb, "head": 0, "target": 0,
+               "source": 0, "inactivity": 0}
+        if not leaking:
+            for flag_index, weight in enumerate(
+                    PARTICIPATION_FLAG_WEIGHTS):
+                row[flag_names[flag_index]] = (
+                    base_reward * weight * unslashed_incs[flag_index]
+                    // (active_increments * WEIGHT_DENOMINATOR))
+        ideal.append(row)
+    return {"ideal_rewards": ideal,
+            "total_rewards": [dict(validator_index=i, **vals)
+                              for i, vals in sorted(totals.items())]}

@@ -130,7 +130,16 @@ def cmd_node(args) -> int:
 
     async def run():
         from .infra.events import FinalizedCheckpointChannel
-        nn = NetworkedNode(spec, genesis_state, port=port, store=restored)
+        udp_port = layered_value("udp-discovery-port",
+                                 args.udp_discovery_port, yaml_cfg)
+        if args.bootnode and udp_port is None:
+            raise SystemExit("--bootnode requires --udp-discovery-port"
+                             " (use 0 for an ephemeral port)")
+        nn = NetworkedNode(
+            spec, genesis_state, port=port, store=restored,
+            udp_discovery_port=(int(udp_port) if udp_port is not None
+                                else None),
+            bootnodes=args.bootnode or [])
         if db is not None:
             if not from_db:
                 # fresh genesis OR checkpoint-synced anchor: persist it
@@ -198,6 +207,15 @@ def cmd_node(args) -> int:
                            // spec.config.SECONDS_PER_SLOT)
                 if slot > 0:
                     await nn.node.on_slot(slot)
+                    # joined late or fell behind: multipeer catch-up
+                    # (gossiped blocks with unknown parents park in the
+                    # pending pool; sync backfills the gap)
+                    if nn.node.chain.head_slot() + 1 < slot \
+                            and nn.net.peers:
+                        try:
+                            await nn.sync.run_until_synced(max_rounds=2)
+                        except Exception:
+                            logging.exception("catch-up sync failed")
                     for c in clients:
                         await c.on_slot_start(slot)
                     await asyncio.sleep(spec.config.SECONDS_PER_SLOT / 3)
@@ -389,6 +407,11 @@ def build_parser() -> argparse.ArgumentParser:
     n.add_argument("--genesis-time", type=int, default=None,
                    help="unix genesis time (default: now; devnet nodes "
                         "must agree)")
+    n.add_argument("--udp-discovery-port", type=int, default=None,
+                   help="enable UDP node discovery on this port "
+                        "(0 = ephemeral)")
+    n.add_argument("--bootnode", action="append",
+                   help="UDP discovery bootstrap address ip:udp_port")
     n.add_argument("--peer", action="append",
                    help="host:port to dial (repeatable)")
     n.add_argument("--eth1-endpoint", default=None,

@@ -4,6 +4,8 @@ Reference: /root/reference/networking/ (p2p, eth2) and
 /root/reference/beacon/sync/.
 """
 
+from typing import Optional
+
 from .gossip import TcpGossipNetwork
 from .reqresp import BeaconRpc
 from .sync import SyncService
@@ -15,12 +17,20 @@ class NetworkedNode:
     mirroring the reference's Eth2P2PNetworkBuilder composition."""
 
     def __init__(self, spec, genesis_state, host: str = "127.0.0.1",
-                 port: int = 0, name: str = "node", store=None):
+                 port: int = 0, name: str = "node", store=None,
+                 udp_discovery_port: Optional[int] = None,
+                 bootnodes=(), target_peers: int = 8):
         from ..spec import helpers as H
         from ..node.node import BeaconNode
+        self._host = host
         digest = H.compute_fork_digest(
             spec.config.GENESIS_FORK_VERSION,
             genesis_state.genesis_validators_root)
+        self._udp_discovery_port = udp_discovery_port
+        self._bootnodes = list(bootnodes)
+        self._target_peers = target_peers
+        self.discv5 = None
+        self._discv5_task = None
         self.net = P2PNetwork(NetworkConfig(host=host, port=port), digest)
         self.gossip = TcpGossipNetwork(self.net)
         self.node = BeaconNode(spec, genesis_state, self.gossip,
@@ -39,11 +49,57 @@ class NetworkedNode:
         self.net.on_peer_connected = _on_connect
 
     async def start(self) -> None:
+        import asyncio
         await self.net.start()
         await self.gossip.start()
         await self.node.start()
+        if self._udp_discovery_port is not None:
+            # UDP walker: discovered fork-matched records feed the TCP
+            # dialer until the peer target holds (reference
+            # DiscoveryNetwork composing discv5 + ConnectionManager)
+            from .discv5 import UdpDiscoveryService
+
+            dial_tasks = set()   # strong refs: tasks held weakly
+
+            def _dial(record):
+                if record.noise_pub == self.net.node_id:
+                    return
+                if len(self.net.peers) >= self._target_peers:
+                    return
+                task = asyncio.ensure_future(
+                    self.net.connect(record.ip, record.tcp_port))
+                dial_tasks.add(task)
+                task.add_done_callback(dial_tasks.discard)
+            self.discv5 = UdpDiscoveryService(
+                noise_pub=self.net.node_id,
+                fork_digest=self.net.fork_digest,
+                ip=self._host,
+                udp_port=self._udp_discovery_port,
+                tcp_port=self.net.port,
+                on_discovered=_dial)
+            await self.discv5.start()
+            if self._bootnodes:
+                await self.discv5.bootstrap(
+                    [(h, int(p)) for h, p in
+                     (addr.rsplit(":", 1) for addr in self._bootnodes)])
+            self._discv5_task = asyncio.create_task(self.discv5.run())
+        elif self._bootnodes:
+            raise ValueError("bootnodes given but UDP discovery is "
+                             "disabled (set udp_discovery_port)")
 
     async def stop(self) -> None:
+        import asyncio
+        if self._discv5_task is not None:
+            # cancel the handle we hold: discv5.stop()'s own handle is
+            # registered from inside run(), which may not have started
+            self._discv5_task.cancel()
+            try:
+                await self._discv5_task
+            except asyncio.CancelledError:
+                pass
+            self._discv5_task = None
+        if self.discv5 is not None:
+            await self.discv5.stop()
         await self.node.stop()
         await self.gossip.stop()
         await self.net.stop()

@@ -1,0 +1,349 @@
+"""UDP node discovery: signed records, Kademlia routing, random-walk
+lookups — the discv5 role, natively.
+
+Equivalent of the reference's discv5 stack (reference: networking/p2p/
+.../discovery/discv5/DiscV5Service.java:57 wrapping a discv5 walker;
+DiscoveryNetwork.java composing it with the connection manager): nodes
+carry SIGNED, sequence-numbered records (the ENR role) and answer
+PING/PONG (liveness + record exchange) and FINDNODE/NODES (peers close
+to a target id) over UDP; a periodic random-target lookup walks the
+DHT and hands live, fork-matched endpoints to the TCP dialer.
+
+Simplifications vs wire-discv5, chosen deliberately: records are
+Ed25519-signed (no secp256k1 in this stack) and datagrams carry
+whole records rather than discv5's encrypted session envelopes — a
+record is self-authenticating, and transport security lives in the
+noise layer where the real traffic flows.  node_id =
+sha256(ed25519_pub), XOR-distance buckets, k=16, alpha=3.
+"""
+
+import asyncio
+import hashlib
+import logging
+import secrets
+import socket
+import struct
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey, Ed25519PublicKey)
+
+_LOG = logging.getLogger(__name__)
+
+K_BUCKET = 16
+ALPHA = 3
+MSG_PING = 1
+MSG_PONG = 2
+MSG_FINDNODE = 3
+MSG_NODES = 4
+MAX_RECORD = 512
+MAX_DATAGRAM = 1400          # stay under typical MTU
+
+
+@dataclass(frozen=True)
+class NodeRecord:
+    """The ENR role: everything needed to contact and authenticate a
+    node, signed by its discovery identity."""
+    seq: int
+    ed_pub: bytes            # 32B identity key
+    noise_pub: bytes         # 32B transport identity (dial target id)
+    fork_digest: bytes       # 4B network filter
+    ip: str
+    udp_port: int
+    tcp_port: int
+    signature: bytes = b""
+
+    @property
+    def node_id(self) -> bytes:
+        return hashlib.sha256(self.ed_pub).digest()
+
+    def _signing_body(self) -> bytes:
+        ip = self.ip.encode()
+        return (struct.pack("<Q", self.seq) + self.ed_pub
+                + self.noise_pub + self.fork_digest
+                + struct.pack("<HHB", self.udp_port, self.tcp_port,
+                              len(ip)) + ip)
+
+    def encode(self) -> bytes:
+        return self._signing_body() + self.signature
+
+    @classmethod
+    def decode(cls, raw: bytes) -> "NodeRecord":
+        if len(raw) < 8 + 32 + 32 + 4 + 5 + 64:
+            raise ValueError("record too short")
+        (seq,) = struct.unpack("<Q", raw[:8])
+        ed_pub = raw[8:40]
+        noise_pub = raw[40:72]
+        fork_digest = raw[72:76]
+        udp_port, tcp_port, ip_len = struct.unpack("<HHB", raw[76:81])
+        ip = raw[81:81 + ip_len].decode()
+        signature = raw[81 + ip_len:81 + ip_len + 64]
+        record = cls(seq=seq, ed_pub=ed_pub, noise_pub=noise_pub,
+                     fork_digest=fork_digest, ip=ip,
+                     udp_port=udp_port, tcp_port=tcp_port,
+                     signature=signature)
+        record.verify()
+        return record
+
+    def verify(self) -> None:
+        try:
+            Ed25519PublicKey.from_public_bytes(self.ed_pub).verify(
+                self.signature, self._signing_body())
+        except Exception:
+            raise ValueError("bad record signature")
+
+
+def make_record(identity: Ed25519PrivateKey, noise_pub: bytes,
+                fork_digest: bytes, ip: str, udp_port: int,
+                tcp_port: int, seq: int = 1) -> NodeRecord:
+    record = NodeRecord(seq=seq,
+                        ed_pub=identity.public_key().public_bytes_raw(),
+                        noise_pub=noise_pub, fork_digest=fork_digest,
+                        ip=ip, udp_port=udp_port, tcp_port=tcp_port)
+    sig = identity.sign(record._signing_body())
+    return NodeRecord(**{**record.__dict__, "signature": sig})
+
+
+def _distance(a: bytes, b: bytes) -> int:
+    return int.from_bytes(a, "big") ^ int.from_bytes(b, "big")
+
+
+class RoutingTable:
+    """XOR-metric buckets (log-distance), k entries each, LRU within a
+    bucket; liveness evicts via the service's ping cycle."""
+
+    def __init__(self, own_id: bytes, k: int = K_BUCKET):
+        self.own_id = own_id
+        self.k = k
+        self._buckets: Dict[int, List[NodeRecord]] = {}
+        self._by_id: Dict[bytes, NodeRecord] = {}
+
+    def _bucket_of(self, node_id: bytes) -> int:
+        d = _distance(self.own_id, node_id)
+        return d.bit_length()        # 0 only for self
+
+    def add(self, record: NodeRecord) -> bool:
+        nid = record.node_id
+        if nid == self.own_id:
+            return False
+        existing = self._by_id.get(nid)
+        if existing is not None and existing.seq >= record.seq:
+            return False             # stale or same
+        idx = self._bucket_of(nid)
+        bucket = self._buckets.setdefault(idx, [])
+        if existing is not None:
+            bucket[:] = [r for r in bucket if r.node_id != nid]
+        elif len(bucket) >= self.k:
+            return False             # full: keep the tested residents
+        bucket.append(record)
+        self._by_id[nid] = record
+        return True
+
+    def remove(self, node_id: bytes) -> None:
+        record = self._by_id.pop(node_id, None)
+        if record is not None:
+            idx = self._bucket_of(node_id)
+            self._buckets[idx] = [r for r in self._buckets.get(idx, [])
+                                  if r.node_id != node_id]
+
+    def closest(self, target: bytes, n: int = K_BUCKET
+                ) -> List[NodeRecord]:
+        return sorted(self._by_id.values(),
+                      key=lambda r: _distance(r.node_id, target))[:n]
+
+    def __len__(self) -> int:
+        return len(self._by_id)
+
+    def records(self) -> List[NodeRecord]:
+        return list(self._by_id.values())
+
+
+class UdpDiscoveryService(asyncio.DatagramProtocol):
+    """The walker: answers PING/FINDNODE, pings for liveness, runs
+    random-target lookups, and reports live fork-matched records to
+    `on_discovered` (the connection manager's dial feed)."""
+
+    def __init__(self, identity: Optional[Ed25519PrivateKey] = None,
+                 noise_pub: bytes = bytes(32),
+                 fork_digest: bytes = bytes(4),
+                 ip: str = "127.0.0.1", udp_port: int = 0,
+                 tcp_port: int = 0,
+                 on_discovered: Optional[
+                     Callable[[NodeRecord], None]] = None):
+        self.identity = identity or Ed25519PrivateKey.generate()
+        self.noise_pub = noise_pub
+        self.fork_digest = fork_digest
+        self._ip = ip
+        self._udp_port = udp_port
+        self._tcp_port = tcp_port
+        self.on_discovered = on_discovered
+        self.record: Optional[NodeRecord] = None
+        self.table: Optional[RoutingTable] = None
+        self._transport = None
+        self._pending_pong: Dict[Tuple[str, int],
+                                 asyncio.Future] = {}
+        self._pending_nodes: Dict[Tuple[str, int],
+                                  asyncio.Future] = {}
+        self._task: Optional[asyncio.Task] = None
+        self.port: int = udp_port
+
+    # -- lifecycle ------------------------------------------------------
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._transport, _ = await loop.create_datagram_endpoint(
+            lambda: self, local_addr=(self._ip, self._udp_port))
+        self.port = self._transport.get_extra_info("sockname")[1]
+        self.record = make_record(self.identity, self.noise_pub,
+                                  self.fork_digest, self._ip,
+                                  self.port, self._tcp_port)
+        self.table = RoutingTable(self.record.node_id)
+
+    async def run(self, interval_s: float = 10.0) -> None:
+        self._task = asyncio.current_task()
+        while True:
+            try:
+                await self.lookup(secrets.token_bytes(32))
+                await self._liveness_round()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                _LOG.exception("discovery round failed")
+            await asyncio.sleep(interval_s)
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        if self._transport is not None:
+            self._transport.close()
+
+    # -- datagram handling ---------------------------------------------
+    def datagram_received(self, data: bytes, addr) -> None:
+        try:
+            self._handle(data, addr)
+        except Exception:
+            _LOG.debug("bad discovery datagram from %s", addr)
+
+    def _handle(self, data: bytes, addr) -> None:
+        if not data:
+            return
+        kind = data[0]
+        if kind in (MSG_PING, MSG_PONG):
+            record = NodeRecord.decode(data[1:])
+            if record.fork_digest != self.fork_digest:
+                return          # other network: no pong, no table entry
+            self._admit(record)
+            if kind == MSG_PING:
+                self._send(addr, MSG_PONG, self.record.encode())
+            else:
+                fut = self._pending_pong.pop(addr, None)
+                if fut is not None and not fut.done():
+                    fut.set_result(record)
+        elif kind == MSG_FINDNODE:
+            target = data[1:33]
+            asker = NodeRecord.decode(data[33:])
+            self._admit(asker)
+            body = bytearray()
+            count = 0
+            for rec in self.table.closest(target):
+                enc = rec.encode()
+                if len(body) + len(enc) + 3 > MAX_DATAGRAM:
+                    break
+                body += struct.pack("<H", len(enc)) + enc
+                count += 1
+            self._send(addr, MSG_NODES,
+                       bytes([count]) + bytes(body))
+        elif kind == MSG_NODES:
+            count = data[1]
+            pos = 2
+            found = []
+            for _ in range(count):
+                (n,) = struct.unpack("<H", data[pos:pos + 2])
+                pos += 2
+                found.append(NodeRecord.decode(data[pos:pos + n]))
+                pos += n
+            for rec in found:
+                self._admit(rec)
+            fut = self._pending_nodes.pop(addr, None)
+            if fut is not None and not fut.done():
+                fut.set_result(found)
+
+    def _admit(self, record: NodeRecord) -> None:
+        """Signed + fork-matched records enter the table and the dial
+        feed (the DiscoveryNetwork composition point)."""
+        if record.fork_digest != self.fork_digest:
+            return
+        if self.table.add(record) and self.on_discovered is not None:
+            try:
+                self.on_discovered(record)
+            except Exception:
+                _LOG.exception("on_discovered failed")
+
+    def _send(self, addr, kind: int, payload: bytes) -> None:
+        if self._transport is not None:
+            self._transport.sendto(bytes([kind]) + payload, addr)
+
+    # -- client ops -----------------------------------------------------
+    async def ping(self, addr: Tuple[str, int],
+                   timeout: float = 2.0) -> Optional[NodeRecord]:
+        """PING an endpoint; returns its (verified) record on PONG."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending_pong[addr] = fut
+        self._send(addr, MSG_PING, self.record.encode())
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            return None
+        finally:
+            self._pending_pong.pop(addr, None)
+
+    async def find_node(self, record: NodeRecord, target: bytes,
+                        timeout: float = 2.0) -> List[NodeRecord]:
+        addr = (record.ip, record.udp_port)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending_nodes[addr] = fut
+        self._send(addr, MSG_FINDNODE, target + self.record.encode())
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            return []
+        finally:
+            self._pending_nodes.pop(addr, None)
+
+    async def bootstrap(self, addrs: List[Tuple[str, int]]) -> int:
+        """PING the seed endpoints; returns how many answered."""
+        results = await asyncio.gather(
+            *(self.ping(a) for a in addrs))
+        return sum(1 for r in results if r is not None)
+
+    async def lookup(self, target: bytes) -> List[NodeRecord]:
+        """Iterative Kademlia lookup: query ALPHA closest, merge NODES,
+        repeat while the closest set improves."""
+        queried = set()
+        while True:
+            frontier = [r for r in self.table.closest(target)
+                        if r.node_id not in queried][:ALPHA]
+            if not frontier:
+                break
+            for r in frontier:
+                queried.add(r.node_id)
+            before = len(self.table)
+            await asyncio.gather(
+                *(self.find_node(r, target) for r in frontier))
+            if len(self.table) == before and len(queried) >= ALPHA:
+                break
+        return self.table.closest(target)
+
+    async def _liveness_round(self) -> None:
+        """Ping the table; evict the dead (the k-bucket 'tested
+        residents' rule's other half)."""
+        for record in self.table.records():
+            pong = await self.ping((record.ip, record.udp_port),
+                                   timeout=1.0)
+            if pong is None:
+                self.table.remove(record.node_id)

@@ -183,6 +183,9 @@ class P2PNetwork:
         await self._handshake(peer, noise_id)
         if not peer.connected:
             return None
+        if not self._resolve_duplicate(peer):
+            peer.close()
+            return None
         self.peers.append(peer)
         asyncio.create_task(self._read_loop(peer))
         if self.on_peer_connected:
@@ -203,6 +206,9 @@ class P2PNetwork:
         peer = Peer(reader, writer, outbound=False)
         await self._handshake(peer, noise_id)
         if not peer.connected:
+            return
+        if not self._resolve_duplicate(peer):
+            peer.close()
             return
         if len(self.peers) >= self.config.max_peers:
             await peer.send_frame(KIND_GOODBYE, b"\x02")  # too many peers
@@ -225,6 +231,26 @@ class P2PNetwork:
             timeout=10.0)
         return N.NoiseReader(reader, rx), N.NoiseWriter(writer, tx), \
             remote_static
+
+    def _resolve_duplicate(self, new_peer: Peer) -> bool:
+        """Simultaneous-open tie-break: when two links to the same peer
+        exist, BOTH sides keep the one initiated by the smaller
+        node_id (each side sees the same link from opposite
+        directions, so picking by initiator id is symmetric — naive
+        keep-first lets each side keep a different link and close them
+        both).  True = admit the new link."""
+        old = [p for p in self.peers
+               if p.connected and p.node_id == new_peer.node_id]
+        if not old:
+            return True
+        keep_ours = self.node_id < new_peer.node_id
+        new_wins = (new_peer.outbound == keep_ours)
+        if new_wins:
+            for p in old:
+                p.close()
+                if p in self.peers:
+                    self.peers.remove(p)
+        return new_wins
 
     async def _handshake(self, peer: Peer,
                          noise_id: Optional[bytes] = None) -> None:

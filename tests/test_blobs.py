@@ -171,19 +171,19 @@ def test_blob_sidecars_rpc_serving():
     rpc = rr.BeaconRpc(net, node)
     peer = types.SimpleNamespace()
 
-    from teku_tpu.native import snappyc
+    from teku_tpu.networking import encoding as E
     from teku_tpu.spec.deneb.datastructures import get_deneb_schemas
     schema = get_deneb_schemas(cfg).BlobSidecar
 
     async def run():
-        body = snappyc.compress(root + (1).to_bytes(8, "little"))
+        body = E.encode_payload(root + (1).to_bytes(8, "little"))
         resp = await net.on_request(peer, rr.BLOB_SIDECARS_BY_ROOT, body)
         chunks = rr._unpack_chunks(resp)
         assert len(chunks) == 1
         assert schema.deserialize(chunks[0]) == sidecars[1]
 
         import struct
-        body = snappyc.compress(struct.pack("<QQ", 0, 32))
+        body = E.encode_payload(struct.pack("<QQ", 0, 32))
         resp = await net.on_request(peer, rr.BLOB_SIDECARS_BY_RANGE, body)
         chunks = rr._unpack_chunks(resp)
         assert [schema.deserialize(c).index for c in chunks] == [0, 1]

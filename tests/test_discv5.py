@@ -1,0 +1,154 @@
+"""UDP discovery: signed records, routing table, PING/FINDNODE walk,
+and the dial feed into the TCP layer.
+
+reference: networking/p2p/.../discovery/discv5/DiscV5Service.java:57.
+"""
+
+import asyncio
+import secrets
+
+import pytest
+
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey)
+
+from teku_tpu.networking import discv5 as D
+
+FORK = b"\xaa\xbb\xcc\xdd"
+
+
+def _record(seq=1, fork=FORK, **kw):
+    identity = Ed25519PrivateKey.generate()
+    return identity, D.make_record(
+        identity, noise_pub=b"\x01" * 32, fork_digest=fork,
+        ip="127.0.0.1", udp_port=kw.get("udp_port", 9),
+        tcp_port=kw.get("tcp_port", 10), seq=seq)
+
+
+def test_record_roundtrip_and_tamper_rejected():
+    identity, record = _record()
+    raw = record.encode()
+    decoded = D.NodeRecord.decode(raw)
+    assert decoded == record
+    assert decoded.node_id == record.node_id
+    tampered = bytearray(raw)
+    tampered[76] ^= 1                 # flip a port bit
+    with pytest.raises(ValueError):
+        D.NodeRecord.decode(bytes(tampered))
+    # forged signature over modified content also fails
+    other, _ = _record()
+    forged = record.__dict__ | {"signature": other.sign(b"junk" * 16)}
+    with pytest.raises(ValueError):
+        D.NodeRecord(**forged).verify()
+
+
+def test_routing_table_seq_and_bucket_rules():
+    _, own = _record()
+    table = D.RoutingTable(own.node_id, k=2)
+    identity, rec = _record(seq=1)
+    assert table.add(rec)
+    assert not table.add(rec)                 # same seq: no-op
+    newer = D.make_record(identity, rec.noise_pub, rec.fork_digest,
+                          rec.ip, rec.udp_port, 99, seq=2)
+    assert table.add(newer)                   # seq bump updates
+    assert table._by_id[rec.node_id].tcp_port == 99
+    assert not table.add(own.__class__(**own.__dict__))  # self
+    # closest() orders by XOR distance
+    for _ in range(6):
+        table.add(_record()[1])
+    target = secrets.token_bytes(32)
+    ordered = table.closest(target)
+    dists = [D._distance(r.node_id, target) for r in ordered]
+    assert dists == sorted(dists)
+
+
+def test_three_node_walk_discovers_transitively():
+    """A knows only B; C is known only to B.  One lookup makes A learn
+    C via FINDNODE/NODES, and the dial feed fires."""
+    async def run():
+        found = []
+        a = D.UdpDiscoveryService(fork_digest=FORK, tcp_port=1001,
+                                  on_discovered=found.append)
+        b = D.UdpDiscoveryService(fork_digest=FORK, tcp_port=1002)
+        c = D.UdpDiscoveryService(fork_digest=FORK, tcp_port=1003)
+        await a.start()
+        await b.start()
+        await c.start()
+        try:
+            # seed: C pings B (B learns C); A pings B
+            assert await c.ping(("127.0.0.1", b.port)) is not None
+            assert await a.bootstrap([("127.0.0.1", b.port)]) == 1
+            await a.lookup(secrets.token_bytes(32))
+            ids = {r.node_id for r in a.table.records()}
+            assert b.record.node_id in ids
+            assert c.record.node_id in ids
+            # the dial feed carries the tcp endpoint + noise identity
+            assert any(r.tcp_port == 1003 for r in found)
+            # B reciprocally learned A from the FINDNODE it served
+            assert a.record.node_id in {r.node_id
+                                        for r in b.table.records()}
+        finally:
+            await a.stop()
+            await b.stop()
+            await c.stop()
+    asyncio.run(run())
+
+
+def test_wrong_fork_records_never_enter_the_table():
+    async def run():
+        a = D.UdpDiscoveryService(fork_digest=FORK)
+        b = D.UdpDiscoveryService(fork_digest=b"\x00\x00\x00\x00")
+        await a.start()
+        await b.start()
+        try:
+            assert await b.ping(("127.0.0.1", a.port)) is None
+            assert len(a.table) == 0
+            assert len(b.table) == 0
+        finally:
+            await a.stop()
+            await b.stop()
+    asyncio.run(run())
+
+
+def test_liveness_round_evicts_dead_nodes():
+    async def run():
+        a = D.UdpDiscoveryService(fork_digest=FORK)
+        b = D.UdpDiscoveryService(fork_digest=FORK)
+        await a.start()
+        await b.start()
+        assert await a.ping(("127.0.0.1", b.port)) is not None
+        assert len(a.table) == 1
+        await b.stop()                # b goes dark
+        await a._liveness_round()
+        assert len(a.table) == 0
+        await a.stop()
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_networked_nodes_find_each_other_over_udp():
+    """Two full nodes with only a UDP bootnode address end up
+    TCP-connected (noise + hello) without any explicit dial."""
+    from teku_tpu.networking import NetworkedNode
+    from teku_tpu.spec import create_spec
+    from teku_tpu.spec.genesis import interop_genesis
+
+    async def run():
+        spec = create_spec("minimal")
+        state, _ = interop_genesis(spec.config, 8)
+        a = NetworkedNode(spec, state, name="a", udp_discovery_port=0)
+        await a.start()
+        b = NetworkedNode(spec, state, name="b", udp_discovery_port=0,
+                          bootnodes=[f"127.0.0.1:{a.discv5.port}"])
+        await b.start()
+        try:
+            for _ in range(60):
+                if a.net.peers and b.net.peers:
+                    break
+                await asyncio.sleep(0.1)
+            assert a.net.peers and b.net.peers
+            assert a.net.peers[0].node_id == b.net.node_id
+        finally:
+            await b.stop()
+            await a.stop()
+    asyncio.run(run())

@@ -1,0 +1,136 @@
+"""Rewards REST endpoints: block reward decomposition, per-validator
+attestation rewards, sync-committee rewards.
+
+reference: data/beaconrestapi/.../handlers/v1/rewards/
+(GetBlockRewards, PostAttestationRewards, PostSyncCommitteeRewards)
+backed by RewardCalculator.java.
+"""
+
+import asyncio
+import dataclasses
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from teku_tpu.api import BeaconRestApi
+from teku_tpu.node import Devnet
+from teku_tpu.spec import config as C, Spec
+from teku_tpu.spec import helpers as H
+
+
+@pytest.mark.slow
+def test_rewards_endpoints_on_altair_devnet():
+    cfg = dataclasses.replace(C.MINIMAL, ALTAIR_FORK_EPOCH=0)
+    spec = Spec(cfg)
+    net = Devnet(n_nodes=1, n_validators=16, spec=spec)
+    node = net.nodes[0]
+
+    async def run():
+        await net.start()
+        api = BeaconRestApi(node)
+        await api.start()
+        try:
+            await net.run_until_slot(3 * cfg.SLOTS_PER_EPOCH + 2)
+            base = f"http://127.0.0.1:{api.port}"
+            loop = asyncio.get_running_loop()
+
+            def get(path):
+                with urllib.request.urlopen(base + path, timeout=10) as r:
+                    return json.loads(r.read())
+
+            def post(path, payload):
+                req = urllib.request.Request(
+                    base + path, data=json.dumps(payload).encode(),
+                    method="POST",
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return json.loads(r.read())
+
+            # -- block rewards: decomposition sums to the exact
+            #    proposer balance delta
+            head_root = node.chain.head_root
+            block = node.store.blocks[head_root]
+            parent_state = node.chain.get_state(block.parent_root)
+            post_state = node.chain.get_state(head_root)
+            from teku_tpu.spec.transition import process_slots
+            pre = parent_state
+            if pre.slot < block.slot:
+                pre = process_slots(cfg, pre, block.slot)
+            expected_total = (post_state.balances[block.proposer_index]
+                              - pre.balances[block.proposer_index])
+            out = await loop.run_in_executor(
+                None, get, "/eth/v1/beacon/rewards/blocks/head")
+            data = out["data"]
+            assert int(data["total"]) == expected_total
+            assert (int(data["attestations"])
+                    + int(data["sync_aggregate"])
+                    + int(data["proposer_slashings"])
+                    + int(data["attester_slashings"])) \
+                == int(data["total"])
+            assert int(data["proposer_index"]) == block.proposer_index
+
+            # -- sync committee rewards: every committee seat reported,
+            #    participants earn what absentees pay
+            sync = await loop.run_in_executor(
+                None, post, "/eth/v1/beacon/rewards/sync_committee/head",
+                [])
+            assert len(sync["data"]) == cfg.SYNC_COMMITTEE_SIZE
+            rewards = [int(r["reward"]) for r in sync["data"]]
+            bits = block.body.sync_aggregate.sync_committee_bits
+            assert sum(1 for r in rewards if r > 0) == sum(bits)
+            magnitudes = {abs(r) for r in rewards if r != 0}
+            assert len(magnitudes) <= 1      # one participant_reward
+
+            # filtered query returns only the asked validator
+            only0 = await loop.run_in_executor(
+                None, post, "/eth/v1/beacon/rewards/sync_committee/head",
+                ["0"])
+            assert all(r["validator_index"] == "0" for r in only0["data"])
+
+            # -- attestation rewards: only SETTLED epochs (inclusion
+            #    runs through epoch+1) — perfect devnet participation
+            #    → actual == ideal at each tier
+            # current-2 with current==3 → epoch 1 (epoch 0 is
+            # degenerate: the slot-0 committee never attests)
+            epoch = H.get_current_epoch(cfg, node.chain.head_state()) - 2
+            att = await loop.run_in_executor(
+                None, post,
+                f"/eth/v1/beacon/rewards/attestations/{epoch}",
+                ["0", "1"])
+            totals = att["data"]["total_rewards"]
+            assert [t["validator_index"] for t in totals] == ["0", "1"]
+            ideal = {int(row["effective_balance"]): row
+                     for row in att["data"]["ideal_rewards"]}
+            for t in totals:
+                vi = int(t["validator_index"])
+                eb = node.chain.head_state().validators[vi] \
+                    .effective_balance
+                row = ideal[eb]
+                for part in ("head", "target", "source"):
+                    assert int(t[part]) == int(row[part]) > 0
+                assert int(t["inactivity"]) == 0
+
+            # not-yet-settled epochs (current and current-1) are 400
+            current = H.get_current_epoch(cfg, node.chain.head_state())
+            for unsettled in (current, current - 1):
+                try:
+                    await loop.run_in_executor(
+                        None, post,
+                        f"/eth/v1/beacon/rewards/attestations/"
+                        f"{unsettled}", [])
+                    raise AssertionError("expected 400")
+                except urllib.error.HTTPError as exc:
+                    assert exc.code == 400
+            # pubkey-shaped ids are accepted per the API schema
+            pk = node.chain.head_state().validators[3].pubkey
+            by_pk = await loop.run_in_executor(
+                None, post, "/eth/v1/beacon/rewards/sync_committee/head",
+                ["0x" + pk.hex()])
+            assert all(r["validator_index"] == "3"
+                       for r in by_pk["data"])
+        finally:
+            await api.stop()
+            await net.stop()
+    asyncio.run(run())
