@@ -321,6 +321,65 @@ def cmd_slashing_protection(args) -> int:
     return 0
 
 
+def cmd_voluntary_exit(args) -> int:
+    """Sign and submit a voluntary exit through a beacon node's REST
+    API (reference cli/subcommand/VoluntaryExitCommand.java): the exit
+    epoch defaults to the chain's current epoch, the signature uses the
+    interop key for --validator-index, and the node's pool validation
+    is the acceptance gate."""
+    import json as _json
+    import time
+    import urllib.request
+    from .crypto import bls
+    from .spec import create_spec
+    from .spec import helpers as H
+    from .spec.config import DOMAIN_VOLUNTARY_EXIT
+    from .spec.datastructures import VoluntaryExit
+    from .spec.genesis import interop_secret_keys
+    from .spec.milestones import build_fork_schedule
+
+    spec = create_spec(args.network or "minimal")
+    base = args.beacon_node.rstrip("/")
+
+    def get(path):
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            return _json.loads(r.read())
+
+    genesis = get("/eth/v1/beacon/genesis")["data"]
+    genesis_time = int(genesis["genesis_time"])
+    gvr = bytes.fromhex(genesis["genesis_validators_root"][2:])
+    current_epoch = max(0, (int(time.time()) - genesis_time)
+                        // spec.config.SECONDS_PER_SLOT
+                        // spec.config.SLOTS_PER_EPOCH)
+    epoch = args.epoch if args.epoch is not None else current_epoch
+    msg = VoluntaryExit(epoch=epoch,
+                        validator_index=args.validator_index)
+    # domain from the fork live at the exit epoch (deneb+ pins exit
+    # domains to capella, handled by the schedule's fork_at_epoch)
+    prev, cur, _ = build_fork_schedule(spec.config).fork_at_epoch(epoch)
+    domain = H.compute_domain(DOMAIN_VOLUNTARY_EXIT, cur, gvr)
+    sks = interop_secret_keys(args.interop_total)
+    sk = sks[args.validator_index]
+    signature = bls.sign(sk, H.compute_signing_root(msg, domain))
+    body = _json.dumps({
+        "message": {"epoch": str(epoch),
+                    "validator_index": str(args.validator_index)},
+        "signature": "0x" + signature.hex()}).encode()
+    req = urllib.request.Request(
+        base + "/eth/v1/beacon/pool/voluntary_exits", data=body,
+        method="POST", headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=10):
+            pass
+    except urllib.error.HTTPError as exc:
+        print(f"exit rejected: HTTP {exc.code} "
+              f"{exc.read().decode(errors='replace')}", file=sys.stderr)
+        return 1
+    print(f"voluntary exit submitted: validator "
+          f"{args.validator_index} at epoch {epoch}")
+    return 0
+
+
 def cmd_validator_client(args) -> int:
     """VC-only process: duties over the REST API of a remote beacon
     node (reference `validator-client` subcommand /
@@ -454,6 +513,17 @@ def build_parser() -> argparse.ArgumentParser:
 
     vc = sub.add_parser("validator-client",
                         help="VC-only process against a remote node")
+    ve = sub.add_parser("voluntary-exit",
+                        help="sign and submit a voluntary exit")
+    ve.set_defaults(fn=cmd_voluntary_exit)
+    ve.add_argument("--network", default=None)
+    ve.add_argument("--beacon-node", default="http://127.0.0.1:5051")
+    ve.add_argument("--validator-index", type=int, required=True)
+    ve.add_argument("--epoch", type=int, default=None,
+                    help="exit epoch (default: current)")
+    ve.add_argument("--interop-total", type=int, default=64,
+                    help="interop keyset size the index signs from")
+
     vc.add_argument("--network", default=None)
     vc.add_argument("--beacon-node", default="http://127.0.0.1:5051",
                     help="REST base URL of the beacon node")

@@ -262,3 +262,48 @@ def test_duty_and_committee_endpoints():
         finally:
             await api.stop()
     asyncio.run(run())
+
+
+def test_voluntary_exit_subcommand():
+    """`voluntary-exit` signs with the interop key and lands in the
+    node's exit pool through the REST pool endpoint (reference
+    cli/subcommand/VoluntaryExitCommand.java)."""
+    import dataclasses
+    import types
+    from teku_tpu.api import BeaconRestApi
+    from teku_tpu.cli import cmd_voluntary_exit
+    from teku_tpu.node.gossip import InMemoryGossipNetwork
+    from teku_tpu.node.node import BeaconNode
+    from teku_tpu.spec import config as C, Spec
+    from teku_tpu.spec.genesis import interop_genesis
+    from teku_tpu.spec.transition import process_slots
+
+    # exits need SHARD_COMMITTEE_PERIOD epochs of service
+    cfg = dataclasses.replace(C.MINIMAL, SHARD_COMMITTEE_PERIOD=0)
+    spec = Spec(cfg)
+    state, sks = interop_genesis(cfg, 16)
+    state = process_slots(cfg, state, 1)
+
+    async def run():
+        node = BeaconNode(spec, state, InMemoryGossipNetwork().endpoint())
+        api = BeaconRestApi(node)
+        await api.start()
+        try:
+            loop = asyncio.get_running_loop()
+            args = types.SimpleNamespace(
+                network="minimal",
+                beacon_node=f"http://127.0.0.1:{api.port}",
+                validator_index=3, epoch=0, interop_total=16)
+            rc = await loop.run_in_executor(
+                None, cmd_voluntary_exit, args)
+            assert rc == 0
+            pool = node.operation_pools["voluntary_exits"]
+            ops = pool.get_for_block(16, node.chain.head_state())
+            assert any(op.message.validator_index == 3 for op in ops)
+            # resubmission is a duplicate → nonzero exit code
+            rc2 = await loop.run_in_executor(
+                None, cmd_voluntary_exit, args)
+            assert rc2 == 1
+        finally:
+            await api.stop()
+    asyncio.run(run())
