@@ -328,52 +328,65 @@ def cmd_voluntary_exit(args) -> int:
     interop key for --validator-index, and the node's pool validation
     is the acceptance gate."""
     import json as _json
-    import time
-    import urllib.request
+    import urllib.error
     from .crypto import bls
     from .spec import create_spec
     from .spec import helpers as H
     from .spec.config import DOMAIN_VOLUNTARY_EXIT
     from .spec.datastructures import VoluntaryExit
     from .spec.genesis import interop_secret_keys
-    from .spec.milestones import build_fork_schedule
+    from .spec.milestones import build_fork_schedule, SpecMilestone
+    from .validator import RemoteValidatorApi
 
+    if not 0 <= args.validator_index < args.interop_total:
+        print(f"error: --validator-index must be in "
+              f"[0, {args.interop_total})", file=sys.stderr)
+        return 2
     spec = create_spec(args.network or "minimal")
-    base = args.beacon_node.rstrip("/")
-
-    def get(path):
-        with urllib.request.urlopen(base + path, timeout=10) as r:
-            return _json.loads(r.read())
-
-    genesis = get("/eth/v1/beacon/genesis")["data"]
-    genesis_time = int(genesis["genesis_time"])
-    gvr = bytes.fromhex(genesis["genesis_validators_root"][2:])
-    current_epoch = max(0, (int(time.time()) - genesis_time)
-                        // spec.config.SECONDS_PER_SLOT
-                        // spec.config.SLOTS_PER_EPOCH)
-    epoch = args.epoch if args.epoch is not None else current_epoch
+    remote = RemoteValidatorApi(spec, args.beacon_node)
+    try:
+        genesis = remote._get_json("/eth/v1/beacon/genesis")["data"]
+        gvr = bytes.fromhex(genesis["genesis_validators_root"][2:])
+        if args.epoch is not None:
+            epoch = args.epoch
+        else:
+            # the NODE's head decides "current": the local clock plus
+            # a guessed preset can disagree with the node's config
+            head = remote._get_json(
+                "/eth/v1/beacon/headers/head")["data"]
+            epoch = (int(head["header"]["message"]["slot"])
+                     // spec.config.SLOTS_PER_EPOCH)
+    except (urllib.error.URLError, OSError) as exc:
+        print(f"error: beacon node unreachable: {exc}",
+              file=sys.stderr)
+        return 1
     msg = VoluntaryExit(epoch=epoch,
                         validator_index=args.validator_index)
-    # domain from the fork live at the exit epoch (deneb+ pins exit
-    # domains to capella, handled by the schedule's fork_at_epoch)
-    prev, cur, _ = build_fork_schedule(spec.config).fork_at_epoch(epoch)
-    domain = H.compute_domain(DOMAIN_VOLUNTARY_EXIT, cur, gvr)
-    sks = interop_secret_keys(args.interop_total)
-    sk = sks[args.validator_index]
+    schedule = build_fork_schedule(spec.config)
+    if schedule.milestone_at_epoch(epoch) >= SpecMilestone.DENEB:
+        # EIP-7044: deneb+ pins exit domains to the capella fork so
+        # exits stay valid forever (spec/deneb/block.py does the same
+        # on the verification side)
+        version = spec.config.CAPELLA_FORK_VERSION
+    else:
+        version = schedule.fork_at_epoch(epoch)[1]
+    domain = H.compute_domain(DOMAIN_VOLUNTARY_EXIT, version, gvr)
+    sk = interop_secret_keys(args.interop_total)[args.validator_index]
     signature = bls.sign(sk, H.compute_signing_root(msg, domain))
     body = _json.dumps({
         "message": {"epoch": str(epoch),
                     "validator_index": str(args.validator_index)},
         "signature": "0x" + signature.hex()}).encode()
-    req = urllib.request.Request(
-        base + "/eth/v1/beacon/pool/voluntary_exits", data=body,
-        method="POST", headers={"Content-Type": "application/json"})
     try:
-        with urllib.request.urlopen(req, timeout=10):
-            pass
+        remote._post("/eth/v1/beacon/pool/voluntary_exits", body,
+                     ctype="application/json")
     except urllib.error.HTTPError as exc:
         print(f"exit rejected: HTTP {exc.code} "
               f"{exc.read().decode(errors='replace')}", file=sys.stderr)
+        return 1
+    except (urllib.error.URLError, OSError) as exc:
+        print(f"error: beacon node unreachable: {exc}",
+              file=sys.stderr)
         return 1
     print(f"voluntary exit submitted: validator "
           f"{args.validator_index} at epoch {epoch}")

@@ -307,3 +307,19 @@ def test_voluntary_exit_subcommand():
         finally:
             await api.stop()
     asyncio.run(run())
+
+
+def test_voluntary_exit_subcommand_error_paths():
+    import types
+    from teku_tpu.cli import cmd_voluntary_exit
+    # index out of the interop keyset → usage error, no traceback
+    args = types.SimpleNamespace(network="minimal",
+                                 beacon_node="http://127.0.0.1:1",
+                                 validator_index=100, epoch=0,
+                                 interop_total=16)
+    assert cmd_voluntary_exit(args) == 2
+    args.validator_index = -1
+    assert cmd_voluntary_exit(args) == 2
+    # unreachable node → clean exit code, no traceback
+    args.validator_index = 3
+    assert cmd_voluntary_exit(args) == 1
