@@ -66,6 +66,14 @@ class BeaconRestApi(RestApi):
           self._attestation_rewards)
         p("/eth/v1/beacon/rewards/sync_committee/{block_id}",
           self._sync_committee_rewards)
+        p("/eth/v1/validator/beacon_committee_subscriptions",
+          self._committee_subscriptions)
+        p("/eth/v1/validator/sync_committee_subscriptions",
+          self._sync_subscriptions)
+        p("/eth/v1/validator/prepare_beacon_proposer",
+          self._prepare_proposer)
+        p("/eth/v1/validator/register_validator",
+          self._register_validator)
         p("/eth/v1/beacon/pool/attestations", self._submit_attestations)
         p("/eth/v1/beacon/pool/voluntary_exits", self._submit_exit)
         p("/eth/v1/beacon/pool/sync_committees", self._submit_sync_messages)
@@ -158,9 +166,16 @@ class BeaconRestApi(RestApi):
     async def _identity(self):
         node_id = (self.networked.net.node_id.hex()
                    if self.networked else "00" * 32)
+        attnets = bytearray(8)
+        manager = getattr(self.networked, "subnets", None) \
+            if self.networked else None
+        if manager is not None:
+            for subnet in manager.active_subnets():
+                attnets[subnet // 8] |= 1 << (subnet % 8)
         return {"data": {"peer_id": node_id, "enr": "",
                          "p2p_addresses": [], "metadata": {
-                             "seq_number": "0", "attnets": "0x" + "00" * 8}}}
+                             "seq_number": "0",
+                             "attnets": "0x" + bytes(attnets).hex()}}}
 
     async def _syncing(self):
         syncing = bool(self.networked and self.networked.sync.syncing)
@@ -690,6 +705,115 @@ class BeaconRestApi(RestApi):
                     {"validator_index": str(i), "reward": str(d)}
                     for i, d in deltas
                     if wanted is None or i in wanted]}
+
+    async def _committee_subscriptions(self, body=None):
+        """reference handlers/v1/validator/PostSubscribeToBeaconCommittee
+        Subnet.java: duty-driven subnet subscriptions from the VC.
+        This node carries every attestation subnet (devnet-correct);
+        the manager tracks the duty windows for expiry and for the
+        attnets advertised by /eth/v1/node/identity.  Validation runs
+        over the WHOLE body before any state changes."""
+        from ..node.node import compute_subnet_for_attestation
+        cfg = self.node.spec.config
+        manager = getattr(self.networked, "subnets", None) \
+            if self.networked else None
+        parsed = []
+        for sub in (body or []):
+            try:
+                parsed.append((int(sub["slot"]),
+                               int(sub["committee_index"]),
+                               int(sub["committees_at_slot"])))
+            except (KeyError, ValueError, TypeError):
+                raise HttpError(400, "malformed subscription")
+        for slot, committee_index, committees in parsed:
+            if manager is not None:
+                subnet = compute_subnet_for_attestation(
+                    cfg, committees, slot, committee_index)
+                manager.subscribe_for_duty(subnet, slot + 1)
+        return {"data": {"accepted": str(len(parsed))}}
+
+    async def _sync_subscriptions(self, body=None):
+        """reference PostSyncCommitteeSubscriptions — sync-committee
+        topics are node-global in this stack, so acceptance is the
+        whole contract."""
+        for sub in (body or []):
+            if "validator_index" not in sub:
+                raise HttpError(400, "malformed subscription")
+        return {}
+
+    async def _prepare_proposer(self, body=None):
+        """reference PostPrepareBeaconProposer: fee recipients per
+        proposer, consumed at payload-attribute build time."""
+        parsed = []
+        for item in (body or []):
+            try:
+                index = int(item["validator_index"])
+                recipient = bytes.fromhex(
+                    item["fee_recipient"].removeprefix("0x"))
+                if len(recipient) != 20:
+                    raise ValueError("fee recipient must be 20 bytes")
+            except (KeyError, ValueError, TypeError, AttributeError):
+                raise HttpError(400, "malformed preparation")
+            parsed.append((index, recipient))
+        # all-or-nothing: nothing commits if any item was malformed
+        prepared = getattr(self.node, "proposer_preparations", None)
+        if prepared is None:
+            prepared = {}
+            self.node.proposer_preparations = prepared
+        prepared.update(parsed)
+        return {}
+
+    async def _register_validator(self, body=None):
+        """reference PostRegisterValidator: signed builder
+        registrations, verified and forwarded to the builder when one
+        is wired (otherwise retained for when it is)."""
+        from ..builderapi import (SignedValidatorRegistration,
+                                  ValidatorRegistration,
+                                  verify_registration)
+        cfg = self.node.spec.config
+        registrations = []
+        for item in (body or []):
+            try:
+                msg = item["message"]
+                signed = SignedValidatorRegistration(
+                    message=ValidatorRegistration(
+                        fee_recipient=bytes.fromhex(
+                            msg["fee_recipient"].removeprefix("0x")),
+                        gas_limit=int(msg["gas_limit"]),
+                        timestamp=int(msg["timestamp"]),
+                        pubkey=bytes.fromhex(
+                            msg["pubkey"].removeprefix("0x"))),
+                    signature=bytes.fromhex(
+                        item["signature"].removeprefix("0x")))
+            except (KeyError, ValueError, TypeError,
+                    AttributeError) as exc:
+                raise HttpError(400, f"malformed registration: {exc}")
+            registrations.append(signed)
+        # signature checks off the event loop (a VC registers its
+        # whole keyset at once; pairings would stall every endpoint)
+        import asyncio
+
+        def _verify_all():
+            for signed in registrations:
+                try:
+                    if not verify_registration(cfg, signed):
+                        return False
+                except Exception:
+                    return False       # SSZ length/range errors = 400
+            return True
+        if registrations and not await asyncio.get_running_loop() \
+                .run_in_executor(None, _verify_all):
+            raise HttpError(400, "bad registration signature")
+        store = getattr(self.node, "validator_registrations", None)
+        if store is None:
+            store = {}
+            self.node.validator_registrations = store
+        for signed in registrations:
+            store[signed.message.pubkey] = signed
+        builder = getattr(self.node, "builder", None)
+        if builder is not None and registrations:
+            await builder.register_validators(registrations)
+        return {}
 
     def _decode_versioned(self, attr: str, raw: bytes):
         """Decode raw SSZ against each scheduled milestone's schema,

@@ -37,6 +37,18 @@ class NetworkedNode:
                                name=name, store=store)
         self.rpc = BeaconRpc(self.net, self.node)
         self.sync = SyncService(self.net, self.rpc, self.node)
+        from .subnets import AttestationSubnetManager
+        self.subnets = AttestationSubnetManager(spec.config,
+                                                self.net.node_id)
+        # expire duty-driven subnet windows with the chain clock (the
+        # manager's active set also feeds /eth/v1/node/identity attnets)
+        from ..infra.events import SlotEventsChannel
+        subnets = self.subnets
+
+        class _SubnetTicker:
+            def on_slot(self, slot):
+                subnets.on_slot(slot)
+        self.node.channels.subscribe(SlotEventsChannel, _SubnetTicker())
 
         async def _on_connect(peer):
             # gossipsub sends the full subscription set on connect so

@@ -323,3 +323,93 @@ def test_voluntary_exit_subcommand_error_paths():
     # unreachable node → clean exit code, no traceback
     args.validator_index = 3
     assert cmd_voluntary_exit(args) == 1
+
+
+def test_validator_subscription_and_registration_endpoints():
+    """The remaining VC-facing POST endpoints: committee/sync
+    subscriptions, proposer preparation, builder registrations
+    (reference handlers/v1/validator/Post*)."""
+    import time
+    from teku_tpu import builderapi as B
+    from teku_tpu.api import BeaconRestApi
+    from teku_tpu.crypto import bls
+    from teku_tpu.networking import NetworkedNode
+    from teku_tpu.spec import config as C, Spec
+    from teku_tpu.spec.genesis import interop_genesis
+
+    cfg = C.MINIMAL
+    spec = Spec(cfg)
+    state, sks = interop_genesis(cfg, 8)
+
+    async def run():
+        nn = NetworkedNode(spec, state, name="subtest")
+        await nn.start()
+        api = BeaconRestApi(nn.node, nn)
+        await api.start()
+        try:
+            base = f"http://127.0.0.1:{api.port}"
+            loop = asyncio.get_running_loop()
+
+            def post(path, payload):
+                req = urllib.request.Request(
+                    base + path, data=json.dumps(payload).encode(),
+                    method="POST",
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=5) as r:
+                    return json.loads(r.read() or b"{}")
+
+            out = await loop.run_in_executor(
+                None, post,
+                "/eth/v1/validator/beacon_committee_subscriptions",
+                [{"validator_index": "1", "committee_index": "0",
+                  "committees_at_slot": "1", "slot": "5",
+                  "is_aggregator": True}])
+            assert out["data"]["accepted"] == "1"
+            assert nn.subnets._until              # duty recorded
+
+            await loop.run_in_executor(
+                None, post,
+                "/eth/v1/validator/sync_committee_subscriptions",
+                [{"validator_index": "1",
+                  "sync_committee_indices": ["0"],
+                  "until_epoch": "2"}])
+
+            await loop.run_in_executor(
+                None, post, "/eth/v1/validator/prepare_beacon_proposer",
+                [{"validator_index": "2",
+                  "fee_recipient": "0x" + "ab" * 20}])
+            assert nn.node.proposer_preparations[2] == b"\xab" * 20
+
+            # a SIGNED registration round-trips verification
+            sk = 4242
+            reg = B.ValidatorRegistration(
+                fee_recipient=b"\x11" * 20, gas_limit=30_000_000,
+                timestamp=int(time.time()),
+                pubkey=bls.secret_to_public_key(sk))
+            signed = B.sign_registration(cfg, sk, reg)
+            await loop.run_in_executor(
+                None, post, "/eth/v1/validator/register_validator",
+                [{"message": {
+                    "fee_recipient": "0x" + reg.fee_recipient.hex(),
+                    "gas_limit": str(reg.gas_limit),
+                    "timestamp": str(reg.timestamp),
+                    "pubkey": "0x" + bytes(reg.pubkey).hex()},
+                  "signature": "0x" + signed.signature.hex()}])
+            assert bytes(reg.pubkey) in nn.node.validator_registrations
+            # a forged signature is a 400
+            try:
+                await loop.run_in_executor(
+                    None, post, "/eth/v1/validator/register_validator",
+                    [{"message": {
+                        "fee_recipient": "0x" + reg.fee_recipient.hex(),
+                        "gas_limit": str(reg.gas_limit),
+                        "timestamp": str(reg.timestamp),
+                        "pubkey": "0x" + bytes(reg.pubkey).hex()},
+                      "signature": "0x" + ("11" * 96)}])
+                raise AssertionError("expected 400")
+            except urllib.error.HTTPError as exc:
+                assert exc.code == 400
+        finally:
+            await api.stop()
+            await nn.stop()
+    asyncio.run(run())
