@@ -713,6 +713,8 @@ class BeaconRestApi(RestApi):
         the manager tracks the duty windows for expiry and for the
         attnets advertised by /eth/v1/node/identity.  Validation runs
         over the WHOLE body before any state changes."""
+        if body is not None and not isinstance(body, list):
+            raise HttpError(400, "body must be a list")
         from ..node.node import compute_subnet_for_attestation
         cfg = self.node.spec.config
         manager = getattr(self.networked, "subnets", None) \
@@ -736,14 +738,19 @@ class BeaconRestApi(RestApi):
         """reference PostSyncCommitteeSubscriptions — sync-committee
         topics are node-global in this stack, so acceptance is the
         whole contract."""
+        if body is not None and not isinstance(body, list):
+            raise HttpError(400, "body must be a list")
         for sub in (body or []):
-            if "validator_index" not in sub:
+            if not isinstance(sub, dict) or "validator_index" not in sub:
                 raise HttpError(400, "malformed subscription")
         return {}
 
     async def _prepare_proposer(self, body=None):
         """reference PostPrepareBeaconProposer: fee recipients per
-        proposer, consumed at payload-attribute build time."""
+        proposer, consumed by block production (the devnet payload
+        builder stamps them into execution_payload.fee_recipient)."""
+        if body is not None and not isinstance(body, list):
+            raise HttpError(400, "body must be a list")
         parsed = []
         for item in (body or []):
             try:
@@ -770,6 +777,8 @@ class BeaconRestApi(RestApi):
         from ..builderapi import (SignedValidatorRegistration,
                                   ValidatorRegistration,
                                   verify_registration)
+        if body is not None and not isinstance(body, list):
+            raise HttpError(400, "body must be a list")
         cfg = self.node.spec.config
         registrations = []
         for item in (body or []):
@@ -810,6 +819,9 @@ class BeaconRestApi(RestApi):
             self.node.validator_registrations = store
         for signed in registrations:
             store[signed.message.pubkey] = signed
+        # forwarded when a builder relay is wired on the node (the
+        # builder flow consumes the same SignedValidatorRegistration
+        # shape); otherwise retained for the flow to pick up
         builder = getattr(self.node, "builder", None)
         if builder is not None and registrations:
             await builder.register_validators(registrations)

@@ -41,14 +41,11 @@ class NetworkedNode:
         self.subnets = AttestationSubnetManager(spec.config,
                                                 self.net.node_id)
         # expire duty-driven subnet windows with the chain clock (the
-        # manager's active set also feeds /eth/v1/node/identity attnets)
+        # manager's active set also feeds /eth/v1/node/identity
+        # attnets); the manager itself satisfies the channel's on_slot
+        # shape
         from ..infra.events import SlotEventsChannel
-        subnets = self.subnets
-
-        class _SubnetTicker:
-            def on_slot(self, slot):
-                subnets.on_slot(slot)
-        self.node.channels.subscribe(SlotEventsChannel, _SubnetTicker())
+        self.node.channels.subscribe(SlotEventsChannel, self.subnets)
 
         async def _on_connect(peer):
             # gossipsub sends the full subscription set on connect so

@@ -97,6 +97,7 @@ def build_unsigned_block(cfg: SpecConfig, pre, slot: int,
                          attester_slashings: Sequence = (),
                          voluntary_exits: Sequence = (),
                          graffiti: bytes = bytes(32),
+                         fee_recipient: Optional[bytes] = None,
                          proposer_index: Optional[int] = None,
                          sync_aggregate=None,
                          eth1_vote=None,
@@ -142,8 +143,8 @@ def build_unsigned_block(cfg: SpecConfig, pre, slot: int,
             # capella+: payload checks run unconditionally, so build a
             # minimal payload that chains on the stored header, matches
             # randao/timestamp, and carries the expected withdrawals
-            body_kwargs["execution_payload"] = _devnet_payload(cfg, pre,
-                                                               slot, S)
+            body_kwargs["execution_payload"] = _devnet_payload(
+                cfg, pre, slot, S, fee_recipient=fee_recipient)
         else:
             # bellatrix default (empty) payload = merge not yet
             # transitioned: the processor skips execution checks
@@ -191,7 +192,7 @@ def produce_block(cfg: SpecConfig, state, slot: int, signer: Signer,
     return signed, post
 
 
-def _devnet_payload(cfg: SpecConfig, pre, slot: int, S):
+def _devnet_payload(cfg: SpecConfig, pre, slot: int, S, fee_recipient=None):
     """A self-consistent execution payload with no real EL attached:
     block hashes chain deterministically off the previous payload header
     (the reference's stubbed EL plays the same role,
@@ -211,6 +212,8 @@ def _devnet_payload(cfg: SpecConfig, pre, slot: int, S):
                           + slot.to_bytes(8, "little"))
     kw = dict(
         parent_hash=parent_hash,
+        fee_recipient=(fee_recipient if fee_recipient is not None
+                       else bytes(20)),
         prev_randao=H.get_randao_mix(cfg, pre,
                                      H.get_current_epoch(cfg, pre)),
         block_number=header.block_number + 1,

@@ -241,9 +241,14 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
                         k: v for k, v in
                         self._pending_blob_bundles.items()
                         if v[0] >= slot - 2}   # keep only fresh ones
+        # prepare_beacon_proposer fee recipients land in the payload
+        proposer = H.get_beacon_proposer_index(cfg, pre)
+        fee_recipient = getattr(self.node, "proposer_preparations",
+                                {}).get(proposer)
         block, _post = build_unsigned_block(
             cfg, pre, slot, randao_reveal, attestations=atts,
             deposits=deposits, eth1_vote=eth1_vote,
+            proposer_index=proposer, fee_recipient=fee_recipient,
             blob_kzg_commitments=commitments,
             proposer_slashings=pools["proposer_slashings"].get_for_block(
                 cfg.MAX_PROPOSER_SLASHINGS, pre),
