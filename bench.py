@@ -152,14 +152,15 @@ def _probe_backend(timeout_s: float, code: str = _PROBE_CODE):
     The probe owns the hang risk: if the axon tunnel is wedged the child
     is killed at timeout_s and this process never touches the TPU
     runtime — round 3 lost 3 x ~25 min to in-process probes that could
-    not be interrupted.  Returns (platform, device_str) or (None, why)."""
+    not be interrupted.  Returns (platform, device_str, stderr_tail);
+    platform is None on failure."""
     try:
         proc = subprocess.Popen(
             [sys.executable, "-c", code],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             start_new_session=True, text=True)
     except OSError as exc:
-        return None, f"probe spawn failed: {exc}"
+        return None, f"probe spawn failed: {exc}", ""
     try:
         out, err = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
@@ -167,40 +168,68 @@ def _probe_backend(timeout_s: float, code: str = _PROBE_CODE):
             os.killpg(proc.pid, signal.SIGKILL)
         except OSError:
             proc.kill()
-        proc.wait()
-        return None, f"probe timeout after {timeout_s:.0f}s"
+        out, err = proc.communicate()
+        return (None, f"probe timeout after {timeout_s:.0f}s",
+                (err or "")[-800:])
     if proc.returncode != 0:
         tail = (err or "").strip().splitlines()[-1:] or ["no stderr"]
-        return None, f"probe rc={proc.returncode}: {tail[0][:200]}"
+        return (None, f"probe rc={proc.returncode}: {tail[0][:200]}",
+                (err or "")[-800:])
     try:
         info = json.loads(out.strip().splitlines()[-1])
-        return info["platform"], info["device"]
+        return info["platform"], info["device"], (err or "")[-400:]
     except (ValueError, KeyError, IndexError):
-        return None, f"probe emitted garbage: {out[:120]!r}"
+        return None, f"probe emitted garbage: {out[:120]!r}", (
+            err or "")[-800:]
 
 
-def _init_device():
+def _probe_with_retries(deadline):
+    """Round 4 gave up after ONE 60s probe and benchmarked the CPU for
+    25 minutes; a tunnel that needs a longer first handshake (or one
+    retry) deserves more than one chance.  Retry with backoff, always
+    budget-aware, and leave each attempt's stderr in the heartbeat so
+    the NEXT failure is diagnosable."""
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
+    attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
+    last_detail = "no probe attempts made"
+    for i in range(attempts):
+        remaining = deadline - time.time()
+        if remaining < 90:
+            last_detail += " (probe budget exhausted)"
+            break
+        t0 = time.time()
+        timeout_s = min(probe_timeout, max(remaining - 60, 60))
+        _beat("probe_start", attempt=i + 1, timeout_s=timeout_s)
+        platform, detail, err_tail = _probe_backend(timeout_s)
+        OUT["probe_s"] = OUT.get("probe_s", 0) + round(time.time() - t0, 1)
+        if platform is not None:
+            _beat("probe_ok", attempt=i + 1, platform=platform,
+                  device=detail)
+            return platform, detail
+        last_detail = detail
+        _beat("probe_failed", attempt=i + 1, why=detail,
+              child_stderr=err_tail)
+        if i + 1 < attempts:
+            time.sleep(min(10 * (i + 1), deadline - time.time() - 60, 30)
+                       if deadline - time.time() > 120 else 0)
+    return None, last_detail
+
+
+def _init_device(deadline):
     """Bring up a JAX backend without ever letting a wedged TPU tunnel
-    eat the budget: subprocess probe with a hard deadline first, CPU
-    fallback immediately on probe failure, watchdog on the in-process
+    eat the budget: subprocess probes with hard deadlines first (with
+    retries), CPU fallback on exhaustion, watchdog on the in-process
     init that follows a successful probe."""
-    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "60"))
-    t0 = time.time()
-    _beat("probe_start", timeout_s=probe_timeout)
-    platform, detail = _probe_backend(probe_timeout)
-    OUT["probe_s"] = round(time.time() - t0, 1)
+    platform, detail = _probe_with_retries(deadline)
     if platform is None:
         # fast-fail to CPU: the env var must be set BEFORE jax imports
         os.environ["JAX_PLATFORMS"] = "cpu"
         OUT["fallback"] = f"tpu init failed: {detail}"
-        _beat("probe_failed", why=detail)
-    else:
-        _beat("probe_ok", platform=platform, device=detail)
 
     # the probe proved (or disproved) the backend in a disposable
     # process; the in-process init after a good probe should be quick,
     # but the tunnel can still wedge between the two — watchdog it
-    WD.arm(max(probe_timeout * 2, 120), "in-process backend init")
+    WD.arm(240, "in-process backend init")
     import jax
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -220,20 +249,22 @@ def _init_device():
     return jax
 
 
-def _throughput_phase(jax, deadline, batches):
+def _throughput_phase(jax, deadline, batches, detail):
     """Batches are tried IN ORDER and each fresh compile is gated on
     the remaining budget: TPU-XLA compiles of the full kernel run tens
     of minutes cold (hash-to-G2 alone is ~8 min), so one measured
     number at the primary shape beats four JSON-less timeouts.  The
-    persistent compile cache makes warm reruns cheap."""
+    persistent compile cache makes warm reruns cheap.  `detail` is the
+    shared accumulator across calls (main() runs this phase twice:
+    primary shape first, the rest only after p50/epoch landed)."""
     import __graft_entry__ as ge
     from teku_tpu.ops import verify as V
 
     kernel = V.verify_staged     # five bounded compiles, not one monolith
-    detail = {}
-    best = 0.0
-    best_batch = None
-    compiled_once = False
+    best = float(OUT.get("value") or 0.0)
+    best_batch = OUT.get("best_batch")
+    compiled_once = any(isinstance(v, dict) and "compile_s" in v
+                        for v in detail.values())
     for n in batches:
         remaining = deadline - time.time()
         # a cold compile needs a wide margin; after one shape compiled
@@ -389,6 +420,70 @@ def _epoch_transition_phase(deadline):
         _beat("epoch_phase_done", ms=round(best, 1))
 
 
+def _kzg_phase(deadline):
+    """Blob-verification throughput (deneb DA check): batch of 6 blobs
+    (mainnet MAX_BLOBS_PER_BLOCK) verified per dispatch, REAL ceremony
+    setup (the vendored public KZG ceremony artifact), device path when
+    available (reference surface: CKZG4844.java:104-122
+    verifyBlobKzgProofBatch)."""
+    import secrets as _secrets
+
+    from teku_tpu.crypto import kzg
+    from teku_tpu.ops.kzg import JaxKzg
+
+    kzg.set_backend(JaxKzg())
+    setup = kzg.get_setup()   # the real 4096-point ceremony file
+    n_blobs = int(os.environ.get("BENCH_KZG_BLOBS", "6"))
+    _beat("kzg_phase_start", blobs=n_blobs)
+    rng = np.random.default_rng(11)
+    blobs = []
+    for _ in range(n_blobs):
+        fes = [int.from_bytes(_secrets.token_bytes(31), "big")
+               for _ in range(kzg.FIELD_ELEMENTS_PER_BLOB)]
+        blobs.append(b"".join(v.to_bytes(32, "big") for v in fes))
+    t0 = time.time()
+    commitments = []
+    proofs = []
+    for b in blobs:
+        # every commitment/proof is one 4096-lane device MSM — gate
+        # each on the remaining budget so this phase can't overshoot
+        if time.time() > deadline - 60 and commitments:
+            break
+        commitments.append(kzg.blob_to_kzg_commitment(b, setup))
+        proofs.append(kzg.compute_blob_kzg_proof(b, commitments[-1],
+                                                 setup))
+    blobs = blobs[:len(proofs)]
+    commitments = commitments[:len(proofs)]
+    if not blobs:
+        return
+    n_blobs = len(blobs)
+    # commit + proof are one MSM each: the recorded figure is the
+    # prover-side cost per blob (both MSMs)
+    OUT["kzg_commit_proof_s_per_blob"] = round(
+        (time.time() - t0) / n_blobs, 2)
+    _beat("kzg_proofs_ready", blobs=n_blobs)
+    # warm (compiles the verification kernel when the device backend is
+    # installed), then measure
+    t_warm = time.time()
+    assert kzg.verify_blob_kzg_proof_batch(blobs, commitments, proofs,
+                                           setup)
+    warm_s = time.time() - t_warm
+    iters = 0
+    t0 = time.time()
+    while iters < 5 and time.time() < deadline:
+        assert kzg.verify_blob_kzg_proof_batch(blobs, commitments,
+                                               proofs, setup)
+        iters += 1
+    if iters:
+        dt = (time.time() - t0) / iters
+    else:
+        dt = warm_s          # budget-starved: the warm dispatch (incl.
+        OUT["kzg_warm_only"] = True   # compile) is still evidence
+    OUT["kzg_blobs_per_sec"] = round(n_blobs / dt, 2)
+    OUT["kzg_backend"] = kzg.backend_name()
+    _beat("kzg_phase_done", blobs_per_sec=OUT["kzg_blobs_per_sec"])
+
+
 def main():
     t_start = time.time()
     budget_s = float(os.environ.get("BENCH_BUDGET_S", "1500"))
@@ -399,17 +494,25 @@ def main():
     except OSError:
         pass
     _beat("bench_start", budget_s=budget_s)
-    # 256 first: it doubles as the latency phase's service bucket
+    # 256 first: it doubles as the latency phase's service bucket.
+    # 512 is BASELINE.md measurement config 2's missing size (r4 never
+    # measured it); 1/64/512/4096 are the advertised batch points.
     batches = [int(b) for b in
-               os.environ.get("BENCH_BATCHES", "256,4096,64,1").split(",")]
+               os.environ.get("BENCH_BATCHES",
+                              "256,512,64,4096,1").split(",")]
     try:
-        jax = _init_device()
+        jax = _init_device(deadline)
     except Exception as exc:
         OUT["error"] = f"device init: {type(exc).__name__}: {exc}"
         _emit()
         return
+    # Phase order is budget-priority order (round 4 burned the whole
+    # budget on big-batch compiles and starved p50/epoch): primary
+    # shape -> p50 latency (reuses the warm 256 bucket) -> epoch
+    # transition (host-side, cheap) -> the remaining batch shapes.
+    detail: dict = {}
     try:
-        _throughput_phase(jax, deadline, batches)
+        _throughput_phase(jax, deadline, batches[:1], detail)
     except Exception as exc:
         OUT["error"] = f"throughput: {type(exc).__name__}: {exc}"
         OUT["trace"] = traceback.format_exc(limit=3)
@@ -428,6 +531,18 @@ def main():
             WD.disarm()
         except Exception as exc:
             OUT["epoch_error"] = f"{type(exc).__name__}: {exc}"
+    try:
+        _throughput_phase(jax, deadline, batches[1:], detail)
+    except Exception as exc:
+        OUT["error"] = f"throughput2: {type(exc).__name__}: {exc}"
+        OUT["trace"] = traceback.format_exc(limit=3)
+    if os.environ.get("BENCH_KZG", "1") != "0" and time.time() < deadline:
+        try:
+            WD.arm(max(deadline - time.time(), 60) + 300, "kzg phase")
+            _kzg_phase(deadline)
+            WD.disarm()
+        except Exception as exc:
+            OUT["kzg_error"] = f"{type(exc).__name__}: {exc}"
     OUT["total_s"] = round(time.time() - t_start, 1)
     _beat("bench_done", total_s=OUT["total_s"])
     _emit()

@@ -25,6 +25,11 @@ def _hex(b: bytes) -> str:
     return "0x" + b.hex()
 
 
+# schema-driven SSZ<->JSON (shared with the Web3Signer client)
+from ..ssz.json import ssz_from_json as _ssz_from_json  # noqa: E402
+from ..ssz.json import ssz_to_json as _ssz_to_json  # noqa: E402
+
+
 class BeaconRestApi(RestApi):
     """Routes bound to one BeaconNode (and optionally its p2p net)."""
 
@@ -77,6 +82,32 @@ class BeaconRestApi(RestApi):
         p("/eth/v1/beacon/pool/attestations", self._submit_attestations)
         p("/eth/v1/beacon/pool/voluntary_exits", self._submit_exit)
         p("/eth/v1/beacon/pool/sync_committees", self._submit_sync_messages)
+        # op-pool family (reference data/beaconrestapi handlers/v1/
+        # beacon: Get/PostAttesterSlashings, Get/PostProposerSlashings,
+        # Get/PostBlsToExecutionChanges)
+        g("/eth/v1/beacon/pool/voluntary_exits", self._get_pool_exits)
+        g("/eth/v1/beacon/pool/attester_slashings",
+          self._get_attester_slashings)
+        p("/eth/v1/beacon/pool/attester_slashings",
+          self._post_attester_slashing)
+        g("/eth/v1/beacon/pool/proposer_slashings",
+          self._get_proposer_slashings)
+        p("/eth/v1/beacon/pool/proposer_slashings",
+          self._post_proposer_slashing)
+        g("/eth/v1/beacon/pool/bls_to_execution_changes",
+          self._get_bls_changes)
+        p("/eth/v1/beacon/pool/bls_to_execution_changes",
+          self._post_bls_changes)
+        g("/eth/v1/beacon/states/{state_id}/validator_balances",
+          self._validator_balances)
+        p("/eth/v1/beacon/states/{state_id}/validator_balances",
+          self._validator_balances_post)
+        g("/eth/v1/beacon/blocks/{block_id}/root", self._block_root)
+        g("/eth/v1/beacon/blocks/{block_id}/attestations",
+          self._block_attestations)
+        g("/eth/v1/node/peer_count", self._peer_count)
+        g("/eth/v1/beacon/states/{state_id}/expected_withdrawals",
+          self._expected_withdrawals)
         g("/eth/v1/beacon/blob_sidecars/{block_id}", self._blob_sidecars)
         # the remote-VC surface (reference: handlers/v1/validator/* and
         # the debug state endpoint checkpoint sync reads)
@@ -930,6 +961,150 @@ class BeaconRestApi(RestApi):
         await self.node.gossip.publish(
             VOLUNTARY_EXIT_TOPIC, SVE.serialize(exit_op))
         return {}
+
+    # -- op-pool family (generic SSZ<->JSON via the schema walk) -------
+    def _pool_json(self, pool_name: str):
+        return {"data": [
+            _ssz_to_json(type(op), op)
+            for op in self.node.operation_pools[pool_name].get_for_block(
+                10 ** 9)]}
+
+    async def _get_pool_exits(self):
+        return self._pool_json("voluntary_exits")
+
+    async def _get_attester_slashings(self):
+        return self._pool_json("attester_slashings")
+
+    async def _get_proposer_slashings(self):
+        return self._pool_json("proposer_slashings")
+
+    async def _get_bls_changes(self):
+        return self._pool_json("bls_to_execution_changes")
+
+    async def _submit_op(self, pool_name: str, schema, topic, body):
+        """Shared POST path: parse via the schema walk, validate by
+        pool entry (the apply rule), gossip on accept (reference
+        statetransition/OperationPool.java add + publish)."""
+        try:
+            op = _ssz_from_json(schema, body)
+        except (KeyError, ValueError, TypeError, AttributeError) as exc:
+            raise HttpError(400, f"malformed {pool_name[:-1]}: {exc}")
+        pool = self.node.operation_pools[pool_name]
+        if not pool.add(self.node.chain.head_state(), op):
+            raise HttpError(400,
+                            f"{pool_name[:-1]} invalid or duplicate")
+        await self.node.gossip.publish(topic, type(op).serialize(op))
+        return {}
+
+    async def _post_attester_slashing(self, body=None):
+        from ..node.gossip import ATTESTER_SLASHING_TOPIC
+        S = self.node.spec.at_slot(self.node.chain.head_slot()).schemas
+        return await self._submit_op(
+            "attester_slashings", S.AttesterSlashing,
+            ATTESTER_SLASHING_TOPIC, body)
+
+    async def _post_proposer_slashing(self, body=None):
+        from ..node.gossip import PROPOSER_SLASHING_TOPIC
+        S = self.node.spec.at_slot(self.node.chain.head_slot()).schemas
+        return await self._submit_op(
+            "proposer_slashings", S.ProposerSlashing,
+            PROPOSER_SLASHING_TOPIC, body)
+
+    async def _post_bls_changes(self, body=None):
+        """Per-item semantics (standard API): every valid change is
+        pooled + broadcast; failures are reported per index, and one
+        duplicate must not abort the rest of the batch."""
+        from ..node.gossip import BLS_TO_EXECUTION_CHANGE_TOPIC
+        from ..spec.milestones import build_fork_schedule, SpecMilestone
+        try:
+            version = build_fork_schedule(
+                self.node.spec.config).version_for(SpecMilestone.CAPELLA)
+        except KeyError:
+            raise HttpError(400, "capella not scheduled on this network")
+        ops = body if isinstance(body, list) else [body]
+        failures = []
+        for i, op in enumerate(ops):
+            try:
+                await self._submit_op(
+                    "bls_to_execution_changes",
+                    version.schemas.SignedBLSToExecutionChange,
+                    BLS_TO_EXECUTION_CHANGE_TOPIC, op)
+            except HttpError as exc:
+                failures.append({"index": i, "message": exc.message})
+        if failures:
+            raise HttpError(400, f"failures: {failures}")
+        return {}
+
+    # -- balances / roots / withdrawals --------------------------------
+    async def _validator_balances(self, state_id: str, query=None):
+        state = await self._resolve_state_async(state_id)
+        ids = None
+        if query and query.get("id"):
+            # the standard API allows index OR pubkey ids
+            ids = self._validator_indices(state,
+                                          query["id"].split(","))
+        return self._balances_json(state, ids)
+
+    async def _validator_balances_post(self, state_id: str, body=None):
+        state = await self._resolve_state_async(state_id)
+        ids = self._validator_indices(state, body) \
+            if isinstance(body, list) else None
+        return self._balances_json(state, ids)
+
+    def _balances_json(self, state, ids):
+        n = len(state.balances)
+        idx = range(n) if ids is None else ids
+        out = []
+        for i in idx:
+            if not 0 <= i < n:
+                raise HttpError(400, f"unknown validator index {i}")
+            out.append({"index": str(i),
+                        "balance": str(state.balances[i])})
+        return {"data": out}
+
+    async def _block_root(self, block_id: str):
+        return {"data": {"root": _hex(self._resolve_block_root(
+            block_id))}}
+
+    async def _block_attestations(self, block_id: str):
+        block = self._block_by_root(self._resolve_block_root(block_id))
+        if block is None:
+            raise HttpError(404, "block not found")
+        body = block.message.body if hasattr(block, "message") else \
+            block.body
+        return {"data": [_ssz_to_json(type(a), a)
+                         for a in body.attestations]}
+
+    async def _peer_count(self):
+        connected = 0
+        if self.networked:
+            connected = sum(1 for p in self.networked.net.peers
+                            if p.connected)
+        return {"data": {"disconnected": "0", "connecting": "0",
+                         "connected": str(connected),
+                         "disconnecting": "0"}}
+
+    async def _expected_withdrawals(self, state_id: str, query=None):
+        state = await self._resolve_state_async(state_id)
+        if not hasattr(state, "next_withdrawal_index"):
+            raise HttpError(400, "pre-capella state has no withdrawals")
+        slot = int(query["proposal_slot"]) if query \
+            and query.get("proposal_slot") else state.slot + 1
+        cfg = self.node.spec.config
+        from ..spec.transition import process_slots
+        if state.slot < slot:
+            state = process_slots(cfg, state, slot)
+        if hasattr(state, "pending_partial_withdrawals"):
+            from ..spec.electra.block import get_expected_withdrawals
+            withdrawals = get_expected_withdrawals(cfg, state)[0]
+        else:
+            from ..spec.capella.block import get_expected_withdrawals
+            withdrawals = get_expected_withdrawals(cfg, state)
+        return {"data": [{
+            "index": str(w.index),
+            "validator_index": str(w.validator_index),
+            "address": _hex(w.address),
+            "amount": str(w.amount)} for w in withdrawals]}
 
     # -- metrics -------------------------------------------------------
     async def _submit_sync_messages(self, body=None):

@@ -106,10 +106,14 @@ def block_rewards(cfg: SpecConfig, pre_state, post_state, block
     for w in getattr(payload, "withdrawals", ()) or ():
         if w.validator_index == proposer:
             total += int(w.amount)
-    proposer_pubkey = pre_state.validators[proposer].pubkey
-    for deposit in getattr(body, "deposits", ()) or ():
-        if deposit.data.pubkey == proposer_pubkey:
-            total -= int(deposit.data.amount)
+    # electra (EIP-6110/7251) deposits credit the pending-deposit queue
+    # during block processing, NOT balances — normalizing there would
+    # understate the attestations component by the deposit amount
+    if not hasattr(post_state, "pending_deposits"):
+        proposer_pubkey = pre_state.validators[proposer].pubkey
+        for deposit in getattr(body, "deposits", ()) or ():
+            if deposit.data.pubkey == proposer_pubkey:
+                total -= int(deposit.data.amount)
     sync_total = 0
     if hasattr(body, "sync_aggregate") \
             and hasattr(pre_state, "current_sync_committee"):

@@ -53,6 +53,21 @@ def _load_yaml(path: Optional[str]) -> Dict[str, Any]:
 # subcommands
 # --------------------------------------------------------------------------
 
+def _configure_bls(args, yaml_cfg) -> str:
+    """Install the BLS provider BEFORE any service starts (reference:
+    Teku.java:74 preflight + BLS.java:51-62 setBlsImplementation):
+    default auto tries the JAX/TPU provider and falls back loudly."""
+    from .crypto.bls import loader
+    choice = layered_value("bls-impl", getattr(args, "bls_impl", None),
+                           yaml_cfg, "auto")
+    try:
+        name = loader.configure(choice)
+    except loader.BlsLoadError as exc:
+        raise SystemExit(f"BLS preflight failed: {exc}")
+    print(f"BLS implementation: {name}")
+    return name
+
+
 def cmd_node(args) -> int:
     """Run a beacon node: p2p + REST + optional validators + storage."""
     from .networking import NetworkedNode
@@ -65,6 +80,7 @@ def cmd_node(args) -> int:
     from .validator.slashing_protection import SlashingProtector
 
     yaml_cfg = _load_yaml(args.config_file)
+    _configure_bls(args, yaml_cfg)
     network = layered_value("network", args.network, yaml_cfg, "minimal")
     port = int(layered_value("p2p-port", args.p2p_port, yaml_cfg, 0, int))
     rest_port = int(layered_value("rest-port", args.rest_port, yaml_cfg,
@@ -243,6 +259,8 @@ def cmd_devnet(args) -> int:
     """In-process devnet: N nodes, loopback gossip, fast clock."""
     from .node import Devnet
 
+    _configure_bls(args, {})
+
     async def run():
         net = Devnet(n_nodes=args.nodes, n_validators=args.validators)
         await net.start()
@@ -404,6 +422,7 @@ def cmd_validator_client(args) -> int:
                             SlashingProtectedSigner, ValidatorClient)
     from .validator.slashing_protection import SlashingProtector
 
+    _configure_bls(args, {})
     spec = create_spec(args.network or "minimal")
     remote = RemoteValidatorApi(spec, args.beacon_node)
     genesis = remote._get_json("/eth/v1/beacon/genesis")["data"]
@@ -493,12 +512,19 @@ def build_parser() -> argparse.ArgumentParser:
     n.add_argument("--checkpoint-sync-url", default=None,
                    help="REST base URL of a trusted node to anchor "
                         "from (finalized state + block)")
+    n.add_argument("--bls-impl", default=None,
+                   choices=["auto", "jax", "pure"],
+                   help="BLS provider: auto tries the JAX/TPU kernel "
+                        "and falls back to the pure oracle; jax makes "
+                        "accelerator failure fatal")
     n.set_defaults(fn=cmd_node)
 
     d = sub.add_parser("devnet", help="in-process fast devnet")
     d.add_argument("--nodes", type=int, default=2)
     d.add_argument("--validators", type=int, default=32)
     d.add_argument("--epochs", type=int, default=4)
+    d.add_argument("--bls-impl", default=None,
+                   choices=["auto", "jax", "pure"])
     d.set_defaults(fn=cmd_devnet)
 
     t = sub.add_parser("transition", help="offline state transition")
@@ -545,6 +571,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="first interop key index this VC owns")
     vc.add_argument("--interop-total", type=int, default=64)
     vc.add_argument("--data-dir", default=None)
+    vc.add_argument("--bls-impl", default=None,
+                    choices=["auto", "jax", "pure"])
     vc.set_defaults(fn=cmd_validator_client)
 
     pe = sub.add_parser("peer", help="generate a node identity")

@@ -1,0 +1,129 @@
+"""BLS implementation selection at process start.
+
+The reference refuses to boot before its accelerated BLS is proven
+loadable (reference: teku/src/main/java/tech/pegasys/teku/Teku.java:74
+preflight calling BLS.getBlsImpl, and the setBlsImplementation seam at
+infrastructure/bls/src/main/java/tech/pegasys/teku/bls/BLS.java:51-62;
+graceful degradation lives in BlstLoader.java:34-51).  This module is
+that seam for the TPU build: `configure("auto"|"jax"|"pure")` installs
+the chosen provider into the facade before any node service starts, so
+every gossip / block-import / sync signature flows through the batched
+device kernel rather than the pure-Python oracle.
+
+"auto" probes the accelerator with a bounded deadline: a wedged TPU
+tunnel must not hang node startup (the same failure mode bench.py
+guards against), so the probe runs in a daemon thread and on timeout
+the node falls back to the oracle with a loud log.  "jax" makes probe
+failure fatal, mirroring the reference's hard preflight.
+"""
+
+import logging
+import os
+import threading
+from typing import Optional
+
+from . import get_implementation, reset_implementation, set_implementation
+
+_LOG = logging.getLogger(__name__)
+
+# generator pubkey (secret key 1): a cheap known-good probe input
+_PROBE_PK = bytes.fromhex(
+    "97f1d3a73197d7942695638c4fa9ac0fc3688c4f9774b905a14e3a3f171bac58"
+    "6c55e83ff97a1aeffb3af00adb22c6bb")
+
+CHOICES = ("auto", "jax", "pure")
+
+
+class BlsLoadError(RuntimeError):
+    """The requested BLS implementation could not be brought up."""
+
+
+def _probe_jax(max_batch: int, min_bucket: int):
+    """Instantiate the device provider and prove the backend executes:
+    one pubkey-validation dispatch (the small program; the five staged
+    verify programs compile lazily on first real batch)."""
+    from ...ops.provider import JaxBls12381
+
+    impl = JaxBls12381(max_batch=max_batch, min_bucket=min_bucket)
+    if not impl.public_key_is_valid(_PROBE_PK):
+        raise BlsLoadError("device probe rejected the generator pubkey")
+    import jax
+    return impl, str(jax.devices()[0])
+
+
+def configure(choice: str = "auto", *, max_batch: int = 256,
+              min_bucket: int = 16,
+              probe_timeout_s: Optional[float] = None) -> str:
+    """Install the BLS provider for this process; returns its name.
+
+    auto: try the JAX/TPU provider under a deadline, fall back to the
+          pure oracle with a loud warning on any failure.
+    jax:  require the JAX/TPU provider; raise BlsLoadError on failure.
+    pure: install the oracle (also the explicit opt-out for tests).
+    """
+    if choice not in CHOICES:
+        raise ValueError(f"unknown bls impl {choice!r} (use one of "
+                         f"{'/'.join(CHOICES)})")
+    if choice == "pure":
+        reset_implementation()
+        _reset_kzg_backend()
+        return "pure"
+    if probe_timeout_s is None:
+        probe_timeout_s = float(
+            os.environ.get("TEKU_TPU_BLS_PROBE_TIMEOUT_S", "120"))
+
+    result: dict = {}
+
+    def run():
+        try:
+            result["ok"] = _probe_jax(max_batch, min_bucket)
+        except BaseException as exc:  # noqa: BLE001 - report any failure
+            result["err"] = exc
+
+    t = threading.Thread(target=run, daemon=True,
+                         name="bls-loader-probe")
+    t.start()
+    t.join(probe_timeout_s)
+    if t.is_alive():
+        err: BaseException = BlsLoadError(
+            f"backend probe exceeded {probe_timeout_s:.0f}s "
+            "(wedged device tunnel?)")
+    else:
+        err = result.get("err")
+    if err is None:
+        impl, device = result["ok"]
+        set_implementation(impl)
+        # KZG rides the same kernel base: install the device backend
+        # alongside (the reference's initKzg moment,
+        # BeaconChainController.java:557-572)
+        try:
+            from .. import kzg as kzg_facade
+            from ...ops.kzg import JaxKzg
+            kzg_facade.set_backend(JaxKzg())
+        except Exception as exc:  # pragma: no cover - defensive
+            _LOG.warning("device KZG backend unavailable: %s", exc)
+        _LOG.info("BLS implementation: %s on %s", impl.name, device)
+        return impl.name
+    if choice == "jax":
+        raise BlsLoadError(f"--bls-impl jax: {err}") from (
+            err if isinstance(err, Exception) else None)
+    _LOG.warning(
+        "BLS accelerator unavailable (%s: %s) — FALLING BACK to the "
+        "pure-Python oracle; node-side signature verification will be "
+        "slow", type(err).__name__, err)
+    reset_implementation()
+    _reset_kzg_backend()
+    return "pure"
+
+
+def _reset_kzg_backend() -> None:
+    try:
+        from .. import kzg as kzg_facade
+        kzg_facade.set_backend(None)
+    except Exception:  # pragma: no cover - import-order edge
+        pass
+
+
+def current_name() -> str:
+    impl = get_implementation()
+    return getattr(impl, "name", type(impl).__name__)

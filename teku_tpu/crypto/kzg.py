@@ -230,6 +230,9 @@ def blob_to_kzg_commitment(blob: bytes,
         # known tau: p(tau) in the field, then ONE scalar mul
         y = evaluate_polynomial_in_evaluation_form(poly, setup.tau)
         return C.g1_compress(C.point_mul(C.FQ_OPS, y, G1))
+    if _BACKEND is not None:
+        # device ladder MSM over the Lagrange basis (ops/kzg.py)
+        return _BACKEND.g1_lincomb(setup, poly)
     pt = g1_msm(setup.g1_lagrange, poly)
     return C.g1_compress(pt)
 
@@ -261,6 +264,8 @@ def compute_kzg_proof_impl(poly: List[int], z: int,
     if setup.tau is not None:
         q_tau = evaluate_polynomial_in_evaluation_form(quotient, setup.tau)
         return C.g1_compress(C.point_mul(C.FQ_OPS, q_tau, G1)), y
+    if _BACKEND is not None:
+        return _BACKEND.g1_lincomb(setup, quotient), y
     return C.g1_compress(g1_msm(setup.g1_lagrange, quotient)), y
 
 
@@ -320,6 +325,12 @@ def verify_kzg_proof_impl(commitment_pt, z: int, y: int, proof_pt,
 def verify_blob_kzg_proof(blob: bytes, commitment: bytes, proof: bytes,
                           setup: Optional[TrustedSetup] = None) -> bool:
     """reference KZG.verifyBlobKzgProof (CKZG4844.java:104-113)."""
+    if _BACKEND is not None and len(blob) == BYTES_PER_BLOB:
+        try:
+            return _BACKEND.verify_blob_kzg_proof(
+                blob, commitment, proof, setup or get_setup())
+        except KzgError:
+            return False
     try:
         c_pt = _decompress_g1_checked(commitment, "commitment")
         p_pt = _decompress_g1_checked(proof, "proof")
@@ -331,16 +342,41 @@ def verify_blob_kzg_proof(blob: bytes, commitment: bytes, proof: bytes,
     return verify_kzg_proof_impl(c_pt, z, y, p_pt, setup)
 
 
+# Pluggable accelerated backend (the KZG analogue of the BLS facade's
+# set_implementation seam): installed by the loader alongside the JAX
+# BLS provider, mirroring the reference's initKzg wiring
+# (BeaconChainController.java:557-572 -> CKZG4844 JNI singleton).
+_BACKEND = None
+
+
+def set_backend(backend) -> None:
+    global _BACKEND
+    _BACKEND = backend
+
+
+def backend_name() -> str:
+    return getattr(_BACKEND, "name", "host-pure") if _BACKEND else \
+        "host-pure"
+
+
 def verify_blob_kzg_proof_batch(blobs: Sequence[bytes],
                                 commitments: Sequence[bytes],
                                 proofs: Sequence[bytes],
                                 setup: Optional[TrustedSetup] = None
                                 ) -> bool:
-    """reference KZG.verifyBlobKzgProofBatch (CKZG4844.java:115-122).
-    Verified per item (the random-linear-combination fold is a planned
-    device-batch optimization on the shared pairing kernel)."""
+    """reference KZG.verifyBlobKzgProofBatch (CKZG4844.java:115-122):
+    one random-linear-combination fold -> 2 pairings for the whole
+    batch, dispatched to the device backend when installed."""
     if not (len(blobs) == len(commitments) == len(proofs)):
         return False
+    if not blobs:
+        return True
+    if _BACKEND is not None:
+        try:
+            return _BACKEND.verify_blob_kzg_proof_batch(
+                blobs, commitments, proofs, setup or get_setup())
+        except KzgError:
+            return False
     return all(verify_blob_kzg_proof(b, c, p, setup)
                for b, c, p in zip(blobs, commitments, proofs))
 

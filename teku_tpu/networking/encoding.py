@@ -204,20 +204,34 @@ def _frame_end(data: bytes, pos: int, want: int) -> int:
         raise EncodingError("missing snappy stream identifier")
     cursor = pos + len(_STREAM_IDENT)
     produced = 0
+    chunks = 0
     while produced < want:
         if cursor + 4 > len(data):
             raise EncodingError("truncated stream")
+        # bound the walk: a crafted stream of produce-nothing chunks
+        # must not be scanned unboundedly before frame_uncompress
+        # rejects it (every data chunk produces >= 1 byte, so `want`
+        # data chunks suffice; allow as many again for padding)
+        chunks += 1
+        if chunks > 2 * max(want, 1) + 64:
+            raise EncodingError("chunk count exceeds stream bound")
         head = struct.unpack("<I", data[cursor:cursor + 4])[0]
         ctype = head & 0xFF
         clen = head >> 8
         cursor += 4 + clen
         if cursor > len(data):
             raise EncodingError("truncated chunk")
-        if ctype == _CHUNK_UNCOMPRESSED:
-            produced += clen - 4
-        elif ctype == _CHUNK_COMPRESSED:
-            body = data[cursor - clen + 4:cursor]
-            produced += _snappy_uncompressed_len(body)
+        if ctype in (_CHUNK_UNCOMPRESSED, _CHUNK_COMPRESSED):
+            if clen < 4:
+                # mirrors frame_uncompress's "chunk too short for
+                # checksum" check: without it `produced` could go
+                # NEGATIVE and walk the stream further than intended
+                raise EncodingError("chunk too short for checksum")
+            if ctype == _CHUNK_UNCOMPRESSED:
+                produced += clen - 4
+            else:
+                body = data[cursor - clen + 4:cursor]
+                produced += _snappy_uncompressed_len(body)
         # other chunk types (repeated ident, skippable/padding) produce
         # nothing; frame_uncompress validates them afterwards
     return cursor

@@ -126,6 +126,9 @@ class BeaconNode(Service):
             body.attester_slashings)
         self.operation_pools["voluntary_exits"].on_included(
             body.voluntary_exits)
+        if hasattr(body, "bls_to_execution_changes"):
+            self.operation_pools["bls_to_execution_changes"].on_included(
+                body.bls_to_execution_changes)
 
     # ------------------------------------------------------------------
     def _subscribe_topics(self) -> None:
@@ -176,8 +179,23 @@ class BeaconNode(Service):
                  "attester_slashings")):
             self.gossip.subscribe(topic, SszTopicHandler(
                 schema, self._make_op_processor(pool_name), topic))
+        self._subscribe_bls_change_topic()
         self._subscribe_sync_topic()
         self._subscribe_blob_topics()
+
+    def _subscribe_bls_change_topic(self) -> None:
+        from .gossip import BLS_TO_EXECUTION_CHANGE_TOPIC
+        from ..spec.milestones import build_fork_schedule, SpecMilestone
+        try:
+            version = build_fork_schedule(self.spec.config).version_for(
+                SpecMilestone.CAPELLA)
+        except KeyError:
+            return          # capella not scheduled on this network
+        self.gossip.subscribe(
+            BLS_TO_EXECUTION_CHANGE_TOPIC, SszTopicHandler(
+                version.schemas.SignedBLSToExecutionChange,
+                self._make_op_processor("bls_to_execution_changes"),
+                BLS_TO_EXECUTION_CHANGE_TOPIC))
 
     def _subscribe_blob_topics(self) -> None:
         from ..spec.config import FAR_FUTURE_EPOCH

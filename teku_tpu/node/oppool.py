@@ -80,9 +80,12 @@ class OperationPool:
 
 
 def make_operation_pools(cfg):
-    """The three phase0 pools with the spec process_* functions as
-    their apply/validate rules."""
+    """The phase0 pools + the capella bls-change pool, with the spec
+    process_* functions as their apply/validate rules (reference:
+    SignedBlsToExecutionChangeValidator delegates to the same spec
+    check + signature)."""
     from ..spec import block as B
+    from ..spec.capella.block import process_bls_to_execution_change
 
     def _apply(fn):
         return lambda state, op: fn(cfg, state, op, SIMPLE)
@@ -100,4 +103,10 @@ def make_operation_pools(cfg):
             "voluntary_exits",
             key_fn=lambda op: op.message.validator_index,
             apply_fn=_apply(B.process_voluntary_exit)),
+        # pre-capella states simply fail the apply rule, so the pool
+        # stays empty until the fork activates
+        "bls_to_execution_changes": OperationPool(
+            "bls_to_execution_changes",
+            key_fn=lambda op: op.message.validator_index,
+            apply_fn=_apply(process_bls_to_execution_change)),
     }

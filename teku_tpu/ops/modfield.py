@@ -1,0 +1,178 @@
+"""Generic fixed-width Montgomery limb field for TPU (JAX).
+
+The same lazy-reduction design as ops/limbs.py (the Fq engine of the
+BLS kernel) parameterized over the modulus, so other prime fields ride
+the proven pattern instead of duplicating it.  First client: the
+BLS12-381 SCALAR field Fr for KZG (barycentric blob evaluation runs in
+Fr — reference: c-kzg's fr_t arithmetic behind
+infrastructure/kzg/src/main/java/tech/pegasys/teku/kzg/CKZG4844.java).
+
+Contracts are identical to ops/limbs.py: elementwise add/sub/neg are
+lazy (no carries), mont_mul/mont_sqr take bounded lazy operands and
+emit one compressed unit with value in (-M, 2M), canonical() decides
+equality, inversion is Fermat, and the batch inverse is Montgomery's
+trick over two log-depth associative scans.
+"""
+
+from types import SimpleNamespace
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def make_field(modulus: int, name: str = "field",
+               width: int = 26) -> SimpleNamespace:
+    W = width
+    L = (modulus.bit_length() + W - 1) // W
+    MASK = (1 << W) - 1
+    RADIX = 1 << W
+    M = modulus
+    R_MOD = (1 << (W * L)) % M
+    R2_MOD = (R_MOD * R_MOD) % M
+    N0INV = (-pow(M, -1, RADIX)) % RADIX
+
+    def int_to_limbs(x: int) -> np.ndarray:
+        if not 0 <= x < (1 << (W * L)):
+            raise ValueError("value out of limb range")
+        return np.array([(x >> (W * i)) & MASK for i in range(L)],
+                        dtype=np.int64)
+
+    def limbs_to_int(a) -> int:
+        a = np.asarray(a)
+        return sum(int(a[..., i]) << (W * i) for i in range(L)) % M
+
+    M_LIMBS = int_to_limbs(M)
+    ONE_MONT = int_to_limbs(R_MOD)
+    R2_LIMBS = int_to_limbs(R2_MOD)
+
+    def int_to_mont(x: int) -> np.ndarray:
+        return int_to_limbs((x % M) * R_MOD % M)
+
+    def mont_to_int(a) -> int:
+        return limbs_to_int(a) * pow(R_MOD, -1, M) % M
+
+    def select(cond, a, b):
+        return jnp.where(cond[..., None], a, b)
+
+    def compress(r):
+        def step(c, col):
+            v = col + c
+            return v >> W, v & MASK
+        c0 = jnp.zeros(r.shape[:-1], dtype=jnp.int64)
+        c, limbs = lax.scan(step, c0, jnp.moveaxis(r, -1, 0))
+        limbs = jnp.moveaxis(limbs, 0, -1)
+        return limbs.at[..., L - 1].add(c * RADIX)
+
+    def _sub_with_borrow(a, b):
+        a, b = jnp.broadcast_arrays(a, b)
+
+        def step(c, cols):
+            v = cols[0] - cols[1] + c
+            return v >> W, v & MASK
+        c0 = jnp.zeros(a.shape[:-1], dtype=jnp.int64)
+        c, limbs = lax.scan(
+            step, c0, (jnp.moveaxis(a, -1, 0), jnp.moveaxis(b, -1, 0)))
+        return jnp.moveaxis(limbs, 0, -1), c
+
+    def _cond_sub_m(a):
+        m = jnp.asarray(M_LIMBS)
+        d, borrow = _sub_with_borrow(a, m)
+        return jnp.where((borrow != 0)[..., None], a, d)
+
+    def _pad_last(x, lo, hi):
+        return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(lo, hi)])
+
+    def _mont_reduce(t):
+        m_pad = _pad_last(jnp.asarray(M_LIMBS), 0, L)
+
+        def red(t, _):
+            mm = ((t[..., 0] & MASK) * N0INV) & MASK
+            t = t + mm[..., None] * m_pad
+            c = t[..., 0] >> W
+            head = t[..., 1:2] + c[..., None]
+            t = jnp.concatenate(
+                [head, t[..., 2:], jnp.zeros_like(t[..., :1])], axis=-1)
+            return t, None
+
+        t, _ = lax.scan(red, t, None, length=L)
+        return compress(t[..., :L])
+
+    def mont_mul(a, b):
+        t = sum(_pad_last(a[..., i:i + 1] * b, i, L - i)
+                for i in range(L))
+        return _mont_reduce(t)
+
+    def mont_sqr(a):
+        rows = []
+        for i in range(L):
+            diag = a[..., i:i + 1] * a[..., i:i + 1]
+            cross = 2 * a[..., i:i + 1] * a[..., i + 1:]
+            seg = jnp.concatenate([diag, cross], axis=-1)
+            rows.append(_pad_last(seg, 2 * i, L - i))
+        return _mont_reduce(sum(rows))
+
+    def to_mont(a):
+        return mont_mul(a, jnp.asarray(R2_LIMBS))
+
+    def canonical(a):
+        y = mont_mul(a, jnp.asarray(R2_LIMBS))
+        y = compress(y + jnp.asarray(M_LIMBS))
+        return _cond_sub_m(_cond_sub_m(y))
+
+    def canonical_plain(a):
+        one = jnp.zeros_like(a).at[..., 0].set(1)
+        y = mont_mul(a, one)
+        y = compress(y + jnp.asarray(M_LIMBS))
+        return _cond_sub_m(_cond_sub_m(y))
+
+    def is_zero(a):
+        return jnp.all(canonical(a) == 0, axis=-1)
+
+    def pow_static(a, e: int):
+        if e == 0:
+            return jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape)
+        bits = np.array(
+            [(e >> i) & 1 for i in range(e.bit_length())][::-1],
+            dtype=np.int64)
+
+        def body(acc, bit):
+            acc = mont_sqr(acc)
+            acc = select(bit != 0, mont_mul(acc, a), acc)
+            return acc, None
+
+        acc, _ = lax.scan(body, jnp.asarray(a), jnp.asarray(bits[1:]))
+        return acc
+
+    def inv(a):
+        return pow_static(a, M - 2)
+
+    def inv_many(a):
+        shape = a.shape
+        flat = a.reshape((-1, L))
+        mlen = flat.shape[0]
+        if mlen == 1:
+            return inv(flat).reshape(shape)
+        zero = is_zero(flat)
+        one = jnp.broadcast_to(jnp.asarray(ONE_MONT), flat.shape)
+        safe = jnp.where(zero[:, None], one, flat)
+        pre = lax.associative_scan(mont_mul, safe, axis=0)
+        suf = lax.associative_scan(mont_mul, safe, axis=0, reverse=True)
+        tinv = inv(pre[-1])
+        left = jnp.concatenate([one[:1], pre[:-1]], axis=0)
+        right = jnp.concatenate([suf[1:], one[:1]], axis=0)
+        out = mont_mul(mont_mul(left, right), tinv[None])
+        out = jnp.where(zero[:, None], 0, out)
+        return out.reshape(shape)
+
+    return SimpleNamespace(
+        name=name, M=M, W=W, L=L, MASK=MASK,
+        int_to_limbs=int_to_limbs, limbs_to_int=limbs_to_int,
+        int_to_mont=int_to_mont, mont_to_int=mont_to_int,
+        ONE_MONT=ONE_MONT, M_LIMBS=M_LIMBS,
+        select=select, compress=compress, mont_mul=mont_mul,
+        mont_sqr=mont_sqr, to_mont=to_mont, canonical=canonical,
+        canonical_plain=canonical_plain, is_zero=is_zero,
+        pow_static=pow_static, inv=inv, inv_many=inv_many,
+    )

@@ -124,9 +124,16 @@ class JaxBls12381(BLS12381):
     name = "jax-tpu"
 
     def __init__(self, max_batch: int = 4096, max_keys_per_lane: int = 2048,
-                 min_bucket: int = 4):
+                 min_bucket: int = 4, mesh=None):
         self._pure = PureBls12381()
         self.max_batch = max_batch
+        # optional multi-chip dispatch: lanes shard over the mesh's dp
+        # axis, partial products ride one all_gather (teku_tpu/parallel)
+        self._sharded = None
+        if mesh is not None:
+            from ..parallel import ShardedVerifier
+            self._sharded = ShardedVerifier(mesh, min_bucket=min_bucket)
+            min_bucket = self._sharded.min_bucket
         self.max_keys_per_lane = max_keys_per_lane
         # tiny batches pad up to one shared bucket: a couple of masked
         # lanes cost microseconds on device, a fresh XLA compile costs
@@ -139,6 +146,11 @@ class JaxBls12381(BLS12381):
         # whose TPU compile is unbounded (ops/verify.py staged_jits)
         self._verify_jit = V.verify_staged
         self._pk_validate_jit = jax.jit(self._pk_validate_kernel)
+        # observability: proof that node traffic actually reaches the
+        # device path (mirrors the reference's signature_verifications_*
+        # counters at AggregatingSignatureVerificationService.java:76-98)
+        self.dispatch_count = 0
+        self.lanes_dispatched = 0
 
     # ------------------------------------------------------------------
     # Host-side SPI ops delegated to the oracle (rare, non-batch paths)
@@ -293,6 +305,8 @@ class JaxBls12381(BLS12381):
     # ------------------------------------------------------------------
     def _dispatch(self, semis: List[_Semi], randomize: bool) -> bool:
         n = len(semis)
+        self.dispatch_count += 1
+        self.lanes_dispatched += n
         padded = max(_next_pow2(n), self.min_bucket)
         kmax = _next_pow2(max(len(s.pk_limbs) for s in semis))
         pk_xs = np.zeros((padded, kmax, fp.L), dtype=np.int64)
@@ -328,8 +342,13 @@ class JaxBls12381(BLS12381):
         else:
             rs = np.ones(padded, dtype=np.uint64)
         r_bits = np.asarray(PT.scalar_from_uint64(rs))
-        ok, lane_ok = self._verify_jit(
-            pk_xs, pk_ys, pk_present, (u0c0, u0c1), (u1c0, u1c1),
-            (sx0, sx1), s_large, s_inf, r_bits, lane_valid)
+        if self._sharded is not None:
+            ok, lane_ok = self._sharded(
+                pk_xs, pk_ys, pk_present, (u0c0, u0c1), (u1c0, u1c1),
+                (sx0, sx1), s_large, s_inf, r_bits, lane_valid)
+        else:
+            ok, lane_ok = self._verify_jit(
+                pk_xs, pk_ys, pk_present, (u0c0, u0c1), (u1c0, u1c1),
+                (sx0, sx1), s_large, s_inf, r_bits, lane_valid)
         lane_ok = np.asarray(lane_ok)
         return bool(np.asarray(ok)) and bool(lane_ok[:n].all())

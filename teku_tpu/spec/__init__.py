@@ -55,4 +55,11 @@ class Spec:
 
 
 def create_spec(network: str = "minimal") -> Spec:
+    """Build a Spec for a named network: full bundles (mainnet,
+    sepolia, holesky, gnosis — real fork schedules) from
+    spec/networks.py, else the bare presets."""
+    from .networks import BUNDLES
+    bundle = BUNDLES.get(network)
+    if bundle is not None:
+        return Spec(bundle.config)
     return Spec(get_config(network))

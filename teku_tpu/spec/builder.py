@@ -101,7 +101,8 @@ def build_unsigned_block(cfg: SpecConfig, pre, slot: int,
                          proposer_index: Optional[int] = None,
                          sync_aggregate=None,
                          eth1_vote=None,
-                         blob_kzg_commitments: Sequence = ()):
+                         blob_kzg_commitments: Sequence = (),
+                         bls_to_execution_changes: Sequence = ()):
     """(unsigned block with state root filled, post_state) on an
     already-slot-advanced pre-state — the ONE body-construction recipe
     shared by local production and the validator API (reference:
@@ -150,6 +151,11 @@ def build_unsigned_block(cfg: SpecConfig, pre, slot: int,
             # transitioned: the processor skips execution checks
             # (is_execution_enabled False)
             body_kwargs["execution_payload"] = S.ExecutionPayload()
+    if "bls_to_execution_changes" in S.BeaconBlockBody._ssz_fields:
+        body_kwargs["bls_to_execution_changes"] = tuple(
+            bls_to_execution_changes)
+    elif bls_to_execution_changes:
+        raise ValueError("bls_to_execution_changes need a capella+ fork")
     if "blob_kzg_commitments" in S.BeaconBlockBody._ssz_fields:
         body_kwargs["blob_kzg_commitments"] = tuple(blob_kzg_commitments)
     elif blob_kzg_commitments:

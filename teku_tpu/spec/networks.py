@@ -1,0 +1,205 @@
+"""Per-network configuration bundles: mainnet, sepolia, holesky, gnosis.
+
+Equivalent of the reference's bundled network configs + builder
+(reference: ethereum/networks/src/main/resources/ fork schedules and
+Eth2NetworkConfiguration.java with deposit contract, bootnodes and
+checkpoint-sync URLs).  Values are public protocol constants from the
+published network configs.
+
+A bundle = the SpecConfig (preset + network overrides: fork versions/
+epochs, churn, deposit chain) + network identity (genesis validators
+root, genesis time, deposit contract address) + operational defaults
+(bootnode ENRs, checkpoint-sync URLs).  `--network <name>` resolves
+here (teku_tpu/cli.py -> spec.create_spec).
+"""
+
+from dataclasses import dataclass, replace
+from typing import Dict, Optional, Tuple
+
+from .config import FAR_FUTURE_EPOCH, MAINNET, MINIMAL, SpecConfig
+
+
+@dataclass(frozen=True)
+class NetworkBundle:
+    name: str
+    config: SpecConfig
+    genesis_validators_root: Optional[bytes] = None
+    genesis_time: Optional[int] = None
+    deposit_contract: Optional[bytes] = None       # 20-byte address
+    bootnodes: Tuple[str, ...] = ()
+    checkpoint_sync_urls: Tuple[str, ...] = ()
+
+
+# --------------------------------------------------------------------------
+# mainnet — the real fork schedule (preset values already in MAINNET)
+# --------------------------------------------------------------------------
+
+MAINNET_NETWORK = NetworkBundle(
+    name="mainnet",
+    config=replace(
+        MAINNET,
+        config_name="mainnet",
+        ALTAIR_FORK_EPOCH=74240,
+        BELLATRIX_FORK_EPOCH=144896,
+        CAPELLA_FORK_EPOCH=194048,
+        DENEB_FORK_EPOCH=269568,
+        ELECTRA_FORK_EPOCH=364032,
+    ),
+    genesis_validators_root=bytes.fromhex(
+        "4b363db94e286120d76eb905340fdd4e54bfe9f06bf33ff6cf5ad27f511bfe95"),
+    genesis_time=1606824023,
+    deposit_contract=bytes.fromhex(
+        "00000000219ab540356cbb839cbe05303d7705fa"),
+    bootnodes=(
+        # EF + client-team mainnet bootnode ENRs ship with every client;
+        # carried as opaque strings for the discovery layer
+        "enr:-Ku4QImhMc1z8yCiNJ1TyUxdcfNucje3BGwEHzodEZUan8PherEo4sF7pPHPSIB1NNuSg5fZy7qFsjmUKs2ea1Whi0EBh2F0dG5ldHOIAAAAAAAAAACEZXRoMpD1pf1CAAAAAP__________gmlkgnY0gmlwhBLf22SJc2VjcDI1NmsxoQOVphkDqal4QzPMksc5wnpuC3gvSC8AfbFOnZY_On34wIN1ZHCCIyg",
+        "enr:-Ku4QP2xDnEtUXIjzJ_DhlCRN9SN99RYQPJL92TMlSv7U5C1YnYLjwOQHgZIUXw6c-BvRg2Yc2QsZxxoS_pPRVe0yK8Bh2F0dG5ldHOIAAAAAAAAAACEZXRoMpD1pf1CAAAAAP__________gmlkgnY0gmlwhBLf22SJc2VjcDI1NmsxoQMeFF5GrS7UZpAH2Ly84aLK-TyvH-dRo0JM1i8yygH50YN1ZHCCJxA",
+    ),
+    checkpoint_sync_urls=(
+        "https://beaconstate.info",
+        "https://mainnet-checkpoint-sync.attestant.io",
+    ),
+)
+
+
+# --------------------------------------------------------------------------
+# sepolia — permissioned-deposit testnet (mainnet preset)
+# --------------------------------------------------------------------------
+
+SEPOLIA_NETWORK = NetworkBundle(
+    name="sepolia",
+    config=replace(
+        MAINNET,
+        config_name="sepolia",
+        MIN_GENESIS_TIME=1655647200,
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=1300,
+        GENESIS_DELAY=86400,
+        GENESIS_FORK_VERSION=bytes.fromhex("90000069"),
+        ALTAIR_FORK_VERSION=bytes.fromhex("90000070"),
+        ALTAIR_FORK_EPOCH=50,
+        BELLATRIX_FORK_VERSION=bytes.fromhex("90000071"),
+        BELLATRIX_FORK_EPOCH=100,
+        CAPELLA_FORK_VERSION=bytes.fromhex("90000072"),
+        CAPELLA_FORK_EPOCH=56832,
+        DENEB_FORK_VERSION=bytes.fromhex("90000073"),
+        DENEB_FORK_EPOCH=132608,
+        ELECTRA_FORK_VERSION=bytes.fromhex("90000074"),
+        ELECTRA_FORK_EPOCH=222464,
+        DEPOSIT_CHAIN_ID=11155111,
+        DEPOSIT_NETWORK_ID=11155111,
+    ),
+    genesis_validators_root=bytes.fromhex(
+        "d8ea171f3c94aea21ebc42a1ed61052acf3f9209c00e4efbaaddac09ed9b8078"),
+    genesis_time=1655733600,
+    deposit_contract=bytes.fromhex(
+        "7f02c3e3c98b133055b8b348b2ac625669ed295d"),
+    checkpoint_sync_urls=(
+        "https://sepolia.beaconstate.info",
+        "https://checkpoint-sync.sepolia.ethpandaops.io",
+    ),
+)
+
+
+# --------------------------------------------------------------------------
+# holesky — large public testnet (mainnet preset)
+# --------------------------------------------------------------------------
+
+HOLESKY_NETWORK = NetworkBundle(
+    name="holesky",
+    config=replace(
+        MAINNET,
+        config_name="holesky",
+        MIN_GENESIS_TIME=1695902100,
+        GENESIS_DELAY=300,
+        GENESIS_FORK_VERSION=bytes.fromhex("01017000"),
+        ALTAIR_FORK_VERSION=bytes.fromhex("02017000"),
+        ALTAIR_FORK_EPOCH=0,
+        BELLATRIX_FORK_VERSION=bytes.fromhex("03017000"),
+        BELLATRIX_FORK_EPOCH=0,
+        CAPELLA_FORK_VERSION=bytes.fromhex("04017000"),
+        CAPELLA_FORK_EPOCH=256,
+        DENEB_FORK_VERSION=bytes.fromhex("05017000"),
+        DENEB_FORK_EPOCH=29696,
+        ELECTRA_FORK_VERSION=bytes.fromhex("06017000"),
+        ELECTRA_FORK_EPOCH=115968,
+        EJECTION_BALANCE=28 * 10 ** 9,
+        DEPOSIT_CHAIN_ID=17000,
+        DEPOSIT_NETWORK_ID=17000,
+    ),
+    genesis_validators_root=bytes.fromhex(
+        "9143aa7c615a7f7115e2b6aac319c03529df8242ae705fba9df39b79c59fa8b1"),
+    genesis_time=1695902400,
+    deposit_contract=bytes.fromhex(
+        "4242424242424242424242424242424242424242"),
+    checkpoint_sync_urls=(
+        "https://holesky.beaconstate.ethstaker.cc",
+        "https://checkpoint-sync.holesky.ethpandaops.io",
+    ),
+)
+
+
+# --------------------------------------------------------------------------
+# gnosis — independent chain on the gnosis preset (5s slots, 16/epoch)
+# --------------------------------------------------------------------------
+
+GNOSIS_NETWORK = NetworkBundle(
+    name="gnosis",
+    config=replace(
+        MAINNET,
+        preset_name="gnosis",
+        config_name="gnosis",
+        SECONDS_PER_SLOT=5,
+        SLOTS_PER_EPOCH=16,
+        EPOCHS_PER_ETH1_VOTING_PERIOD=64,
+        SECONDS_PER_ETH1_BLOCK=6,
+        EPOCHS_PER_SYNC_COMMITTEE_PERIOD=512,
+        MAX_WITHDRAWALS_PER_PAYLOAD=8,
+        MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP=8192,
+        CHURN_LIMIT_QUOTIENT=4096,
+        MIN_GENESIS_TIME=1638968400,
+        MIN_GENESIS_ACTIVE_VALIDATOR_COUNT=4096,
+        GENESIS_DELAY=6000,
+        BASE_REWARD_FACTOR=25,
+        GENESIS_FORK_VERSION=bytes.fromhex("00000064"),
+        ALTAIR_FORK_VERSION=bytes.fromhex("01000064"),
+        ALTAIR_FORK_EPOCH=512,
+        BELLATRIX_FORK_VERSION=bytes.fromhex("02000064"),
+        BELLATRIX_FORK_EPOCH=385536,
+        CAPELLA_FORK_VERSION=bytes.fromhex("03000064"),
+        CAPELLA_FORK_EPOCH=648704,
+        DENEB_FORK_VERSION=bytes.fromhex("04000064"),
+        DENEB_FORK_EPOCH=889856,
+        DEPOSIT_CHAIN_ID=100,
+        DEPOSIT_NETWORK_ID=100,
+    ),
+    genesis_validators_root=bytes.fromhex(
+        "f5dcb5564e829aab27264b9becd5dfaa017085611224cb3036f573368dbb9d47"),
+    genesis_time=1638993340,
+    deposit_contract=bytes.fromhex(
+        "0b98057ea310f4d31f2a452b414647007d1645d9"),
+    checkpoint_sync_urls=(
+        "https://checkpoint.gnosischain.com",
+    ),
+)
+
+
+MINIMAL_NETWORK = NetworkBundle(name="minimal", config=MINIMAL)
+# the bare mainnet PRESET (phase0 at genesis, forks unscheduled) stays
+# reachable for interop/devnet use under its historical name
+MAINNET_PRESET_NETWORK = NetworkBundle(name="mainnet-preset",
+                                       config=MAINNET)
+
+BUNDLES: Dict[str, NetworkBundle] = {
+    b.name: b for b in (
+        MAINNET_NETWORK, SEPOLIA_NETWORK, HOLESKY_NETWORK,
+        GNOSIS_NETWORK, MINIMAL_NETWORK, MAINNET_PRESET_NETWORK)
+}
+
+
+def get_bundle(name: str) -> NetworkBundle:
+    try:
+        return BUNDLES[name]
+    except KeyError:
+        raise ValueError(f"unknown network {name!r} (available: "
+                         f"{', '.join(sorted(BUNDLES))})") from None

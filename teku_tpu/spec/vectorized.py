@@ -77,13 +77,26 @@ def validator_arrays(state):
     return arrays
 
 
+def _checked_sum(arr: np.ndarray) -> int:
+    """Exact sum of non-negative int64 entries, or OverflowRisk.
+
+    numpy int64 sums WRAP silently at 2^63 (per-element loads raise,
+    sums do not), so before trusting one the worst case n * max must
+    fit.  Unreachable with real balances (total stake is bounded far
+    below 2^63 gwei) but reachable with synthetic states — those fall
+    back to exact big-int scalar code."""
+    if arr.size and arr.size * int(arr.max()) >= 2 ** 63:
+        raise OverflowRisk("int64 sum headroom")
+    return int(arr.sum())
+
+
 def total_active_balance(cfg: SpecConfig, state) -> int:
     """Exact twin of H.get_total_active_balance without the index-set
     build (O(V) python loop → one masked array sum)."""
     cur = H.get_current_epoch(cfg, state)
     eb, _, activation, exit_epoch, _, _ = validator_arrays(state)
     active = (activation <= cur) & (cur < exit_epoch)
-    return max(cfg.EFFECTIVE_BALANCE_INCREMENT, int(eb[active].sum()))
+    return max(cfg.EFFECTIVE_BALANCE_INCREMENT, _checked_sum(eb[active]))
 
 
 def _epoch_masks(cfg: SpecConfig, state):
@@ -126,7 +139,7 @@ def process_rewards_and_penalties(cfg: SpecConfig, state,
     # unslashed_increments can exceed active_increments (mass exits:
     # last epoch's participants dwarf the current active set), so the
     # guard must cover the worst multiplicand, not the current one
-    max_increments = max(1, int(eb.sum()) // inc)
+    max_increments = max(1, _checked_sum(eb) // inc)
     if int(base_reward.max(initial=0)) * 64 * max_increments >= 2 ** 62:
         raise OverflowRisk("flag delta product")
 
@@ -140,7 +153,7 @@ def process_rewards_and_penalties(cfg: SpecConfig, state,
         unslashed = _unslashed_flag_mask(active_prev, slashed, part,
                                          flag_index)
         unslashed_increments = max(
-            inc, int(eb[unslashed].sum())) // inc
+            inc, _checked_sum(eb[unslashed])) // inc
         rewards = np.zeros(len(eb), dtype=np.int64)
         penalties = np.zeros(len(eb), dtype=np.int64)
         if not leaking:
@@ -174,6 +187,11 @@ def process_inactivity_updates(cfg: SpecConfig, state):
     eb, slashed, active_prev, eligible, part = _epoch_masks(cfg, state)
     scores = np.fromiter(state.inactivity_scores, dtype=np.int64,
                          count=len(eb))
+    # score-headroom guard: adding INACTIVITY_SCORE_BIAS must not wrap
+    # int64 (pathological synthetic scores fall back to scalar code)
+    if scores.size and int(scores.max()) >= 2 ** 63 \
+            - cfg.INACTIVITY_SCORE_BIAS:
+        raise OverflowRisk("inactivity score headroom")
     participated = _unslashed_flag_mask(active_prev, slashed, part,
                                         TIMELY_TARGET_FLAG_INDEX)
     scores = np.where(eligible & participated,
@@ -312,5 +330,5 @@ def target_participation_balances(cfg: SpecConfig, state
         active = (activation <= epoch) & (epoch < exit_epoch)
         mask = active & ~slashed & (
             (part >> TIMELY_TARGET_FLAG_INDEX) & 1 == 1)
-        out.append(max(inc, int(eb[mask].sum())))
+        out.append(max(inc, _checked_sum(eb[mask])))
     return out[0], out[1]

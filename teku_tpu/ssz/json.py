@@ -1,0 +1,60 @@
+"""Schema-driven SSZ <-> standard-API JSON conversion.
+
+One generic walk for every consensus container (string decimals,
+0x-hex bytes, hex-encoded SSZ bitfields) — the role of the reference's
+SerializableTypeDefinition layer (data/serializer +
+ethereum/json-types) without per-type hand coding.  Shared by the REST
+API handlers and the Web3Signer request bodies.
+"""
+
+from .types import (BitlistType, BitvectorType, ByteListType,
+                    ByteVectorType, Container, ListType, UIntType,
+                    VectorType, _ContainerSchemaAdapter)
+
+
+def _hex(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def ssz_to_json(schema, value):
+    """SSZ value -> JSON-able object, driven by its schema."""
+    if isinstance(schema, _ContainerSchemaAdapter):
+        schema = schema.cls
+    if isinstance(schema, type) and issubclass(schema, Container):
+        return {name: ssz_to_json(s, getattr(value, name))
+                for name, s in schema._ssz_fields.items()}
+    if isinstance(schema, UIntType):
+        return str(value)
+    if isinstance(schema, (ByteVectorType, ByteListType)):
+        return _hex(value)
+    if isinstance(schema, (BitlistType, BitvectorType)):
+        return _hex(schema.serialize(value))
+    if isinstance(schema, (ListType, VectorType)):
+        return [ssz_to_json(schema.elem, v) for v in value]
+    if schema.__class__.__name__ == "BooleanType":
+        return bool(value)
+    return value
+
+
+def ssz_from_json(schema, data):
+    """Inverse of ssz_to_json; raises ValueError/KeyError/TypeError on
+    shape mismatches (REST callers map those to HTTP 400)."""
+    if isinstance(schema, _ContainerSchemaAdapter):
+        schema = schema.cls
+    if isinstance(schema, type) and issubclass(schema, Container):
+        if not isinstance(data, dict):
+            raise ValueError(f"expected object for {schema.__name__}")
+        return schema(**{name: ssz_from_json(s, data[name])
+                         for name, s in schema._ssz_fields.items()})
+    if isinstance(schema, UIntType):
+        return int(data)
+    if isinstance(schema, (ByteVectorType, ByteListType)):
+        return bytes.fromhex(str(data).removeprefix("0x"))
+    if isinstance(schema, (BitlistType, BitvectorType)):
+        return schema.deserialize(
+            bytes.fromhex(str(data).removeprefix("0x")))
+    if isinstance(schema, (ListType, VectorType)):
+        return tuple(ssz_from_json(schema.elem, v) for v in data)
+    if schema.__class__.__name__ == "BooleanType":
+        return bool(data)
+    return data

@@ -256,6 +256,10 @@ class BeaconNodeValidatorApi(ValidatorApiChannel):
                 cfg.MAX_ATTESTER_SLASHINGS, pre),
             voluntary_exits=pools["voluntary_exits"].get_for_block(
                 cfg.MAX_VOLUNTARY_EXITS, pre),
+            bls_to_execution_changes=(
+                pools["bls_to_execution_changes"].get_for_block(
+                    cfg.MAX_BLS_TO_EXECUTION_CHANGES, pre)
+                if hasattr(pre, "next_withdrawal_index") else ()),
             graffiti=graffiti, sync_aggregate=sync_aggregate)
         if commitments:
             # keyed by body root: the signed envelope isn't known yet

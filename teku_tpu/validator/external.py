@@ -46,14 +46,21 @@ class ExternalSigner(DutySigner):
         self.verify = verify
 
     # -- HTTP ----------------------------------------------------------
-    def _sign(self, validator_index: int, root: bytes,
-              duty_type: str) -> bytes:
+    def _sign(self, validator_index: int, root: bytes, duty_type: str,
+              extra: Optional[Dict] = None) -> bytes:
         pubkey = self.pubkeys.get(validator_index)
         if pubkey is None:
             raise SigningError(f"no pubkey for validator "
                                f"{validator_index}")
-        body = json.dumps({"type": duty_type,
-                           "signingRoot": "0x" + root.hex()}).encode()
+        # a conforming Web3Signer requires fork_info + the typed duty
+        # payload (it reads the slot/epoch for its own slashing
+        # protection); signingRoot alone is rejected (reference:
+        # ExternalSigner.java request bodies)
+        payload = {"type": duty_type,
+                   "signingRoot": "0x" + root.hex()}
+        if extra:
+            payload.update(extra)
+        body = json.dumps(payload).encode()
         req = urllib.request.Request(
             f"{self.base}/api/v1/eth2/sign/0x{pubkey.hex()}",
             data=body, method="POST",
@@ -106,9 +113,17 @@ class ExternalSigner(DutySigner):
     def sign_block(self, cfg: SpecConfig, state, block) -> bytes:
         domain = H.get_domain(cfg, state, DOMAIN_BEACON_PROPOSER,
                               H.compute_epoch_at_slot(cfg, block.slot))
-        return self._sign(block.proposer_index,
-                          H.compute_signing_root(block, domain),
-                          "BLOCK_V2")
+        header = {"slot": str(block.slot),
+                  "proposer_index": str(block.proposer_index),
+                  "parent_root": _hex(block.parent_root),
+                  "state_root": _hex(block.state_root),
+                  "body_root": _hex(block.body.htr())}
+        return self._sign(
+            block.proposer_index,
+            H.compute_signing_root(block, domain), "BLOCK_V2",
+            {"fork_info": _fork_info(state),
+             "beacon_block": {"version": _milestone_name(cfg, block.slot),
+                              "block_header": header}})
 
     def sign_attestation_data(self, cfg, state, data,
                               validator_index) -> bytes:
@@ -116,13 +131,17 @@ class ExternalSigner(DutySigner):
                               data.target.epoch)
         return self._sign(validator_index,
                           H.compute_signing_root(data, domain),
-                          "ATTESTATION")
+                          "ATTESTATION",
+                          {"fork_info": _fork_info(state),
+                           "attestation": _container_json(data)})
 
     def sign_randao_reveal(self, cfg, state, epoch,
                            validator_index) -> bytes:
         return self._sign(validator_index,
                           H.randao_signing_root(cfg, state, epoch),
-                          "RANDAO_REVEAL")
+                          "RANDAO_REVEAL",
+                          {"fork_info": _fork_info(state),
+                           "randao_reveal": {"epoch": str(epoch)}})
 
     def sign_aggregate_and_proof(self, cfg, state, msg) -> bytes:
         domain = H.get_domain(
@@ -130,14 +149,18 @@ class ExternalSigner(DutySigner):
             H.compute_epoch_at_slot(cfg, msg.aggregate.data.slot))
         return self._sign(msg.aggregator_index,
                           H.compute_signing_root(msg, domain),
-                          "AGGREGATE_AND_PROOF")
+                          "AGGREGATE_AND_PROOF",
+                          {"fork_info": _fork_info(state),
+                           "aggregate_and_proof": _container_json(msg)})
 
     def sign_selection_proof(self, cfg, state, slot,
                              validator_index) -> bytes:
         return self._sign(
             validator_index,
             H.selection_proof_signing_root(cfg, state, slot),
-            "AGGREGATION_SLOT")
+            "AGGREGATION_SLOT",
+            {"fork_info": _fork_info(state),
+             "aggregation_slot": {"slot": str(slot)}})
 
     def sign_sync_committee_message(self, cfg, state, slot, block_root,
                                     validator_index) -> bytes:
@@ -145,7 +168,11 @@ class ExternalSigner(DutySigner):
         return self._sign(validator_index,
                           sync_message_signing_root(cfg, state, slot,
                                                     block_root),
-                          "SYNC_COMMITTEE_MESSAGE")
+                          "SYNC_COMMITTEE_MESSAGE",
+                          {"fork_info": _fork_info(state),
+                           "sync_committee_message": {
+                               "beacon_block_root": _hex(block_root),
+                               "slot": str(slot)}})
 
     def sign_sync_selection_proof(self, cfg, state, slot,
                                   subcommittee_index,
@@ -156,7 +183,11 @@ class ExternalSigner(DutySigner):
             validator_index,
             sync_selection_proof_signing_root(cfg, state, slot,
                                               subcommittee_index),
-            "SYNC_COMMITTEE_SELECTION_PROOF")
+            "SYNC_COMMITTEE_SELECTION_PROOF",
+            {"fork_info": _fork_info(state),
+             "sync_aggregator_selection_data": {
+                 "slot": str(slot),
+                 "subcommittee_index": str(subcommittee_index)}})
 
     def sign_contribution_and_proof(self, cfg, state, msg) -> bytes:
         from ..spec.altair.helpers import (
@@ -164,7 +195,43 @@ class ExternalSigner(DutySigner):
         return self._sign(
             msg.aggregator_index,
             contribution_and_proof_signing_root(cfg, state, msg),
-            "SYNC_COMMITTEE_CONTRIBUTION_AND_PROOF")
+            "SYNC_COMMITTEE_CONTRIBUTION_AND_PROOF",
+            {"fork_info": _fork_info(state),
+             "contribution_and_proof": _container_json(msg)})
+
+
+def _hex(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def _container_json(obj):
+    """SSZ container -> Web3Signer JSON shape: the schema-driven walk
+    (bitfields MUST serialize as hex strings, not bool arrays — a
+    conforming Web3Signer rejects the latter)."""
+    from ..ssz.json import ssz_to_json
+    return ssz_to_json(type(obj), obj)
+
+
+def _fork_info(state) -> Dict:
+    f = state.fork
+    return {"fork": {"previous_version": _hex(f.previous_version),
+                     "current_version": _hex(f.current_version),
+                     "epoch": str(f.epoch)},
+            "genesis_validators_root":
+                _hex(state.genesis_validators_root)}
+
+
+def _milestone_name(cfg, slot) -> str:
+    epoch = H.compute_epoch_at_slot(cfg, slot)
+    names = (("ELECTRA_FORK_EPOCH", "ELECTRA"),
+             ("DENEB_FORK_EPOCH", "DENEB"),
+             ("CAPELLA_FORK_EPOCH", "CAPELLA"),
+             ("BELLATRIX_FORK_EPOCH", "BELLATRIX"),
+             ("ALTAIR_FORK_EPOCH", "ALTAIR"))
+    for attr, name in names:
+        if epoch >= getattr(cfg, attr, 2 ** 63):
+            return name
+    return "PHASE0"
 
 
 class FailoverError(Exception):

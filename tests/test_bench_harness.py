@@ -21,7 +21,7 @@ import bench  # noqa: E402
 
 def test_probe_kills_hung_backend_within_deadline():
     t0 = time.time()
-    platform, why = bench._probe_backend(
+    platform, why, _err = bench._probe_backend(
         1.5, code="import time\ntime.sleep(600)\n")
     elapsed = time.time() - t0
     assert platform is None
@@ -30,10 +30,12 @@ def test_probe_kills_hung_backend_within_deadline():
 
 
 def test_probe_reports_crash_and_garbage():
-    platform, why = bench._probe_backend(
-        30, code="import sys\nsys.exit(3)\n")
+    platform, why, err = bench._probe_backend(
+        30, code="import sys\nsys.stderr.write('boom trace')\n"
+                 "sys.exit(3)\n")
     assert platform is None and "rc=3" in why
-    platform, why = bench._probe_backend(
+    assert "boom trace" in err   # child stderr is evidence, not lost
+    platform, why, _err = bench._probe_backend(
         30, code="print('not json')\n")
     assert platform is None and "garbage" in why
 
@@ -42,9 +44,43 @@ def test_probe_parses_healthy_backend():
     code = ("import json\n"
             "print(json.dumps({'platform': 'tpu', "
             "'device': 'TPU_0(process=0,(0,0,0,0))'}))\n")
-    platform, device = bench._probe_backend(30, code=code)
+    platform, device, _err = bench._probe_backend(30, code=code)
     assert platform == "tpu"
     assert device.startswith("TPU_0")
+
+
+def test_probe_retries_until_success(monkeypatch):
+    """Round 4 gave up after ONE probe; the retry loop must try again
+    within budget and report each failure's stderr to the heartbeat."""
+    calls = []
+
+    def fake_probe(timeout_s, code=None):
+        calls.append(timeout_s)
+        if len(calls) < 2:
+            return None, "probe timeout after 1s", "tunnel stderr tail"
+        return "tpu", "TPU_0", ""
+
+    monkeypatch.setattr(bench, "_probe_backend", fake_probe)
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT_S", "1")
+    monkeypatch.setenv("BENCH_PROBE_ATTEMPTS", "3")
+    platform, device = bench._probe_with_retries(time.time() + 3600)
+    assert platform == "tpu" and device == "TPU_0"
+    assert len(calls) == 2
+
+
+def test_probe_retries_respect_budget(monkeypatch):
+    """With <90s remaining no further probe attempt may start."""
+    calls = []
+
+    def fake_probe(timeout_s, code=None):
+        calls.append(timeout_s)
+        return None, "probe timeout", ""
+
+    monkeypatch.setattr(bench, "_probe_backend", fake_probe)
+    monkeypatch.setenv("BENCH_PROBE_ATTEMPTS", "5")
+    platform, why = bench._probe_with_retries(time.time() + 60)
+    assert platform is None
+    assert calls == []           # budget already too thin to probe
 
 
 def test_heartbeat_file_records_stages(tmp_path, monkeypatch):

@@ -1,0 +1,91 @@
+"""Network configuration bundles (spec/networks.py).
+
+Fork digests are asserted against the PUBLICLY KNOWN mainnet constants
+(the values every consensus client advertises on its gossip topics) —
+the same check the reference encodes in its bundled network configs
+(ethereum/networks/src/main/resources/, Eth2NetworkConfiguration.java).
+"""
+
+import pytest
+
+from teku_tpu.spec import create_spec
+from teku_tpu.spec import helpers as H
+from teku_tpu.spec.networks import BUNDLES, get_bundle
+
+
+def test_mainnet_fork_digests_match_known_constants():
+    b = get_bundle("mainnet")
+    gvr = b.genesis_validators_root
+    cfg = b.config
+    # genesis (phase0) fork digest on mainnet gossip: 0xb5303f2a
+    assert H.compute_fork_digest(cfg.GENESIS_FORK_VERSION,
+                                 gvr).hex() == "b5303f2a"
+    # capella: 0xbba4da96; deneb: 0x6a95a1a9 (public topic constants)
+    assert H.compute_fork_digest(cfg.CAPELLA_FORK_VERSION,
+                                 gvr).hex() == "bba4da96"
+    assert H.compute_fork_digest(cfg.DENEB_FORK_VERSION,
+                                 gvr).hex() == "6a95a1a9"
+
+
+def test_mainnet_fork_schedule():
+    cfg = get_bundle("mainnet").config
+    assert cfg.ALTAIR_FORK_EPOCH == 74240
+    assert cfg.BELLATRIX_FORK_EPOCH == 144896
+    assert cfg.CAPELLA_FORK_EPOCH == 194048
+    assert cfg.DENEB_FORK_EPOCH == 269568
+    assert cfg.ELECTRA_FORK_EPOCH == 364032
+    spec = create_spec("mainnet")
+    # milestone routing uses the real schedule
+    assert spec.milestone_at_slot(0).name == "PHASE0"
+    assert spec.milestone_at_slot(194048 * 32).name == "CAPELLA"
+    assert spec.milestone_at_slot(364032 * 32).name == "ELECTRA"
+
+
+@pytest.mark.parametrize("name", ["sepolia", "holesky", "gnosis"])
+def test_testnet_bundles_are_coherent(name):
+    b = get_bundle(name)
+    cfg = b.config
+    # fork versions are distinct and network-scoped
+    versions = [cfg.GENESIS_FORK_VERSION, cfg.ALTAIR_FORK_VERSION,
+                cfg.BELLATRIX_FORK_VERSION, cfg.CAPELLA_FORK_VERSION,
+                cfg.DENEB_FORK_VERSION]
+    assert len(set(versions)) == len(versions)
+    # schedule is monotone
+    epochs = [cfg.ALTAIR_FORK_EPOCH, cfg.BELLATRIX_FORK_EPOCH,
+              cfg.CAPELLA_FORK_EPOCH, cfg.DENEB_FORK_EPOCH]
+    assert epochs == sorted(epochs)
+    assert b.deposit_contract is not None \
+        and len(b.deposit_contract) == 20
+    assert b.genesis_validators_root is not None \
+        and len(b.genesis_validators_root) == 32
+    assert b.checkpoint_sync_urls
+    # create_spec resolves the bundle
+    spec = create_spec(name)
+    assert spec.config.config_name == name
+
+
+def test_sepolia_identity():
+    cfg = get_bundle("sepolia").config
+    assert cfg.DEPOSIT_CHAIN_ID == 11155111
+    assert cfg.GENESIS_FORK_VERSION == bytes.fromhex("90000069")
+    assert cfg.ELECTRA_FORK_EPOCH == 222464
+
+
+def test_holesky_identity():
+    cfg = get_bundle("holesky").config
+    assert cfg.DEPOSIT_CHAIN_ID == 17000
+    assert cfg.ALTAIR_FORK_EPOCH == 0 and cfg.BELLATRIX_FORK_EPOCH == 0
+    assert cfg.EJECTION_BALANCE == 28 * 10 ** 9
+
+
+def test_gnosis_identity():
+    cfg = get_bundle("gnosis").config
+    assert cfg.SECONDS_PER_SLOT == 5 and cfg.SLOTS_PER_EPOCH == 16
+    assert cfg.DEPOSIT_CHAIN_ID == 100
+    assert cfg.preset_name == "gnosis"
+
+
+def test_unknown_network_rejected():
+    with pytest.raises(ValueError):
+        get_bundle("nosuchnet")
+    assert "minimal" in BUNDLES and "mainnet-preset" in BUNDLES

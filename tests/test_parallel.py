@@ -1,0 +1,55 @@
+"""teku_tpu.parallel: mesh construction + sharded provider dispatch.
+
+The multi-chip story end to end: JaxBls12381(mesh=...) routes its
+batched dispatches through the shard_map kernel over the 8-virtual-
+device CPU mesh (production: ICI), and the verdicts match the
+single-chip provider.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from teku_tpu import parallel
+from teku_tpu.crypto import bls
+from teku_tpu.crypto.bls import keygen
+from teku_tpu.crypto.bls.pure_impl import PureBls12381
+from teku_tpu.ops.provider import JaxBls12381
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices (see conftest XLA_FLAGS)")
+    m = parallel.make_mesh(8)
+    with m:
+        yield m
+
+
+def test_make_mesh_validates_device_count():
+    with pytest.raises(ValueError):
+        parallel.make_mesh(10 ** 6)
+
+
+def test_sharded_verifier_bucket_rule(mesh):
+    v = parallel.ShardedVerifier(mesh, min_bucket=4)
+    assert v.n_devices == 8
+    assert v.min_bucket == 8          # raised to the mesh size
+
+
+@pytest.mark.slow
+def test_sharded_provider_matches_single_chip(mesh):
+    pure = PureBls12381()
+    sks = [keygen(bytes([i + 1]) * 32) for i in range(8)]
+    pks = [pure.secret_key_to_public_key(sk) for sk in sks]
+    msgs = [b"shard-%d" % i for i in range(8)]
+    sigs = [pure.sign(sk, m) for sk, m in zip(sks, msgs)]
+    triples = [([pk], m, s) for pk, m, s in zip(pks, msgs, sigs)]
+
+    impl = JaxBls12381(mesh=mesh)
+    assert impl._sharded is not None
+    assert impl.batch_verify(triples)
+    bad = list(triples)
+    bad[3] = ([pks[3]], b"tampered", sigs[3])
+    assert not impl.batch_verify(bad)
