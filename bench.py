@@ -399,7 +399,7 @@ def _epoch_transition_phase(deadline):
     from teku_tpu.spec import perf as P
     from teku_tpu.spec.altair import epoch as AE
 
-    n = int(os.environ.get("BENCH_EPOCH_VALIDATORS", "100000"))
+    n = int(os.environ.get("BENCH_EPOCH_VALIDATORS", "300000"))
     cfg = P.perf_config()
     _beat("epoch_phase_start", validators=n)
     state = P.make_synthetic_altair_state(cfg, n)
@@ -418,6 +418,23 @@ def _epoch_transition_phase(deadline):
         OUT["epoch_transition_validators"] = n
         OUT["epoch_transition_runs"] = runs
         _beat("epoch_phase_done", ms=round(best, 1))
+    # the latest fork's epoch transition (pending queues, compounding
+    # credentials) on the same registry size
+    if time.time() < deadline:
+        from teku_tpu.spec.electra import epoch as EE
+        cfg_e = P.perf_config_electra()
+        state_e = P.make_synthetic_electra_state(cfg_e, n)
+        best_e = None
+        for _ in range(2):
+            if time.time() > deadline:
+                break
+            t0 = time.time()
+            EE.process_epoch(cfg_e, state_e)
+            best_e = ((time.time() - t0) * 1e3 if best_e is None
+                      else min(best_e, (time.time() - t0) * 1e3))
+        if best_e is not None:
+            OUT["epoch_transition_electra_ms"] = round(best_e, 1)
+            _beat("epoch_electra_done", ms=round(best_e, 1))
 
 
 def _kzg_phase(deadline):

@@ -203,7 +203,10 @@ class BeaconRestApi(RestApi):
         if manager is not None:
             for subnet in manager.active_subnets():
                 attnets[subnet // 8] |= 1 << (subnet % 8)
-        return {"data": {"peer_id": node_id, "enr": "",
+        enr = getattr(self.networked, "enr", None) \
+            if self.networked else None
+        return {"data": {"peer_id": node_id,
+                         "enr": enr.to_text() if enr else "",
                          "p2p_addresses": [], "metadata": {
                              "seq_number": "0",
                              "attnets": "0x" + bytes(attnets).hex()}}}
@@ -1088,9 +1091,19 @@ class BeaconRestApi(RestApi):
         state = await self._resolve_state_async(state_id)
         if not hasattr(state, "next_withdrawal_index"):
             raise HttpError(400, "pre-capella state has no withdrawals")
-        slot = int(query["proposal_slot"]) if query \
-            and query.get("proposal_slot") else state.slot + 1
         cfg = self.node.spec.config
+        try:
+            slot = int(query["proposal_slot"]) if query \
+                and query.get("proposal_slot") else state.slot + 1
+        except (ValueError, TypeError):
+            raise HttpError(400, "invalid proposal_slot")
+        # the advance is client-controlled work on the event loop:
+        # bound it to one epoch ahead (the reference's handler serves
+        # proposal lookahead, not arbitrary time travel)
+        if not (state.slot <= slot
+                <= state.slot + cfg.SLOTS_PER_EPOCH):
+            raise HttpError(400, "proposal_slot out of range "
+                                 "(within one epoch of the state)")
         from ..spec.transition import process_slots
         if state.slot < slot:
             state = process_slots(cfg, state, slot)

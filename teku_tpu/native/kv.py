@@ -74,8 +74,10 @@ class _NativeKv(KvStore):
         out_len = ctypes.c_uint32()
         rc = self._lib.kv_get(self._h, key, len(key),
                               ctypes.byref(out), ctypes.byref(out_len))
-        if rc != 0:
+        if rc == 1:
             return None
+        if rc != 0:
+            raise OSError("kv_get: log read failed")
         try:
             return ctypes.string_at(out, out_len.value)
         finally:

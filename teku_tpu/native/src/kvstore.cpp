@@ -1,12 +1,18 @@
-// Embedded append-log KV engine with an in-memory index.
+// Embedded append-log KV engine with a memory-bounded index.
 //
 // The storage backend role RocksDB/LevelDB play for the reference
 // (reference: storage/src/main/java/tech/pegasys/teku/storage/server/
 // kvstore/ + rocksdbjni/leveldb-native deps in gradle/versions.gradle):
-// a write-ahead append log replayed into a std::map on open, explicit
-// flush (fsync), and compaction that rewrites the live set.  Record
-// framing is CRC-checked so a torn tail write is truncated, not
-// propagated.
+// a write-ahead append log, explicit flush (fsync), and compaction that
+// rewrites the live set.  Record framing is CRC-checked so a torn tail
+// write is truncated, not propagated.
+//
+// MEMORY MODEL: the in-memory index maps key -> (offset, length) of the
+// value INSIDE the log; values themselves stay on disk and are read
+// back on demand.  RSS is bounded by the live KEY set (an archive-mode
+// chain where multi-megabyte states dominate the data keeps a flat
+// footprint as the DB grows); the log replay on open rebuilds only the
+// offset table, never materializes values.
 //
 // C ABI kept dumb-simple for ctypes: byte buffers + lengths, caller
 // frees returned buffers via kv_free.
@@ -17,10 +23,12 @@
 #include <cstdlib>
 #include <cstring>
 #include <map>
+#include <mutex>
 #include <string>
 #include <vector>
 
 #ifndef _WIN32
+#include <fcntl.h>
 #include <unistd.h>
 #endif
 
@@ -47,10 +55,21 @@ uint32_t crc32(const uint8_t* p, size_t n, uint32_t seed = 0) {
   return c ^ 0xFFFFFFFFu;
 }
 
+struct ValueRef {
+  uint64_t off = 0;   // byte offset of the value bytes in the log
+  uint32_t len = 0;
+};
+
 struct Store {
   std::string path;
-  FILE* log = nullptr;
-  std::map<std::string, std::string> index;
+  FILE* log = nullptr;     // append handle
+  int read_fd = -1;        // independent read descriptor (pread)
+  uint64_t end_off = 0;    // logical end of the log (append cursor)
+  bool dirty = false;      // appended since last fflush
+  bool broken = false;     // append desync: refuse further writes
+  std::mutex mu;           // ctypes releases the GIL: REST executor
+                           // threads read while the loop thread writes
+  std::map<std::string, ValueRef> index;
 };
 
 constexpr uint8_t OP_PUT = 1;
@@ -58,26 +77,43 @@ constexpr uint8_t OP_DEL = 2;
 
 // record: u8 op | u32 klen | u32 vlen | key | value | u32 crc(all prior)
 bool append_record(Store* s, uint8_t op, const std::string& k,
-                   const std::string& v) {
+                   const uint8_t* v, uint32_t vlen) {
   std::vector<uint8_t> buf;
-  buf.reserve(9 + k.size() + v.size() + 4);
+  buf.reserve(9 + k.size() + vlen + 4);
   buf.push_back(op);
-  uint32_t klen = (uint32_t)k.size(), vlen = (uint32_t)v.size();
+  uint32_t klen = (uint32_t)k.size();
   const uint8_t* kp = (const uint8_t*)&klen;
   const uint8_t* vp = (const uint8_t*)&vlen;
   buf.insert(buf.end(), kp, kp + 4);
   buf.insert(buf.end(), vp, vp + 4);
   buf.insert(buf.end(), k.begin(), k.end());
-  buf.insert(buf.end(), v.begin(), v.end());
+  if (vlen) buf.insert(buf.end(), v, v + vlen);
   uint32_t crc = crc32(buf.data(), buf.size());
   const uint8_t* cp = (const uint8_t*)&crc;
   buf.insert(buf.end(), cp, cp + 4);
-  return fwrite(buf.data(), 1, buf.size(), s->log) == buf.size();
+  if (fwrite(buf.data(), 1, buf.size(), s->log) != buf.size()) {
+    // a partial record would desync every future offset: try to cut
+    // the torn tail; if that fails the handle is permanently
+    // read-only (reads of already-indexed offsets stay valid)
+    fflush(s->log);
+#ifndef _WIN32
+    if (ftruncate(fileno(s->log), (off_t)s->end_off) != 0)
+      s->broken = true;
+#else
+    s->broken = true;
+#endif
+    return false;
+  }
+  s->end_off += buf.size();
+  s->dirty = true;
+  return true;
 }
 
-// replay; returns the byte offset of the last VALID record end
-long replay(Store* s, FILE* f) {
-  long good_end = 0;
+// replay into the offset index (values are skipped, not loaded);
+// returns the byte offset of the last VALID record end
+uint64_t replay(Store* s, FILE* f) {
+  uint64_t good_end = 0;
+  uint64_t pos = 0;
   for (;;) {
     uint8_t head[9];
     if (fread(head, 1, 9, f) != 9) break;
@@ -96,13 +132,38 @@ long replay(Store* s, FILE* f) {
     memcpy(&want, body.data() + klen + vlen, 4);
     if (crc32(all.data(), all.size()) != want) break;  // torn tail
     std::string key((char*)body.data(), klen);
-    if (op == OP_PUT)
-      s->index[key] = std::string((char*)body.data() + klen, vlen);
-    else
+    if (op == OP_PUT) {
+      ValueRef ref;
+      ref.off = pos + 9 + klen;
+      ref.len = vlen;
+      s->index[key] = ref;
+    } else {
       s->index.erase(key);
-    good_end = ftell(f);
+    }
+    pos += 9 + body.size();
+    good_end = pos;
   }
   return good_end;
+}
+
+bool read_value(Store* s, const ValueRef& ref, uint8_t* out) {
+  // caller holds s->mu
+  if (s->dirty) {           // buffered appends must be visible to reads
+    fflush(s->log);
+    s->dirty = false;
+  }
+#ifndef _WIN32
+  size_t got = 0;
+  while (got < ref.len) {
+    ssize_t n = pread(s->read_fd, out + got, ref.len - got,
+                      (off_t)(ref.off + got));
+    if (n <= 0) return false;
+    got += (size_t)n;
+  }
+  return true;
+#else
+  return false;
+#endif
 }
 
 }  // namespace
@@ -114,23 +175,29 @@ void* kv_open(const char* path) {
   s->path = path;
   FILE* f = fopen(path, "rb");
   if (f) {
-    long good = replay(s, f);
+    uint64_t good = replay(s, f);
+    fseek(f, 0, SEEK_END);
+    uint64_t full = (uint64_t)ftell(f);
     fclose(f);
     // truncate a torn tail so the next append starts clean
-    long full;
-    FILE* probe = fopen(path, "rb");
-    fseek(probe, 0, SEEK_END);
-    full = ftell(probe);
-    fclose(probe);
     if (good < full) {
-      if (truncate(path, good) != 0) {
+      if (truncate(path, (long)good) != 0) {
         delete s;
         return nullptr;
       }
     }
+    s->end_off = good;
   }
   s->log = fopen(path, "ab");
   if (!s->log) {
+    delete s;
+    return nullptr;
+  }
+#ifndef _WIN32
+  s->read_fd = open(path, O_RDONLY);
+#endif
+  if (s->read_fd < 0) {
+    fclose(s->log);
     delete s;
     return nullptr;
   }
@@ -140,40 +207,58 @@ void* kv_open(const char* path) {
 int kv_put(void* h, const uint8_t* k, uint32_t klen, const uint8_t* v,
            uint32_t vlen) {
   Store* s = (Store*)h;
-  std::string key((const char*)k, klen), val((const char*)v, vlen);
-  if (!append_record(s, OP_PUT, key, val)) return -1;
-  s->index[key] = std::move(val);
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (s->broken) return -1;
+  std::string key((const char*)k, klen);
+  ValueRef ref;
+  ref.off = s->end_off + 9 + klen;
+  ref.len = vlen;
+  if (!append_record(s, OP_PUT, key, v, vlen)) return -1;
+  s->index[key] = ref;
   return 0;
 }
 
 int kv_del(void* h, const uint8_t* k, uint32_t klen) {
   Store* s = (Store*)h;
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (s->broken) return -1;
   std::string key((const char*)k, klen);
   if (s->index.find(key) == s->index.end()) return 1;  // absent
-  if (!append_record(s, OP_DEL, key, "")) return -1;
+  if (!append_record(s, OP_DEL, key, nullptr, 0)) return -1;
   s->index.erase(key);
   return 0;
 }
 
-// returns 0 + malloc'd copy in *out; 1 if absent
+// returns 0 + malloc'd copy in *out; 1 if absent; -1 on read error
 int kv_get(void* h, const uint8_t* k, uint32_t klen, uint8_t** out,
            uint32_t* out_len) {
   Store* s = (Store*)h;
+  std::lock_guard<std::mutex> lock(s->mu);
   auto it = s->index.find(std::string((const char*)k, klen));
   if (it == s->index.end()) return 1;
-  *out_len = (uint32_t)it->second.size();
-  *out = (uint8_t*)malloc(it->second.size() ? it->second.size() : 1);
-  memcpy(*out, it->second.data(), it->second.size());
+  *out_len = it->second.len;
+  *out = (uint8_t*)malloc(it->second.len ? it->second.len : 1);
+  if (!read_value(s, it->second, *out)) {
+    free(*out);
+    *out = nullptr;
+    return -1;
+  }
   return 0;
 }
 
 void kv_free(uint8_t* p) { free(p); }
 
-uint64_t kv_count(void* h) { return ((Store*)h)->index.size(); }
+uint64_t kv_count(void* h) {
+  Store* s = (Store*)h;
+  std::lock_guard<std::mutex> lock(s->mu);
+  return s->index.size();
+}
 
 int kv_flush(void* h) {
   Store* s = (Store*)h;
+  std::lock_guard<std::mutex> lock(s->mu);
   if (fflush(s->log) != 0) return -1;
+  s->dirty = false;
 #ifndef _WIN32
   if (fsync(fileno(s->log)) != 0) return -1;
 #endif
@@ -184,6 +269,7 @@ int kv_flush(void* h) {
 int kv_keys(void* h, const uint8_t* prefix, uint32_t plen, uint8_t** out,
             uint64_t* out_len) {
   Store* s = (Store*)h;
+  std::lock_guard<std::mutex> lock(s->mu);
   std::string pre((const char*)prefix, plen);
   std::vector<uint8_t> buf;
   for (auto it = s->index.lower_bound(pre); it != s->index.end(); ++it) {
@@ -199,36 +285,79 @@ int kv_keys(void* h, const uint8_t* prefix, uint32_t plen, uint8_t** out,
   return 0;
 }
 
-// rewrite only the live set (drops overwritten/deleted records)
+// rewrite only the live set (drops overwritten/deleted records);
+// values stream through a bounded buffer, never all in memory at once
 int kv_compact(void* h) {
   Store* s = (Store*)h;
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (s->broken) return -1;
+  if (s->dirty) {
+    fflush(s->log);
+    s->dirty = false;
+  }
   std::string tmp = s->path + ".compact";
-  FILE* old = s->log;
   Store fresh;
   fresh.path = tmp;
   fresh.log = fopen(tmp.c_str(), "wb");
   if (!fresh.log) return -1;
-  for (auto& kvp : s->index)
-    if (!append_record(&fresh, OP_PUT, kvp.first, kvp.second)) {
+  std::map<std::string, ValueRef> new_index;
+  std::vector<uint8_t> val;
+  for (auto& kvp : s->index) {
+    val.resize(kvp.second.len);
+    if (!read_value(s, kvp.second, val.data())) {
       fclose(fresh.log);
+      remove(tmp.c_str());
       return -1;
     }
+    ValueRef ref;
+    ref.off = fresh.end_off + 9 + kvp.first.size();
+    ref.len = kvp.second.len;
+    if (!append_record(&fresh, OP_PUT, kvp.first, val.data(),
+                       kvp.second.len)) {
+      fclose(fresh.log);
+      remove(tmp.c_str());
+      return -1;
+    }
+    new_index[kvp.first] = ref;
+  }
   fflush(fresh.log);
 #ifndef _WIN32
   fsync(fileno(fresh.log));
 #endif
   fclose(fresh.log);
-  fclose(old);
-  if (rename(tmp.c_str(), s->path.c_str()) != 0) return -1;
+  if (rename(tmp.c_str(), s->path.c_str()) != 0) {
+    // ORIGINAL file is untouched: the open handles stay valid and the
+    // store keeps serving from the uncompacted log
+    remove(tmp.c_str());
+    return -1;
+  }
+  // the old handles now reference the unlinked inode: swap them for
+  // the compacted file before anything else can fail
+  fclose(s->log);
+#ifndef _WIN32
+  close(s->read_fd);
+  s->read_fd = open(s->path.c_str(), O_RDONLY);
+#endif
   s->log = fopen(s->path.c_str(), "ab");
-  return s->log ? 0 : -1;
+  if (!s->log || s->read_fd < 0) {
+    s->broken = true;                 // cannot write; reads unsafe too
+    return -1;
+  }
+  s->index = std::move(new_index);
+  s->end_off = fresh.end_off;
+  s->dirty = false;
+  return 0;
 }
 
 void kv_close(void* h) {
   Store* s = (Store*)h;
-  if (s->log) {
-    fflush(s->log);
-    fclose(s->log);
+  {
+    std::lock_guard<std::mutex> lock(s->mu);
+    if (s->log) {
+      fflush(s->log);
+      fclose(s->log);
+    }
+    if (s->read_fd >= 0) close(s->read_fd);
   }
   delete s;
 }

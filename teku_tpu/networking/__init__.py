@@ -40,6 +40,26 @@ class NetworkedNode:
         from .subnets import AttestationSubnetManager
         self.subnets = AttestationSubnetManager(spec.config,
                                                 self.net.node_id)
+        # spec node record (EIP-778, secp256k1 v4 identity) advertising
+        # the eth2 fork digest — what /eth/v1/node/identity publishes
+        # (reference: ENRs from DiscV5Service.java)
+        import secrets as _secrets
+        from . import secp256k1 as _ec
+        from .enr import Enr as _Enr
+        self._enr_secret = (int.from_bytes(_secrets.token_bytes(32),
+                                           "big") % _ec.N) or 1
+        # ENRForkID (p2p spec): fork_digest || next_fork_version ||
+        # next_fork_epoch, with next = current/FAR_FUTURE when no fork
+        # is scheduled — anything else makes conformant peers treat us
+        # as on an incompatible fork
+        from ..spec.config import FAR_FUTURE_EPOCH
+        enr_fork_id = (digest + spec.config.GENESIS_FORK_VERSION
+                       + FAR_FUTURE_EPOCH.to_bytes(8, "little"))
+        self.enr = _Enr.create(
+            self._enr_secret, seq=1, ip=host if host[0].isdigit()
+            else "127.0.0.1",
+            udp=udp_discovery_port or 0,
+            extra={"eth2": enr_fork_id, "attnets": bytes(8)})
         # expire duty-driven subnet windows with the chain clock (the
         # manager's active set also feeds /eth/v1/node/identity
         # attnets); the manager itself satisfies the channel's on_slot

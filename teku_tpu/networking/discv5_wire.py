@@ -1,0 +1,403 @@
+"""discv5 v5.1 wire protocol: packet masking, WHOAREYOU handshake,
+session keys, and the PING/PONG/FINDNODE/NODES message codec.
+
+The spec wire format of Ethereum's discovery layer (reference:
+networking/p2p/.../discovery/discv5/DiscV5Service.java delegates to
+the discovery library; this module implements the protocol itself):
+
+  packet        = masking-iv || masked(header) || message
+  masked(x)     = AES-128-CTR(key=dest-node-id[:16], iv=masking-iv, x)
+  header        = "discv5" || 0x0001 || flag || nonce(12) || authdata-size
+  message       = AES-128-GCM(session-key, nonce, type||RLP,
+                              ad=masking-iv||header)
+
+Flags: 0 ordinary, 1 WHOAREYOU (authdata = id-nonce || enr-seq),
+2 handshake (authdata = src-id || sig-size || eph-key-size ||
+id-signature || eph-pubkey || [record]).  Session keys derive from
+ECDH over secp256k1 via HKDF-SHA256 with the WHOAREYOU challenge data
+as salt; the id-signature proves the static identity over
+sha256("discovery v5 identity proof" || challenge-data ||
+eph-pubkey || dest-node-id).
+
+Messages: PING(0x01) PONG(0x02) FINDNODE(0x03) NODES(0x04), RLP
+bodies per the spec.
+"""
+
+import hashlib
+import hmac
+import os
+import secrets
+from typing import Dict, List, Optional, Tuple
+
+from . import rlp, secp256k1 as EC
+from .enr import Enr
+
+PROTOCOL_ID = b"discv5"
+VERSION = b"\x00\x01"
+FLAG_MESSAGE = 0
+FLAG_WHOAREYOU = 1
+FLAG_HANDSHAKE = 2
+
+ID_SIGNATURE_TEXT = b"discovery v5 identity proof"
+KDF_INFO = b"discovery v5 key agreement"
+
+MSG_PING = 0x01
+MSG_PONG = 0x02
+MSG_FINDNODE = 0x03
+MSG_NODES = 0x04
+
+
+class WireError(ValueError):
+    pass
+
+
+def _aes_ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
+    from cryptography.hazmat.primitives.ciphers import (Cipher,
+                                                        algorithms,
+                                                        modes)
+    enc = Cipher(algorithms.AES(key), modes.CTR(iv)).encryptor()
+    return enc.update(data) + enc.finalize()
+
+
+def _aes_gcm_encrypt(key: bytes, nonce: bytes, pt: bytes,
+                     ad: bytes) -> bytes:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    return AESGCM(key).encrypt(nonce, pt, ad)
+
+
+def _aes_gcm_decrypt(key: bytes, nonce: bytes, ct: bytes,
+                     ad: bytes) -> bytes:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    return AESGCM(key).decrypt(nonce, ct, ad)
+
+
+def _hkdf_extract_expand(salt: bytes, ikm: bytes, info: bytes,
+                         length: int) -> bytes:
+    prk = hmac.new(salt, ikm, hashlib.sha256).digest()
+    out = b""
+    block = b""
+    counter = 1
+    while len(out) < length:
+        block = hmac.new(prk, block + info + bytes([counter]),
+                         hashlib.sha256).digest()
+        out += block
+        counter += 1
+    return out[:length]
+
+
+# --------------------------------------------------------------------------
+# Header / packet codec
+# --------------------------------------------------------------------------
+
+def _build_header(flag: int, nonce: bytes, authdata: bytes) -> bytes:
+    return (PROTOCOL_ID + VERSION + bytes([flag]) + nonce
+            + len(authdata).to_bytes(2, "big") + authdata)
+
+
+def encode_packet(dest_node_id: bytes, flag: int, nonce: bytes,
+                  authdata: bytes, message: bytes = b"",
+                  masking_iv: Optional[bytes] = None) -> bytes:
+    header = _build_header(flag, nonce, authdata)
+    iv = masking_iv if masking_iv is not None else os.urandom(16)
+    return iv + _aes_ctr(dest_node_id[:16], iv, header) + message
+
+
+def decode_packet(local_node_id: bytes, datagram: bytes
+                  ) -> Tuple[int, bytes, bytes, bytes, bytes]:
+    """(flag, nonce, authdata, message_ciphertext, ad) — `ad` is the
+    AES-GCM associated data (masking-iv || unmasked header)."""
+    if len(datagram) < 16 + 23:
+        raise WireError("datagram too short")
+    iv = datagram[:16]
+    # unmask the static header first to learn the authdata size
+    static = _aes_ctr(local_node_id[:16], iv, datagram[16:16 + 23])
+    if static[:6] != PROTOCOL_ID or static[6:8] != VERSION:
+        raise WireError("bad protocol id")
+    flag = static[8]
+    nonce = static[9:21]
+    authdata_size = int.from_bytes(static[21:23], "big")
+    end = 16 + 23 + authdata_size
+    if len(datagram) < end:
+        raise WireError("truncated authdata")
+    # re-run the CTR stream over header+authdata in one pass
+    header = _aes_ctr(local_node_id[:16], iv,
+                      datagram[16:end])
+    authdata = header[23:]
+    return flag, nonce, authdata, datagram[end:], iv + header
+
+
+# --------------------------------------------------------------------------
+# WHOAREYOU + handshake
+# --------------------------------------------------------------------------
+
+def whoareyou_authdata(id_nonce: bytes, enr_seq: int) -> bytes:
+    return id_nonce + enr_seq.to_bytes(8, "big")
+
+
+def challenge_data(masking_iv: bytes, dest_node_id: bytes,
+                   nonce: bytes, authdata: bytes) -> bytes:
+    """masking-iv || static-header || authdata of the WHOAREYOU
+    packet, exactly as transmitted (pre-masking)."""
+    return masking_iv + _build_header(FLAG_WHOAREYOU, nonce, authdata)
+
+
+def derive_session_keys(ecdh_secret: bytes, node_id_a: bytes,
+                        node_id_b: bytes,
+                        challenge: bytes) -> Tuple[bytes, bytes]:
+    """(initiator_key, recipient_key) per the spec KDF."""
+    info = KDF_INFO + node_id_a + node_id_b
+    out = _hkdf_extract_expand(challenge, ecdh_secret, info, 32)
+    return out[:16], out[16:]
+
+
+def id_signature(static_secret: int, challenge: bytes,
+                 eph_pubkey: bytes, dest_node_id: bytes) -> bytes:
+    digest = hashlib.sha256(ID_SIGNATURE_TEXT + challenge + eph_pubkey
+                            + dest_node_id).digest()
+    return EC.sign(static_secret, digest)
+
+
+def verify_id_signature(signer_pub, challenge: bytes,
+                        eph_pubkey: bytes, dest_node_id: bytes,
+                        signature: bytes) -> bool:
+    digest = hashlib.sha256(ID_SIGNATURE_TEXT + challenge + eph_pubkey
+                            + dest_node_id).digest()
+    return EC.verify(signer_pub, digest, signature)
+
+
+def handshake_authdata(src_node_id: bytes, signature: bytes,
+                       eph_pubkey: bytes,
+                       record: Optional[bytes] = None) -> bytes:
+    return (src_node_id + bytes([len(signature)])
+            + bytes([len(eph_pubkey)]) + signature + eph_pubkey
+            + (record or b""))
+
+
+def parse_handshake_authdata(authdata: bytes
+                             ) -> Tuple[bytes, bytes, bytes,
+                                        Optional[bytes]]:
+    if len(authdata) < 34:
+        raise WireError("handshake authdata too short")
+    src_id = authdata[:32]
+    sig_size = authdata[32]
+    key_size = authdata[33]
+    need = 34 + sig_size + key_size
+    if len(authdata) < need:
+        raise WireError("truncated handshake authdata")
+    sig = authdata[34:34 + sig_size]
+    eph = authdata[34 + sig_size:need]
+    record = authdata[need:] or None
+    return src_id, sig, eph, record
+
+
+# --------------------------------------------------------------------------
+# Messages
+# --------------------------------------------------------------------------
+
+def encode_ping(request_id: bytes, enr_seq: int) -> bytes:
+    return bytes([MSG_PING]) + rlp.encode(
+        [request_id, rlp.encode_uint(enr_seq)])
+
+
+def encode_pong(request_id: bytes, enr_seq: int, ip: str,
+                port: int) -> bytes:
+    return bytes([MSG_PONG]) + rlp.encode(
+        [request_id, rlp.encode_uint(enr_seq),
+         bytes(int(p) for p in ip.split(".")),
+         rlp.encode_uint(port)])
+
+
+def encode_findnode(request_id: bytes, distances: List[int]) -> bytes:
+    return bytes([MSG_FINDNODE]) + rlp.encode(
+        [request_id, [rlp.encode_uint(d) for d in distances]])
+
+
+def encode_nodes(request_id: bytes, total: int,
+                 records: List[Enr]) -> bytes:
+    return bytes([MSG_NODES]) + rlp.encode(
+        [request_id, rlp.encode_uint(total),
+         [rlp.decode(r.to_rlp()) for r in records]])
+
+
+def decode_message(data: bytes):
+    """(type, decoded fields dict)."""
+    if not data:
+        raise WireError("empty message")
+    mtype = data[0]
+    body = rlp.decode(data[1:])
+    if not isinstance(body, list) or not body:
+        raise WireError("malformed message body")
+    if mtype == MSG_PING:
+        return mtype, {"request_id": body[0],
+                       "enr_seq": int.from_bytes(body[1], "big")}
+    if mtype == MSG_PONG:
+        return mtype, {"request_id": body[0],
+                       "enr_seq": int.from_bytes(body[1], "big"),
+                       "ip": ".".join(str(b) for b in body[2]),
+                       "port": int.from_bytes(body[3], "big")}
+    if mtype == MSG_FINDNODE:
+        return mtype, {"request_id": body[0],
+                       "distances": [int.from_bytes(d, "big")
+                                     for d in body[1]]}
+    if mtype == MSG_NODES:
+        records = []
+        for item in body[2]:
+            records.append(Enr.from_rlp(rlp.encode(item)))
+        return mtype, {"request_id": body[0],
+                       "total": int.from_bytes(body[1], "big"),
+                       "records": records}
+    raise WireError(f"unknown message type {mtype:#x}")
+
+
+def log2_distance(a: bytes, b: bytes) -> int:
+    x = int.from_bytes(a, "big") ^ int.from_bytes(b, "big")
+    return x.bit_length()
+
+
+# --------------------------------------------------------------------------
+# Protocol driver (session state machine)
+# --------------------------------------------------------------------------
+
+class Session:
+    __slots__ = ("send_key", "recv_key")
+
+    def __init__(self, send_key: bytes, recv_key: bytes):
+        self.send_key = send_key
+        self.recv_key = recv_key
+
+
+class Discv5Wire:
+    """Per-node protocol state: encode/decode datagrams, run the
+    WHOAREYOU handshake, manage sessions.  Transport-agnostic — the
+    caller moves datagrams (tests use real UDP sockets)."""
+
+    def __init__(self, secret: int, enr: Enr):
+        self.secret = secret
+        self.enr = enr
+        self.node_id = enr.node_id
+        self.sessions: Dict[bytes, Session] = {}
+        # nonce -> (dest_node_id, pending message plaintext)
+        self._awaiting_whoareyou: Dict[bytes, Tuple[bytes, bytes]] = {}
+        # node_id -> challenge data we issued
+        self._issued_challenges: Dict[bytes, bytes] = {}
+
+    # -- sending ------------------------------------------------------
+    def initial_packet(self, dest: Enr, message: bytes) -> bytes:
+        """First contact: an ordinary packet under a RANDOM key (the
+        recipient cannot decrypt and answers WHOAREYOU — spec
+        first-contact flow)."""
+        nonce = os.urandom(12)
+        self._awaiting_whoareyou[nonce] = (dest.node_id, message)
+        junk = os.urandom(max(len(message) + 16, 32))
+        return encode_packet(dest.node_id, FLAG_MESSAGE, nonce,
+                             self.node_id, junk)
+
+    def message_packet(self, dest_node_id: bytes,
+                       message: bytes) -> bytes:
+        session = self.sessions.get(dest_node_id)
+        if session is None:
+            raise WireError("no session with peer")
+        nonce = os.urandom(12)
+        iv = os.urandom(16)
+        header = _build_header(FLAG_MESSAGE, nonce, self.node_id)
+        ct = _aes_gcm_encrypt(session.send_key, nonce, message,
+                              iv + header)
+        return iv + _aes_ctr(dest_node_id[:16], iv, header) + ct
+
+    def whoareyou_packet(self, request_nonce: bytes, src_node_id: bytes,
+                         enr_seq: int = 0) -> bytes:
+        """Challenge an undecryptable packet; remembers the challenge
+        data for the handshake verification."""
+        id_nonce = os.urandom(16)
+        authdata = whoareyou_authdata(id_nonce, enr_seq)
+        iv = os.urandom(16)
+        self._issued_challenges[src_node_id] = challenge_data(
+            iv, src_node_id, request_nonce, authdata)
+        return encode_packet(src_node_id, FLAG_WHOAREYOU,
+                             request_nonce, authdata, b"",
+                             masking_iv=iv)
+
+    # -- receiving ----------------------------------------------------
+    def handle_datagram(self, datagram: bytes, peer_enr_hint=None):
+        """Returns one of:
+        ("whoareyou_needed", reply_datagram)    — first contact seen
+        ("handshake", reply_datagram)           — we must handshake
+        ("message", src_node_id, mtype, fields) — decrypted message
+        ("none", None)                          — dropped
+        `peer_enr_hint`: known Enr of the peer (needed to answer a
+        WHOAREYOU; real deployments look it up from the table)."""
+        flag, nonce, authdata, ct, ad = decode_packet(self.node_id,
+                                                      datagram)
+        if flag == FLAG_WHOAREYOU:
+            return self._on_whoareyou(nonce, authdata, ad,
+                                      peer_enr_hint)
+        if flag == FLAG_HANDSHAKE:
+            return self._on_handshake(nonce, authdata, ct, ad)
+        if flag == FLAG_MESSAGE:
+            src_id = authdata
+            if len(src_id) != 32:
+                raise WireError("bad ordinary authdata")
+            session = self.sessions.get(src_id)
+            if session is not None:
+                try:
+                    pt = _aes_gcm_decrypt(session.recv_key, nonce, ct,
+                                          ad)
+                    mtype, fields = decode_message(pt)
+                    return ("message", src_id, mtype, fields)
+                except Exception:
+                    pass            # stale keys: fall through, re-key
+            return ("whoareyou_needed",
+                    self.whoareyou_packet(nonce, src_id))
+        raise WireError(f"unknown flag {flag}")
+
+    def _on_whoareyou(self, nonce, authdata, ad, peer_enr):
+        pending = self._awaiting_whoareyou.pop(nonce, None)
+        if pending is None or peer_enr is None:
+            return ("none", None)
+        dest_node_id, message = pending
+        id_nonce, enr_seq = authdata[:16], authdata[16:24]
+        challenge = ad     # masking-iv || header, exactly as received
+        eph_secret = int.from_bytes(secrets.token_bytes(32), "big") \
+            % EC.N or 1
+        eph_pub = EC.compress(EC.pubkey(eph_secret))
+        ecdh_secret = EC.ecdh(eph_secret, peer_enr.public_key)
+        init_key, recp_key = derive_session_keys(
+            ecdh_secret, self.node_id, dest_node_id, challenge)
+        self.sessions[dest_node_id] = Session(send_key=init_key,
+                                              recv_key=recp_key)
+        sig = id_signature(self.secret, challenge, eph_pub,
+                           dest_node_id)
+        record = self.enr.to_rlp() \
+            if int.from_bytes(enr_seq, "big") < self.enr.seq else None
+        authdata_out = handshake_authdata(self.node_id, sig, eph_pub,
+                                          record)
+        out_nonce = os.urandom(12)
+        iv = os.urandom(16)
+        header = _build_header(FLAG_HANDSHAKE, out_nonce, authdata_out)
+        ct = _aes_gcm_encrypt(init_key, out_nonce, message,
+                              iv + header)
+        return ("handshake",
+                iv + _aes_ctr(dest_node_id[:16], iv, header) + ct)
+
+    def _on_handshake(self, nonce, authdata, ct, ad):
+        src_id, sig, eph_pub, record = parse_handshake_authdata(
+            authdata)
+        challenge = self._issued_challenges.pop(src_id, None)
+        if challenge is None:
+            return ("none", None)
+        peer_enr = Enr.from_rlp(record) if record else None
+        if peer_enr is None:
+            return ("none", None)   # no cached records in this driver
+        if peer_enr.node_id != src_id:
+            raise WireError("handshake record/node-id mismatch")
+        if not verify_id_signature(peer_enr.public_key, challenge,
+                                   eph_pub, self.node_id, sig):
+            raise WireError("bad id signature")
+        ecdh_secret = EC.ecdh(self.secret, EC.decompress(eph_pub))
+        init_key, recp_key = derive_session_keys(
+            ecdh_secret, src_id, self.node_id, challenge)
+        self.sessions[src_id] = Session(send_key=recp_key,
+                                        recv_key=init_key)
+        pt = _aes_gcm_decrypt(init_key, nonce, ct, ad)
+        mtype, fields = decode_message(pt)
+        return ("message", src_id, mtype, fields)

@@ -1,0 +1,143 @@
+"""Ethereum Node Records (EIP-778) with the v4 identity scheme.
+
+The spec-wire node identity the reference publishes via its discovery
+library (reference: networking/p2p/.../discovery/discv5/
+DiscV5Service.java — ENRs carry eth2 fork digest + attnets/syncnets):
+RLP [signature, seq, k, v, ...] with keys sorted, signed with
+secp256k1 over keccak256(content), node ID = keccak256(uncompressed
+pubkey).  Textual form enr:<base64url-unpadded>.
+
+Validated against the EIP-778 example record in tests (an
+independently-published vector — the closest thing to foreign-client
+interop available offline).
+"""
+
+import base64
+from typing import Dict, Optional, Tuple
+
+from . import rlp, secp256k1 as EC
+from .keccak import keccak256
+
+MAX_RECORD_SIZE = 300
+
+
+class EnrError(ValueError):
+    pass
+
+
+class Enr:
+    """Immutable decoded record."""
+
+    def __init__(self, seq: int, pairs: Dict[bytes, bytes],
+                 signature: bytes):
+        self.seq = seq
+        self.pairs = dict(pairs)
+        self.signature = signature
+
+    # -- content ------------------------------------------------------
+    def get(self, key: str) -> Optional[bytes]:
+        return self.pairs.get(key.encode())
+
+    @property
+    def public_key(self) -> Tuple[int, int]:
+        raw = self.get("secp256k1")
+        if raw is None:
+            raise EnrError("record has no secp256k1 key")
+        return EC.decompress(raw)
+
+    @property
+    def node_id(self) -> bytes:
+        return keccak256(EC.uncompressed_xy(self.public_key))
+
+    @property
+    def ip(self) -> Optional[str]:
+        raw = self.get("ip")
+        return ".".join(str(b) for b in raw) if raw else None
+
+    @property
+    def udp(self) -> Optional[int]:
+        raw = self.get("udp")
+        return int.from_bytes(raw, "big") if raw else None
+
+    # -- wire ---------------------------------------------------------
+    def _content(self) -> list:
+        items = [rlp.encode_uint(self.seq)]
+        for k in sorted(self.pairs):
+            items += [k, self.pairs[k]]
+        return items
+
+    def to_rlp(self) -> bytes:
+        out = rlp.encode([self.signature] + self._content())
+        if len(out) > MAX_RECORD_SIZE:
+            raise EnrError("record exceeds 300 bytes")
+        return out
+
+    def to_text(self) -> str:
+        return "enr:" + base64.urlsafe_b64encode(
+            self.to_rlp()).rstrip(b"=").decode()
+
+    def verify(self) -> bool:
+        if self.get("id") != b"v4":
+            return False
+        digest = keccak256(rlp.encode(self._content()))
+        try:
+            return EC.verify(self.public_key, digest, self.signature)
+        except (ValueError, EnrError):
+            return False
+
+    # -- constructors -------------------------------------------------
+    @classmethod
+    def create(cls, secret: int, seq: int = 1,
+               ip: Optional[str] = None, udp: Optional[int] = None,
+               extra: Optional[Dict[str, bytes]] = None) -> "Enr":
+        pairs: Dict[bytes, bytes] = {
+            b"id": b"v4",
+            b"secp256k1": EC.compress(EC.pubkey(secret)),
+        }
+        if ip is not None:
+            pairs[b"ip"] = bytes(int(p) for p in ip.split("."))
+        if udp is not None:
+            pairs[b"udp"] = udp.to_bytes(2, "big")
+        for k, v in (extra or {}).items():
+            pairs[k.encode()] = v
+        record = cls(seq, pairs, b"")
+        digest = keccak256(rlp.encode(record._content()))
+        record.signature = EC.sign(secret, digest)
+        return record
+
+    @classmethod
+    def from_rlp(cls, data: bytes) -> "Enr":
+        if len(data) > MAX_RECORD_SIZE:
+            raise EnrError("record exceeds 300 bytes")
+        items = rlp.decode(data)
+        if not isinstance(items, list) or len(items) < 2 \
+                or len(items) % 2 != 0:
+            raise EnrError("malformed record structure")
+        signature, seq_raw = items[0], items[1]
+        pairs = {}
+        prev = None
+        for i in range(2, len(items), 2):
+            k, v = items[i], items[i + 1]
+            if not isinstance(k, bytes) or not isinstance(v, bytes):
+                raise EnrError("non-bytes key/value")
+            if prev is not None and k <= prev:
+                raise EnrError("keys not strictly sorted")
+            prev = k
+            pairs[k] = v
+        record = cls(int.from_bytes(seq_raw, "big"), pairs, signature)
+        if not record.verify():
+            raise EnrError("invalid record signature")
+        return record
+
+    @classmethod
+    def from_text(cls, text: str) -> "Enr":
+        if not text.startswith("enr:"):
+            raise EnrError("missing enr: prefix")
+        raw = text[4:]
+        raw += "=" * (-len(raw) % 4)
+        return cls.from_rlp(base64.urlsafe_b64decode(raw))
+
+    def __repr__(self) -> str:
+        return (f"Enr(seq={self.seq}, "
+                f"node_id={self.node_id.hex()[:16]}..., "
+                f"ip={self.ip}, udp={self.udp})")

@@ -1,0 +1,93 @@
+"""RLP (recursive length prefix) encoding — the serialization ENRs and
+discv5 messages use (Ethereum's devp2p format; EIP-778 records are
+signed RLP lists).  Values are bytes; lists nest arbitrarily.
+Integers encode as minimal big-endian byte strings (no leading zero,
+zero = empty string) — callers convert.
+"""
+
+from typing import List, Tuple, Union
+
+Item = Union[bytes, List["Item"]]
+
+
+class RlpError(ValueError):
+    pass
+
+
+def encode_uint(v: int) -> bytes:
+    if v == 0:
+        return b""
+    return v.to_bytes((v.bit_length() + 7) // 8, "big")
+
+
+def decode_uint(b: bytes) -> int:
+    if b[:1] == b"\x00":
+        raise RlpError("leading zero in integer")
+    return int.from_bytes(b, "big")
+
+
+def _encode_length(n: int, short_base: int) -> bytes:
+    if n <= 55:
+        return bytes([short_base + n])
+    n_bytes = encode_uint(n)
+    return bytes([short_base + 55 + len(n_bytes)]) + n_bytes
+
+
+def encode(item: Item) -> bytes:
+    if isinstance(item, (bytes, bytearray)):
+        item = bytes(item)
+        if len(item) == 1 and item[0] < 0x80:
+            return item
+        return _encode_length(len(item), 0x80) + item
+    if isinstance(item, (list, tuple)):
+        payload = b"".join(encode(i) for i in item)
+        return _encode_length(len(payload), 0xC0) + payload
+    raise RlpError(f"cannot RLP-encode {type(item).__name__}")
+
+
+def _decode_at(data: bytes, pos: int) -> Tuple[Item, int]:
+    if pos >= len(data):
+        raise RlpError("truncated item")
+    b0 = data[pos]
+    if b0 < 0x80:
+        return bytes([b0]), pos + 1
+    if b0 <= 0xBF:
+        if b0 <= 0xB7:
+            n, pos = b0 - 0x80, pos + 1
+        else:
+            ln = b0 - 0xB7
+            n = decode_uint(data[pos + 1:pos + 1 + ln])
+            if n <= 55:
+                raise RlpError("non-canonical long length")
+            pos += 1 + ln
+        if pos + n > len(data):
+            raise RlpError("truncated string")
+        out = data[pos:pos + n]
+        if n == 1 and out[0] < 0x80:
+            raise RlpError("non-canonical single byte")
+        return out, pos + n
+    if b0 <= 0xF7:
+        n, pos = b0 - 0xC0, pos + 1
+    else:
+        ln = b0 - 0xF7
+        n = decode_uint(data[pos + 1:pos + 1 + ln])
+        if n <= 55:
+            raise RlpError("non-canonical long length")
+        pos += 1 + ln
+    end = pos + n
+    if end > len(data):
+        raise RlpError("truncated list")
+    items: List[Item] = []
+    while pos < end:
+        item, pos = _decode_at(data, pos)
+        items.append(item)
+    if pos != end:
+        raise RlpError("list payload overrun")
+    return items, pos
+
+
+def decode(data: bytes) -> Item:
+    item, end = _decode_at(data, 0)
+    if end != len(data):
+        raise RlpError("trailing bytes after item")
+    return item

@@ -1,0 +1,145 @@
+"""Minimal secp256k1: ECDSA (RFC 6979 deterministic nonces) + ECDH.
+
+The identity curve of Ethereum's discovery layer (EIP-778 ENRs sign
+with it; discv5's handshake needs the COMPRESSED shared ECDH point,
+which OpenSSL-backed APIs don't expose).  Pure Python — identity
+operations are per-handshake, not per-message, so correctness and
+auditability beat speed here (the reference's equivalent dependency
+is Bouncy Castle via jvm-libp2p / the discovery library).
+"""
+
+import hashlib
+import hmac
+from typing import Optional, Tuple
+
+P = 2 ** 256 - 2 ** 32 - 977
+N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+Point = Optional[Tuple[int, int]]     # None = infinity
+
+
+def _inv(a: int, m: int) -> int:
+    return pow(a, -1, m)
+
+
+def point_add(a: Point, b: Point) -> Point:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    if a[0] == b[0] and (a[1] + b[1]) % P == 0:
+        return None
+    if a == b:
+        lam = (3 * a[0] * a[0]) * _inv(2 * a[1], P) % P
+    else:
+        lam = (b[1] - a[1]) * _inv(b[0] - a[0], P) % P
+    x = (lam * lam - a[0] - b[0]) % P
+    return (x, (lam * (a[0] - x) - a[1]) % P)
+
+
+def point_mul(k: int, pt: Point) -> Point:
+    acc: Point = None
+    add = pt
+    while k:
+        if k & 1:
+            acc = point_add(acc, add)
+        add = point_add(add, add)
+        k >>= 1
+    return acc
+
+
+def pubkey(secret: int) -> Tuple[int, int]:
+    if not 0 < secret < N:
+        raise ValueError("secret key out of range")
+    pt = point_mul(secret, (GX, GY))
+    assert pt is not None
+    return pt
+
+
+def compress(pt: Tuple[int, int]) -> bytes:
+    return bytes([2 + (pt[1] & 1)]) + pt[0].to_bytes(32, "big")
+
+
+def decompress(data: bytes) -> Tuple[int, int]:
+    if len(data) != 33 or data[0] not in (2, 3):
+        raise ValueError("bad compressed point")
+    x = int.from_bytes(data[1:], "big")
+    if x >= P:
+        raise ValueError("x out of range")
+    y2 = (pow(x, 3, P) + 7) % P
+    y = pow(y2, (P + 1) // 4, P)
+    if y * y % P != y2:
+        raise ValueError("not on curve")
+    if (y & 1) != (data[0] & 1):
+        y = P - y
+    return (x, y)
+
+
+def uncompressed_xy(pt: Tuple[int, int]) -> bytes:
+    """64-byte x||y (the EIP-778 node-id preimage)."""
+    return pt[0].to_bytes(32, "big") + pt[1].to_bytes(32, "big")
+
+
+# -- ECDSA (RFC 6979 nonce, raw r||s signatures, low-s normalized) ----------
+
+def _rfc6979_k(secret: int, digest: bytes) -> int:
+    key = secret.to_bytes(32, "big")
+    v = b"\x01" * 32
+    k = b"\x00" * 32
+    k = hmac.new(k, v + b"\x00" + key + digest, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    k = hmac.new(k, v + b"\x01" + key + digest, hashlib.sha256).digest()
+    v = hmac.new(k, v, hashlib.sha256).digest()
+    while True:
+        v = hmac.new(k, v, hashlib.sha256).digest()
+        cand = int.from_bytes(v, "big")
+        if 0 < cand < N:
+            return cand
+        k = hmac.new(k, v + b"\x00", hashlib.sha256).digest()
+        v = hmac.new(k, v, hashlib.sha256).digest()
+
+
+def sign(secret: int, digest: bytes) -> bytes:
+    """64-byte r||s over a 32-byte message digest."""
+    z = int.from_bytes(digest, "big") % N
+    while True:
+        k = _rfc6979_k(secret, digest)
+        pt = point_mul(k, (GX, GY))
+        r = pt[0] % N
+        if r == 0:
+            digest = hashlib.sha256(digest).digest()
+            continue
+        s = _inv(k, N) * (z + r * secret) % N
+        if s == 0:
+            digest = hashlib.sha256(digest).digest()
+            continue
+        if s > N // 2:
+            s = N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+
+def verify(pub: Tuple[int, int], digest: bytes, signature: bytes) -> bool:
+    if len(signature) != 64:
+        return False
+    r = int.from_bytes(signature[:32], "big")
+    s = int.from_bytes(signature[32:], "big")
+    if not (0 < r < N and 0 < s < N):
+        return False
+    z = int.from_bytes(digest, "big") % N
+    w = _inv(s, N)
+    u1 = z * w % N
+    u2 = r * w % N
+    pt = point_add(point_mul(u1, (GX, GY)), point_mul(u2, pub))
+    if pt is None:
+        return False
+    return pt[0] % N == r
+
+
+def ecdh(secret: int, peer_pub: Tuple[int, int]) -> bytes:
+    """discv5 key agreement: the COMPRESSED 33-byte shared point."""
+    shared = point_mul(secret, peer_pub)
+    if shared is None:
+        raise ValueError("degenerate ECDH result")
+    return compress(shared)

@@ -54,6 +54,18 @@ def get_expected_withdrawals(cfg: SpecConfig, state):
     validator_index = state.next_withdrawal_validator_index
     withdrawals = []
     n = len(state.validators)
+    from .. import vectorized as _V
+    if n >= _V.VECTOR_THRESHOLD:
+        out = []
+        for vi, amount in _V.sweep_withdrawal_hits(
+                cfg, state, electra=False
+        )[:cfg.MAX_WITHDRAWALS_PER_PAYLOAD]:
+            out.append(Withdrawal(
+                index=withdrawal_index, validator_index=vi,
+                address=state.validators[vi]
+                .withdrawal_credentials[12:], amount=amount))
+            withdrawal_index += 1
+        return out
     for _ in range(min(n, cfg.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)):
         v = state.validators[validator_index]
         balance = state.balances[validator_index]

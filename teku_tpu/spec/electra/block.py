@@ -372,6 +372,21 @@ def get_expected_withdrawals(cfg: SpecConfig, state):
 
     validator_index = state.next_withdrawal_validator_index
     n = len(state.validators)
+    from .. import vectorized as _V
+    if n >= _V.VECTOR_THRESHOLD:
+        skip = {}
+        for w in withdrawals:
+            skip[w.validator_index] = skip.get(w.validator_index, 0) \
+                + w.amount
+        cap = cfg.MAX_WITHDRAWALS_PER_PAYLOAD - len(withdrawals)
+        for vi, amount in _V.sweep_withdrawal_hits(
+                cfg, state, electra=True, skip_amounts=skip)[:cap]:
+            withdrawals.append(Withdrawal(
+                index=withdrawal_index, validator_index=vi,
+                address=state.validators[vi]
+                .withdrawal_credentials[12:], amount=amount))
+            withdrawal_index += 1
+        return withdrawals, processed_partials
     for _ in range(min(n, cfg.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)):
         v = state.validators[validator_index]
         partially_withdrawn = sum(

@@ -21,6 +21,12 @@ def process_registry_updates(cfg: SpecConfig, state):
     """Electra: eligibility needs MIN_ACTIVATION_BALANCE; ejections use
     the balance-churn exit; every finalized-eligible validator
     activates (the churn was already paid at deposit time)."""
+    from .. import vectorized as _V
+    if len(state.validators) >= _V.VECTOR_THRESHOLD:
+        try:
+            return _V.process_registry_updates_electra(cfg, state)
+        except (_V.OverflowRisk, OverflowError):
+            pass     # exact big-int scalar path below
     current_epoch = H.get_current_epoch(cfg, state)
     validators = list(state.validators)
     changed = False
@@ -78,10 +84,12 @@ def process_pending_deposits(cfg: SpecConfig, state):
     churn_reached = False
     finalized_slot = H.compute_start_slot_at_epoch(
         cfg, state.finalized_checkpoint.epoch)
-    # one pubkey→index map for the whole queue, not a rebuild per
-    # deposit (epoch cost stays O(V + D))
-    index_by_pubkey = {v.pubkey: i
-                       for i, v in enumerate(state.validators)}
+    # one pubkey→index map for the whole queue, identity-cached per
+    # registry (epoch cost stays O(D) when the registry is unchanged);
+    # the overlay keeps writes out of the shared cached map
+    from collections import ChainMap
+    from .. import vectorized as _V
+    index_by_pubkey = ChainMap({}, _V.pubkey_index_map(state))
 
     for deposit in state.pending_deposits:
         # eth1-bridge deposits drain before any request-sourced ones

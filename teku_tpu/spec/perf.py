@@ -24,6 +24,13 @@ def perf_config(base: SpecConfig = None) -> SpecConfig:
     return dataclasses.replace(base or C.MAINNET, ALTAIR_FORK_EPOCH=0)
 
 
+def perf_config_electra(base: SpecConfig = None) -> SpecConfig:
+    """Mainnet-preset config with every fork live at genesis."""
+    return dataclasses.replace(
+        base or C.MAINNET, ALTAIR_FORK_EPOCH=0, BELLATRIX_FORK_EPOCH=0,
+        CAPELLA_FORK_EPOCH=0, DENEB_FORK_EPOCH=0, ELECTRA_FORK_EPOCH=0)
+
+
 def make_synthetic_altair_state(cfg: SpecConfig, n_validators: int,
                                 epoch: int = 5,
                                 participation_rate: float = 0.99,
@@ -94,4 +101,84 @@ def make_synthetic_altair_state(cfg: SpecConfig, n_validators: int,
         inactivity_scores=tuple(0 for _ in range(n_validators)),
         current_sync_committee=sync_committee,
         next_sync_committee=sync_committee,
+    )
+
+
+def make_synthetic_electra_state(cfg: SpecConfig, n_validators: int,
+                                 epoch: int = 5,
+                                 participation_rate: float = 0.99,
+                                 compounding_rate: float = 0.25,
+                                 seed: int = 1234):
+    """An electra BeaconState at the last slot of `epoch`: mixed
+    0x01/0x02 withdrawal credentials, balances straddling the
+    per-credential caps, empty pending queues (electra-only fields
+    take their schema defaults).  The surface the reference's
+    EpochTransitionBenchmark measures, on the latest fork."""
+    from .electra.datastructures import get_electra_schemas
+
+    assert cfg.ELECTRA_FORK_EPOCH == 0, "build against an electra config"
+    S = get_electra_schemas(cfg)
+    rng = random.Random(seed)
+    min_ab = cfg.MIN_ACTIVATION_BALANCE
+    max_eb = cfg.MAX_EFFECTIVE_BALANCE_ELECTRA
+    validators = []
+    balances = []
+    for i in range(n_validators):
+        compounding = rng.random() < compounding_rate
+        prefix = b"\x02" if compounding else b"\x01"
+        eb = max_eb if compounding and rng.random() < 0.5 else min_ab
+        validators.append(Validator(
+            pubkey=i.to_bytes(6, "little") * 8,
+            withdrawal_credentials=prefix + bytes(11)
+            + i.to_bytes(20, "little"),
+            effective_balance=eb,
+            activation_eligibility_epoch=0, activation_epoch=0,
+            exit_epoch=FAR_FUTURE_EPOCH,
+            withdrawable_epoch=FAR_FUTURE_EPOCH))
+        balances.append(eb + rng.randrange(-10 ** 9, 10 ** 9))
+    full = (1 << 0) | (1 << 1) | (1 << 2)
+    participation = tuple(
+        full if rng.random() < participation_rate else 0
+        for _ in range(n_validators))
+    slot = (epoch + 1) * cfg.SLOTS_PER_EPOCH - 1
+    root = b"\x5b" * 32
+    committee_pubkeys = tuple(
+        validators[i % n_validators].pubkey
+        for i in range(cfg.SYNC_COMMITTEE_SIZE))
+    sync_committee = S.SyncCommittee(
+        pubkeys=committee_pubkeys,
+        aggregate_pubkey=b"\xc0" + bytes(47))
+    return S.BeaconState(
+        genesis_time=0,
+        genesis_validators_root=b"\x33" * 32,
+        slot=slot,
+        fork=Fork(previous_version=cfg.DENEB_FORK_VERSION,
+                  current_version=cfg.ELECTRA_FORK_VERSION,
+                  epoch=0),
+        latest_block_header=BeaconBlockHeader(body_root=b"\x44" * 32),
+        block_roots=tuple(root
+                          for _ in range(cfg.SLOTS_PER_HISTORICAL_ROOT)),
+        state_roots=tuple(bytes(32)
+                          for _ in range(cfg.SLOTS_PER_HISTORICAL_ROOT)),
+        eth1_data=Eth1Data(deposit_root=bytes(32),
+                           deposit_count=n_validators,
+                           block_hash=b"\x42" * 32),
+        eth1_deposit_index=n_validators,
+        validators=tuple(validators),
+        balances=tuple(balances),
+        randao_mixes=tuple(
+            b"\x77" * 32 for _ in range(cfg.EPOCHS_PER_HISTORICAL_VECTOR)),
+        slashings=tuple(0 for _ in range(cfg.EPOCHS_PER_SLASHINGS_VECTOR)),
+        previous_epoch_participation=participation,
+        current_epoch_participation=participation,
+        justification_bits=(True, True, True, True),
+        previous_justified_checkpoint=Checkpoint(epoch=epoch - 2,
+                                                 root=root),
+        current_justified_checkpoint=Checkpoint(epoch=epoch - 1,
+                                                root=root),
+        finalized_checkpoint=Checkpoint(epoch=epoch - 2, root=root),
+        inactivity_scores=tuple(0 for _ in range(n_validators)),
+        current_sync_committee=sync_committee,
+        next_sync_committee=sync_committee,
+        deposit_requests_start_index=C.UNSET_DEPOSIT_REQUESTS_START_INDEX,
     )

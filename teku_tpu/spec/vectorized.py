@@ -314,6 +314,129 @@ def process_registry_updates(cfg: SpecConfig, state,
     return state
 
 
+_CRED_CACHE: list = []
+
+
+def credential_first_bytes(state) -> np.ndarray:
+    """Identity-cached first byte of every withdrawal credential (the
+    prefix that routes capella/electra withdrawal predicates)."""
+    vals = state.validators
+    for entry in _CRED_CACHE:
+        if entry[0] is vals:
+            return entry[1]
+    out = np.fromiter((v.withdrawal_credentials[0] for v in vals),
+                      dtype=np.uint8, count=len(vals))
+    _CRED_CACHE.insert(0, (vals, out))
+    del _CRED_CACHE[_ARRAY_CACHE_MAX:]
+    return out
+
+
+_PUBKEY_CACHE: list = []
+
+
+def pubkey_index_map(state) -> dict:
+    """Identity-cached pubkey -> index map (electra pending-deposit
+    processing needs it every epoch; rebuilding is O(V))."""
+    vals = state.validators
+    for entry in _PUBKEY_CACHE:
+        if entry[0] is vals:
+            return entry[1]
+    out = {v.pubkey: i for i, v in enumerate(vals)}
+    _PUBKEY_CACHE.insert(0, (vals, out))
+    del _PUBKEY_CACHE[_ARRAY_CACHE_MAX:]
+    return out
+
+
+def sweep_withdrawal_hits(cfg: SpecConfig, state, electra: bool,
+                          skip_amounts=None):
+    """Vectorized withdrawals-sweep window: the (validator_index,
+    amount) hits, in sweep order, over the bounded visit window
+    (scalar twins: capella/block.py and electra/block.py
+    get_expected_withdrawals sweep loops).  The caller applies the
+    MAX_WITHDRAWALS_PER_PAYLOAD cap and builds the containers."""
+    import operator
+
+    n = len(state.validators)
+    m = min(n, cfg.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)
+    start = state.next_withdrawal_validator_index
+    idx = np.arange(start, start + m, dtype=np.int64) % n
+    eb, _, _, _, withdrawable, _ = validator_arrays(state)
+    cred0 = credential_first_bytes(state)
+    # balances change per block: gather ONLY the window (C-level)
+    if m == 1:
+        bals = np.array([state.balances[int(idx[0])]], dtype=np.int64)
+    else:
+        bals = np.fromiter(
+            operator.itemgetter(*idx.tolist())(state.balances),
+            dtype=np.int64, count=m)
+    if skip_amounts:
+        for i, vi in enumerate(idx.tolist()):
+            if vi in skip_amounts:
+                bals[i] -= skip_amounts[vi]
+    w_eb = eb[idx]
+    w_wd = withdrawable[idx]
+    w_cred = cred0[idx]
+    epoch = H.get_current_epoch(cfg, state)
+    if electra:
+        exec_cred = (w_cred == 1) | (w_cred == 2)
+        max_eb = np.where(w_cred == 2,
+                          cfg.MAX_EFFECTIVE_BALANCE_ELECTRA,
+                          cfg.MIN_ACTIVATION_BALANCE)
+    else:
+        exec_cred = w_cred == 1
+        max_eb = np.full(m, cfg.MAX_EFFECTIVE_BALANCE, dtype=np.int64)
+    full = exec_cred & (w_wd <= epoch) & (bals > 0)
+    partial = ~full & exec_cred & (w_eb == max_eb) & (bals > max_eb)
+    hits = np.nonzero(full | partial)[0]
+    return [(int(idx[k]),
+             int(bals[k]) if full[k] else int(bals[k] - max_eb[k]))
+            for k in hits.tolist()]
+
+
+def process_registry_updates_electra(cfg: SpecConfig, state):
+    """Electra registry sweep: vector candidate detection, scalar
+    object work on the (rare) hits (scalar twin:
+    electra/epoch.py process_registry_updates)."""
+    current_epoch = H.get_current_epoch(cfg, state)
+    eb, _, activation, exit_epoch, _, eligibility = \
+        validator_arrays(state)
+
+    enter = (eligibility == _FAR_I64) \
+        & (eb >= cfg.MIN_ACTIVATION_BALANCE)
+    enter_idx = np.nonzero(enter)[0]
+    if len(enter_idx):
+        validators = list(state.validators)
+        for i in enter_idx.tolist():
+            validators[i] = validators[i].copy_with(
+                activation_eligibility_epoch=current_epoch + 1)
+        state = state.copy_with(validators=tuple(validators))
+
+    from .electra import helpers as EH
+    active_now = (activation <= current_epoch) \
+        & (current_epoch < exit_epoch)
+    eject = active_now & (eb <= cfg.EJECTION_BALANCE)
+    for i in np.nonzero(eject)[0].tolist():
+        state = EH.initiate_validator_exit(cfg, state, i)
+
+    # activation: EVERY finalized-eligible validator (no queue cap —
+    # electra's churn was paid at deposit time).  Arrays predate the
+    # edits above, but new entrants carry eligibility current+1 >
+    # finalized, and ejection touches exit fields only.
+    finalized_epoch = state.finalized_checkpoint.epoch
+    if len(enter_idx):
+        _, _, activation, _, _, eligibility = validator_arrays(state)
+    ready = (eligibility <= finalized_epoch) & (activation == _FAR_I64)
+    ready_idx = np.nonzero(ready)[0]
+    if len(ready_idx):
+        target = H.compute_activation_exit_epoch(cfg, current_epoch)
+        validators = list(state.validators)
+        for i in ready_idx.tolist():
+            validators[i] = validators[i].copy_with(
+                activation_epoch=target)
+        state = state.copy_with(validators=tuple(validators))
+    return state
+
+
 def target_participation_balances(cfg: SpecConfig, state
                                   ) -> Tuple[int, int]:
     """(previous_target_balance, current_target_balance) for altair

@@ -136,3 +136,36 @@ def test_native_handles_python_torn_tail(tmp_path):
     with _NativeKv(p) as kv:
         assert kv.get(b"x") == b"1"
         assert len(kv) == 1
+
+
+@pytest.mark.slow
+def test_kv_memory_bounded_for_large_values(tmp_path):
+    """Archive-shaped workload: values (states) dominate the data; the
+    engine must keep them ON DISK — the in-memory index holds only
+    key -> (offset, length).  RSS must stay far below the log size,
+    including across a reopen replay (which skips value bytes)."""
+    import resource
+
+    from teku_tpu.native.kv import KvStore
+
+    path = tmp_path / "big.db"
+    n, vlen = 120, 1 << 20          # ~120 MB of value data
+    value = bytes(vlen)
+    base = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    with KvStore(path) as kv:
+        for i in range(n):
+            kv.put(b"state/%08d" % i, value)
+        kv.flush()
+        assert kv.get(b"state/%08d" % 7) == value
+    grown = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss - base
+    # ru_maxrss is KiB on linux; allow ~40 MB of slack for allocator
+    # noise but nothing near the 120 MB of values
+    assert grown < 40 * 1024, f"RSS grew {grown} KiB"
+    assert path.stat().st_size > n * vlen
+
+    base2 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    with KvStore(path) as kv:      # reopen: replay indexes, not values
+        assert len(kv) == n
+        assert kv.get(b"state/%08d" % 99) == value
+    grown2 = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss - base2
+    assert grown2 < 40 * 1024, f"replay RSS grew {grown2} KiB"

@@ -235,3 +235,84 @@ def test_uint64_range_values_fall_back_without_crashing():
     assert len(out.inactivity_scores) == N
     out2 = AE.process_rewards_and_penalties(CFG, state)
     assert len(out2.balances) == N
+
+
+# -- electra / capella additions (round 5) ---------------------------------
+
+def _electra_state(seed=11):
+    cfg = P.perf_config_electra()
+    rng = random.Random(seed)
+    state = P.make_synthetic_electra_state(cfg, N, epoch=5, seed=seed)
+    validators = list(state.validators)
+    for i in range(N):
+        r = rng.random()
+        if r < 0.08:     # fully-withdrawable: exited + matured
+            validators[i] = validators[i].copy_with(
+                exit_epoch=1, withdrawable_epoch=2)
+        elif r < 0.12:   # BLS credential: invisible to the sweep
+            validators[i] = validators[i].copy_with(
+                withdrawal_credentials=b"\x00"
+                + validators[i].withdrawal_credentials[1:])
+        elif r < 0.2:    # fresh deposit awaiting eligibility
+            validators[i] = validators[i].copy_with(
+                activation_eligibility_epoch=C.FAR_FUTURE_EPOCH,
+                activation_epoch=C.FAR_FUTURE_EPOCH)
+        elif r < 0.28:   # finalized-eligible, not yet active
+            validators[i] = validators[i].copy_with(
+                activation_eligibility_epoch=rng.randrange(0, 3),
+                activation_epoch=C.FAR_FUTURE_EPOCH)
+        elif r < 0.32:   # ejectable
+            validators[i] = validators[i].copy_with(
+                effective_balance=cfg.EJECTION_BALANCE)
+    return cfg, state.copy_with(validators=tuple(validators))
+
+
+def test_capella_sweep_exact_match():
+    from teku_tpu.spec.capella import block as CB
+    cfg, state = _electra_state(seed=12)
+    for cursor in (0, N - 7):   # wrap-around window too
+        s = state.copy_with(next_withdrawal_validator_index=cursor,
+                            next_withdrawal_index=40)
+        scalar = _scalar(CB.get_expected_withdrawals, cfg, s)
+        vec = CB.get_expected_withdrawals(cfg, s)
+        assert scalar == vec
+        assert len(vec) > 0     # the scenario actually exercises hits
+
+
+def test_electra_sweep_exact_match_with_partials():
+    from teku_tpu.spec.electra import block as EB
+    from teku_tpu.spec.electra.datastructures import get_electra_schemas
+    cfg, state = _electra_state(seed=13)
+    S = get_electra_schemas(cfg)
+    # a couple of matured pending partials, one against a sweep hit
+    partials = (
+        S.PendingPartialWithdrawal(validator_index=3,
+                                   amount=10 ** 9,
+                                   withdrawable_epoch=1),
+        S.PendingPartialWithdrawal(validator_index=9,
+                                   amount=2 * 10 ** 9,
+                                   withdrawable_epoch=2),
+    )
+    state = state.copy_with(pending_partial_withdrawals=partials,
+                            next_withdrawal_validator_index=0)
+    scalar = _scalar(EB.get_expected_withdrawals, cfg, state)
+    vec = EB.get_expected_withdrawals(cfg, state)
+    assert scalar == vec
+    assert len(vec[0]) > 0
+
+
+def test_electra_registry_updates_exact_match():
+    from teku_tpu.spec.electra import epoch as EE
+    cfg, state = _electra_state(seed=14)
+    scalar = _scalar(EE.process_registry_updates, cfg, state)
+    vec = EE.process_registry_updates(cfg, state)
+    assert scalar.validators == vec.validators
+    assert scalar.htr() == vec.htr()
+
+
+def test_electra_full_epoch_matches_scalar():
+    from teku_tpu.spec.electra import epoch as EE
+    cfg, state = _electra_state(seed=15)
+    scalar = _scalar(EE.process_epoch, cfg, state)
+    vec = EE.process_epoch(cfg, state)
+    assert scalar.htr() == vec.htr()
