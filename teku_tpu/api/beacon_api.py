@@ -128,6 +128,9 @@ class BeaconRestApi(RestApi):
           self._lc_bootstrap)
         g("/eth/v1/beacon/light_client/finality_update",
           self._lc_finality_update)
+        g("/eth/v1/beacon/light_client/updates", self._lc_updates)
+        g("/eth/v1/node/peers/{peer_id}", self._peer_by_id)
+        g("/eth/v1/debug/fork_choice", self._debug_fork_choice)
         g("/metrics", self._metrics)
 
     # -- resolution helpers -------------------------------------------
@@ -220,16 +223,20 @@ class BeaconRestApi(RestApi):
                          "is_syncing": syncing,
                          "is_optimistic": False, "el_offline": False}}
 
+    @staticmethod
+    def _peer_json(peer) -> dict:
+        return {"peer_id": peer.node_id.hex(),
+                "state": "connected" if peer.connected
+                else "disconnected",
+                "direction": "outbound" if peer.outbound
+                else "inbound",
+                "last_seen_p2p_address": ""}
+
     async def _peers(self):
         peers = []
         if self.networked:
             for peer in self.networked.net.peers:
-                peers.append({
-                    "peer_id": peer.node_id.hex(),
-                    "state": "connected" if peer.connected
-                    else "disconnected",
-                    "direction": "outbound" if peer.outbound
-                    else "inbound"})
+                peers.append(self._peer_json(peer))
         return {"data": peers,
                 "meta": {"count": len(peers)}}
 
@@ -1324,6 +1331,124 @@ class BeaconRestApi(RestApi):
                         "signature_slot": str(u.signature_slot)}}
             root = parent
         raise HttpError(404, "no finality update available")
+
+    async def _lc_updates(self, query=None):
+        """GetLightClientUpdatesByRange: best retained update per sync
+        committee period (reference handlers/v1/beacon/
+        GetLightClientUpdatesByRange) — served from the hot chain, so
+        only recently-retained periods resolve."""
+        from ..spec.altair.light_client import (block_to_header,
+                                                create_update)
+        try:
+            start = int(query.get("start_period", 0)) if query else 0
+            count = min(int(query.get("count", 1)) if query else 1, 128)
+        except (ValueError, TypeError, KeyError):
+            raise HttpError(400, "invalid start_period/count")
+        store = self.node.store
+        cfg = self.node.spec.config
+        period_slots = (cfg.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+                        * cfg.SLOTS_PER_EPOCH)
+        best_by_period: dict = {}
+        root = self.node.chain.head_root
+        for _ in range(4 * cfg.SLOTS_PER_EPOCH):
+            blk = store.blocks.get(root)
+            if blk is None or not hasattr(blk.body, "sync_aggregate"):
+                break
+            parent = blk.parent_root
+            pblk = store.blocks.get(parent)
+            pstate = store.block_states.get(parent)
+            agg = blk.body.sync_aggregate
+            if (pblk is not None and pstate is not None
+                    and sum(agg.sync_committee_bits) > 0):
+                period = pblk.slot // period_slots
+                fin_blk = store.blocks.get(
+                    pstate.finalized_checkpoint.root)
+                prev = best_by_period.get(period)
+                # "best" per the spec's is_better_update ordering
+                # proxy: finality-bearing beats not, then highest
+                # sync-committee participation
+                rank = (fin_blk is not None,
+                        sum(agg.sync_committee_bits))
+                if (start <= period < start + count
+                        and (prev is None or rank > prev[2])):
+                    u = create_update(
+                        cfg, pstate, pblk,
+                        block_to_header(fin_blk)
+                        if fin_blk is not None else None,
+                        agg, blk.slot)
+                    best_by_period[period] = (u, agg, rank)
+            root = parent
+        # the API schema requires these fields populated; a zeroed
+        # header marks "no finality proof in this update"
+        zero_header = {"beacon": {
+            "slot": "0", "proposer_index": "0",
+            "parent_root": _hex(bytes(32)),
+            "state_root": _hex(bytes(32)),
+            "body_root": _hex(bytes(32))}}
+        out = []
+        for period in sorted(best_by_period):
+            u, agg, _rank = best_by_period[period]
+            out.append({"data": {
+                "attested_header": self._lc_header_json(
+                    u.attested_header),
+                "next_sync_committee": self._lc_committee_json(
+                    u.next_sync_committee)
+                if u.next_sync_committee is not None else None,
+                "next_sync_committee_branch": [
+                    _hex(h) for h in u.next_sync_committee_branch],
+                "finalized_header": self._lc_header_json(
+                    u.finalized_header)
+                if u.finalized_header is not None else zero_header,
+                "finality_branch": [_hex(h)
+                                    for h in u.finality_branch],
+                "sync_aggregate": {
+                    "sync_committee_bits": _hex(
+                        type(agg)._ssz_fields[
+                            "sync_committee_bits"].serialize(
+                            agg.sync_committee_bits)),
+                    "sync_committee_signature": _hex(
+                        agg.sync_committee_signature)},
+                "signature_slot": str(u.signature_slot)}})
+        return out
+
+    async def _peer_by_id(self, peer_id: str):
+        """reference handlers/v1/node/GetPeerById."""
+        if self.networked:
+            for peer in self.networked.net.peers:
+                if peer.node_id.hex() == peer_id.removeprefix("0x"):
+                    return {"data": self._peer_json(peer)}
+        raise HttpError(404, "peer not found")
+
+    async def _debug_fork_choice(self):
+        """reference handlers/v1/debug/GetForkChoice: the proto-array
+        dump fork-choice debugging tools consume."""
+        store = self.node.store
+        nodes = []
+        for n in store.proto.nodes:
+            nodes.append({
+                "slot": str(n.slot),
+                "block_root": _hex(n.root),
+                "parent_root": _hex(store.proto.nodes[n.parent].root)
+                if n.parent is not None else _hex(bytes(32)),
+                "justified_epoch": str(n.justified_epoch),
+                "finalized_epoch": str(n.finalized_epoch),
+                # RAW weight: this endpoint exists to expose
+                # vote-accounting state, including corrupt (negative)
+                # values a clamp would hide
+                "weight": str(n.weight),
+                "validity": "valid",
+                "execution_block_hash": _hex(bytes(32)),
+            })
+        return {
+            "justified_checkpoint": {
+                "epoch": str(store.justified_checkpoint.epoch),
+                "root": _hex(store.justified_checkpoint.root)},
+            "finalized_checkpoint": {
+                "epoch": str(store.finalized_checkpoint.epoch),
+                "root": _hex(store.finalized_checkpoint.root)},
+            "fork_choice_nodes": nodes,
+            "extra_data": {},
+        }
 
     async def _metrics(self):
         return GLOBAL_REGISTRY.expose(), "text/plain; version=0.0.4"

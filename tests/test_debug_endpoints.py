@@ -1,0 +1,56 @@
+"""Debug + light-client-range + peer REST endpoints (round-5 REST
+parity tail; reference: handlers/v1/debug/GetForkChoice,
+handlers/v1/beacon/GetLightClientUpdatesByRange,
+handlers/v1/node/GetPeerById)."""
+
+import asyncio
+import dataclasses
+
+import pytest
+
+from teku_tpu.api import BeaconRestApi
+from teku_tpu.infra.restapi import HttpError
+from teku_tpu.node import Devnet
+from teku_tpu.spec import config as C, Spec
+
+CFG = dataclasses.replace(C.MINIMAL, ALTAIR_FORK_EPOCH=0)
+
+
+@pytest.mark.slow
+def test_debug_fork_choice_lc_updates_and_peer():
+    net = Devnet(n_nodes=1, n_validators=16, spec=Spec(CFG))
+    node = net.nodes[0]
+
+    async def run():
+        await net.start()
+        try:
+            await net.run_until_slot(2 * CFG.SLOTS_PER_EPOCH)
+            api = BeaconRestApi(node)
+            fc = await api._debug_fork_choice()
+            assert len(fc["fork_choice_nodes"]) \
+                == 2 * CFG.SLOTS_PER_EPOCH + 1   # anchor + every block
+            head = node.chain.head_root
+            assert any(n["block_root"] == "0x" + head.hex()
+                       for n in fc["fork_choice_nodes"])
+            assert all(int(n["weight"]) >= 0
+                       for n in fc["fork_choice_nodes"])
+            # light-client updates by range: one update for period 0
+            ups = await api._lc_updates(query={"start_period": "0",
+                                               "count": "4"})
+            assert len(ups) == 1
+            data = ups[0]["data"]
+            assert int(data["signature_slot"]) > 0
+            assert data["sync_aggregate"][
+                "sync_committee_bits"].startswith("0x")
+            # malformed range is a 400, not a 500
+            with pytest.raises(HttpError) as err:
+                await api._lc_updates(query={"start_period": "x"})
+            assert err.value.status == 400
+            # unknown peer is a 404
+            with pytest.raises(HttpError) as err:
+                await api._peer_by_id("00" * 32)
+            assert err.value.status == 404
+        finally:
+            await net.stop()
+
+    asyncio.run(run())
