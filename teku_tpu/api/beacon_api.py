@@ -98,6 +98,16 @@ class BeaconRestApi(RestApi):
           self._get_bls_changes)
         p("/eth/v1/beacon/pool/bls_to_execution_changes",
           self._post_bls_changes)
+        # v2 pool family: electra-era versioned envelope (reference
+        # handlers/v2/beacon/GetAttesterSlashingsV2.java etc.)
+        g("/eth/v2/beacon/pool/attester_slashings",
+          self._get_attester_slashings_v2)
+        p("/eth/v2/beacon/pool/attester_slashings",
+          self._post_attester_slashing)
+        g("/eth/v2/beacon/pool/proposer_slashings",
+          self._get_proposer_slashings_v2)
+        p("/eth/v2/beacon/pool/proposer_slashings",
+          self._post_proposer_slashing)
         g("/eth/v1/beacon/states/{state_id}/validator_balances",
           self._validator_balances)
         p("/eth/v1/beacon/states/{state_id}/validator_balances",
@@ -990,6 +1000,20 @@ class BeaconRestApi(RestApi):
 
     async def _get_bls_changes(self):
         return self._pool_json("bls_to_execution_changes")
+
+    def _head_version_name(self) -> str:
+        from ..spec.milestones import build_fork_schedule
+        v = build_fork_schedule(self.node.spec.config).version_at_slot(
+            self.node.chain.head_slot())
+        return v.milestone.name.lower()
+
+    async def _get_attester_slashings_v2(self):
+        return {"version": self._head_version_name(),
+                **self._pool_json("attester_slashings")}
+
+    async def _get_proposer_slashings_v2(self):
+        return {"version": self._head_version_name(),
+                **self._pool_json("proposer_slashings")}
 
     async def _submit_op(self, pool_name: str, schema, topic, body):
         """Shared POST path: parse via the schema walk, validate by

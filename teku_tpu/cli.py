@@ -164,10 +164,16 @@ def cmd_node(args) -> int:
                     nn.node.store.justified_checkpoint.root]
                 db.save_anchor(anchor,
                                nn.node.store.block_states[anchor.htr()])
-            nn.node.block_manager.on_imported.append(
-                lambda root: storage.on_block_imported(
+            def _persist_import(root):
+                storage.on_block_imported(
                     nn.node.store.signed_blocks[root],
-                    nn.node.store.block_states[root]))
+                    nn.node.store.block_states[root])
+                # verified wire sidecars outlive the in-memory pool:
+                # persisted for DA-window serving, pruned by epoch
+                sidecars = nn.node.blob_pool.wire_sidecars_for(root)
+                if sidecars:
+                    db.save_blob_sidecars(root, sidecars)
+            nn.node.block_manager.on_imported.append(_persist_import)
 
             class _FinalizedSink:
                 def on_new_finalized_checkpoint(self, checkpoint,
@@ -175,6 +181,24 @@ def cmd_node(args) -> int:
                     storage.on_finalized(nn.node.store, checkpoint)
             nn.node.channels.subscribe(FinalizedCheckpointChannel,
                                        _FinalizedSink())
+
+            from .infra.events import SlotEventsChannel
+            from .storage.pruner import StoragePruner
+            retention = layered_value("history-retention-epochs",
+                                      args.history_retention_epochs,
+                                      yaml_cfg)
+            pruner = StoragePruner(
+                db, spec.config,
+                history_retention_epochs=(int(retention)
+                                          if retention is not None
+                                          else None))
+            nn.node.blob_store = db      # req/resp DB fallback
+            nn.node.storage_pruner = pruner
+
+            class _PruneSink:
+                def on_slot(self, slot):
+                    pruner.on_slot(slot)
+            nn.node.channels.subscribe(SlotEventsChannel, _PruneSink())
         await nn.start()
         eth1_task = None
         eth1_endpoint = layered_value("eth1-endpoint",
@@ -318,6 +342,132 @@ def cmd_genesis(args) -> int:
     Path(args.out).write_bytes(spec.schemas.BeaconState.serialize(state))
     print(f"genesis written: {args.out} validators={args.validators} "
           f"root=0x{state.htr().hex()}")
+    return 0
+
+
+def cmd_migrate_database(args) -> int:
+    """Convert a data dir between storage modes in place (reference
+    cli/util/DatabaseMigrater.java + `migrate-database` subcommand).
+
+    archive -> prune: drops per-slot state snapshots and the slot
+    index (PRUNE serves only the anchor + hot subtree).
+    prune -> archive: rebuilds the canonical slot index from the
+    persisted finalized chain; intermediate states regenerate by
+    replay on demand, so no state backfill is needed.
+    """
+    from .spec import create_spec
+    from .storage.database import Database
+
+    spec = create_spec(args.network)
+    path = Path(args.data_dir) / "chain.db"
+    if not path.exists():
+        print(f"no database at {path}", file=sys.stderr)
+        return 1
+    db = Database(path, spec, mode=args.to)
+    anchor_root = db._kv.get(b"meta/anchor_root")
+    if anchor_root is None:
+        print("database has no anchor; nothing to migrate",
+              file=sys.stderr)
+        db.close()
+        return 1
+    dropped_states = dropped_index = 0
+    if args.to == "prune":
+        for key in db._kv.keys_with_prefix(b"st/"):
+            if key[len(b"st/"):] != anchor_root:
+                db._kv.delete(key)
+                dropped_states += 1
+        for key in db._kv.keys_with_prefix(b"sl/"):
+            db._kv.delete(key)
+            dropped_index += 1
+        print(f"migrated to prune: dropped {dropped_states} state "
+              f"snapshots, {dropped_index} slot-index entries")
+    else:
+        db._index_finalized_chain(anchor_root)
+        indexed = len(db._kv.keys_with_prefix(b"sl/"))
+        print(f"migrated to archive: slot index rebuilt "
+              f"({indexed} entries); states regenerate by replay")
+    db.compact()
+    db.close()
+    return 0
+
+
+def cmd_debug(args) -> int:
+    """Debug helpers (reference cli/subcommand/debug/: DebugDbCommand,
+    PrettyPrintCommand)."""
+    from .spec import create_spec
+
+    if args.debug_cmd == "pretty-print":
+        from .spec.codec import (deserialize_signed_block,
+                                 deserialize_state)
+        spec = create_spec(args.network)
+        raw = Path(args.file).read_bytes()
+        if args.type == "state":
+            obj = deserialize_state(spec.config, raw)
+        else:
+            obj = deserialize_signed_block(spec.config, raw)
+
+        def render(v, indent=0):
+            pad = "  " * indent
+            if getattr(type(v), "_ssz_fields", None):
+                lines = [f"{pad}{type(v).__name__}:"]
+                for name in type(v)._ssz_fields:
+                    lines.append(f"{pad}  {name}:")
+                    lines.append(render(getattr(v, name), indent + 2))
+                return "\n".join(lines)
+            if isinstance(v, bytes):
+                return f"{pad}0x{v.hex()}"
+            if isinstance(v, (tuple, list)):
+                if len(v) > 8:
+                    return f"{pad}[{len(v)} items]"
+                return "\n".join(render(x, indent) for x in v) \
+                    if v else f"{pad}[]"
+            return f"{pad}{v}"
+        print(render(obj))
+        return 0
+    if args.debug_cmd == "db-info":
+        from .storage.database import Database
+        spec = create_spec(args.network)
+        path = Path(args.data_dir) / "chain.db"
+        if not path.exists():
+            print(f"no database at {path}", file=sys.stderr)
+            return 1
+        db = Database(path, spec)
+        prefixes = {b"blk/": "blocks", b"st/": "states",
+                    b"hot/": "hot refs", b"sl/": "slot index",
+                    b"bl/": "blob sidecars", b"meta/": "meta"}
+        for prefix, label in prefixes.items():
+            print(f"{label}: {len(db._kv.keys_with_prefix(prefix))}")
+        anchor = db.load_anchor()
+        if anchor is not None:
+            print(f"anchor: slot={anchor[0].slot} "
+                  f"root=0x{anchor[0].htr().hex()}")
+        db.close()
+        return 0
+    print(f"unknown debug command {args.debug_cmd}", file=sys.stderr)
+    return 1
+
+
+def cmd_admin_weak_subjectivity(args) -> int:
+    """Compute the weak-subjectivity period for a state (reference
+    cli/subcommand/admin/WeakSubjectivityCommand)."""
+    from .spec import create_spec
+    from .spec.codec import deserialize_state
+    from .spec.weak_subjectivity import (WeakSubjectivityValidator,
+                                         compute_weak_subjectivity_period)
+
+    spec = create_spec(args.network)
+    state = deserialize_state(spec.config,
+                              Path(args.state).read_bytes())
+    period = compute_weak_subjectivity_period(spec.config, state)
+    epoch = state.slot // spec.config.SLOTS_PER_EPOCH
+    print(f"state epoch: {epoch}")
+    print(f"weak subjectivity period: {period} epochs")
+    print(f"safe until epoch: {epoch + period}")
+    if args.current_epoch is not None:
+        ok = WeakSubjectivityValidator(spec.config).is_within_period(
+            state, args.current_epoch)
+        print(f"within period at epoch {args.current_epoch}: {ok}")
+        return 0 if ok else 2
     return 0
 
 
@@ -491,6 +641,10 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["archive", "prune"],
                    help="archive keeps the full chain with state "
                         "snapshots; prune keeps finalized + hot")
+    n.add_argument("--history-retention-epochs", type=int, default=None,
+                   help="optionally drop finalized blocks/states older "
+                        "than this many epochs (rolling-window node); "
+                        "blob sidecars always prune at the DA window")
     n.add_argument("--interop-validators", type=int, default=None,
                    help="run the first N interop validators locally")
     n.add_argument("--interop-total", type=int, default=None,
@@ -577,6 +731,34 @@ def build_parser() -> argparse.ArgumentParser:
 
     pe = sub.add_parser("peer", help="generate a node identity")
     pe.set_defaults(fn=cmd_peer)
+
+    mg = sub.add_parser("migrate-database",
+                        help="convert a data dir between storage modes")
+    mg.add_argument("--network", default="minimal")
+    mg.add_argument("--data-dir", required=True)
+    mg.add_argument("--to", required=True, choices=["archive", "prune"])
+    mg.set_defaults(fn=cmd_migrate_database)
+
+    dbg = sub.add_parser("debug", help="debug helpers")
+    dbg_sub = dbg.add_subparsers(dest="debug_cmd", required=True)
+    pp = dbg_sub.add_parser("pretty-print",
+                            help="render an SSZ file as text")
+    pp.add_argument("--network", default="minimal")
+    pp.add_argument("type", choices=["state", "block"])
+    pp.add_argument("file")
+    di = dbg_sub.add_parser("db-info", help="database key statistics")
+    di.add_argument("--network", default="minimal")
+    di.add_argument("--data-dir", required=True)
+    dbg.set_defaults(fn=cmd_debug)
+
+    adm = sub.add_parser("admin", help="admin utilities")
+    adm_sub = adm.add_subparsers(dest="admin_cmd", required=True)
+    ws = adm_sub.add_parser("weak-subjectivity",
+                            help="compute the WS period for a state")
+    ws.add_argument("--network", default="minimal")
+    ws.add_argument("--state", required=True)
+    ws.add_argument("--current-epoch", type=int, default=None)
+    ws.set_defaults(fn=cmd_admin_weak_subjectivity)
     return p
 
 

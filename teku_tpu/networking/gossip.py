@@ -27,7 +27,8 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 from ..infra.collections import LimitedSet
 from ..native import snappyc
 from ..node.gossip import GossipNetwork, TopicHandler, ValidationResult
-from .transport import KIND_GOSSIP, P2PNetwork, Peer
+from .scoring import GossipScoring
+from .transport import GOODBYE_FAULT, KIND_GOSSIP, P2PNetwork, Peer
 
 _LOG = logging.getLogger(__name__)
 
@@ -48,9 +49,17 @@ SEEN_CACHE_SIZE = 1 << 19
 
 MESSAGE_DOMAIN_VALID_SNAPPY = b"\x01\x00\x00\x00"
 
-REJECT_SCORE = -10
-IGNORE_SCORE = -1
-GRAFT_SCORE_FLOOR = -30   # gossipsub v1.1 score gate for mesh admission
+# gossipsub v1.1 mesh admission: GRAFT only from peers with
+# non-negative score (the graded thresholds live in scoring.py)
+GRAFT_SCORE_FLOOR = 0.0
+# gossipsub v1.1 PRUNE backoff: a pruned peer may not rejoin the mesh
+# (either direction) until the backoff expires — without it a P3
+# eviction re-grafts the same peer in the same heartbeat
+PRUNE_BACKOFF_HEARTBEATS = 86          # ~60s at the 700ms heartbeat
+# duplicates credit a mesh member's delivery duty only within this
+# window after the first VALIDATED delivery (unbounded windows let a
+# freeloader farm P3 credit by replaying one old message)
+DELIVERY_WINDOW_HEARTBEATS = 2
 
 ENV_DATA = 0
 ENV_CONTROL = 1
@@ -172,13 +181,14 @@ class TcpGossipNetwork(GossipNetwork):
     """GossipNetwork implementation the BeaconNode subscribes through —
     same interface as the in-memory devnet bus, gossipsub underneath."""
 
-    def __init__(self, net: P2PNetwork, rng: Optional[random.Random] = None):
+    def __init__(self, net: P2PNetwork, rng: Optional[random.Random] = None,
+                 scoring: Optional[GossipScoring] = None):
         self.net = net
         self.net.on_gossip = self._on_gossip
         self.net.on_peer_disconnected = self._on_peer_gone
         self._handlers: Dict[str, TopicHandler] = {}
         self._seen: LimitedSet = LimitedSet(SEEN_CACHE_SIZE)
-        self._scores: Dict[bytes, float] = {}
+        self.scoring = scoring or GossipScoring()
         self._peer_topics: Dict[bytes, Set[str]] = {}
         self._mesh: Dict[str, Set[Peer]] = {}
         self._mcache = MessageCache()
@@ -190,6 +200,10 @@ class TcpGossipNetwork(GossipNetwork):
         # per-peer ids already served via IWANT (gossipsub v1.1 bounds
         # IWANT retries to stop bandwidth amplification)
         self._iwant_served: Dict[bytes, LimitedSet] = {}
+        # (topic, node_id) -> heartbeat index when re-graft is allowed
+        self._prune_backoff: Dict[Tuple[str, bytes], int] = {}
+        # mid -> heartbeat expiry of the P3 duplicate-credit window
+        self._delivery_window: Dict[bytes, int] = {}
         # mid -> heartbeat count when our own outstanding IWANT expires:
         # without this, every IHAVE advertiser is asked for the same
         # missing message and the payload arrives D_lazy times
@@ -247,6 +261,30 @@ class TcpGossipNetwork(GossipNetwork):
         self._iwant_served.pop(peer.node_id, None)
         for mesh in self._mesh.values():
             mesh.discard(peer)
+        # retain the score book (no reconnect-washing); only end mesh
+        # tenure, and only when no OTHER link to the same id survives
+        # (duplicate-link teardown must not reset the live link)
+        if not any(p.connected and p.node_id == peer.node_id
+                   for p in self.net.peers if p is not peer):
+            self.scoring.on_disconnect(peer.node_id)
+
+    def _mesh_add(self, topic: str, peer: Peer) -> None:
+        self._mesh.setdefault(topic, set()).add(peer)
+        self.scoring.on_graft(peer.node_id, topic)
+
+    def _mesh_drop(self, topic: str, peer: Peer,
+                   backoff: bool = False) -> None:
+        mesh = self._mesh.get(topic)
+        if mesh is not None and peer in mesh:
+            mesh.discard(peer)
+            self.scoring.on_prune(peer.node_id, topic)
+        if backoff:
+            self._prune_backoff[(topic, peer.node_id)] = \
+                self._heartbeats + PRUNE_BACKOFF_HEARTBEATS
+
+    def _in_backoff(self, topic: str, node_id: bytes) -> bool:
+        exp = self._prune_backoff.get((topic, node_id))
+        return exp is not None and exp > self._heartbeats
 
     def _topic_peers(self, topic: str) -> List[Peer]:
         return [p for p in self.net.peers
@@ -260,9 +298,12 @@ class TcpGossipNetwork(GossipNetwork):
         mesh = [p for p in self._mesh.get(topic, ()) if p.connected]
         if mesh:
             return mesh
-        candidates = self._topic_peers(topic)
+        floor = self.scoring.params.publish_threshold
+        candidates = [p for p in self._topic_peers(topic)
+                      if self.scoring.score(p.node_id) >= floor]
         if not candidates:
-            candidates = list(self.net.peers)
+            candidates = [p for p in self.net.peers
+                          if self.scoring.score(p.node_id) >= floor]
         self._rng.shuffle(candidates)
         return candidates[:D]
 
@@ -294,8 +335,11 @@ class TcpGossipNetwork(GossipNetwork):
 
     # -- inbound -------------------------------------------------------
     async def _on_gossip(self, peer: Peer, payload: bytes) -> None:
+        if self.scoring.score(peer.node_id) \
+                < self.scoring.params.graylist_threshold:
+            return                      # graylisted: drop everything
         if not payload:
-            self._punish(peer, REJECT_SCORE)
+            self._misbehave(peer)
             return
         kind = payload[0]
         if kind == ENV_DATA:
@@ -303,7 +347,7 @@ class TcpGossipNetwork(GossipNetwork):
         elif kind == ENV_CONTROL:
             await self._on_control(peer, payload[1:])
         else:
-            self._punish(peer, REJECT_SCORE)
+            self._misbehave(peer)
 
     async def _on_data(self, peer: Peer, payload: bytes) -> None:
         try:
@@ -311,17 +355,25 @@ class TcpGossipNetwork(GossipNetwork):
             topic = payload[1:1 + tlen].decode()
             data = snappyc.uncompress(payload[1 + tlen:])
         except Exception:
-            self._punish(peer, REJECT_SCORE)
+            self._misbehave(peer)
             return
         mid = spec_msg_id(topic, data)
         self._iwant_pending.pop(mid, None)
         if not self._seen.add(mid):
-            return                      # duplicate
+            # duplicate: credits a mesh member's delivery duty ONLY
+            # inside the post-validation delivery window
+            exp = self._delivery_window.get(mid)
+            if exp is not None and exp > self._heartbeats:
+                self.scoring.on_duplicate_delivery(peer.node_id, topic)
+            return
         handler = self._handlers.get(topic)
         if handler is None:
             return
         result = await handler.handle_message(data)
         if result is ValidationResult.ACCEPT:
+            self.scoring.on_first_delivery(peer.node_id, topic)
+            self._delivery_window[mid] = \
+                self._heartbeats + DELIVERY_WINDOW_HEARTBEATS
             # eager-push into the mesh only after validation (gossipsub
             # propagation gating); everyone else learns the id via the
             # next heartbeat's IHAVE
@@ -331,34 +383,38 @@ class TcpGossipNetwork(GossipNetwork):
                                   self._eager_targets(topic),
                                   exclude=peer)
         elif result is ValidationResult.REJECT:
-            self._punish(peer, REJECT_SCORE)
-        elif result is ValidationResult.IGNORE:
-            self._punish(peer, IGNORE_SCORE)
+            self.scoring.on_invalid(peer.node_id, topic)
+            self._maybe_graylist(peer)
+        # IGNORE: no score change (gossipsub v1.1 — only REJECT counts
+        # as an invalid delivery)
 
     async def _on_control(self, peer: Peer, payload: bytes) -> None:
         try:
             subs, graft, prune, ihave, iwant = decode_control(payload)
         except ValueError:
-            self._punish(peer, REJECT_SCORE)
+            self._misbehave(peer)
             return
         topics = self._peer_topics.setdefault(peer.node_id, set())
         for on, topic in subs:
             (topics.add if on else topics.discard)(topic)
-            if not on and topic in self._mesh:
-                self._mesh[topic].discard(peer)
+            if not on:
+                self._mesh_drop(topic, peer)
         prune_back = []
         for topic in graft:
-            # mesh admission: must be subscribed ourselves and the
-            # peer's score above the gate (gossipsub v1.1)
-            if (topic in self._handlers
-                    and self._scores.get(peer.node_id, 0)
-                    > GRAFT_SCORE_FLOOR):
-                self._mesh.setdefault(topic, set()).add(peer)
+            if self._in_backoff(topic, peer.node_id):
+                # grafting during backoff is a protocol violation
+                # (gossipsub v1.1) — costs behaviour score
+                self.scoring.add_behaviour_penalty(peer.node_id, 0.5)
+                prune_back.append(topic)
+            elif (topic in self._handlers
+                    and self.scoring.score(peer.node_id)
+                    >= GRAFT_SCORE_FLOOR):
+                self._mesh_add(topic, peer)
             else:
                 prune_back.append(topic)
         for topic in prune:
-            if topic in self._mesh:
-                self._mesh[topic].discard(peer)
+            # peer-initiated PRUNE carries the backoff both ways
+            self._mesh_drop(topic, peer, backoff=True)
         if prune_back:
             self._send_control(peer, encode_control(prune=prune_back))
         # IHAVE → IWANT for ids we miss — one outstanding request per
@@ -389,7 +445,8 @@ class TcpGossipNetwork(GossipNetwork):
                                                 LimitedSet(4096))
         for mid in iwant[:MAX_IWANT_PER_CONTROL]:
             if mid in already:
-                self._punish(peer, IGNORE_SCORE)
+                # bandwidth-amplification probe: costs behaviour score
+                self._misbehave(peer, n=0.2)
                 continue
             entry = self._mcache.get(mid)
             if entry is not None:
@@ -412,31 +469,46 @@ class TcpGossipNetwork(GossipNetwork):
     def heartbeat(self) -> None:
         """One mesh-maintenance pass (callable directly from tests —
         deterministic, no awaits: control sends are fire-and-forget)."""
+        # one score snapshot per pass: scores change only via events,
+        # and recomputing per (topic, peer) filter is O(topics^2*peers)
+        scores = {p.node_id: self.scoring.score(p.node_id)
+                  for p in self.net.peers}
         for topic in self._handlers:
             mesh = self._mesh.setdefault(topic, set())
             for p in [p for p in mesh if not p.connected]:
-                mesh.discard(p)
+                self._mesh_drop(topic, p)
+            # evict mesh members whose score went negative (gossipsub
+            # v1.1 score-based pruning) — WITH backoff, else the
+            # refill below re-grafts the same peer this same pass
+            for p in [p for p in mesh
+                      if scores.get(p.node_id, 0) < GRAFT_SCORE_FLOOR]:
+                self._mesh_drop(topic, p, backoff=True)
+                self._send_control(p, encode_control(prune=[topic]))
             if len(mesh) < D_LOW:
                 candidates = [
                     p for p in self._topic_peers(topic)
                     if p not in mesh
-                    and self._scores.get(p.node_id, 0) > GRAFT_SCORE_FLOOR]
+                    and scores.get(p.node_id, 0) >= GRAFT_SCORE_FLOOR
+                    and not self._in_backoff(topic, p.node_id)]
                 self._rng.shuffle(candidates)
                 for p in candidates[:D - len(mesh)]:
-                    mesh.add(p)
+                    self._mesh_add(topic, p)
                     self._send_control(p, encode_control(graft=[topic]))
             elif len(mesh) > D_HIGH:
                 excess = self._rng.sample(sorted(mesh, key=id),
                                           len(mesh) - D)
                 for p in excess:
-                    mesh.discard(p)
+                    self._mesh_drop(topic, p, backoff=True)
                     self._send_control(p, encode_control(prune=[topic]))
             # gossip: IHAVE recent ids to D_lazy non-mesh topic peers
+            # above the gossip threshold (below it they get nothing)
             mids = self._mcache.gossip_ids(topic)[
                 :MAX_IHAVE_PER_HEARTBEAT]
             if mids:
                 lazy = [p for p in self._topic_peers(topic)
-                        if p not in mesh]
+                        if p not in mesh
+                        and scores.get(p.node_id, 0)
+                        >= self.scoring.params.gossip_threshold]
                 self._rng.shuffle(lazy)
                 for p in lazy[:D_LAZY]:
                     self._send_control(
@@ -447,17 +519,33 @@ class TcpGossipNetwork(GossipNetwork):
             self._iwant_pending = {
                 mid: exp for mid, exp in self._iwant_pending.items()
                 if exp > self._heartbeats}
-        # score decay toward zero (gossipsub counters decay each
-        # heartbeat so old sins are forgiven)
-        for nid in list(self._scores):
-            self._scores[nid] *= 0.9
-            if abs(self._scores[nid]) < 0.1:
-                del self._scores[nid]
+        if self._delivery_window:
+            self._delivery_window = {
+                mid: exp for mid, exp in self._delivery_window.items()
+                if exp > self._heartbeats}
+        if self._prune_backoff:
+            self._prune_backoff = {
+                k: exp for k, exp in self._prune_backoff.items()
+                if exp > self._heartbeats}
+        # decaying counters (P2/P3/P4/P7) tick on the scoring module's
+        # own interval, not per-heartbeat
+        self.scoring.maybe_decay()
 
     # -- scoring --------------------------------------------------------
-    def _punish(self, peer: Peer, delta: float) -> None:
-        score = self._scores.get(peer.node_id, 0) + delta
-        self._scores[peer.node_id] = score
-        if score <= -100:
-            _LOG.warning("disconnecting misbehaving peer")
+    def _misbehave(self, peer: Peer, n: float = 1.0) -> None:
+        """Protocol violation (malformed frame, amplification probe):
+        behaviour penalty (P7), squared above its tolerance."""
+        self.scoring.add_behaviour_penalty(peer.node_id, n)
+        self._maybe_graylist(peer)
+
+    def _maybe_graylist(self, peer: Peer) -> None:
+        if self.scoring.score(peer.node_id) \
+                <= self.scoring.params.graylist_threshold:
+            _LOG.warning("disconnecting graylisted peer")
+            # record the for-cause disconnect in the transport-level
+            # reputation book so the dialer won't immediately redial
+            rep = getattr(self.net, "reputation", None)
+            if rep is not None:
+                rep.report_initiated_disconnect(peer.node_id,
+                                                GOODBYE_FAULT)
             peer.close()

@@ -1,0 +1,106 @@
+"""Peer reputation book: graded adjustments, disconnect thresholds,
+and time-bounded bans.
+
+The transport-level companion to gossip scoring (reference:
+networking/p2p/src/main/java/tech/pegasys/teku/networking/p2p/
+reputation/DefaultReputationManager.java and ReputationAdjustment.java
+— score clamped to a max, LARGE/SMALL penalty and reward steps,
+disconnect once the score crosses the floor, and ban-worthy goodbye
+reason codes that suppress reconnects for a cooldown period).
+
+Separation of duties: gossip scoring measures MESSAGE quality per
+topic; this book measures CONNECTION behavior (handshake failures,
+rate-limit violations, useless sync responses, rude goodbyes) and is
+the thing consulted before dialing or admitting a peer.
+"""
+
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from ..infra.collections import LimitedMap
+
+__all__ = ["Adjustment", "ReputationManager", "GOODBYE_BAN_WORTHY"]
+
+
+class Adjustment:
+    """Graded steps (reference ReputationAdjustment.java)."""
+    LARGE_PENALTY = -10.0
+    SMALL_PENALTY = -3.0
+    SMALL_REWARD = 2.0
+    LARGE_REWARD = 10.0
+
+
+MAX_SCORE = 150.0
+DISCONNECT_SCORE = -150.0
+
+# goodbye reason codes whose SENDER is telling us we misbehaved in a
+# way that makes an immediate redial pointless or rude (spec codes:
+# 1=client shutdown, 2=irrelevant network, 3=fault/error, plus the
+# 128+ banned/score range real clients use).  Transient conditions —
+# client shutdown (1), too-many-peers (129) — are deliberately NOT
+# here: banning over a full peer table turns one busy node into
+# 10-minute mutual lockouts across a small devnet.
+GOODBYE_BAN_WORTHY = frozenset({2, 3, 128, 250})
+
+BAN_PERIOD_S = 600.0          # reference uses a cooldown of minutes
+_BOOK_CAPACITY = 2048
+
+
+class ReputationManager:
+    """LRU-bounded score/ban book keyed by node id.  All reads are
+    O(1); nothing here is async — callers close peers themselves on a
+    True return from adjust()."""
+
+    def __init__(self, time_fn: Callable[[], float] = time.monotonic,
+                 capacity: int = _BOOK_CAPACITY,
+                 ban_period_s: float = BAN_PERIOD_S):
+        self._now = time_fn
+        self._ban_period = ban_period_s
+        self._scores: LimitedMap = LimitedMap(capacity)
+        self._banned_until: LimitedMap = LimitedMap(capacity)
+
+    # -- queries --------------------------------------------------------
+    def score(self, node_id: bytes) -> float:
+        return self._scores.get(node_id) or 0.0
+
+    def is_connect_allowed(self, node_id: bytes) -> bool:
+        """Consulted before dialing AND before admitting an inbound
+        peer: banned ids wait out the cooldown."""
+        until = self._banned_until.get(node_id)
+        if until is None:
+            return True
+        if self._now() >= until:
+            # ban expired: forgive the score too (the reference resets
+            # on cooldown expiry so one old sin can't re-ban instantly)
+            self._banned_until.pop(node_id, None)
+            self._scores.pop(node_id, None)
+            return True
+        return False
+
+    # -- mutations ------------------------------------------------------
+    def adjust(self, node_id: bytes, delta: float) -> bool:
+        """Apply a graded adjustment; True = the caller should
+        disconnect (score crossed the floor, peer is now banned)."""
+        s = min(self.score(node_id) + delta, MAX_SCORE)
+        self._scores.put(node_id, s)
+        if s <= DISCONNECT_SCORE:
+            self._ban(node_id)
+            return True
+        return False
+
+    def report_initiated_disconnect(self, node_id: bytes,
+                                    reason: Optional[int]) -> None:
+        """WE disconnected them for cause: ban-worthy reasons suppress
+        redials for the cooldown."""
+        if reason is not None and reason in GOODBYE_BAN_WORTHY:
+            self._ban(node_id)
+
+    def report_received_goodbye(self, node_id: bytes,
+                                reason: Optional[int]) -> None:
+        """THEY disconnected us citing a fault: don't redial into the
+        same rejection for the cooldown."""
+        if reason is not None and reason in GOODBYE_BAN_WORTHY:
+            self._ban(node_id)
+
+    def _ban(self, node_id: bytes) -> None:
+        self._banned_until.put(node_id, self._now() + self._ban_period)

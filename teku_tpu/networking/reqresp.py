@@ -174,29 +174,40 @@ class BeaconRpc:
     def _blob_pool(self):
         return getattr(self.node, "blob_pool", None)
 
+    def _stored_sidecars(self, root: bytes) -> List[bytes]:
+        """Serialized sidecars for `root`: the in-memory pool first,
+        then the database (persisted imports outlive the pool's
+        64-block horizon; pruned past the DA window)."""
+        pool = self._blob_pool()
+        if pool is not None:
+            live = pool.wire_sidecars_for(root)
+            if live:
+                return [type(sc).serialize(sc) for sc in live]
+        store = getattr(self.node, "blob_store", None)
+        if store is not None:
+            return store.get_blob_sidecars(root)
+        return []
+
     def _blob_sidecars_by_range(self, start: int,
                                 count: int) -> List[bytes]:
-        pool = self._blob_pool()
-        if pool is None:
-            return []
         cap = self.node.spec.config.MAX_REQUEST_BLOB_SIDECARS
         out = []
         for r in self._canonical_roots_in_range(start, count):
-            for sc in pool.wire_sidecars_for(r):
-                out.append(type(sc).serialize(sc))
+            for raw in self._stored_sidecars(r):
+                out.append(raw)
                 if len(out) >= cap:
                     return out
         return out
 
     def _blob_sidecars_by_root(self, ids) -> List[bytes]:
-        pool = self._blob_pool()
-        if pool is None:
+        schema = self._sidecar_schema()
+        if schema is None:
             return []
         out = []
         for root, index in ids:
-            for sc in pool.wire_sidecars_for(root):
-                if sc.index == index:
-                    out.append(type(sc).serialize(sc))
+            for raw in self._stored_sidecars(root):
+                if schema.deserialize(raw).index == index:
+                    out.append(raw)
         return out
 
     # -- client side ---------------------------------------------------

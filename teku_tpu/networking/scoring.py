@@ -1,0 +1,273 @@
+"""Gossipsub v1.1 peer scoring: per-topic weighted parameters with
+decaying counters.
+
+Replaces the r4 scalar score with the spec's score function (reference:
+networking/p2p/src/main/java/tech/pegasys/teku/networking/p2p/gossip/
+config/GossipScoringConfig.java and networking/eth2/src/main/java/tech/
+pegasys/teku/networking/eth2/gossip/config/GossipScoringConfigurator.java
+— there the per-topic params are derived from spec constants; here the
+same component shapes with values scaled to this router's traffic):
+
+    score(p) = sum_topic tw_t * ( w1*P1 + w2*P2 + w3*P3 + w4*P4 )
+               [positive topic sum capped at topic_score_cap]
+             + w7 * max(0, behaviour_penalty - threshold)^2
+
+  P1 time in mesh          (capped, rewards stable mesh members)
+  P2 first-message deliveries      (decaying counter, capped)
+  P3 mesh-message-delivery deficit (squared; active only after the
+     mesh membership is older than the activation window)
+  P4 invalid message deliveries    (squared penalty)
+  P7 behaviour penalty    (protocol violations: malformed frames,
+     broken IWANT promises; squared above a tolerance threshold)
+
+An adversary who alternates valid and invalid traffic — the attack the
+r4 scalar counter was gameable by — now carries the *squared* P4
+penalty per topic while the linear P2 credit is capped, so the score
+goes monotonically down under any mix with a nonzero invalid rate.
+
+Counters decay multiplicatively every DECAY_INTERVAL_S (the spec slot
+time) and snap to zero below `decay_to_zero`, which also garbage-
+collects drained records.  Disconnects RETAIN the counters
+(`on_disconnect` only ends mesh tenure — spec retainScore): a peer
+cannot wash a negative score by dropping and redialing.
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+__all__ = [
+    "TopicScoreParams", "PeerScoreParams", "GossipScoring",
+    "eth2_topic_params",
+]
+
+
+@dataclass(frozen=True)
+class TopicScoreParams:
+    """Weights for one topic (gossipsub v1.1 §score-function)."""
+    topic_weight: float = 0.5
+    # P1: time in mesh
+    time_in_mesh_weight: float = 0.033
+    time_in_mesh_quantum_s: float = 12.0
+    time_in_mesh_cap: float = 300.0
+    # P2: first message deliveries
+    first_message_weight: float = 1.0
+    first_message_decay: float = 0.86
+    first_message_cap: float = 40.0
+    # P3: mesh message delivery deficit (weight must be <= 0)
+    mesh_delivery_weight: float = -1.0
+    mesh_delivery_decay: float = 0.93
+    mesh_delivery_cap: float = 20.0
+    mesh_delivery_threshold: float = 4.0
+    mesh_delivery_activation_s: float = 60.0
+    # P4: invalid message deliveries (weight must be <= 0)
+    invalid_message_weight: float = -50.0
+    invalid_message_decay: float = 0.93
+
+
+@dataclass(frozen=True)
+class PeerScoreParams:
+    """Peer-global weights and thresholds."""
+    topic_score_cap: float = 100.0
+    behaviour_penalty_weight: float = -10.0
+    behaviour_penalty_decay: float = 0.86
+    behaviour_penalty_threshold: float = 6.0
+    decay_interval_s: float = 12.0
+    decay_to_zero: float = 0.01
+    # thresholds (gossipsub v1.1 §thresholds)
+    gossip_threshold: float = -40.0     # below: no IHAVE/IWANT exchange
+    publish_threshold: float = -80.0    # below: not a publish target
+    graylist_threshold: float = -160.0  # below: drop everything / close
+
+
+def eth2_topic_params(topic: str) -> TopicScoreParams:
+    """Reference-shaped per-topic families (GossipScoringConfigurator
+    derives block/aggregate/subnet params from spec constants; the
+    relative weighting here mirrors its structure: blocks score high
+    and slow, subnets low and fast)."""
+    if "beacon_attestation" in topic:
+        # 64 subnets: each carries 1/64 of the weight, fast decay
+        return TopicScoreParams(
+            topic_weight=0.015, first_message_cap=120.0,
+            first_message_decay=0.68, mesh_delivery_threshold=2.0,
+            invalid_message_weight=-99.0)
+    if "beacon_aggregate_and_proof" in topic:
+        return TopicScoreParams(topic_weight=0.5,
+                                first_message_decay=0.68)
+    if "beacon_block" in topic:
+        return TopicScoreParams(topic_weight=0.5,
+                                first_message_cap=23.0,
+                                mesh_delivery_threshold=1.0)
+    if "sync_committee" in topic:
+        return TopicScoreParams(topic_weight=0.015,
+                                first_message_decay=0.68)
+    # voluntary_exit / slashings / bls_to_execution_change: rare
+    # messages — no mesh-delivery duty (threshold 0 disables P3)
+    return TopicScoreParams(topic_weight=0.05,
+                            mesh_delivery_weight=0.0,
+                            mesh_delivery_threshold=0.0)
+
+
+@dataclass
+class _TopicCounters:
+    mesh_since: Optional[float] = None   # None = not in our mesh
+    first_deliveries: float = 0.0
+    mesh_deliveries: float = 0.0
+    invalid: float = 0.0
+
+
+@dataclass
+class _PeerRecord:
+    topics: Dict[str, _TopicCounters] = field(default_factory=dict)
+    behaviour_penalty: float = 0.0
+
+
+class GossipScoring:
+    """Per-peer score book.  All methods are O(1) except score()
+    (O(active topics for that peer)) and decay() (O(peers x topics),
+    run once per decay interval)."""
+
+    def __init__(self,
+                 params: Optional[PeerScoreParams] = None,
+                 topic_params: Optional[Callable[
+                     [str], TopicScoreParams]] = None,
+                 time_fn: Callable[[], float] = time.monotonic):
+        self.params = params or PeerScoreParams()
+        self._topic_params = topic_params or eth2_topic_params
+        self._now = time_fn
+        self._peers: Dict[bytes, _PeerRecord] = {}
+        self._tp_cache: Dict[str, TopicScoreParams] = {}
+        self._last_decay = time_fn()
+
+    # -- params ---------------------------------------------------------
+    def topic_params(self, topic: str) -> TopicScoreParams:
+        tp = self._tp_cache.get(topic)
+        if tp is None:
+            tp = self._tp_cache[topic] = self._topic_params(topic)
+        return tp
+
+    # -- event intake ---------------------------------------------------
+    def _counters(self, peer_id: bytes, topic: str) -> _TopicCounters:
+        rec = self._peers.setdefault(peer_id, _PeerRecord())
+        tc = rec.topics.get(topic)
+        if tc is None:
+            tc = rec.topics[topic] = _TopicCounters()
+        return tc
+
+    def on_graft(self, peer_id: bytes, topic: str) -> None:
+        tc = self._counters(peer_id, topic)
+        if tc.mesh_since is None:
+            tc.mesh_since = self._now()
+
+    def on_prune(self, peer_id: bytes, topic: str) -> None:
+        rec = self._peers.get(peer_id)
+        tc = rec.topics.get(topic) if rec else None
+        if tc is not None:
+            tc.mesh_since = None
+            tc.mesh_deliveries = 0.0
+
+    def on_first_delivery(self, peer_id: bytes, topic: str) -> None:
+        tp = self.topic_params(topic)
+        tc = self._counters(peer_id, topic)
+        tc.first_deliveries = min(tc.first_deliveries + 1,
+                                  tp.first_message_cap)
+        if tc.mesh_since is not None:
+            tc.mesh_deliveries = min(tc.mesh_deliveries + 1,
+                                     tp.mesh_delivery_cap)
+
+    def on_duplicate_delivery(self, peer_id: bytes, topic: str) -> None:
+        """A duplicate from a mesh member still counts toward its
+        mesh-delivery duty (it IS delivering, just not first)."""
+        tc = self._counters(peer_id, topic)
+        if tc.mesh_since is not None:
+            tp = self.topic_params(topic)
+            tc.mesh_deliveries = min(tc.mesh_deliveries + 1,
+                                     tp.mesh_delivery_cap)
+
+    def on_invalid(self, peer_id: bytes, topic: str) -> None:
+        self._counters(peer_id, topic).invalid += 1
+
+    def add_behaviour_penalty(self, peer_id: bytes,
+                              n: float = 1.0) -> None:
+        rec = self._peers.setdefault(peer_id, _PeerRecord())
+        rec.behaviour_penalty += n
+
+    def on_disconnect(self, peer_id: bytes) -> None:
+        """Connection teardown ends mesh tenure but RETAINS the decay
+        counters (gossipsub retainScore): a peer cannot wash a negative
+        score by reconnecting — the record lives until decay drains it."""
+        rec = self._peers.get(peer_id)
+        if rec is None:
+            return
+        for tc in rec.topics.values():
+            tc.mesh_since = None
+
+    # -- score ----------------------------------------------------------
+    def score(self, peer_id: bytes) -> float:
+        rec = self._peers.get(peer_id)
+        if rec is None:
+            return 0.0
+        now = self._now()
+        topic_sum = 0.0
+        for topic, tc in rec.topics.items():
+            tp = self.topic_params(topic)
+            s = 0.0
+            if tc.mesh_since is not None:
+                in_mesh = now - tc.mesh_since
+                s += tp.time_in_mesh_weight * min(
+                    in_mesh / tp.time_in_mesh_quantum_s,
+                    tp.time_in_mesh_cap)
+            s += tp.first_message_weight * tc.first_deliveries
+            if (tp.mesh_delivery_weight != 0.0
+                    and tc.mesh_since is not None
+                    and now - tc.mesh_since
+                    >= tp.mesh_delivery_activation_s
+                    and tc.mesh_deliveries < tp.mesh_delivery_threshold):
+                deficit = tp.mesh_delivery_threshold - tc.mesh_deliveries
+                s += tp.mesh_delivery_weight * deficit * deficit
+            s += tp.invalid_message_weight * tc.invalid * tc.invalid
+            topic_sum += tp.topic_weight * s
+        total = min(topic_sum, self.params.topic_score_cap)
+        excess = rec.behaviour_penalty \
+            - self.params.behaviour_penalty_threshold
+        if excess > 0:
+            total += self.params.behaviour_penalty_weight \
+                * excess * excess
+        return total
+
+    # -- decay ----------------------------------------------------------
+    def maybe_decay(self) -> None:
+        """Apply one decay pass if a decay interval has elapsed —
+        callers invoke this from their heartbeat, cadence-free."""
+        now = self._now()
+        if now - self._last_decay < self.params.decay_interval_s:
+            return
+        self._last_decay = now
+        self.decay()
+
+    def decay(self) -> None:
+        zero = self.params.decay_to_zero
+        dead = []
+        for peer_id, rec in self._peers.items():
+            rec.behaviour_penalty *= self.params.behaviour_penalty_decay
+            if rec.behaviour_penalty < zero:
+                rec.behaviour_penalty = 0.0
+            empty = rec.behaviour_penalty == 0.0
+            for topic, tc in rec.topics.items():
+                tp = self.topic_params(topic)
+                tc.first_deliveries *= tp.first_message_decay
+                if tc.first_deliveries < zero:
+                    tc.first_deliveries = 0.0
+                tc.mesh_deliveries *= tp.mesh_delivery_decay
+                if tc.mesh_deliveries < zero:
+                    tc.mesh_deliveries = 0.0
+                tc.invalid *= tp.invalid_message_decay
+                if tc.invalid < zero:
+                    tc.invalid = 0.0
+                if (tc.mesh_since is not None or tc.first_deliveries
+                        or tc.mesh_deliveries or tc.invalid):
+                    empty = False
+            if empty:
+                dead.append(peer_id)
+        for peer_id in dead:
+            del self._peers[peer_id]

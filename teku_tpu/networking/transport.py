@@ -26,6 +26,14 @@ KIND_REQUEST = 2
 KIND_RESPONSE = 3
 KIND_GOODBYE = 4
 
+# goodbye reason codes (spec p2p-interface Goodbye reasons 1-3 plus
+# the 128+ client-extension range real clients use)
+GOODBYE_SHUTDOWN = 1
+GOODBYE_IRRELEVANT_NETWORK = 2
+GOODBYE_FAULT = 3
+GOODBYE_BANNED = 128
+GOODBYE_TOO_MANY_PEERS = 129
+
 MAX_FRAME = 1 << 24
 
 
@@ -120,9 +128,12 @@ class P2PNetwork:
     static public key proven during the handshake."""
 
     def __init__(self, config: NetworkConfig, fork_digest: bytes,
-                 node_id: Optional[bytes] = None, static_key=None):
+                 node_id: Optional[bytes] = None, static_key=None,
+                 reputation=None):
+        from .reputation import ReputationManager
         self.config = config
         self.fork_digest = fork_digest
+        self.reputation = reputation or ReputationManager()
         if config.noise:
             if node_id is not None:
                 raise ValueError(
@@ -157,7 +168,7 @@ class P2PNetwork:
 
     async def stop(self) -> None:
         for p in list(self.peers):
-            await p.send_frame(KIND_GOODBYE, b"\x01")
+            await p.send_frame(KIND_GOODBYE, bytes([GOODBYE_SHUTDOWN]))
             p.close()
         self.peers.clear()
         if self._server is not None:
@@ -183,6 +194,10 @@ class P2PNetwork:
         await self._handshake(peer, noise_id)
         if not peer.connected:
             return None
+        if not self.reputation.is_connect_allowed(peer.node_id):
+            _LOG.info("dialed a banned peer, dropping")
+            peer.close()
+            return None
         if not self._resolve_duplicate(peer):
             peer.close()
             return None
@@ -207,11 +222,17 @@ class P2PNetwork:
         await self._handshake(peer, noise_id)
         if not peer.connected:
             return
+        if not self.reputation.is_connect_allowed(peer.node_id):
+            await peer.send_frame(KIND_GOODBYE,
+                                  bytes([GOODBYE_BANNED]))
+            peer.close()
+            return
         if not self._resolve_duplicate(peer):
             peer.close()
             return
         if len(self.peers) >= self.config.max_peers:
-            await peer.send_frame(KIND_GOODBYE, b"\x02")  # too many peers
+            await peer.send_frame(KIND_GOODBYE,
+                                  bytes([GOODBYE_TOO_MANY_PEERS]))
             peer.close()
             return
         self.peers.append(peer)
@@ -280,7 +301,10 @@ class P2PNetwork:
             return
         if peer.fork_digest != self.fork_digest:
             _LOG.info("peer on a different fork, disconnecting")
-            await peer.send_frame(KIND_GOODBYE, b"\x03")  # irrelevant net
+            await peer.send_frame(KIND_GOODBYE,
+                                  bytes([GOODBYE_IRRELEVANT_NETWORK]))
+            self.reputation.report_initiated_disconnect(
+                peer.node_id, GOODBYE_IRRELEVANT_NETWORK)
             peer.close()
         if peer.node_id == self.node_id:
             peer.close()                                  # self-dial
@@ -308,6 +332,10 @@ class P2PNetwork:
                     if fut is not None and not fut.done():
                         fut.set_result(payload[4:])
                 elif kind == KIND_GOODBYE:
+                    # a fault-citing goodbye means redialing is useless
+                    # for a while; remember that
+                    self.reputation.report_received_goodbye(
+                        peer.node_id, payload[0] if payload else None)
                     break
             except Exception:
                 _LOG.exception("peer frame handling failed")

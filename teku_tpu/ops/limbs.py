@@ -262,25 +262,53 @@ def from_mont(a):
 # Exponentiation with a static exponent (scan over constant bit vector)
 # --------------------------------------------------------------------------
 
-def pow_static(a, e: int):
+POW_WINDOW = 4
+
+
+def pow_static(a, e: int, window: int = POW_WINDOW):
     """a^e mod P for a static python-int exponent; a a Montgomery unit.
 
-    Square-and-multiply over the exponent's bits as a traced scan: one
-    sqr + one selected mul per bit, so the compiled graph is O(1) in the
-    exponent length while the runtime is O(bits).
+    Fixed-window exponentiation as a traced scan: the bit-serial form
+    pays one sqr AND one (select-discarded but still computed) mul per
+    bit — 2 mont ops/bit.  A 2^w table (built once: 2^w - 2 muls) and a
+    scan over the exponent's static base-2^w digits pays w sqrs + ONE
+    gathered mul per digit: for the 381-bit Fermat exponents that
+    dominate the verify pipeline (inversion, sqrt, sqrt_ratio) this is
+    ~489 mont ops instead of ~760.  The graph stays O(1) in exponent
+    length (one scan body; digits are a scanned array).
     """
     if e == 0:
         return jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape)
-    bits = np.array([(e >> i) & 1 for i in range(e.bit_length())][::-1],
-                    dtype=np.int64)
+    if e.bit_length() <= window:
+        # tiny exponent: square-and-multiply unrolled is smaller than
+        # any table
+        acc = a
+        for bit in bin(e)[3:]:
+            acc = mont_sqr(acc)
+            if bit == "1":
+                acc = mont_mul(acc, a)
+        return acc
+    n_digits = (e.bit_length() + window - 1) // window
+    digits = np.array(
+        [(e >> (window * i)) & ((1 << window) - 1)
+         for i in range(n_digits)][::-1], dtype=np.int64)
+    # table[d] = a^d, d in [0, 2^w) — scan-built so the graph holds
+    # one mont_mul body, not 2^w - 2 inlined copies
+    one = jnp.broadcast_to(jnp.asarray(ONE_MONT), np.shape(a))
 
-    def body(acc, bit):
-        acc = mont_sqr(acc)
-        acc = select(bit != 0, mont_mul(acc, a), acc)
+    def build(carry, _):
+        return mont_mul(carry, a), carry
+    _, table = lax.scan(build, one, None, length=1 << window)
+
+    def body(acc, d):
+        for _ in range(window):
+            acc = mont_sqr(acc)
+        acc = mont_mul(acc, jnp.take(table, d, axis=0))
         return acc, None
 
-    # First bit is always 1: start from a directly to save a step.
-    acc, _ = lax.scan(body, jnp.asarray(a), jnp.asarray(bits[1:]))
+    # top digit is nonzero (bit_length > window): start from its row
+    acc = jnp.take(table, jnp.asarray(digits[0]), axis=0)
+    acc, _ = lax.scan(body, acc, jnp.asarray(digits[1:]))
     return acc
 
 

@@ -30,6 +30,7 @@ from typing import NamedTuple
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -230,22 +231,56 @@ def point_eq(k: FieldKit, p, q):
 # Scalar multiplication
 # --------------------------------------------------------------------------
 
-def scalar_mul_bits(k: FieldKit, bits, p):
+SCALAR_WINDOW = 4
+
+
+def scalar_mul_bits(k: FieldKit, bits, p, window: int = SCALAR_WINDOW):
     """[s]P for runtime scalars given as a bit array.
 
     bits: int array (..., NBITS), MSB first, matching P's batch shape.
-    Constant-time scan: double every step, add selected by bit lane.
+
+    Fixed-window ladder: the bit-serial form pays a double AND a
+    (select-discarded but computed) add per bit — for the 64-bit batch
+    multipliers that is 64 doubles + 64 adds.  A per-lane 2^w table
+    (2^w - 2 adds once) and one gathered add per w-bit digit pays
+    64 doubles + 16 adds + 14 build adds: ~35% fewer point ops in the
+    scalars stage.  Still constant-time: every digit gathers and adds
+    (digit 0 adds the infinity row, which point_add absorbs).
     """
     nbits = bits.shape[-1]
-    acc = infinity_like(k, p[0])
+    if nbits % window:
+        window = 1                       # irregular widths: bit ladder
+    # table rows [0]P..[2^w - 1]P, stacked on a leading axis.  Built
+    # with a scan so the graph holds ONE point_add body (an unrolled
+    # build inlines 2^w - 2 adds and measurably bloats XLA compiles).
+    def build(carry, _):
+        return point_add(k, carry, p), carry
+    _, table = lax.scan(build, infinity_like(k, p[0]), None,
+                        length=1 << window)
 
-    def body(acc, i):
-        acc = point_double(k, acc)
-        added = point_add(k, acc, p)
-        acc = _select_point(k, bits[..., i] != 0, added, acc)
+    def gather(d):
+        # leaf (2^w, ..., L); d (...,) -> (..., L)
+        def take(leaf):
+            idx = jnp.broadcast_to(
+                d[None, ..., None], (1,) + d.shape + (leaf.shape[-1],))
+            return jnp.take_along_axis(leaf, idx, axis=0)[0]
+        return jax.tree_util.tree_map(take, table)
+
+    # MSB-first base-2^w digits, scanned: (..., nbits) -> (nwin, ...)
+    weights = jnp.asarray([1 << (window - 1 - t) for t in range(window)],
+                          dtype=bits.dtype)
+    digits = jnp.moveaxis(
+        (bits.reshape(bits.shape[:-1] + (-1, window)) * weights)
+        .sum(axis=-1), -1, 0)
+
+    def body(acc, d):
+        for _ in range(window):
+            acc = point_double(k, acc)
+        acc = point_add(k, acc, gather(d))
         return acc, None
 
-    acc, _ = lax.scan(body, acc, jnp.arange(nbits))
+    acc = gather(digits[0])              # leading doubles of inf elided
+    acc, _ = lax.scan(body, acc, digits[1:])
     return acc
 
 

@@ -1,0 +1,64 @@
+"""Storage pruners: bound the database's growth while the node runs.
+
+Equivalent of the reference's pruner family (reference: storage/src/
+main/java/tech/pegasys/teku/storage/server/pruner/BlobSidecarPruner.java,
+BlockPruner.java, StatePruner.java — periodic async jobs deleting data
+past their retention windows).  Here one throttled pass owns all three
+concerns:
+
+- blob sidecars past the data-availability window
+  (MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS — the spec serving horizon);
+- finalized blocks/states past an OPTIONAL retention window (off by
+  default: PRUNE mode already drops non-canonical data on
+  finalization, ARCHIVE mode means "keep everything" — an explicit
+  retention turns a node into a rolling-window node).
+
+The pass runs at most once per epoch, from the node's on_slot phase,
+and is synchronous-but-bounded: each pass walks only expired keys.
+"""
+
+import logging
+from typing import Optional
+
+_LOG = logging.getLogger(__name__)
+
+
+class StoragePruner:
+    def __init__(self, db, cfg,
+                 blob_retention_epochs: Optional[int] = None,
+                 history_retention_epochs: Optional[int] = None):
+        self.db = db
+        self.cfg = cfg
+        self.blob_retention_epochs = (
+            blob_retention_epochs if blob_retention_epochs is not None
+            else cfg.MIN_EPOCHS_FOR_BLOB_SIDECARS_REQUESTS)
+        self.history_retention_epochs = history_retention_epochs
+        self._last_pruned_epoch = -1
+        # observability (the reference exports the same counters)
+        self.blobs_pruned_total = 0
+        self.blocks_pruned_total = 0
+        self.states_pruned_total = 0
+
+    def on_slot(self, slot: int) -> None:
+        epoch = slot // self.cfg.SLOTS_PER_EPOCH
+        if epoch == self._last_pruned_epoch \
+                or slot % self.cfg.SLOTS_PER_EPOCH != 0:
+            return
+        self._last_pruned_epoch = epoch
+        spe = self.cfg.SLOTS_PER_EPOCH
+        blob_cutoff = (epoch - self.blob_retention_epochs) * spe
+        if blob_cutoff > 0:
+            n = self.db.prune_blob_sidecars(blob_cutoff)
+            self.blobs_pruned_total += n
+            if n:
+                _LOG.info("pruned %d blob sidecars below slot %d",
+                          n, blob_cutoff)
+        if self.history_retention_epochs is not None:
+            cutoff = (epoch - self.history_retention_epochs) * spe
+            if cutoff > 0:
+                b, s = self.db.prune_finalized_history(cutoff)
+                self.blocks_pruned_total += b
+                self.states_pruned_total += s
+                if b or s:
+                    _LOG.info("pruned %d blocks / %d states below "
+                              "slot %d", b, s, cutoff)

@@ -147,6 +147,13 @@ def test_pool_rest_family_and_balances():
                          "voluntary_exits"):
                 empty = await get(f"/eth/v1/beacon/pool/{name}")
                 assert empty["data"] == []
+            # v2 pool family: versioned envelope
+            for name in ("attester_slashings", "proposer_slashings"):
+                v2 = await get(f"/eth/v2/beacon/pool/{name}")
+                assert v2["data"] == []
+                assert v2["version"] in (
+                    "phase0", "altair", "bellatrix", "capella",
+                    "deneb", "electra")
             # balances: full + filtered
             bal = await get(
                 "/eth/v1/beacon/states/head/validator_balances")

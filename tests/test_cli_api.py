@@ -413,3 +413,61 @@ def test_validator_subscription_and_registration_endpoints():
             await api.stop()
             await nn.stop()
     asyncio.run(run())
+
+
+def test_debug_and_admin_subcommands(tmp_path, capsys):
+    gen = tmp_path / "g.ssz"
+    assert main(["genesis", "--validators", "8", "--out", str(gen)]) == 0
+    capsys.readouterr()
+    assert main(["debug", "pretty-print", "state", str(gen)]) == 0
+    out = capsys.readouterr().out
+    assert "BeaconState:" in out and "genesis_time" in out
+    assert main(["admin", "weak-subjectivity", "--state", str(gen),
+                 "--current-epoch", "10"]) == 0
+    out = capsys.readouterr().out
+    assert "weak subjectivity period" in out
+    # far beyond the period: exit code 2 signals "outside"
+    assert main(["admin", "weak-subjectivity", "--state", str(gen),
+                 "--current-epoch", "99999"]) == 2
+
+
+def test_migrate_database_between_modes(tmp_path, capsys):
+    """archive -> prune drops snapshots/index; prune -> archive
+    rebuilds the slot index from the persisted chain."""
+    from teku_tpu.spec import config as C
+    from teku_tpu.spec.builder import make_local_signer, produce_block
+    from teku_tpu.spec.datastructures import SCHEMAS_MINIMAL as S
+    from teku_tpu.spec.genesis import interop_genesis
+    from teku_tpu.storage.database import Database
+
+    cfg = C.MINIMAL
+    spec = create_spec("minimal")
+    data_dir = tmp_path / "node"
+    data_dir.mkdir()
+    db = Database(data_dir / "chain.db", spec, mode="archive",
+                  state_snapshot_interval=1)
+    state, sks = interop_genesis(cfg, 16)
+    signer = make_local_signer(dict(enumerate(sks)))
+    anchor = S.BeaconBlock(slot=0, parent_root=bytes(32),
+                           state_root=state.htr(),
+                           body=S.BeaconBlockBody())
+    db.save_anchor(anchor, state)
+    cur, roots = state, []
+    for slot in range(1, 4):
+        signed, post = produce_block(cfg, cur, slot, signer)
+        db.save_block(signed, post)
+        roots.append(signed.message.htr())
+        cur = post
+    db.close()
+    assert main(["migrate-database", "--data-dir", str(data_dir),
+                 "--to", "prune"]) == 0
+    assert "migrated to prune" in capsys.readouterr().out
+    db = Database(data_dir / "chain.db", spec, mode="prune")
+    # anchor state survives, per-block snapshots are gone
+    assert db.get_state(anchor.htr()) is not None
+    assert db.get_state(roots[-1]) is None
+    assert db.get_block(roots[-1]) is not None
+    db.close()
+    assert main(["migrate-database", "--data-dir", str(data_dir),
+                 "--to", "archive"]) == 0
+    assert "migrated to archive" in capsys.readouterr().out

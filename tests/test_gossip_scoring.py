@@ -1,0 +1,292 @@
+"""Gossipsub v1.1 peer-scoring model: per-topic weighted components
+with decaying counters (reference: networking/eth2/.../gossip/config/
+GossipScoringConfigurator.java builds the same parameter families).
+"""
+
+import asyncio
+import random
+
+from teku_tpu.networking import gossip as G
+from teku_tpu.networking.scoring import (GossipScoring, PeerScoreParams,
+                                         TopicScoreParams,
+                                         eth2_topic_params)
+
+PEER = b"\x01" * 32
+TOPIC = "beacon_block"
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _scoring(**kw):
+    clock = _Clock()
+    tp = kw.pop("topic_params", None) or (lambda t: TopicScoreParams())
+    s = GossipScoring(params=PeerScoreParams(**kw), topic_params=tp,
+                      time_fn=clock)
+    return s, clock
+
+
+def test_time_in_mesh_rewards_and_caps():
+    # P3 off so long mesh tenure with no deliveries isolates P1
+    s, clock = _scoring(topic_params=lambda t: TopicScoreParams(
+        mesh_delivery_weight=0.0))
+    s.on_graft(PEER, TOPIC)
+    assert s.score(PEER) == 0.0
+    clock.t += 24.0                       # two quanta
+    tp = s.topic_params(TOPIC)
+    expect = tp.topic_weight * tp.time_in_mesh_weight * 2.0
+    assert abs(s.score(PEER) - expect) < 1e-9
+    clock.t += 10_000_000.0               # way past the cap
+    capped = tp.topic_weight * tp.time_in_mesh_weight \
+        * tp.time_in_mesh_cap
+    assert abs(s.score(PEER) - capped) < 1e-9
+
+
+def test_first_deliveries_count_and_cap():
+    s, _ = _scoring()
+    tp = s.topic_params(TOPIC)
+    for _ in range(int(tp.first_message_cap) + 25):
+        s.on_first_delivery(PEER, TOPIC)
+    expect = tp.topic_weight * tp.first_message_weight \
+        * tp.first_message_cap
+    assert abs(s.score(PEER) - expect) < 1e-9
+
+
+def test_invalid_penalty_is_squared_and_beats_linear_credit():
+    """The r4-scalar attack: alternate valid and invalid traffic.
+    Squared P4 with capped P2 must drive the score down."""
+    s, _ = _scoring()
+    for _ in range(60):
+        s.on_first_delivery(PEER, TOPIC)
+        s.on_invalid(PEER, TOPIC)
+    assert s.score(PEER) < 0
+
+
+def test_mesh_delivery_deficit_activates_after_window():
+    s, clock = _scoring()
+    s.on_graft(PEER, TOPIC)
+    tp = s.topic_params(TOPIC)
+    # inside the activation window: no deficit penalty yet
+    clock.t += tp.mesh_delivery_activation_s / 2
+    assert s.score(PEER) >= 0
+    # past the window with zero deliveries: squared deficit applies
+    clock.t += tp.mesh_delivery_activation_s
+    deficit = tp.mesh_delivery_threshold
+    expect_p3 = tp.mesh_delivery_weight * deficit * deficit
+    assert s.score(PEER) < 0
+    assert s.score(PEER) <= tp.topic_weight * expect_p3 / 2
+    # meeting the duty clears the penalty
+    for _ in range(int(tp.mesh_delivery_threshold)):
+        s.on_duplicate_delivery(PEER, TOPIC)
+    assert s.score(PEER) >= 0
+
+
+def test_prune_resets_mesh_counters():
+    s, clock = _scoring()
+    s.on_graft(PEER, TOPIC)
+    clock.t += 120.0
+    s.on_prune(PEER, TOPIC)
+    # no longer in mesh: neither P1 credit nor P3 deficit
+    assert s.score(PEER) == 0.0
+
+
+def test_behaviour_penalty_squared_above_threshold():
+    s, _ = _scoring()
+    thr = s.params.behaviour_penalty_threshold
+    s.add_behaviour_penalty(PEER, thr)     # exactly at tolerance
+    assert s.score(PEER) == 0.0
+    s.add_behaviour_penalty(PEER, 2.0)
+    expect = s.params.behaviour_penalty_weight * 4.0
+    assert abs(s.score(PEER) - expect) < 1e-9
+
+
+def test_positive_topic_sum_capped_but_penalties_uncapped():
+    s, _ = _scoring(topic_score_cap=5.0)
+    for _ in range(1000):
+        s.on_first_delivery(PEER, TOPIC)
+    assert s.score(PEER) == 5.0
+    s.add_behaviour_penalty(PEER, s.params.behaviour_penalty_threshold
+                            + 10.0)
+    assert s.score(PEER) < 5.0 - 100.0 * abs(
+        s.params.behaviour_penalty_weight) / 2
+
+
+def test_decay_forgives_and_garbage_collects():
+    s, _ = _scoring()
+    s.on_invalid(PEER, TOPIC)
+    s.add_behaviour_penalty(PEER, 10.0)
+    assert s.score(PEER) < 0
+    for _ in range(200):
+        s.decay()
+    assert s.score(PEER) == 0.0
+    assert PEER not in s._peers            # record GC'd
+
+
+def test_eth2_topic_families():
+    att = eth2_topic_params("beacon_attestation_7")
+    blk = eth2_topic_params("beacon_block")
+    exi = eth2_topic_params("voluntary_exit")
+    assert att.topic_weight < blk.topic_weight
+    assert exi.mesh_delivery_weight == 0.0   # no mesh duty for rare ops
+    assert att.invalid_message_weight < blk.invalid_message_weight
+
+
+def test_router_graylists_on_repeated_invalid_messages():
+    """End-to-end through the router: REJECT-heavy traffic drives the
+    peer below the graylist threshold and the router closes it."""
+    from teku_tpu.node.gossip import TopicHandler, ValidationResult
+
+    class _RejectHandler(TopicHandler):
+        async def handle_message(self, data):
+            return ValidationResult.REJECT
+
+    class _FakePeer:
+        def __init__(self):
+            self.node_id = b"\x07" * 32
+            self.connected = True
+
+        async def send_frame(self, kind, payload):
+            pass
+
+        def close(self):
+            self.connected = False
+
+    class _FakeNet:
+        def __init__(self, peer):
+            self.peers = [peer]
+            self.on_gossip = None
+            self.on_peer_disconnected = None
+
+    async def run():
+        peer = _FakePeer()
+        router = G.TcpGossipNetwork(_FakeNet(peer),
+                                    rng=random.Random(1))
+        router.subscribe("beacon_block", _RejectHandler())
+        i = 0
+        while peer.connected and i < 200:
+            await router._on_gossip(
+                peer, router._encode_data("beacon_block",
+                                          b"junk-%d" % i))
+            i += 1
+        assert not peer.connected          # graylisted and closed
+        assert router.scoring.score(peer.node_id) \
+            <= router.scoring.params.graylist_threshold
+    asyncio.run(run())
+
+
+def test_reconnect_does_not_wash_score():
+    """Review regression: a penalized peer that drops and redials
+    keeps its negative counters (retainScore)."""
+    s, _ = _scoring()
+    s.on_invalid(PEER, TOPIC)
+    before = s.score(PEER)
+    assert before < 0
+    s.on_disconnect(PEER)
+    assert s.score(PEER) == before           # counters retained
+    s.on_graft(PEER, TOPIC)                  # "reconnected" + grafted
+    assert s.score(PEER) <= before           # still carrying the sin
+
+
+def test_eviction_backoff_prevents_same_heartbeat_regraft():
+    """Review regression: a P3-deficit eviction must not re-graft the
+    same peer in the same (or next) heartbeat pass."""
+    async def run():
+        net, router, _ = _fresh_router(3)
+        router.heartbeat()                    # initial grafting
+        victim = next(iter(router._mesh[TOPIC]))
+        # P4 invalid: drives score below zero without touching P3
+        router.scoring.on_invalid(victim.node_id, TOPIC)
+        assert router.scoring.score(victim.node_id) < 0
+        router.heartbeat()                    # evicts with backoff
+        assert victim not in router._mesh[TOPIC]
+        # even after the P4 counter decays back to zero, the backoff
+        # still holds the peer out of the refill
+        for _ in range(100):
+            router.scoring.decay()
+        assert router.scoring.score(victim.node_id) == 0.0
+        router.heartbeat()
+        assert victim not in router._mesh[TOPIC]
+        # once the backoff expires it may rejoin
+        router._heartbeats += G.PRUNE_BACKOFF_HEARTBEATS
+        router.heartbeat()
+        assert victim in router._mesh[TOPIC]
+    asyncio.run(run())
+
+
+def test_graft_during_backoff_costs_behaviour_score():
+    async def run():
+        net, router, _ = _fresh_router(2)
+        peer = net.peers[0]
+        await router._on_gossip(peer, G.encode_control(
+            prune=[TOPIC]))                   # peer prunes us: backoff
+        before = router.scoring._peers.get(peer.node_id)
+        before_bp = before.behaviour_penalty if before else 0.0
+        await router._on_gossip(peer, G.encode_control(
+            graft=[TOPIC]))                   # rude re-graft
+        assert peer not in router._mesh[TOPIC]
+        rec = router.scoring._peers[peer.node_id]
+        assert rec.behaviour_penalty > before_bp
+    asyncio.run(run())
+
+
+def test_duplicate_credit_only_inside_delivery_window():
+    """Review regression: replaying one stale message must not farm
+    P3 mesh-delivery credit forever."""
+    async def run():
+        net, router, _ = _fresh_router(2)
+        peer = net.peers[0]
+        router._mesh_add(TOPIC, peer)
+        frame = router._encode_data(TOPIC, b"the-message")[1:]
+        await router._on_data(peer, frame)    # first: validated
+        await router._on_data(peer, frame)    # dup inside window
+        rec = router.scoring._peers[peer.node_id]
+        in_window = rec.topics[TOPIC].mesh_deliveries
+        assert in_window >= 2.0
+        # expire the window; replays no longer credit
+        for _ in range(G.DELIVERY_WINDOW_HEARTBEATS + 1):
+            router.heartbeat()
+        for _ in range(10):
+            await router._on_data(peer, frame)
+        after = router.scoring._peers[peer.node_id] \
+            .topics[TOPIC].mesh_deliveries
+        assert after <= in_window * 1.0 + 1e-9   # no new credit
+    asyncio.run(run())
+
+
+def _fresh_router(n_peers):
+    """Tiny fake-net router (mirrors test_gossipsub's harness)."""
+    from teku_tpu.node.gossip import TopicHandler, ValidationResult
+
+    class _Accept(TopicHandler):
+        async def handle_message(self, data):
+            return ValidationResult.ACCEPT
+
+    class _FakePeer:
+        def __init__(self, nid):
+            self.node_id = bytes([nid]) * 32
+            self.connected = True
+
+        async def send_frame(self, kind, payload):
+            pass
+
+        def close(self):
+            self.connected = False
+
+    class _FakeNet:
+        def __init__(self, n):
+            self.peers = [_FakePeer(i + 1) for i in range(n)]
+            self.on_gossip = None
+            self.on_peer_disconnected = None
+
+    net = _FakeNet(n_peers)
+    router = G.TcpGossipNetwork(net, rng=random.Random(7))
+    handler = _Accept()
+    router.subscribe(TOPIC, handler)
+    for p in net.peers:
+        router._peer_topics[p.node_id] = {TOPIC}
+    return net, router, handler

@@ -1,0 +1,86 @@
+"""Storage pruners: blob DA-window pruning, optional history
+retention, epoch-throttled passes (reference: storage/.../server/
+pruner/BlobSidecarPruner.java, BlockPruner.java, StatePruner.java).
+"""
+
+from teku_tpu.node.blobs import BlobSidecar
+from teku_tpu.spec import config as C, create_spec
+from teku_tpu.spec.builder import make_local_signer, produce_block
+from teku_tpu.spec.datastructures import SCHEMAS_MINIMAL as S
+from teku_tpu.spec.genesis import interop_genesis
+from teku_tpu.storage.database import Database
+from teku_tpu.storage.pruner import StoragePruner
+
+CFG = C.MINIMAL
+
+
+def _db(tmp_path, mode="archive"):
+    return Database(tmp_path / "db", create_spec("minimal"), mode=mode)
+
+
+def _sc(root, slot, index, tag=b"\x00"):
+    return BlobSidecar(index=index, blob=b"", kzg_commitment=tag * 48,
+                       kzg_proof=b"\x00" * 48, block_root=root,
+                       slot=slot)
+
+
+def test_blob_sidecars_roundtrip_and_prune(tmp_path):
+    db = _db(tmp_path)
+    r1, r2 = b"\x01" * 32, b"\x02" * 32
+    db.save_blob_sidecars(r1, [_sc(r1, 8, i) for i in range(2)])
+    db.save_blob_sidecars(r2, [_sc(r2, 64, 0, tag=b"\x11")])
+    assert len(db.get_blob_sidecars(r1)) == 2
+    assert len(db.get_blob_sidecars(r2)) == 1
+    # round-trip preserves wire bytes
+    raw = db.get_blob_sidecars(r2)[0]
+    assert BlobSidecar.deserialize(raw).kzg_commitment == b"\x11" * 48
+    removed = db.prune_blob_sidecars(cutoff_slot=32)
+    assert removed == 2
+    assert db.get_blob_sidecars(r1) == []
+    assert len(db.get_blob_sidecars(r2)) == 1
+    db.close()
+
+
+def test_pruner_runs_once_per_epoch_and_uses_da_window(tmp_path):
+    db = _db(tmp_path)
+    root = b"\x03" * 32
+    db.save_blob_sidecars(root, [_sc(root, 0, 0)])
+    pruner = StoragePruner(db, CFG, blob_retention_epochs=2)
+    spe = CFG.SLOTS_PER_EPOCH
+    pruner.on_slot(1 * spe)           # cutoff would be negative: no-op
+    assert pruner.blobs_pruned_total == 0
+    pruner.on_slot(3 * spe)           # cutoff = (3-2)*spe > 0: prunes
+    assert pruner.blobs_pruned_total == 1
+    before = pruner.blobs_pruned_total
+    pruner.on_slot(3 * spe + 1)       # mid-epoch: throttled
+    assert pruner.blobs_pruned_total == before
+    db.close()
+
+
+def test_history_retention_prunes_blocks_and_states(tmp_path):
+    """A rolling-window node: finalized history past the retention is
+    dropped; the anchor and recent history survive."""
+    db = _db(tmp_path)
+    state, sks = interop_genesis(CFG, 32)
+    signer = make_local_signer(dict(enumerate(sks)))
+    anchor = S.BeaconBlock(slot=0, parent_root=bytes(32),
+                           state_root=state.htr(),
+                           body=S.BeaconBlockBody())
+    db.save_anchor(anchor, state)
+    cur = state
+    last_root = None
+    for slot in range(1, 6):
+        signed, post = produce_block(CFG, cur, slot, signer)
+        db.save_block(signed, post)
+        last_root = signed.message.htr()
+        db._kv.put(b"sl/" + slot.to_bytes(8, "big"), last_root)
+        db._kv.put(b"st/" + last_root, type(post).serialize(post))
+        cur = post
+    blocks, states = db.prune_finalized_history(cutoff_slot=4)
+    assert blocks == 3 and states == 3
+    # anchor + recent blocks intact
+    assert db.load_anchor() is not None
+    assert db.canonical_root_at_slot(1) is None
+    assert db.canonical_root_at_slot(5) is not None
+    assert db.get_block(last_root) is not None
+    db.close()

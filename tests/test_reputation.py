@@ -1,0 +1,91 @@
+"""Peer reputation book: graded adjustments, disconnect floor,
+time-bounded bans, and the transport admission gate (reference:
+networking/p2p/.../reputation/DefaultReputationManager.java).
+"""
+
+import asyncio
+
+import pytest
+
+from teku_tpu.networking import transport as T
+from teku_tpu.networking.reputation import (Adjustment,
+                                            ReputationManager)
+
+NID = b"\x42" * 32
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_adjust_clamps_and_disconnects_at_floor():
+    rep = ReputationManager(time_fn=_Clock())
+    for _ in range(100):
+        assert not rep.adjust(NID, Adjustment.LARGE_REWARD)
+    assert rep.score(NID) == 150.0          # clamped at MAX_SCORE
+    # the floor is an absolute score, so even a maxed-out peer can
+    # fall: 30 large penalties from +150
+    hit = False
+    for _ in range(60):
+        if rep.adjust(NID, Adjustment.LARGE_PENALTY):
+            hit = True
+            break
+    assert hit
+    assert not rep.is_connect_allowed(NID)   # banned
+
+
+def test_ban_expires_and_forgives():
+    clock = _Clock()
+    rep = ReputationManager(time_fn=clock, ban_period_s=100.0)
+    while not rep.adjust(NID, -50.0):
+        pass
+    assert not rep.is_connect_allowed(NID)
+    clock.t += 99.0
+    assert not rep.is_connect_allowed(NID)
+    clock.t += 2.0
+    assert rep.is_connect_allowed(NID)
+    assert rep.score(NID) == 0.0             # forgiven with the ban
+
+
+def test_ban_worthy_goodbye_codes():
+    clock = _Clock()
+    rep = ReputationManager(time_fn=clock)
+    rep.report_received_goodbye(NID, 1)      # clean shutdown: no ban
+    assert rep.is_connect_allowed(NID)
+    rep.report_received_goodbye(NID, 3)      # fault: ban
+    assert not rep.is_connect_allowed(NID)
+    other = b"\x43" * 32
+    rep.report_initiated_disconnect(other, 128)
+    assert not rep.is_connect_allowed(other)
+    # transient conditions never ban: shutdown (1), too-many-peers (129)
+    third = b"\x44" * 32
+    rep.report_received_goodbye(third, 129)
+    assert rep.is_connect_allowed(third)
+
+
+@pytest.mark.slow
+def test_banned_peer_refused_at_transport():
+    """Real TCP: node A bans node B's id; B's dial completes the
+    handshake but is refused admission with a goodbye."""
+    async def run():
+        a = T.P2PNetwork(T.NetworkConfig(noise=False), b"\x00" * 4,
+                         node_id=b"\x0a" * 32)
+        b = T.P2PNetwork(T.NetworkConfig(noise=False), b"\x00" * 4,
+                         node_id=b"\x0b" * 32)
+        await a.start()
+        await b.start()
+        try:
+            a.reputation.report_initiated_disconnect(b.node_id, 3)
+            peer = await b.connect("127.0.0.1", a.port)
+            # give A's accept path a beat to refuse
+            await asyncio.sleep(0.2)
+            assert a.peers == []
+            assert peer is None or not peer.connected
+        finally:
+            await a.stop()
+            await b.stop()
+    asyncio.run(run())
