@@ -140,6 +140,21 @@ def _arm_process_guards() -> None:
         WD = _Watchdog()
 
 
+_BACKEND_STATES: list = []
+
+
+def _backend_state(state: str, **extra) -> None:
+    """Record a supervisor-style backend state transition (COLD →
+    PROBING → READY/DEGRADED) with a timestamp, into BOTH the heartbeat
+    stream and the final JSON — so BENCH_*.json shows WHY this run
+    served the backend it served (`infra/supervisor.py:BackendState`
+    names; the node's supervisor emits the same vocabulary)."""
+    _BACKEND_STATES.append({"state": state, "t": round(time.time(), 1),
+                            **extra})
+    OUT["backend_states"] = _BACKEND_STATES
+    _beat("backend_state", state=state, **extra)
+
+
 _PROBE_CODE = ("import jax, json, sys\n"
                "d = jax.devices()[0]\n"
                "print(json.dumps({'platform': d.platform, "
@@ -220,11 +235,13 @@ def _init_device(deadline):
     eat the budget: subprocess probes with hard deadlines first (with
     retries), CPU fallback on exhaustion, watchdog on the in-process
     init that follows a successful probe."""
+    _backend_state("probing")
     platform, detail = _probe_with_retries(deadline)
     if platform is None:
         # fast-fail to CPU: the env var must be set BEFORE jax imports
         os.environ["JAX_PLATFORMS"] = "cpu"
         OUT["fallback"] = f"tpu init failed: {detail}"
+        _backend_state("degraded", why=detail)
 
     # the probe proved (or disproved) the backend in a disposable
     # process; the in-process init after a good probe should be quick,
@@ -245,6 +262,8 @@ def _init_device(deadline):
     devs = jax.devices()
     WD.disarm()
     OUT["device"] = str(devs[0])
+    if platform is not None:
+        _backend_state("ready", device=OUT["device"])
     _beat("device_ready", device=OUT["device"])
     return jax
 
@@ -511,6 +530,7 @@ def main():
     except OSError:
         pass
     _beat("bench_start", budget_s=budget_s)
+    _backend_state("cold")
     # 256 first: it doubles as the latency phase's service bucket.
     # 512 is BASELINE.md measurement config 2's missing size (r4 never
     # measured it); 1/64/512/4096 are the advertised batch points.

@@ -11,6 +11,7 @@ verification kill-switch (reference BLS.java:93 BLSConstants.verificationDisable
 
 from typing import List, Optional, Sequence, Tuple
 
+from ...infra import faults
 from .pure_impl import (G1_INFINITY, G2_INFINITY, PureBls12381, keygen,
                         random_secret_key)
 from .spi import BLS12381, BatchSemiAggregate
@@ -111,10 +112,16 @@ def batch_verify(
         return True
     if not triples:
         return True
+    # `bls.batch_verify` fault site: every backend's batch dispatch
+    # crosses this facade, so wrong-result/hang/raise injection here
+    # exercises the service-layer bisect and breaker paths uniformly
+    faults.check("bls.batch_verify")
     if len(triples) == 1:
         pks, msg, sig = triples[0]
-        return _IMPL.fast_aggregate_verify(pks, msg, sig)
-    return _IMPL.batch_verify(triples)
+        ok = _IMPL.fast_aggregate_verify(pks, msg, sig)
+    else:
+        ok = _IMPL.batch_verify(triples)
+    return faults.transform("bls.batch_verify", ok)
 
 
 def prepare_batch_verify(

@@ -41,6 +41,12 @@ class KzgError(ValueError):
     """Malformed blob/commitment/proof input."""
 
 
+class BackendUnavailable(RuntimeError):
+    """The accelerated backend cannot serve this dispatch (circuit
+    open, deadline overrun, device fault).  The facade falls through to
+    the host path: a sick device costs latency, never a verdict."""
+
+
 # --------------------------------------------------------------------------
 # Roots of unity (bit-reversed order, matching c-kzg's Lagrange layout)
 # --------------------------------------------------------------------------
@@ -231,8 +237,11 @@ def blob_to_kzg_commitment(blob: bytes,
         y = evaluate_polynomial_in_evaluation_form(poly, setup.tau)
         return C.g1_compress(C.point_mul(C.FQ_OPS, y, G1))
     if _BACKEND is not None:
-        # device ladder MSM over the Lagrange basis (ops/kzg.py)
-        return _BACKEND.g1_lincomb(setup, poly)
+        try:
+            # device ladder MSM over the Lagrange basis (ops/kzg.py)
+            return _BACKEND.g1_lincomb(setup, poly)
+        except BackendUnavailable:
+            pass                 # host Pippenger serves this call
     pt = g1_msm(setup.g1_lagrange, poly)
     return C.g1_compress(pt)
 
@@ -265,7 +274,10 @@ def compute_kzg_proof_impl(poly: List[int], z: int,
         q_tau = evaluate_polynomial_in_evaluation_form(quotient, setup.tau)
         return C.g1_compress(C.point_mul(C.FQ_OPS, q_tau, G1)), y
     if _BACKEND is not None:
-        return _BACKEND.g1_lincomb(setup, quotient), y
+        try:
+            return _BACKEND.g1_lincomb(setup, quotient), y
+        except BackendUnavailable:
+            pass
     return C.g1_compress(g1_msm(setup.g1_lagrange, quotient)), y
 
 
@@ -322,15 +334,12 @@ def verify_kzg_proof_impl(commitment_pt, z: int, y: int, proof_pt,
     return out == F.FQ12_ONE
 
 
-def verify_blob_kzg_proof(blob: bytes, commitment: bytes, proof: bytes,
-                          setup: Optional[TrustedSetup] = None) -> bool:
-    """reference KZG.verifyBlobKzgProof (CKZG4844.java:104-113)."""
-    if _BACKEND is not None and len(blob) == BYTES_PER_BLOB:
-        try:
-            return _BACKEND.verify_blob_kzg_proof(
-                blob, commitment, proof, setup or get_setup())
-        except KzgError:
-            return False
+def _verify_blob_kzg_proof_host(blob: bytes, commitment: bytes,
+                                proof: bytes,
+                                setup: Optional[TrustedSetup] = None
+                                ) -> bool:
+    """Host-only pairing path — shared by the no-backend case and the
+    BackendUnavailable fallbacks (which must NOT re-enter the device)."""
     try:
         c_pt = _decompress_g1_checked(commitment, "commitment")
         p_pt = _decompress_g1_checked(proof, "proof")
@@ -340,6 +349,20 @@ def verify_blob_kzg_proof(blob: bytes, commitment: bytes, proof: bytes,
     z = compute_challenge(blob, commitment)
     y = evaluate_polynomial_in_evaluation_form(poly, z)
     return verify_kzg_proof_impl(c_pt, z, y, p_pt, setup)
+
+
+def verify_blob_kzg_proof(blob: bytes, commitment: bytes, proof: bytes,
+                          setup: Optional[TrustedSetup] = None) -> bool:
+    """reference KZG.verifyBlobKzgProof (CKZG4844.java:104-113)."""
+    if _BACKEND is not None and len(blob) == BYTES_PER_BLOB:
+        try:
+            return _BACKEND.verify_blob_kzg_proof(
+                blob, commitment, proof, setup or get_setup())
+        except KzgError:
+            return False
+        except BackendUnavailable:
+            pass                 # host pairing path serves this call
+    return _verify_blob_kzg_proof_host(blob, commitment, proof, setup)
 
 
 # Pluggable accelerated backend (the KZG analogue of the BLS facade's
@@ -352,6 +375,10 @@ _BACKEND = None
 def set_backend(backend) -> None:
     global _BACKEND
     _BACKEND = backend
+
+
+def get_backend():
+    return _BACKEND
 
 
 def backend_name() -> str:
@@ -377,6 +404,12 @@ def verify_blob_kzg_proof_batch(blobs: Sequence[bytes],
                 blobs, commitments, proofs, setup or get_setup())
         except KzgError:
             return False
+        except BackendUnavailable:
+            # the device just failed this batch: serve it entirely from
+            # the host path rather than paying a fresh device deadline
+            # per blob on a backend we know is sick
+            return all(_verify_blob_kzg_proof_host(b, c, p, setup)
+                       for b, c, p in zip(blobs, commitments, proofs))
     return all(verify_blob_kzg_proof(b, c, p, setup)
                for b, c, p in zip(blobs, commitments, proofs))
 

@@ -11,6 +11,7 @@ ordered queue for single-writer subsystems like fork choice.
 
 import asyncio
 import logging
+import random
 import time
 from typing import Awaitable, Callable, Optional, TypeVar
 
@@ -114,17 +115,38 @@ class RepeatingTask:
 
 async def retry_with_backoff(fn: Callable[[], Awaitable[T]],
                              attempts: int = 3, base_delay_s: float = 0.5,
-                             what: str = "operation") -> T:
+                             what: str = "operation",
+                             jitter: float = 0.0,
+                             max_delay_s: float = 60.0,
+                             retry_on: tuple = (Exception,),
+                             giveup: Optional[Callable[
+                                 [BaseException], bool]] = None) -> T:
     """Bounded exponential-backoff retry (the reference's
-    FailedExecutionPool / RetryingStorageUpdateChannel pattern)."""
+    FailedExecutionPool / RetryingStorageUpdateChannel pattern).
+
+    `jitter` adds up to that fraction of random extra delay so fleets
+    of retriers don't synchronize; `retry_on` narrows which exceptions
+    are transient — anything else propagates immediately (a malformed
+    response must fail loudly, not get three more chances); `giveup`
+    inspects a caught exception and aborts the remaining attempts when
+    it returns True (e.g. ImportError: no amount of retrying installs
+    a missing package)."""
     last: Optional[BaseException] = None
+    made = 0
     for i in range(attempts):
         try:
             return await fn()
         except asyncio.CancelledError:
             raise
-        except Exception as exc:
+        except retry_on as exc:
             last = exc
+            made = i + 1
+            if giveup is not None and giveup(exc):
+                break
             if i + 1 < attempts:
-                await asyncio.sleep(base_delay_s * (2 ** i))
-    raise RuntimeError(f"{what} failed after {attempts} attempts") from last
+                delay = min(base_delay_s * (2 ** i), max_delay_s)
+                if jitter:
+                    delay *= 1.0 + random.random() * jitter
+                await asyncio.sleep(delay)
+    raise RuntimeError(
+        f"{what} failed after {made} attempt(s)") from last

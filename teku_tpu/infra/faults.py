@@ -1,0 +1,184 @@
+"""Fault-injection harness: deterministic failure modes at named sites.
+
+The robustness counterpart of the reference's acceptance-test chaos
+hooks (reference: acceptance-tests/.../dsl/TekuNode.java restart/kill
+semantics): production code calls `check(site)` / `transform(site, v)`
+at its dispatch seams, and tests install faults keyed by site to prove
+the supervisor/breaker state machine end to end — dispatch hangs,
+dispatch exceptions, wrong results, slow-ramp backend init, and queue
+overflow — without ever touching a real accelerator.
+
+Sites in use (grep for `faults.check` / `faults.transform`):
+
+- ``backend.init``       device bring-up probe (SlowRamp / Raise / Hang)
+- ``bls.dispatch``       JaxBls12381._dispatch device call
+- ``bls.batch_verify``   the BLS facade's batch entry (WrongResult)
+- ``kzg.dispatch``       device KZG backend calls
+- ``sigservice.enqueue`` batching-service queue admission (Overflow)
+- ``verifiers.dispatch`` the spec-level verifier seam
+
+The no-fault fast path is one module-global bool check, so production
+traffic pays nothing for the instrumentation.  The registry is
+process-global on purpose: dispatch sites run inside worker threads and
+jitted call stacks where plumbing a context object through would leak
+test concerns into kernel signatures.
+"""
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["Fault", "Hang", "Raise", "WrongResult", "SlowRamp",
+           "Overflow", "inject", "clear", "active", "check", "transform",
+           "fired_count"]
+
+
+class Fault:
+    """One injectable failure.  `times` bounds how often it fires
+    (None = every time until cleared).  `kind` decides whether the
+    fault spends its budget at check() (entry) or transform() (result)
+    — a WrongResult must not be consumed by the entry hook."""
+
+    kind = "check"
+
+    def __init__(self, times: Optional[int] = None):
+        self.times = times
+        self.fired = 0
+
+    def _consume(self) -> bool:
+        if self.times is not None and self.fired >= self.times:
+            return False
+        self.fired += 1
+        return True
+
+    # subclasses override exactly one of these
+    def on_check(self) -> None:  # pragma: no cover - interface
+        pass
+
+    def on_transform(self, value):
+        return value
+
+
+class Hang(Fault):
+    """Dispatch hang: the call blocks for `seconds` (long enough to
+    overrun a breaker deadline, short enough for tests)."""
+
+    def __init__(self, seconds: float, times: Optional[int] = None):
+        super().__init__(times)
+        self.seconds = seconds
+
+    def on_check(self) -> None:
+        time.sleep(self.seconds)
+
+
+class Raise(Fault):
+    """Dispatch exception: the call raises `exc` (an instance or a
+    zero-arg factory)."""
+
+    def __init__(self, exc, times: Optional[int] = None):
+        super().__init__(times)
+        self.exc = exc
+
+    def on_check(self) -> None:
+        exc = self.exc() if callable(self.exc) else self.exc
+        raise exc
+
+
+class WrongResult(Fault):
+    """Wrong-result: boolean results are inverted (or forced to `value`
+    when given) — the fault class the bisect-on-fail path must isolate."""
+
+    kind = "transform"
+
+    def __init__(self, value=None, times: Optional[int] = None):
+        super().__init__(times)
+        self.value = value
+
+    def on_transform(self, result):
+        if self.value is not None:
+            return self.value
+        if isinstance(result, bool):
+            return not result
+        return result
+
+
+class SlowRamp(Hang):
+    """Slow-ramp init: the site takes `seconds` before succeeding —
+    models the ~25-minute TPU plugin bring-up at test timescales.
+    Mechanically a Hang; the distinct name marks *bring-up* slowness
+    (site succeeds afterwards) vs a *dispatch* wedge."""
+
+
+class Overflow(Fault):
+    """Queue overflow: admission raises the overflow error class the
+    site's shed path handles (default asyncio.QueueFull)."""
+
+    def __init__(self, exc=None, times: Optional[int] = None):
+        super().__init__(times)
+        self.exc = exc
+
+    def on_check(self) -> None:
+        if self.exc is not None:
+            raise self.exc() if callable(self.exc) else self.exc
+        import asyncio
+        raise asyncio.QueueFull()
+
+
+_LOCK = threading.Lock()
+_FAULTS: Dict[str, List[Fault]] = {}
+_ACTIVE = False       # fast-path guard: no dict lookup when quiescent
+
+
+def inject(site: str, fault: Fault) -> Fault:
+    """Install `fault` at `site`; returns it (so tests can read
+    .fired)."""
+    global _ACTIVE
+    with _LOCK:
+        _FAULTS.setdefault(site, []).append(fault)
+        _ACTIVE = True
+    return fault
+
+
+def clear(site: Optional[str] = None) -> None:
+    """Remove faults at `site` (all sites when None)."""
+    global _ACTIVE
+    with _LOCK:
+        if site is None:
+            _FAULTS.clear()
+        else:
+            _FAULTS.pop(site, None)
+        _ACTIVE = bool(_FAULTS)
+
+
+def active() -> bool:
+    return _ACTIVE
+
+
+def fired_count(site: str) -> int:
+    with _LOCK:
+        return sum(f.fired for f in _FAULTS.get(site, ()))
+
+
+def check(site: str) -> None:
+    """Call at a dispatch site BEFORE the real work: installed faults
+    may sleep (Hang/SlowRamp) or raise (Raise/Overflow)."""
+    if not _ACTIVE:
+        return
+    with _LOCK:
+        faults = [f for f in _FAULTS.get(site, ())
+                  if f.kind == "check" and f._consume()]
+    for f in faults:
+        f.on_check()
+
+
+def transform(site: str, value):
+    """Call at a dispatch site on the RESULT: WrongResult faults
+    corrupt the value on its way out."""
+    if not _ACTIVE:
+        return value
+    with _LOCK:
+        faults = [f for f in _FAULTS.get(site, ())
+                  if f.kind == "transform" and f._consume()]
+    for f in faults:
+        value = f.on_transform(value)
+    return value

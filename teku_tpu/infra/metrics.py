@@ -98,6 +98,38 @@ class Histogram:
         return out
 
 
+class StateGauge:
+    """Enum-style gauge: one series per known state, exactly one at 1.0
+    (the Prometheus StateSet convention — used for the backend
+    supervisor and circuit breaker state machines)."""
+
+    def __init__(self, name: str, help_: str, states: Sequence[str]):
+        self.name = name
+        self.help = help_
+        self.states = tuple(states)
+        self._current = self.states[0] if self.states else ""
+        self._lock = threading.Lock()
+
+    def set_state(self, state: str) -> None:
+        with self._lock:
+            if state not in self.states:
+                # late-registered states are tolerated: the supervisor
+                # may gain states without a redeploy of the dashboards
+                self.states = self.states + (state,)
+            self._current = state
+
+    @property
+    def state(self) -> str:
+        return self._current
+
+    def collect(self) -> List[str]:
+        with self._lock:
+            return [f"# TYPE {self.name} gauge"] + [
+                f'{self.name}{{state="{s}"}} '
+                f'{1.0 if s == self._current else 0.0}'
+                for s in self.states]
+
+
 class MetricsRegistry:
     """Named registry; categories mirror TekuMetricCategory groupings."""
 
@@ -118,6 +150,11 @@ class MetricsRegistry:
                   ) -> Histogram:
         return self._get_or_create(
             name, lambda: Histogram(name, help_, buckets), Histogram)
+
+    def state_gauge(self, name: str, help_: str = "",
+                    states: Sequence[str] = ()) -> StateGauge:
+        return self._get_or_create(
+            name, lambda: StateGauge(name, help_, states), StateGauge)
 
     def _get_or_create(self, name, factory, cls):
         with self._lock:

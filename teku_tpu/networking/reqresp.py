@@ -11,16 +11,29 @@ to chunk checksums (encoding.py).  The transport multiplexes whole
 messages where libp2p uses streams; the payload bytes are identical.
 """
 
+import asyncio
 import logging
+import os
 import struct
 from typing import List, Optional, Sequence
 
+from ..infra.aio import retry_with_backoff
 from ..spec import helpers as H
 from ..spec.codec import (deserialize_signed_block,
                           serialize_signed_block)
 from ..spec.datastructures import MetadataMessage, Ping, Status
 from . import encoding as E
-from .transport import P2PNetwork, Peer
+
+try:
+    from .transport import P2PNetwork, Peer
+except ModuleNotFoundError:      # pragma: no cover - optional crypto
+    # the noise transport needs the `cryptography` package.  This guard
+    # alone does not make `teku_tpu.networking` importable without it
+    # (the package __init__ pulls the transport chain first), but it
+    # lets THIS module load standalone — tests drive the client
+    # retry/timeout logic in minimal containers by registering a stub
+    # parent package and importing reqresp directly
+    P2PNetwork = Peer = None
 
 _LOG = logging.getLogger(__name__)
 
@@ -69,11 +82,25 @@ def _unpack_chunks(data: bytes) -> Optional[List[bytes]]:
 
 class BeaconRpc:
     """Server + client for the beacon RPC methods, bound to a node's
-    chain data."""
+    chain data.
 
-    def __init__(self, net: P2PNetwork, node):
+    Client fetches carry a configurable per-request timeout (formerly
+    four hard-coded 30 s literals) and transient failures — timeouts,
+    connection resets — retry with bounded exponential backoff + jitter
+    through `infra/aio.py:retry_with_backoff`.  A malformed response is
+    NOT transient: it raises immediately so sync treats the peer as
+    misbehaving instead of giving it three more chances."""
+
+    def __init__(self, net: P2PNetwork, node,
+                 request_timeout_s: Optional[float] = None,
+                 request_attempts: int = 3):
         self.net = net
         self.node = node
+        if request_timeout_s is None:
+            request_timeout_s = float(os.environ.get(
+                "TEKU_TPU_REQRESP_TIMEOUT_S", "30"))
+        self.request_timeout_s = request_timeout_s
+        self.request_attempts = request_attempts
         self.seq_number = 0
         # chain, don't clobber: another protocol (e.g. discovery) may
         # already be installed — unknown methods fall through to it
@@ -211,6 +238,18 @@ class BeaconRpc:
         return out
 
     # -- client side ---------------------------------------------------
+    async def _fetch(self, peer: Peer, method: str, body: bytes) -> bytes:
+        """One client request with per-request timeout and bounded
+        retry (jittered backoff) on transient transport failures."""
+        async def once():
+            return await peer.request(method, body,
+                                      timeout=self.request_timeout_s)
+        return await retry_with_backoff(
+            once, attempts=self.request_attempts, base_delay_s=0.25,
+            jitter=0.5, what=f"reqresp {method}",
+            retry_on=(asyncio.TimeoutError, ConnectionResetError,
+                      BrokenPipeError, TimeoutError))
+
     async def exchange_status(self, peer: Peer) -> Optional[Status]:
         resp = await peer.request(
             STATUS,
@@ -223,10 +262,9 @@ class BeaconRpc:
 
     async def blocks_by_range(self, peer: Peer, start: int,
                               count: int) -> List:
-        resp = await peer.request(
-            BLOCKS_BY_RANGE,
-            E.encode_payload(struct.pack("<QQ", start, count)),
-            timeout=30.0)
+        resp = await self._fetch(
+            peer, BLOCKS_BY_RANGE,
+            E.encode_payload(struct.pack("<QQ", start, count)))
         chunks = _unpack_chunks(resp)
         if chunks is None:
             # malformed/error responses must FAIL, not read as an empty
@@ -238,9 +276,8 @@ class BeaconRpc:
 
     async def blocks_by_root(self, peer: Peer, roots: Sequence[bytes]
                              ) -> List:
-        resp = await peer.request(
-            BLOCKS_BY_ROOT, E.encode_payload(b"".join(roots)),
-            timeout=30.0)
+        resp = await self._fetch(
+            peer, BLOCKS_BY_ROOT, E.encode_payload(b"".join(roots)))
         chunks = _unpack_chunks(resp)
         if chunks is None:
             return []
@@ -253,10 +290,9 @@ class BeaconRpc:
 
     async def blob_sidecars_by_range(self, peer: Peer, start: int,
                                      count: int) -> List:
-        resp = await peer.request(
-            BLOB_SIDECARS_BY_RANGE,
-            E.encode_payload(struct.pack("<QQ", start, count)),
-            timeout=30.0)
+        resp = await self._fetch(
+            peer, BLOB_SIDECARS_BY_RANGE,
+            E.encode_payload(struct.pack("<QQ", start, count)))
         chunks = _unpack_chunks(resp)
         if chunks is None:
             return []
@@ -267,8 +303,8 @@ class BeaconRpc:
         """ids: (block_root, index) pairs (spec BlobIdentifier)."""
         body = b"".join(root + index.to_bytes(8, "little")
                         for root, index in ids)
-        resp = await peer.request(BLOB_SIDECARS_BY_ROOT,
-                                  E.encode_payload(body), timeout=30.0)
+        resp = await self._fetch(peer, BLOB_SIDECARS_BY_ROOT,
+                                 E.encode_payload(body))
         chunks = _unpack_chunks(resp)
         if chunks is None:
             return []

@@ -50,6 +50,12 @@ class BeaconNode(Service):
                  store: Optional[Store] = None):
         super().__init__(name)
         self.spec = spec
+        # backend supervisor (infra/supervisor.py), injected by the
+        # process entry point after construction: the node boots on the
+        # oracle and this service hot-swaps the device backend in the
+        # background; the node owns its lifecycle (reference: the
+        # preflight moment Teku.java:74, reshaped for 25-minute init)
+        self.supervisor = None
         S = spec.schemas
         self.channels = EventChannels()
         if store is None:
@@ -373,8 +379,12 @@ class BeaconNode(Service):
     # ------------------------------------------------------------------
     async def do_start(self) -> None:
         await self.sig_service.start()
+        if self.supervisor is not None:
+            await self.supervisor.start()
 
     async def do_stop(self) -> None:
+        if self.supervisor is not None:
+            await self.supervisor.stop()
         await self.sig_service.stop()
 
     # ------------------------------------------------------------------

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from ..crypto.bls import hash_to_curve as OH
+from ..infra import faults
 from ..crypto.bls.constants import P, R
 from ..crypto.bls.pure_impl import PureBls12381
 from ..crypto.bls.spi import BLS12381, BatchSemiAggregate
@@ -304,6 +305,9 @@ class JaxBls12381(BLS12381):
 
     # ------------------------------------------------------------------
     def _dispatch(self, semis: List[_Semi], randomize: bool) -> bool:
+        # `bls.dispatch` fault site: the supervisor/breaker tests prove
+        # hang/exception containment at the REAL device-dispatch seam
+        faults.check("bls.dispatch")
         n = len(semis)
         self.dispatch_count += 1
         self.lanes_dispatched += n
@@ -351,4 +355,5 @@ class JaxBls12381(BLS12381):
                 pk_xs, pk_ys, pk_present, (u0c0, u0c1), (u1c0, u1c1),
                 (sx0, sx1), s_large, s_inf, r_bits, lane_valid)
         lane_ok = np.asarray(lane_ok)
-        return bool(np.asarray(ok)) and bool(lane_ok[:n].all())
+        verdict = bool(np.asarray(ok)) and bool(lane_ok[:n].all())
+        return faults.transform("bls.dispatch", verdict)

@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Tuple
 
 from ..crypto import bls
+from ..infra import faults
 from ..infra.metrics import GLOBAL_REGISTRY, MetricsRegistry
 
 Triple = Tuple[Sequence[bytes], bytes, bytes]
@@ -77,6 +78,11 @@ class AggregatingSignatureVerificationService:
         self._m_batch_size = registry.histogram(
             f"{name}_batch_size", "signatures per dispatched batch",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
+        # overflow shedding used to be invisible in metrics: a node
+        # rejecting gossip under load looked identical to a healthy one
+        self._m_rejected = registry.counter(
+            f"{name}_rejected_total",
+            "tasks shed because the queue was at capacity")
 
     # ------------------------------------------------------------------
     async def start(self) -> None:
@@ -120,8 +126,16 @@ class AggregatingSignatureVerificationService:
             raise RuntimeError("service not running")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         try:
+            # `sigservice.enqueue` fault site: Overflow injection proves
+            # the shed path (metrics + WARN) without a 15k-deep queue
+            faults.check("sigservice.enqueue")
             self._queue.put_nowait(_Task(list(triples), fut))
         except asyncio.QueueFull:
+            self._m_rejected.inc()
+            _LOG.warning(
+                "signature verification queue at capacity "
+                "(%d/%d pending) — shedding task (%d triples)",
+                self._queue.qsize(), self.queue_capacity, len(triples))
             raise ServiceCapacityExceededError(
                 f"queue at capacity ({self.queue_capacity})") from None
         return fut

@@ -15,6 +15,7 @@ from dataclasses import dataclass
 from typing import List, Sequence, Tuple
 
 from ..crypto import bls
+from ..infra import faults
 
 Triple = Tuple[Sequence[bytes], bytes, bytes]
 
@@ -32,10 +33,16 @@ class SimpleSignatureVerifier(SignatureVerifier):
     reference's BLSSignatureVerifier.SIMPLE)."""
 
     def verify(self, public_keys, message, signature) -> bool:
+        # `verifiers.dispatch` fault site: the spec-level seam, so
+        # injected faults reach block import exactly where a sick
+        # backend would
+        faults.check("verifiers.dispatch")
         if len(public_keys) == 1:
-            return bls.verify(public_keys[0], message, signature)
-        return bls.fast_aggregate_verify(
-            list(public_keys), message, signature)
+            ok = bls.verify(public_keys[0], message, signature)
+        else:
+            ok = bls.fast_aggregate_verify(
+                list(public_keys), message, signature)
+        return faults.transform("verifiers.dispatch", ok)
 
 
 SIMPLE = SimpleSignatureVerifier()
@@ -69,7 +76,9 @@ class BatchSignatureVerifier(SignatureVerifier):
         self._complete = True
         if not self._jobs:
             return True
-        return bls.batch_verify(self._jobs)
+        faults.check("verifiers.dispatch")
+        return faults.transform("verifiers.dispatch",
+                                bls.batch_verify(self._jobs))
 
 
 class AsyncSignatureVerifier:

@@ -1,0 +1,355 @@
+"""Fault-injection harness semantics + the failure paths it unlocks.
+
+Covers the harness itself (site registry, budgets, check/transform
+split), wrong-result bisection in the batching service at the issue's
+250-task scale, overflow-shed observability, and the reqresp
+retry-on-transient-failure path.
+"""
+
+import asyncio
+import logging
+
+import pytest
+
+from teku_tpu.crypto import bls
+from teku_tpu.crypto.bls import keygen
+from teku_tpu.infra import faults
+from teku_tpu.infra.metrics import MetricsRegistry
+from teku_tpu.services.signatures import (
+    AggregatingSignatureVerificationService, ServiceCapacityExceededError)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    faults.clear()
+    bls.reset_implementation()
+
+
+SKS = [keygen(bytes([60 + i]) * 32) for i in range(4)]
+PKS = [bls.secret_to_public_key(sk) for sk in SKS]
+
+
+# --------------------------------------------------------------------------
+# harness semantics
+# --------------------------------------------------------------------------
+
+def test_inactive_harness_is_free():
+    assert not faults.active()
+    faults.check("anywhere")                     # no-op
+    assert faults.transform("anywhere", True) is True
+
+
+def test_times_budget_and_clear():
+    f = faults.inject("s", faults.Raise(ValueError("x"), times=2))
+    for _ in range(2):
+        with pytest.raises(ValueError):
+            faults.check("s")
+    faults.check("s")                            # budget spent
+    assert f.fired == 2
+    assert faults.fired_count("s") == 2
+    faults.clear("s")
+    assert not faults.active()
+
+
+def test_sites_are_independent():
+    faults.inject("a", faults.Raise(ValueError("a")))
+    faults.check("b")                            # different site: clean
+    with pytest.raises(ValueError):
+        faults.check("a")
+
+
+def test_wrong_result_only_consumed_by_transform():
+    f = faults.inject("s", faults.WrongResult(times=1))
+    faults.check("s")                            # must NOT spend it
+    assert f.fired == 0
+    assert faults.transform("s", True) is False  # inverted
+    assert faults.transform("s", True) is True   # budget spent
+
+
+def test_hang_fault_blocks_for_duration():
+    import time
+    faults.inject("s", faults.Hang(0.05, times=1))
+    t0 = time.monotonic()
+    faults.check("s")
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_overflow_fault_raises_queuefull():
+    faults.inject("s", faults.Overflow(times=1))
+    with pytest.raises(asyncio.QueueFull):
+        faults.check("s")
+
+
+def test_callable_exception_factory():
+    faults.inject("s", faults.Raise(lambda: RuntimeError("fresh")))
+    with pytest.raises(RuntimeError):
+        faults.check("s")
+    with pytest.raises(RuntimeError):            # fresh instance each time
+        faults.check("s")
+
+
+# --------------------------------------------------------------------------
+# facade / provider sites
+# --------------------------------------------------------------------------
+
+def test_facade_batch_verify_wrong_result_site():
+    sk, pk = SKS[0], PKS[0]
+    sig = bls.sign(sk, b"m")
+    faults.inject("bls.batch_verify", faults.WrongResult(times=1))
+    assert bls.batch_verify([([pk], b"m", sig)]) is False  # corrupted
+    assert bls.batch_verify([([pk], b"m", sig)]) is True   # clean again
+
+
+def test_spec_verifier_site():
+    from teku_tpu.spec.verifiers import BatchSignatureVerifier, SIMPLE
+
+    sig = bls.sign(SKS[0], b"m")
+    faults.inject("verifiers.dispatch", faults.WrongResult(times=1))
+    assert SIMPLE.verify([PKS[0]], b"m", sig) is False
+    v = BatchSignatureVerifier()
+    assert v.verify([PKS[0]], b"m", sig)        # optimistic record
+    assert v.batch_verify() is True             # fault budget spent
+
+
+# --------------------------------------------------------------------------
+# bisect-on-fail under injected wrong results (satellite)
+# --------------------------------------------------------------------------
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_service(**kw):
+    kw.setdefault("registry", MetricsRegistry())
+    return AggregatingSignatureVerificationService(**kw)
+
+
+class StubBls:
+    """Constant-time provider for batch-shape tests: a triple verifies
+    iff its signature equals the stub tag for (pks, msg).  Bisection at
+    the 250-task scale is a SERVICE-layer property; real pairing math
+    at that scale belongs to the slow tier."""
+
+    name = "stub"
+
+    @staticmethod
+    def tag(pks, msg):
+        return (b"sig:" + msg + b":" + b"".join(pk[:2] for pk in pks)
+                )[:96].ljust(96, b"\x00")
+
+    def fast_aggregate_verify(self, pks, msg, sig):
+        return sig == self.tag(pks, msg)
+
+    def batch_verify(self, triples):
+        return all(self.fast_aggregate_verify(pks, m, s)
+                   for pks, m, s in triples)
+
+
+def test_bisect_isolates_single_bad_triple_in_250_batch():
+    """One genuinely-bad triple inside a full 250-task batch: bisection
+    must fail exactly that task and pass the other 249."""
+    async def main():
+        stub = StubBls()
+        bls.set_implementation(stub)
+        svc = make_service(num_workers=1, max_batch_size=250,
+                           split_threshold=4)
+        await svc.start()
+        futs = []
+        bad_index = 137
+        n = 250
+        for i in range(n):
+            m = b"m-%d" % i
+            pks = [PKS[i % 4]]
+            sig = (stub.tag(pks, m) if i != bad_index
+                   else stub.tag(pks, b"tampered"))
+            futs.append(svc.verify(pks, m, sig))
+        got = await asyncio.gather(*futs)
+        await svc.stop()
+        assert got[bad_index] is False
+        assert all(got[:bad_index]) and all(got[bad_index + 1:])
+    run(main())
+
+
+def test_bisect_survives_spurious_wrong_result_fault():
+    """A WrongResult fault on the FIRST whole-batch dispatch (a flaky
+    device reporting False for a good batch): bisection re-verifies and
+    every honest task still resolves True — wrong results cost retries,
+    never verdicts."""
+    async def main():
+        svc = make_service(num_workers=1, split_threshold=4)
+        await svc.start()
+        faults.inject("bls.batch_verify",
+                      faults.WrongResult(times=1))
+        futs = []
+        for i in range(8):
+            m = b"flaky-%d" % i
+            futs.append(svc.verify([PKS[i % 4]],
+                                   m, bls.sign(SKS[i % 4], m)))
+        got = await asyncio.gather(*futs)
+        await svc.stop()
+        assert got == [True] * 8
+        assert faults.fired_count("bls.batch_verify") == 1
+    run(main())
+
+
+def test_atomic_multi_sig_task_fails_as_unit_under_fault():
+    """A multi-triple task (e.g. SignedAggregateAndProof's three
+    signatures) is atomic through bisection: one bad triple fails the
+    WHOLE task, neighbours unaffected."""
+    async def main():
+        svc = make_service(num_workers=1, split_threshold=2)
+        await svc.start()
+        m1, m2, m3 = b"sel", b"agg", b"proof"
+        good_multi = [([PKS[0]], m1, bls.sign(SKS[0], m1)),
+                      ([PKS[1]], m2, bls.sign(SKS[1], m2))]
+        bad_multi = [([PKS[2]], m3, bls.sign(SKS[2], m3)),
+                     ([PKS[3]], m1, bls.sign(SKS[3], m2))]  # wrong msg
+        f1 = svc.verify_multi(good_multi)
+        f2 = svc.verify_multi(bad_multi)
+        f3 = svc.verify([PKS[0]], m2, bls.sign(SKS[0], m2))
+        got = await asyncio.gather(f1, f2, f3)
+        await svc.stop()
+        assert got == [True, False, True]
+    run(main())
+
+
+# --------------------------------------------------------------------------
+# overflow shedding observability (satellite)
+# --------------------------------------------------------------------------
+
+def test_overflow_shed_counts_and_warns(caplog):
+    async def main():
+        reg = MetricsRegistry()
+        svc = make_service(num_workers=1, registry=reg)
+        await svc.start()
+        faults.inject("sigservice.enqueue", faults.Overflow(times=1))
+        sig = bls.sign(SKS[0], b"shed")
+        with caplog.at_level(logging.WARNING,
+                             logger="teku_tpu.services.signatures"):
+            with pytest.raises(ServiceCapacityExceededError):
+                svc.verify([PKS[0]], b"shed", sig)
+        await svc.stop()
+        rejected = reg.counter(
+            "signature_verifications_rejected_total").value
+        assert rejected == 1
+        assert any("shedding task" in r.getMessage()
+                   for r in caplog.records)
+        assert "signature_verifications_rejected_total 1" in reg.expose()
+    run(main())
+
+
+def test_real_queue_overflow_also_counted():
+    async def main():
+        reg = MetricsRegistry()
+        svc = make_service(num_workers=1, queue_capacity=2, registry=reg)
+        await svc.start()
+        sig = bls.sign(SKS[0], b"ovf")
+        futs = [svc.verify([PKS[0]], b"ovf", sig) for _ in range(2)]
+        with pytest.raises(ServiceCapacityExceededError):
+            for _ in range(50):
+                futs.append(svc.verify([PKS[0]], b"ovf", sig))
+        await asyncio.gather(*futs)
+        await svc.stop()
+        assert reg.counter(
+            "signature_verifications_rejected_total").value >= 1
+    run(main())
+
+
+# --------------------------------------------------------------------------
+# reqresp retry on transient failures (satellite)
+# --------------------------------------------------------------------------
+
+def _import_reqresp():
+    """Import the RPC module even where the optional `cryptography`
+    dependency (noise transport) is absent: the retry/timeout logic
+    under test is pure asyncio and must stay testable in minimal
+    containers."""
+    try:
+        from teku_tpu.networking import reqresp
+        return reqresp
+    except ModuleNotFoundError:
+        import importlib
+        import os
+        import sys
+        import types
+        import teku_tpu
+        if "teku_tpu.networking" not in sys.modules:
+            pkg = types.ModuleType("teku_tpu.networking")
+            pkg.__path__ = [os.path.join(
+                os.path.dirname(teku_tpu.__file__), "networking")]
+            sys.modules["teku_tpu.networking"] = pkg
+        return importlib.import_module("teku_tpu.networking.reqresp")
+
+
+class _FlakyPeer:
+    """Peer whose request times out `fail` times, then succeeds."""
+
+    def __init__(self, fail: int, payload: bytes):
+        self.fail = fail
+        self.calls = 0
+        self.payload = payload
+        self.timeouts = []
+
+    async def request(self, method, body, timeout=10.0):
+        self.calls += 1
+        self.timeouts.append(timeout)
+        if self.calls <= self.fail:
+            raise asyncio.TimeoutError()
+        return self.payload
+
+
+def _make_rpc(**kw):
+    BeaconRpc = _import_reqresp().BeaconRpc
+
+    class _Net:
+        on_request = None
+
+    return BeaconRpc(_Net(), node=None, **kw)
+
+
+def test_reqresp_retries_transient_timeouts():
+    _import_reqresp()
+    import importlib
+    E = importlib.import_module("teku_tpu.networking.encoding")
+
+    async def main():
+        rpc = _make_rpc(request_timeout_s=5.0, request_attempts=3)
+        payload = E.encode_response_chunk(b"chunk")
+        peer = _FlakyPeer(fail=2, payload=payload)
+        resp = await rpc._fetch(peer, "any", b"")
+        assert resp == payload
+        assert peer.calls == 3
+        # the configurable timeout reached the transport on every try
+        assert peer.timeouts == [5.0] * 3
+    asyncio.run(main())
+
+
+def test_reqresp_bounded_attempts_then_fails():
+    async def main():
+        rpc = _make_rpc(request_timeout_s=1.0, request_attempts=2)
+        peer = _FlakyPeer(fail=99, payload=b"")
+        with pytest.raises(RuntimeError):
+            await rpc._fetch(peer, "any", b"")
+        assert peer.calls == 2
+    asyncio.run(main())
+
+
+def test_reqresp_malformed_response_not_retried():
+    """A malformed blocks_by_range response is peer misbehaviour, not a
+    transient fault: it must raise WITHOUT burning retry attempts."""
+    async def main():
+        rpc = _make_rpc(request_timeout_s=1.0, request_attempts=3)
+        peer = _FlakyPeer(fail=0, payload=b"\xff\xffgarbage")
+        with pytest.raises(ConnectionError):
+            await rpc.blocks_by_range(peer, 0, 4)
+        assert peer.calls == 1                  # no retries on garbage
+    asyncio.run(main())
+
+
+def test_reqresp_timeout_env_default(monkeypatch):
+    monkeypatch.setenv("TEKU_TPU_REQRESP_TIMEOUT_S", "7.5")
+    rpc = _make_rpc()
+    assert rpc.request_timeout_s == 7.5
