@@ -349,15 +349,38 @@ def _throughput_phase(jax, deadline, batches, detail):
 
 def _latency_phase(jax, deadline):
     """Slot-burst replay through AggregatingSignatureVerificationService:
-    Poisson-bursty single-attestation tasks, p50/p99 task latency."""
+    Poisson-bursty single-attestation tasks, p50/p99 task latency PLUS
+    per-stage attribution (queue_wait / assembly / dispatch / host_prep /
+    device_execute / complete p50/p95/p99) from the tracing layer — so a
+    future p50 regression in BENCH_*.json names its guilty stage."""
     import asyncio
     import secrets
+    from collections import defaultdict
 
     from teku_tpu.crypto import bls
     from teku_tpu.crypto.bls import keygen
+    from teku_tpu.infra import tracing
     from teku_tpu.ops.provider import JaxBls12381
     from teku_tpu.services.signatures import (
         AggregatingSignatureVerificationService)
+
+    trace_on = os.environ.get("BENCH_TRACING", "1") != "0"
+    tracing.set_enabled(trace_on)
+    OUT["tracing"] = "on" if trace_on else "off"
+    stage_samples: dict = defaultdict(list)
+
+    def _sampler(tr):
+        # raw per-trace samples beat histogram-bucket percentiles:
+        # dedupe repeated stage entries (bisect retries) by summing
+        per_stage: dict = defaultdict(float)
+        for stage, dur in tr.stages:
+            per_stage[stage] += dur
+        for stage, dur in per_stage.items():
+            stage_samples[stage].append(dur)
+        stage_samples["complete"].append(tr.total_s)
+
+    if trace_on:
+        tracing.set_sampler(_sampler)
 
     # min_bucket=256 pins EVERY service dispatch to the one 256-lane
     # shape the throughput phase already compiled — no extra kernel
@@ -391,11 +414,16 @@ def _latency_phase(jax, deadline):
             for i in range(n_msgs):
                 j = i % 16
                 t_submit = time.perf_counter()
-                fut = svc.verify([pks[j]], msgs[j], sigs[j])
-                pending.append((t_submit, fut))
+                # one root trace per attestation, submit → verdict
+                # (the service + provider attribute their stages to it)
+                tr = tracing.new_trace("bench_verify")
+                with tracing.attach((tr,)):
+                    fut = svc.verify([pks[j]], msgs[j], sigs[j])
+                pending.append((t_submit, fut, tr))
                 await asyncio.sleep(float(rng.exponential(0.0004)))
-            for t_submit, fut in pending:
+            for t_submit, fut, tr in pending:
                 okv = await fut
+                tracing.finish(tr)
                 assert okv
                 lat.append(time.perf_counter() - t_submit)
             await svc.stop()
@@ -405,7 +433,25 @@ def _latency_phase(jax, deadline):
         OUT["p50_ms"] = round(float(np.percentile(lat_ms, 50)), 2)
         OUT["p99_ms"] = round(float(np.percentile(lat_ms, 99)), 2)
         OUT["latency_tasks"] = len(lat_ms)
+        if stage_samples:
+            stages = {}
+            for stage, samples in sorted(stage_samples.items()):
+                arr = np.asarray(samples) * 1e3
+                stages[stage] = {
+                    "p50_ms": round(float(np.percentile(arr, 50)), 3),
+                    "p95_ms": round(float(np.percentile(arr, 95)), 3),
+                    "p99_ms": round(float(np.percentile(arr, 99)), 3),
+                    "n": len(samples)}
+            OUT["latency_stages"] = stages
+            # attribution coverage: the named stages' p50s should
+            # account for the end-to-end p50 (driver checks ±20%)
+            attributed = sum(
+                stages[s]["p50_ms"] for s in
+                ("queue_wait", "assembly", "host_prep", "device_execute")
+                if s in stages)
+            OUT["latency_p50_attributed_ms"] = round(attributed, 3)
     finally:
+        tracing.set_sampler(None)
         bls.reset_implementation()
 
 

@@ -12,6 +12,7 @@ exposition (infrastructure/metrics MetricsEndpoint analogue).
 import logging
 from typing import Optional
 
+from ..infra import tracing
 from ..infra.metrics import GLOBAL_REGISTRY
 from ..infra.restapi import HttpError, RestApi
 from ..spec import helpers as H
@@ -141,6 +142,10 @@ class BeaconRestApi(RestApi):
         g("/eth/v1/beacon/light_client/updates", self._lc_updates)
         g("/eth/v1/node/peers/{peer_id}", self._peer_by_id)
         g("/eth/v1/debug/fork_choice", self._debug_fork_choice)
+        # slow-trace dump (per-stage breakdowns of the slowest
+        # verifies) — teku-namespaced like the reference's /teku/v1
+        # operator endpoints
+        g("/teku/v1/admin/traces", self._admin_traces)
         g("/metrics", self._metrics)
 
     # -- resolution helpers -------------------------------------------
@@ -1473,6 +1478,17 @@ class BeaconRestApi(RestApi):
             "fork_choice_nodes": nodes,
             "extra_data": {},
         }
+
+    async def _admin_traces(self, query=None):
+        """The slow-trace ring as JSON: the N slowest complete verifies
+        with their per-stage latency breakdowns (ms), slowest first.
+        `?clear=1` empties the ring after the read — useful for
+        isolating one incident's traces from boot-time compiles."""
+        out = {"tracing_enabled": tracing.enabled(),
+               "data": tracing.slow_traces()}
+        if query and query.get("clear") in ("1", "true"):
+            tracing.clear_slow_traces()
+        return out
 
     async def _metrics(self):
         return GLOBAL_REGISTRY.expose(), "text/plain; version=0.0.4"

@@ -53,6 +53,25 @@ def _load_yaml(path: Optional[str]) -> Dict[str, Any]:
 # subcommands
 # --------------------------------------------------------------------------
 
+def _configure_tracing(args, yaml_cfg) -> str:
+    """Hot-path tracing switch (default on: spans cost ~a perf_counter
+    pair each; `off` compiles them to shared no-ops for A/B runs)."""
+    from .infra import tracing
+
+    def norm(v):
+        # YAML parses bare on/off as booleans; map them back
+        if isinstance(v, bool):
+            return "on" if v else "off"
+        return str(v).lower()
+
+    choice = layered_value("tracing", getattr(args, "tracing", None),
+                           yaml_cfg, "on", cast=norm)
+    if choice not in ("on", "off"):
+        raise SystemExit(f"invalid --tracing {choice!r} (use on or off)")
+    tracing.set_enabled(choice == "on")
+    return choice
+
+
 def _configure_bls(args, yaml_cfg, *, supervise: bool = True):
     """Choose the BLS bring-up shape BEFORE any service starts.
 
@@ -93,6 +112,7 @@ def cmd_node(args) -> int:
     from .validator.slashing_protection import SlashingProtector
 
     yaml_cfg = _load_yaml(args.config_file)
+    _configure_tracing(args, yaml_cfg)
     _, bls_supervisor = _configure_bls(args, yaml_cfg)
     network = layered_value("network", args.network, yaml_cfg, "minimal")
     port = int(layered_value("p2p-port", args.p2p_port, yaml_cfg, 0, int))
@@ -299,6 +319,7 @@ def cmd_devnet(args) -> int:
     """In-process devnet: N nodes, loopback gossip, fast clock."""
     from .node import Devnet
 
+    _configure_tracing(args, {})
     _, bls_supervisor = _configure_bls(args, {})
 
     async def run():
@@ -592,6 +613,7 @@ def cmd_validator_client(args) -> int:
     from .validator.slashing_protection import SlashingProtector
 
     # the VC's hot path is signing (host-side); no background bring-up
+    _configure_tracing(args, {})
     _configure_bls(args, {}, supervise=False)
     spec = create_spec(args.network or "minimal")
     remote = RemoteValidatorApi(spec, args.beacon_node)
@@ -693,6 +715,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "kernel when background bring-up reaches READY; "
                         "jax blocks on a hard preflight and makes "
                         "accelerator failure fatal; pure opts out")
+    n.add_argument("--tracing", default=None, choices=["on", "off"],
+                   help="hot-path verify tracing: per-stage latency "
+                        "histograms on /metrics and the slow-trace "
+                        "ring on /teku/v1/admin/traces (default on; "
+                        "off compiles spans to no-ops)")
     n.set_defaults(fn=cmd_node)
 
     d = sub.add_parser("devnet", help="in-process fast devnet")
@@ -701,6 +728,7 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--epochs", type=int, default=4)
     d.add_argument("--bls-impl", default=None,
                    choices=["auto", "supervised", "jax", "pure"])
+    d.add_argument("--tracing", default=None, choices=["on", "off"])
     d.set_defaults(fn=cmd_devnet)
 
     t = sub.add_parser("transition", help="offline state transition")
@@ -749,6 +777,7 @@ def build_parser() -> argparse.ArgumentParser:
     vc.add_argument("--data-dir", default=None)
     vc.add_argument("--bls-impl", default=None,
                     choices=["auto", "supervised", "jax", "pure"])
+    vc.add_argument("--tracing", default=None, choices=["on", "off"])
     vc.set_defaults(fn=cmd_validator_client)
 
     pe = sub.add_parser("peer", help="generate a node identity")

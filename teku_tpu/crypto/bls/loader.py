@@ -34,7 +34,7 @@ import threading
 from typing import Optional, Sequence, Tuple
 
 from . import get_implementation, reset_implementation, set_implementation
-from ...infra import faults
+from ...infra import faults, tracing
 from ...infra.metrics import GLOBAL_REGISTRY, MetricsRegistry
 from ...infra.supervisor import (BackendSupervisor, CircuitBreaker,
                                  CircuitOpenError, DispatchTimeoutError,
@@ -100,6 +100,13 @@ class GuardedBls12381(BLS12381):
         self.device = device
         self.breaker = breaker
         self.oracle = oracle or PureBls12381()
+        # degraded-mode visibility: every guarded dispatch labeled by
+        # the backend that actually served it and why — a node quietly
+        # paying oracle latency must show up on one PromQL ratio
+        self._m_requests = GLOBAL_REGISTRY.labeled_counter(
+            "bls_verify_requests_total",
+            "guarded BLS dispatches by serving backend and reason",
+            labelnames=("backend", "reason"))
         # serializes device entry: a timed-out dispatch's orphaned
         # thread may still be running (e.g. finishing a cold compile)
         # and the provider's caches are not safe under concurrent
@@ -143,17 +150,28 @@ class GuardedBls12381(BLS12381):
                 return device_fn(*args)
 
         try:
-            return self.breaker.call(locked)
+            result = self.breaker.call(locked)
+            self._m_requests.labels(backend="device", reason="ok").inc()
+            return result
         except CircuitOpenError:
-            pass        # expected while tripped: silent oracle service
+            # expected while tripped: silent oracle service
+            self._m_requests.labels(backend="oracle",
+                                    reason="breaker_open").inc()
         except DispatchTimeoutError as exc:
+            self._m_requests.labels(backend="oracle",
+                                    reason="fallback").inc()
             _LOG.warning("device %s overran deadline (%s); serving "
                          "this call from the oracle", op, exc)
         except Exception as exc:  # noqa: BLE001 - any device fault
+            self._m_requests.labels(backend="oracle",
+                                    reason="fallback").inc()
             _LOG.warning("device %s failed (%s: %s); serving this "
                          "call from the oracle", op,
                          type(exc).__name__, exc)
-        return getattr(self.oracle, op)(*args)
+        # the oracle serving a device's call IS the degraded-mode cost:
+        # a separate stage so traces show where the p50 went
+        with tracing.span("oracle_execute"):
+            return getattr(self.oracle, op)(*args)
 
     def public_key_is_valid(self, public_key: bytes) -> bool:
         return self._guarded("public_key_is_valid", public_key)
@@ -328,6 +346,10 @@ class GuardedKzgBackend:
         self.name = f"guarded({getattr(inner, 'name', 'device')})"
         self._device_lock = threading.Lock()   # same orphan-thread rule
                                                # as GuardedBls12381
+        self._m_requests = GLOBAL_REGISTRY.labeled_counter(
+            "kzg_verify_requests_total",
+            "guarded KZG dispatches by serving backend and reason",
+            labelnames=("backend", "reason"))
 
     def _call(self, op: str, *args):
         from .. import kzg as kzg_facade
@@ -348,12 +370,22 @@ class GuardedKzgBackend:
 
         try:
             kind, value = self.breaker.call(run)
-        except (CircuitOpenError, DispatchTimeoutError) as exc:
+        except CircuitOpenError as exc:
+            self._m_requests.labels(backend="oracle",
+                                    reason="breaker_open").inc()
+            raise kzg_facade.BackendUnavailable(str(exc)) from exc
+        except DispatchTimeoutError as exc:
+            self._m_requests.labels(backend="oracle",
+                                    reason="fallback").inc()
             raise kzg_facade.BackendUnavailable(str(exc)) from exc
         except Exception as exc:  # noqa: BLE001 - any device fault
+            self._m_requests.labels(backend="oracle",
+                                    reason="fallback").inc()
             _LOG.warning("device KZG %s failed (%s: %s); host path "
                          "serves this call", op, type(exc).__name__, exc)
             raise kzg_facade.BackendUnavailable(str(exc)) from exc
+        # KzgError verdicts executed on the device: still backend=device
+        self._m_requests.labels(backend="device", reason="ok").inc()
         if kind == "kzg":
             raise value
         return value

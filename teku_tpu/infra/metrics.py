@@ -4,13 +4,58 @@ Counterpart of the reference's Besu-backed MetricsSystem (reference:
 infrastructure/metrics/src/main/java/tech/pegasys/teku/infrastructure/
 metrics/MetricsEndpoint.java, TekuMetricCategory.java) reduced to what
 the node needs: counters, gauges (settable or callback-backed),
-fixed-bucket histograms, and a text exposition for scraping.  No
-external dependencies, safe for use from asyncio tasks and worker
-threads (operations are simple attribute updates guarded by locks).
+fixed-bucket histograms, LABELED counter/histogram families (the
+reference's LabelledMetric seam — what per-stage / per-backend
+breakdowns hang off), and a text exposition for scraping.  No external
+dependencies, safe for use from asyncio tasks and worker threads
+(operations are simple attribute updates guarded by locks).
+
+Conventions (enforced by the fast-tier naming lint in
+tests/test_metrics_exposition.py):
+- counters end in ``_total``;
+- duration metrics are measured in SECONDS, named ``*_seconds``, and
+  use ``LATENCY_BUCKETS_S`` — the old unitless DEFAULT_BUCKETS
+  (1…2500) remain only for size/count distributions.
 """
 
+import logging
 import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+_LOG = logging.getLogger(__name__)
+
+# Log-spaced latency buckets: 100 µs … 10 s.  Covers everything from a
+# warm single-lane device dispatch (~ms) through an oracle fallback
+# pairing (tens of ms) up to a cold XLA compile absorbed on the hot
+# path (seconds) — every duration metric in the tree uses these.
+LATENCY_BUCKETS_S = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+def _escape_label(value: str) -> str:
+    """Prometheus label-value escaping: backslash, quote, newline."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _label_pairs(names: Sequence[str], values: Sequence[str]) -> str:
+    return ",".join(f'{n}="{_escape_label(v)}"'
+                    for n, v in zip(names, values))
+
+
+def _fmt_labels(names: Sequence[str], values: Sequence[str]) -> str:
+    pairs = _label_pairs(names, values)
+    return "{" + pairs + "}" if pairs else ""
+
+
+def _header(name: str, help_: str, type_: str) -> List[str]:
+    return [f"# HELP {name} {_escape_help(help_)}",
+            f"# TYPE {name} {type_}"]
 
 
 class Counter:
@@ -26,11 +71,12 @@ class Counter:
 
     @property
     def value(self) -> float:
-        return self._value
+        with self._lock:
+            return self._value
 
     def collect(self) -> List[str]:
-        return [f"# TYPE {self.name} counter",
-                f"{self.name} {self._value}"]
+        return _header(self.name, self.help, "counter") + [
+            f"{self.name} {self.value}"]
 
 
 class Gauge:
@@ -40,27 +86,37 @@ class Gauge:
         self.help = help_
         self._supplier = supplier
         self._value = 0.0
+        self._lock = threading.Lock()
 
     def set(self, value: float) -> None:
-        self._value = value
+        with self._lock:
+            self._value = value
 
     @property
     def value(self) -> float:
-        return self._supplier() if self._supplier else self._value
+        if self._supplier:
+            return self._supplier()
+        with self._lock:
+            return self._value
 
     def collect(self) -> List[str]:
-        return [f"# TYPE {self.name} gauge", f"{self.name} {self.value}"]
+        out = _header(self.name, self.help, "gauge")
+        try:
+            # a raising supplier must cost ONE sample, never the scrape
+            out.append(f"{self.name} {self.value}")
+        except Exception:
+            _LOG.warning("gauge %s supplier failed; omitting sample",
+                         self.name, exc_info=True)
+        return out
 
 
-class Histogram:
-    """Fixed upper-bound buckets (cumulative, Prometheus-style)."""
+class _HistogramState:
+    """Shared bucket accounting used by Histogram and the children of
+    LabeledHistogram."""
 
-    DEFAULT_BUCKETS = (1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500)
+    __slots__ = ("buckets", "_counts", "_sum", "_total", "_lock")
 
-    def __init__(self, name: str, help_: str,
-                 buckets: Sequence[float] = DEFAULT_BUCKETS):
-        self.name = name
-        self.help = help_
+    def __init__(self, buckets: Sequence[float]):
         self.buckets = tuple(sorted(buckets))
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
@@ -77,24 +133,142 @@ class Histogram:
                     return
             self._counts[-1] += 1
 
+    def snapshot(self) -> Tuple[List[int], float, int]:
+        with self._lock:
+            return list(self._counts), self._sum, self._total
+
+    def sample_lines(self, name: str, labels: str = "") -> List[str]:
+        """`name_bucket{...le=...}` series + sum + count, with `labels`
+        an already-formatted `k="v",` prefix (may be empty)."""
+        counts, sum_, total = self.snapshot()
+        out = []
+        cum = 0
+        for i, ub in enumerate(self.buckets):
+            cum += counts[i]
+            out.append(
+                f'{name}_bucket{{{labels}le="{ub}"}} {cum}')
+        cum += counts[-1]
+        out.append(f'{name}_bucket{{{labels}le="+Inf"}} {cum}')
+        suffix = "{" + labels.rstrip(",") + "}" if labels else ""
+        out.append(f"{name}_sum{suffix} {sum_}")
+        out.append(f"{name}_count{suffix} {total}")
+        return out
+
+
+class Histogram:
+    """Fixed upper-bound buckets (cumulative, Prometheus-style)."""
+
+    DEFAULT_BUCKETS = (1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500)
+
+    def __init__(self, name: str, help_: str,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self._state = _HistogramState(buckets)
+
+    @property
+    def buckets(self):
+        return self._state.buckets
+
+    def observe(self, value: float) -> None:
+        self._state.observe(value)
+
     @property
     def count(self) -> int:
-        return self._total
+        return self._state.snapshot()[2]
 
     @property
     def sum(self) -> float:
-        return self._sum
+        return self._state.snapshot()[1]
 
     def collect(self) -> List[str]:
-        out = [f"# TYPE {self.name} histogram"]
-        cum = 0
-        for i, ub in enumerate(self.buckets):
-            cum += self._counts[i]
-            out.append(f'{self.name}_bucket{{le="{ub}"}} {cum}')
-        cum += self._counts[-1]
-        out.append(f'{self.name}_bucket{{le="+Inf"}} {cum}')
-        out.append(f"{self.name}_sum {self._sum}")
-        out.append(f"{self.name}_count {self._total}")
+        return _header(self.name, self.help, "histogram") + \
+            self._state.sample_lines(self.name)
+
+
+class _LabeledFamily:
+    """Shared parent bookkeeping: a dict of children keyed by the label
+    value tuple, created on first `labels(**kv)`."""
+
+    def __init__(self, name: str, help_: str, labelnames: Sequence[str]):
+        if not labelnames:
+            raise ValueError(f"labeled metric {name} needs label names")
+        self.name = name
+        self.help = help_
+        self.labelnames = tuple(labelnames)
+        self._children: Dict[Tuple[str, ...], object] = {}
+        self._lock = threading.Lock()
+
+    def _key(self, kv: Dict[str, str]) -> Tuple[str, ...]:
+        if set(kv) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name} takes labels {self.labelnames}, "
+                f"got {tuple(sorted(kv))}")
+        return tuple(str(kv[n]) for n in self.labelnames)
+
+    def _child(self, kv: Dict[str, str], factory):
+        key = self._key(kv)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = factory()
+                self._children[key] = child
+            return child
+
+    def _items(self):
+        with self._lock:
+            return sorted(self._children.items())
+
+
+class LabeledCounter(_LabeledFamily):
+    """Counter family with a `labels(**kv)` child API, e.g.
+    ``m.labels(backend="device", reason="ok").inc()``."""
+
+    class _Child:
+        __slots__ = ("_value", "_lock")
+
+        def __init__(self):
+            self._value = 0.0
+            self._lock = threading.Lock()
+
+        def inc(self, amount: float = 1.0) -> None:
+            with self._lock:
+                self._value += amount
+
+        @property
+        def value(self) -> float:
+            with self._lock:
+                return self._value
+
+    def labels(self, **kv) -> "_Child":
+        return self._child(kv, LabeledCounter._Child)
+
+    def collect(self) -> List[str]:
+        out = _header(self.name, self.help, "counter")
+        for key, child in self._items():
+            out.append(f"{self.name}"
+                       f"{_fmt_labels(self.labelnames, key)} "
+                       f"{child.value}")
+        return out
+
+
+class LabeledHistogram(_LabeledFamily):
+    """Histogram family with per-label-set buckets, e.g.
+    ``m.labels(stage="device_execute").observe(dt)``."""
+
+    def __init__(self, name: str, help_: str, labelnames: Sequence[str],
+                 buckets: Sequence[float] = LATENCY_BUCKETS_S):
+        super().__init__(name, help_, labelnames)
+        self.buckets = tuple(sorted(buckets))
+
+    def labels(self, **kv) -> _HistogramState:
+        return self._child(kv, lambda: _HistogramState(self.buckets))
+
+    def collect(self) -> List[str]:
+        out = _header(self.name, self.help, "histogram")
+        for key, child in self._items():
+            prefix = _label_pairs(self.labelnames, key) + ","
+            out.extend(child.sample_lines(self.name, prefix))
         return out
 
 
@@ -124,8 +298,8 @@ class StateGauge:
 
     def collect(self) -> List[str]:
         with self._lock:
-            return [f"# TYPE {self.name} gauge"] + [
-                f'{self.name}{{state="{s}"}} '
+            return _header(self.name, self.help, "gauge") + [
+                f'{self.name}{{state="{_escape_label(s)}"}} '
                 f'{1.0 if s == self._current else 0.0}'
                 for s in self.states]
 
@@ -151,6 +325,32 @@ class MetricsRegistry:
         return self._get_or_create(
             name, lambda: Histogram(name, help_, buckets), Histogram)
 
+    def labeled_counter(self, name: str, help_: str = "",
+                        labelnames: Sequence[str] = ()) -> LabeledCounter:
+        m = self._get_or_create(
+            name, lambda: LabeledCounter(name, help_, labelnames),
+            LabeledCounter)
+        # empty labelnames = retrieval of an existing family
+        if labelnames and tuple(labelnames) != m.labelnames:
+            raise ValueError(
+                f"metric {name} already registered with labels "
+                f"{m.labelnames}")
+        return m
+
+    def labeled_histogram(self, name: str, help_: str = "",
+                          labelnames: Sequence[str] = (),
+                          buckets: Sequence[float] = LATENCY_BUCKETS_S
+                          ) -> LabeledHistogram:
+        m = self._get_or_create(
+            name,
+            lambda: LabeledHistogram(name, help_, labelnames, buckets),
+            LabeledHistogram)
+        if labelnames and tuple(labelnames) != m.labelnames:
+            raise ValueError(
+                f"metric {name} already registered with labels "
+                f"{m.labelnames}")
+        return m
+
     def state_gauge(self, name: str, help_: str = "",
                     states: Sequence[str] = ()) -> StateGauge:
         return self._get_or_create(
@@ -167,13 +367,25 @@ class MetricsRegistry:
                                  f"as {type(m).__name__}")
             return m
 
+    def metrics(self) -> Dict[str, object]:
+        """Snapshot of the registered families (for the naming lint)."""
+        with self._lock:
+            return dict(self._metrics)
+
     def expose(self) -> str:
-        """Prometheus text exposition of every registered metric."""
+        """Prometheus text exposition of every registered metric.  One
+        broken metric (e.g. a raising gauge supplier) loses its own
+        samples, never the scrape."""
         lines: List[str] = []
         with self._lock:
             metrics = list(self._metrics.values())
         for m in metrics:
-            lines.extend(m.collect())
+            try:
+                lines.extend(m.collect())
+            except Exception:
+                _LOG.warning("metric %s failed to collect; omitted "
+                             "from exposition",
+                             getattr(m, "name", m), exc_info=True)
         return "\n".join(lines) + "\n"
 
 

@@ -29,6 +29,7 @@ rather than hang (2G2T, arXiv:2602.23464).
 """
 
 import asyncio
+import contextvars
 import enum
 import logging
 import threading
@@ -202,10 +203,14 @@ class CircuitBreaker:
                 "cooldown remaining)")
         box: dict = {}
         done = threading.Event()
+        # carry the caller's context (tracing's current traces) into
+        # the dispatch thread — a raw Thread drops contextvars, which
+        # would detach device spans from the traces awaiting them
+        ctx = contextvars.copy_context()
 
         def run():
             try:
-                box["ok"] = fn(*args, **kwargs)
+                box["ok"] = ctx.run(fn, *args, **kwargs)
             except BaseException as exc:  # noqa: BLE001 - reported below
                 box["err"] = exc
             finally:

@@ -1,0 +1,296 @@
+"""Hot-path tracing: per-stage verify-latency attribution.
+
+The north-star metric is attestation-gossip p50 verify latency, but an
+end-to-end number cannot say WHERE a slow verify spent its time — the
+asyncio queue, batch assembly, host-side limb packing, a JAX recompile,
+device execute, or an oracle fallback.  This module is the attribution
+layer (the reference's analogue is the per-stage labelled timers its
+Besu MetricsSystem hangs off the validation pipeline):
+
+- ``span(stage, **labels)`` — a context-manager stopwatch usable from
+  asyncio tasks AND worker threads (monotonic ``perf_counter``); on
+  exit the duration lands in the per-stage latency histogram
+  ``verify_stage_duration_seconds{stage=...}`` and in every trace
+  attached to the current context;
+- ``trace(name, **labels)`` — opens a ROOT span: creates a `Trace`,
+  binds it to the current context (a `ContextVar`, so `asyncio.to_thread`
+  carries it into worker threads for free), and on exit completes the
+  trace: total duration → the ``complete`` stage histogram, the trace →
+  the slow-trace ring (+ the optional sampler);
+- ``new_trace``/``attach``/``finish`` — the unbundled form for flows
+  whose root outlives one lexical scope (the batching service attaches
+  a whole batch's traces around one device dispatch; bench holds a
+  trace open across submit→future-resolve);
+- a bounded ring of the N slowest complete traces with their stage
+  breakdowns, dumped by ``GET /teku/v1/admin/traces``.
+
+Disabled mode (``--tracing off`` / ``set_enabled(False)``) compiles
+spans to a shared no-op: ``span()``/``trace()`` return singletons whose
+enter/exit do nothing, ``new_trace`` returns None, and record calls
+return immediately — no allocation, no lock, no histogram touch.
+"""
+
+import os
+import threading
+import time
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .metrics import GLOBAL_REGISTRY, LATENCY_BUCKETS_S
+
+# The canonical hot-path stages (bench reports percentiles for these;
+# `complete` is the root span's end-to-end total).
+STAGES = ("queue_wait", "assembly", "dispatch", "host_prep",
+          "device_execute", "complete")
+
+_enabled = True
+
+# Traces bound to the current execution context.  A tuple (not a single
+# trace): one device dispatch serves a whole batch of root traces, and
+# its host_prep/device_execute spans must attribute to every one.
+_CURRENT: ContextVar[Tuple["Trace", ...]] = ContextVar(
+    "teku_tpu_traces", default=())
+
+_STAGE_HIST = GLOBAL_REGISTRY.labeled_histogram(
+    "verify_stage_duration_seconds",
+    "per-stage latency attribution of the verification pipeline",
+    labelnames=("stage",), buckets=LATENCY_BUCKETS_S)
+
+# Called with every completed Trace (bench installs one to compute
+# per-stage percentiles from raw samples instead of bucket edges).
+_sampler: Optional[Callable[["Trace"], None]] = None
+
+
+def set_enabled(on: bool) -> None:
+    global _enabled
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_sampler(fn: Optional[Callable[["Trace"], None]]) -> None:
+    global _sampler
+    _sampler = fn
+
+
+class Trace:
+    """One verification's stage breakdown, root-span start to verdict.
+
+    Thread-safe append: the enqueueing asyncio task, the service worker
+    task, and the device-dispatch worker thread all contribute stages.
+    """
+
+    __slots__ = ("name", "labels", "t_start", "t_wall", "_end",
+                 "stages", "_lock")
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        self.name = name
+        self.labels = labels
+        self.t_start = time.perf_counter()
+        self.t_wall = time.time()
+        self._end: Optional[float] = None
+        self.stages: List[Tuple[str, float]] = []
+        self._lock = threading.Lock()
+
+    def add_stage(self, stage: str, seconds: float) -> None:
+        with self._lock:
+            self.stages.append((stage, seconds))
+
+    @property
+    def complete(self) -> bool:
+        return self._end is not None
+
+    @property
+    def total_s(self) -> float:
+        end = self._end if self._end is not None else time.perf_counter()
+        return end - self.t_start
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            stages = list(self.stages)
+        return {"name": self.name,
+                "labels": dict(self.labels),
+                "t_wall": round(self.t_wall, 3),
+                "total_ms": round(self.total_s * 1e3, 3),
+                "stages": [{"stage": s, "ms": round(d * 1e3, 3)}
+                           for s, d in stages]}
+
+
+class _SlowTraceRing:
+    """Bounded collection of the N slowest COMPLETE traces."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._traces: List[Trace] = []
+        self._lock = threading.Lock()
+
+    def offer(self, trace: Trace) -> None:
+        if self.capacity <= 0:   # ring disabled, histograms still live
+            return
+        with self._lock:
+            if len(self._traces) < self.capacity:
+                self._traces.append(trace)
+                self._traces.sort(key=lambda t: t.total_s, reverse=True)
+                return
+            if trace.total_s > self._traces[-1].total_s:
+                self._traces[-1] = trace
+                self._traces.sort(key=lambda t: t.total_s, reverse=True)
+
+    def snapshot(self) -> List[Trace]:
+        with self._lock:
+            return list(self._traces)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+
+_RING = _SlowTraceRing(
+    int(os.environ.get("TEKU_TPU_SLOW_TRACE_RING", "32")))
+
+
+def slow_traces() -> List[dict]:
+    """Slowest complete traces, slowest first, as JSON-able dicts."""
+    return [t.to_dict() for t in _RING.snapshot()]
+
+
+def clear_slow_traces() -> None:
+    _RING.clear()
+
+
+# --------------------------------------------------------------------------
+# Recording primitives
+# --------------------------------------------------------------------------
+
+def record_stage(stage: str, seconds: float,
+                 traces: Optional[Sequence[Trace]] = None) -> None:
+    """Attribute an already-measured duration: stage histogram + the
+    given traces (default: the context's current traces)."""
+    if not _enabled:
+        return
+    _STAGE_HIST.labels(stage=stage).observe(seconds)
+    for t in (traces if traces is not None else _CURRENT.get()):
+        t.add_stage(stage, seconds)
+
+
+class _Span:
+    __slots__ = ("stage", "_traces", "_t0")
+
+    def __init__(self, stage: str, traces: Optional[Sequence[Trace]]):
+        self.stage = stage
+        self._traces = traces
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        record_stage(self.stage, time.perf_counter() - self._t0,
+                     self._traces)
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        # None, not self: `with trace(...) as tr` callers test
+        # `tr is None` to skip label stamping in disabled mode
+        return None
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+def span(stage: str, traces: Optional[Sequence[Trace]] = None):
+    """Stopwatch context manager for one pipeline stage.  Records into
+    the stage histogram and into `traces` (default: the context's
+    current traces, empty tuple when none — histogram-only)."""
+    if not _enabled:
+        return _NOOP
+    return _Span(stage, traces)
+
+
+# --------------------------------------------------------------------------
+# Root traces
+# --------------------------------------------------------------------------
+
+def new_trace(name: str, **labels) -> Optional[Trace]:
+    """Create a root trace WITHOUT binding it to the context (use
+    `attach` around the calls that should pick it up, `finish` when the
+    verdict lands).  None when tracing is disabled — every consumer of
+    a trace handle tolerates None."""
+    if not _enabled:
+        return None
+    return Trace(name, labels)
+
+
+@contextmanager
+def attach(traces: Sequence[Optional[Trace]]):
+    """Bind `traces` (Nones filtered) as the context's current traces
+    for the duration of the block.  `asyncio.to_thread` copies the
+    context, so spans inside a worker thread attribute correctly."""
+    live = tuple(t for t in traces if t is not None)
+    if not live:
+        yield
+        return
+    token = _CURRENT.set(live)
+    try:
+        yield
+    finally:
+        _CURRENT.reset(token)
+
+
+def current_trace() -> Optional[Trace]:
+    """First trace bound to the current context (the enqueue hot path
+    stamps this onto queued tasks), or None."""
+    traces = _CURRENT.get()
+    return traces[0] if traces else None
+
+
+def finish(trace: Optional[Trace]) -> None:
+    """Complete a root trace: total → the `complete` stage histogram,
+    trace → slow ring + sampler.  No-op for None (disabled mode)."""
+    if trace is None or trace.complete:
+        return
+    end = time.perf_counter()
+    trace._end = end
+    total = end - trace.t_start
+    if _enabled:
+        _STAGE_HIST.labels(stage="complete").observe(total)
+        _RING.offer(trace)
+    sampler = _sampler
+    if sampler is not None:
+        try:
+            sampler(trace)
+        except Exception:  # pragma: no cover - observer must not kill
+            pass
+
+
+class _RootSpan:
+    __slots__ = ("trace", "_token")
+
+    def __init__(self, trace: Trace):
+        self.trace = trace
+
+    def __enter__(self) -> Trace:
+        self._token = _CURRENT.set((self.trace,))
+        return self.trace
+
+    def __exit__(self, *exc) -> None:
+        _CURRENT.reset(self._token)
+        finish(self.trace)
+
+
+def trace(name: str, **labels):
+    """Open a root span: the returned context manager yields the Trace,
+    binds it as current, and finishes it on exit — one trace covers
+    gossip-arrival → verdict."""
+    if not _enabled:
+        return _NOOP
+    return _RootSpan(Trace(name, labels))

@@ -11,9 +11,11 @@ ride the device batcher (AsyncBatchSignatureVerifier keeps an
 aggregate-and-proof's three signatures atomic in one task).
 """
 
+import functools
 import logging
 from typing import Optional, Set, Tuple
 
+from ..infra import tracing
 from ..spec import Spec
 from ..spec import helpers as H
 from ..spec.block import is_valid_indexed_attestation
@@ -32,6 +34,23 @@ ACCEPT = ValidationResult.ACCEPT
 IGNORE = ValidationResult.IGNORE
 REJECT = ValidationResult.REJECT
 SAVE_FOR_FUTURE = ValidationResult.SAVE_FOR_FUTURE
+
+
+def _traced_validate(topic: str):
+    """Decorator opening the ROOT span of the hot path: one trace per
+    gossip message, arrival → verdict, so a slow verify's time is
+    attributable across queue-wait / assembly / dispatch / device.  The
+    verdict is stamped as a trace label for the slow-trace dump."""
+    def wrap(fn):
+        @functools.wraps(fn)
+        async def validate(self, message) -> ValidationResult:
+            with tracing.trace("gossip_verify", topic=topic) as tr:
+                result = await fn(self, message)
+                if tr is not None:
+                    tr.labels["result"] = result.value
+                return result
+        return validate
+    return wrap
 
 
 def _committee_index_of(attestation):
@@ -93,6 +112,7 @@ class AttestationValidator:
         # bounded like the reference's LimitedSet seen-caches
         self._seen: LimitedSet = LimitedSet(65536)
 
+    @_traced_validate("attestation")
     async def validate(self, attestation) -> ValidationResult:
         cfg = self.spec.config
         data = attestation.data
@@ -153,6 +173,7 @@ class AggregateValidator:
         self.verifier = verifier
         self._seen_aggregators: LimitedSet = LimitedSet(16384)
 
+    @_traced_validate("aggregate")
     async def validate(self, signed_aggregate) -> ValidationResult:
         cfg = self.spec.config
         msg = signed_aggregate.message
@@ -232,6 +253,7 @@ class ContributionValidator:
         self.verifier = verifier
         self._seen: LimitedSet = LimitedSet(8192)
 
+    @_traced_validate("sync_contribution")
     async def validate(self, signed) -> ValidationResult:
         from ..spec.altair import helpers as AH
         cfg = self.spec.config
@@ -301,6 +323,7 @@ class BlockGossipValidator:
         self.verifier = verifier
         self._seen: LimitedSet = LimitedSet(16384)
 
+    @_traced_validate("block")
     async def validate(self, signed_block) -> ValidationResult:
         cfg = self.spec.config
         block = signed_block.message

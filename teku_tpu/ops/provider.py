@@ -24,6 +24,7 @@ nothing after warm-up).
 """
 
 import secrets
+import threading
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -32,7 +33,8 @@ import jax
 import jax.numpy as jnp
 
 from ..crypto.bls import hash_to_curve as OH
-from ..infra import faults
+from ..infra import faults, tracing
+from ..infra.metrics import GLOBAL_REGISTRY
 from ..crypto.bls.constants import P, R
 from ..crypto.bls.pure_impl import PureBls12381
 from ..crypto.bls.spi import BLS12381, BatchSemiAggregate
@@ -42,6 +44,41 @@ from . import verify as V
 
 _G1_INF = bytes([0xC0] + [0] * 47)
 _G2_INF = bytes([0xC0] + [0] * 95)
+
+# Process-level dispatch observability (module-level because the staged
+# verify jits in ops/verify.py are shared across provider instances).
+# First dispatch of a (padded, kmax) bucket shape is the one that pays
+# the XLA compile; everything after hits the jit cache.
+_SEEN_SHAPES: set = set()
+_SEEN_LOCK = threading.Lock()
+_M_JIT = GLOBAL_REGISTRY.labeled_counter(
+    "bls_jit_dispatch_total",
+    "verify dispatches by padded bucket shape (lanes x keys) and "
+    "jit-cache outcome",
+    labelnames=("shape", "outcome"))
+_M_LANES_REAL = GLOBAL_REGISTRY.counter(
+    "bls_dispatch_lanes_real_total",
+    "real (non-padding) lanes dispatched to the device")
+_M_LANES_PADDED = GLOBAL_REGISTRY.counter(
+    "bls_dispatch_lanes_padded_total",
+    "total lanes dispatched including pow-2 padding")
+
+
+def _padding_waste() -> float:
+    # read real BEFORE padded (writers inc padded first): a dispatch
+    # landing between the reads skews the ratio high, never negative
+    real = _M_LANES_REAL.value
+    padded = _M_LANES_PADDED.value
+    return (padded - real) / padded if padded else 0.0
+
+
+# pow-2 padding trades jit-cache size for dead lanes: this is the dead
+# fraction, a direct throughput observable (0.3 means 30% of device
+# work verified nothing)
+GLOBAL_REGISTRY.gauge(
+    "bls_dispatch_padding_waste_ratio",
+    "fraction of dispatched lanes that were pow-2 padding",
+    supplier=_padding_waste)
 
 
 def _next_pow2(n: int) -> int:
@@ -274,8 +311,11 @@ class JaxBls12381(BLS12381):
     def batch_verify(
         self, triples: Sequence[Tuple[Sequence[bytes], bytes, bytes]],
     ) -> bool:
-        return self.complete_batch_verify(
-            [self.prepare_batch_verify(t) for t in triples])
+        # wire parse + pk-cache resolve is host work too: the trace's
+        # host_prep stage sums this with _dispatch's array packing
+        with tracing.span("host_prep"):
+            semis = [self.prepare_batch_verify(t) for t in triples]
+        return self.complete_batch_verify(semis)
 
     def verify(self, public_key: bytes, message: bytes,
                signature: bytes) -> bool:
@@ -311,49 +351,70 @@ class JaxBls12381(BLS12381):
         n = len(semis)
         self.dispatch_count += 1
         self.lanes_dispatched += n
-        padded = max(_next_pow2(n), self.min_bucket)
-        kmax = _next_pow2(max(len(s.pk_limbs) for s in semis))
-        pk_xs = np.zeros((padded, kmax, fp.L), dtype=np.int64)
-        pk_ys = np.zeros((padded, kmax, fp.L), dtype=np.int64)
-        pk_present = np.zeros((padded, kmax), dtype=bool)
-        u0c0 = np.zeros((padded, fp.L), dtype=np.int64)
-        u0c1 = np.zeros((padded, fp.L), dtype=np.int64)
-        u1c0 = np.zeros((padded, fp.L), dtype=np.int64)
-        u1c1 = np.zeros((padded, fp.L), dtype=np.int64)
-        sig_bytes = np.zeros((padded, 2, 48), dtype=np.uint8)
-        s_large = np.zeros(padded, dtype=bool)
-        s_inf = np.zeros(padded, dtype=bool)
-        lane_valid = np.zeros(padded, dtype=bool)
-        for i, s in enumerate(semis):
-            for j, (x, y) in enumerate(s.pk_limbs):
-                pk_xs[i, j] = x
-                pk_ys[i, j] = y
-                pk_present[i, j] = True
-            u0c0[i], u0c1[i], u1c0[i], u1c1[i] = self._u_draws(s.message)
-            sig_bytes[i] = s.sig_x_bytes
-            s_large[i] = s.sig_large
-            s_inf[i] = s.sig_inf
-            lane_valid[i] = True
-        sx1 = bytes_to_limbs_np(sig_bytes[:, 0])
-        sx0 = bytes_to_limbs_np(sig_bytes[:, 1])
-        if randomize:
-            # one os-entropy draw for the whole batch (the reference uses
-            # SecureRandom per multiplier, BlstBLS12381.java:191-195);
-            # zero lanes are nudged to 1 (2^-64 bias, negligible)
-            rs = np.frombuffer(secrets.token_bytes(8 * padded),
-                               dtype=np.uint64).copy()
-            rs[rs == 0] = 1
-        else:
-            rs = np.ones(padded, dtype=np.uint64)
-        r_bits = np.asarray(PT.scalar_from_uint64(rs))
-        if self._sharded is not None:
-            ok, lane_ok = self._sharded(
-                pk_xs, pk_ys, pk_present, (u0c0, u0c1), (u1c0, u1c1),
-                (sx0, sx1), s_large, s_inf, r_bits, lane_valid)
-        else:
-            ok, lane_ok = self._verify_jit(
-                pk_xs, pk_ys, pk_present, (u0c0, u0c1), (u1c0, u1c1),
-                (sx0, sx1), s_large, s_inf, r_bits, lane_valid)
-        lane_ok = np.asarray(lane_ok)
-        verdict = bool(np.asarray(ok)) and bool(lane_ok[:n].all())
+        with tracing.span("host_prep"):
+            padded = max(_next_pow2(n), self.min_bucket)
+            kmax = _next_pow2(max(len(s.pk_limbs) for s in semis))
+            pk_xs = np.zeros((padded, kmax, fp.L), dtype=np.int64)
+            pk_ys = np.zeros((padded, kmax, fp.L), dtype=np.int64)
+            pk_present = np.zeros((padded, kmax), dtype=bool)
+            u0c0 = np.zeros((padded, fp.L), dtype=np.int64)
+            u0c1 = np.zeros((padded, fp.L), dtype=np.int64)
+            u1c0 = np.zeros((padded, fp.L), dtype=np.int64)
+            u1c1 = np.zeros((padded, fp.L), dtype=np.int64)
+            sig_bytes = np.zeros((padded, 2, 48), dtype=np.uint8)
+            s_large = np.zeros(padded, dtype=bool)
+            s_inf = np.zeros(padded, dtype=bool)
+            lane_valid = np.zeros(padded, dtype=bool)
+            for i, s in enumerate(semis):
+                for j, (x, y) in enumerate(s.pk_limbs):
+                    pk_xs[i, j] = x
+                    pk_ys[i, j] = y
+                    pk_present[i, j] = True
+                u0c0[i], u0c1[i], u1c0[i], u1c1[i] = \
+                    self._u_draws(s.message)
+                sig_bytes[i] = s.sig_x_bytes
+                s_large[i] = s.sig_large
+                s_inf[i] = s.sig_inf
+                lane_valid[i] = True
+            sx1 = bytes_to_limbs_np(sig_bytes[:, 0])
+            sx0 = bytes_to_limbs_np(sig_bytes[:, 1])
+            if randomize:
+                # one os-entropy draw for the whole batch (the
+                # reference uses SecureRandom per multiplier,
+                # BlstBLS12381.java:191-195); zero lanes are nudged to
+                # 1 (2^-64 bias, negligible)
+                rs = np.frombuffer(secrets.token_bytes(8 * padded),
+                                   dtype=np.uint64).copy()
+                rs[rs == 0] = 1
+            else:
+                rs = np.ones(padded, dtype=np.uint64)
+            r_bits = np.asarray(PT.scalar_from_uint64(rs))
+        shape = f"{padded}x{kmax}"
+        # the staged jits are module-level (shared across providers),
+        # but a ShardedVerifier's jit cache is per-instance — key the
+        # seen-set on the kernel that will actually serve the dispatch
+        cache_key = (id(self._sharded) if self._sharded is not None
+                     else 0, shape)
+        with _SEEN_LOCK:
+            outcome = ("cache_hit" if cache_key in _SEEN_SHAPES
+                       else "compile")
+            _SEEN_SHAPES.add(cache_key)
+        _M_JIT.labels(shape=shape, outcome=outcome).inc()
+        # padded first: a scrape between the two incs must read the
+        # ratio high, never negative
+        _M_LANES_PADDED.inc(padded)
+        _M_LANES_REAL.inc(n)
+        with tracing.span("device_execute"):
+            if self._sharded is not None:
+                ok, lane_ok = self._sharded(
+                    pk_xs, pk_ys, pk_present, (u0c0, u0c1), (u1c0, u1c1),
+                    (sx0, sx1), s_large, s_inf, r_bits, lane_valid)
+            else:
+                ok, lane_ok = self._verify_jit(
+                    pk_xs, pk_ys, pk_present, (u0c0, u0c1), (u1c0, u1c1),
+                    (sx0, sx1), s_large, s_inf, r_bits, lane_valid)
+            # np.asarray forces the device round-trip, so the span
+            # covers execute-to-host-synchronized, not dispatch-only
+            lane_ok = np.asarray(lane_ok)
+            verdict = bool(np.asarray(ok)) and bool(lane_ok[:n].all())
         return faults.transform("bls.dispatch", verdict)

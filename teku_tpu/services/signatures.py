@@ -25,12 +25,14 @@ attestation-gossip p50 the north star measures.
 
 import asyncio
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Tuple
 
 from ..crypto import bls
-from ..infra import faults
-from ..infra.metrics import GLOBAL_REGISTRY, MetricsRegistry
+from ..infra import faults, tracing
+from ..infra.metrics import (GLOBAL_REGISTRY, LATENCY_BUCKETS_S,
+                             MetricsRegistry)
 
 Triple = Tuple[Sequence[bytes], bytes, bytes]
 
@@ -45,6 +47,11 @@ class ServiceCapacityExceededError(Exception):
 class _Task:
     triples: List[Triple]
     future: asyncio.Future = field(repr=False)
+    # stamped at enqueue: queue-wait attribution + the caller's root
+    # trace (the gossip validator's), so the worker can attribute its
+    # stages to the trace that is awaiting this task's future
+    t_enqueue: float = 0.0
+    trace: Optional[tracing.Trace] = field(default=None, repr=False)
 
 
 class AggregatingSignatureVerificationService:
@@ -78,6 +85,19 @@ class AggregatingSignatureVerificationService:
         self._m_batch_size = registry.histogram(
             f"{name}_batch_size", "signatures per dispatched batch",
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512))
+        # batch LATENCY next to batch size: a regressed p50 with a flat
+        # size distribution points at the dispatch, not the batching
+        self._m_batch_duration = registry.histogram(
+            f"{name}_batch_duration_seconds",
+            "wall seconds per batch dispatch (device call inclusive)",
+            buckets=LATENCY_BUCKETS_S)
+        # first-try vs bisect-recursion dispatches: the failure path
+        # amplifies one bad batch into O(log n) extra device calls, and
+        # that amplification used to be invisible
+        self._m_dispatches = registry.labeled_counter(
+            f"{name}_dispatch_total",
+            "batch dispatches by kind (first_try vs bisect recursion)",
+            labelnames=("kind",))
         # overflow shedding used to be invisible in metrics: a node
         # rejecting gossip under load looked identical to a healthy one
         self._m_rejected = registry.counter(
@@ -129,7 +149,9 @@ class AggregatingSignatureVerificationService:
             # `sigservice.enqueue` fault site: Overflow injection proves
             # the shed path (metrics + WARN) without a 15k-deep queue
             faults.check("sigservice.enqueue")
-            self._queue.put_nowait(_Task(list(triples), fut))
+            self._queue.put_nowait(_Task(
+                list(triples), fut, t_enqueue=time.perf_counter(),
+                trace=tracing.current_trace()))
         except asyncio.QueueFull:
             self._m_rejected.inc()
             _LOG.warning(
@@ -144,6 +166,7 @@ class AggregatingSignatureVerificationService:
     async def _worker(self) -> None:
         while not self._stopped:
             first = await self._queue.get()
+            t_first = time.perf_counter()
             tasks = [first]
             budget = self.max_batch_size - len(first.triples)
             while budget > 0:
@@ -153,6 +176,16 @@ class AggregatingSignatureVerificationService:
                     break
                 tasks.append(nxt)
                 budget -= len(nxt.triples)
+            t_assembled = time.perf_counter()
+            if tracing.enabled():
+                # per-task attribution: each task experienced its own
+                # queue-wait and the whole batch's assembly time
+                assembly = t_assembled - t_first
+                for t in tasks:
+                    trs = (t.trace,) if t.trace is not None else ()
+                    tracing.record_stage(
+                        "queue_wait", t_first - t.t_enqueue, trs)
+                    tracing.record_stage("assembly", assembly, trs)
             try:
                 await self._verify_batch(tasks)
             except asyncio.CancelledError:
@@ -166,14 +199,24 @@ class AggregatingSignatureVerificationService:
                     if not t.future.done():
                         t.future.set_exception(exc)
 
-    async def _verify_batch(self, tasks: List[_Task]) -> None:
+    async def _verify_batch(self, tasks: List[_Task],
+                            first_try: bool = True) -> None:
         tasks = [t for t in tasks if not t.future.cancelled()]
         if not tasks:
             return
         triples = [tr for t in tasks for tr in t.triples]
         self._m_batches.inc()
         self._m_batch_size.observe(len(triples))
-        ok = await asyncio.to_thread(bls.batch_verify, triples)
+        self._m_dispatches.labels(
+            kind="first_try" if first_try else "bisect").inc()
+        # the dispatch runs with the whole batch's traces bound to the
+        # context: asyncio.to_thread copies it, so the provider's
+        # host_prep/device_execute spans attribute to every trace
+        t0 = time.perf_counter()
+        with tracing.attach([t.trace for t in tasks]):
+            with tracing.span("dispatch"):
+                ok = await asyncio.to_thread(bls.batch_verify, triples)
+        self._m_batch_duration.observe(time.perf_counter() - t0)
         if ok:
             for t in tasks:
                 self._complete(t, True)
@@ -183,11 +226,11 @@ class AggregatingSignatureVerificationService:
             return
         if len(tasks) >= self.split_threshold:
             half = len(tasks) // 2
-            await self._verify_batch(tasks[:half])
-            await self._verify_batch(tasks[half:])
+            await self._verify_batch(tasks[:half], first_try=False)
+            await self._verify_batch(tasks[half:], first_try=False)
         else:
             for t in tasks:
-                await self._verify_batch([t])
+                await self._verify_batch([t], first_try=False)
 
     def _complete(self, task: _Task, result: bool) -> None:
         self._m_tasks.inc()

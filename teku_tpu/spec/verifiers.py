@@ -15,7 +15,7 @@ from dataclasses import dataclass
 from typing import List, Sequence, Tuple
 
 from ..crypto import bls
-from ..infra import faults
+from ..infra import faults, tracing
 
 Triple = Tuple[Sequence[bytes], bytes, bytes]
 
@@ -37,11 +37,15 @@ class SimpleSignatureVerifier(SignatureVerifier):
         # injected faults reach block import exactly where a sick
         # backend would
         faults.check("verifiers.dispatch")
-        if len(public_keys) == 1:
-            ok = bls.verify(public_keys[0], message, signature)
-        else:
-            ok = bls.fast_aggregate_verify(
-                list(public_keys), message, signature)
+        # root span: SIMPLE serves cold paths (no batching service in
+        # front), so the trace is opened here and the dispatch IS it
+        with tracing.trace("verify", kind="simple"):
+            with tracing.span("dispatch"):
+                if len(public_keys) == 1:
+                    ok = bls.verify(public_keys[0], message, signature)
+                else:
+                    ok = bls.fast_aggregate_verify(
+                        list(public_keys), message, signature)
         return faults.transform("verifiers.dispatch", ok)
 
 
@@ -77,8 +81,13 @@ class BatchSignatureVerifier(SignatureVerifier):
         if not self._jobs:
             return True
         faults.check("verifiers.dispatch")
-        return faults.transform("verifiers.dispatch",
-                                bls.batch_verify(self._jobs))
+        # root span per imported block's signature batch — the
+        # provider's host_prep/device_execute spans nest inside
+        with tracing.trace("verify", kind="block_import",
+                           jobs=str(len(self._jobs))):
+            with tracing.span("dispatch"):
+                ok = bls.batch_verify(self._jobs)
+        return faults.transform("verifiers.dispatch", ok)
 
 
 class AsyncSignatureVerifier:

@@ -1,0 +1,257 @@
+"""Prometheus exposition correctness + metric-naming lint.
+
+A minimal text-format parser validates the FULL registry exposition:
+HELP/TYPE pairing, label escaping, histogram bucket monotonicity — so
+a malformed family breaks a fast test here instead of a scraper in
+production.  The naming lint (counters end ``_total``, durations end
+``_seconds``, no colliding families) runs against GLOBAL_REGISTRY after
+importing the node modules, so every metric the node actually registers
+is covered.
+"""
+
+import re
+
+import pytest
+
+from teku_tpu.infra.metrics import (Counter, Gauge, Histogram,
+                                    LabeledCounter, LabeledHistogram,
+                                    LATENCY_BUCKETS_S, MetricsRegistry,
+                                    StateGauge)
+
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>.*)\})?'
+    r' (?P<value>[^ ]+)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(value: str) -> str:
+    return (value.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+def parse_exposition(text: str):
+    """Parse Prometheus text format into
+    {family: {"type", "help", "samples": [(name, labels, value)]}}.
+    Raises AssertionError on any structural violation."""
+    families: dict = {}
+    current = None
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_ = rest.partition(" ")
+            assert name not in families, \
+                f"line {lineno}: duplicate HELP for {name}"
+            families[name] = {"help": help_, "type": None, "samples": []}
+            current = name
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, type_ = rest.partition(" ")
+            assert name == current, \
+                f"line {lineno}: TYPE {name} not paired under HELP"
+            assert type_ in ("counter", "gauge", "histogram", "summary")
+            assert families[name]["type"] is None, \
+                f"line {lineno}: duplicate TYPE for {name}"
+            families[name]["type"] = type_
+            continue
+        assert not line.startswith("#"), f"line {lineno}: bad comment"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"line {lineno}: unparsable sample {line!r}"
+        name = m.group("name")
+        family = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in families:
+                family = name[:-len(suffix)]
+        assert family in families, \
+            f"line {lineno}: sample {name} outside any HELP/TYPE family"
+        raw = m.group("labels") or ""
+        labels = {k: _unescape(v) for k, v in _LABEL_RE.findall(raw)}
+        if raw:
+            # every label pair must parse (catches broken escaping)
+            rebuilt = ",".join(f'{k}="{v}"'
+                               for k, v in _LABEL_RE.findall(raw))
+            assert rebuilt == raw, \
+                f"line {lineno}: malformed labels {raw!r}"
+        value = float(m.group("value")) if m.group("value") != "+Inf" \
+            else float("inf")
+        families[family]["samples"].append((name, labels, value))
+        current = family
+    for name, fam in families.items():
+        assert fam["type"] is not None, f"family {name} missing TYPE"
+    return families
+
+
+def _histogram_checks(fam, family_name):
+    """le-monotonicity + bucket/sum/count coherence per label set."""
+    by_labelset: dict = {}
+    for name, labels, value in fam["samples"]:
+        key = tuple(sorted((k, v) for k, v in labels.items()
+                           if k != "le"))
+        entry = by_labelset.setdefault(
+            key, {"buckets": [], "sum": None, "count": None})
+        if name.endswith("_bucket"):
+            le = labels["le"]
+            entry["buckets"].append(
+                (float("inf") if le == "+Inf" else float(le), value))
+        elif name.endswith("_sum"):
+            entry["sum"] = value
+        elif name.endswith("_count"):
+            entry["count"] = value
+    assert by_labelset, f"{family_name}: no samples"
+    for key, entry in by_labelset.items():
+        buckets = entry["buckets"]
+        assert buckets, f"{family_name}{key}: no buckets"
+        les = [le for le, _ in buckets]
+        counts = [c for _, c in buckets]
+        assert les == sorted(les), f"{family_name}{key}: le unsorted"
+        assert les[-1] == float("inf"), \
+            f"{family_name}{key}: missing +Inf bucket"
+        assert counts == sorted(counts), \
+            f"{family_name}{key}: cumulative counts not monotone"
+        assert entry["count"] == counts[-1], \
+            f"{family_name}{key}: count != +Inf bucket"
+        assert entry["sum"] is not None
+
+
+def test_full_exposition_parses_and_validates():
+    reg = MetricsRegistry()
+    reg.counter("requests_total", "requests").inc(3)
+    reg.gauge("depth", "queue depth").set(7)
+    h = reg.histogram("sizes", "batch sizes", buckets=(1, 10, 100))
+    h.observe(5)
+    h.observe(5000)
+    lc = reg.labeled_counter(
+        "outcomes_total", "labeled outcomes",
+        labelnames=("backend", "reason"))
+    lc.labels(backend="device", reason="ok").inc()
+    lc.labels(backend="oracle", reason='we "quoted" a\\slash\nnewline'
+              ).inc(2)
+    lh = reg.labeled_histogram(
+        "stage_seconds", "stage durations", labelnames=("stage",))
+    lh.labels(stage="device_execute").observe(0.004)
+    lh.labels(stage="queue_wait").observe(11.0)   # overflows to +Inf
+    sg = reg.state_gauge("backend_state", "state set",
+                         states=("cold", "ready"))
+    sg.set_state("ready")
+
+    fams = parse_exposition(reg.expose())
+    assert fams["requests_total"]["type"] == "counter"
+    assert fams["requests_total"]["samples"][0][2] == 3.0
+    assert fams["depth"]["type"] == "gauge"
+    assert fams["sizes"]["type"] == "histogram"
+    _histogram_checks(fams["sizes"], "sizes")
+    _histogram_checks(fams["stage_seconds"], "stage_seconds")
+    # label escaping round-trips through the parser
+    oracle = [s for s in fams["outcomes_total"]["samples"]
+              if s[1].get("backend") == "oracle"]
+    assert oracle[0][1]["reason"] == 'we "quoted" a\\slash\nnewline'
+    assert oracle[0][2] == 2.0
+    # state set: exactly one series at 1.0
+    states = fams["backend_state"]["samples"]
+    assert sum(v for _, _, v in states) == 1.0
+    assert [s for _, s, v in states if v == 1.0][0]["state"] == "ready"
+
+
+def test_raising_gauge_supplier_does_not_break_scrape():
+    reg = MetricsRegistry()
+    reg.counter("alive_total", "proof of scrape").inc()
+
+    def boom():
+        raise RuntimeError("supplier died")
+
+    reg.gauge("sick", "raising supplier", supplier=boom)
+    text = reg.expose()
+    fams = parse_exposition(text)
+    # the scrape survives; the healthy metric is present with a value,
+    # the sick gauge lost only its sample
+    assert fams["alive_total"]["samples"][0][2] == 1.0
+    assert fams["sick"]["samples"] == []
+
+
+def test_help_lines_emitted_for_every_family():
+    reg = MetricsRegistry()
+    reg.counter("a_total", "help a")
+    reg.histogram("b_seconds", "help b", buckets=LATENCY_BUCKETS_S)
+    text = reg.expose()
+    assert "# HELP a_total help a" in text
+    assert "# HELP b_seconds help b" in text
+    # HELP precedes TYPE for each family
+    lines = text.splitlines()
+    for name in ("a_total", "b_seconds"):
+        help_i = lines.index(f"# HELP {name} help {name[0]}")
+        type_i = next(i for i, l in enumerate(lines)
+                      if l.startswith(f"# TYPE {name} "))
+        assert help_i + 1 == type_i
+
+
+def test_labeled_counter_label_validation():
+    reg = MetricsRegistry()
+    lc = reg.labeled_counter("x_total", "x", labelnames=("a", "b"))
+    with pytest.raises(ValueError):
+        lc.labels(a="1")              # missing label
+    with pytest.raises(ValueError):
+        lc.labels(a="1", b="2", c="3")  # extra label
+    with pytest.raises(ValueError):
+        reg.labeled_counter("x_total", "x", labelnames=("other",))
+    with pytest.raises(ValueError):
+        reg.counter("x_total")        # type mismatch on re-registration
+
+
+# --------------------------------------------------------------------------
+# Naming lint: run against the GLOBAL registry after importing the node
+# modules, so every metric the node wires is checked
+# --------------------------------------------------------------------------
+
+_DURATION_HINT = re.compile(r"(duration|latency|_wait|elapsed)")
+_UNIT_SUFFIXES = ("_seconds", "_ratio", "_bytes")
+
+
+def test_metric_naming_lint_after_node_imports():
+    import teku_tpu.crypto.bls.loader  # noqa: F401
+    import teku_tpu.infra.supervisor  # noqa: F401
+    import teku_tpu.infra.tracing  # noqa: F401
+    import teku_tpu.node.node  # noqa: F401
+    import teku_tpu.ops.provider  # noqa: F401
+    import teku_tpu.services.signatures  # noqa: F401
+    from teku_tpu.infra.metrics import GLOBAL_REGISTRY
+
+    metrics = GLOBAL_REGISTRY.metrics()
+    assert metrics, "node imports registered no metrics"
+    problems = []
+    names = set(metrics)
+    for name, m in metrics.items():
+        if isinstance(m, (Counter, LabeledCounter)):
+            if not name.endswith("_total"):
+                problems.append(f"counter {name} must end _total")
+        if isinstance(m, (Histogram, LabeledHistogram, Gauge)):
+            if _DURATION_HINT.search(name) \
+                    and not name.endswith("_seconds"):
+                problems.append(
+                    f"duration metric {name} must end _seconds")
+        if isinstance(m, (Histogram, LabeledHistogram)) \
+                and name.endswith("_seconds"):
+            if max(m.buckets) > 100:
+                problems.append(
+                    f"histogram {name} is *_seconds but its buckets "
+                    f"({m.buckets[:3]}…{m.buckets[-1]}) look like "
+                    "unitless DEFAULT_BUCKETS — use LATENCY_BUCKETS_S")
+        if isinstance(m, (Histogram, LabeledHistogram)):
+            # derived series must not collide with another family
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name + suffix in names:
+                    problems.append(
+                        f"{name + suffix} collides with histogram "
+                        f"{name}'s derived series")
+    assert not problems, "\n".join(problems)
+
+
+def test_global_exposition_is_well_formed_after_node_imports():
+    import teku_tpu.node.node  # noqa: F401
+    from teku_tpu.infra.metrics import GLOBAL_REGISTRY
+
+    fams = parse_exposition(GLOBAL_REGISTRY.expose())
+    assert "verify_stage_duration_seconds" in fams
+    assert "bls_dispatch_padding_waste_ratio" in fams
