@@ -62,6 +62,11 @@ def _emit():
     if _emitted:
         return
     _emitted = True
+    try:
+        # even a signal/watchdog exit carries the health verdict
+        _final_health()
+    except Exception:
+        pass
     print(json.dumps(OUT))
     sys.stdout.flush()
 
@@ -152,7 +157,39 @@ def _backend_state(state: str, **extra) -> None:
     _BACKEND_STATES.append({"state": state, "t": round(time.time(), 1),
                             **extra})
     OUT["backend_states"] = _BACKEND_STATES
+    try:
+        # same ring the node uses: breaker trips / sheds from the
+        # latency phase interleave with bring-up in one timeline
+        from teku_tpu.infra import flightrecorder
+        flightrecorder.record("backend_state", supervisor="bench",
+                              state=state, **extra)
+    except Exception:
+        pass
     _beat("backend_state", state=state, **extra)
+
+
+def _final_health() -> None:
+    """A last health snapshot + the flight-recorder tail into the
+    result JSON and heartbeat, so a degraded run (e.g. 'tpu init
+    failed: probe timeout' falling back to TFRT_CPU_0, BENCH_r05.json)
+    explains itself without log archaeology."""
+    status, detail = "up", ""
+    if OUT.get("fallback"):
+        status, detail = "degraded", OUT["fallback"]
+    if OUT.get("error"):
+        status, detail = "down", OUT["error"]
+    OUT["health"] = {
+        "status": status, "detail": detail,
+        "device": OUT.get("device", "unknown"),
+        "last_backend_state": (_BACKEND_STATES[-1]["state"]
+                               if _BACKEND_STATES else "unknown")}
+    try:
+        from teku_tpu.infra import flightrecorder
+        OUT["flight_recorder"] = flightrecorder.RECORDER.tail(20)
+    except Exception:
+        pass
+    _beat("final_health", health=OUT["health"],
+          flight_recorder_events=len(OUT.get("flight_recorder", [])))
 
 
 _PROBE_CODE = ("import jax, json, sys\n"

@@ -146,6 +146,8 @@ class BeaconRestApi(RestApi):
         # verifies) — teku-namespaced like the reference's /teku/v1
         # operator endpoints
         g("/teku/v1/admin/traces", self._admin_traces)
+        g("/teku/v1/admin/readiness", self._admin_readiness)
+        g("/teku/v1/admin/flight_recorder", self._admin_flight_recorder)
         g("/metrics", self._metrics)
 
     # -- resolution helpers -------------------------------------------
@@ -206,8 +208,81 @@ class BeaconRestApi(RestApi):
         return state
 
     # -- node ----------------------------------------------------------
-    async def _health(self):
-        return {}
+    def _is_syncing(self) -> bool:
+        return bool(self.networked and self.networked.sync.syncing)
+
+    async def _health(self, query=None):
+        """Spec-correct node health (reference handlers/v1/node/
+        GetHealth.java): 200 ready, 206 syncing or DEGRADED (serving,
+        but impaired), 503 DOWN — driven by the live HealthRegistry,
+        not a stub.  The optional ``syncing_status`` query param
+        substitutes the 206 (per the Beacon API spec: any valid HTTP
+        code; invalid values are a 400)."""
+        from ..infra.health import HealthStatus
+        health = getattr(self.node, "health", None)
+        status = health.evaluate() if health is not None \
+            else HealthStatus.UP
+        syncing_code = 206
+        if query and "syncing_status" in query:
+            try:
+                syncing_code = int(query["syncing_status"])
+            except ValueError:
+                raise HttpError(400, "syncing_status must be an "
+                                     "integer status code")
+            if not 100 <= syncing_code < 600:
+                raise HttpError(400, "syncing_status out of range "
+                                     "(100-599)")
+        if status is HealthStatus.DOWN:
+            return {}, None, 503
+        # the override substitutes ONLY the syncing response (its spec
+        # contract) — a ?syncing_status=200 probe keeping syncing nodes
+        # in rotation must not also mask genuine degradation
+        if self._is_syncing():
+            return {}, None, syncing_code
+        if status is HealthStatus.DEGRADED:
+            return {}, None, 206
+        return {}, None, 200
+
+    async def _admin_readiness(self):
+        """Detailed operator/autoscaler readiness: every health check's
+        verdict + detail, the SLO burn rates, and sync state — the
+        'WHICH subsystem is hurting' companion to /eth/v1/node/health's
+        one status code."""
+        health = getattr(self.node, "health", None)
+        slo = getattr(self.node, "slo", None)
+        if health is None:
+            raise HttpError(503, "health registry not wired")
+        health.evaluate()
+        out = health.snapshot()
+        out["syncing"] = self._is_syncing()
+        if slo is not None:
+            out["slo"] = slo.snapshot()
+        sup = getattr(self.node, "supervisor", None)
+        if sup is not None:
+            out["backend"] = sup.snapshot()
+        return out
+
+    async def _admin_flight_recorder(self, query=None):
+        """The flight-recorder ring as JSON, oldest first: backend
+        state transitions, breaker trips, SLO breaches, queue sheds,
+        health flips — each with its originating trace id.  `?last=N`
+        tails, `?clear=1` empties after the read, `?dump=1` also
+        writes the JSONL file an incident report wants."""
+        recorder = getattr(self.node, "flight_recorder", None)
+        if recorder is None:
+            raise HttpError(503, "flight recorder not wired")
+        last = None
+        if query and query.get("last"):
+            try:
+                last = max(1, int(query["last"]))
+            except ValueError:
+                raise HttpError(400, "last must be an integer")
+        out = {"data": recorder.snapshot(last=last)}
+        if query and query.get("dump") in ("1", "true"):
+            out["dumped_to"] = recorder.dump("operator request")
+        if query and query.get("clear") in ("1", "true"):
+            recorder.clear()
+        return out
 
     async def _version(self):
         return {"data": {"version": VERSION}}

@@ -53,6 +53,22 @@ def _load_yaml(path: Optional[str]) -> Dict[str, Any]:
 # subcommands
 # --------------------------------------------------------------------------
 
+def _configure_log_format(args, yaml_cfg) -> str:
+    """Opt-in structured logging (`--log-format json` /
+    TEKU_TPU_LOG_FORMAT): every record becomes one JSON object carrying
+    the active trace id, so logs join slow traces and flight-recorder
+    events on one correlation key.  Default stays the human-scannable
+    text lines."""
+    choice = str(layered_value(
+        "log-format", getattr(args, "log_format", None), yaml_cfg,
+        "text")).lower()
+    if choice not in ("text", "json"):
+        raise SystemExit(
+            f"invalid --log-format {choice!r} (use text or json)")
+    configure_logging(fmt=choice)
+    return choice
+
+
 def _configure_tracing(args, yaml_cfg) -> str:
     """Hot-path tracing switch (default on: spans cost ~a perf_counter
     pair each; `off` compiles them to shared no-ops for A/B runs)."""
@@ -112,7 +128,12 @@ def cmd_node(args) -> int:
     from .validator.slashing_protection import SlashingProtector
 
     yaml_cfg = _load_yaml(args.config_file)
+    _configure_log_format(args, yaml_cfg)
     _configure_tracing(args, yaml_cfg)
+    # arm the crash path before anything can wedge: faulthandler file
+    # + flight-recorder JSONL dump on fatal crash (infra/flightrecorder)
+    from .infra import flightrecorder
+    flightrecorder.install_crash_hooks()
     _, bls_supervisor = _configure_bls(args, yaml_cfg)
     network = layered_value("network", args.network, yaml_cfg, "minimal")
     port = int(layered_value("p2p-port", args.p2p_port, yaml_cfg, 0, int))
@@ -319,6 +340,7 @@ def cmd_devnet(args) -> int:
     """In-process devnet: N nodes, loopback gossip, fast clock."""
     from .node import Devnet
 
+    _configure_log_format(args, {})
     _configure_tracing(args, {})
     _, bls_supervisor = _configure_bls(args, {})
 
@@ -613,6 +635,7 @@ def cmd_validator_client(args) -> int:
     from .validator.slashing_protection import SlashingProtector
 
     # the VC's hot path is signing (host-side); no background bring-up
+    _configure_log_format(args, {})
     _configure_tracing(args, {})
     _configure_bls(args, {}, supervise=False)
     spec = create_spec(args.network or "minimal")
@@ -720,6 +743,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "histograms on /metrics and the slow-trace "
                         "ring on /teku/v1/admin/traces (default on; "
                         "off compiles spans to no-ops)")
+    n.add_argument("--log-format", default=None,
+                   choices=["text", "json"],
+                   help="console log format: json emits one object "
+                        "per line carrying the active trace id, so "
+                        "logs correlate with slow traces and "
+                        "flight-recorder events")
     n.set_defaults(fn=cmd_node)
 
     d = sub.add_parser("devnet", help="in-process fast devnet")
@@ -729,6 +758,8 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--bls-impl", default=None,
                    choices=["auto", "supervised", "jax", "pure"])
     d.add_argument("--tracing", default=None, choices=["on", "off"])
+    d.add_argument("--log-format", default=None,
+                   choices=["text", "json"])
     d.set_defaults(fn=cmd_devnet)
 
     t = sub.add_parser("transition", help="offline state transition")
@@ -778,6 +809,8 @@ def build_parser() -> argparse.ArgumentParser:
     vc.add_argument("--bls-impl", default=None,
                     choices=["auto", "supervised", "jax", "pure"])
     vc.add_argument("--tracing", default=None, choices=["on", "off"])
+    vc.add_argument("--log-format", default=None,
+                    choices=["text", "json"])
     vc.set_defaults(fn=cmd_validator_client)
 
     pe = sub.add_parser("peer", help="generate a node identity")

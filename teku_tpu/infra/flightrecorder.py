@@ -1,0 +1,223 @@
+"""Crash flight recorder: a bounded ring of structured operator events.
+
+When a node degrades in production the operator's question is "what
+happened in the last minute, in order?" — and the answer is scattered
+across log lines, metric deltas, and (if tracing caught it) a slow
+trace.  This module is the ordered record: backend state transitions,
+circuit-breaker trips, SLO breaches/recoveries, queue sheds, and health
+flips all land in one bounded in-memory ring, each event stamped with
+the wall clock and the ACTIVE TRACE ID from `infra/tracing.py`'s
+ContextVar, so a breaker trip correlates with the exact verification
+that tripped it and with the JSON log lines it emitted.
+
+The ring is dumped three ways:
+
+- ``GET /teku/v1/admin/flight_recorder`` (api/beacon_api.py) for live
+  inspection;
+- automatically to a JSONL file on circuit-breaker trip
+  (`dump_throttled` — at most one file per THROTTLE_S so a flapping
+  breaker cannot fill a disk);
+- on fatal crash via ``install_crash_hooks()``: `faulthandler` writes
+  C-level tracebacks to a file in the dump dir, and a `sys.excepthook`
+  wrapper dumps the ring before the interpreter dies (an `atexit` hook
+  disables faulthandler so teardown never writes to a closed file).
+
+The recorder is process-global on purpose (like `infra/faults.py`):
+events originate in worker threads, breaker dispatch threads, and
+asyncio tasks, and the value of the ring IS that they interleave in one
+timeline.
+"""
+
+import atexit
+import json
+import logging
+import os
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+from . import tracing
+from .metrics import GLOBAL_REGISTRY, MetricsRegistry
+
+_LOG = logging.getLogger(__name__)
+
+DEFAULT_CAPACITY = int(os.environ.get(
+    "TEKU_TPU_FLIGHT_RECORDER_CAPACITY", "512"))
+
+# minimum seconds between automatic dumps (breaker trips can flap)
+THROTTLE_S = float(os.environ.get(
+    "TEKU_TPU_FLIGHT_RECORDER_THROTTLE_S", "30"))
+
+
+def default_dump_dir() -> str:
+    return os.environ.get("TEKU_TPU_FLIGHT_RECORDER_DIR") or os.path.join(
+        tempfile.gettempdir(), "teku_tpu_flightrecorder")
+
+
+class FlightRecorder:
+    """Bounded, thread-safe ring of JSON-able events."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 dump_dir: Optional[str] = None,
+                 registry: MetricsRegistry = GLOBAL_REGISTRY):
+        self.capacity = capacity
+        self.dump_dir = dump_dir or default_dump_dir()
+        self._events: deque = deque(maxlen=capacity)
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._last_trace_id = ""
+        self._last_dump_t = 0.0
+        self._m_events = registry.labeled_counter(
+            "flight_recorder_events_total",
+            "events recorded into the flight-recorder ring, by kind",
+            labelnames=("kind",))
+        self._m_dumps = registry.counter(
+            "flight_recorder_dumps_total",
+            "JSONL dumps written (breaker trips, crashes, manual)")
+
+    # ------------------------------------------------------------------
+    def record(self, kind: str, trace_id: Optional[str] = None,
+               **fields) -> dict:
+        """Append one event.  `trace_id` defaults to the context's
+        current trace (empty when none) — explicit overrides let the
+        SLO engine blame the verification that originated a breach."""
+        if trace_id is None:
+            trace_id = tracing.current_trace_id()
+        event = {"seq": 0, "t_wall": round(time.time(), 3),
+                 "kind": kind, "trace_id": trace_id or "", **fields}
+        with self._lock:
+            self._seq += 1
+            event["seq"] = self._seq
+            self._events.append(event)
+            if trace_id:
+                self._last_trace_id = trace_id
+        self._m_events.labels(kind=kind).inc()
+        return event
+
+    def last_trace_id(self) -> str:
+        """Most recent non-empty trace id seen on any event — the
+        "originating trace" an untraced observer (the SLO tick) blames
+        when degradation was caused by an earlier traced failure."""
+        with self._lock:
+            return self._last_trace_id
+
+    def snapshot(self, last: Optional[int] = None) -> List[dict]:
+        """Events oldest-first (the whole ring, or the `last` N)."""
+        with self._lock:
+            events = list(self._events)
+        return events[-last:] if last else events
+
+    def tail(self, n: int) -> List[dict]:
+        return self.snapshot(last=n)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    # ------------------------------------------------------------------
+    def dump(self, reason: str, path: Optional[str] = None
+             ) -> Optional[str]:
+        """Write the ring to a JSONL file (header line first); returns
+        the path, or None when the write failed or there was nothing to
+        write.  Never raises: the dump runs inside failure paths."""
+        events = self.snapshot()
+        if not events:
+            return None
+        if path is None:
+            path = os.path.join(
+                self.dump_dir,
+                f"flight_{int(time.time())}_{os.getpid()}.jsonl")
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path, "w") as fh:
+                fh.write(json.dumps(
+                    {"kind": "dump_header", "reason": reason,
+                     "t_wall": round(time.time(), 3),
+                     "pid": os.getpid(), "events": len(events)}) + "\n")
+                for event in events:
+                    fh.write(json.dumps(event) + "\n")
+        except (OSError, TypeError, ValueError):
+            _LOG.warning("flight-recorder dump to %s failed", path,
+                         exc_info=True)
+            return None
+        self._m_dumps.inc()
+        _LOG.warning("flight recorder dumped %d events to %s (%s)",
+                     len(events), path, reason)
+        return path
+
+    def dump_throttled(self, reason: str) -> Optional[str]:
+        """`dump`, at most once per THROTTLE_S — the automatic
+        breaker-trip hook, where a flapping circuit must not turn each
+        half-open failure into a fresh file."""
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_dump_t < THROTTLE_S:
+                return None
+            self._last_dump_t = now
+        return self.dump(reason)
+
+
+# the process-wide recorder every subsystem records into
+RECORDER = FlightRecorder()
+
+
+def record(kind: str, trace_id: Optional[str] = None, **fields) -> dict:
+    return RECORDER.record(kind, trace_id=trace_id, **fields)
+
+
+# --------------------------------------------------------------------------
+# Fatal-crash hooks (installed by the CLI entry points, NOT on import —
+# a library import must never mutate process-global handlers)
+# --------------------------------------------------------------------------
+
+_hooks_installed = False
+_faulthandler_file = None
+
+
+def install_crash_hooks(recorder: Optional[FlightRecorder] = None
+                        ) -> Optional[str]:
+    """Arm the crash path: faulthandler to a file in the dump dir (so a
+    segfault/wedge leaves C-level tracebacks), a sys.excepthook wrapper
+    that dumps the ring before an unhandled exception kills the
+    process, and an atexit hook that disables faulthandler before its
+    file closes.  Idempotent; returns the faulthandler path."""
+    global _hooks_installed, _faulthandler_file
+    rec = recorder or RECORDER
+    if _hooks_installed:
+        return getattr(_faulthandler_file, "name", None)
+    _hooks_installed = True
+    fh_path = None
+    try:
+        import faulthandler
+        os.makedirs(rec.dump_dir, exist_ok=True)
+        fh_path = os.path.join(rec.dump_dir,
+                               f"faulthandler_{os.getpid()}.log")
+        _faulthandler_file = open(fh_path, "w")
+        faulthandler.enable(_faulthandler_file)
+
+        def _disarm():
+            try:
+                faulthandler.disable()
+                _faulthandler_file.close()
+            except Exception:
+                pass
+        atexit.register(_disarm)
+    except OSError:
+        _LOG.warning("faulthandler file setup failed", exc_info=True)
+
+    prev_hook = sys.excepthook
+
+    def _hook(exc_type, exc, tb):
+        try:
+            rec.record("fatal_crash",
+                       error=f"{exc_type.__name__}: {exc}")
+            rec.dump("fatal crash (unhandled exception)")
+        except Exception:
+            pass
+        prev_hook(exc_type, exc, tb)
+
+    sys.excepthook = _hook
+    return fh_path

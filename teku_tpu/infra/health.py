@@ -1,0 +1,574 @@
+"""Node health & SLO engine: "is this node healthy, and if not, WHERE?"
+
+PR 2 gave the node per-stage latency attribution; this module turns
+those histograms (plus the supervisor/breaker/queue state PR 1 built)
+into something an operator or autoscaler can act on:
+
+- ``HealthRegistry``: named per-subsystem ``HealthCheck``s, each
+  returning UP/DEGRADED/DOWN with a human detail line, aggregated
+  worst-wins to the node verdict behind ``/eth/v1/node/health``
+  (200/206/503) and ``/teku/v1/admin/readiness``.  Status changes are
+  EDGE-TRIGGERED: one flip = one log line + one flight-recorder event
+  + one ``health_transitions_total`` increment, never a per-tick spam.
+- ``EventLoopLagWatchdog``: measures asyncio scheduling delay (sleep
+  `interval_s`, compare the loop clock) — a blocked event loop is the
+  one failure every other check silently shares.
+- ``SloEngine``: declared objectives evaluated on a periodic tick from
+  the LIVE metrics registry (no offline bench needed).  Every objective
+  reduces to cumulative ``(good, total)`` event counts; per tick the
+  engine takes the delta and computes the burn rate
+
+      burn = (bad_fraction_this_window) / (1 - target_ratio)
+
+  — the standard error-budget form: 1.0 means exactly consuming
+  budget, >1.0 is a breach.  A p50-latency objective is the same
+  arithmetic with target_ratio=0.5 and good = "samples ≤ target
+  latency" read from the histogram buckets, so "verify p50 over
+  target" and "success ratio under target" share one code path.
+
+The reference's analogue is external (Grafana burn-rate alerts over the
+Besu metrics); committee-based-consensus measurements (PAPERS: EdDSA/
+BLS in committee consensus) show verify-latency tails gate attestation
+inclusion directly, which is why these SLOs run *inside* the node.
+
+Thresholds are env-tunable (documented in README/PERF):
+``TEKU_TPU_SLO_VERIFY_P50_MS``, ``TEKU_TPU_SLO_VERIFY_SUCCESS_RATIO``,
+``TEKU_TPU_SLO_DEVICE_RATIO``, ``TEKU_TPU_LOOP_LAG_DEGRADED_S``,
+``TEKU_TPU_LOOP_LAG_DOWN_S``, ``TEKU_TPU_HEALTH_QUEUE_SAT_DEGRADED``,
+``TEKU_TPU_HEALTH_WORKER_STALL_S``, ``TEKU_TPU_HEALTH_TICK_S``.
+"""
+
+import asyncio
+import enum
+import logging
+import os
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import flightrecorder, tracing
+from .metrics import GLOBAL_REGISTRY, MetricsRegistry
+
+_LOG = logging.getLogger(__name__)
+
+
+class HealthStatus(enum.Enum):
+    UP = "up"
+    DEGRADED = "degraded"
+    DOWN = "down"
+
+
+_SEVERITY = {HealthStatus.UP: 0, HealthStatus.DEGRADED: 1,
+             HealthStatus.DOWN: 2}
+
+
+@dataclass
+class CheckResult:
+    status: HealthStatus
+    detail: str = ""
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+# --------------------------------------------------------------------------
+# Health registry
+# --------------------------------------------------------------------------
+
+class HealthRegistry:
+    """Named subsystem checks aggregated worst-wins to one verdict.
+
+    A check is any zero-arg callable returning a CheckResult (or a bare
+    HealthStatus).  A RAISING check reads as DOWN — a prober that
+    cannot even run is evidence of sickness, not a reason to 500 the
+    health endpoint."""
+
+    STATES = tuple(s.value for s in HealthStatus)
+
+    def __init__(self, name: str = "node",
+                 registry: MetricsRegistry = GLOBAL_REGISTRY,
+                 recorder: Optional[flightrecorder.FlightRecorder] = None):
+        self.name = name
+        self._checks: Dict[str, Callable[[], CheckResult]] = {}
+        self._last: Dict[str, CheckResult] = {}
+        self._last_aggregate: Optional[HealthStatus] = None
+        self._recorder = recorder or flightrecorder.RECORDER
+        # fn(subject, old_status_or_None, result) on every edge
+        self.listeners: List[Callable] = []
+        # every family carries a `node` label: the families are
+        # process-global (get_or_create) but devnets run N nodes in
+        # one process, and node B's DOWN must not be overwritten by
+        # node A's next evaluate()
+        self._m_state = registry.labeled_gauge(
+            "health_node_state", "aggregate node health (worst check "
+            "wins): 1 on the series matching the current state",
+            labelnames=("node", "state"))
+        self._m_checks = registry.labeled_gauge(
+            "health_check_state",
+            "per-subsystem health: 1 on the series matching the "
+            "check's current state",
+            labelnames=("node", "check", "state"))
+        self._m_flips = registry.labeled_counter(
+            "health_transitions_total",
+            "edge-triggered health state changes per check "
+            "('node' = the aggregate)",
+            labelnames=("node", "check"))
+
+    def register(self, name: str,
+                 fn: Callable[[], CheckResult]) -> None:
+        if name in self._checks:
+            raise ValueError(f"health check {name!r} already registered")
+        self._checks[name] = fn
+
+    def check_names(self) -> List[str]:
+        return list(self._checks)
+
+    # ------------------------------------------------------------------
+    def _run_check(self, name: str, fn) -> CheckResult:
+        try:
+            res = fn()
+        except Exception as exc:  # noqa: BLE001 - sick prober = sick
+            return CheckResult(
+                HealthStatus.DOWN,
+                f"check raised {type(exc).__name__}: {exc}")
+        if isinstance(res, HealthStatus):
+            return CheckResult(res)
+        return res
+
+    def _flip(self, subject: str, old: Optional[HealthStatus],
+              result: CheckResult) -> None:
+        # first evaluation establishing UP is not an event; booting
+        # straight into DEGRADED/DOWN is
+        if old is None and result.status is HealthStatus.UP:
+            return
+        self._m_flips.labels(node=self.name, check=subject).inc()
+        level = (logging.WARNING
+                 if _SEVERITY[result.status] > _SEVERITY.get(old, 0)
+                 else logging.INFO)
+        _LOG.log(level, "health %s/%s: %s -> %s (%s)", self.name,
+                 subject, old.value if old else "?",
+                 result.status.value, result.detail or "no detail")
+        self._recorder.record(
+            "health_flip", subject=subject,
+            **{"from": old.value if old else None,
+               "to": result.status.value, "detail": result.detail})
+        for listener in self.listeners:
+            try:
+                listener(subject, old, result)
+            except Exception:  # pragma: no cover - observer must not kill
+                _LOG.exception("health listener failed")
+
+    def evaluate(self) -> HealthStatus:
+        """Run every check, update metrics, fire edges; returns the
+        aggregate.  Cheap enough for on-request use by the REST layer
+        AND the periodic tick — edges are idempotent across both."""
+        results = {name: self._run_check(name, fn)
+                   for name, fn in self._checks.items()}
+        aggregate = HealthStatus.UP
+        for name, res in results.items():
+            if _SEVERITY[res.status] > _SEVERITY[aggregate]:
+                aggregate = res.status
+            for state in self.STATES:
+                self._m_checks.labels(
+                    node=self.name, check=name, state=state).set(
+                    1.0 if state == res.status.value else 0.0)
+            prev = self._last.get(name)
+            if prev is None or prev.status is not res.status:
+                self._flip(name, prev.status if prev else None, res)
+        self._last = results
+        for state in self.STATES:
+            self._m_state.labels(node=self.name, state=state).set(
+                1.0 if state == aggregate.value else 0.0)
+        if aggregate is not self._last_aggregate:
+            detail = "; ".join(
+                f"{n}: {r.detail or r.status.value}"
+                for n, r in results.items()
+                if r.status is not HealthStatus.UP) or "all checks up"
+            self._flip("node", self._last_aggregate,
+                       CheckResult(aggregate, detail))
+            self._last_aggregate = aggregate
+        return aggregate
+
+    def snapshot(self) -> dict:
+        """Last evaluation as JSON (the /teku/v1/admin/readiness body)."""
+        return {
+            "status": (self._last_aggregate or HealthStatus.UP).value,
+            "checks": {name: {"status": res.status.value,
+                              "detail": res.detail}
+                       for name, res in self._last.items()}}
+
+
+# --------------------------------------------------------------------------
+# Event-loop-lag watchdog
+# --------------------------------------------------------------------------
+
+class EventLoopLagWatchdog:
+    """Scheduling-delay sampler: sleep `interval_s` on the loop and
+    measure the overshoot.  A CPU-bound handler (or a device call that
+    escaped its to_thread) shows up as lag here before it shows up
+    anywhere else.  The health verdict reads the WORST lag over the
+    last `window` samples, so one long stall stays visible for a few
+    seconds instead of vanishing at the next good tick."""
+
+    def __init__(self, interval_s: float = 0.25,
+                 degraded_s: Optional[float] = None,
+                 down_s: Optional[float] = None, window: int = 8,
+                 name: str = "node",
+                 registry: MetricsRegistry = GLOBAL_REGISTRY):
+        self.interval_s = interval_s
+        self.degraded_s = (degraded_s if degraded_s is not None else
+                           _env_float("TEKU_TPU_LOOP_LAG_DEGRADED_S",
+                                      0.2))
+        self.down_s = (down_s if down_s is not None else
+                       _env_float("TEKU_TPU_LOOP_LAG_DOWN_S", 2.0))
+        self._samples: deque = deque(maxlen=window)
+        self._task: Optional[asyncio.Task] = None
+        # a labeled child updated per sample, NOT a supplier gauge:
+        # get_or_create would pin the family to the FIRST watchdog's
+        # supplier, silently never exporting later nodes' lag
+        self._m_lag = registry.labeled_gauge(
+            "health_event_loop_lag_seconds",
+            "worst recent asyncio scheduling lag",
+            labelnames=("node",)).labels(node=name)
+
+    @property
+    def lag_s(self) -> float:
+        return max(self._samples, default=0.0)
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            t0 = loop.time()
+            await asyncio.sleep(self.interval_s)
+            self._samples.append(
+                max(0.0, loop.time() - t0 - self.interval_s))
+            self._m_lag.set(self.lag_s)
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.create_task(
+                self._run(), name="event-loop-lag-watchdog")
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    def check(self) -> CheckResult:
+        if self._task is None:
+            return CheckResult(HealthStatus.UP, "watchdog not running")
+        if self._task.done():
+            # started but died (external cancel sweep, sampler bug):
+            # frozen samples must not keep reporting a green loop
+            return CheckResult(HealthStatus.DEGRADED,
+                               "watchdog task died; lag unknown")
+        lag = self.lag_s
+        if lag >= self.down_s:
+            return CheckResult(HealthStatus.DOWN,
+                               f"event loop lag {lag:.3f}s")
+        if lag >= self.degraded_s:
+            return CheckResult(HealthStatus.DEGRADED,
+                               f"event loop lag {lag:.3f}s")
+        return CheckResult(HealthStatus.UP, f"lag {lag * 1e3:.1f}ms")
+
+
+# --------------------------------------------------------------------------
+# SLO engine
+# --------------------------------------------------------------------------
+
+@dataclass
+class SloObjective:
+    """One declared objective.  `sample()` returns CUMULATIVE
+    (good_events, total_events); the engine windows by delta between
+    ticks.  `target_ratio` is the fraction that must be good — 0.5 for
+    a p50-latency objective, 0.99 for a success ratio."""
+
+    name: str
+    description: str
+    target_ratio: float
+    sample: Callable[[], Tuple[float, float]]
+
+
+def histogram_good_total(child_getter: Callable, le_s: float
+                         ) -> Tuple[float, float]:
+    """(samples ≤ le_s, total) from a histogram child's cumulative
+    buckets — the bucket boundary at or below `le_s` bounds `good`
+    conservatively (a mid-bucket target under-counts good, never
+    over-counts)."""
+    child = child_getter()
+    counts, _sum, total = child.snapshot()
+    good = 0
+    cum = 0
+    for i, ub in enumerate(child.buckets):
+        cum += counts[i]
+        if ub <= le_s:
+            good = cum
+    return float(good), float(total)
+
+
+def labeled_counter_good_total(family, good_pred) -> Tuple[float, float]:
+    """(sum of children matching good_pred(labels_dict), sum of all)
+    over a LabeledCounter family."""
+    good = total = 0.0
+    for key, child in family._items():
+        labels = dict(zip(family.labelnames, key))
+        total += child.value
+        if good_pred(labels):
+            good += child.value
+    return good, total
+
+
+def default_slo_objectives(registry: MetricsRegistry = GLOBAL_REGISTRY
+                           ) -> List[SloObjective]:
+    """The ROADMAP's north-star objectives, read from the metrics the
+    hot path already populates (tracing + the guarded BLS facade).
+
+    Caveat: these source families are process-global (the hot path
+    carries no node label), so in a multi-node process (devnet) every
+    node's engine windows the COMBINED traffic — one node's failures
+    raise every node's burn.  Production topology is one node per
+    process, where the families and the node are the same thing; the
+    per-node `node` label on the slo_* output series exists so the
+    devnet case at least stays distinguishable per engine."""
+    p50_target_s = _env_float("TEKU_TPU_SLO_VERIFY_P50_MS", 100.0) / 1e3
+    success_target = _env_float("TEKU_TPU_SLO_VERIFY_SUCCESS_RATIO",
+                                0.99)
+    device_target = _env_float("TEKU_TPU_SLO_DEVICE_RATIO", 0.0)
+    stage_hist = registry.labeled_histogram(
+        "verify_stage_duration_seconds",
+        "per-stage latency attribution of the verification pipeline",
+        labelnames=("stage",))
+    requests = registry.labeled_counter(
+        "bls_verify_requests_total",
+        "guarded BLS dispatches by serving backend and reason",
+        labelnames=("backend", "reason"))
+    return [
+        SloObjective(
+            name="attestation_verify_p50",
+            description=f"p50 end-to-end verify latency ≤ "
+                        f"{p50_target_s * 1e3:.0f}ms",
+            target_ratio=0.5,
+            sample=lambda: histogram_good_total(
+                lambda: stage_hist.labels(stage="complete"),
+                p50_target_s)),
+        SloObjective(
+            name="verify_success_ratio",
+            description=f"≥ {success_target:.2%} of guarded verifies "
+                        "served without breaker/fallback",
+            target_ratio=success_target,
+            sample=lambda: labeled_counter_good_total(
+                requests, lambda l: l.get("reason") == "ok")),
+        SloObjective(
+            name="device_serving_ratio",
+            description=f"≥ {device_target:.0%} of guarded verifies "
+                        "served by the device backend",
+            target_ratio=device_target,
+            sample=lambda: labeled_counter_good_total(
+                requests, lambda l: l.get("backend") == "device")),
+    ]
+
+
+class SloEngine:
+    """Periodic burn-rate evaluation with edge-triggered breach events.
+
+    Each tick windows every objective's cumulative (good, total) by
+    delta, computes burn = bad_fraction / (1 - target_ratio), exports
+    ``slo_burn_rate{objective=...}``, and on a breach EDGE records an
+    ``slo_breach`` flight-recorder event carrying the originating trace
+    id (the context's current trace, else the last traced failure the
+    recorder saw — e.g. the verify whose dispatch tripped the breaker).
+    A window with fewer than `min_samples` new events holds the
+    previous verdict instead of swinging on noise."""
+
+    def __init__(self, objectives: Optional[List[SloObjective]] = None,
+                 registry: MetricsRegistry = GLOBAL_REGISTRY,
+                 recorder: Optional[flightrecorder.FlightRecorder] = None,
+                 min_samples: int = 1, name: str = "node"):
+        self.objectives = (objectives if objectives is not None
+                           else default_slo_objectives(registry))
+        self.name = name
+        self._recorder = recorder or flightrecorder.RECORDER
+        self.min_samples = max(1, min_samples)
+        self._prev: Dict[str, Tuple[float, float]] = {}
+        self._burn: Dict[str, float] = {}
+        self._in_breach: Dict[str, bool] = {}
+        # windows evaluated per objective: 0 means the objective has
+        # never had evidence (e.g. the latency objective with
+        # --tracing off) — surfaced in snapshot() so a dark objective
+        # cannot masquerade as a green one
+        self._windows: Dict[str, int] = {}
+        self._m_burn = registry.labeled_gauge(
+            "slo_burn_rate",
+            "error-budget burn rate per objective over the last tick "
+            "window (1.0 = consuming exactly the budget, >1 = breach)",
+            labelnames=("node", "objective"))
+        self._m_breached = registry.labeled_gauge(
+            "slo_breached", "1 while the objective is in breach",
+            labelnames=("node", "objective"))
+        self._m_breaches = registry.labeled_counter(
+            "slo_breaches_total", "edge-triggered SLO breach events",
+            labelnames=("node", "objective"))
+
+    # ------------------------------------------------------------------
+    def tick(self) -> dict:
+        for obj in self.objectives:
+            try:
+                good, total = obj.sample()
+            except Exception:
+                _LOG.warning("SLO %s sample failed", obj.name,
+                             exc_info=True)
+                continue
+            prev_good, prev_total = self._prev.get(obj.name, (0.0, 0.0))
+            d_good = good - prev_good
+            d_total = total - prev_total
+            self._prev[obj.name] = (good, total)
+            if d_total < self.min_samples:
+                continue        # no new evidence: hold the last verdict
+            self._windows[obj.name] = self._windows.get(obj.name, 0) + 1
+            bad_fraction = min(1.0, max(0.0, 1.0 - d_good / d_total))
+            budget = max(1e-9, 1.0 - obj.target_ratio)
+            burn = bad_fraction / budget
+            self._burn[obj.name] = burn
+            self._m_burn.labels(node=self.name, objective=obj.name
+                                ).set(round(burn, 6))
+            # strict >: a zero-budget-headroom objective (target 0)
+            # reads fully-bad traffic as burn == 1.0, not a breach
+            breached = burn > 1.0 + 1e-9
+            self._m_breached.labels(node=self.name, objective=obj.name
+                                    ).set(1.0 if breached else 0.0)
+            was = self._in_breach.get(obj.name, False)
+            if breached and not was:
+                self._m_breaches.labels(node=self.name,
+                                        objective=obj.name).inc()
+                trace_id = (tracing.current_trace_id()
+                            or self._recorder.last_trace_id())
+                self._recorder.record(
+                    "slo_breach", trace_id=trace_id,
+                    objective=obj.name, burn_rate=round(burn, 3),
+                    detail=obj.description)
+                _LOG.warning("SLO BREACH %s: burn %.2f (%s)",
+                             obj.name, burn, obj.description)
+            elif was and not breached:
+                self._recorder.record(
+                    "slo_recovery", objective=obj.name,
+                    burn_rate=round(burn, 3))
+                _LOG.info("SLO recovered %s: burn %.2f", obj.name, burn)
+            self._in_breach[obj.name] = breached
+        return self.snapshot()
+
+    def snapshot(self) -> dict:
+        return {obj.name: {
+            "description": obj.description,
+            "target_ratio": obj.target_ratio,
+            "burn_rate": round(self._burn.get(obj.name, 0.0), 4),
+            "breached": self._in_breach.get(obj.name, False),
+            # 0 = the objective has never seen a data window (dark,
+            # not green) — e.g. the latency objective under
+            # --tracing off, whose source histogram never observes
+            "windows": self._windows.get(obj.name, 0)}
+            for obj in self.objectives}
+
+    def check(self) -> CheckResult:
+        """Health-check adapter: any in-breach objective degrades."""
+        breached = [n for n, b in self._in_breach.items() if b]
+        if breached:
+            return CheckResult(
+                HealthStatus.DEGRADED,
+                "SLO breach: " + ", ".join(sorted(breached)))
+        return CheckResult(HealthStatus.UP, "all objectives within "
+                                            "budget")
+
+
+# --------------------------------------------------------------------------
+# Check factories for the node's subsystems
+# --------------------------------------------------------------------------
+
+def supervisor_check(supervisor_getter: Callable) -> Callable[[], CheckResult]:
+    """Backend supervisor + circuit breaker as one check: TRIPPED /
+    DEGRADED (oracle-permanent) / an open breaker all read DEGRADED —
+    the node stays CORRECT on the oracle, only latency degrades, which
+    maps to 206 on the health endpoint, never 503."""
+    def check() -> CheckResult:
+        sup = supervisor_getter()
+        if sup is None:
+            return CheckResult(HealthStatus.UP,
+                               "no supervisor (static backend)")
+        state = sup.backend_state
+        if state == "tripped":
+            return CheckResult(
+                HealthStatus.DEGRADED,
+                "circuit open, oracle serving "
+                f"({sup.backend_detail or 'breaker trip'})")
+        if state == "degraded":
+            return CheckResult(
+                HealthStatus.DEGRADED,
+                f"bring-up abandoned, oracle permanent "
+                f"({sup.backend_detail or 'no detail'})")
+        breaker = getattr(sup, "breaker", None)
+        if breaker is not None and breaker.state != "closed" \
+                and state == "ready":
+            return CheckResult(HealthStatus.DEGRADED,
+                               f"breaker {breaker.state}")
+        return CheckResult(HealthStatus.UP, f"backend {state}")
+    return check
+
+
+def signature_service_check(service,
+                            saturation_degraded: Optional[float] = None,
+                            stall_down_s: Optional[float] = None
+                            ) -> Callable[[], CheckResult]:
+    """Signature-queue saturation + worker stall: a near-full queue is
+    shedding-imminent (DEGRADED); queued work with no worker progress
+    for `stall_down_s` means verdicts are not being produced (DOWN)."""
+    sat_limit = (saturation_degraded if saturation_degraded is not None
+                 else _env_float("TEKU_TPU_HEALTH_QUEUE_SAT_DEGRADED",
+                                 0.8))
+    stall_limit = (stall_down_s if stall_down_s is not None
+                   else _env_float("TEKU_TPU_HEALTH_WORKER_STALL_S",
+                                   30.0))
+
+    def check() -> CheckResult:
+        snap = service.health_snapshot()
+        if snap["stalled_s"] >= stall_limit:
+            return CheckResult(
+                HealthStatus.DOWN,
+                f"workers stalled {snap['stalled_s']:.1f}s with "
+                f"{snap['queue_size']} tasks queued")
+        if snap["saturation"] >= sat_limit:
+            return CheckResult(
+                HealthStatus.DEGRADED,
+                f"queue {snap['queue_size']}/{snap['capacity']} "
+                f"({snap['saturation']:.0%} full)")
+        return CheckResult(
+            HealthStatus.UP,
+            f"queue {snap['queue_size']}/{snap['capacity']}")
+    return check
+
+
+def staleness_check(last_seen_getter: Callable[[], Optional[float]],
+                    degraded_s: float, what: str,
+                    clock: Callable[[], float] = time.monotonic
+                    ) -> Callable[[], CheckResult]:
+    """Generic freshness check: DEGRADED once `what` has not been seen
+    for `degraded_s` (None = never seen yet, reads UP with detail —
+    silence before the first event is boot, not sickness)."""
+    def check() -> CheckResult:
+        last = last_seen_getter()
+        if last is None:
+            return CheckResult(HealthStatus.UP, f"no {what} yet")
+        age = clock() - last
+        if age >= degraded_s:
+            return CheckResult(HealthStatus.DEGRADED,
+                               f"last {what} {age:.0f}s ago")
+        return CheckResult(HealthStatus.UP,
+                           f"last {what} {age:.1f}s ago")
+    return check

@@ -5,9 +5,17 @@ logging/src/main/java/tech/pegasys/teku/infrastructure/logging/
 StatusLogger.java, EventLogger.java, ValidatorLogger.java): named
 channels with consistent, human-scannable slot/epoch event lines, on
 top of stdlib logging so operators configure handlers normally.
+
+``--log-format json`` switches every record to one JSON object per
+line, each carrying the ACTIVE TRACE ID from `infra/tracing.py`'s
+ContextVar — so log lines, slow traces (`/teku/v1/admin/traces`), and
+flight-recorder events all correlate on one id without any call-site
+changes.
 """
 
+import json
 import logging
+import time
 
 STATUS = logging.getLogger("teku_tpu.status")
 EVENTS = logging.getLogger("teku_tpu.events")
@@ -15,16 +23,59 @@ VALIDATOR = logging.getLogger("teku_tpu.validator")
 P2P = logging.getLogger("teku_tpu.p2p")
 
 
-def configure(level: int = logging.INFO) -> None:
-    """Console setup with the reference's log line flavor."""
+class JsonFormatter(logging.Formatter):
+    """One JSON object per record, trace-correlated.
+
+    The trace id is read at FORMAT time from the emitting context, so a
+    WARN inside a gossip validator's root span (or inside the breaker's
+    dispatch thread, which copies the context) carries the id of the
+    verification that logged it."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        from . import tracing
+        out = {
+            "t": round(record.created, 3),
+            "iso": time.strftime("%Y-%m-%dT%H:%M:%S",
+                                 time.localtime(record.created)),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        trace_id = tracing.current_trace_id()
+        if trace_id:
+            out["trace_id"] = trace_id
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, default=str)
+
+
+def _make_formatter(fmt: str) -> logging.Formatter:
+    if fmt == "json":
+        return JsonFormatter()
+    return logging.Formatter(
+        "%(asctime)s | %(levelname)-5s | %(name)s | %(message)s",
+        datefmt="%H:%M:%S")
+
+
+def configure(level: int = logging.INFO, fmt: str = "text") -> None:
+    """Console setup with the reference's log line flavor, or one JSON
+    object per line when ``fmt == "json"``.  Re-invoking with a new
+    format reformats in place — but ONLY the handlers this function
+    created (marked): an embedding application's own handlers keep
+    their formatters (and an embedder that owns every handler simply
+    isn't reformatted — it owns its log config)."""
+    if fmt not in ("text", "json"):
+        raise ValueError(f"unknown log format {fmt!r} (text or json)")
     root = logging.getLogger()
     if root.handlers:
         root.setLevel(level)
+        for handler in root.handlers:
+            if getattr(handler, "_teku_tpu_managed", False):
+                handler.setFormatter(_make_formatter(fmt))
         return
     handler = logging.StreamHandler()
-    handler.setFormatter(logging.Formatter(
-        "%(asctime)s | %(levelname)-5s | %(name)s | %(message)s",
-        datefmt="%H:%M:%S"))
+    handler._teku_tpu_managed = True
+    handler.setFormatter(_make_formatter(fmt))
     root.addHandler(handler)
     root.setLevel(level)
 

@@ -252,6 +252,39 @@ class LabeledCounter(_LabeledFamily):
         return out
 
 
+class LabeledGauge(_LabeledFamily):
+    """Gauge family with a `labels(**kv)` child API, e.g.
+    ``m.labels(objective="verify_p50").set(burn)`` — what per-check
+    health states and per-objective SLO burn rates hang off."""
+
+    class _Child:
+        __slots__ = ("_value", "_lock")
+
+        def __init__(self):
+            self._value = 0.0
+            self._lock = threading.Lock()
+
+        def set(self, value: float) -> None:
+            with self._lock:
+                self._value = float(value)
+
+        @property
+        def value(self) -> float:
+            with self._lock:
+                return self._value
+
+    def labels(self, **kv) -> "_Child":
+        return self._child(kv, LabeledGauge._Child)
+
+    def collect(self) -> List[str]:
+        out = _header(self.name, self.help, "gauge")
+        for key, child in self._items():
+            out.append(f"{self.name}"
+                       f"{_fmt_labels(self.labelnames, key)} "
+                       f"{child.value}")
+        return out
+
+
 class LabeledHistogram(_LabeledFamily):
     """Histogram family with per-label-set buckets, e.g.
     ``m.labels(stage="device_execute").observe(dt)``."""
@@ -331,6 +364,17 @@ class MetricsRegistry:
             name, lambda: LabeledCounter(name, help_, labelnames),
             LabeledCounter)
         # empty labelnames = retrieval of an existing family
+        if labelnames and tuple(labelnames) != m.labelnames:
+            raise ValueError(
+                f"metric {name} already registered with labels "
+                f"{m.labelnames}")
+        return m
+
+    def labeled_gauge(self, name: str, help_: str = "",
+                      labelnames: Sequence[str] = ()) -> LabeledGauge:
+        m = self._get_or_create(
+            name, lambda: LabeledGauge(name, help_, labelnames),
+            LabeledGauge)
         if labelnames and tuple(labelnames) != m.labelnames:
             raise ValueError(
                 f"metric {name} already registered with labels "

@@ -161,11 +161,18 @@ class RestApi:
                     if isinstance(result, SseStream):
                         await self._stream_sse(writer, result)
                         return True
-                    if isinstance(result, tuple):       # (payload, ctype)
-                        payload, ctype = result
+                    # (payload, ctype) or (payload, ctype, status) —
+                    # the health endpoint speaks through its status
+                    # code (200/206/503), not its body
+                    if isinstance(result, tuple):
+                        if len(result) == 3:
+                            payload, ctype, status = result
+                        else:
+                            payload, ctype = result
+                            status = 200
                     else:
                         payload = result
-                    status = 200
+                        status = 200
                 except HttpError as exc:
                     status = exc.status
                     payload = {"code": exc.status, "message": exc.message}

@@ -36,7 +36,7 @@ import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
-from . import faults
+from . import faults, flightrecorder
 from .aio import retry_with_backoff
 from .metrics import GLOBAL_REGISTRY, MetricsRegistry
 from .service import Service
@@ -98,6 +98,7 @@ class CircuitBreaker:
                  registry: MetricsRegistry = GLOBAL_REGISTRY,
                  clock: Callable[[], float] = time.monotonic,
                  on_state_change: Optional[Callable[[str], None]] = None):
+        self.name = name
         self.failure_threshold = failure_threshold
         self.deadline_s = deadline_s
         self.base_cooldown_s = cooldown_s
@@ -161,13 +162,17 @@ class CircuitBreaker:
             return False
 
     def record_success(self) -> None:
+        reclosed = False
         with self._lock:
             self._consecutive_failures = 0
             if self._state != self.CLOSED:
                 _LOG.info("circuit %s: probe succeeded, re-closing",
                           self._m_state.name)
                 self._trips = 0
+                reclosed = True
             self._set_state(self.CLOSED)
+        if reclosed:
+            flightrecorder.record("breaker_reclose", breaker=self.name)
 
     def record_failure(self, timeout: bool = False) -> None:
         (self._m_timeouts if timeout else self._m_failures).inc()
@@ -183,13 +188,27 @@ class CircuitBreaker:
                     self.base_cooldown_s * (2 ** (self._trips - 1)),
                     self.max_cooldown_s)
                 self._open_until = self._clock() + cooldown
-                if self._state != self.OPEN:
+                newly_open = self._state != self.OPEN
+                if newly_open:
                     _LOG.warning(
                         "circuit %s OPEN after %d consecutive "
                         "failures (cooldown %.1fs)", self._m_state.name,
                         self._consecutive_failures, cooldown)
+                consecutive = self._consecutive_failures
                 self._consecutive_failures = 0
                 self._set_state(self.OPEN)
+            else:
+                return
+        # outside the lock: the trip event (with the tripping verify's
+        # trace id — dispatch runs under the caller's copied context)
+        # and the automatic JSONL dump must not hold the breaker
+        flightrecorder.record(
+            "breaker_trip", breaker=self.name,
+            consecutive_failures=consecutive,
+            timeout=timeout, cooldown_s=round(cooldown, 1),
+            reopened=not newly_open)
+        flightrecorder.RECORDER.dump_throttled(
+            f"breaker trip: {self.name}")
 
     # ------------------------------------------------------------------
     def call(self, fn: Callable, *args, probe: bool = False, **kwargs):
@@ -317,6 +336,9 @@ class BackendSupervisor(Service):
         self.transitions.append((state.value, time.time()))
         self._m_state.set_state(state.value)
         self._m_transitions.inc()
+        flightrecorder.record("backend_state", supervisor=self.name,
+                              state=state.value,
+                              detail=self.backend_detail)
         _LOG.info("backend supervisor %s: %s", self.name, state.value)
 
     def _on_breaker_state(self, breaker_state: str) -> None:

@@ -30,6 +30,7 @@ enter/exit do nothing, ``new_trace`` returns None, and record calls
 return immediately — no allocation, no lock, no histogram touch.
 """
 
+import itertools
 import os
 import threading
 import time
@@ -76,6 +77,16 @@ def set_sampler(fn: Optional[Callable[["Trace"], None]]) -> None:
     _sampler = fn
 
 
+# Process-unique trace ids: the ONE key logs, slow traces, and flight-
+# recorder events correlate on.  pid-prefixed so ids from a devnet of
+# subprocesses (or a bench child) stay distinguishable in merged logs.
+_TRACE_SEQ = itertools.count(1)
+
+
+def _next_trace_id() -> str:
+    return f"{os.getpid():x}-{next(_TRACE_SEQ):06x}"
+
+
 class Trace:
     """One verification's stage breakdown, root-span start to verdict.
 
@@ -83,10 +94,11 @@ class Trace:
     task, and the device-dispatch worker thread all contribute stages.
     """
 
-    __slots__ = ("name", "labels", "t_start", "t_wall", "_end",
-                 "stages", "_lock")
+    __slots__ = ("trace_id", "name", "labels", "t_start", "t_wall",
+                 "_end", "stages", "_lock")
 
     def __init__(self, name: str, labels: Dict[str, str]):
+        self.trace_id = _next_trace_id()
         self.name = name
         self.labels = labels
         self.t_start = time.perf_counter()
@@ -111,7 +123,8 @@ class Trace:
     def to_dict(self) -> dict:
         with self._lock:
             stages = list(self.stages)
-        return {"name": self.name,
+        return {"trace_id": self.trace_id,
+                "name": self.name,
                 "labels": dict(self.labels),
                 "t_wall": round(self.t_wall, 3),
                 "total_ms": round(self.total_s * 1e3, 3),
@@ -251,6 +264,13 @@ def current_trace() -> Optional[Trace]:
     stamps this onto queued tasks), or None."""
     traces = _CURRENT.get()
     return traces[0] if traces else None
+
+
+def current_trace_id() -> str:
+    """Trace id of the context's current trace, or "" — the correlation
+    key JSON log records and flight-recorder events carry."""
+    traces = _CURRENT.get()
+    return traces[0].trace_id if traces else ""
 
 
 def finish(trace: Optional[Trace]) -> None:

@@ -76,6 +76,47 @@ class NetworkedNode:
             except Exception:
                 pass
         self.net.on_peer_connected = _on_connect
+        self._register_health_checks()
+
+    def _register_health_checks(self) -> None:
+        """Networking-layer checks into the node's HealthRegistry —
+        peer count, sync status, gossip staleness (the node itself
+        registers its subsystem checks; only the layer that OWNS the
+        network can judge it)."""
+        from ..infra.health import (CheckResult, HealthStatus,
+                                    staleness_check)
+
+        def peers_check() -> CheckResult:
+            connected = sum(1 for p in self.net.peers if p.connected)
+            if connected == 0:
+                return CheckResult(HealthStatus.DEGRADED,
+                                   "no connected peers")
+            return CheckResult(HealthStatus.UP,
+                               f"{connected} peer(s) connected")
+
+        def sync_check() -> CheckResult:
+            if self.sync.syncing:
+                head = self.node.chain.head_slot()
+                return CheckResult(HealthStatus.DEGRADED,
+                                   f"syncing (head slot {head})")
+            return CheckResult(HealthStatus.UP, "in sync")
+
+        self.node.health.register("peers", peers_check)
+        self.node.health.register("sync", sync_check)
+        # gossip silence only counts once a first frame has arrived
+        # AND peers are connected — a peerless node is the peers
+        # check's finding, not a staleness one
+        base = staleness_check(
+            lambda: self.gossip.last_message_monotonic,
+            degraded_s=60.0, what="gossip message")
+
+        def gossip_check() -> CheckResult:
+            if not any(p.connected for p in self.net.peers):
+                return CheckResult(HealthStatus.UP,
+                                   "no peers (staleness n/a)")
+            return base()
+
+        self.node.health.register("gossip", gossip_check)
 
     async def start(self) -> None:
         import asyncio

@@ -22,6 +22,7 @@ import hashlib
 import logging
 import random
 import struct
+import time
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from ..infra.collections import LimitedSet
@@ -188,6 +189,10 @@ class TcpGossipNetwork(GossipNetwork):
         self.net.on_peer_disconnected = self._on_peer_gone
         self._handlers: Dict[str, TopicHandler] = {}
         self._seen: LimitedSet = LimitedSet(SEEN_CACHE_SIZE)
+        # monotonic stamp of the last gossip frame received from ANY
+        # peer — the health layer's staleness signal (None until the
+        # first frame: silence during boot is not sickness)
+        self.last_message_monotonic: Optional[float] = None
         self.scoring = scoring or GossipScoring()
         self._peer_topics: Dict[bytes, Set[str]] = {}
         self._mesh: Dict[str, Set[Peer]] = {}
@@ -335,6 +340,7 @@ class TcpGossipNetwork(GossipNetwork):
 
     # -- inbound -------------------------------------------------------
     async def _on_gossip(self, peer: Peer, payload: bytes) -> None:
+        self.last_message_monotonic = time.monotonic()
         if self.scoring.score(peer.node_id) \
                 < self.scoring.params.graylist_threshold:
             return                      # graylisted: drop everything

@@ -10,10 +10,16 @@ pool and topic subscriptions, and exposes the slot-phase entry points
 timer or a devnet driver invokes.
 """
 
+import asyncio
 import logging
+import os
 from typing import List, Optional
 
+from ..infra import flightrecorder
 from ..infra.events import EventChannels, SlotEventsChannel
+from ..infra.health import (CheckResult, EventLoopLagWatchdog,
+                            HealthRegistry, HealthStatus, SloEngine,
+                            signature_service_check, supervisor_check)
 from ..infra.logs import log_slot_event
 from ..infra.service import Service
 from ..services.signatures import AggregatingSignatureVerificationService
@@ -108,7 +114,47 @@ class BeaconNode(Service):
         self._advanced_cache: Optional[tuple] = None
         # gossip awaiting re-validation (kind, message, retries)
         self._deferred_gossip: List[tuple] = []
+        # health & SLO subsystem (infra/health.py): per-subsystem
+        # checks aggregated behind /eth/v1/node/health, SLOs evaluated
+        # continuously from the live metrics, everything edge-logged
+        # into the process flight recorder
+        self.flight_recorder = flightrecorder.RECORDER
+        self.health = HealthRegistry(name=name)
+        self.loop_watchdog = EventLoopLagWatchdog(name=name)
+        self.slo = SloEngine(name=name)
+        self.health.register("backend",
+                             supervisor_check(lambda: self.supervisor))
+        self.health.register("signature_queue",
+                             signature_service_check(self.sig_service))
+        self.health.register("event_loop", self.loop_watchdog.check)
+        # late binding: bench/tests may swap the engine after wiring
+        self.health.register("slo", lambda: self.slo.check())
+        self.health.register("chain_head", self._chain_head_check)
+        self._health_task: Optional[asyncio.Task] = None
         self._subscribe_topics()
+
+    def _chain_head_check(self) -> CheckResult:
+        """Head freshness: a head stuck N slots behind the wall clock
+        is the node-side symptom of sync loss or import stall."""
+        lag = max(0, self.chain.current_slot() - self.chain.head_slot())
+        if lag > 4:
+            return CheckResult(HealthStatus.DEGRADED,
+                               f"head {lag} slots behind the clock")
+        return CheckResult(HealthStatus.UP, f"head lag {lag} slot(s)")
+
+    async def _health_tick_loop(self) -> None:
+        """Periodic SLO window + health sweep.  The tick must survive
+        any single broken check/objective — losing the watchdog because
+        one gauge raised would be the observability layer's own
+        silent-failure bug."""
+        interval = float(os.environ.get("TEKU_TPU_HEALTH_TICK_S", "5"))
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                self.slo.tick()
+                self.health.evaluate()
+            except Exception:  # pragma: no cover - belt and braces
+                _LOG.exception("health tick failed")
 
     def advanced_head_state(self, slot: int):
         """Head state advanced to `slot`, computed once per (head, slot)
@@ -381,8 +427,19 @@ class BeaconNode(Service):
         await self.sig_service.start()
         if self.supervisor is not None:
             await self.supervisor.start()
+        self.loop_watchdog.start()
+        self._health_task = asyncio.create_task(
+            self._health_tick_loop(), name=f"{self.name}-health-tick")
 
     async def do_stop(self) -> None:
+        if self._health_task is not None:
+            self._health_task.cancel()
+            try:
+                await self._health_task
+            except asyncio.CancelledError:
+                pass
+            self._health_task = None
+        await self.loop_watchdog.stop()
         if self.supervisor is not None:
             await self.supervisor.stop()
         await self.sig_service.stop()

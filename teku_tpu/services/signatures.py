@@ -30,7 +30,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Tuple
 
 from ..crypto import bls
-from ..infra import faults, tracing
+from ..infra import faults, flightrecorder, tracing
 from ..infra.metrics import (GLOBAL_REGISTRY, LATENCY_BUCKETS_S,
                              MetricsRegistry)
 
@@ -64,6 +64,7 @@ class AggregatingSignatureVerificationService:
         if num_workers < 1:
             raise ValueError("need at least one worker")
         self.num_workers = num_workers
+        self._name = name
         self.queue_capacity = queue_capacity
         self.max_batch_size = max_batch_size
         self.split_threshold = split_threshold
@@ -103,6 +104,15 @@ class AggregatingSignatureVerificationService:
         self._m_rejected = registry.counter(
             f"{name}_rejected_total",
             "tasks shed because the queue was at capacity")
+        # (queue saturation is served by health_snapshot() / the
+        # readiness endpoint, not a supplier gauge: get_or_create would
+        # pin the family to the FIRST service instance's closure)
+        # worker liveness: monotonic stamp of the last time ANY worker
+        # made progress (took or finished a batch) — queued work plus a
+        # stale stamp is the signature of every worker wedged in a
+        # dispatch, which no throughput counter can distinguish from
+        # simple idleness
+        self._last_worker_progress = time.monotonic()
 
     # ------------------------------------------------------------------
     async def start(self) -> None:
@@ -154,6 +164,10 @@ class AggregatingSignatureVerificationService:
                 trace=tracing.current_trace()))
         except asyncio.QueueFull:
             self._m_rejected.inc()
+            flightrecorder.record(
+                "queue_shed", service=self._name,
+                queue_size=self._queue.qsize(),
+                capacity=self.queue_capacity, triples=len(triples))
             _LOG.warning(
                 "signature verification queue at capacity "
                 "(%d/%d pending) — shedding task (%d triples)",
@@ -162,10 +176,26 @@ class AggregatingSignatureVerificationService:
                 f"queue at capacity ({self.queue_capacity})") from None
         return fut
 
+    def health_snapshot(self) -> dict:
+        """Queue + worker liveness for `infra/health.py`'s check:
+        `stalled_s` is nonzero only while tasks are QUEUED with no
+        worker progress — an idle service never reads as stalled."""
+        qsize = self._queue.qsize()
+        stalled_s = 0.0
+        if qsize > 0 and self._started and not self._stopped:
+            stalled_s = max(
+                0.0, time.monotonic() - self._last_worker_progress)
+        return {"queue_size": qsize,
+                "capacity": self.queue_capacity,
+                "saturation": qsize / self.queue_capacity,
+                "workers": len(self._workers),
+                "stalled_s": stalled_s}
+
     # ------------------------------------------------------------------
     async def _worker(self) -> None:
         while not self._stopped:
             first = await self._queue.get()
+            self._last_worker_progress = time.monotonic()
             t_first = time.perf_counter()
             tasks = [first]
             budget = self.max_batch_size - len(first.triples)
@@ -198,6 +228,8 @@ class AggregatingSignatureVerificationService:
                 for t in tasks:
                     if not t.future.done():
                         t.future.set_exception(exc)
+            finally:
+                self._last_worker_progress = time.monotonic()
 
     async def _verify_batch(self, tasks: List[_Task],
                             first_try: bool = True) -> None:

@@ -150,6 +150,11 @@ def test_blob_sidecars_rpc_serving():
     """BeaconRpc serves deneb sidecars from the pool by root and range."""
     import asyncio
     import types
+    # teku_tpu.networking imports the noise transport, whose AEAD
+    # primitives need the optional `cryptography` wheel
+    pytest.importorskip(
+        "cryptography",
+        reason="networking stack needs the optional cryptography wheel")
     from teku_tpu.spec import config as C
     from teku_tpu.networking import reqresp as rr
 

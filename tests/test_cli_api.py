@@ -330,6 +330,11 @@ def test_validator_subscription_and_registration_endpoints():
     subscriptions, proposer preparation, builder registrations
     (reference handlers/v1/validator/Post*)."""
     import time
+    # NetworkedNode pulls in the noise transport, whose AEAD
+    # primitives need the optional `cryptography` wheel
+    pytest.importorskip(
+        "cryptography",
+        reason="networking stack needs the optional cryptography wheel")
     from teku_tpu import builderapi as B
     from teku_tpu.api import BeaconRestApi
     from teku_tpu.crypto import bls

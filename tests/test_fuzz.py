@@ -149,6 +149,11 @@ def test_fuzz_wire_encoding_payloads():
     """Spec ssz_snappy payload decoder: mutated uvarint prefixes and
     framing streams must raise EncodingError (or SnappyError at the
     block layer), never crash or return wrong-length data."""
+    # teku_tpu.networking imports the noise transport, whose AEAD
+    # primitives need the optional `cryptography` wheel
+    pytest.importorskip(
+        "cryptography",
+        reason="networking stack needs the optional cryptography wheel")
     from teku_tpu.networking import encoding as E
     rng = random.Random(71)
     base = E.encode_payload(rng.randbytes(5000))
@@ -165,6 +170,9 @@ def test_fuzz_wire_encoding_payloads():
 def test_fuzz_gossip_control_decoder():
     """Gossipsub control frames: arbitrary mutations either decode to
     well-formed lists or raise ValueError for the scoring layer."""
+    pytest.importorskip(
+        "cryptography",
+        reason="networking stack needs the optional cryptography wheel")
     from teku_tpu.networking import gossip as G
     rng = random.Random(72)
     base = G.encode_control(
@@ -185,6 +193,10 @@ def test_fuzz_gossip_control_decoder():
 def test_fuzz_discovery_records():
     """Signed node records: any mutation that survives decoding must
     still verify — i.e. decode() never admits a tampered record."""
+    pytest.importorskip(
+        "cryptography",
+        reason="ed25519 identities need the optional cryptography "
+               "wheel")
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PrivateKey)
     from teku_tpu.networking import discv5 as D
@@ -208,6 +220,9 @@ def test_fuzz_discovery_records():
 def test_fuzz_noise_handshake_messages():
     """Noise handshake: mutated message-2/3 bytes must surface as
     NoiseError (AEAD/shape), never as an unauthenticated success."""
+    pytest.importorskip(
+        "cryptography",
+        reason="noise AEAD needs the optional cryptography wheel")
     from teku_tpu.networking import noise as N
     rng = random.Random(74)
     a_sk, _ = N.generate_static_keypair()

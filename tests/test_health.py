@@ -1,0 +1,493 @@
+"""Node health & SLO engine (PR 3): aggregation truth table, the
+event-loop-lag watchdog, SLO burn-rate math on synthetic data,
+trace-correlated JSON logs, flight-recorder dump-on-trip via the
+fault-injection harness, and the REST acceptance flow — breaker trip
+degrades /eth/v1/node/health to 206 with an slo_*/breaker event in the
+flight recorder carrying the originating trace id, and recovery
+restores 200."""
+
+import asyncio
+import json
+import logging
+import time
+
+import pytest
+
+from teku_tpu.infra import faults, flightrecorder, tracing
+from teku_tpu.infra.health import (CheckResult, EventLoopLagWatchdog,
+                                   HealthRegistry, HealthStatus,
+                                   SloEngine, SloObjective,
+                                   histogram_good_total,
+                                   labeled_counter_good_total,
+                                   signature_service_check,
+                                   supervisor_check)
+from teku_tpu.infra.logs import JsonFormatter, _make_formatter
+from teku_tpu.infra.metrics import MetricsRegistry
+from teku_tpu.infra.supervisor import (BackendState, BackendSupervisor,
+                                       CircuitBreaker)
+
+UP, DEGRADED, DOWN = (HealthStatus.UP, HealthStatus.DEGRADED,
+                      HealthStatus.DOWN)
+
+
+def _recorder(tmp_path) -> flightrecorder.FlightRecorder:
+    return flightrecorder.FlightRecorder(
+        capacity=64, dump_dir=str(tmp_path),
+        registry=MetricsRegistry())
+
+
+# --------------------------------------------------------------------------
+# Aggregation truth table + edge triggering
+# --------------------------------------------------------------------------
+
+def test_health_aggregation_truth_table(tmp_path):
+    reg = MetricsRegistry()
+    rec = _recorder(tmp_path)
+    hr = HealthRegistry(name="t", registry=reg, recorder=rec)
+    state = {"a": UP, "b": UP}
+    hr.register("a", lambda: CheckResult(state["a"], "detail-a"))
+    hr.register("b", lambda: state["b"])      # bare-status form
+    with pytest.raises(ValueError):
+        hr.register("a", lambda: CheckResult(UP))   # duplicate name
+
+    assert hr.evaluate() is UP
+    assert hr.snapshot()["status"] == "up"
+    # one sick check degrades the NODE verdict
+    state["a"] = DEGRADED
+    assert hr.evaluate() is DEGRADED
+    # DOWN dominates DEGRADED
+    state["b"] = DOWN
+    assert hr.evaluate() is DOWN
+    snap = hr.snapshot()
+    assert snap["status"] == "down"
+    assert snap["checks"]["a"]["status"] == "degraded"
+    assert snap["checks"]["a"]["detail"] == "detail-a"
+    # recovery flips it all the way back
+    state["a"] = state["b"] = UP
+    assert hr.evaluate() is UP
+    # a RAISING check reads as DOWN, never a crash
+    hr.register("boom", lambda: 1 / 0)
+    assert hr.evaluate() is DOWN
+    assert "ZeroDivisionError" in hr.snapshot()["checks"]["boom"]["detail"]
+
+
+def test_health_events_are_edge_triggered(tmp_path):
+    reg = MetricsRegistry()
+    rec = _recorder(tmp_path)
+    hr = HealthRegistry(name="t", registry=reg, recorder=rec)
+    state = {"s": UP}
+    hr.register("a", lambda: CheckResult(state["s"]))
+
+    hr.evaluate()
+    hr.evaluate()
+    # first evaluation establishing UP is not an event
+    assert [e for e in rec.snapshot()
+            if e["kind"] == "health_flip"] == []
+
+    state["s"] = DEGRADED
+    hr.evaluate()
+    hr.evaluate()          # steady state: no second event
+    hr.evaluate()
+    flips = [e for e in rec.snapshot() if e["kind"] == "health_flip"]
+    # exactly one flip for the check, one for the aggregate
+    assert sorted(e["subject"] for e in flips) == ["a", "node"]
+    assert all(e["to"] == "degraded" for e in flips)
+
+    state["s"] = UP
+    hr.evaluate()
+    hr.evaluate()
+    flips = [e for e in rec.snapshot() if e["kind"] == "health_flip"]
+    assert len(flips) == 4     # + one recovery edge each
+    assert [e["to"] for e in flips[-2:]] == ["up", "up"]
+    # the transitions counter matches the edges
+    assert hr._m_flips.labels(node="t", check="a").value == 2.0
+    assert hr._m_flips.labels(node="t", check="node").value == 2.0
+
+
+# --------------------------------------------------------------------------
+# Event-loop-lag watchdog
+# --------------------------------------------------------------------------
+
+def test_event_loop_lag_watchdog_detects_blocked_loop():
+    reg = MetricsRegistry()
+    wd = EventLoopLagWatchdog(interval_s=0.05, degraded_s=0.2,
+                              down_s=10.0, registry=reg)
+    assert wd.check().status is UP          # not running yet
+
+    async def run():
+        wd.start()
+        await asyncio.sleep(0.15)           # a few clean samples
+        assert wd.check().status is UP
+        time.sleep(0.4)                     # deliberately block the loop
+        await asyncio.sleep(0.1)            # let the overshoot land
+        res = wd.check()
+        assert res.status is DEGRADED, res
+        assert "lag" in res.detail
+        # the gauge exports the same worst-recent lag
+        assert wd.lag_s >= 0.2
+        await wd.stop()
+
+    asyncio.run(run())
+
+
+# --------------------------------------------------------------------------
+# SLO burn-rate math on synthetic data
+# --------------------------------------------------------------------------
+
+def test_slo_burn_rate_latency_objective(tmp_path):
+    reg = MetricsRegistry()
+    rec = _recorder(tmp_path)
+    hist = reg.labeled_histogram(
+        "t_stage_seconds", "t", labelnames=("stage",),
+        buckets=(0.01, 0.1, 1.0))
+    child = hist.labels(stage="complete")
+    obj = SloObjective(
+        name="verify_p50", description="p50 <= 100ms",
+        target_ratio=0.5,
+        sample=lambda: histogram_good_total(lambda: child, 0.1))
+    eng = SloEngine([obj], registry=reg, recorder=rec)
+
+    # window 1: 8 fast + 2 slow -> bad 0.2, budget 0.5, burn 0.4
+    for _ in range(8):
+        child.observe(0.005)
+    for _ in range(2):
+        child.observe(0.5)
+    snap = eng.tick()
+    assert snap["verify_p50"]["burn_rate"] == pytest.approx(0.4)
+    assert not snap["verify_p50"]["breached"]
+
+    # window 2: 2 fast + 8 slow -> bad 0.8, burn 1.6 -> BREACH (once)
+    for _ in range(2):
+        child.observe(0.005)
+    for _ in range(8):
+        child.observe(0.5)
+    snap = eng.tick()
+    assert snap["verify_p50"]["burn_rate"] == pytest.approx(1.6)
+    assert snap["verify_p50"]["breached"]
+    eng.tick()                 # no new samples: verdict held, no spam
+    breaches = [e for e in rec.snapshot() if e["kind"] == "slo_breach"]
+    assert len(breaches) == 1
+    assert breaches[0]["objective"] == "verify_p50"
+    assert eng.check().status is DEGRADED
+
+    # window 3: all fast -> burn 0 -> edge-triggered recovery
+    for _ in range(10):
+        child.observe(0.005)
+    snap = eng.tick()
+    assert snap["verify_p50"]["burn_rate"] == 0.0
+    assert not snap["verify_p50"]["breached"]
+    assert [e["kind"] for e in rec.snapshot()].count("slo_recovery") == 1
+    assert eng.check().status is UP
+
+
+def test_slo_ratio_objective_and_trace_blame(tmp_path):
+    reg = MetricsRegistry()
+    rec = _recorder(tmp_path)
+    fam = reg.labeled_counter("t_requests_total", "t",
+                              labelnames=("backend", "reason"))
+    obj = SloObjective(
+        name="success_ratio", description=">= 90% ok",
+        target_ratio=0.9,
+        sample=lambda: labeled_counter_good_total(
+            fam, lambda l: l.get("reason") == "ok"))
+    eng = SloEngine([obj], registry=reg, recorder=rec)
+
+    fam.labels(backend="device", reason="ok").inc(100)
+    snap = eng.tick()
+    assert snap["success_ratio"]["burn_rate"] == 0.0
+
+    # a traced failure lands in the recorder FIRST (the breaker-trip
+    # path); the subsequent untraced SLO tick must blame that trace
+    rec.record("breaker_trip", trace_id="cafe-000001",
+               breaker="t_device")
+    fam.labels(backend="oracle", reason="fallback").inc(50)
+    snap = eng.tick()
+    # window: 0 ok of 50 -> bad 1.0, budget 0.1 -> burn 10
+    assert snap["success_ratio"]["burn_rate"] == pytest.approx(10.0)
+    breach = [e for e in rec.snapshot()
+              if e["kind"] == "slo_breach"][-1]
+    assert breach["trace_id"] == "cafe-000001"
+
+
+def test_slo_zero_target_never_breaches(tmp_path):
+    """target_ratio=0 (the device-serving default on CPU-only nodes):
+    fully-bad traffic reads burn == 1.0, not a breach."""
+    reg = MetricsRegistry()
+    rec = _recorder(tmp_path)
+    fam = reg.labeled_counter("t2_requests_total", "t",
+                              labelnames=("backend", "reason"))
+    obj = SloObjective(
+        name="device_ratio", description="opt-in", target_ratio=0.0,
+        sample=lambda: labeled_counter_good_total(
+            fam, lambda l: l.get("backend") == "device"))
+    eng = SloEngine([obj], registry=reg, recorder=rec)
+    fam.labels(backend="oracle", reason="ok").inc(100)
+    snap = eng.tick()
+    assert snap["device_ratio"]["burn_rate"] == pytest.approx(1.0)
+    assert not snap["device_ratio"]["breached"]
+
+
+# --------------------------------------------------------------------------
+# JSON log records carry the current trace id
+# --------------------------------------------------------------------------
+
+def test_json_log_records_carry_trace_id():
+    fmt = JsonFormatter()
+    logger = logging.getLogger("teku_tpu.test_health")
+
+    def make(msg):
+        return logger.makeRecord(logger.name, logging.WARNING, "f", 1,
+                                 msg, (), None)
+
+    with tracing.trace("json_log_verify") as tr:
+        line = fmt.format(make("slow batch"))
+    out = json.loads(line)
+    assert out["msg"] == "slow batch"
+    assert out["level"] == "WARNING"
+    assert out["trace_id"] == tr.trace_id
+
+    # outside any trace: no trace_id key, still valid JSON
+    out = json.loads(fmt.format(make("untraced")))
+    assert "trace_id" not in out
+    # the formatter factory maps names correctly
+    assert isinstance(_make_formatter("json"), JsonFormatter)
+    assert not isinstance(_make_formatter("text"), JsonFormatter)
+
+
+# --------------------------------------------------------------------------
+# Flight recorder: ring semantics + dump-on-trip via the faults harness
+# --------------------------------------------------------------------------
+
+def test_flight_recorder_ring_and_dump(tmp_path):
+    rec = _recorder(tmp_path)
+    for i in range(80):              # capacity 64: oldest evicted
+        rec.record("test_event", i=i)
+    events = rec.snapshot()
+    assert len(events) == 64
+    assert events[0]["i"] == 16 and events[-1]["i"] == 79
+    assert [e["i"] for e in rec.tail(3)] == [77, 78, 79]
+    path = rec.dump("unit test")
+    lines = [json.loads(line)
+             for line in open(path).read().splitlines()]
+    assert lines[0]["kind"] == "dump_header"
+    assert lines[0]["reason"] == "unit test"
+    assert len(lines) == 65
+    rec.clear()
+    assert rec.snapshot() == []
+    assert rec.dump("empty") is None
+
+
+@pytest.mark.faults
+def test_breaker_trip_dumps_flight_recorder(tmp_path, monkeypatch):
+    """A fault-injected dispatch failure trips the breaker; the GLOBAL
+    recorder lands a breaker_trip event carrying the originating
+    verify's trace id and auto-dumps the ring to JSONL."""
+    rec = flightrecorder.RECORDER
+    monkeypatch.setattr(rec, "dump_dir", str(tmp_path))
+    monkeypatch.setattr(rec, "_last_dump_t", -1e9)   # defeat throttle
+    reg = MetricsRegistry()
+    br = CircuitBreaker(failure_threshold=1, deadline_s=2.0,
+                        name="t_dump_device", registry=reg)
+    faults.inject("test.dump_site",
+                  faults.Raise(RuntimeError("injected dispatch fault")))
+    try:
+        tr = tracing.new_trace("tripping_verify")
+        with tracing.attach((tr,)):
+            with pytest.raises(RuntimeError):
+                br.call(lambda: faults.check("test.dump_site"))
+        tracing.finish(tr)
+    finally:
+        faults.clear("test.dump_site")
+    assert br.state == CircuitBreaker.OPEN
+    trip = [e for e in rec.snapshot()
+            if e["kind"] == "breaker_trip"][-1]
+    assert trip["breaker"] == "t_dump_device"
+    assert trip["trace_id"] == tr.trace_id
+    # the auto-dump wrote a JSONL file containing that same event
+    files = sorted(tmp_path.glob("flight_*.jsonl"))
+    assert files, "breaker trip did not dump the flight recorder"
+    dumped = [json.loads(line)
+              for line in files[-1].read_text().splitlines()]
+    assert any(e.get("kind") == "breaker_trip"
+               and e.get("trace_id") == tr.trace_id for e in dumped)
+    # throttled: an immediate second trip does not write a second file
+    br.record_failure()
+    assert sorted(tmp_path.glob("flight_*.jsonl")) == files
+
+
+# --------------------------------------------------------------------------
+# Subsystem check factories
+# --------------------------------------------------------------------------
+
+def test_signature_service_check_saturation_and_stall():
+    class FakeService:
+        def __init__(self):
+            self.snap = {"queue_size": 0, "capacity": 100,
+                         "saturation": 0.0, "workers": 2,
+                         "stalled_s": 0.0}
+
+        def health_snapshot(self):
+            return dict(self.snap)
+
+    svc = FakeService()
+    check = signature_service_check(svc, saturation_degraded=0.8,
+                                    stall_down_s=30.0)
+    assert check().status is UP
+    svc.snap.update(queue_size=85, saturation=0.85)
+    assert check().status is DEGRADED
+    svc.snap.update(stalled_s=45.0)
+    res = check()
+    assert res.status is DOWN and "stalled" in res.detail
+
+
+def test_real_signature_service_health_snapshot():
+    from teku_tpu.services.signatures import (
+        AggregatingSignatureVerificationService)
+    svc = AggregatingSignatureVerificationService(
+        queue_capacity=10, registry=MetricsRegistry(),
+        name="t_health_sigs")
+    snap = svc.health_snapshot()
+    assert snap == {"queue_size": 0, "capacity": 10, "saturation": 0.0,
+                    "workers": 0, "stalled_s": 0.0}
+
+
+def test_supervisor_check_states(tmp_path):
+    assert supervisor_check(lambda: None)().status is UP
+
+    class FakeSup:
+        backend_state = "ready"
+        backend_detail = ""
+        breaker = None
+
+    sup = FakeSup()
+    check = supervisor_check(lambda: sup)
+    assert check().status is UP
+    sup.backend_state = "tripped"
+    assert check().status is DEGRADED
+    sup.backend_state = "degraded"
+    sup.backend_detail = "bring-up abandoned: probe timeout"
+    res = check()
+    assert res.status is DEGRADED and "probe timeout" in res.detail
+    sup.backend_state = "probing"
+    assert check().status is UP         # bring-up is boot, not sickness
+
+
+# --------------------------------------------------------------------------
+# REST acceptance: 200 -> (trip) 206 -> (recover) 200, 503 on DOWN,
+# syncing_status override, readiness + flight-recorder endpoints
+# --------------------------------------------------------------------------
+
+@pytest.mark.faults
+def test_node_health_endpoint_acceptance(tmp_path, monkeypatch):
+    import dataclasses
+    from teku_tpu.api import BeaconRestApi
+    from teku_tpu.infra.restapi import HttpError
+    from teku_tpu.node.gossip import InMemoryGossipNetwork
+    from teku_tpu.node.node import BeaconNode
+    from teku_tpu.spec import config as C, Spec
+    from teku_tpu.spec.genesis import interop_genesis
+
+    monkeypatch.setattr(flightrecorder.RECORDER, "dump_dir",
+                        str(tmp_path))
+    spec = Spec(C.MINIMAL)
+    state, _ = interop_genesis(C.MINIMAL, 16, 0)
+
+    async def run():
+        node = BeaconNode(spec, state,
+                          InMemoryGossipNetwork().endpoint(),
+                          name="t_health_node")
+        api = BeaconRestApi(node)
+        # healthy node: 200
+        assert (await api._health())[2] == 200
+
+        # wire a READY supervisor whose breaker we then trip with an
+        # injected dispatch fault, under a root trace
+        reg = MetricsRegistry()
+        br = CircuitBreaker(failure_threshold=1, deadline_s=2.0,
+                            cooldown_s=60.0, name="t_acc_device",
+                            registry=reg)
+        sup = BackendSupervisor(probe=lambda: None,
+                                install=lambda b: None, breaker=br,
+                                name="t_acc_backend", registry=reg)
+        sup._record(BackendState.READY)
+        node.supervisor = sup
+
+        faults.inject("test.acceptance_site",
+                      faults.Raise(RuntimeError("injected")))
+        try:
+            tr = tracing.new_trace("acceptance_verify")
+            with tracing.attach((tr,)):
+                with pytest.raises(RuntimeError):
+                    br.call(lambda: faults.check("test.acceptance_site"))
+            tracing.finish(tr)
+        finally:
+            faults.clear("test.acceptance_site")
+        assert sup.backend_state == "tripped"
+
+        # live HealthRegistry drives the endpoint: DEGRADED -> 206
+        assert (await api._health())[2] == 206
+        # syncing_status substitutes ONLY the syncing response: a
+        # DEGRADED-but-synced node keeps its 206 (a ?syncing_status=200
+        # LB probe must not mask real degradation) ...
+        assert (await api._health(
+            query={"syncing_status": "299"}))[2] == 206
+        # ... while an actually-syncing node honors the override
+        import types
+        api_sync = BeaconRestApi(node, networked=types.SimpleNamespace(
+            sync=types.SimpleNamespace(syncing=True)))
+        assert (await api_sync._health())[2] == 206
+        assert (await api_sync._health(
+            query={"syncing_status": "299"}))[2] == 299
+        with pytest.raises(HttpError) as err:
+            await api._health(query={"syncing_status": "999"})
+        assert err.value.status == 400
+        with pytest.raises(HttpError) as err:
+            await api._health(query={"syncing_status": "abc"})
+        assert err.value.status == 400
+
+        # the breaker trip recorded the originating trace id; feed an
+        # SLO objective a bad window so the breach event lands too
+        bad = {"good": 100.0, "total": 100.0}
+        node.slo = SloEngine(
+            [SloObjective(name="verify_success_ratio",
+                          description=">= 99% ok", target_ratio=0.99,
+                          sample=lambda: (bad["good"], bad["total"]))],
+            registry=reg, recorder=node.flight_recorder)
+        node.slo.tick()                     # clean baseline window
+        bad["total"] = 150.0                # 50 new, all bad
+        node.slo.tick()
+        events = node.flight_recorder.snapshot()
+        trip = [e for e in events if e["kind"] == "breaker_trip"][-1]
+        breach = [e for e in events if e["kind"] == "slo_breach"][-1]
+        assert trip["trace_id"] == tr.trace_id
+        assert breach["objective"] == "verify_success_ratio"
+        assert breach["trace_id"] == tr.trace_id   # originating trace
+
+        # readiness names the hurting subsystems
+        ready = await api._admin_readiness()
+        assert ready["status"] == "degraded"
+        assert ready["checks"]["backend"]["status"] == "degraded"
+        assert ready["slo"]["verify_success_ratio"]["breached"]
+        assert ready["backend"]["state"] == "tripped"
+
+        # flight-recorder endpoint serves the ring (and tails)
+        fr = await api._admin_flight_recorder(query={"last": "5"})
+        assert 0 < len(fr["data"]) <= 5
+
+        # recovery: breaker re-closes -> supervisor READY -> slo
+        # window recovers -> 200 again
+        br.record_success()
+        assert sup.backend_state == "ready"
+        bad["good"] = bad["total"] = 1150.0   # 1000 new, all good
+        node.slo.tick()
+        assert (await api._health())[2] == 200
+
+        # a DOWN check on the live registry is a 503
+        forced = {"s": DOWN}
+        node.health.register("forced",
+                             lambda: CheckResult(forced["s"], "test"))
+        assert (await api._health())[2] == 503
+        forced["s"] = UP
+        assert (await api._health())[2] == 200
+
+    asyncio.run(run())

@@ -14,9 +14,9 @@ import re
 import pytest
 
 from teku_tpu.infra.metrics import (Counter, Gauge, Histogram,
-                                    LabeledCounter, LabeledHistogram,
-                                    LATENCY_BUCKETS_S, MetricsRegistry,
-                                    StateGauge)
+                                    LabeledCounter, LabeledGauge,
+                                    LabeledHistogram, LATENCY_BUCKETS_S,
+                                    MetricsRegistry, StateGauge)
 
 _SAMPLE_RE = re.compile(
     r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
@@ -255,3 +255,52 @@ def test_global_exposition_is_well_formed_after_node_imports():
     fams = parse_exposition(GLOBAL_REGISTRY.expose())
     assert "verify_stage_duration_seconds" in fams
     assert "bls_dispatch_padding_waste_ratio" in fams
+
+
+def test_slo_health_family_naming_lint():
+    """The PR-3 families must not drift from the conventions: states as
+    labeled/state gauges (never bare numbers encoding an enum), burn
+    rates unitless gauges, durations ``_seconds``, counters
+    ``_total``."""
+    # importing + instantiating registers the families in the global
+    # registry (idempotent: get_or_create)
+    from teku_tpu.infra import flightrecorder  # noqa: F401
+    from teku_tpu.infra.health import (EventLoopLagWatchdog,
+                                       HealthRegistry, SloEngine)
+    from teku_tpu.infra.metrics import GLOBAL_REGISTRY
+    HealthRegistry(name="lint")
+    SloEngine()
+
+    metrics = {n: m for n, m in GLOBAL_REGISTRY.metrics().items()
+               if n.startswith(("slo_", "health_"))}
+    assert {"slo_burn_rate", "slo_breached", "slo_breaches_total",
+            "health_node_state", "health_check_state",
+            "health_transitions_total"} <= set(metrics)
+    problems = []
+    for name, m in metrics.items():
+        if isinstance(m, (Counter, LabeledCounter)) \
+                and not name.endswith("_total"):
+            problems.append(f"counter {name} must end _total")
+        if name.endswith("_total") \
+                and not isinstance(m, (Counter, LabeledCounter)):
+            problems.append(f"{name} ends _total but is not a counter")
+        if _DURATION_HINT.search(name) and not name.endswith("_seconds"):
+            problems.append(f"duration metric {name} must end _seconds")
+        # states are gauges with a `state` dimension, not enum numbers
+        if name.endswith("_state"):
+            if isinstance(m, StateGauge):
+                pass
+            elif isinstance(m, LabeledGauge) \
+                    and "state" in m.labelnames:
+                pass
+            else:
+                problems.append(
+                    f"{name} must be a StateGauge or a LabeledGauge "
+                    "with a 'state' label")
+        # burn rates are unitless ratios: no unit suffix allowed
+        if "burn_rate" in name:
+            if not isinstance(m, (Gauge, LabeledGauge)):
+                problems.append(f"{name} must be a gauge")
+            if name.endswith(("_seconds", "_bytes", "_total")):
+                problems.append(f"burn rate {name} must be unitless")
+    assert not problems, "\n".join(problems)
