@@ -292,10 +292,13 @@ def _init_device(deadline):
         except Exception:
             pass
     # persistent compile cache: repeat bench invocations skip the
-    # 20-40s-per-bucket XLA compiles (one definition, shared with the
-    # driver entry hooks)
-    from __graft_entry__ import _wire_compile_cache
-    _wire_compile_cache()
+    # multi-minute per-bucket XLA compiles (one definition in
+    # infra/compilecache, shared with the CLI and driver entry hooks);
+    # hit/miss counters feed the compile vs cache_load accounting below
+    from teku_tpu.infra import compilecache
+    cache_dir = compilecache.configure()
+    compilecache.ensure_instrumented()
+    OUT["compile_cache"] = {"dir": cache_dir}
     devs = jax.devices()
     WD.disarm()
     OUT["device"] = str(devs[0])
@@ -314,13 +317,15 @@ def _throughput_phase(jax, deadline, batches, detail):
     shared accumulator across calls (main() runs this phase twice:
     primary shape first, the rest only after p50/epoch landed)."""
     import __graft_entry__ as ge
+    from teku_tpu.infra import compilecache
     from teku_tpu.ops import verify as V
 
     kernel = V.verify_staged     # five bounded compiles, not one monolith
     best = float(OUT.get("value") or 0.0)
     best_batch = OUT.get("best_batch")
-    compiled_once = any(isinstance(v, dict) and "compile_s" in v
-                        for v in detail.values())
+    compiled_once = any(
+        isinstance(v, dict) and ("compile_s" in v or "cache_load_s" in v)
+        for v in detail.values())
     for n in batches:
         remaining = deadline - time.time()
         # a cold compile needs a wide margin; after one shape compiled
@@ -342,13 +347,23 @@ def _throughput_phase(jax, deadline, batches, detail):
             # staged programs must land within the phase's own margin
             _beat("compile_start", batch=n)
             WD.arm(max(remaining, need) + 120, f"compile batch {n}")
+            cache_before = compilecache.stats()
             t0 = time.time()
             ok, lane_ok = kernel(*args, on_stage=_on_stage)
             ok = bool(np.asarray(ok))
             WD.disarm()
             compile_s = time.time() - t0
             compiled_once = True
-            entry = {"compile_s": round(compile_s, 1),
+            # compile_s vs cache_load_s: a post-cache (warm-boot) run
+            # must not report disk loads as "compile" time — the two
+            # differ by orders of magnitude and drivers compare them
+            moved = compilecache.delta(cache_before)
+            kind = ("cache_load_s"
+                    if compilecache.classify_first_dispatch(moved)
+                    == "cache_load" else "compile_s")
+            entry = {kind: round(compile_s, 1),
+                     "cache_hits": moved["hits"],
+                     "cache_misses": moved["misses"],
                      "stage_s": stage_s}
             detail[str(n)] = entry
             if not (ok and np.asarray(lane_ok).all()):
@@ -429,13 +444,23 @@ def _latency_phase(jax, deadline):
         pks = [impl.secret_key_to_public_key(sk) for sk in sks]
         msgs = [b"att-%d" % i for i in range(16)]
         sigs = [impl.sign(sk, m) for sk, m in zip(sks, msgs)]
-        # one warm dispatch (256-lane bucket + pk validation compile)
+        # one warm dispatch (256-lane bucket + pk validation compile);
+        # same compile vs cache_load split as the throughput phase so
+        # a post-cache run doesn't report a misleading "warm_compile_s"
+        from teku_tpu.infra import compilecache
         triples = [([pks[i % 16]], msgs[i % 16], sigs[i % 16])
                    for i in range(256)]
+        cache_before = compilecache.stats()
         t0 = time.time()
         if not impl.batch_verify(triples):
             raise RuntimeError("warmup batch failed")
-        OUT["warm_compile_s"] = round(time.time() - t0, 1)
+        warm_s = round(time.time() - t0, 1)
+        moved = compilecache.delta(cache_before)
+        if (moved["hits"] or moved["misses"]) and \
+                compilecache.classify_first_dispatch(moved) == "cache_load":
+            OUT["warm_cache_load_s"] = warm_s
+        else:
+            OUT["warm_compile_s"] = warm_s
 
         lat: list = []
 
@@ -490,6 +515,78 @@ def _latency_phase(jax, deadline):
     finally:
         tracing.set_sampler(None)
         bls.reset_implementation()
+
+
+def _mont_phase(jax, deadline):
+    """Kernel-level A/B microbench: mont_muls/sec on the vpu
+    (elementwise int64 pad-and-sum) vs mxu (int8 digit-split matmul)
+    path at the service's primary batch shapes — so BENCH_*.json shows
+    the multiplier-level speedup INDEPENDENT of end-to-end pipeline
+    noise (the whole verify pipeline is ~11k mont_muls/signature, so
+    this ratio bounds the pipeline win the MXU path can deliver)."""
+    import secrets as _secrets
+
+    import jax.numpy as jnp
+    from jax import lax
+
+    from teku_tpu.ops import limbs as fp
+    from teku_tpu.ops import mxu
+
+    batches = [int(b) for b in os.environ.get(
+        "BENCH_MONT_BATCHES", "256,4096").split(",")]
+    chain = int(os.environ.get("BENCH_MONT_CHAIN", "16"))
+    _beat("mont_phase_start", batches=batches, chain=chain)
+    out: dict = {"chain": chain, "unit": "mont_muls/sec"}
+
+    def make_chain(mul):
+        # a scan-chained multiply measures steady-state kernel cost,
+        # not per-dispatch overhead: chain * batch mont_muls per call
+        def run(a, b):
+            def step(c, _):
+                return mul(c, b), None
+            c, _ = lax.scan(step, a, None, length=chain)
+            return c
+        return jax.jit(run)
+
+    kernels = {"vpu": make_chain(fp.mont_mul_vpu),
+               "mxu": make_chain(fp.mont_mul_mxu)}
+    for n in batches:
+        if time.time() > deadline - 60:
+            out[str(n)] = "skipped: budget"
+            continue
+        a = np.stack([fp.int_to_mont(int.from_bytes(
+            _secrets.token_bytes(47), "big")) for _ in range(n)])
+        b = np.roll(a, 1, axis=0)
+        entry: dict = {}
+        for path, fn in kernels.items():
+            try:
+                WD.arm(max(deadline - time.time(), 60) + 120,
+                       f"mont_mul {path} batch {n}")
+                jax.block_until_ready(fn(a, b))      # warm/compile
+                iters = max(3, min(50, int(2e6 / (n * chain))))
+                t0 = time.time()
+                for _ in range(iters):
+                    r = fn(a, b)
+                jax.block_until_ready(r)
+                WD.disarm()
+                dt = (time.time() - t0) / iters
+                entry[path] = {
+                    "mont_muls_per_sec": round(n * chain / dt, 1),
+                    "dispatch_ms": round(dt * 1e3, 3)}
+            except Exception as exc:
+                entry[path] = {"error": f"{type(exc).__name__}: {exc}"}
+        if all("mont_muls_per_sec" in entry.get(p, {})
+               for p in ("vpu", "mxu")):
+            entry["mxu_speedup"] = round(
+                entry["mxu"]["mont_muls_per_sec"]
+                / entry["vpu"]["mont_muls_per_sec"], 3)
+        out[str(n)] = entry
+        _beat("mont_batch_done", batch=n,
+              **{p: entry[p].get("mont_muls_per_sec")
+                 for p in ("vpu", "mxu") if p in entry})
+    out["active_path"] = mxu.resolve()
+    OUT["mont_mul"] = out
+    _beat("mont_phase_done")
 
 
 def _epoch_transition_phase(deadline):
@@ -644,6 +741,14 @@ def main():
             WD.disarm()
         except Exception as exc:
             OUT["p50_error"] = f"{type(exc).__name__}: {exc}"
+    if os.environ.get("BENCH_MONT", "1") != "0" \
+            and time.time() < deadline:
+        try:
+            WD.arm(max(deadline - time.time(), 60) + 300, "mont phase")
+            _mont_phase(jax, deadline)
+            WD.disarm()
+        except Exception as exc:
+            OUT["mont_error"] = f"{type(exc).__name__}: {exc}"
     if os.environ.get("BENCH_EPOCH", "1") != "0":
         try:
             WD.arm(max(deadline - time.time(), 60) + 300, "epoch phase")
@@ -663,6 +768,16 @@ def main():
             WD.disarm()
         except Exception as exc:
             OUT["kzg_error"] = f"{type(exc).__name__}: {exc}"
+    try:
+        # hit/miss evidence for the whole run: a warm (second) run
+        # shows hits>0 and per-shape cache_load_s instead of compile_s
+        from teku_tpu.infra import compilecache
+        stats = compilecache.stats()
+        OUT.setdefault("compile_cache", {}).update(stats)
+        from teku_tpu.ops import mxu
+        OUT["mont_path"] = mxu.resolve()
+    except Exception:
+        pass
     OUT["total_s"] = round(time.time() - t_start, 1)
     _beat("bench_done", total_s=OUT["total_s"])
     _emit()

@@ -88,7 +88,38 @@ def _configure_tracing(args, yaml_cfg) -> str:
     return choice
 
 
-def _configure_bls(args, yaml_cfg, *, supervise: bool = True):
+# mirror of ops/mxu.py PATHS, spelled locally so the boot path never
+# imports the ops package (whose __init__ imports jax) on the main
+# thread — the env var is how the choice reaches the kernel layer
+_MONT_PATHS = ("vpu", "mxu", "auto", "mxu-force")
+
+
+def _configure_kernel(args, yaml_cfg) -> str:
+    """Kernel-layer knobs that must be decided BEFORE jax loads:
+
+    - the mont_mul engine (`--mont-path` / TEKU_TPU_MONT_MUL: vpu |
+      mxu | auto; auto = the int8 digit-split MXU path exactly when
+      the dispatch device is a TPU) — resolved by ops/mxu.py at trace
+      time in the probe/dispatch threads;
+    - the persistent XLA compile cache (TEKU_TPU_XLA_CACHE_DIR, ON by
+      default; =off disables) so warm boots load the multi-minute
+      per-shape kernel compiles from disk instead of repaying them.
+    """
+    from .infra import compilecache
+
+    choice = str(layered_value(
+        "mont-mul", getattr(args, "mont_path", None), yaml_cfg,
+        "auto")).lower()
+    if choice not in _MONT_PATHS:
+        raise SystemExit(f"invalid --mont-path {choice!r} (use one of "
+                         f"{'/'.join(_MONT_PATHS)})")
+    os.environ["TEKU_TPU_MONT_MUL"] = choice
+    compilecache.configure()
+    return choice
+
+
+def _configure_bls(args, yaml_cfg, *, supervise: bool = True,
+                   mont_path=None):
     """Choose the BLS bring-up shape BEFORE any service starts.
 
     ``auto`` (the default) and ``supervised`` boot the node immediately
@@ -103,13 +134,13 @@ def _configure_bls(args, yaml_cfg, *, supervise: bool = True):
                            yaml_cfg, "auto")
     if choice in ("auto", "supervised") and supervise:
         loader.configure("supervised")      # oracle serves from slot 0
-        supervisor = loader.make_supervisor()
+        supervisor = loader.make_supervisor(mont_path=mont_path)
         print("BLS implementation: pure (supervised device bring-up "
               "in background)")
         return "supervised", supervisor
     try:
         name = loader.configure("pure" if choice == "supervised"
-                                else choice)
+                                else choice, mont_path=mont_path)
     except loader.BlsLoadError as exc:
         raise SystemExit(f"BLS preflight failed: {exc}")
     print(f"BLS implementation: {name}")
@@ -134,7 +165,9 @@ def cmd_node(args) -> int:
     # + flight-recorder JSONL dump on fatal crash (infra/flightrecorder)
     from .infra import flightrecorder
     flightrecorder.install_crash_hooks()
-    _, bls_supervisor = _configure_bls(args, yaml_cfg)
+    mont_path = _configure_kernel(args, yaml_cfg)
+    _, bls_supervisor = _configure_bls(args, yaml_cfg,
+                                       mont_path=mont_path)
     network = layered_value("network", args.network, yaml_cfg, "minimal")
     port = int(layered_value("p2p-port", args.p2p_port, yaml_cfg, 0, int))
     rest_port = int(layered_value("rest-port", args.rest_port, yaml_cfg,
@@ -342,7 +375,8 @@ def cmd_devnet(args) -> int:
 
     _configure_log_format(args, {})
     _configure_tracing(args, {})
-    _, bls_supervisor = _configure_bls(args, {})
+    mont_path = _configure_kernel(args, {})
+    _, bls_supervisor = _configure_bls(args, {}, mont_path=mont_path)
 
     async def run():
         net = Devnet(n_nodes=args.nodes, n_validators=args.validators)
@@ -637,7 +671,8 @@ def cmd_validator_client(args) -> int:
     # the VC's hot path is signing (host-side); no background bring-up
     _configure_log_format(args, {})
     _configure_tracing(args, {})
-    _configure_bls(args, {}, supervise=False)
+    mont_path = _configure_kernel(args, {})
+    _configure_bls(args, {}, supervise=False, mont_path=mont_path)
     spec = create_spec(args.network or "minimal")
     remote = RemoteValidatorApi(spec, args.beacon_node)
     genesis = remote._get_json("/eth/v1/beacon/genesis")["data"]
@@ -738,6 +773,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "kernel when background bring-up reaches READY; "
                         "jax blocks on a hard preflight and makes "
                         "accelerator failure fatal; pure opts out")
+    n.add_argument("--mont-path", default=None,
+                   choices=["vpu", "mxu", "auto"],
+                   help="mont_mul engine for the verify kernels: vpu "
+                        "(elementwise int64), mxu (int8 digit-split "
+                        "matmul on the TPU matrix unit), auto "
+                        "(default: mxu exactly when the dispatch "
+                        "device is a TPU).  mxu on a non-TPU device "
+                        "falls back to vpu with one warning.  Env: "
+                        "TEKU_TPU_MONT_MUL")
     n.add_argument("--tracing", default=None, choices=["on", "off"],
                    help="hot-path verify tracing: per-stage latency "
                         "histograms on /metrics and the slow-trace "
@@ -757,6 +801,8 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--epochs", type=int, default=4)
     d.add_argument("--bls-impl", default=None,
                    choices=["auto", "supervised", "jax", "pure"])
+    d.add_argument("--mont-path", default=None,
+                   choices=["vpu", "mxu", "auto"])
     d.add_argument("--tracing", default=None, choices=["on", "off"])
     d.add_argument("--log-format", default=None,
                    choices=["text", "json"])
@@ -808,6 +854,8 @@ def build_parser() -> argparse.ArgumentParser:
     vc.add_argument("--data-dir", default=None)
     vc.add_argument("--bls-impl", default=None,
                     choices=["auto", "supervised", "jax", "pure"])
+    vc.add_argument("--mont-path", default=None,
+                    choices=["vpu", "mxu", "auto"])
     vc.add_argument("--tracing", default=None, choices=["on", "off"])
     vc.add_argument("--log-format", default=None,
                     choices=["text", "json"])

@@ -56,12 +56,19 @@ class BlsLoadError(RuntimeError):
     """The requested BLS implementation could not be brought up."""
 
 
-def _probe_jax(max_batch: int, min_bucket: int):
+def _probe_jax(max_batch: int, min_bucket: int, mont_path=None):
     """Instantiate the device provider and prove the backend executes:
     one pubkey-validation dispatch (the small program; the five staged
-    verify programs compile lazily on first real batch)."""
+    verify programs compile lazily on first real batch).
+
+    `mont_path` installs the process-global mont_mul engine choice
+    (vpu | mxu | auto, ops/mxu.py) BEFORE any kernel traces — this is
+    the seam the CLI's `--mont-path` threads through."""
+    from ...ops import mxu
     from ...ops.provider import JaxBls12381
 
+    if mont_path is not None:
+        mxu.set_path(mont_path)
     impl = JaxBls12381(max_batch=max_batch, min_bucket=min_bucket)
     if not impl.public_key_is_valid(_PROBE_PK):
         raise BlsLoadError("device probe rejected the generator pubkey")
@@ -231,7 +238,7 @@ def make_supervisor(*, max_batch: int = 256, min_bucket: int = 16,
                     breaker_name: str = "bls_device",
                     registry: MetricsRegistry = GLOBAL_REGISTRY,
                     breaker: Optional[CircuitBreaker] = None,
-                    warm: bool = True,
+                    warm: bool = True, mont_path: Optional[str] = None,
                     **supervisor_kw) -> BackendSupervisor:
     """Build the production BackendSupervisor: boot-on-oracle now,
     background JAX bring-up, breaker-guarded hot-swap at READY for both
@@ -263,7 +270,7 @@ def make_supervisor(*, max_batch: int = 256, min_bucket: int = 16,
     installed: dict = {}
 
     def probe():
-        return _probe_jax(max_batch, min_bucket)
+        return _probe_jax(max_batch, min_bucket, mont_path=mont_path)
 
     def warmup(backend):
         if not warm:
@@ -409,7 +416,8 @@ class GuardedKzgBackend:
 
 def configure(choice: str = "auto", *, max_batch: int = 256,
               min_bucket: int = 16,
-              probe_timeout_s: Optional[float] = None) -> str:
+              probe_timeout_s: Optional[float] = None,
+              mont_path: Optional[str] = None) -> str:
     """Install the BLS provider for this process; returns its name.
 
     auto: try the JAX/TPU provider under a deadline, fall back to the
@@ -436,7 +444,8 @@ def configure(choice: str = "auto", *, max_batch: int = 256,
 
     def run():
         try:
-            result["ok"] = _probe_jax(max_batch, min_bucket)
+            result["ok"] = _probe_jax(max_batch, min_bucket,
+                                      mont_path=mont_path)
         except BaseException as exc:  # noqa: BLE001 - report any failure
             result["err"] = exc
 

@@ -1,0 +1,188 @@
+"""Persistent XLA compile cache: wire-up + hit/miss observability.
+
+Cold XLA compiles of the staged verify kernel cost 314-357 s PER
+BUCKET SHAPE on CPU (tens of minutes projected on TPU) and were paid
+again on every boot.  The compiles are deterministic in (program,
+shape, flags), so JAX's persistent compilation cache
+(``jax_compilation_cache_dir``) turns every boot after the first into
+cache LOADS — warm boots skip the compile entirely.
+
+``configure()`` is called by ``cli node`` / ``cli devnet`` and bench.py
+(ON BY DEFAULT; opt out with TEKU_TPU_XLA_CACHE_DIR=off).  It is safe
+in both import orders: before jax is imported it sets the JAX_* env
+vars the config reads at definition time; after, it updates jax.config
+directly.  Nothing here initializes a backend — boot stays O(1).
+
+Observability: a jax.monitoring listener counts the runtime's
+``/jax/compilation_cache/cache_hits|cache_misses`` events into
+``xla_compile_cache_total{outcome="hit"|"miss"}`` and a process-local
+snapshot API — ``ops/provider.py`` diffs snapshots around the first
+dispatch of a bucket shape to split its jit outcome into ``compile``
+(fresh XLA work) vs ``cache_load`` (served from disk), and the backend
+supervisor's WARMING stage reports how much of the warmup was cache
+hits vs fresh compiles.
+"""
+
+import logging
+import os
+import sys
+import threading
+
+from .metrics import GLOBAL_REGISTRY
+
+_LOG = logging.getLogger(__name__)
+
+ENV_DIR = "TEKU_TPU_XLA_CACHE_DIR"
+ENV_MIN_COMPILE_S = "TEKU_TPU_XLA_CACHE_MIN_COMPILE_S"
+_OFF_VALUES = ("off", "0", "none", "disabled")
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+
+_lock = threading.Lock()
+_counts = {"hit": 0, "miss": 0}
+_installed = {"listener": False, "dir": None}
+
+_M_CACHE = GLOBAL_REGISTRY.labeled_counter(
+    "xla_compile_cache_total",
+    "persistent XLA compile cache lookups by outcome",
+    labelnames=("outcome",))
+
+
+def default_dir() -> str:
+    """Repo-adjacent default (shared with the driver entry hooks)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    repo = os.path.dirname(os.path.dirname(here))
+    return os.path.join(repo, ".jax_cache")
+
+
+def _on_event(event: str, **_kw) -> None:
+    if event == _HIT_EVENT:
+        key = "hit"
+    elif event == _MISS_EVENT:
+        key = "miss"
+    else:
+        return
+    with _lock:
+        _counts[key] += 1
+    _M_CACHE.labels(outcome=key).inc()
+
+
+def ensure_instrumented() -> bool:
+    """Register the monitoring listener (idempotent).  Imports jax, so
+    callers on the boot path defer this until jax is loaded anyway."""
+    with _lock:
+        if _installed["listener"]:
+            return True
+    try:
+        from jax import monitoring
+    except Exception:  # pragma: no cover - jax-less host tooling
+        return False
+    with _lock:
+        if not _installed["listener"]:
+            monitoring.register_event_listener(_on_event)
+            _installed["listener"] = True
+    return True
+
+
+def configure(cache_dir=None, min_compile_s=None, enabled=True):
+    """Wire the persistent cache; returns the cache dir or None (off).
+
+    Precedence: explicit args > env (TEKU_TPU_XLA_CACHE_DIR /
+    TEKU_TPU_XLA_CACHE_MIN_COMPILE_S) > defaults (on, repo-adjacent
+    dir, 2 s minimum compile time so trivial programs don't churn the
+    disk).  TEKU_TPU_XLA_CACHE_DIR=off disables.
+    """
+    env_dir = os.environ.get(ENV_DIR)
+    if cache_dir is None:
+        cache_dir = env_dir
+    if (not enabled or (cache_dir is not None
+                        and str(cache_dir).lower() in _OFF_VALUES)):
+        # the off switch must actually turn a previously-enabled cache
+        # OFF, not just stop reporting it
+        if "jax" in sys.modules:
+            import jax
+            try:
+                if getattr(jax.config, "jax_compilation_cache_dir",
+                           None):
+                    jax.config.update("jax_compilation_cache_dir", None)
+                    from jax._src import compilation_cache as _cc
+                    _cc.reset_cache()
+            except Exception:  # pragma: no cover - internal API drift
+                pass
+        else:
+            os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+        _installed["dir"] = None
+        return None
+    if cache_dir is None:
+        cache_dir = default_dir()
+    if min_compile_s is None:
+        min_compile_s = float(os.environ.get(ENV_MIN_COMPILE_S, "2"))
+    settings = {
+        "jax_compilation_cache_dir": str(cache_dir),
+        "jax_persistent_cache_min_compile_time_secs": min_compile_s,
+        "jax_persistent_cache_min_entry_size_bytes": -1,
+    }
+    if "jax" in sys.modules:
+        import jax
+        dir_changed = (
+            getattr(jax.config, "jax_compilation_cache_dir", None)
+            != str(cache_dir))
+        for key, value in settings.items():
+            try:
+                jax.config.update(key, value)
+            except Exception:  # pragma: no cover - old/new jax drift
+                _LOG.warning("compile cache: jax has no config %s", key)
+        if dir_changed:
+            # jax binds its cache OBJECT to the dir at first use; a
+            # config update alone leaves reads/writes on the old dir
+            try:
+                from jax._src import compilation_cache as _cc
+                _cc.reset_cache()
+            except Exception:  # pragma: no cover - internal API drift
+                pass
+        ensure_instrumented()
+    else:
+        # jax not imported yet (cli boot path): the env vars are read
+        # when jax.config defines these options, so this wires the
+        # cache without paying the jax import here.  The listener is
+        # installed by whichever component imports jax first and asks
+        # for stats (provider module import / bench / supervisor).
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = str(cache_dir)
+        os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = \
+            str(min_compile_s)
+        os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "-1"
+    _installed["dir"] = str(cache_dir)
+    _LOG.info("persistent XLA compile cache: %s", cache_dir)
+    return str(cache_dir)
+
+
+def cache_dir():
+    """The configured dir (None when off/unconfigured)."""
+    return _installed["dir"]
+
+
+def stats() -> dict:
+    """Process-local cache counters (one JSON-able dict)."""
+    if "jax" in sys.modules:
+        ensure_instrumented()
+    with _lock:
+        return {"dir": _installed["dir"], "hits": _counts["hit"],
+                "misses": _counts["miss"]}
+
+
+def delta(before: dict, after=None) -> dict:
+    """Hit/miss movement between two stats() snapshots."""
+    if after is None:
+        after = stats()
+    return {"hits": after["hits"] - before["hits"],
+            "misses": after["misses"] - before["misses"]}
+
+
+def classify_first_dispatch(d: dict) -> str:
+    """Jit outcome for the FIRST dispatch of a shape, from the cache
+    delta observed around it: pure disk hits -> ``cache_load``; any
+    fresh XLA work (or no persistent cache at all) -> ``compile``."""
+    if d["hits"] > 0 and d["misses"] == 0:
+        return "cache_load"
+    return "compile"

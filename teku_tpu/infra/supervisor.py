@@ -36,7 +36,7 @@ import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
-from . import faults, flightrecorder
+from . import compilecache, faults, flightrecorder
 from .aio import retry_with_backoff
 from .metrics import GLOBAL_REGISTRY, MetricsRegistry
 from .service import Service
@@ -313,6 +313,10 @@ class BackendSupervisor(Service):
         self.warmup_deadline_s = warmup_deadline_s
         self.backend = None
         self.backend_detail: str = ""
+        # WARMING's compile-cache verdict ({"hits", "misses", "s"}):
+        # a warm boot shows hits>0, misses==0 — the multi-minute
+        # per-shape compiles were served from disk
+        self.warmup_cache: dict = {}
         self.transitions: List[Tuple[str, float]] = []
         self._task: Optional[asyncio.Task] = None
         self._ready_event = asyncio.Event()
@@ -401,6 +405,8 @@ class BackendSupervisor(Service):
                                for s, t in self.transitions]}
         if self.breaker is not None:
             out["circuit"] = self.breaker.state
+        if self.warmup_cache:
+            out["warmup_cache"] = self.warmup_cache
         return out
 
     async def wait_ready(self, timeout_s: Optional[float] = None) -> bool:
@@ -521,6 +527,12 @@ class BackendSupervisor(Service):
                 delay = min(delay * 2, self.max_round_delay_s)
         self._record(BackendState.WARMING)
         if self._warmup is not None:
+            # WARMING pays the hot-program compiles off-path; the
+            # persistent compile cache decides whether that costs
+            # minutes (fresh compiles) or seconds (cache loads) —
+            # report which, so a slow bring-up explains itself
+            cache_before = compilecache.stats()
+            warm_t0 = time.monotonic()
             try:
                 # bounded: WARMING must not become the one phase that
                 # can wedge forever (probing retries, READY has the
@@ -551,6 +563,18 @@ class BackendSupervisor(Service):
             except Exception:
                 _LOG.exception("backend warmup failed; installing "
                                "anyway (first batch compiles lazily)")
+            moved = compilecache.delta(cache_before)
+            self.warmup_cache = {
+                "hits": moved["hits"], "misses": moved["misses"],
+                "s": round(time.monotonic() - warm_t0, 1)}
+            flightrecorder.record("warmup_cache", supervisor=self.name,
+                                  **self.warmup_cache)
+            _LOG.info(
+                "backend %s warmup in %.1fs: %d compile-cache load(s), "
+                "%d fresh compile(s)%s", self.name,
+                self.warmup_cache["s"], moved["hits"], moved["misses"],
+                "" if compilecache.cache_dir() else
+                " (persistent cache not configured)")
         self.backend = backend
         self._install(backend)
         self._record(BackendState.READY)

@@ -31,8 +31,15 @@ as long as units(a) * units(b) <= 64 — the product-column bound
 the bound carry a comment.  Everything broadcasts over leading batch
 dims; batching is plain array broadcasting.
 
+TWO mont_mul engines live behind one contract: the VPU pad-and-sum
+path below, and the MXU int8 digit-split matmul path (ops/mxu.py) —
+`mont_mul`/`mont_sqr` dispatch at trace time on the process-global
+path config (`--mont-path` / TEKU_TPU_MONT_MUL; auto = mxu only on a
+TPU dispatch device).  Both emit one compressed unit in (-P, 2P)
+through the SAME `_mont_reduce` scan, so outputs are bit-identical.
+
 Layer validation: tests/test_ops_limbs.py checks every op against the
-pure-Python oracle (teku_tpu/crypto/bls/fields.py).
+pure-Python oracle (teku_tpu/crypto/bls/fields.py), on both paths.
 """
 
 import numpy as np
@@ -42,6 +49,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..crypto.bls.constants import P
+from . import mxu as _mxu
 
 # --------------------------------------------------------------------------
 # Representation constants
@@ -191,7 +199,7 @@ def _mont_reduce(t):
     return compress(t[..., :L])
 
 
-def mont_mul(a, b):
+def mont_mul_vpu(a, b):
     """Montgomery product a*b*R^-1 (one unit out, value in (-P, 2P)).
 
     Schoolbook column products built by pad-and-sum — no scatters, no
@@ -201,7 +209,7 @@ def mont_mul(a, b):
     return _mont_reduce(t)
 
 
-def mont_sqr(a):
+def mont_sqr_vpu(a):
     """Montgomery squaring: symmetric cross products computed once and
     doubled (~half the limb multiplies of mont_mul)."""
     rows = []
@@ -211,6 +219,29 @@ def mont_sqr(a):
         seg = jnp.concatenate([diag, cross], axis=-1)   # columns 2i..i+L-1
         rows.append(_pad_last(seg, 2 * i, L - i))
     return _mont_reduce(sum(rows))
+
+
+# MXU path: same operand contract, same _mont_reduce, product columns
+# built as batched int8 digit-split dot_general (ops/mxu.py)
+mont_mul_mxu, mont_sqr_mxu = _mxu.make_digit_kernels(
+    L, W, P.bit_length(), compress, _mont_reduce)
+
+
+def mont_mul(a, b):
+    """Montgomery product via the configured engine (vpu | mxu).
+
+    The path is resolved at TRACE time from the process-global config;
+    a jitted program keeps the path it was traced with."""
+    if _mxu.active():
+        return mont_mul_mxu(a, b)
+    return mont_mul_vpu(a, b)
+
+
+def mont_sqr(a):
+    """Montgomery squaring via the configured engine (vpu | mxu)."""
+    if _mxu.active():
+        return mont_sqr_mxu(a)
+    return mont_sqr_vpu(a)
 
 
 def to_mont(a):

@@ -12,6 +12,12 @@ lazy (no carries), mont_mul/mont_sqr take bounded lazy operands and
 emit one compressed unit with value in (-M, 2M), canonical() decides
 equality, inversion is Fermat, and the batch inverse is Montgomery's
 trick over two log-depth associative scans.
+
+Like ops/limbs.py, every field built here carries BOTH multiplier
+engines: the VPU pad-and-sum path and the MXU int8 digit-split matmul
+path (ops/mxu.py), dispatched at trace time on the same process-global
+path config.  The namespace exposes mont_mul_vpu / mont_mul_mxu (and
+sqr variants) for layer-validation parity tests.
 """
 
 from types import SimpleNamespace
@@ -20,6 +26,8 @@ import numpy as np
 
 import jax.numpy as jnp
 from jax import lax
+
+from . import mxu as _mxu
 
 
 def make_field(modulus: int, name: str = "field",
@@ -99,12 +107,12 @@ def make_field(modulus: int, name: str = "field",
         t, _ = lax.scan(red, t, None, length=L)
         return compress(t[..., :L])
 
-    def mont_mul(a, b):
+    def mont_mul_vpu(a, b):
         t = sum(_pad_last(a[..., i:i + 1] * b, i, L - i)
                 for i in range(L))
         return _mont_reduce(t)
 
-    def mont_sqr(a):
+    def mont_sqr_vpu(a):
         rows = []
         for i in range(L):
             diag = a[..., i:i + 1] * a[..., i:i + 1]
@@ -112,6 +120,19 @@ def make_field(modulus: int, name: str = "field",
             seg = jnp.concatenate([diag, cross], axis=-1)
             rows.append(_pad_last(seg, 2 * i, L - i))
         return _mont_reduce(sum(rows))
+
+    mont_mul_mxu, mont_sqr_mxu = _mxu.make_digit_kernels(
+        L, W, M.bit_length(), compress, _mont_reduce)
+
+    def mont_mul(a, b):
+        if _mxu.active():
+            return mont_mul_mxu(a, b)
+        return mont_mul_vpu(a, b)
+
+    def mont_sqr(a):
+        if _mxu.active():
+            return mont_sqr_mxu(a)
+        return mont_sqr_vpu(a)
 
     def to_mont(a):
         return mont_mul(a, jnp.asarray(R2_LIMBS))
@@ -172,7 +193,9 @@ def make_field(modulus: int, name: str = "field",
         int_to_mont=int_to_mont, mont_to_int=mont_to_int,
         ONE_MONT=ONE_MONT, M_LIMBS=M_LIMBS,
         select=select, compress=compress, mont_mul=mont_mul,
-        mont_sqr=mont_sqr, to_mont=to_mont, canonical=canonical,
+        mont_sqr=mont_sqr, mont_mul_vpu=mont_mul_vpu,
+        mont_sqr_vpu=mont_sqr_vpu, mont_mul_mxu=mont_mul_mxu,
+        mont_sqr_mxu=mont_sqr_mxu, to_mont=to_mont, canonical=canonical,
         canonical_plain=canonical_plain, is_zero=is_zero,
         pow_static=pow_static, inv=inv, inv_many=inv_many,
     )

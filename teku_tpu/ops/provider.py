@@ -33,14 +33,19 @@ import jax
 import jax.numpy as jnp
 
 from ..crypto.bls import hash_to_curve as OH
-from ..infra import faults, tracing
+from ..infra import compilecache, faults, tracing
 from ..infra.metrics import GLOBAL_REGISTRY
 from ..crypto.bls.constants import P, R
 from ..crypto.bls.pure_impl import PureBls12381
 from ..crypto.bls.spi import BLS12381, BatchSemiAggregate
 from . import limbs as fp
+from . import mxu
 from . import points as PT
 from . import verify as V
+
+# jax is imported by now (via ops/__init__): install the compile-cache
+# hit/miss listener so dispatch outcomes below can be classified
+compilecache.ensure_instrumented()
 
 _G1_INF = bytes([0xC0] + [0] * 47)
 _G2_INF = bytes([0xC0] + [0] * 95)
@@ -48,14 +53,18 @@ _G2_INF = bytes([0xC0] + [0] * 95)
 # Process-level dispatch observability (module-level because the staged
 # verify jits in ops/verify.py are shared across provider instances).
 # First dispatch of a (padded, kmax) bucket shape is the one that pays
-# the XLA compile; everything after hits the jit cache.
+# the XLA work — `compile` when it was a fresh compile, `cache_load`
+# when the persistent compile cache served it from disk; everything
+# after hits the in-memory jit cache (`cache_hit`).  `path` is the
+# active mont_mul engine (vpu | mxu, ops/mxu.py).
 _SEEN_SHAPES: set = set()
 _SEEN_LOCK = threading.Lock()
 _M_JIT = GLOBAL_REGISTRY.labeled_counter(
     "bls_jit_dispatch_total",
-    "verify dispatches by padded bucket shape (lanes x keys) and "
-    "jit-cache outcome",
-    labelnames=("shape", "outcome"))
+    "verify dispatches by padded bucket shape (lanes x keys), "
+    "jit-cache outcome (compile|cache_load|cache_hit) and mont_mul "
+    "path (vpu|mxu)",
+    labelnames=("shape", "outcome", "path"))
 _M_LANES_REAL = GLOBAL_REGISTRY.counter(
     "bls_dispatch_lanes_real_total",
     "real (non-padding) lanes dispatched to the device")
@@ -189,6 +198,11 @@ class JaxBls12381(BLS12381):
         # counters at AggregatingSignatureVerificationService.java:76-98)
         self.dispatch_count = 0
         self.lanes_dispatched = 0
+        # the mont_mul engine resolved when this provider was built —
+        # jitted programs KEEP the engine they were traced with, so
+        # the dispatch metric labels with this, not a re-resolution
+        # (a mid-process set_path() affects only not-yet-traced shapes)
+        self.mont_path = mxu.resolve()
 
     # ------------------------------------------------------------------
     # Host-side SPI ops delegated to the oracle (rare, non-batch paths)
@@ -396,25 +410,39 @@ class JaxBls12381(BLS12381):
         cache_key = (id(self._sharded) if self._sharded is not None
                      else 0, shape)
         with _SEEN_LOCK:
-            outcome = ("cache_hit" if cache_key in _SEEN_SHAPES
-                       else "compile")
+            first = cache_key not in _SEEN_SHAPES
             _SEEN_SHAPES.add(cache_key)
-        _M_JIT.labels(shape=shape, outcome=outcome).inc()
+        mont_path = self.mont_path
+        # first dispatch of a shape pays the XLA work: diff the
+        # persistent-cache counters around it to tell a fresh compile
+        # from a disk cache load (racy under concurrent first
+        # dispatches — the label may misattribute, the counts don't)
+        cache_before = compilecache.stats() if first else None
         # padded first: a scrape between the two incs must read the
         # ratio high, never negative
         _M_LANES_PADDED.inc(padded)
         _M_LANES_REAL.inc(n)
-        with tracing.span("device_execute"):
-            if self._sharded is not None:
-                ok, lane_ok = self._sharded(
-                    pk_xs, pk_ys, pk_present, (u0c0, u0c1), (u1c0, u1c1),
-                    (sx0, sx1), s_large, s_inf, r_bits, lane_valid)
-            else:
-                ok, lane_ok = self._verify_jit(
-                    pk_xs, pk_ys, pk_present, (u0c0, u0c1), (u1c0, u1c1),
-                    (sx0, sx1), s_large, s_inf, r_bits, lane_valid)
-            # np.asarray forces the device round-trip, so the span
-            # covers execute-to-host-synchronized, not dispatch-only
-            lane_ok = np.asarray(lane_ok)
-            verdict = bool(np.asarray(ok)) and bool(lane_ok[:n].all())
+        outcome = "cache_hit"
+        try:
+            with tracing.span("device_execute"):
+                if self._sharded is not None:
+                    ok, lane_ok = self._sharded(
+                        pk_xs, pk_ys, pk_present, (u0c0, u0c1),
+                        (u1c0, u1c1), (sx0, sx1), s_large, s_inf,
+                        r_bits, lane_valid)
+                else:
+                    ok, lane_ok = self._verify_jit(
+                        pk_xs, pk_ys, pk_present, (u0c0, u0c1),
+                        (u1c0, u1c1), (sx0, sx1), s_large, s_inf,
+                        r_bits, lane_valid)
+                # np.asarray forces the device round-trip, so the span
+                # covers execute-to-host-synchronized, not dispatch-only
+                lane_ok = np.asarray(lane_ok)
+                verdict = bool(np.asarray(ok)) and bool(lane_ok[:n].all())
+        finally:
+            if first:
+                outcome = compilecache.classify_first_dispatch(
+                    compilecache.delta(cache_before))
+            _M_JIT.labels(shape=shape, outcome=outcome,
+                          path=mont_path).inc()
         return faults.transform("bls.dispatch", verdict)

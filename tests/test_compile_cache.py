@@ -1,0 +1,146 @@
+"""Persistent XLA compile cache round-trip + mont-path selection.
+
+Fast-tier gates for the two boot-cost levers this repo leans on:
+
+- the persistent compile cache must actually ROUND-TRIP: a first jit
+  populates the dir (miss), and after the in-memory jit caches are
+  dropped (a process/config reload in miniature) the same program is
+  served from disk (hit) — otherwise every boot repays the multi-minute
+  per-shape kernel compiles;
+- `--mont-path mxu` on a CPU-only host must fall back to the vpu path
+  with ONE warning instead of a slow (or failing) int8-matmul dispatch.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from teku_tpu.infra import compilecache
+from teku_tpu.ops import limbs as fp
+from teku_tpu.ops import mxu
+
+
+@pytest.fixture
+def isolated_cache(tmp_path, monkeypatch):
+    """Point the persistent cache at a fresh dir; restore after."""
+    before = {
+        "dir": jax.config.jax_compilation_cache_dir,
+        "min_s": jax.config.jax_persistent_cache_min_compile_time_secs,
+        "min_b": jax.config.jax_persistent_cache_min_entry_size_bytes,
+    }
+    monkeypatch.delenv(compilecache.ENV_DIR, raising=False)
+    cache_dir = tmp_path / "xla_cache"
+    yield str(cache_dir)
+    jax.config.update("jax_compilation_cache_dir", before["dir"])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      before["min_s"])
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                      before["min_b"])
+    # rebind jax's cache object to the restored dir (it pins the dir
+    # it first initialized with; configure() does the same on change)
+    from jax._src import compilation_cache as _cc
+    _cc.reset_cache()
+
+
+def test_compile_cache_round_trips(isolated_cache):
+    got = compilecache.configure(cache_dir=isolated_cache,
+                                 min_compile_s=0)
+    assert got == isolated_cache
+    assert compilecache.cache_dir() == isolated_cache
+    assert compilecache.ensure_instrumented()
+
+    # the traced program must be unique to this test run, or a
+    # previous process's cache dir... (it can't be: tmp_path is fresh)
+    x = jnp.arange(64, dtype=jnp.int64)
+
+    before = compilecache.stats()
+    first = jax.jit(lambda v: (v * 3 + 1).sum())(x)
+    moved = compilecache.delta(before)
+    assert moved["misses"] >= 1, "first jit must MISS the fresh dir"
+    import os
+    assert os.listdir(isolated_cache), "miss must populate the dir"
+
+    # a fresh process/config reload in miniature: drop the in-memory
+    # jit caches, re-trace the same program, expect a DISK hit
+    jax.clear_caches()
+    before = compilecache.stats()
+    second = jax.jit(lambda v: (v * 3 + 1).sum())(x)
+    moved = compilecache.delta(before)
+    assert moved["hits"] >= 1, "reload must be served from the dir"
+    assert moved["misses"] == 0
+    assert int(first) == int(second)
+    assert compilecache.classify_first_dispatch(moved) == "cache_load"
+
+
+def test_classify_first_dispatch_outcomes():
+    assert compilecache.classify_first_dispatch(
+        {"hits": 2, "misses": 0}) == "cache_load"
+    assert compilecache.classify_first_dispatch(
+        {"hits": 0, "misses": 3}) == "compile"
+    # mixed (some programs loaded, some compiled) counts as compile
+    assert compilecache.classify_first_dispatch(
+        {"hits": 1, "misses": 1}) == "compile"
+    # no persistent cache configured: first dispatch is a compile
+    assert compilecache.classify_first_dispatch(
+        {"hits": 0, "misses": 0}) == "compile"
+
+
+def test_configure_off_disables(monkeypatch):
+    prev_dir = jax.config.jax_compilation_cache_dir
+    monkeypatch.setenv(compilecache.ENV_DIR, "off")
+    assert compilecache.configure() is None
+    assert compilecache.cache_dir() is None
+    # off actually turns the jax-side cache off, not just the report
+    assert jax.config.jax_compilation_cache_dir is None
+    # re-enable for the rest of the suite (conftest wired this dir)
+    monkeypatch.delenv(compilecache.ENV_DIR)
+    if prev_dir:
+        assert compilecache.configure(cache_dir=prev_dir) == prev_dir
+
+
+def test_mxu_on_cpu_falls_back_with_one_warn(caplog):
+    """Explicit mxu on a non-TPU dispatch device: vpu serves, exactly
+    one WARN, and the kernels still agree with the oracle."""
+    assert jax.default_backend() != "tpu", "test assumes a CPU host"
+    caplog.set_level(logging.WARNING, logger="teku_tpu.ops.mxu")
+    prev = mxu.get_path()
+    try:
+        mxu.set_path("mxu")
+        assert mxu.resolve() == "vpu"
+        assert mxu.resolve() == "vpu"      # second resolve: no new WARN
+        warns = [r for r in caplog.records
+                 if "falling back to the vpu path" in r.getMessage()]
+        assert len(warns) == 1
+        # and the dispatching mont_mul serves the vpu result
+        a = np.stack([np.asarray(fp.int_to_mont(v))
+                      for v in (5, 7, 11)])
+        out = np.asarray(fp.mont_mul(a, a))
+        assert [fp.mont_to_int(out[i]) for i in range(3)] == \
+            [25, 49, 121]
+    finally:
+        mxu.set_path(prev if prev != "auto" else None)
+
+
+def test_auto_resolves_vpu_on_cpu():
+    prev = mxu.get_path()
+    try:
+        mxu.set_path("auto")
+        assert mxu.resolve() == ("mxu" if jax.default_backend() == "tpu"
+                                 else "vpu")
+        mxu.set_path("vpu")
+        assert mxu.resolve() == "vpu"
+        with pytest.raises(ValueError):
+            mxu.set_path("simd")
+    finally:
+        mxu.set_path(prev if prev != "auto" else None)
+
+
+def test_force_context_restores():
+    prev = mxu.get_path()
+    with mxu.force("mxu-force"):
+        assert mxu.resolve() == "mxu"
+    assert mxu.get_path() == prev

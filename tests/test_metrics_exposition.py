@@ -257,6 +257,36 @@ def test_global_exposition_is_well_formed_after_node_imports():
     assert "bls_dispatch_padding_waste_ratio" in fams
 
 
+_JIT_OUTCOMES = {"compile", "cache_load", "cache_hit"}
+
+
+def test_dispatch_and_cache_label_contract():
+    """The mont-path/compile-cache label vocabulary must not drift:
+    dashboards key on `path` (vpu|mxu) and the three-way jit outcome
+    (compile = fresh XLA work, cache_load = served from the persistent
+    cache dir, cache_hit = in-memory jit cache)."""
+    from teku_tpu.infra import compilecache  # noqa: F401 - registers
+    from teku_tpu.infra.metrics import GLOBAL_REGISTRY
+    import teku_tpu.ops.provider as pv
+    from teku_tpu.ops import mxu
+
+    metrics = GLOBAL_REGISTRY.metrics()
+    jit = metrics["bls_jit_dispatch_total"]
+    assert isinstance(jit, LabeledCounter)
+    assert tuple(jit.labelnames) == ("shape", "outcome", "path")
+    cache = metrics["xla_compile_cache_total"]
+    assert isinstance(cache, LabeledCounter)
+    assert tuple(cache.labelnames) == ("outcome",)
+    # the classifier can only emit the documented vocabulary
+    for d in ({"hits": 1, "misses": 0}, {"hits": 0, "misses": 1},
+              {"hits": 3, "misses": 2}, {"hits": 0, "misses": 0}):
+        assert compilecache.classify_first_dispatch(d) in _JIT_OUTCOMES
+    # and the path label values come from the resolver's closed set
+    assert mxu.resolve() in ("vpu", "mxu")
+    # provider records its engine for introspection
+    assert pv  # imported above; JaxBls12381 instances carry .mont_path
+
+
 def test_slo_health_family_naming_lint():
     """The PR-3 families must not drift from the conventions: states as
     labeled/state gauges (never bare numbers encoding an enum), burn

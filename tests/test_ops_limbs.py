@@ -1,14 +1,27 @@
-"""fp381 limb arithmetic vs the pure-Python oracle."""
+"""fp381 limb arithmetic vs the pure-Python oracle.
+
+Layer validation for BOTH mont_mul engines: the VPU pad-and-sum path
+and the MXU int8 digit-split matmul path (ops/mxu.py) run against the
+same oracle, including adversarial operands at the documented
+``units(a) * units(b) <= 64`` lazy-reduction contract edge, plus a
+cross-path parity gate asserting bit-identical ``canonical()`` images.
+"""
 
 import random
 
 import numpy as np
 import pytest
 
-from teku_tpu.crypto.bls.constants import P
+from teku_tpu.crypto.bls.constants import P, R
 from teku_tpu.ops import limbs as fp
+from teku_tpu.ops import modfield, mxu
 
 rng = random.Random(0xB15)
+
+PATH_KERNELS = {
+    "vpu": (fp.mont_mul_vpu, fp.mont_sqr_vpu),
+    "mxu": (fp.mont_mul_mxu, fp.mont_sqr_mxu),
+}
 
 
 def rand_fq():
@@ -104,3 +117,127 @@ def test_sqrt_candidate():
         sq = r * r % P
         cand = fp.mont_to_int(np.asarray(fp.sqrt_candidate(batch_mont([sq]))[0]))
         assert cand in (r, P - r)
+
+
+# --------------------------------------------------------------------------
+# Adversarial operand bounds at the lazy-reduction contract edge, on
+# BOTH multiplier paths (units(a) * units(b) <= 64; ops/limbs.py)
+# --------------------------------------------------------------------------
+
+def _lazy_operand(n_units: int, sign_rng):
+    """A signed sum of n_units Montgomery units: (lazy_limbs, value)."""
+    acc = np.zeros(fp.L, dtype=np.int64)
+    value = 0
+    for _ in range(n_units):
+        v = rand_fq()
+        s = sign_rng.choice((1, -1))
+        acc = acc + s * np.asarray(fp.int_to_mont(v), dtype=np.int64)
+        value = (value + s * v) % P
+    return acc, value
+
+
+@pytest.mark.parametrize("path", sorted(PATH_KERNELS))
+@pytest.mark.parametrize("ua,ub", [(1, 64), (2, 32), (4, 16), (8, 8),
+                                   (16, 4), (64, 1)])
+def test_mont_mul_lazy_contract_edge(path, ua, ub):
+    """Signed lazy sums at every (ua, ub) split of the ua*ub = 64
+    contract edge must reduce to the oracle product on both paths."""
+    mont_mul, _ = PATH_KERNELS[path]
+    sign_rng = random.Random(ua * 1000 + ub)
+    lanes = 4
+    la, lb, expect = [], [], []
+    for _ in range(lanes):
+        a, va = _lazy_operand(ua, sign_rng)
+        b, vb = _lazy_operand(ub, sign_rng)
+        la.append(a)
+        lb.append(b)
+        expect.append(va * vb % P)
+    out = np.asarray(mont_mul(np.stack(la), np.stack(lb)))
+    got = [fp.mont_to_int(out[i]) for i in range(lanes)]
+    assert got == expect
+
+
+@pytest.mark.parametrize("path", sorted(PATH_KERNELS))
+def test_mont_mul_top_limb_magnitude(path):
+    """Operands whose compressed top limb sits near the +-2^22 unit
+    bound (and beyond, at the 64-unit lazy bound) stay exact: the MXU
+    digit split must carry the top limb's sign and overflow."""
+    mont_mul, mont_sqr = PATH_KERNELS[path]
+    top = fp.W * (fp.L - 1)                      # bit 364
+    cases = []
+    for top_mag in ((1 << 22) - 1, (1 << 21) + 1):
+        v = ((top_mag << top) + rng.randrange(1 << top)) % P
+        cases.append(v)
+    # maximal canonical value: top limb at its largest canonical size
+    cases += [P - 1, P - 2]
+    a = np.stack([np.asarray(fp.int_to_mont(v), dtype=np.int64)
+                  for v in cases])
+    # drive the top limb NEGATIVE and large via signed-sum lazies:
+    # a (1 unit) x neg (32 units) hits ua*ub = 32; mont_sqr uses an
+    # 8-unit operand so the squared contract 8*8 = 64 sits AT the edge
+    neg = np.stack([-32 * row for row in a])
+    out = np.asarray(mont_mul(a, neg))
+    for i, v in enumerate(cases):
+        assert fp.mont_to_int(out[i]) == (v * (-32 * v)) % P
+    sq = np.asarray(mont_sqr(np.stack([-8 * row for row in a])))
+    for i, v in enumerate(cases):
+        assert fp.mont_to_int(sq[i]) == (8 * v) ** 2 % P
+
+
+def test_cross_path_parity_bit_identical():
+    """vpu and mxu mont_mul/mont_sqr must produce BIT-IDENTICAL
+    canonical() images on shared random vectors — the gate for
+    swapping the engine under the live kernels."""
+    prng = random.Random(0xA11CE)
+    lanes = 32
+    a = np.stack([np.asarray(fp.int_to_mont(prng.randrange(P)))
+                  for _ in range(lanes)])
+    b = np.stack([np.asarray(fp.int_to_mont(prng.randrange(P)))
+                  for _ in range(lanes)])
+    # plus lazy signed sums (units 2 and 4), like real call sites feed
+    lazy_a = a - np.roll(a, 1, axis=0)
+    lazy_b = b + np.roll(b, 3, axis=0) - np.roll(a, 5, axis=0) + a
+    for x, y in ((a, b), (lazy_a, lazy_b), (lazy_b, lazy_a)):
+        vpu = np.asarray(fp.canonical(fp.mont_mul_vpu(x, y)))
+        mxu_ = np.asarray(fp.canonical(fp.mont_mul_mxu(x, y)))
+        assert (vpu == mxu_).all()
+    sq_v = np.asarray(fp.canonical(fp.mont_sqr_vpu(lazy_b)))
+    sq_m = np.asarray(fp.canonical(fp.mont_sqr_mxu(lazy_b)))
+    assert (sq_v == sq_m).all()
+
+
+def test_dispatch_follows_path_config():
+    """fp.mont_mul routes by the process-global config: forced mxu and
+    forced vpu must agree bit-for-bit (trace-time dispatch).  Both
+    ends are pinned so an ambient TEKU_TPU_MONT_MUL doesn't leak in."""
+    a = batch_mont([rand_fq() for _ in range(4)])
+    b = batch_mont([rand_fq() for _ in range(4)])
+    with mxu.force("vpu"):
+        assert mxu.resolve() == "vpu"
+        base = np.asarray(fp.mont_mul(a, b))
+    with mxu.force("mxu-force"):
+        assert mxu.resolve() == "mxu"
+        forced = np.asarray(fp.mont_mul(a, b))
+    assert (np.asarray(fp.canonical(base))
+            == np.asarray(fp.canonical(forced))).all()
+
+
+def test_generic_field_cross_path_parity():
+    """modfield.make_field carries both engines too (Fr for KZG): the
+    scalar field's 10-limb digit split needs 5 digit planes — cover it
+    against the bigint oracle and across paths."""
+    FR = modfield.make_field(R, "fr")
+    prng = random.Random(0xF2)
+    xs = [0, 1, R - 1, R - 2] + [prng.randrange(R) for _ in range(12)]
+    ys = list(reversed(xs))
+    a = np.stack([np.asarray(FR.int_to_mont(v)) for v in xs])
+    b = np.stack([np.asarray(FR.int_to_mont(v)) for v in ys])
+    lazy_a = a - np.roll(b, 2, axis=0)
+    va = [(x - y2) % R for x, y2 in zip(xs, np.roll(ys, 2).tolist())]
+    out_v = np.asarray(FR.mont_mul_vpu(lazy_a, b))
+    out_m = np.asarray(FR.mont_mul_mxu(lazy_a, b))
+    for i in range(len(xs)):
+        assert FR.mont_to_int(out_v[i]) == va[i] * ys[i] % R
+        assert FR.mont_to_int(out_m[i]) == va[i] * ys[i] % R
+    assert (np.asarray(FR.canonical(out_v))
+            == np.asarray(FR.canonical(out_m))).all()
