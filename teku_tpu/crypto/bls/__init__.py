@@ -14,7 +14,7 @@ from typing import List, Optional, Sequence, Tuple
 from ...infra import faults
 from .pure_impl import (G1_INFINITY, G2_INFINITY, PureBls12381, keygen,
                         random_secret_key)
-from .spi import BLS12381, BatchSemiAggregate
+from .spi import BLS12381, BatchSemiAggregate, ResolvedHandle
 
 _IMPL: BLS12381 = PureBls12381()
 
@@ -122,6 +122,49 @@ def batch_verify(
     else:
         ok = _IMPL.batch_verify(triples)
     return faults.transform("bls.batch_verify", ok)
+
+
+class _FaultCheckedHandle:
+    """Applies the `bls.batch_verify` result-transform faults at the
+    sync point, mirroring what the sync facade does inline."""
+
+    __slots__ = ("_inner",)
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def result(self) -> bool:
+        return faults.transform("bls.batch_verify", self._inner.result())
+
+
+def supports_async_verify() -> bool:
+    """True when the active implementation exposes the async begin
+    seam (callers avoid a thread hop per batch otherwise)."""
+    return getattr(_IMPL, "begin_batch_verify", None) is not None
+
+
+def begin_batch_verify(
+    triples: Sequence[Tuple[Sequence[bytes], bytes, bytes]],
+):
+    """Async-dispatch twin of batch_verify: host_prep + device enqueue
+    now, verdict at handle.result() (the only sync point) — the
+    batching service overlaps the next batch's host_prep with the
+    in-flight device execute through this seam.
+
+    Returns None when the active implementation has no async path
+    (pure-Python oracle, breaker-guarded backends — the breaker must
+    own its dispatch deadline, so guarded deployments stay on the sync
+    path); callers fall back to batch_verify."""
+    if verification_disabled or not triples:
+        return ResolvedHandle(True)
+    begin = getattr(_IMPL, "begin_batch_verify", None)
+    if begin is None:
+        return None
+    faults.check("bls.batch_verify")
+    inner = begin(triples)
+    if inner is None:
+        return None
+    return _FaultCheckedHandle(inner)
 
 
 def prepare_batch_verify(

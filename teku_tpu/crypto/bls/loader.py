@@ -289,12 +289,30 @@ def make_supervisor(*, max_batch: int = 256, min_bucket: int = 16,
         msg = b"teku-tpu warmup"
         sig = oracle.sign(1, msg)
         triple = ([_PROBE_PK], msg, sig)
-        for shape in (1, max_batch):
-            if not impl.batch_verify([triple] * shape):
-                # a wrong verdict on a known-good signature is a
-                # device we must never install
+        if not impl.batch_verify([triple]):
+            raise WarmupVetoError("warmup batch (x1) did not verify")
+        # primary bucket with DISTINCT messages: the dedup-aware
+        # pipeline specializes on the unique-message bucket, and
+        # all-unique (fresh gossip, dup factor 1) is the worst-case
+        # shape — warm that first
+        batch = [([_PROBE_PK], m, oracle.sign(1, m))
+                 for m in (b"teku-tpu warmup %d" % i
+                           for i in range(max_batch))]
+        if not impl.batch_verify(batch):
+            # a wrong verdict on a known-good signature is a device
+            # we must never install
+            raise WarmupVetoError(
+                f"warmup batch (x{max_batch}) did not verify")
+        if max_batch >= 8:
+            # committee-duplicated shape (dup factor 8, the common
+            # gossip mix): the grouped pipeline specializes on the
+            # (unique, group) bucket pair, and the first REAL committee
+            # batch must not pay that compile inside a breaker-guarded
+            # live dispatch
+            dup = [batch[i // 8] for i in range(max_batch)]
+            if not impl.batch_verify(dup):
                 raise WarmupVetoError(
-                    f"warmup batch (x{shape}) did not verify")
+                    f"warmup batch (x{max_batch}, dup 8) did not verify")
 
     def install(backend):
         impl, device = backend

@@ -26,6 +26,20 @@ class BatchSemiAggregate:
     """
 
 
+class ResolvedHandle:
+    """Trivially-resolved async-verify handle: the shared shape for
+    batches whose verdict is known at begin time (empty, host-rejected)
+    — same .result() contract as a live dispatch handle."""
+
+    __slots__ = ("_verdict",)
+
+    def __init__(self, verdict: bool):
+        self._verdict = bool(verdict)
+
+    def result(self) -> bool:
+        return self._verdict
+
+
 class BLS12381(abc.ABC):
     """Provider interface: everything the node needs from a BLS library."""
 

@@ -7,6 +7,7 @@ containers behind every seen-message cache, sized so long-running nodes
 cannot grow without bound.
 """
 
+import threading
 from collections import OrderedDict
 from typing import Generic, Hashable, Iterator, Optional, TypeVar
 
@@ -41,28 +42,48 @@ class LimitedSet(Generic[K]):
 
 
 class LimitedMap(Generic[K, V]):
-    def __init__(self, max_size: int):
+    def __init__(self, max_size: int, on_evict=None):
+        """`on_evict(key, value)` fires per LRU eviction (NOT explicit
+        pops) — the hook the verify-path caches hang their shared
+        eviction-counter metric on, so a hot cache evicting warm
+        entries one by one is observable instead of silently churning
+        (the old wholesale `.clear()` at the bound was worse: it dumped
+        every warm entry at once and caused re-validation storms)."""
         assert max_size > 0
         self._max = max_size
+        self._on_evict = on_evict
+        # get/put are compound (lookup + move_to_end + popitem): the
+        # verify-path caches are hit from concurrent dispatch worker
+        # threads, where an unlocked interleaving can move_to_end a key
+        # another thread just evicted (KeyError) or double-evict
+        self._lock = threading.Lock()
         self._items: "OrderedDict[K, V]" = OrderedDict()
 
     def get(self, key: K, default: Optional[V] = None) -> Optional[V]:
-        if key in self._items:
-            self._items.move_to_end(key)
-            return self._items[key]
-        return default
+        with self._lock:
+            if key in self._items:
+                self._items.move_to_end(key)
+                return self._items[key]
+            return default
 
     def put(self, key: K, value: V) -> None:
-        self._items[key] = value
-        self._items.move_to_end(key)
-        if len(self._items) > self._max:
-            self._items.popitem(last=False)
+        evicted = None
+        with self._lock:
+            self._items[key] = value
+            self._items.move_to_end(key)
+            if len(self._items) > self._max:
+                evicted = self._items.popitem(last=False)
+        # the eviction hook (a metrics counter) fires outside the lock
+        if evicted is not None and self._on_evict is not None:
+            self._on_evict(*evicted)
 
     def __contains__(self, key: K) -> bool:
-        return key in self._items
+        with self._lock:
+            return key in self._items
 
     def pop(self, key: K, default: Optional[V] = None) -> Optional[V]:
-        return self._items.pop(key, default)
+        with self._lock:
+            return self._items.pop(key, default)
 
     def __len__(self) -> int:
         return len(self._items)

@@ -11,8 +11,12 @@ overflow — without ever touching a real accelerator.
 Sites in use (grep for `faults.check` / `faults.transform`):
 
 - ``backend.init``       device bring-up probe (SlowRamp / Raise / Hang)
-- ``bls.dispatch``       JaxBls12381._dispatch device call
+- ``bls.dispatch``       JaxBls12381 device dispatch (begin + result)
 - ``bls.batch_verify``   the BLS facade's batch entry (WrongResult)
+- ``h2c.cache``          H(m) device-cache slot resolution
+                         (WrongResult(value=slot) poisons a hit; the
+                         cache must re-verify by digest and recompute,
+                         never flip a verdict — ops/h2c_cache.py)
 - ``kzg.dispatch``       device KZG backend calls
 - ``sigservice.enqueue`` batching-service queue admission (Overflow)
 - ``verifiers.dispatch`` the spec-level verifier seam

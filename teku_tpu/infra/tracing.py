@@ -259,6 +259,13 @@ def attach(traces: Sequence[Optional[Trace]]):
         _CURRENT.reset(token)
 
 
+def current_traces() -> Tuple[Trace, ...]:
+    """Every trace bound to the current context.  Async dispatch
+    handles capture these at enqueue time so the device span can
+    attribute at sync time, possibly under a different context."""
+    return _CURRENT.get()
+
+
 def current_trace() -> Optional[Trace]:
     """First trace bound to the current context (the enqueue hot path
     stamps this onto queued tasks), or None."""

@@ -16,15 +16,28 @@ Host/device split:
 - device: pubkey decompression + subgroup checks for cache misses (one
   batched dispatch), and the whole verification pipeline — per-lane
   multi-key aggregation, hash-to-G2, scalar muls, Miller loops, final
-  exponentiation — in ONE jitted call per padded batch-shape bucket.
+  exponentiation — as a chain of staged jitted programs per padded
+  batch-shape bucket.
+
+DEDUP-AWARE: hash-to-G2 (the largest per-lane stage) runs over each
+batch's UNIQUE messages, backed by a bounded device-resident H(m)
+point cache (ops/h2c_cache.py — steady-state committee gossip pays h2c
+once per distinct AttestationData, a fully-warm batch dispatches no
+h2c at all), and the Miller loops fold to unique width via pairing
+bilinearity (ops/verify.py:stage_group).  begin_batch_verify exposes
+the async seam the batching service uses to overlap host_prep of the
+next batch with the in-flight device execute.
 
 Batch sizes (and the per-lane key-count axis) are padded to powers of
 two so the jit cache stays small and shapes stay static (XLA recompiles
 nothing after warm-up).
 """
 
+import hashlib
+import os
 import secrets
 import threading
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -34,10 +47,13 @@ import jax.numpy as jnp
 
 from ..crypto.bls import hash_to_curve as OH
 from ..infra import compilecache, faults, tracing
+from ..infra.collections import LimitedMap
 from ..infra.metrics import GLOBAL_REGISTRY
 from ..crypto.bls.constants import P, R
 from ..crypto.bls.pure_impl import PureBls12381
-from ..crypto.bls.spi import BLS12381, BatchSemiAggregate
+from ..crypto.bls.spi import (BLS12381, BatchSemiAggregate,
+                              ResolvedHandle)
+from . import h2c_cache as HC
 from . import limbs as fp
 from . import mxu
 from . import points as PT
@@ -71,6 +87,40 @@ _M_LANES_REAL = GLOBAL_REGISTRY.counter(
 _M_LANES_PADDED = GLOBAL_REGISTRY.counter(
     "bls_dispatch_lanes_padded_total",
     "total lanes dispatched including pow-2 padding")
+
+# Dedup-aware h2c observability: hash-to-curve runs over each batch's
+# UNIQUE messages (committee traffic signs the same AttestationData
+# many times), so the lanes/unique gap is realized h2c savings and the
+# dispatch counter proves a warm H(m) cache skips h2c entirely.
+_M_H2C_LANES = GLOBAL_REGISTRY.counter(
+    "bls_h2c_lanes_total",
+    "real lanes entering unique-message h2c dedup")
+_M_H2C_UNIQUE = GLOBAL_REGISTRY.counter(
+    "bls_h2c_unique_total",
+    "unique messages after dedup (h2c work actually owed)")
+_M_H2C_DISPATCH = GLOBAL_REGISTRY.counter(
+    "bls_h2c_dispatch_total",
+    "hash-to-curve device dispatches (0 growth = H(m) cache warm)")
+
+
+def _dedup_ratio() -> float:
+    # read unique BEFORE lanes (writers inc lanes first): a dispatch
+    # landing between the reads skews the ratio high, never negative
+    uniq = _M_H2C_UNIQUE.value
+    lanes = _M_H2C_LANES.value
+    return (lanes - uniq) / lanes if lanes else 0.0
+
+
+# duplication factor observable: 0.875 means 8 lanes/unique message —
+# the fraction of h2c work the dedup pipeline did NOT have to do
+GLOBAL_REGISTRY.gauge(
+    "bls_h2c_dedup_ratio",
+    "fraction of lanes whose H(m) was served by dedup instead of h2c",
+    supplier=_dedup_ratio)
+
+# the host-side wire caches share the H(m) arena's eviction family
+_EVICT_PK = HC.evictions_counter("pk")
+_EVICT_U = HC.evictions_counter("u")
 
 
 def _padding_waste() -> float:
@@ -131,6 +181,50 @@ class _Semi(BatchSemiAggregate):
         self.sig_inf = sig_inf
 
 
+class _DispatchHandle:
+    """An in-flight batch dispatch.
+
+    The device work was enqueued via JAX async dispatch when this was
+    created; result() forces the verdict arrays (the only host/device
+    sync point) — callers may do arbitrary host work (e.g. host_prep of
+    the NEXT batch) between the two.  The traces bound at dispatch time
+    are captured so the device_execute span attributes to the right
+    verifications even when result() runs under a different context.
+    """
+
+    __slots__ = ("_ok", "_lane_ok", "_n", "_t_dev0", "_traces", "_done",
+                 "_verdict")
+
+    def __init__(self, ok, lane_ok, n, t_dev0, traces):
+        self._ok = ok
+        self._lane_ok = lane_ok
+        self._n = n
+        self._t_dev0 = t_dev0
+        self._traces = traces
+        self._done = False
+        self._verdict = False
+
+    def result(self) -> bool:
+        """Synchronize and return the batch verdict (idempotent)."""
+        if self._done:
+            return self._verdict
+        try:
+            # np.asarray forces the device round-trip, so the recorded
+            # stage covers enqueue-to-host-synchronized; under overlap
+            # that includes time the dispatch spent queued behind the
+            # previous in-flight batch (documented attribution caveat)
+            lane_ok = np.asarray(self._lane_ok)
+            verdict = bool(np.asarray(self._ok)) \
+                and bool(lane_ok[:self._n].all())
+        finally:
+            tracing.record_stage(
+                "device_execute", time.perf_counter() - self._t_dev0,
+                self._traces)
+        self._done = True
+        self._verdict = faults.transform("bls.dispatch", verdict)
+        return self._verdict
+
+
 def _parse_g2_wire(sig: bytes):
     """Host wire checks for a compressed G2 signature.
 
@@ -186,18 +280,39 @@ class JaxBls12381(BLS12381):
         # lanes cost microseconds on device, a fresh XLA compile costs
         # minutes — fewer distinct shapes is strictly better
         self.min_bucket = min_bucket
-        # pk bytes -> ("ok", x_mont (L,), y_mont (L,)) | ("bad",)
-        self._pk_cache: dict = {}
-        self._u_cache: dict = {}
-        # staged dispatch: five small programs instead of one monolith
-        # whose TPU compile is unbounded (ops/verify.py staged_jits)
-        self._verify_jit = V.verify_staged
+        # pk bytes -> ("ok", x_mont (L,), y_mont (L,)) | ("bad",).
+        # Bounded LRU, NOT a clear-at-bound dict: a wholesale clear
+        # dumps every warm validator key at once and the next gossip
+        # batches pay a re-validation storm; LRU evicts one cold entry
+        # per insert and the shared eviction counter makes churn visible.
+        self._pk_cache: LimitedMap = LimitedMap(
+            200_000, on_evict=lambda _k, _v: _EVICT_PK.inc())
+        self._u_cache: LimitedMap = LimitedMap(
+            100_000, on_evict=lambda _k, _v: _EVICT_U.inc())
+        # device-resident H(m) point cache: steady-state gossip pays
+        # hash-to-curve once per distinct AttestationData
+        self._h2c_cache = HC.H2cPointCache()
+        # h2c dispatches pad the unique bucket to a pow-2 with this
+        # floor so the h2c program keeps very few distinct shapes
+        self._h2c_min_bucket = int(
+            os.environ.get("TEKU_TPU_H2C_MIN_BUCKET", "8"))
+        # stage_group materializes a (U, G) lane matrix: cap G and
+        # split oversized committees across rows (a message may own
+        # several Miller rows — same verdict, bounded memory)
+        self._group_cap = max(1, int(
+            os.environ.get("TEKU_TPU_H2C_GROUP_CAP", "32")))
+        # staged dispatch: small programs instead of one monolith whose
+        # TPU compile is unbounded (ops/verify.py staged_jits); h2c
+        # runs separately over unique messages (see _begin_dispatch)
         self._pk_validate_jit = jax.jit(self._pk_validate_kernel)
         # observability: proof that node traffic actually reaches the
         # device path (mirrors the reference's signature_verifications_*
         # counters at AggregatingSignatureVerificationService.java:76-98)
         self.dispatch_count = 0
         self.lanes_dispatched = 0
+        # h2c dispatches this provider issued: the warm-cache tests
+        # assert a fully-warm batch leaves this untouched
+        self.h2c_dispatch_count = 0
         # the mont_mul engine resolved when this provider was built —
         # jitted programs KEEP the engine they were traced with, so
         # the dispatch metric labels with this, not a re-resolution
@@ -232,25 +347,37 @@ class JaxBls12381(BLS12381):
         # Z == 1 by construction: (X, Y) are already the affine coords
         return ok, fp.compress(pt[0]), fp.compress(pt[1])
 
-    def _resolve_pks(self, all_pks: Sequence[bytes]):
-        """Fill the cache for every unseen pubkey in one device dispatch."""
-        if len(self._pk_cache) > 200_000:
-            # Bound like _u_cache: pubkey bytes can be attacker-influenced,
-            # so an unbounded cache (including "bad" entries) is a slow
-            # memory-growth vector.
-            self._pk_cache.clear()
+    def _resolve_pks(self, all_pks: Sequence[bytes]) -> dict:
+        """Resolve every requested pubkey (cache-filling, one device
+        dispatch for the misses) and return {pk: entry}.
+
+        The cache is a bounded LRU (pubkey bytes can be
+        attacker-influenced, so an unbounded cache — including "bad"
+        entries — is a slow memory-growth vector); eviction is one cold
+        entry at a time, counted in bls_cache_evictions_total{cache="pk"}.
+        Callers MUST read entries from the returned snapshot, never
+        re-read the shared cache afterwards: at the bound, this batch's
+        own inserts (or a concurrent worker's) may evict an entry
+        resolved here, and a valid signature must not verify False
+        because its pubkey went cold."""
+        resolved = {}
         miss = {}
         for pk in all_pks:
-            if pk in self._pk_cache or pk in miss:
+            if pk in resolved or pk in miss:
+                continue
+            entry = self._pk_cache.get(pk)   # refreshes LRU recency
+            if entry is not None:
+                resolved[pk] = entry
                 continue
             wire = _parse_g1_wire(pk)
             if wire is None or wire[2]:   # malformed or infinity
-                self._pk_cache[pk] = ("bad",)
+                resolved[pk] = ("bad",)
+                self._pk_cache.put(pk, ("bad",))
             else:
                 miss[pk] = wire
         miss = list(miss.items())
         if not miss:
-            return
+            return resolved
         # floor of 16 keeps the validation program at very few distinct
         # shapes (same compile-cost argument as the verify min_bucket)
         n = max(_next_pow2(len(miss)), 16)
@@ -263,14 +390,13 @@ class JaxBls12381(BLS12381):
         ok = np.asarray(ok)
         gx, gy = np.asarray(gx), np.asarray(gy)
         for i, (pk, _) in enumerate(miss):
-            if ok[i]:
-                self._pk_cache[pk] = ("ok", gx[i], gy[i])
-            else:
-                self._pk_cache[pk] = ("bad",)
+            entry = ("ok", gx[i], gy[i]) if ok[i] else ("bad",)
+            resolved[pk] = entry
+            self._pk_cache.put(pk, entry)
+        return resolved
 
     def public_key_is_valid(self, public_key: bytes) -> bool:
-        self._resolve_pks([public_key])
-        return self._pk_cache[public_key][0] == "ok"
+        return self._resolve_pks([public_key])[public_key][0] == "ok"
 
     # ------------------------------------------------------------------
     # Message hashing (host SHA-256 -> field draws, cached)
@@ -281,9 +407,7 @@ class JaxBls12381(BLS12381):
             (a, b), (c, d) = OH.hash_to_field_fq2(message, 2)
             hit = (fp.int_to_mont(a), fp.int_to_mont(b),
                    fp.int_to_mont(c), fp.int_to_mont(d))
-            if len(self._u_cache) > 100_000:
-                self._u_cache.clear()
-            self._u_cache[message] = hit
+            self._u_cache.put(message, hit)
         return hit
 
     # ------------------------------------------------------------------
@@ -295,10 +419,10 @@ class JaxBls12381(BLS12381):
         public_keys, message, signature = triple
         if not public_keys or len(public_keys) > self.max_keys_per_lane:
             return None
-        self._resolve_pks(public_keys)
+        resolved = self._resolve_pks(public_keys)
         points = []
         for pk in public_keys:
-            entry = self._pk_cache[pk]
+            entry = resolved[pk]
             if entry[0] != "ok":
                 return None
             points.append((entry[1], entry[2]))
@@ -358,7 +482,95 @@ class JaxBls12381(BLS12381):
         return self._dispatch(semis, randomize=False)
 
     # ------------------------------------------------------------------
+    # Dedup-aware dispatch: h2c over unique messages + async handle
+    # ------------------------------------------------------------------
+    def begin_batch_verify(self, triples: Sequence[
+            Tuple[Sequence[bytes], bytes, bytes]]):
+        """Async-overlap entry: host_prep + device enqueue NOW (JAX
+        async dispatch), verdict at handle.result().  The batching
+        service uses this to overlap host_prep of batch N+1 with
+        device_execute of batch N.  Returns None for oversized batches
+        (callers fall back to the splitting sync path)."""
+        if len(triples) > self.max_batch:
+            return None
+        with tracing.span("host_prep"):
+            semis = [self.prepare_batch_verify(t) for t in triples]
+        if any(s is None for s in semis):
+            return ResolvedHandle(False)
+        if not semis:
+            return ResolvedHandle(True)
+        return self._begin_dispatch(semis, randomize=True)
+
+    def _uniq_draws(self, msgs: List[bytes], bucket: int):
+        """Host hash_to_field draws for `msgs`, padded to `bucket`."""
+        u0c0 = np.zeros((bucket, fp.L), dtype=np.int64)
+        u0c1 = np.zeros((bucket, fp.L), dtype=np.int64)
+        u1c0 = np.zeros((bucket, fp.L), dtype=np.int64)
+        u1c1 = np.zeros((bucket, fp.L), dtype=np.int64)
+        for j, m in enumerate(msgs):
+            u0c0[j], u0c1[j], u1c0[j], u1c1[j] = self._u_draws(m)
+        return (u0c0, u0c1), (u1c0, u1c1)
+
+    def _h2c_dispatch(self, draws):
+        """ONE hash-to-curve device dispatch over precomputed draws."""
+        u0, u1 = draws
+        self.h2c_dispatch_count += 1
+        _M_H2C_DISPATCH.inc()
+        return V.staged_jits()["h2c"](u0, u1)
+
+    def _hm_host_plan(self, uniq_msgs: List[bytes], u_bucket: int):
+        """Host half of H(m) resolution — runs inside the host_prep
+        span: message digests, arena lookups, and the hash_to_field
+        draws for whatever still needs an h2c dispatch (so the SHA-256
+        and draw cost never pollutes the device_execute attribution).
+
+        The cache is bypassed when the batch carries more unique
+        messages than the whole arena holds: inserting more rows than
+        capacity would recycle slots assigned earlier in the same call
+        and serve the wrong point."""
+        cache = self._h2c_cache
+        if not cache.enabled or len(uniq_msgs) > cache.capacity:
+            return None, None, None, self._uniq_draws(uniq_msgs,
+                                                      u_bucket)
+        digests = [hashlib.sha256(m).digest() for m in uniq_msgs]
+        slots = np.zeros(u_bucket, dtype=np.int64)
+        missing = []
+        for j, dg in enumerate(digests):
+            slot = cache.lookup(dg)
+            if slot is None:
+                missing.append(j)
+            else:
+                slots[j] = slot
+        draws = None
+        if missing:
+            mb = max(_next_pow2(len(missing)), self._h2c_min_bucket)
+            draws = self._uniq_draws([uniq_msgs[j] for j in missing],
+                                     mb)
+        return slots, missing, digests, draws
+
+    def _hm_device(self, plan):
+        """Device half of H(m) resolution for a deduped batch.
+
+        Arena hits cost one gather; misses pay ONE h2c dispatch over
+        the missing-message bucket and land in the arena; a fully-warm
+        batch performs ZERO h2c dispatches.  Padding rows (>= the
+        unique count) carry arbitrary points — group_present masks
+        them downstream."""
+        slots, missing, digests, draws = plan
+        if slots is None:   # cache disabled/bypassed: plain unique h2c
+            return self._h2c_dispatch(draws)
+        if missing:
+            hm_bucket = self._h2c_dispatch(draws)
+            new_slots = self._h2c_cache.insert(
+                [digests[j] for j in missing], hm_bucket)
+            slots[np.asarray(missing)] = new_slots
+        return self._h2c_cache.gather(slots)
+
     def _dispatch(self, semis: List[_Semi], randomize: bool) -> bool:
+        return self._begin_dispatch(semis, randomize).result()
+
+    def _begin_dispatch(self, semis: List[_Semi],
+                        randomize: bool) -> "_DispatchHandle":
         # `bls.dispatch` fault site: the supervisor/breaker tests prove
         # hang/exception containment at the REAL device-dispatch seam
         faults.check("bls.dispatch")
@@ -371,25 +583,57 @@ class JaxBls12381(BLS12381):
             pk_xs = np.zeros((padded, kmax, fp.L), dtype=np.int64)
             pk_ys = np.zeros((padded, kmax, fp.L), dtype=np.int64)
             pk_present = np.zeros((padded, kmax), dtype=bool)
-            u0c0 = np.zeros((padded, fp.L), dtype=np.int64)
-            u0c1 = np.zeros((padded, fp.L), dtype=np.int64)
-            u1c0 = np.zeros((padded, fp.L), dtype=np.int64)
-            u1c1 = np.zeros((padded, fp.L), dtype=np.int64)
             sig_bytes = np.zeros((padded, 2, 48), dtype=np.uint8)
             s_large = np.zeros(padded, dtype=bool)
             s_inf = np.zeros(padded, dtype=bool)
             lane_valid = np.zeros(padded, dtype=bool)
+            # unique-message index + per-message lane groups: h2c AND
+            # the Miller loops run at unique width (stage_group folds a
+            # message's lanes into one pairing input via bilinearity);
+            # padding lanes keep index 0 — masked downstream
+            lane_map = np.zeros(padded, dtype=np.int32)
+            uniq_index: dict = {}
+            uniq_msgs: List[bytes] = []
+            groups: List[List[int]] = []
             for i, s in enumerate(semis):
                 for j, (x, y) in enumerate(s.pk_limbs):
                     pk_xs[i, j] = x
                     pk_ys[i, j] = y
                     pk_present[i, j] = True
-                u0c0[i], u0c1[i], u1c0[i], u1c1[i] = \
-                    self._u_draws(s.message)
+                u = uniq_index.get(s.message)
+                if u is None:
+                    u = uniq_index[s.message] = len(uniq_msgs)
+                    uniq_msgs.append(s.message)
+                    groups.append([])
+                groups[u].append(i)
+                lane_map[i] = u
                 sig_bytes[i] = s.sig_x_bytes
                 s_large[i] = s.sig_large
                 s_inf[i] = s.sig_inf
                 lane_valid[i] = True
+            # split committees larger than the group cap across rows:
+            # G stays bounded (the grouped gather materializes a
+            # (U, G) lane matrix) and a split message simply owns
+            # several Miller rows backed by the SAME H(m) point
+            cap = self._group_cap
+            rows: List[Tuple[int, List[int]]] = []
+            for u, g in enumerate(groups):
+                for off in range(0, len(g), cap):
+                    rows.append((u, g[off:off + cap]))
+            row_msgs = [uniq_msgs[u] for u, _ in rows]
+            # lane gather (sharded path) keys on hm ROWS: point every
+            # lane at the first row carrying its message's point
+            msg_to_row = np.zeros(len(uniq_msgs), dtype=np.int32)
+            for r in range(len(rows) - 1, -1, -1):
+                msg_to_row[rows[r][0]] = r
+            lane_map = msg_to_row[lane_map]
+            u_bucket = max(_next_pow2(len(rows)), self._h2c_min_bucket)
+            g_bucket = _next_pow2(max(len(g) for _, g in rows))
+            group_idx = np.zeros((u_bucket, g_bucket), dtype=np.int32)
+            group_present = np.zeros((u_bucket, g_bucket), dtype=bool)
+            for r, (_, g) in enumerate(rows):
+                group_idx[r, :len(g)] = g
+                group_present[r, :len(g)] = True
             sx1 = bytes_to_limbs_np(sig_bytes[:, 0])
             sx0 = bytes_to_limbs_np(sig_bytes[:, 1])
             if randomize:
@@ -403,6 +647,10 @@ class JaxBls12381(BLS12381):
             else:
                 rs = np.ones(padded, dtype=np.uint64)
             r_bits = np.asarray(PT.scalar_from_uint64(rs))
+            # H(m) host half (digests + cache lookups + field draws)
+            # belongs to host_prep; only the dispatch/gather below is
+            # device work
+            hm_plan = self._hm_host_plan(row_msgs, u_bucket)
         shape = f"{padded}x{kmax}"
         # the staged jits are module-level (shared across providers),
         # but a ShardedVerifier's jit cache is per-instance — key the
@@ -422,27 +670,34 @@ class JaxBls12381(BLS12381):
         # ratio high, never negative
         _M_LANES_PADDED.inc(padded)
         _M_LANES_REAL.inc(n)
+        _M_H2C_LANES.inc(n)
+        _M_H2C_UNIQUE.inc(len(uniq_msgs))
+        # device section: every launch below is async (XLA compiles
+        # synchronously on a first shape, then enqueues); the handle's
+        # result() forces the arrays and records the device span
+        traces = tracing.current_traces()
+        t_dev0 = time.perf_counter()
         outcome = "cache_hit"
         try:
-            with tracing.span("device_execute"):
-                if self._sharded is not None:
-                    ok, lane_ok = self._sharded(
-                        pk_xs, pk_ys, pk_present, (u0c0, u0c1),
-                        (u1c0, u1c1), (sx0, sx1), s_large, s_inf,
-                        r_bits, lane_valid)
-                else:
-                    ok, lane_ok = self._verify_jit(
-                        pk_xs, pk_ys, pk_present, (u0c0, u0c1),
-                        (u1c0, u1c1), (sx0, sx1), s_large, s_inf,
-                        r_bits, lane_valid)
-                # np.asarray forces the device round-trip, so the span
-                # covers execute-to-host-synchronized, not dispatch-only
-                lane_ok = np.asarray(lane_ok)
-                verdict = bool(np.asarray(ok)) and bool(lane_ok[:n].all())
+            hm_uniq = self._hm_device(hm_plan)
+            if self._sharded is not None:
+                # the sharded kernel is hm-INPUT (grouping by message
+                # would cross shard boundaries): scatter the unique
+                # points back into lanes with one gather
+                hm = V.staged_jits()["gather"](hm_uniq,
+                                               jnp.asarray(lane_map))
+                ok, lane_ok = self._sharded(
+                    pk_xs, pk_ys, pk_present, hm, (sx0, sx1),
+                    s_large, s_inf, r_bits, lane_valid)
+            else:
+                ok, lane_ok = V.verify_staged_grouped(
+                    pk_xs, pk_ys, pk_present, hm_uniq, group_idx,
+                    group_present, (sx0, sx1), s_large, s_inf,
+                    r_bits, lane_valid)
         finally:
             if first:
                 outcome = compilecache.classify_first_dispatch(
                     compilecache.delta(cache_before))
             _M_JIT.labels(shape=shape, outcome=outcome,
                           path=mont_path).inc()
-        return faults.transform("bls.dispatch", verdict)
+        return _DispatchHandle(ok, lane_ok, n, t_dev0, traces)

@@ -15,6 +15,16 @@ signature decompression + psi-endomorphism subgroup checks, hash-to-G2
 multiplications, the batched Miller loops, a log-depth product/point-sum
 reduction over the batch, and one shared final exponentiation.
 
+DEDUP-AWARE: committee-based consensus signs the same AttestationData
+across whole committees, so a gossip batch has far fewer UNIQUE
+messages than lanes.  The pipeline exploits this twice: hash-to-G2
+runs over the unique-message bucket only (stage_h2c + stage_gather_hm
+scatters the points back to lanes), and — since the pairing is
+bilinear in G1 — stage_group folds every message's r-weighted pubkeys
+into ONE Miller loop per unique (prod_i e([r_i]pk_i, H(m)) ==
+e(sum_i [r_i]pk_i, H(m))), collapsing the two dominant per-lane stages
+by the duplication factor with an unchanged verdict.
+
 Lanes carry masks instead of branches: padding lanes (valid=False)
 contribute the identity; infinity signatures contribute the infinity
 point exactly like the oracle (crypto/bls/pure_impl.py:205-214).
@@ -90,21 +100,27 @@ def _aggregate_lane_pks(pk_xs, pk_ys, pk_present):
     return pk_jac, PT.is_infinity(PT.G1_KIT, pk_jac)
 
 
-def _lane_work(pk_xs, pk_ys, pk_present, u0, u1, sig_x_plain, sig_large,
+def _lane_work(pk_xs, pk_ys, pk_present, hm_aff, sig_x_plain, sig_large,
                sig_inf, r_bits, lane_valid):
     """Per-lane pipeline (shardable over the batch axis with no
     communication), COMPOSED from the stage functions below so the
     monolithic/sharded kernels and the staged dispatch can never
     diverge.
 
+    Takes the per-lane H(m) AFFINE points (`hm_aff`), not the field
+    draws: hash-to-curve runs over the batch's UNIQUE messages upstream
+    (stage_h2c on a smaller bucket + stage_gather_hm, or the provider's
+    device-resident H(m) cache) — in committee-based consensus a batch
+    has far fewer distinct messages than lanes, and h2c is the largest
+    per-lane stage.
+
     Returns (ml (N-lane Fq12 values), wsig (N weighted sig points),
     lane_ok (N,))."""
     pk_jac, sig_jac, lane_ok, miller_mask = stage_prepare(
         pk_xs, pk_ys, pk_present, sig_x_plain, sig_large, sig_inf,
         lane_valid)
-    hm_aff = stage_h2c(u0, u1)
-    pk_r_aff, wsig = stage_scalars(pk_jac, sig_jac, r_bits)
-    ml = stage_miller(pk_r_aff, hm_aff, miller_mask)
+    pk_r_jac, wsig = stage_scalars(pk_jac, sig_jac, r_bits)
+    ml = stage_miller(stage_lane_affine(pk_r_jac), hm_aff, miller_mask)
     return ml, wsig, lane_ok
 
 
@@ -120,15 +136,21 @@ def _finish(ml_prod, s_sum):
     return PR.pairing_check(f)
 
 
-def verify_kernel(pk_xs, pk_ys, pk_present, u0, u1, sig_x_plain,
-                  sig_large, sig_inf, r_bits, lane_valid):
-    """The batched verification dispatch (single device).
+def verify_kernel(pk_xs, pk_ys, pk_present, u0, u1, group_idx,
+                  group_present, sig_x_plain, sig_large, sig_inf,
+                  r_bits, lane_valid):
+    """The batched verification dispatch (single device), dedup-aware.
 
     pk_xs/pk_ys: (N, K, L) Montgomery limbs — per-triple pubkeys, each
         already validated (subgroup, non-infinity) by the caller's
         cache, padded to K along axis 1; aggregation happens in-kernel.
+    u0/u1: Fq2 draws of the batch's UNIQUE messages' hash_to_field
+        (host SHA-256), padded to a pow-2 bucket U <= N — h2c runs at
+        unique width, not lane width.
+    group_idx/group_present: (U, G) lane indices/mask of each unique
+        message's lanes (stage_group: bilinearity folds those lanes
+        into one Miller loop per unique).
     pk_present: (N, K) — False for key-padding slots.
-    u0/u1: Fq2 draws of each message's hash_to_field (host SHA-256).
     sig_x_plain: ((N, L), (N, L)) plain-form Fq2 x of each signature;
     sig_large: (N,) wire sign bit; sig_inf: (N,) infinity-signature mask.
     r_bits: (N, 64) bits of the nonzero random multipliers, MSB first.
@@ -139,9 +161,14 @@ def verify_kernel(pk_xs, pk_ys, pk_present, u0, u1, sig_x_plain,
     checks or whose keys aggregated to infinity (the caller must AND
     `ok` with all valid lanes' lane_ok).
     """
-    ml, wsig, lane_ok = _lane_work(pk_xs, pk_ys, pk_present, u0, u1,
-                                   sig_x_plain, sig_large, sig_inf,
-                                   r_bits, lane_valid)
+    hm_uniq = stage_h2c(u0, u1)
+    pk_jac, sig_jac, lane_ok, miller_mask = stage_prepare(
+        pk_xs, pk_ys, pk_present, sig_x_plain, sig_large, sig_inf,
+        lane_valid)
+    pk_r_jac, wsig = stage_scalars(pk_jac, sig_jac, r_bits)
+    agg_aff, u_mask = stage_group(pk_r_jac, miller_mask, group_idx,
+                                  group_present)
+    ml = stage_miller(agg_aff, hm_uniq, u_mask)
     ok = _finish(PR.batch_product(ml), point_batch_sum(PT.G2_KIT, wsig))
     return ok, lane_ok
 
@@ -168,19 +195,72 @@ def stage_prepare(pk_xs, pk_ys, pk_present, sig_x_plain, sig_large,
 
 
 def stage_h2c(u0, u1):
-    """Hash-to-G2 map + cofactor clearing + batched affine."""
+    """Hash-to-G2 map + cofactor clearing + batched affine.
+
+    Runs over the UNIQUE-message bucket, not lanes: callers dedup the
+    batch's messages, dispatch this at the (smaller, pow-2) unique
+    width, and scatter the mapped points back with stage_gather_hm."""
     return h2c.to_affine_g2(h2c.hash_to_g2_device(u0, u1))
 
 
+def stage_gather_hm(hm_uniq, lane_map):
+    """Scatter the unique-message H(m) points back into lanes: one
+    device gather of the affine coordinate arrays along the unique
+    axis.  `lane_map` is the (N,) unique index of each lane's message
+    (padding lanes may carry any in-range index — downstream masks,
+    not the gathered point, decide their contribution)."""
+    return jax.tree_util.tree_map(lambda x: x[lane_map], hm_uniq)
+
+
 def stage_scalars(pk_jac, sig_jac, r_bits):
-    """Random-multiplier scalar muls + batched G1 affine."""
-    pk_r_aff = to_affine_g1(PT.scalar_mul_bits(PT.G1_KIT, r_bits, pk_jac))
+    """Random-multiplier scalar muls (Jacobian G1 out — the affine
+    conversion happens per-lane in stage_lane_affine or per-UNIQUE in
+    stage_group, whichever path runs)."""
+    pk_r_jac = PT.scalar_mul_bits(PT.G1_KIT, r_bits, pk_jac)
     wsig = PT.scalar_mul_bits(PT.G2_KIT, r_bits, sig_jac)
-    return pk_r_aff, wsig
+    return pk_r_jac, wsig
+
+
+def stage_lane_affine(pk_r_jac):
+    """Per-lane batched G1 affine (the non-grouped pipeline)."""
+    return to_affine_g1(pk_r_jac)
+
+
+def stage_group(pk_r_jac, miller_mask, group_idx, group_present):
+    """Fold each unique message's lanes into ONE pairing input.
+
+    The pairing is bilinear in its G1 argument, so lanes sharing H(m)
+    satisfy prod_i e([r_i]pk_i, H(m)) == e(sum_i [r_i]pk_i, H(m)): the
+    per-lane Miller loops of a committee-duplicated batch collapse to
+    one loop per UNIQUE message.  Masked lanes (padding/invalid) enter
+    the sum as infinity — exactly the identity contribution the
+    per-lane mask gave them — and a unique whose aggregate is infinity
+    is masked out of the Miller stage (e(infinity, Q) == 1).
+
+    group_idx: (U, G) lane indices of each unique's lanes (padded rows
+    arbitrary); group_present: (U, G) False for group padding.
+    Returns ((x, y) affine aggregates (U, L), u_mask (U,))."""
+    inf = PT.infinity_like(PT.G1_KIT, pk_r_jac[0])
+    masked = PT._select_point(PT.G1_KIT, miller_mask, pk_r_jac, inf)
+    grouped = jax.tree_util.tree_map(lambda x: x[group_idx], masked)
+    inf_g = PT.infinity_like(PT.G1_KIT, grouped[0])
+    grouped = PT._select_point(PT.G1_KIT, group_present, grouped, inf_g)
+    if group_idx.shape[1] == 1:
+        agg = jax.tree_util.tree_map(lambda x: x[:, 0], grouped)
+    else:
+        gmoved = jax.tree_util.tree_map(
+            lambda x: jnp.moveaxis(x, 1, 0), grouped)   # (G, U, L)
+        agg = point_batch_sum(PT.G1_KIT, gmoved)
+    u_mask = ~PT.is_infinity(PT.G1_KIT, agg)
+    # affine conversion now costs ONE batched inversion at unique
+    # width, not lane width (infinity aggregates give garbage coords —
+    # u_mask carries them out of the Miller loop)
+    return to_affine_g1(agg), u_mask
 
 
 def stage_miller(pk_r_aff, hm_aff, mask):
-    """Per-lane Miller loops."""
+    """Miller loops — width-polymorphic: per-lane inputs on the
+    hm-gather path, per-unique aggregates on the grouped path."""
     return PR.miller_loop(pk_r_aff, hm_aff, mask=mask)
 
 
@@ -201,38 +281,81 @@ def staged_jits():
                 _STAGED_JITS = {
                     "prepare": jax.jit(stage_prepare),
                     "h2c": jax.jit(stage_h2c),
+                    "gather": jax.jit(stage_gather_hm),
                     "scalars": jax.jit(stage_scalars),
+                    "affine": jax.jit(stage_lane_affine),
+                    "group": jax.jit(stage_group),
                     "miller": jax.jit(stage_miller),
                     "finish": jax.jit(stage_finish),
                 }
     return _STAGED_JITS
 
 
-def verify_staged(pk_xs, pk_ys, pk_present, u0, u1, sig_x_plain,
-                  sig_large, sig_inf, r_bits, lane_valid,
-                  on_stage=None):
-    """Same contract as verify_kernel, via the five staged programs.
-    `on_stage(name, seconds)` reports per-stage wall time (bench)."""
+def _stage_runner(on_stage):
     import time
     jits = staged_jits()
 
-    def run(name, fn, *args):
+    def run(name, *args):
         t0 = time.time()
-        out = fn(*args)
+        out = jits[name](*args)
         if on_stage is not None:
             jax.block_until_ready(out)
             on_stage(name, time.time() - t0)
         return out
 
+    return run
+
+
+def verify_staged_hm(pk_xs, pk_ys, pk_present, hm_aff, sig_x_plain,
+                     sig_large, sig_inf, r_bits, lane_valid,
+                     on_stage=None):
+    """The staged PER-LANE pipeline downstream of hash-to-curve:
+    per-lane H(m) affine points in (the provider's H(m) cache or
+    stage_h2c + stage_gather_hm supplies them), verdict out.  This is
+    the parity surface for the grouped path and the composition the
+    sharded kernel uses.  `on_stage(name, seconds)` reports per-stage
+    wall time (bench)."""
+    run = _stage_runner(on_stage)
     pk_jac, sig_jac, lane_ok, miller_mask = run(
-        "prepare", jits["prepare"], pk_xs, pk_ys, pk_present,
-        sig_x_plain, sig_large, sig_inf, lane_valid)
-    hm_aff = run("h2c", jits["h2c"], u0, u1)
-    pk_r_aff, wsig = run("scalars", jits["scalars"], pk_jac, sig_jac,
-                         r_bits)
-    ml = run("miller", jits["miller"], pk_r_aff, hm_aff, miller_mask)
-    ok = run("finish", jits["finish"], ml, wsig)
+        "prepare", pk_xs, pk_ys, pk_present, sig_x_plain, sig_large,
+        sig_inf, lane_valid)
+    pk_r_jac, wsig = run("scalars", pk_jac, sig_jac, r_bits)
+    pk_r_aff = run("affine", pk_r_jac)
+    ml = run("miller", pk_r_aff, hm_aff, miller_mask)
+    ok = run("finish", ml, wsig)
     return ok, lane_ok
+
+
+def verify_staged_grouped(pk_xs, pk_ys, pk_present, hm_uniq, group_idx,
+                          group_present, sig_x_plain, sig_large,
+                          sig_inf, r_bits, lane_valid, on_stage=None):
+    """The staged GROUPED pipeline: unique-width H(m) points in (from
+    stage_h2c over uniques or the device H(m) cache), per-message
+    pubkey aggregation via stage_group, Miller loops at UNIQUE width."""
+    run = _stage_runner(on_stage)
+    pk_jac, sig_jac, lane_ok, miller_mask = run(
+        "prepare", pk_xs, pk_ys, pk_present, sig_x_plain, sig_large,
+        sig_inf, lane_valid)
+    pk_r_jac, wsig = run("scalars", pk_jac, sig_jac, r_bits)
+    agg_aff, u_mask = run("group", pk_r_jac, miller_mask, group_idx,
+                          group_present)
+    ml = run("miller", agg_aff, hm_uniq, u_mask)
+    ok = run("finish", ml, wsig)
+    return ok, lane_ok
+
+
+def verify_staged(pk_xs, pk_ys, pk_present, u0, u1, group_idx,
+                  group_present, sig_x_plain, sig_large, sig_inf,
+                  r_bits, lane_valid, on_stage=None):
+    """Same contract as verify_kernel (unique-message draws + group
+    index), via the staged programs.  `on_stage(name, seconds)` reports
+    per-stage wall time (bench)."""
+    run = _stage_runner(on_stage)
+    hm_uniq = run("h2c", u0, u1)
+    return verify_staged_grouped(pk_xs, pk_ys, pk_present, hm_uniq,
+                                 group_idx, group_present, sig_x_plain,
+                                 sig_large, sig_inf, r_bits, lane_valid,
+                                 on_stage=on_stage)
 
 
 def verify_kernel_sharded(mesh, axis: str = "dp"):
@@ -240,7 +363,13 @@ def verify_kernel_sharded(mesh, axis: str = "dp"):
     reductions, then an all_gather of one Fq12 value + one G2 point per
     device rides the ICI; the final exponentiation is replicated.
 
-    Returns a function with the same signature/result as verify_kernel
+    hm-INPUT contract: the caller supplies per-lane H(m) affine points
+    (hash-to-curve over unique messages is a global operation — the
+    provider runs it once, cache-aware, before sharding lanes), so the
+    shard function's inputs are all lane-sharded.
+
+    Returns a function taking (pk_xs, pk_ys, pk_present, hm, sig_x,
+    sig_large, sig_inf, r_bits, lane_valid) with verify_kernel's result
     (to be called with GLOBAL batch arrays; N must divide the mesh size).
     """
     from jax.experimental.shard_map import shard_map
@@ -250,9 +379,9 @@ def verify_kernel_sharded(mesh, axis: str = "dp"):
     lane2 = P(axis, None)       # (N, L) and (N, 64)
     lane3 = P(axis, None, None)  # (N, K, L)
 
-    def shard_fn(pk_xs, pk_ys, pk_present, u0, u1, sig_x, sig_large,
+    def shard_fn(pk_xs, pk_ys, pk_present, hm, sig_x, sig_large,
                  sig_inf, r_bits, lane_valid):
-        ml, wsig, lane_ok = _lane_work(pk_xs, pk_ys, pk_present, u0, u1,
+        ml, wsig, lane_ok = _lane_work(pk_xs, pk_ys, pk_present, hm,
                                        sig_x, sig_large, sig_inf, r_bits,
                                        lane_valid)
         local_prod = PR.batch_product(ml)
@@ -267,7 +396,8 @@ def verify_kernel_sharded(mesh, axis: str = "dp"):
         ok = _finish(total_prod, total_sum)
         return ok, lane_ok
 
-    in_specs = (lane3, lane3, lane2, (lane2, lane2), (lane2, lane2),
+    in_specs = (lane3, lane3, lane2,
+                ((lane2, lane2), (lane2, lane2)),   # hm affine x, y
                 (lane2, lane2), lane, lane, lane2, lane)
     out_specs = (P(), lane)
     return shard_map(shard_fn, mesh=mesh, in_specs=in_specs,

@@ -41,8 +41,12 @@ def make_mesh(n_devices: Optional[int] = None,
 
 
 def sharded_verify_fn(mesh: Mesh, axis: str = DEFAULT_AXIS):
-    """Jitted sharded batch-verification kernel over `mesh` (same
-    contract as ops/verify.verify_kernel; N must divide mesh size)."""
+    """Jitted sharded batch-verification kernel over `mesh`.
+
+    hm-INPUT contract (ops/verify.verify_kernel_sharded): callers
+    supply per-lane H(m) affine points — the provider computes them
+    once over the batch's unique messages (H(m) cache-aware) and
+    scatters them to lanes before sharding; N must divide mesh size."""
     from ..ops import verify as V
     return jax.jit(V.verify_kernel_sharded(mesh, axis))
 

@@ -21,13 +21,24 @@ waiting to fill a batch, which is throughput-friendly but latency-naive;
 here a worker takes whatever is queued the moment it goes idle (the
 dispatch itself provides natural batching back-pressure), optimizing the
 attestation-gossip p50 the north star measures.
+
+Two dedup/overlap layers on top of the reference semantics:
+
+- identical in-flight triples coalesce — gossip re-delivers the same
+  (pks, msg, sig); duplicates ride the already-pending task and the
+  verdict fans out to every waiter (``*_coalesced_total``);
+- async overlap — when the BLS implementation exposes the async begin
+  seam (bls.begin_batch_verify), a worker host_preps + enqueues batch
+  N+1 while batch N executes on device, synchronizing only at verdict
+  read (``TEKU_TPU_ASYNC_OVERLAP=0`` disables).
 """
 
 import asyncio
 import logging
+import os
 import time
 from dataclasses import dataclass, field
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..crypto import bls
 from ..infra import faults, flightrecorder, tracing
@@ -37,6 +48,19 @@ from ..infra.metrics import (GLOBAL_REGISTRY, LATENCY_BUCKETS_S,
 Triple = Tuple[Sequence[bytes], bytes, bytes]
 
 _LOG = logging.getLogger(__name__)
+
+# Overlap host_prep of batch N+1 with device_execute of batch N: the
+# worker begins (host_prep + async device enqueue) the next batch
+# BEFORE synchronizing the previous one — JAX async dispatch keeps the
+# device busy while the host packs arrays.  Engages only when the
+# active BLS implementation exposes an async begin (the raw JAX
+# provider; breaker-guarded backends stay sync — the breaker owns its
+# dispatch deadline).  TEKU_TPU_ASYNC_OVERLAP=0 disables.
+ENV_OVERLAP = "TEKU_TPU_ASYNC_OVERLAP"
+
+
+def _overlap_default() -> bool:
+    return os.environ.get(ENV_OVERLAP, "1") not in ("0", "off", "false")
 
 
 class ServiceCapacityExceededError(Exception):
@@ -52,6 +76,23 @@ class _Task:
     # stages to the trace that is awaiting this task's future
     t_enqueue: float = 0.0
     trace: Optional[tracing.Trace] = field(default=None, repr=False)
+    # in-flight dedup: gossip re-delivers the same (pks, msg, sig) —
+    # identical pending triples coalesce onto ONE queued task, and the
+    # verdict fans out to every waiter future
+    key: Optional[tuple] = None
+    waiters: List[asyncio.Future] = field(default_factory=list,
+                                          repr=False)
+
+    def settle(self, result: Optional[bool] = None,
+               exc: Optional[BaseException] = None) -> None:
+        """Resolve the primary future AND every coalesced waiter."""
+        for fut in (self.future, *self.waiters):
+            if fut.done():
+                continue
+            if exc is not None:
+                fut.set_exception(exc)
+            else:
+                fut.set_result(result)
 
 
 class AggregatingSignatureVerificationService:
@@ -60,11 +101,13 @@ class AggregatingSignatureVerificationService:
     def __init__(self, num_workers: int = 2, queue_capacity: int = 15_000,
                  max_batch_size: int = 250, split_threshold: int = 25,
                  registry: MetricsRegistry = GLOBAL_REGISTRY,
-                 name: str = "signature_verifications"):
+                 name: str = "signature_verifications",
+                 overlap: Optional[bool] = None):
         if num_workers < 1:
             raise ValueError("need at least one worker")
         self.num_workers = num_workers
         self._name = name
+        self.overlap = _overlap_default() if overlap is None else overlap
         self.queue_capacity = queue_capacity
         self.max_batch_size = max_batch_size
         self.split_threshold = split_threshold
@@ -104,6 +147,15 @@ class AggregatingSignatureVerificationService:
         self._m_rejected = registry.counter(
             f"{name}_rejected_total",
             "tasks shed because the queue was at capacity")
+        # gossip re-delivery dedup: each coalesced submission rode an
+        # already-pending identical task instead of a fresh lane
+        self._m_coalesced = registry.counter(
+            f"{name}_coalesced_total",
+            "duplicate in-flight submissions coalesced onto a pending "
+            "identical task")
+        # identical-triples key -> the pending task carrying it (entries
+        # removed when the task settles; all on the event loop, no lock)
+        self._pending: Dict[tuple, _Task] = {}
         # (queue saturation is served by health_snapshot() / the
         # readiness endpoint, not a supplier gauge: get_or_create would
         # pin the family to the FIRST service instance's closure)
@@ -139,8 +191,10 @@ class AggregatingSignatureVerificationService:
                 task = self._queue.get_nowait()
             except asyncio.QueueEmpty:
                 break
-            if not task.future.done():
-                task.future.cancel()
+            for fut in (task.future, *task.waiters):
+                if not fut.done():
+                    fut.cancel()
+        self._pending.clear()
 
     # ------------------------------------------------------------------
     def verify(self, public_keys: Sequence[bytes], message: bytes,
@@ -148,20 +202,37 @@ class AggregatingSignatureVerificationService:
         """Queue one fast-aggregate triple; resolves with the verdict."""
         return self.verify_multi([(public_keys, message, signature)])
 
+    @staticmethod
+    def _task_key(triples: Sequence[Triple]) -> tuple:
+        return tuple((tuple(pks), msg, sig) for pks, msg, sig in triples)
+
     def verify_multi(self, triples: Sequence[Triple]
                      ) -> "asyncio.Future[bool]":
         """Queue several triples as ONE atomic task (e.g. the three
-        signatures of a SignedAggregateAndProof verify together)."""
+        signatures of a SignedAggregateAndProof verify together).
+
+        Identical in-flight submissions coalesce: gossip re-delivers
+        the same (pks, msg, sig), and re-verifying a triple that is
+        already pending wastes a lane — the duplicate rides the pending
+        task and its future resolves with the same verdict."""
         if not self._started or self._stopped:
             raise RuntimeError("service not running")
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        key = self._task_key(triples)
+        pending = self._pending.get(key)
+        if pending is not None and not pending.future.cancelled():
+            pending.waiters.append(fut)
+            self._m_coalesced.inc()
+            return fut
         try:
             # `sigservice.enqueue` fault site: Overflow injection proves
             # the shed path (metrics + WARN) without a 15k-deep queue
             faults.check("sigservice.enqueue")
-            self._queue.put_nowait(_Task(
+            task = _Task(
                 list(triples), fut, t_enqueue=time.perf_counter(),
-                trace=tracing.current_trace()))
+                trace=tracing.current_trace(), key=key)
+            self._queue.put_nowait(task)
+            self._pending[key] = task
         except asyncio.QueueFull:
             self._m_rejected.inc()
             flightrecorder.record(
@@ -193,47 +264,146 @@ class AggregatingSignatureVerificationService:
 
     # ------------------------------------------------------------------
     async def _worker(self) -> None:
-        while not self._stopped:
-            first = await self._queue.get()
-            self._last_worker_progress = time.monotonic()
-            t_first = time.perf_counter()
-            tasks = [first]
-            budget = self.max_batch_size - len(first.triples)
-            while budget > 0:
-                try:
-                    nxt = self._queue.get_nowait()
-                except asyncio.QueueEmpty:
-                    break
-                tasks.append(nxt)
-                budget -= len(nxt.triples)
-            t_assembled = time.perf_counter()
-            if tracing.enabled():
-                # per-task attribution: each task experienced its own
-                # queue-wait and the whole batch's assembly time
-                assembly = t_assembled - t_first
-                for t in tasks:
-                    trs = (t.trace,) if t.trace is not None else ()
-                    tracing.record_stage(
-                        "queue_wait", t_first - t.t_enqueue, trs)
-                    tracing.record_stage("assembly", assembly, trs)
-            try:
-                await self._verify_batch(tasks)
-            except asyncio.CancelledError:
-                raise
-            except Exception as exc:  # provider/JAX runtime error
-                # The worker must survive (the reference at least logs
-                # worker death, doStart .finish(err -> LOG.error)); fail
-                # the affected futures so callers never await forever.
-                _LOG.exception("signature batch verification failed")
-                for t in tasks:
-                    if not t.future.done():
-                        t.future.set_exception(exc)
-            finally:
+        # At most ONE in-flight async dispatch per worker: batch N
+        # executes on device while this loop assembles and host_preps
+        # batch N+1 (bls.begin_batch_verify), then retires N.  The
+        # overlap only defers the SYNC, so when the queue is empty the
+        # in-flight batch retires immediately — no added latency.
+        inflight: Optional[tuple] = None
+        try:
+            while not self._stopped:
+                if inflight is not None:
+                    try:
+                        first = self._queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        prev, inflight = inflight, None
+                        await self._retire(*prev)
+                        continue
+                else:
+                    first = await self._queue.get()
                 self._last_worker_progress = time.monotonic()
+                tasks = self._drop_cancelled(self._assemble(first))
+                if not tasks:
+                    continue
+                try:
+                    handle = t0 = None
+                    if self.overlap and bls.supports_async_verify():
+                        handle, t0 = await self._begin(tasks)
+                    if handle is None:
+                        # sync path: implementation has no async seam
+                        if inflight is not None:
+                            prev, inflight = inflight, None
+                            await self._retire(*prev)
+                        await self._verify_batch(tasks)
+                    else:
+                        prev, inflight = inflight, (tasks, handle, t0)
+                        if prev is not None:
+                            await self._retire(*prev)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:  # provider/JAX runtime error
+                    # The worker must survive (the reference at least
+                    # logs worker death, doStart .finish(err ->
+                    # LOG.error)); fail the affected futures so callers
+                    # never await forever.
+                    _LOG.exception("signature batch verification failed")
+                    for t in tasks:
+                        self._drop_pending(t)
+                        t.settle(exc=exc)
+                finally:
+                    self._last_worker_progress = time.monotonic()
+        finally:
+            # shutdown/cancellation with a batch still in flight: never
+            # leave its callers awaiting forever
+            if inflight is not None:
+                for t in inflight[0]:
+                    self._drop_pending(t)
+                    for fut in (t.future, *t.waiters):
+                        if not fut.done():
+                            fut.cancel()
+
+    def _assemble(self, first: _Task) -> List[_Task]:
+        """Drain up to max_batch_size triples into one batch + stamp
+        queue-wait/assembly attribution."""
+        t_first = time.perf_counter()
+        tasks = [first]
+        budget = self.max_batch_size - len(first.triples)
+        while budget > 0:
+            try:
+                nxt = self._queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            tasks.append(nxt)
+            budget -= len(nxt.triples)
+        if tracing.enabled():
+            # per-task attribution: each task experienced its own
+            # queue-wait and the whole batch's assembly time
+            assembly = time.perf_counter() - t_first
+            for t in tasks:
+                trs = (t.trace,) if t.trace is not None else ()
+                tracing.record_stage(
+                    "queue_wait", t_first - t.t_enqueue, trs)
+                tracing.record_stage("assembly", assembly, trs)
+        return tasks
+
+    async def _begin(self, tasks: List[_Task]):
+        """Async-dispatch a batch: host_prep + device enqueue on a
+        worker thread.  Returns (handle, t0); handle is None when the
+        active implementation has no async path."""
+        triples = [tr for t in tasks for tr in t.triples]
+        t0 = time.perf_counter()
+        with tracing.attach([t.trace for t in tasks]):
+            with tracing.span("dispatch"):
+                handle = await asyncio.to_thread(
+                    bls.begin_batch_verify, triples)
+        if handle is None:
+            return None, t0
+        self._m_batches.inc()
+        self._m_batch_size.observe(len(triples))
+        self._m_dispatches.labels(kind="first_try").inc()
+        return handle, t0
+
+    async def _retire(self, tasks: List[_Task], handle, t0) -> None:
+        """Synchronize an in-flight dispatch and settle its tasks
+        (bisecting failures through the sync path)."""
+        try:
+            # the handle records the device_execute span itself (it
+            # captured the batch's traces at dispatch time)
+            ok = await asyncio.to_thread(handle.result)
+        except asyncio.CancelledError:
+            raise
+        except Exception as exc:
+            _LOG.exception("signature batch verification failed")
+            for t in tasks:
+                self._drop_pending(t)
+                t.settle(exc=exc)
+            return
+        self._m_batch_duration.observe(time.perf_counter() - t0)
+        await self._resolve_batch(tasks, ok)
+
+    def _drop_cancelled(self, tasks: List[_Task]) -> List[_Task]:
+        """Filter cancelled tasks, releasing their pending-map entries.
+
+        A cancelled PRIMARY with live coalesced waiters does not kill
+        the task: the waiters' callers still want the verdict (only the
+        original submitter bailed), so the first live waiter is
+        promoted to primary and the task verifies normally."""
+        live = []
+        for t in tasks:
+            if t.future.cancelled():
+                survivors = [f for f in t.waiters if not f.done()]
+                if survivors:
+                    t.future, t.waiters = survivors[0], survivors[1:]
+                    live.append(t)
+                    continue
+                self._drop_pending(t)
+            else:
+                live.append(t)
+        return live
 
     async def _verify_batch(self, tasks: List[_Task],
                             first_try: bool = True) -> None:
-        tasks = [t for t in tasks if not t.future.cancelled()]
+        tasks = self._drop_cancelled(tasks)
         if not tasks:
             return
         triples = [tr for t in tasks for tr in t.triples]
@@ -249,6 +419,11 @@ class AggregatingSignatureVerificationService:
             with tracing.span("dispatch"):
                 ok = await asyncio.to_thread(bls.batch_verify, triples)
         self._m_batch_duration.observe(time.perf_counter() - t0)
+        await self._resolve_batch(tasks, ok)
+
+    async def _resolve_batch(self, tasks: List[_Task], ok: bool) -> None:
+        """Post-dispatch settlement: complete on success, bisect on
+        failure (shared by the sync and the async-overlap paths)."""
         if ok:
             for t in tasks:
                 self._complete(t, True)
@@ -264,7 +439,11 @@ class AggregatingSignatureVerificationService:
             for t in tasks:
                 await self._verify_batch([t], first_try=False)
 
+    def _drop_pending(self, task: _Task) -> None:
+        if task.key is not None and self._pending.get(task.key) is task:
+            del self._pending[task.key]
+
     def _complete(self, task: _Task, result: bool) -> None:
         self._m_tasks.inc()
-        if not task.future.done():
-            task.future.set_result(result)
+        self._drop_pending(task)
+        task.settle(result)

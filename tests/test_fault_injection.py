@@ -246,11 +246,14 @@ def test_real_queue_overflow_also_counted():
         reg = MetricsRegistry()
         svc = make_service(num_workers=1, queue_capacity=2, registry=reg)
         await svc.start()
-        sig = bls.sign(SKS[0], b"ovf")
-        futs = [svc.verify([PKS[0]], b"ovf", sig) for _ in range(2)]
+        # distinct messages: identical pending triples would coalesce
+        # onto one queued task instead of filling the queue
+        msgs = [b"ovf-%d" % i for i in range(52)]
+        sigs = [bls.sign(SKS[0], m) for m in msgs]
+        futs = [svc.verify([PKS[0]], msgs[i], sigs[i]) for i in range(2)]
         with pytest.raises(ServiceCapacityExceededError):
-            for _ in range(50):
-                futs.append(svc.verify([PKS[0]], b"ovf", sig))
+            for i in range(2, 52):
+                futs.append(svc.verify([PKS[0]], msgs[i], sigs[i]))
         await asyncio.gather(*futs)
         await svc.stop()
         assert reg.counter(

@@ -287,6 +287,54 @@ def test_dispatch_and_cache_label_contract():
     assert pv  # imported above; JaxBls12381 instances carry .mont_path
 
 
+def test_h2c_dedup_and_coalesce_family_naming_lint():
+    """The PR-5 dedup/cache/coalesce families must not drift: hit/miss/
+    evict/dispatch counters end ``_total``, the dedup gauge is a
+    unitless ``_ratio``, the shared eviction family is labeled by
+    cache, and the service coalesce counter follows the service's
+    ``<name>_*_total`` convention."""
+    import teku_tpu.ops.h2c_cache  # noqa: F401 - registers families
+    import teku_tpu.ops.provider  # noqa: F401
+    from teku_tpu.infra.metrics import GLOBAL_REGISTRY
+    from teku_tpu.services.signatures import (
+        AggregatingSignatureVerificationService)
+
+    # instantiating registers the per-service families (idempotent)
+    reg = MetricsRegistry()
+    AggregatingSignatureVerificationService(registry=reg)
+    assert isinstance(
+        reg.metrics()["signature_verifications_coalesced_total"],
+        Counter)
+
+    metrics = GLOBAL_REGISTRY.metrics()
+    assert {"bls_h2c_cache_hits_total", "bls_h2c_cache_misses_total",
+            "bls_cache_evictions_total", "bls_h2c_dispatch_total",
+            "bls_h2c_lanes_total", "bls_h2c_unique_total",
+            "bls_h2c_dedup_ratio"} <= set(metrics)
+    evict = metrics["bls_cache_evictions_total"]
+    assert isinstance(evict, LabeledCounter)
+    assert tuple(evict.labelnames) == ("cache",)
+    assert isinstance(metrics["bls_h2c_dedup_ratio"], Gauge)
+    problems = []
+    for name, m in metrics.items():
+        if not name.startswith(("bls_h2c_", "bls_cache_")):
+            continue
+        if isinstance(m, (Counter, LabeledCounter)) \
+                and not name.endswith("_total"):
+            problems.append(f"counter {name} must end _total")
+        if name.endswith("_total") \
+                and not isinstance(m, (Counter, LabeledCounter)):
+            problems.append(f"{name} ends _total but is not a counter")
+        if isinstance(m, Gauge) and not name.endswith(_UNIT_SUFFIXES):
+            problems.append(
+                f"gauge {name} needs a unit suffix (_ratio for the "
+                "dedup/waste observables)")
+    assert not problems, "\n".join(problems)
+    # dedup ratio stays in [0, 1): lanes >= uniques by construction
+    from teku_tpu.ops.provider import _dedup_ratio
+    assert 0.0 <= _dedup_ratio() < 1.0
+
+
 def test_slo_health_family_naming_lint():
     """The PR-3 families must not drift from the conventions: states as
     labeled/state gauges (never bare numbers encoding an enum), burn

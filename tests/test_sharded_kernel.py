@@ -25,7 +25,7 @@ def mesh():
 
 
 def test_sharded_kernel_valid_batch(mesh):
-    args = ge._example_batch(8)
+    args = ge._example_batch_hm(8)
     sharded = jax.jit(V.verify_kernel_sharded(mesh, "dp"))
     ok, lane_ok = sharded(*args)
     assert bool(np.asarray(ok))
@@ -33,15 +33,17 @@ def test_sharded_kernel_valid_batch(mesh):
 
 
 def test_sharded_kernel_rejects_tampered_lane(mesh):
-    args = ge._example_batch(8)
-    # corrupt one lane's message draws: the whole-batch verdict must flip
-    (pk_xs, pk_ys, pk_present, u0, u1, sig_x, s_large, s_inf,
+    args = ge._example_batch_hm(8)
+    # corrupt one lane's H(m) point: the whole-batch verdict must flip
+    (pk_xs, pk_ys, pk_present, hm, sig_x, s_large, s_inf,
      r_bits, lane_valid) = args
-    u0 = (u0[0].copy(), u0[1].copy())
-    u0[0][3] = u0[0][4]
-    u0[1][3] = u0[1][4]
+    (hx0, hx1), (hy0, hy1) = hm
+    hx0, hx1 = hx0.copy(), hx1.copy()
+    hx0[3] = hx0[4]
+    hx1[3] = hx1[4]
+    hm = ((hx0, hx1), (hy0, hy1))
     sharded = jax.jit(V.verify_kernel_sharded(mesh, "dp"))
-    ok, lane_ok = sharded(pk_xs, pk_ys, pk_present, u0, u1, sig_x,
+    ok, lane_ok = sharded(pk_xs, pk_ys, pk_present, hm, sig_x,
                           s_large, s_inf, r_bits, lane_valid)
     assert not bool(np.asarray(ok))
     # the lanes themselves parse fine (failure is the pairing verdict)

@@ -99,15 +99,179 @@ def test_queue_overflow():
     async def main():
         svc = make_service(num_workers=1, queue_capacity=2)
         await svc.start()
-        msg = b"overflow"
-        sig = bls.sign(SKS[0], msg)
-        # stall the worker by flooding faster than it can drain
-        futs = [svc.verify([PKS[0]], msg, sig) for _ in range(2)]
+        # DISTINCT messages: identical pending triples would coalesce
+        # onto one queued task and never overflow the queue
+        msgs = [b"overflow-%d" % i for i in range(52)]
+        sigs = [bls.sign(SKS[0], m) for m in msgs]
+        futs = [svc.verify([PKS[0]], msgs[i], sigs[i]) for i in range(2)]
         with pytest.raises(ServiceCapacityExceededError):
-            for _ in range(50):
-                futs.append(svc.verify([PKS[0]], msg, sig))
+            for i in range(2, 52):
+                futs.append(svc.verify([PKS[0]], msgs[i], sigs[i]))
         await asyncio.gather(*futs)
         await svc.stop()
+    run(main())
+
+
+def test_identical_inflight_triples_coalesce():
+    async def main():
+        reg = MetricsRegistry()
+        svc = make_service(num_workers=1, registry=reg)
+        await svc.start()
+        msg = b"coalesce"
+        sig = bls.sign(SKS[0], msg)
+        bad_sig = bls.sign(SKS[0], b"wrong message")
+        # gossip re-delivery: the same triple submitted 5x while queued
+        futs = [svc.verify([PKS[0]], msg, sig) for _ in range(5)]
+        bad = [svc.verify([PKS[0]], msg, bad_sig) for _ in range(3)]
+        results = await asyncio.gather(*futs)
+        bad_results = await asyncio.gather(*bad)
+        assert results == [True] * 5       # verdict fans out to waiters
+        assert bad_results == [False] * 3
+        coalesced = reg.counter(
+            "signature_verifications_coalesced_total").value
+        assert coalesced == 4 + 2
+        # pending map drains once verdicts land
+        assert not svc._pending
+        # a RE-submission after completion is a fresh task (the dedup
+        # is in-flight only — a later identical request must re-verify)
+        assert await svc.verify([PKS[0]], msg, sig) is True
+        await svc.stop()
+    run(main())
+
+
+def test_multi_triple_tasks_coalesce_by_full_key():
+    async def main():
+        reg = MetricsRegistry()
+        svc = make_service(num_workers=1, registry=reg)
+        await svc.start()
+        m1, m2 = b"agg-1", b"agg-2"
+        task = [([PKS[0]], m1, bls.sign(SKS[0], m1)),
+                ([PKS[1]], m2, bls.sign(SKS[1], m2))]
+        f1 = svc.verify_multi(task)
+        f2 = svc.verify_multi(list(task))      # identical -> coalesces
+        f3 = svc.verify_multi(task[:1])        # different key: own task
+        assert await asyncio.gather(f1, f2, f3) == [True, True, True]
+        assert reg.counter(
+            "signature_verifications_coalesced_total").value == 1
+        await svc.stop()
+    run(main())
+
+
+def test_cancelled_primary_promotes_live_waiter():
+    async def main():
+        svc = make_service(num_workers=1)
+        await svc.start()
+        msg = b"promote"
+        sig = bls.sign(SKS[0], msg)
+        f1 = svc.verify([PKS[0]], msg, sig)
+        f2 = svc.verify([PKS[0]], msg, sig)  # coalesce onto f1's task
+        f3 = svc.verify([PKS[0]], msg, sig)
+        # the original submitter bails while the task is still queued:
+        # the waiters' callers still want the verdict — the first live
+        # waiter is promoted to primary, nobody else gets cancelled
+        f1.cancel()
+        assert await asyncio.gather(f2, f3) == [True, True]
+        assert f1.cancelled()
+        assert not svc._pending
+        await svc.stop()
+    run(main())
+
+
+class _AsyncHandle:
+    def __init__(self, verdict):
+        self._verdict = verdict
+
+    def result(self):
+        return self._verdict
+
+
+class _AsyncFakeImpl:
+    """Minimal BLS impl exposing the async begin seam: records the
+    call interleaving so the overlap test can prove begin(N+1) runs
+    BEFORE result(N) is read."""
+
+    def __init__(self):
+        self.calls = []
+
+    def _verdict(self, triples):
+        return all(sig == b"good" for _pks, _msg, sig in triples)
+
+    def begin_batch_verify(self, triples):
+        self.calls.append(("begin", len(triples)))
+        verdict = self._verdict(triples)
+
+        class H(_AsyncHandle):
+            def result(h):
+                self.calls.append(("result", len(triples)))
+                return verdict
+
+        return H(verdict)
+
+    def batch_verify(self, triples):
+        self.calls.append(("sync", len(triples)))
+        return self._verdict(triples)
+
+    def fast_aggregate_verify(self, pks, msg, sig):
+        self.calls.append(("sync", 1))
+        return sig == b"good"
+
+
+def test_async_overlap_begins_next_batch_before_retiring_previous():
+    async def main():
+        impl = _AsyncFakeImpl()
+        bls.set_implementation(impl)
+        try:
+            svc = make_service(num_workers=1, overlap=True)
+            await svc.start()
+            futs = [svc.verify([PKS[i % 4]], b"msg-%d" % i, b"good")
+                    for i in range(6)]
+            assert all(await asyncio.gather(*futs))
+            await svc.stop()
+        finally:
+            bls.reset_implementation()
+        begins = [c for c in impl.calls if c[0] == "begin"]
+        assert begins, "async seam never engaged"
+        # if more than one batch formed, the worker must have begun a
+        # later batch before reading an earlier batch's result
+        if len(begins) > 1:
+            first_result = impl.calls.index(("result", begins[0][1]))
+            second_begin = impl.calls.index(begins[1])
+            assert second_begin < first_result
+    run(main())
+
+
+def test_async_overlap_failure_still_bisects():
+    async def main():
+        impl = _AsyncFakeImpl()
+        bls.set_implementation(impl)
+        try:
+            svc = make_service(num_workers=1, overlap=True,
+                               split_threshold=2)
+            await svc.start()
+            futs = []
+            for i in range(5):
+                sig = b"bad" if i == 2 else b"good"
+                futs.append(svc.verify([PKS[i % 4]], b"bis-%d" % i, sig))
+            results = await asyncio.gather(*futs)
+            await svc.stop()
+        finally:
+            bls.reset_implementation()
+        assert results == [True, True, False, True, True]
+    run(main())
+
+
+def test_overlap_disabled_stays_sync():
+    async def main():
+        impl = _AsyncFakeImpl()
+        bls.set_implementation(impl)
+        try:
+            svc = make_service(num_workers=1, overlap=False)
+            await svc.start()
+            assert await svc.verify([PKS[0]], b"m", b"good")
+            await svc.stop()
+        finally:
+            bls.reset_implementation()
+        assert all(c[0] == "sync" for c in impl.calls)
     run(main())
 
 
