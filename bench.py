@@ -405,7 +405,8 @@ def _latency_phase(jax, deadline):
     """Slot-burst replay through AggregatingSignatureVerificationService:
     Poisson-bursty single-attestation tasks, p50/p99 task latency PLUS
     per-stage attribution (queue_wait / assembly / dispatch / host_prep /
-    device_execute / complete p50/p95/p99) from the tracing layer — so a
+    device_enqueue / device_sync / complete p50/p95/p99) from the tracing
+    layer — so a
     future p50 regression in BENCH_*.json names its guilty stage."""
     import asyncio
     import secrets
@@ -508,12 +509,28 @@ def _latency_phase(jax, deadline):
                     "n": len(samples)}
             OUT["latency_stages"] = stages
             # attribution coverage: the named stages' p50s should
-            # account for the end-to-end p50 (driver checks ±20%)
+            # account for the end-to-end p50 (driver checks ±20%).
+            # device time is enqueue + sync since the attribution
+            # split (device_sync excludes host-prep overlap, so the
+            # sum no longer double-counts under TEKU_TPU_ASYNC_OVERLAP)
             attributed = sum(
                 stages[s]["p50_ms"] for s in
-                ("queue_wait", "assembly", "host_prep", "device_execute")
+                ("queue_wait", "assembly", "host_prep",
+                 "device_enqueue", "device_sync")
                 if s in stages)
             OUT["latency_p50_attributed_ms"] = round(attributed, 3)
+        # capacity evidence: the same derived signals the node's
+        # /teku/v1/admin/capacity serves, measured over this phase's
+        # live dispatches (per-shape latency model + occupancy)
+        from teku_tpu.infra import capacity
+        cap = capacity.snapshot()
+        OUT["capacity"] = {
+            "derived": cap["derived"],
+            "occupancy_ratio": cap["device"]["occupancy_ratio"],
+            "shapes": {shape: {path: {k: stats[k] for k in
+                                      ("ewma_s", "p50_s", "samples")}
+                               for path, stats in paths.items()}
+                       for shape, paths in cap["shapes"].items()}}
     finally:
         tracing.set_sampler(None)
         bls.reset_implementation()
@@ -808,6 +825,86 @@ def _kzg_phase(deadline):
     _beat("kzg_phase_done", blobs_per_sec=OUT["kzg_blobs_per_sec"])
 
 
+_TRAJECTORY_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_TRAJECTORY.json")
+
+
+def trajectory_entry(out: dict, run_id: str) -> dict:
+    """Flatten one bench result into the compact trajectory record
+    tools/bench_diff.py and future perf PRs compare against."""
+    entry = {"run_id": run_id, "t_wall": round(time.time(), 1),
+             "sigs_per_sec": out.get("value"),
+             "best_batch": out.get("best_batch"),
+             "device": out.get("device"),
+             "mont_path": out.get("mont_path"),
+             "p50_ms": out.get("p50_ms"), "p99_ms": out.get("p99_ms")}
+    stages = out.get("latency_stages") or {}
+    entry["stage_p50_ms"] = {s: v.get("p50_ms")
+                             for s, v in stages.items()
+                             if isinstance(v, dict)}
+    compile_s, cache_load_s = 0.0, 0.0
+    for v in (out.get("detail") or {}).values():
+        if isinstance(v, dict):
+            compile_s += v.get("compile_s", 0.0)
+            cache_load_s += v.get("cache_load_s", 0.0)
+    entry["compile_s"] = round(compile_s, 1)
+    entry["cache_load_s"] = round(cache_load_s, 1)
+    dedup = out.get("h2c_dedup") or {}
+    f8 = (dedup.get("factors") or {}).get("8")
+    entry["dedup_speedup_8x"] = (f8.get("speedup_vs_1x")
+                                 if isinstance(f8, dict) else None)
+    warm = dedup.get("warm")
+    entry["warm_h2c_dispatches"] = (warm.get("h2c_dispatches")
+                                    if isinstance(warm, dict) else None)
+    cap = out.get("capacity") or {}
+    entry["occupancy_ratio"] = cap.get("occupancy_ratio")
+    return entry
+
+
+def append_trajectory(out: dict, path: str = _TRAJECTORY_PATH,
+                      run_id: str = None, max_entries: int = 50) -> str:
+    """Append this run to the rolling BENCH_TRAJECTORY.json.
+
+    REFUSES to overwrite an existing entry for the same run id — a
+    re-run under the same id must not silently rewrite the historical
+    record a regression gate already cited (re-measure under a fresh
+    id instead).  Returns "appended" | "duplicate_run_id" | an error
+    string; never raises (bench's result line must always come out)."""
+    run_id = run_id or os.environ.get("BENCH_RUN_ID") \
+        or f"run_{int(time.time())}"
+    try:
+        beat = _beat if path == _TRAJECTORY_PATH else (
+            lambda *a, **k: None)        # tests use scratch paths
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except FileNotFoundError:
+            doc = {"entries": []}        # first run: fresh history
+        except (OSError, ValueError) as exc:
+            # an EXISTING but unreadable/corrupt trajectory must abort
+            # the append — restarting history here would overwrite the
+            # record a regression gate already cited
+            beat("trajectory_error", run_id=run_id,
+                 why=f"unreadable trajectory: {exc}")
+            return f"error: unreadable trajectory: {exc}"
+        entries = doc.get("entries") or []
+        if any(e.get("run_id") == run_id for e in entries):
+            beat("trajectory_skipped", run_id=run_id,
+                 why="duplicate run id (entries are append-only)")
+            return "duplicate_run_id"
+        entries.append(trajectory_entry(out, run_id))
+        doc["entries"] = entries[-max_entries:]
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, indent=1)
+        os.replace(tmp, path)
+        beat("trajectory_appended", run_id=run_id,
+             entries=len(doc["entries"]))
+        return "appended"
+    except Exception as exc:  # noqa: BLE001 - evidence, not the result
+        return f"error: {type(exc).__name__}: {exc}"
+
+
 def main():
     t_start = time.time()
     budget_s = float(os.environ.get("BENCH_BUDGET_S", "1500"))
@@ -895,6 +992,9 @@ def main():
     except Exception:
         pass
     OUT["total_s"] = round(time.time() - t_start, 1)
+    # rolling trajectory: the regression gate (tools/bench_diff.py)
+    # compares the latest entries across PRs
+    OUT["trajectory"] = append_trajectory(OUT)
     _beat("bench_done", total_s=OUT["total_s"])
     _emit()
 

@@ -148,6 +148,8 @@ class BeaconRestApi(RestApi):
         g("/teku/v1/admin/traces", self._admin_traces)
         g("/teku/v1/admin/readiness", self._admin_readiness)
         g("/teku/v1/admin/flight_recorder", self._admin_flight_recorder)
+        g("/teku/v1/admin/capacity", self._admin_capacity)
+        g("/teku/v1/admin/profile", self._admin_profile)
         g("/metrics", self._metrics)
 
     # -- resolution helpers -------------------------------------------
@@ -283,6 +285,41 @@ class BeaconRestApi(RestApi):
         if query and query.get("clear") in ("1", "true"):
             recorder.clear()
         return out
+
+    async def _admin_capacity(self):
+        """The node's self-measurement (infra/capacity.py): per-shape
+        device-latency model, arrival rates per source, queue-depth
+        series, shed rate, true device occupancy, and the derived
+        sustainable-sigs/sec + utilization/headroom signals the
+        adaptive batcher (ROADMAP 3) will consume.  refresh() also
+        fires the edge-triggered headroom-exhausted flight-recorder
+        event, so polling this endpoint keeps the evidence current
+        even between node health ticks."""
+        from ..infra import capacity
+        return {"data": capacity.refresh()}
+
+    async def _admin_profile(self, query=None):
+        """On-demand jax.profiler capture (infra/profiling.py):
+        ``?start=1`` begins a capture (optional ``&duration_s=N`` arms
+        the auto-stop the health tick enforces), ``?stop=1`` ends it
+        and names the trace directory, no params = status (active
+        capture, last capture, cooldown/trigger config).  Start/stop
+        are also recorded to the flight recorder with the originating
+        trace id."""
+        from ..infra import profiling
+        ctl = profiling.CONTROLLER
+        if query and query.get("start") in ("1", "true"):
+            duration = None
+            if query.get("duration_s"):
+                try:
+                    duration = max(0.1, float(query["duration_s"]))
+                except ValueError:
+                    raise HttpError(400, "duration_s must be a number")
+            return {"data": ctl.start(trigger="manual",
+                                      duration_s=duration)}
+        if query and query.get("stop") in ("1", "true"):
+            return {"data": ctl.stop()}
+        return {"data": ctl.status()}
 
     async def _version(self):
         return {"data": {"version": VERSION}}

@@ -1,0 +1,503 @@
+"""Capacity & occupancy telemetry: can this node carry its load?
+
+ROADMAP item 3 (deadline-aware adaptive batching under SLO feedback
+control) needs the node to MEASURE its own capacity before a controller
+can act on it: live arrival rate, queue depth, shed rate, and — the
+denominator of every utilization claim — per-shape device latency and
+true device occupancy.  This module is those signals as first-class,
+windowed estimators:
+
+- ``RateEstimator`` — events/sec over a trailing window, coalesced into
+  fixed-resolution buckets (O(buckets) memory at any arrival rate, a
+  burst's contribution decays out exactly one window later).  The clock
+  is injectable so tests are deterministic without sleeps.
+- ``QueueDepthSeries`` — a bounded time series of queue-depth samples
+  (the batching service stamps enqueue/drain points).
+- ``ShapeLatencyModel`` — per-``{shape,path}`` device latency fed from
+  real dispatch spans: EWMA + windowed p50/p95 + sample counts,
+  exported as ``bls_shape_device_latency_seconds{shape,path,stat}``.
+  Label cardinality is BOUNDED: past ``max_shapes`` distinct shapes,
+  new ones collapse into ``shape="other"`` (pow-2 bucketing keeps the
+  real set tiny; an adversarial shape storm must not grow the scrape).
+- ``DeviceOccupancyTracker`` — true device-time accounting under async
+  overlap: dispatch N+1 is enqueued while N executes, so wall-clock
+  intervals overlap; the device itself serializes programs, so each
+  dispatch's TRUE device time is ``sync_end - max(enqueue_end,
+  previous_sync_end)``.  Busy seconds accumulate into a windowed
+  estimator whose rate IS the occupancy ratio.
+- ``CapacityTelemetry`` — the combination: estimated sustainable
+  sigs/sec at the CURRENT shape mix (lanes verified / device seconds
+  over the window), utilization = demand/capacity, and headroom —
+  surfaced via ``/teku/v1/admin/capacity``, the signature service's
+  ``health_snapshot()``, and ``capacity_*`` gauges.  Headroom
+  exhaustion (utilization crossing 1.0) is EDGE-TRIGGERED into the
+  flight recorder with the originating trace id, mirroring the
+  breaker/SLO event shapes.
+
+The committee-consensus measurements (PAPERS: EdDSA/BLS in
+committee-based consensus) show per-shape, committee-dependent verify
+cost — which is why the latency model keys on the padded dispatch
+shape, not a scalar average.
+"""
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import flightrecorder, tracing
+from .metrics import GLOBAL_REGISTRY, MetricsRegistry
+
+DEFAULT_WINDOW_S = float(os.environ.get("TEKU_TPU_CAPACITY_WINDOW_S",
+                                        "60"))
+
+# distinct `shape` label values before the model folds into "other"
+DEFAULT_MAX_SHAPES = int(os.environ.get("TEKU_TPU_CAPACITY_MAX_SHAPES",
+                                        "24"))
+
+
+class RateEstimator:
+    """Windowed event-rate estimator with an injectable monotonic
+    clock.  ``record(amount)`` adds to the current fixed-resolution
+    bucket; ``rate()`` is the windowed total divided by the FULL window
+    (an empty or half-empty window reads low, never spikes), and
+    ``total()`` is the raw windowed sum (the occupancy tracker uses it
+    as busy-seconds)."""
+
+    def __init__(self, window_s: float = DEFAULT_WINDOW_S,
+                 buckets: int = 30,
+                 clock: Callable[[], float] = time.monotonic):
+        if window_s <= 0 or buckets <= 0:
+            raise ValueError("window_s and buckets must be positive")
+        self.window_s = float(window_s)
+        self._res = self.window_s / buckets
+        self._span = buckets
+        self._clock = clock
+        self._buckets: deque = deque()   # [bucket_index, amount]
+        self._lock = threading.Lock()
+
+    def _prune(self, idx: int) -> None:
+        horizon = idx - self._span
+        while self._buckets and self._buckets[0][0] <= horizon:
+            self._buckets.popleft()
+
+    def record(self, amount: float = 1.0) -> None:
+        idx = int(self._clock() / self._res)
+        with self._lock:
+            if self._buckets and self._buckets[-1][0] == idx:
+                self._buckets[-1][1] += amount
+            else:
+                self._buckets.append([idx, amount])
+                self._prune(idx)
+
+    def total(self) -> float:
+        idx = int(self._clock() / self._res)
+        with self._lock:
+            self._prune(idx)
+            return sum(a for _, a in self._buckets)
+
+    def rate(self) -> float:
+        return self.total() / self.window_s
+
+
+class QueueDepthSeries:
+    """Bounded (t_wall, depth) time series + current-depth readout.
+    Sampled at enqueue/drain points by the batching service — cheap
+    enough for the hot path (one deque append under a lock)."""
+
+    def __init__(self, capacity: int = 256,
+                 clock: Callable[[], float] = time.time):
+        self._samples: deque = deque(maxlen=capacity)
+        self._clock = clock
+        self._current = 0
+        self._lock = threading.Lock()
+
+    def record(self, depth: int) -> None:
+        with self._lock:
+            self._current = int(depth)
+            self._samples.append((round(self._clock(), 3), int(depth)))
+
+    @property
+    def current(self) -> int:
+        return self._current
+
+    def snapshot(self, last: int = 32) -> List[dict]:
+        with self._lock:
+            samples = list(self._samples)[-last:]
+        return [{"t_wall": t, "depth": d} for t, d in samples]
+
+
+class _ShapeEntry:
+    __slots__ = ("ewma_s", "samples", "count", "lock")
+
+    def __init__(self, window: int):
+        self.ewma_s: Optional[float] = None
+        self.samples: deque = deque(maxlen=window)
+        self.count = 0
+        self.lock = threading.Lock()
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return sorted_vals[i]
+
+
+class ShapeLatencyModel:
+    """Per-``{shape,path}`` device-latency model fed from real dispatch
+    spans: EWMA (alpha-weighted, reacts in a few dispatches), windowed
+    p50/p95 (order statistics over the last `window` samples), and a
+    cumulative sample count.  Cardinality is bounded at `max_shapes`
+    distinct shape strings — later shapes fold into ``"other"`` so a
+    shape storm cannot grow the metric family unbounded."""
+
+    OVERFLOW = "other"
+
+    def __init__(self, alpha: float = 0.2, window: int = 128,
+                 max_shapes: int = DEFAULT_MAX_SHAPES,
+                 registry: MetricsRegistry = GLOBAL_REGISTRY):
+        self.alpha = alpha
+        self.window = window
+        self.max_shapes = max_shapes
+        self._entries: Dict[Tuple[str, str], _ShapeEntry] = {}
+        self._shapes: set = set()
+        self._lock = threading.Lock()
+        self._m_latency = registry.labeled_gauge(
+            "bls_shape_device_latency_seconds",
+            "modeled per-shape device latency (true device time under "
+            "overlap): EWMA and windowed p50/p95 per padded dispatch "
+            "shape and mont_mul path",
+            labelnames=("shape", "path", "stat"))
+
+    def _key(self, shape: str, path: str) -> Tuple[str, str]:
+        with self._lock:
+            if shape not in self._shapes:
+                if len(self._shapes) >= self.max_shapes:
+                    shape = self.OVERFLOW
+                self._shapes.add(shape)
+            key = (shape, path)
+            if key not in self._entries:
+                self._entries[key] = _ShapeEntry(self.window)
+            return key
+
+    def observe(self, shape: str, path: str, seconds: float) -> None:
+        key = self._key(str(shape), str(path))
+        entry = self._entries[key]
+        with entry.lock:
+            entry.count += 1
+            entry.samples.append(seconds)
+            entry.ewma_s = (seconds if entry.ewma_s is None else
+                            self.alpha * seconds
+                            + (1 - self.alpha) * entry.ewma_s)
+            stats = self._stats_locked(entry)
+        for stat, value in (("ewma", stats["ewma_s"]),
+                            ("p50", stats["p50_s"]),
+                            ("p95", stats["p95_s"])):
+            self._m_latency.labels(shape=key[0], path=key[1],
+                                   stat=stat).set(value)
+
+    @staticmethod
+    def _stats_locked(entry: _ShapeEntry) -> dict:
+        ordered = sorted(entry.samples)
+        return {"ewma_s": round(entry.ewma_s or 0.0, 6),
+                "p50_s": round(_percentile(ordered, 0.50), 6),
+                "p95_s": round(_percentile(ordered, 0.95), 6),
+                "samples": entry.count,
+                "window_samples": len(ordered)}
+
+    def snapshot(self) -> Dict[str, Dict[str, dict]]:
+        """{shape: {path: {ewma_s, p50_s, p95_s, samples}}}"""
+        with self._lock:
+            items = list(self._entries.items())
+        out: Dict[str, Dict[str, dict]] = {}
+        for (shape, path), entry in items:
+            with entry.lock:
+                out.setdefault(shape, {})[path] = \
+                    self._stats_locked(entry)
+        return out
+
+    def latency_s(self, shape: str, path: str,
+                  stat: str = "p50_s") -> Optional[float]:
+        entry = self._entries.get((shape, path))
+        if entry is None:
+            return None
+        with entry.lock:
+            return self._stats_locked(entry)[stat]
+
+
+class DeviceOccupancyTracker:
+    """True device-time accounting under async overlap.
+
+    The service enqueues batch N+1 while batch N executes, so
+    ``enqueue_end → sync_end`` wall intervals OVERLAP — summing them
+    would double-count.  The device serializes programs, so a
+    dispatch's true device time is its interval clamped to start no
+    earlier than the previous dispatch's sync end.  ``record`` returns
+    that clamped duration (the per-shape latency model's sample) and
+    accumulates it into a windowed busy-seconds estimator whose rate
+    is the occupancy ratio (busy seconds per wall second)."""
+
+    def __init__(self, window_s: float = DEFAULT_WINDOW_S,
+                 clock: Callable[[], float] = time.monotonic):
+        self._busy = RateEstimator(window_s=window_s, clock=clock)
+        self._last_end: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def record(self, start: float, end: float) -> float:
+        with self._lock:
+            if self._last_end is not None:
+                start = max(start, self._last_end)
+            if self._last_end is None or end > self._last_end:
+                self._last_end = end
+        busy = max(0.0, end - start)
+        self._busy.record(busy)
+        return busy
+
+    def busy_seconds(self) -> float:
+        return self._busy.total()
+
+    def occupancy(self) -> float:
+        return min(1.0, self._busy.rate())
+
+
+class CapacityTelemetry:
+    """The node's self-measurement: arrival rates per source, queue
+    depth, shed rate, per-shape device latency, device occupancy — and
+    the derived signals the future batching controller (ROADMAP 3)
+    will close its loop on:
+
+    - ``capacity_sustainable_sigs_per_second`` = lanes verified /
+      device-busy seconds over the window — what the device can do at
+      the CURRENT shape mix;
+    - ``capacity_utilization_ratio`` = demand / capacity (falls back
+      to measured occupancy before any dispatch evidence exists);
+    - ``capacity_headroom_ratio`` = max(0, 1 - utilization).
+
+    Crossing utilization 1.0 records ONE ``capacity_headroom_exhausted``
+    flight-recorder event (with the originating trace id, mirroring the
+    SLO breach shape); recovery below 1.0 records
+    ``capacity_headroom_recovered`` once."""
+
+    MAX_SOURCES = 16
+
+    def __init__(self, registry: MetricsRegistry = GLOBAL_REGISTRY,
+                 window_s: float = DEFAULT_WINDOW_S,
+                 clock: Callable[[], float] = time.monotonic,
+                 recorder: Optional[flightrecorder.FlightRecorder]
+                 = None):
+        self.window_s = window_s
+        self._clock = clock
+        self._recorder = recorder or flightrecorder.RECORDER
+        self._arrivals: Dict[str, RateEstimator] = {}
+        self._arrivals_lock = threading.Lock()
+        self._sheds = RateEstimator(window_s, clock=clock)
+        self._lanes = RateEstimator(window_s, clock=clock)
+        self.queue_depth = QueueDepthSeries()
+        self.latency = ShapeLatencyModel(registry=registry)
+        self.occupancy = DeviceOccupancyTracker(window_s, clock=clock)
+        self._exhausted = False
+        self._m_arrival = registry.labeled_gauge(
+            "bls_arrival_rate_per_second",
+            "verification arrival rate over the trailing window, per "
+            "submitting source (triples/sec)",
+            labelnames=("source",))
+        registry.gauge(
+            "bls_queue_depth",
+            "current pending verification tasks (capacity view of the "
+            "batching queue)",
+            supplier=lambda: float(self.queue_depth.current))
+        registry.gauge(
+            "bls_device_occupancy_ratio",
+            "fraction of wall time the device spent executing "
+            "dispatches over the trailing window (overlap-corrected)",
+            supplier=self.occupancy.occupancy)
+        registry.gauge(
+            "capacity_shed_rate_per_second",
+            "verification tasks shed at the queue over the trailing "
+            "window",
+            supplier=self._sheds.rate)
+        registry.gauge(
+            "capacity_sustainable_sigs_per_second",
+            "estimated sustainable verification throughput at the "
+            "current shape mix (windowed lanes / device-busy seconds)",
+            supplier=self.sustainable_sigs_per_second)
+        registry.gauge(
+            "capacity_utilization_ratio",
+            "demand / sustainable capacity (measured occupancy before "
+            "dispatch evidence exists); > 1.0 = over capacity",
+            supplier=self.utilization)
+        registry.gauge(
+            "capacity_headroom_ratio",
+            "max(0, 1 - utilization): remaining fraction of capacity",
+            supplier=self.headroom)
+
+    # ------------------------------------------------------------------
+    # Inputs (hot-path recorders)
+    # ------------------------------------------------------------------
+    def record_arrival(self, source: str, triples: int = 1) -> None:
+        with self._arrivals_lock:
+            est = self._arrivals.get(source)
+            if est is None:
+                if len(self._arrivals) >= self.MAX_SOURCES:
+                    source = "other"
+                est = self._arrivals.setdefault(
+                    source, RateEstimator(self.window_s,
+                                          clock=self._clock))
+        est.record(triples)
+        self._m_arrival.labels(source=source).set(round(est.rate(), 4))
+
+    def record_shed(self, triples: int = 1) -> None:
+        self._sheds.record(triples)
+
+    def record_queue_depth(self, depth: int) -> None:
+        self.queue_depth.record(depth)
+
+    def record_dispatch(self, shape: str, path: str, lanes: int,
+                        enqueue_end: float, sync_end: float) -> float:
+        """One completed device dispatch: clamp the interval to true
+        device time (overlap-corrected), feed the per-shape latency
+        model and the lanes-verified window.  Called from the dispatch
+        handle's sync point with ``perf_counter`` stamps."""
+        busy = self.occupancy.record(enqueue_end, sync_end)
+        self.latency.observe(shape, path, busy)
+        self._lanes.record(lanes)
+        return busy
+
+    # ------------------------------------------------------------------
+    # Derived signals
+    # ------------------------------------------------------------------
+    def demand_sigs_per_second(self) -> float:
+        with self._arrivals_lock:
+            ests = list(self._arrivals.values())
+        return sum(e.rate() for e in ests)
+
+    def sustainable_sigs_per_second(self) -> float:
+        busy = self.occupancy.busy_seconds()
+        if busy <= 0:
+            return 0.0
+        return self._lanes.total() / busy
+
+    def utilization(self) -> float:
+        cap = self.sustainable_sigs_per_second()
+        if cap <= 0:
+            # no dispatch evidence yet: measured occupancy is the only
+            # honest utilization statement available
+            return self.occupancy.occupancy()
+        return self.demand_sigs_per_second() / cap
+
+    def headroom(self) -> float:
+        return max(0.0, 1.0 - self.utilization())
+
+    # ------------------------------------------------------------------
+    def refresh(self) -> dict:
+        """Periodic tick: re-evaluate the derived signals and fire the
+        edge-triggered headroom events.  Returns the snapshot (the
+        /teku/v1/admin/capacity body)."""
+        snap = self.snapshot()
+        util = snap["derived"]["utilization"]
+        exhausted = util > 1.0 + 1e-9 \
+            and snap["derived"]["capacity_sigs_per_second"] > 0
+        if exhausted and not self._exhausted:
+            trace_id = (tracing.current_trace_id()
+                        or self._recorder.last_trace_id())
+            self._recorder.record(
+                "capacity_headroom_exhausted", trace_id=trace_id,
+                utilization=round(util, 3),
+                demand_sigs_per_second=snap["derived"][
+                    "demand_sigs_per_second"],
+                capacity_sigs_per_second=snap["derived"][
+                    "capacity_sigs_per_second"],
+                detail="demand exceeds sustainable capacity at the "
+                       "current shape mix")
+        elif self._exhausted and not exhausted:
+            self._recorder.record(
+                "capacity_headroom_recovered",
+                utilization=round(util, 3))
+        self._exhausted = exhausted
+        snap["derived"]["headroom_exhausted"] = self._exhausted
+        return snap
+
+    def snapshot(self) -> dict:
+        with self._arrivals_lock:
+            arrivals = {s: round(e.rate(), 4)
+                        for s, e in self._arrivals.items()}
+        # keep the per-source gauges live: record_arrival() sets them
+        # on traffic, but a source that goes QUIET would otherwise
+        # freeze at its last burst-era rate forever — the health tick
+        # and every endpoint read pass through here, so the gauge
+        # decays with the window like the supplier-based siblings
+        for source, rate in arrivals.items():
+            self._m_arrival.labels(source=source).set(rate)
+        demand = self.demand_sigs_per_second()
+        cap = self.sustainable_sigs_per_second()
+        util = self.utilization()
+        return {
+            "window_s": self.window_s,
+            "arrival_rate_per_second": arrivals,
+            "queue_depth": {"current": self.queue_depth.current,
+                            "series": self.queue_depth.snapshot()},
+            "shed_rate_per_second": round(self._sheds.rate(), 4),
+            "device": {
+                "occupancy_ratio": round(self.occupancy.occupancy(), 4),
+                "busy_seconds_window": round(
+                    self.occupancy.busy_seconds(), 4),
+                "lanes_window": round(self._lanes.total(), 1)},
+            "shapes": self.latency.snapshot(),
+            "derived": {
+                "demand_sigs_per_second": round(demand, 2),
+                "capacity_sigs_per_second": round(cap, 2),
+                "utilization": round(util, 4),
+                "headroom_ratio": round(max(0.0, 1.0 - util), 4),
+                "headroom_sigs_per_second": round(
+                    max(0.0, cap - demand), 2),
+                "headroom_exhausted": self._exhausted}}
+
+    def summary(self) -> dict:
+        """The compact derived view health_snapshot()/SLO consumers
+        embed (full detail lives on /teku/v1/admin/capacity)."""
+        return {
+            "arrival_rate_per_second": round(
+                self.demand_sigs_per_second(), 2),
+            "capacity_sigs_per_second": round(
+                self.sustainable_sigs_per_second(), 2),
+            "utilization": round(self.utilization(), 4),
+            "headroom_ratio": round(self.headroom(), 4),
+            "occupancy_ratio": round(self.occupancy.occupancy(), 4)}
+
+
+# the process-wide telemetry the provider/service/endpoint share (like
+# flightrecorder.RECORDER: dispatch handles, worker threads and the
+# REST task all contribute, and the value is ONE combined view)
+TELEMETRY = CapacityTelemetry()
+
+
+def record_arrival(source: str, triples: int = 1) -> None:
+    TELEMETRY.record_arrival(source, triples)
+
+
+def record_shed(triples: int = 1) -> None:
+    TELEMETRY.record_shed(triples)
+
+
+def record_queue_depth(depth: int) -> None:
+    TELEMETRY.record_queue_depth(depth)
+
+
+def record_dispatch(shape: str, path: str, lanes: int,
+                    enqueue_end: float, sync_end: float) -> float:
+    return TELEMETRY.record_dispatch(shape, path, lanes, enqueue_end,
+                                     sync_end)
+
+
+def snapshot() -> dict:
+    return TELEMETRY.snapshot()
+
+
+def refresh() -> dict:
+    return TELEMETRY.refresh()
+
+
+def summary() -> dict:
+    return TELEMETRY.summary()

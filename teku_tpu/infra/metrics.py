@@ -287,7 +287,7 @@ class LabeledGauge(_LabeledFamily):
 
 class LabeledHistogram(_LabeledFamily):
     """Histogram family with per-label-set buckets, e.g.
-    ``m.labels(stage="device_execute").observe(dt)``."""
+    ``m.labels(stage="device_sync").observe(dt)``."""
 
     def __init__(self, name: str, help_: str, labelnames: Sequence[str],
                  buckets: Sequence[float] = LATENCY_BUCKETS_S):

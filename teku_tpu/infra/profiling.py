@@ -1,0 +1,237 @@
+"""Triggered ``jax.profiler`` trace capture.
+
+When the p50 SLO starts burning the operator's next question is "what
+is the device DOING right now?" — and by the time someone attaches a
+profiler by hand the incident is over.  This module makes capture a
+runtime property:
+
+- on demand via ``GET /teku/v1/admin/profile?start=1`` / ``?stop=1``
+  (api/beacon_api.py) — one bounded capture at a time;
+- automatically when the ``attestation_verify_p50`` burn rate crosses
+  the trigger threshold: ONE capture per cooldown window (a sustained
+  breach must not fill a disk with traces), stopped after a bounded
+  duration by the node's health tick calling ``poll()``.
+
+Every start/stop lands in the flight recorder
+(``profiler_capture_start`` / ``profiler_capture_stop``) with the
+originating trace id — mirroring the breaker/SLO event shapes — and in
+``profiler_captures_total{trigger="manual"|"burn_rate"}``.
+
+The actual profiler is an injectable backend: the default lazily
+imports ``jax.profiler`` (so importing this module never drags jax in,
+and a CPU-only or jax-less process degrades to a recorded error, never
+a crash); tests inject a fake.
+"""
+
+import logging
+import os
+import tempfile
+import threading
+import time
+from typing import Callable, Optional
+
+from . import flightrecorder, tracing
+from .metrics import GLOBAL_REGISTRY, MetricsRegistry
+
+_LOG = logging.getLogger(__name__)
+
+
+def default_profile_dir() -> str:
+    return os.environ.get("TEKU_TPU_PROFILE_DIR") or os.path.join(
+        tempfile.gettempdir(), "teku_tpu_profiles")
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+class JaxProfilerBackend:
+    """The real thing: ``jax.profiler.start_trace``/``stop_trace``
+    writing a TensorBoard-readable trace directory."""
+
+    def start(self, log_dir: str) -> None:
+        import jax.profiler
+        os.makedirs(log_dir, exist_ok=True)
+        jax.profiler.start_trace(log_dir)
+
+    def stop(self) -> None:
+        import jax.profiler
+        jax.profiler.stop_trace()
+
+
+class ProfilerController:
+    """One capture at a time, cooldown-gated auto-trigger, flight-
+    recorder evidence.  All public methods are thread-safe (the REST
+    task and the health tick may race a stop)."""
+
+    WATCH_OBJECTIVE = "attestation_verify_p50"
+
+    def __init__(self, backend=None, out_dir: Optional[str] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry: MetricsRegistry = GLOBAL_REGISTRY,
+                 recorder: Optional[flightrecorder.FlightRecorder]
+                 = None,
+                 cooldown_s: Optional[float] = None,
+                 auto_duration_s: Optional[float] = None,
+                 burn_threshold: Optional[float] = None):
+        self._backend = backend or JaxProfilerBackend()
+        self.out_dir = out_dir or default_profile_dir()
+        self._clock = clock
+        self._recorder = recorder or flightrecorder.RECORDER
+        self.cooldown_s = (cooldown_s if cooldown_s is not None else
+                           _env_float("TEKU_TPU_PROFILE_COOLDOWN_S",
+                                      600.0))
+        self.auto_duration_s = (
+            auto_duration_s if auto_duration_s is not None else
+            _env_float("TEKU_TPU_PROFILE_AUTO_DURATION_S", 5.0))
+        self.burn_threshold = (
+            burn_threshold if burn_threshold is not None else
+            _env_float("TEKU_TPU_PROFILE_BURN_THRESHOLD", 1.0))
+        self._lock = threading.Lock()
+        self._active: Optional[dict] = None
+        self._last: Optional[dict] = None
+        self._last_auto_t: Optional[float] = None
+        self._m_captures = registry.labeled_counter(
+            "profiler_captures_total",
+            "jax.profiler trace captures started, by trigger "
+            "(manual | burn_rate)",
+            labelnames=("trigger",))
+
+    # ------------------------------------------------------------------
+    def start(self, trigger: str = "manual",
+              duration_s: Optional[float] = None) -> dict:
+        """Begin a capture.  ``duration_s`` arms an auto-stop deadline
+        enforced by ``poll()`` (every auto capture gets one; manual
+        captures run until ``stop()`` unless bounded explicitly)."""
+        now = self._clock()
+        with self._lock:
+            if self._active is not None:
+                return {"error": "capture already active",
+                        "capture": dict(self._active)}
+            path = os.path.join(
+                self.out_dir,
+                f"profile_{int(time.time())}_{os.getpid()}_{trigger}")
+            capture = {"trigger": trigger, "path": path,
+                       "t_wall": round(time.time(), 3),
+                       "_t0": now,
+                       "stop_after_s": duration_s}
+            self._active = capture
+        try:
+            self._backend.start(path)
+        except Exception as exc:  # noqa: BLE001 - degrade, don't crash
+            with self._lock:
+                self._active = None
+            _LOG.warning("profiler capture failed to start",
+                         exc_info=True)
+            self._recorder.record(
+                "profiler_capture_error", trigger=trigger,
+                error=f"{type(exc).__name__}: {exc}")
+            return {"error": f"profiler start failed: {exc}"}
+        self._m_captures.labels(trigger=trigger).inc()
+        trace_id = (tracing.current_trace_id()
+                    or self._recorder.last_trace_id())
+        self._recorder.record(
+            "profiler_capture_start", trace_id=trace_id,
+            trigger=trigger, path=path,
+            stop_after_s=duration_s)
+        _LOG.warning("profiler capture started (%s) -> %s", trigger,
+                     path)
+        return {k: v for k, v in capture.items()
+                if not k.startswith("_")}
+
+    def stop(self) -> dict:
+        with self._lock:
+            capture = self._active
+            self._active = None
+        if capture is None:
+            return {"error": "no capture active"}
+        try:
+            self._backend.stop()
+        except Exception as exc:  # noqa: BLE001
+            # the trace is still running: keep the capture active so a
+            # retry can stop it — clearing it here would orphan a
+            # live profiler that can then never be stopped (and block
+            # every future start())
+            with self._lock:
+                if self._active is None:
+                    self._active = capture
+            _LOG.warning("profiler capture failed to stop",
+                         exc_info=True)
+            self._recorder.record(
+                "profiler_capture_error",
+                trigger=capture["trigger"],
+                error=f"{type(exc).__name__}: {exc}")
+            return {"error": f"profiler stop failed: {exc}"}
+        duration = round(self._clock() - capture["_t0"], 3)
+        done = {"trigger": capture["trigger"],
+                "path": capture["path"],
+                "t_wall": capture["t_wall"],
+                "duration_s": duration}
+        with self._lock:
+            self._last = done
+        self._recorder.record(
+            "profiler_capture_stop", trigger=capture["trigger"],
+            path=capture["path"], duration_s=duration)
+        _LOG.info("profiler capture stopped after %.1fs -> %s",
+                  duration, capture["path"])
+        return done
+
+    def status(self) -> dict:
+        with self._lock:
+            active = ({k: v for k, v in self._active.items()
+                       if not k.startswith("_")}
+                      if self._active is not None else None)
+            last = dict(self._last) if self._last else None
+        return {"active": active is not None,
+                "capture": active,
+                "last": last,
+                "cooldown_s": self.cooldown_s,
+                "burn_threshold": self.burn_threshold,
+                "auto_duration_s": self.auto_duration_s,
+                "out_dir": self.out_dir}
+
+    # ------------------------------------------------------------------
+    def maybe_trigger(self, objective: str, burn: float) -> bool:
+        """Burn-rate trigger: start ONE auto capture when the watched
+        objective's burn crosses the threshold, at most once per
+        cooldown.  Returns True when a capture was started."""
+        if objective != self.WATCH_OBJECTIVE:
+            return False
+        if burn <= self.burn_threshold:
+            return False
+        now = self._clock()
+        with self._lock:
+            if self._active is not None:
+                return False
+            if self._last_auto_t is not None \
+                    and now - self._last_auto_t < self.cooldown_s:
+                return False
+            self._last_auto_t = now
+        out = self.start(trigger="burn_rate",
+                         duration_s=self.auto_duration_s)
+        return "error" not in out
+
+    def poll(self, slo_snapshot: Optional[dict] = None) -> None:
+        """The health tick's hook: stop an overdue auto capture, then
+        evaluate the burn trigger from an SloEngine snapshot
+        (``{objective: {"burn_rate": ...}}``)."""
+        with self._lock:
+            capture = self._active
+            overdue = (capture is not None
+                       and capture.get("stop_after_s") is not None
+                       and self._clock() - capture["_t0"]
+                       >= capture["stop_after_s"])
+        if overdue:
+            self.stop()
+        if slo_snapshot:
+            for name, obj in slo_snapshot.items():
+                if isinstance(obj, dict):
+                    self.maybe_trigger(name,
+                                       float(obj.get("burn_rate", 0.0)))
+
+
+# the process-wide controller the REST endpoint and node tick share
+CONTROLLER = ProfilerController()

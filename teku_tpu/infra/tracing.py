@@ -41,15 +41,21 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 from .metrics import GLOBAL_REGISTRY, LATENCY_BUCKETS_S
 
 # The canonical hot-path stages (bench reports percentiles for these;
-# `complete` is the root span's end-to-end total).
+# `complete` is the root span's end-to-end total).  The old combined
+# `device_execute` span is split: `device_enqueue` covers the async
+# launch (plus XLA compile on a first shape), `device_sync` covers
+# only the blocking wait at the handle's result() — so under async
+# overlap the sync span no longer absorbs host-prep time the worker
+# spent on the NEXT batch (the PERF.md attribution fix).
 STAGES = ("queue_wait", "assembly", "dispatch", "host_prep",
-          "device_execute", "complete")
+          "device_enqueue", "device_sync", "complete")
 
 _enabled = True
 
 # Traces bound to the current execution context.  A tuple (not a single
 # trace): one device dispatch serves a whole batch of root traces, and
-# its host_prep/device_execute spans must attribute to every one.
+# its host_prep/device_enqueue/device_sync spans must attribute to
+# every one.
 _CURRENT: ContextVar[Tuple["Trace", ...]] = ContextVar(
     "teku_tpu_traces", default=())
 

@@ -148,11 +148,17 @@ class BeaconNode(Service):
         one gauge raised would be the observability layer's own
         silent-failure bug."""
         interval = float(os.environ.get("TEKU_TPU_HEALTH_TICK_S", "5"))
+        from ..infra import capacity, profiling
         while True:
             await asyncio.sleep(interval)
             try:
-                self.slo.tick()
+                slo_snap = self.slo.tick()
                 self.health.evaluate()
+                # capacity refresh fires the edge-triggered headroom-
+                # exhausted event; the profiler poll stops an overdue
+                # auto capture and evaluates the burn-rate trigger
+                capacity.refresh()
+                profiling.CONTROLLER.poll(slo_snap)
             except Exception:  # pragma: no cover - belt and braces
                 _LOG.exception("health tick failed")
 

@@ -46,7 +46,7 @@ import jax
 import jax.numpy as jnp
 
 from ..crypto.bls import hash_to_curve as OH
-from ..infra import compilecache, faults, tracing
+from ..infra import capacity, compilecache, faults, tracing
 from ..infra.collections import LimitedMap
 from ..infra.metrics import GLOBAL_REGISTRY
 from ..crypto.bls.constants import P, R
@@ -185,22 +185,31 @@ class _DispatchHandle:
     """An in-flight batch dispatch.
 
     The device work was enqueued via JAX async dispatch when this was
-    created; result() forces the verdict arrays (the only host/device
-    sync point) — callers may do arbitrary host work (e.g. host_prep of
-    the NEXT batch) between the two.  The traces bound at dispatch time
-    are captured so the device_execute span attributes to the right
+    created (the `device_enqueue` span, recorded by _begin_dispatch,
+    covers the launch calls plus any XLA compile a first shape pays);
+    result() forces the verdict arrays (the only host/device sync
+    point) — callers may do arbitrary host work (e.g. host_prep of the
+    NEXT batch) between the two.  result() records ONLY the blocking
+    wait as `device_sync`, so under async overlap the span no longer
+    absorbs host-prep time spent between enqueue and sync (the old
+    combined `device_execute` span's documented caveat), and feeds the
+    capacity model's per-shape device-latency/occupancy accounting
+    with the overlap-corrected interval.  The traces bound at dispatch
+    time are captured so both spans attribute to the right
     verifications even when result() runs under a different context.
     """
 
-    __slots__ = ("_ok", "_lane_ok", "_n", "_t_dev0", "_traces", "_done",
-                 "_verdict")
+    __slots__ = ("_ok", "_lane_ok", "_n", "_traces", "_done",
+                 "_verdict", "_shape", "_path", "_t_enq_end")
 
-    def __init__(self, ok, lane_ok, n, t_dev0, traces):
+    def __init__(self, ok, lane_ok, n, traces, shape, path, t_enq_end):
         self._ok = ok
         self._lane_ok = lane_ok
         self._n = n
-        self._t_dev0 = t_dev0
         self._traces = traces
+        self._shape = shape
+        self._path = path
+        self._t_enq_end = t_enq_end
         self._done = False
         self._verdict = False
 
@@ -208,18 +217,25 @@ class _DispatchHandle:
         """Synchronize and return the batch verdict (idempotent)."""
         if self._done:
             return self._verdict
+        t_sync0 = time.perf_counter()
         try:
-            # np.asarray forces the device round-trip, so the recorded
-            # stage covers enqueue-to-host-synchronized; under overlap
-            # that includes time the dispatch spent queued behind the
-            # previous in-flight batch (documented attribution caveat)
+            # np.asarray forces the device round-trip: this wait (and
+            # nothing else) is the device_sync stage
             lane_ok = np.asarray(self._lane_ok)
             verdict = bool(np.asarray(self._ok)) \
                 and bool(lane_ok[:self._n].all())
         finally:
-            tracing.record_stage(
-                "device_execute", time.perf_counter() - self._t_dev0,
-                self._traces)
+            t_end = time.perf_counter()
+            tracing.record_stage("device_sync", t_end - t_sync0,
+                                 self._traces)
+        # true device time = enqueue-end → sync-end, clamped by the
+        # tracker so overlapped dispatches never double-count.  Only a
+        # SUCCESSFUL sync counts its lanes: a raising dispatch gets
+        # bisected and re-dispatched, and crediting its lanes here
+        # would inflate sustainable capacity during exactly the fault
+        # incidents the capacity endpoint is meant to diagnose.
+        capacity.record_dispatch(self._shape, self._path, self._n,
+                                 self._t_enq_end, t_end)
         self._done = True
         self._verdict = faults.transform("bls.dispatch", verdict)
         return self._verdict
@@ -489,7 +505,8 @@ class JaxBls12381(BLS12381):
         """Async-overlap entry: host_prep + device enqueue NOW (JAX
         async dispatch), verdict at handle.result().  The batching
         service uses this to overlap host_prep of batch N+1 with
-        device_execute of batch N.  Returns None for oversized batches
+        device execution of batch N.  Returns None for oversized
+        batches
         (callers fall back to the splitting sync path)."""
         if len(triples) > self.max_batch:
             return None
@@ -522,7 +539,7 @@ class JaxBls12381(BLS12381):
         """Host half of H(m) resolution — runs inside the host_prep
         span: message digests, arena lookups, and the hash_to_field
         draws for whatever still needs an h2c dispatch (so the SHA-256
-        and draw cost never pollutes the device_execute attribution).
+        and draw cost never pollutes the device-span attribution).
 
         The cache is bypassed when the batch carries more unique
         messages than the whole arena holds: inserting more rows than
@@ -673,8 +690,9 @@ class JaxBls12381(BLS12381):
         _M_H2C_LANES.inc(n)
         _M_H2C_UNIQUE.inc(len(uniq_msgs))
         # device section: every launch below is async (XLA compiles
-        # synchronously on a first shape, then enqueues); the handle's
-        # result() forces the arrays and records the device span
+        # synchronously on a first shape, then enqueues); the enqueue
+        # span ends when the launches return, and the handle's
+        # result() records the blocking wait as device_sync
         traces = tracing.current_traces()
         t_dev0 = time.perf_counter()
         outcome = "cache_hit"
@@ -700,4 +718,8 @@ class JaxBls12381(BLS12381):
                     compilecache.delta(cache_before))
             _M_JIT.labels(shape=shape, outcome=outcome,
                           path=mont_path).inc()
-        return _DispatchHandle(ok, lane_ok, n, t_dev0, traces)
+            t_enq_end = time.perf_counter()
+            tracing.record_stage("device_enqueue", t_enq_end - t_dev0,
+                                 traces)
+        return _DispatchHandle(ok, lane_ok, n, traces, shape,
+                               mont_path, t_enq_end)

@@ -41,7 +41,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..crypto import bls
-from ..infra import faults, flightrecorder, tracing
+from ..infra import capacity, faults, flightrecorder, tracing
 from ..infra.metrics import (GLOBAL_REGISTRY, LATENCY_BUCKETS_S,
                              MetricsRegistry)
 
@@ -49,7 +49,7 @@ Triple = Tuple[Sequence[bytes], bytes, bytes]
 
 _LOG = logging.getLogger(__name__)
 
-# Overlap host_prep of batch N+1 with device_execute of batch N: the
+# Overlap host_prep of batch N+1 with device execution of batch N: the
 # worker begins (host_prep + async device enqueue) the next batch
 # BEFORE synchronizing the previous one — JAX async dispatch keeps the
 # device busy while the host packs arrays.  Engages only when the
@@ -224,6 +224,11 @@ class AggregatingSignatureVerificationService:
             pending.waiters.append(fut)
             self._m_coalesced.inc()
             return fut
+        # capacity input: demand is OFFERED load — a shed arrival is
+        # still demand (counting only accepted work would read
+        # utilization low during exactly the overload the headroom-
+        # exhausted event exists to flag)
+        capacity.record_arrival(self._name, len(triples))
         try:
             # `sigservice.enqueue` fault site: Overflow injection proves
             # the shed path (metrics + WARN) without a 15k-deep queue
@@ -233,8 +238,11 @@ class AggregatingSignatureVerificationService:
                 trace=tracing.current_trace(), key=key)
             self._queue.put_nowait(task)
             self._pending[key] = task
+            # the queue-depth time series the admin endpoint serves
+            capacity.record_queue_depth(self._queue.qsize())
         except asyncio.QueueFull:
             self._m_rejected.inc()
+            capacity.record_shed(len(triples))
             flightrecorder.record(
                 "queue_shed", service=self._name,
                 queue_size=self._queue.qsize(),
@@ -260,7 +268,12 @@ class AggregatingSignatureVerificationService:
                 "capacity": self.queue_capacity,
                 "saturation": qsize / self.queue_capacity,
                 "workers": len(self._workers),
-                "stalled_s": stalled_s}
+                "stalled_s": stalled_s,
+                # the derived capacity signals (arrival rate,
+                # utilization, headroom, occupancy) the SLO engine and
+                # the future adaptive batcher consume — full per-shape
+                # detail lives on /teku/v1/admin/capacity
+                "capacity_model": capacity.summary()}
 
     # ------------------------------------------------------------------
     async def _worker(self) -> None:
@@ -335,6 +348,9 @@ class AggregatingSignatureVerificationService:
                 break
             tasks.append(nxt)
             budget -= len(nxt.triples)
+        # drain-side depth sample: the series shows both the burst
+        # build-up (enqueue stamps) and the worker's drawdown
+        capacity.record_queue_depth(self._queue.qsize())
         if tracing.enabled():
             # per-task attribution: each task experienced its own
             # queue-wait and the whole batch's assembly time
@@ -367,7 +383,8 @@ class AggregatingSignatureVerificationService:
         """Synchronize an in-flight dispatch and settle its tasks
         (bisecting failures through the sync path)."""
         try:
-            # the handle records the device_execute span itself (it
+            # the handle records the device_enqueue/device_sync spans
+            # itself (it
             # captured the batch's traces at dispatch time)
             ok = await asyncio.to_thread(handle.result)
         except asyncio.CancelledError:
@@ -413,7 +430,8 @@ class AggregatingSignatureVerificationService:
             kind="first_try" if first_try else "bisect").inc()
         # the dispatch runs with the whole batch's traces bound to the
         # context: asyncio.to_thread copies it, so the provider's
-        # host_prep/device_execute spans attribute to every trace
+        # host_prep/device_enqueue/device_sync spans attribute to
+        # every trace
         t0 = time.perf_counter()
         with tracing.attach([t.trace for t in tasks]):
             with tracing.span("dispatch"):

@@ -82,7 +82,8 @@ class BatchSignatureVerifier(SignatureVerifier):
             return True
         faults.check("verifiers.dispatch")
         # root span per imported block's signature batch — the
-        # provider's host_prep/device_execute spans nest inside
+        # provider's host_prep/device_enqueue/device_sync spans nest
+        # inside
         with tracing.trace("verify", kind="block_import",
                            jobs=str(len(self._jobs))):
             with tracing.span("dispatch"):

@@ -1,0 +1,154 @@
+"""tools/bench_diff.py — the bench regression gate — plus bench.py's
+rolling BENCH_TRAJECTORY.json (append-only per run id)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import bench  # noqa: E402
+from tools import bench_diff  # noqa: E402
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+BASE = os.path.join(FIXTURES, "bench_base.json")
+REGRESSED = os.path.join(FIXTURES, "bench_regressed.json")
+BENCH_R05 = os.path.join(os.path.dirname(__file__), "..",
+                         "BENCH_r05.json")
+
+
+def _by_metric(out):
+    return {c["metric"]: c for c in out["checks"]}
+
+
+def test_regressed_fixture_is_flagged():
+    out = bench_diff.compare(bench_diff.load_result(BASE),
+                             bench_diff.load_result(REGRESSED))
+    assert out["verdict"] == "regression"
+    checks = _by_metric(out)
+    # 46.1 -> 31.8 sigs/sec is far past the 10% tolerance
+    assert checks["sigs_per_sec"]["status"] == "regression"
+    # the guilty stage is named, not just the headline
+    assert checks["stage_p50_ms.device_sync"]["status"] == "regression"
+    # host_prep barely moved: not flagged
+    assert checks["stage_p50_ms.host_prep"]["status"] == "ok"
+    # shape 256 was cache-loaded in base but recompiled in new
+    cache = checks["compile_cache_serving"]
+    assert cache["status"] == "regression" and cache["new"] == ["256"]
+    # dedup gates: 8x speedup fell under 1.5, warm pass dispatched h2c
+    assert checks["dedup_speedup_8x"]["status"] == "regression"
+    assert checks["warm_h2c_dispatches"]["status"] == "regression"
+
+
+def test_base_vs_itself_passes():
+    base = bench_diff.load_result(BASE)
+    out = bench_diff.compare(base, base)
+    assert out["verdict"] == "pass"
+    assert out["regressions"] == 0
+    assert _by_metric(out)["sigs_per_sec"]["ratio"] == 1.0
+
+
+def test_current_bench_r05_vs_itself_passes():
+    """The acceptance gate: the checked-in BENCH_r05 (driver envelope
+    with a `parsed` key, budget-starved phases missing) must compare
+    clean against itself — absent metrics are skipped, never failed."""
+    r05 = bench_diff.load_result(BENCH_R05)
+    assert r05["metric"] == "bls_verify_sigs_per_sec"   # unwrapped
+    out = bench_diff.compare(r05, r05)
+    assert out["verdict"] == "pass"
+    checks = _by_metric(out)
+    # r05 predates the dedup-sweep/latency_stages evidence: skipped
+    assert checks["dedup_speedup_8x"]["status"] == "skipped"
+    assert checks["sigs_per_sec"]["status"] == "ok"
+
+
+def test_threshold_override_changes_verdict():
+    base = bench_diff.load_result(BASE)
+    slower = dict(base)
+    slower["value"] = base["value"] * 0.85        # -15%
+    assert bench_diff.compare(base, slower)["verdict"] == "regression"
+    out = bench_diff.compare(base, slower,
+                             {"sigs_per_sec": 0.2, "p50_ms": 10.0,
+                              "p99_ms": 10.0, "stage_p50_ms": 10.0})
+    assert _by_metric(out)["sigs_per_sec"]["status"] == "ok"
+
+
+def test_cli_exit_codes_and_json(tmp_path, capsys):
+    assert bench_diff.main([BASE, BASE]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["verdict"] == "pass"
+    assert bench_diff.main([BASE, REGRESSED, "--quiet"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["verdict"] == "regression"
+    assert "sigs_per_sec" in out["failed"]
+    # IO errors are a distinct exit code with a JSON error line
+    assert bench_diff.main([BASE, str(tmp_path / "missing.json")]) == 2
+    assert json.loads(capsys.readouterr().out)["verdict"] == "error"
+
+
+# --------------------------------------------------------------------------
+# BENCH_TRAJECTORY.json: rolling, append-only per run id
+# --------------------------------------------------------------------------
+
+def _result(value=46.1):
+    return {"value": value, "best_batch": 256, "device": "cpu",
+            "p50_ms": 5210.4, "p99_ms": 7102.9,
+            "latency_stages": {
+                "device_sync": {"p50_ms": 4801.0, "n": 500}},
+            "detail": {"256": {"cache_load_s": 207.3}},
+            "h2c_dedup": {"factors": {"8": {"speedup_vs_1x": 1.57}},
+                          "warm": {"h2c_dispatches": 0}},
+            "capacity": {"occupancy_ratio": 0.91}}
+
+
+def test_trajectory_appends_and_refuses_same_run_id(tmp_path):
+    path = str(tmp_path / "BENCH_TRAJECTORY.json")
+    assert bench.append_trajectory(_result(46.1), path=path,
+                                   run_id="r06") == "appended"
+    assert bench.append_trajectory(_result(50.0), path=path,
+                                   run_id="r07") == "appended"
+    # the same run id must NOT rewrite history the gate already cited
+    assert bench.append_trajectory(_result(99.9), path=path,
+                                   run_id="r06") == "duplicate_run_id"
+    doc = json.load(open(path))
+    assert [e["run_id"] for e in doc["entries"]] == ["r06", "r07"]
+    entry = doc["entries"][0]
+    assert entry["sigs_per_sec"] == 46.1
+    assert entry["stage_p50_ms"]["device_sync"] == 4801.0
+    assert entry["cache_load_s"] == 207.3 and entry["compile_s"] == 0.0
+    assert entry["dedup_speedup_8x"] == 1.57
+    assert entry["warm_h2c_dispatches"] == 0
+
+
+def test_trajectory_is_bounded_and_comparable(tmp_path):
+    path = str(tmp_path / "BENCH_TRAJECTORY.json")
+    for i in range(7):
+        assert bench.append_trajectory(
+            _result(40.0 + i), path=path, run_id=f"r{i:02d}",
+            max_entries=5) == "appended"
+    doc = json.load(open(path))
+    assert len(doc["entries"]) == 5
+    assert doc["entries"][-1]["run_id"] == "r06"
+    # trajectory entries feed straight back into the diff gate
+    out = bench_diff.compare(doc["entries"][0], doc["entries"][-1])
+    assert _by_metric(out)["sigs_per_sec"]["status"] == "ok"
+
+
+def test_trajectory_corrupt_file_aborts_without_overwrite(tmp_path):
+    """An EXISTING but unreadable trajectory must abort the append —
+    silently restarting history would overwrite the record a
+    regression gate already cited.  A missing file (first run) still
+    starts fresh."""
+    path = tmp_path / "BENCH_TRAJECTORY.json"
+    path.write_text("not json{{{")
+    out = bench.append_trajectory(_result(), path=str(path),
+                                  run_id="r01")
+    assert out.startswith("error:")
+    assert path.read_text() == "not json{{{"    # untouched
+    missing = tmp_path / "fresh" / "BENCH_TRAJECTORY.json"
+    missing.parent.mkdir()
+    assert bench.append_trajectory(_result(), path=str(missing),
+                                   run_id="r01") == "appended"
+    assert len(json.load(open(missing))["entries"]) == 1

@@ -1,0 +1,498 @@
+"""Capacity & occupancy telemetry: windowed rate estimators, the
+per-shape device-latency model, true device-time attribution under
+async overlap, the admin capacity endpoint, and triggered profiler
+capture."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from teku_tpu.crypto import bls
+from teku_tpu.infra import capacity, profiling, tracing
+from teku_tpu.infra.capacity import (CapacityTelemetry,
+                                     DeviceOccupancyTracker,
+                                     QueueDepthSeries, RateEstimator,
+                                     ShapeLatencyModel)
+from teku_tpu.infra.flightrecorder import FlightRecorder
+from teku_tpu.infra.metrics import MetricsRegistry
+from teku_tpu.services.signatures import (
+    AggregatingSignatureVerificationService)
+
+
+class FakeClock:
+    """Injectable monotonic clock: tests advance time explicitly, so
+    windowed-decay behavior is deterministic without sleeps."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _tracing_reset():
+    tracing.set_enabled(True)
+    yield
+    tracing.set_enabled(True)
+    tracing.clear_slow_traces()
+
+
+# --------------------------------------------------------------------------
+# Rate estimator
+# --------------------------------------------------------------------------
+
+def test_rate_estimator_empty_window_is_zero():
+    est = RateEstimator(window_s=10.0, clock=FakeClock())
+    assert est.rate() == 0.0
+    assert est.total() == 0.0
+
+
+def test_rate_estimator_windowed_decay_under_bursty_arrivals():
+    clock = FakeClock()
+    est = RateEstimator(window_s=10.0, buckets=10, clock=clock)
+    # a burst of 100 events lands in one instant
+    for _ in range(100):
+        est.record()
+    assert est.rate() == pytest.approx(10.0)        # 100 / 10s window
+    # half a window later the burst still counts in full...
+    clock.advance(5.0)
+    assert est.rate() == pytest.approx(10.0)
+    # ...and exactly one window later it has decayed out wholesale
+    clock.advance(6.0)
+    assert est.rate() == 0.0
+    # steady trickle after the burst: only the windowed events count
+    for _ in range(5):
+        est.record(2.0)
+        clock.advance(1.0)
+    assert est.total() == pytest.approx(10.0)
+    assert est.rate() == pytest.approx(1.0)
+
+
+def test_rate_estimator_memory_is_bounded_by_bucket_count():
+    clock = FakeClock()
+    est = RateEstimator(window_s=10.0, buckets=10, clock=clock)
+    for _ in range(10_000):
+        est.record()
+        clock.advance(0.001)
+    assert len(est._buckets) <= 11
+
+
+def test_queue_depth_series_tracks_current_and_history():
+    series = QueueDepthSeries(capacity=4)
+    for depth in (1, 5, 3, 7, 2):
+        series.record(depth)
+    assert series.current == 2
+    snap = series.snapshot()
+    assert [s["depth"] for s in snap] == [5, 3, 7, 2]   # bounded ring
+    assert all("t_wall" in s for s in snap)
+
+
+# --------------------------------------------------------------------------
+# Per-shape latency model
+# --------------------------------------------------------------------------
+
+def test_shape_latency_model_ewma_and_percentiles():
+    model = ShapeLatencyModel(alpha=0.5, window=64,
+                              registry=MetricsRegistry())
+    for v in (0.010, 0.010, 0.010, 0.010, 0.100):
+        model.observe("256x1", "vpu", v)
+    snap = model.snapshot()["256x1"]["vpu"]
+    assert snap["samples"] == 5
+    assert snap["p50_s"] == pytest.approx(0.010)
+    assert snap["p95_s"] == pytest.approx(0.100)
+    # alpha=0.5 EWMA after 4x10ms then one 100ms: (10+100)/2-ish
+    assert 0.03 < snap["ewma_s"] < 0.07
+    assert model.latency_s("256x1", "vpu") == snap["p50_s"]
+    assert model.latency_s("999x9", "vpu") is None
+
+
+def test_shape_latency_model_bounds_label_cardinality():
+    reg = MetricsRegistry()
+    model = ShapeLatencyModel(max_shapes=4, registry=reg)
+    for i in range(10):
+        model.observe(f"{2 ** i}x1", "vpu", 0.001 * (i + 1))
+    shapes = set(model.snapshot())
+    # 4 real shapes + the overflow bucket, never 10
+    assert len(shapes) == 5
+    assert ShapeLatencyModel.OVERFLOW in shapes
+    # the exported gauge family carries the same bounded vocabulary
+    gauge = reg.metrics()["bls_shape_device_latency_seconds"]
+    label_shapes = {key[0] for key, _ in gauge._items()}
+    assert label_shapes == shapes
+    # overflow absorbed the 6 extra shapes' samples
+    overflow = model.snapshot()[ShapeLatencyModel.OVERFLOW]["vpu"]
+    assert overflow["samples"] == 6
+
+
+# --------------------------------------------------------------------------
+# Occupancy under overlap
+# --------------------------------------------------------------------------
+
+def test_occupancy_tracker_clamps_overlapping_dispatches():
+    clock = FakeClock()
+    occ = DeviceOccupancyTracker(window_s=10.0, clock=clock)
+    # dispatch A: device busy 1.0 → 3.0
+    assert occ.record(1.0, 3.0) == pytest.approx(2.0)
+    # dispatch B was ENQUEUED at 2.0 while A executed; its true device
+    # time starts only when A's program finished (3.0) — the wall
+    # interval overlaps, the device time must not double-count
+    assert occ.record(2.0, 4.5) == pytest.approx(1.5)
+    assert occ.busy_seconds() == pytest.approx(3.5)
+    assert occ.occupancy() == pytest.approx(0.35)
+    # an interval fully covered by prior busy time contributes zero
+    assert occ.record(3.0, 4.0) == 0.0
+
+
+def test_occupancy_is_capped_at_one():
+    clock = FakeClock()
+    occ = DeviceOccupancyTracker(window_s=2.0, clock=clock)
+    occ.record(0.0, 10.0)
+    assert occ.occupancy() == 1.0
+
+
+# --------------------------------------------------------------------------
+# Combined capacity model
+# --------------------------------------------------------------------------
+
+def _telemetry(clock=None, recorder=None):
+    return CapacityTelemetry(registry=MetricsRegistry(),
+                             window_s=10.0,
+                             clock=clock or FakeClock(),
+                             recorder=recorder or FlightRecorder(
+                                 registry=MetricsRegistry()))
+
+
+def test_capacity_utilization_and_headroom_math():
+    clock = FakeClock()
+    tel = _telemetry(clock)
+    # demand: 200 triples over the 10s window = 20/s
+    tel.record_arrival("gossip", 120)
+    tel.record_arrival("api", 80)
+    # supply evidence: 256 lanes verified in 2.56s of device time
+    # → 100 sigs/sec sustainable
+    tel.record_dispatch("256x1", "vpu", 256, enqueue_end=1.0,
+                        sync_end=3.56)
+    assert tel.demand_sigs_per_second() == pytest.approx(20.0)
+    assert tel.sustainable_sigs_per_second() == pytest.approx(100.0)
+    assert tel.utilization() == pytest.approx(0.2)
+    assert tel.headroom() == pytest.approx(0.8)
+    snap = tel.snapshot()
+    assert snap["arrival_rate_per_second"] == {"gossip": 12.0,
+                                               "api": 8.0}
+    assert snap["derived"]["headroom_sigs_per_second"] \
+        == pytest.approx(80.0)
+    assert snap["shapes"]["256x1"]["vpu"]["samples"] == 1
+
+
+def test_capacity_utilization_falls_back_to_occupancy():
+    tel = _telemetry()
+    # no dispatch evidence at all: utilization must not divide by zero
+    tel.record_arrival("gossip", 50)
+    assert tel.sustainable_sigs_per_second() == 0.0
+    assert tel.utilization() == tel.occupancy.occupancy() == 0.0
+
+
+def test_headroom_exhausted_event_is_edge_triggered_with_trace_id():
+    clock = FakeClock()
+    rec = FlightRecorder(registry=MetricsRegistry())
+    tel = _telemetry(clock, rec)
+    # capacity 10 sigs/sec (10 lanes in 1s device time), demand 40/s
+    tel.record_dispatch("8x1", "vpu", 10, enqueue_end=0.0, sync_end=1.0)
+    tel.record_arrival("gossip", 400)
+    tr = tracing.new_trace("overloaded_verify")
+    with tracing.attach((tr,)):
+        snap = tel.refresh()
+    tracing.finish(tr)
+    assert snap["derived"]["utilization"] > 1.0
+    assert snap["derived"]["headroom_exhausted"] is True
+    events = [e for e in rec.snapshot()
+              if e["kind"] == "capacity_headroom_exhausted"]
+    assert len(events) == 1
+    assert events[0]["trace_id"] == tr.trace_id
+    assert events[0]["demand_sigs_per_second"] > \
+        events[0]["capacity_sigs_per_second"]
+    # still exhausted: NO second event (edge, not level)
+    tel.refresh()
+    assert len([e for e in rec.snapshot()
+                if e["kind"] == "capacity_headroom_exhausted"]) == 1
+    # demand decays out of the window → one recovery event
+    clock.advance(11.0)
+    tel.record_dispatch("8x1", "vpu", 10, enqueue_end=clock.t,
+                        sync_end=clock.t + 1.0)
+    tel.refresh()
+    kinds = [e["kind"] for e in rec.snapshot()]
+    assert kinds.count("capacity_headroom_recovered") == 1
+
+
+# --------------------------------------------------------------------------
+# Attribution split: device_sync excludes host-prep overlap
+# --------------------------------------------------------------------------
+
+class _RealHandleImpl:
+    """BLS impl whose async begin returns the provider's REAL
+    _DispatchHandle over already-materialized numpy verdict arrays —
+    the genuine device_sync span + capacity feed run without a device
+    dispatch."""
+
+    def __init__(self, host_prep_s: float = 0.05):
+        self.host_prep_s = host_prep_s
+        self.begins = 0
+
+    def begin_batch_verify(self, triples):
+        from teku_tpu.ops.provider import _DispatchHandle
+        self.begins += 1
+        with tracing.span("host_prep"):
+            time.sleep(self.host_prep_s)
+        n = len(triples)
+        traces = tracing.current_traces()
+        t_enq_end = time.perf_counter()
+        tracing.record_stage("device_enqueue", 0.0, traces)
+        return _DispatchHandle(
+            np.True_, np.ones(max(n, 1), dtype=bool), n, traces,
+            shape=f"{n}x1", path="vpu", t_enq_end=t_enq_end)
+
+    def batch_verify(self, triples):
+        return True
+
+    def fast_aggregate_verify(self, pks, msg, sig):
+        return True
+
+
+def test_device_sync_excludes_host_prep_overlap(monkeypatch):
+    """The PERF.md:229 caveat, fixed end-to-end: under
+    TEKU_TPU_ASYNC_OVERLAP=1 the worker host_preps batch N+1 between
+    batch N's enqueue and its sync.  The old combined device span
+    started at enqueue and absorbed that host-prep time; the new
+    device_sync span covers ONLY the blocking wait, so its p50 must
+    sit far below the deliberately slow host_prep."""
+    monkeypatch.setenv("TEKU_TPU_ASYNC_OVERLAP", "1")
+    impl = _RealHandleImpl(host_prep_s=0.05)
+    traces = []
+
+    async def main():
+        bls.set_implementation(impl)
+        try:
+            svc = AggregatingSignatureVerificationService(
+                num_workers=1, max_batch_size=1,
+                registry=MetricsRegistry(), name="cap_overlap")
+            assert svc.overlap is True          # read from the env
+            await svc.start()
+            futs = []
+            for i in range(6):
+                tr = tracing.new_trace("overlap_verify")
+                traces.append(tr)
+                with tracing.attach((tr,)):
+                    futs.append(svc.verify(
+                        [b"\xa0" + bytes(47)], b"m%d" % i, b"sig"))
+            assert all(await asyncio.gather(*futs))
+            await svc.stop()
+        finally:
+            bls.reset_implementation()
+        for tr in traces:
+            tracing.finish(tr)
+
+    asyncio.run(main())
+    assert impl.begins >= 2, "overlap path never engaged"
+    syncs, preps = [], []
+    for tr in traces:
+        for stage, dur in tr.stages:
+            if stage == "device_sync":
+                syncs.append(dur)
+            elif stage == "host_prep":
+                preps.append(dur)
+    assert syncs and preps
+    p50 = sorted(syncs)[len(syncs) // 2]
+    # host_prep really was slow (the overlap work existed)...
+    assert sorted(preps)[len(preps) // 2] >= 0.04
+    # ...and device_sync did NOT absorb it (the old combined span
+    # would have measured >= host_prep_s here)
+    assert p50 < 0.025, f"device_sync p50 {p50:.3f}s includes overlap"
+
+
+def test_dispatch_handle_feeds_capacity_shapes():
+    """result() routes the overlap-corrected interval into the global
+    capacity telemetry keyed by {shape, path}."""
+    from teku_tpu.ops.provider import _DispatchHandle
+    before = capacity.TELEMETRY.latency.snapshot().get(
+        "16x2", {}).get("vpu", {}).get("samples", 0)
+    h = _DispatchHandle(np.True_, np.ones(16, dtype=bool), 16, (),
+                        shape="16x2", path="vpu",
+                        t_enq_end=time.perf_counter())
+    assert h.result() is True
+    assert h.result() is True     # idempotent: records once
+    after = capacity.TELEMETRY.latency.snapshot()["16x2"]["vpu"]
+    assert after["samples"] == before + 1
+
+
+# --------------------------------------------------------------------------
+# Service + endpoint integration
+# --------------------------------------------------------------------------
+
+def test_admin_capacity_endpoint_serves_live_dispatch_model():
+    """Service-level acceptance: live dispatches through the batching
+    service land in the per-shape latency model, and the admin
+    endpoint serves them with the utilization/headroom derivation."""
+    from teku_tpu.api import BeaconRestApi
+
+    impl = _RealHandleImpl(host_prep_s=0.0)
+
+    async def main():
+        bls.set_implementation(impl)
+        try:
+            svc = AggregatingSignatureVerificationService(
+                num_workers=1, registry=MetricsRegistry(),
+                name="cap_endpoint", overlap=True)
+            await svc.start()
+            futs = [svc.verify([b"\xa0" + bytes(47)], b"c%d" % i,
+                               b"sig") for i in range(4)]
+            assert all(await asyncio.gather(*futs))
+            snap = svc.health_snapshot()
+            await svc.stop()
+        finally:
+            bls.reset_implementation()
+        api = BeaconRestApi(None)
+        return snap, (await api._admin_capacity())["data"]
+
+    snap, data = asyncio.run(main())
+    # the service's health snapshot embeds the derived capacity view
+    model = snap["capacity_model"]
+    assert {"arrival_rate_per_second", "capacity_sigs_per_second",
+            "utilization", "headroom_ratio",
+            "occupancy_ratio"} <= set(model)
+    assert model["arrival_rate_per_second"] > 0
+    # the endpoint serves the full detail: this service's arrivals,
+    # the per-shape model fed by its dispatch handles, and the
+    # derived signals
+    assert data["arrival_rate_per_second"]["cap_endpoint"] > 0
+    shapes = {(s, p) for s, paths in data["shapes"].items()
+              for p in paths}
+    assert any(p == "vpu" for _, p in shapes)
+    derived = data["derived"]
+    assert derived["capacity_sigs_per_second"] >= 0
+    assert 0.0 <= derived["headroom_ratio"] <= 1.0
+    assert "headroom_exhausted" in derived
+    assert data["queue_depth"]["series"]
+
+
+# --------------------------------------------------------------------------
+# Profiler capture
+# --------------------------------------------------------------------------
+
+class _FakeProfilerBackend:
+    def __init__(self, fail_start: bool = False):
+        self.fail_start = fail_start
+        self.calls = []
+
+    def start(self, log_dir):
+        if self.fail_start:
+            raise RuntimeError("no profiler here")
+        self.calls.append(("start", log_dir))
+
+    def stop(self):
+        self.calls.append(("stop",))
+
+
+def _controller(tmp_path, clock, backend=None, rec=None, **kw):
+    return profiling.ProfilerController(
+        backend=backend or _FakeProfilerBackend(),
+        out_dir=str(tmp_path), clock=clock,
+        registry=MetricsRegistry(),
+        recorder=rec or FlightRecorder(registry=MetricsRegistry()),
+        cooldown_s=60.0, auto_duration_s=2.0, burn_threshold=1.0,
+        **kw)
+
+
+def test_profiler_manual_start_stop_records_flight_events(tmp_path):
+    clock = FakeClock()
+    rec = FlightRecorder(registry=MetricsRegistry())
+    ctl = _controller(tmp_path, clock, rec=rec)
+    tr = tracing.new_trace("profiled_verify")
+    with tracing.attach((tr,)):
+        out = ctl.start()
+    tracing.finish(tr)
+    assert out["trigger"] == "manual" and "path" in out
+    assert ctl.status()["active"] is True
+    # a second start while active is refused, not stacked
+    assert "error" in ctl.start()
+    clock.advance(3.0)
+    done = ctl.stop()
+    assert done["duration_s"] == pytest.approx(3.0)
+    assert ctl.status()["active"] is False
+    assert ctl.status()["last"]["path"] == out["path"]
+    assert "error" in ctl.stop()              # nothing active anymore
+    kinds = [(e["kind"], e.get("trace_id")) for e in rec.snapshot()]
+    assert ("profiler_capture_start", tr.trace_id) in kinds
+    assert any(k == "profiler_capture_stop" for k, _ in kinds)
+
+
+def test_profiler_burn_trigger_cooldown_and_auto_stop(tmp_path):
+    clock = FakeClock()
+    rec = FlightRecorder(registry=MetricsRegistry())
+    ctl = _controller(tmp_path, clock, rec=rec)
+    # below threshold / wrong objective: no capture
+    assert not ctl.maybe_trigger("attestation_verify_p50", 0.9)
+    assert not ctl.maybe_trigger("verify_success_ratio", 99.0)
+    # burning: one auto capture starts...
+    assert ctl.maybe_trigger("attestation_verify_p50", 5.0)
+    assert ctl.status()["capture"]["trigger"] == "burn_rate"
+    # ...the tick's poll stops it after auto_duration_s...
+    clock.advance(1.0)
+    ctl.poll()
+    assert ctl.status()["active"] is True
+    clock.advance(1.5)
+    ctl.poll({"attestation_verify_p50": {"burn_rate": 5.0}})
+    assert ctl.status()["active"] is False
+    # ...and the cooldown suppresses a re-trigger (even via poll)
+    ctl.poll({"attestation_verify_p50": {"burn_rate": 5.0}})
+    assert ctl.status()["active"] is False
+    # past the cooldown the trigger arms again
+    clock.advance(61.0)
+    assert ctl.maybe_trigger("attestation_verify_p50", 5.0)
+    starts = [e for e in rec.snapshot()
+              if e["kind"] == "profiler_capture_start"]
+    assert len(starts) == 2
+    assert all(e["trigger"] == "burn_rate" for e in starts)
+
+
+def test_profiler_start_failure_degrades_cleanly(tmp_path):
+    clock = FakeClock()
+    rec = FlightRecorder(registry=MetricsRegistry())
+    ctl = _controller(tmp_path, clock, rec=rec,
+                      backend=_FakeProfilerBackend(fail_start=True))
+    out = ctl.start()
+    assert "error" in out
+    assert ctl.status()["active"] is False
+    assert any(e["kind"] == "profiler_capture_error"
+               for e in rec.snapshot())
+
+
+def test_admin_profile_endpoint(tmp_path, monkeypatch):
+    from teku_tpu.api import BeaconRestApi
+    from teku_tpu.infra.restapi import HttpError
+
+    clock = FakeClock()
+    ctl = _controller(tmp_path, clock)
+    monkeypatch.setattr(profiling, "CONTROLLER", ctl)
+    api = BeaconRestApi(None)
+
+    async def main():
+        status = (await api._admin_profile())["data"]
+        assert status["active"] is False
+        started = (await api._admin_profile(
+            query={"start": "1", "duration_s": "2"}))["data"]
+        assert started["trigger"] == "manual"
+        assert started["stop_after_s"] == 2.0
+        with pytest.raises(HttpError):
+            await api._admin_profile(query={"start": "1",
+                                            "duration_s": "nope"})
+        stopped = (await api._admin_profile(query={"stop": "1"}))["data"]
+        assert stopped["path"] == started["path"]
+        assert (await api._admin_profile())["data"]["active"] is False
+
+    asyncio.run(main())

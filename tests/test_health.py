@@ -347,8 +347,13 @@ def test_real_signature_service_health_snapshot():
         queue_capacity=10, registry=MetricsRegistry(),
         name="t_health_sigs")
     snap = svc.health_snapshot()
+    capacity_model = snap.pop("capacity_model")
     assert snap == {"queue_size": 0, "capacity": 10, "saturation": 0.0,
                     "workers": 0, "stalled_s": 0.0}
+    # the embedded capacity view (infra/capacity.py) rides along for
+    # the SLO engine / adaptive batcher
+    assert {"utilization", "headroom_ratio",
+            "occupancy_ratio"} <= set(capacity_model)
 
 
 def test_supervisor_check_states(tmp_path):

@@ -335,6 +335,76 @@ def test_h2c_dedup_and_coalesce_family_naming_lint():
     assert 0.0 <= _dedup_ratio() < 1.0
 
 
+def test_capacity_profiler_family_naming_lint():
+    """The capacity/occupancy + profiler families must not drift:
+    HELP/TYPE pairing on the exposition, counters ``_total``, durations
+    ``_seconds``, ratios ``_ratio`` / rates ``_per_second``, and a
+    BOUNDED ``shape`` label cardinality on the device-latency model
+    (pow-2 bucketing keeps the real set tiny; an adversarial shape
+    storm must fold into "other", never grow the scrape)."""
+    from teku_tpu.infra import capacity, profiling  # noqa: F401
+    from teku_tpu.infra.capacity import ShapeLatencyModel
+    from teku_tpu.infra.metrics import GLOBAL_REGISTRY
+
+    metrics = GLOBAL_REGISTRY.metrics()
+    assert {"bls_shape_device_latency_seconds",
+            "bls_arrival_rate_per_second", "bls_queue_depth",
+            "bls_device_occupancy_ratio",
+            "capacity_shed_rate_per_second",
+            "capacity_sustainable_sigs_per_second",
+            "capacity_utilization_ratio", "capacity_headroom_ratio",
+            "profiler_captures_total"} <= set(metrics)
+    lat = metrics["bls_shape_device_latency_seconds"]
+    assert isinstance(lat, LabeledGauge)
+    assert tuple(lat.labelnames) == ("shape", "path", "stat")
+    arrival = metrics["bls_arrival_rate_per_second"]
+    assert isinstance(arrival, LabeledGauge)
+    assert tuple(arrival.labelnames) == ("source",)
+    captures = metrics["profiler_captures_total"]
+    assert isinstance(captures, LabeledCounter)
+    assert tuple(captures.labelnames) == ("trigger",)
+
+    problems = []
+    for name, m in metrics.items():
+        if not name.startswith(("capacity_", "profiler_",
+                                "bls_shape_", "bls_arrival_",
+                                "bls_device_occupancy")):
+            continue
+        if isinstance(m, (Counter, LabeledCounter)) \
+                and not name.endswith("_total"):
+            problems.append(f"counter {name} must end _total")
+        if name.endswith("_total") \
+                and not isinstance(m, (Counter, LabeledCounter)):
+            problems.append(f"{name} ends _total but is not a counter")
+        if _DURATION_HINT.search(name) and not name.endswith("_seconds"):
+            problems.append(f"duration metric {name} must end _seconds")
+        if isinstance(m, (Gauge, LabeledGauge)) \
+                and not name.endswith(
+                    ("_seconds", "_ratio", "_per_second", "_depth")):
+            problems.append(
+                f"gauge {name} needs a unit suffix (_seconds, _ratio, "
+                "_per_second)")
+    assert not problems, "\n".join(problems)
+
+    # bounded `shape` cardinality: 40 distinct shapes collapse to the
+    # model's cap + the "other" overflow series, on the exported gauge
+    reg = MetricsRegistry()
+    model = ShapeLatencyModel(max_shapes=8, registry=reg)
+    for i in range(40):
+        model.observe(f"{i}x{i}", "vpu", 0.001)
+    gauge = reg.metrics()["bls_shape_device_latency_seconds"]
+    shapes = {key[0] for key, _ in gauge._items()}
+    assert len(shapes) == 9 and ShapeLatencyModel.OVERFLOW in shapes
+
+    # the exposition stays structurally valid (HELP/TYPE pairing) with
+    # every new family present
+    fams = parse_exposition(GLOBAL_REGISTRY.expose())
+    for fam in ("bls_shape_device_latency_seconds",
+                "capacity_utilization_ratio",
+                "profiler_captures_total"):
+        assert fam in fams and fams[fam]["type"] is not None
+
+
 def test_slo_health_family_naming_lint():
     """The PR-3 families must not drift from the conventions: states as
     labeled/state gauges (never bare numbers encoding an enum), burn

@@ -47,7 +47,7 @@ def test_worker_thread_and_asyncio_task_land_in_same_trace():
                 await asyncio.sleep(0)
 
             def thread_stage():
-                with tracing.span("device_execute"):
+                with tracing.span("device_sync"):
                     time.sleep(0.001)
 
             await asyncio.to_thread(thread_stage)
@@ -62,8 +62,8 @@ def test_worker_thread_and_asyncio_task_land_in_same_trace():
 
     tr = asyncio.run(run())
     stages = dict(tr.stages)
-    assert set(stages) == {"assembly", "device_execute", "queue_wait"}
-    assert stages["device_execute"] >= 0.001
+    assert set(stages) == {"assembly", "device_sync", "queue_wait"}
+    assert stages["device_sync"] >= 0.001
     assert tr.complete and tr.total_s >= 0.001
 
 

@@ -1,0 +1,2 @@
+"""Operator/CI tooling that lives beside the repo, not inside the
+node package: bench comparison (bench_diff) and friends."""

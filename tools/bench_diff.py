@@ -1,0 +1,215 @@
+"""bench_diff — the bench regression gate.
+
+Compares any two bench result JSONs (``BENCH_r*.json`` — raw bench
+output or the driver wrapper with a ``parsed`` key — or one entry of
+``BENCH_TRAJECTORY.json``) metric by metric with per-metric thresholds
+and emits a machine-readable verdict:
+
+    python tools/bench_diff.py BENCH_r04.json BENCH_r05.json
+    python tools/bench_diff.py base.json new.json \
+        --threshold sigs_per_sec=0.2 --quiet
+
+Exit code 0 = pass, 1 = regression, 2 = usage/IO error.  The JSON
+verdict on stdout is the contract future perf PRs (ROADMAP items 1, 2,
+5) cite as their regression gate:
+
+    {"verdict": "pass" | "regression",
+     "regressions": <n>,
+     "checks": [{"metric", "base", "new", "ratio", "threshold",
+                 "direction", "status": "ok"|"regression"|"skipped"},
+                ...]}
+
+Checked metrics (a metric missing on either side is ``skipped``, never
+a failure — budget-starved runs drop phases):
+
+- ``sigs_per_sec`` (higher is better): flag when new < base·(1-thr);
+- ``p50_ms`` / ``p99_ms`` and every per-stage p50 in
+  ``latency_stages`` (lower is better): flag when new > base·(1+thr);
+- compile-cache accounting: a shape the base run served as
+  ``cache_load_s`` that the new run paid as ``compile_s`` again means
+  the persistent cache stopped serving (absolute check);
+- dedup gates (absolute): ``h2c_dedup`` 8x speedup ≥ 1.5 and the
+  fully-warm pass's ``h2c_dispatches == 0`` — the PR-5 acceptance
+  properties must not silently rot.
+"""
+
+import argparse
+import json
+import sys
+from typing import Dict, Optional
+
+# fractional tolerance per relative metric; absolute gates are coded
+DEFAULT_THRESHOLDS: Dict[str, float] = {
+    "sigs_per_sec": 0.10,
+    "p50_ms": 0.25,
+    "p99_ms": 0.30,
+    "stage_p50_ms": 0.30,
+    "dedup_speedup_8x_min": 1.5,
+}
+
+
+def load_result(path: str) -> dict:
+    """Read a bench result, unwrapping the driver's ``{"parsed": ...}``
+    envelope when present."""
+    with open(path) as fh:
+        doc = json.load(fh)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: not a bench result object")
+    return doc
+
+
+def _get(doc: dict, *path):
+    for key in path:
+        if not isinstance(doc, dict):
+            return None
+        doc = doc.get(key)
+    return doc
+
+
+def _stage_p50s(doc: dict) -> Dict[str, float]:
+    stages = doc.get("latency_stages") or {}
+    out = {}
+    for stage, v in stages.items():
+        if isinstance(v, dict) and isinstance(
+                v.get("p50_ms"), (int, float)):
+            out[stage] = float(v["p50_ms"])
+    # trajectory entries carry the flattened form
+    for stage, v in (doc.get("stage_p50_ms") or {}).items():
+        if isinstance(v, (int, float)):
+            out.setdefault(stage, float(v))
+    return out
+
+
+def _check(checks: list, metric: str, base, new, threshold: float,
+           direction: str) -> None:
+    """direction: "higher" = higher is better, "lower" = lower is
+    better.  None/zero on either side = skipped (no evidence)."""
+    entry = {"metric": metric, "base": base, "new": new,
+             "threshold": threshold, "direction": direction}
+    if not isinstance(base, (int, float)) \
+            or not isinstance(new, (int, float)) or base <= 0:
+        entry["status"] = "skipped"
+        checks.append(entry)
+        return
+    ratio = new / base
+    entry["ratio"] = round(ratio, 4)
+    if direction == "higher":
+        regressed = ratio < 1.0 - threshold
+    else:
+        regressed = ratio > 1.0 + threshold
+    entry["status"] = "regression" if regressed else "ok"
+    checks.append(entry)
+
+
+def _check_absolute(checks: list, metric: str, value, predicate,
+                    detail: str) -> None:
+    entry = {"metric": metric, "new": value, "direction": "absolute",
+             "detail": detail}
+    if value is None:
+        entry["status"] = "skipped"
+    else:
+        entry["status"] = "ok" if predicate(value) else "regression"
+    checks.append(entry)
+
+
+def compare(base: dict, new: dict,
+            thresholds: Optional[Dict[str, float]] = None) -> dict:
+    thr = dict(DEFAULT_THRESHOLDS)
+    thr.update(thresholds or {})
+    checks: list = []
+
+    _check(checks, "sigs_per_sec",
+           base.get("value", base.get("sigs_per_sec")),
+           new.get("value", new.get("sigs_per_sec")),
+           thr["sigs_per_sec"], "higher")
+    _check(checks, "p50_ms", base.get("p50_ms"), new.get("p50_ms"),
+           thr["p50_ms"], "lower")
+    _check(checks, "p99_ms", base.get("p99_ms"), new.get("p99_ms"),
+           thr["p99_ms"], "lower")
+
+    base_stages, new_stages = _stage_p50s(base), _stage_p50s(new)
+    for stage in sorted(set(base_stages) & set(new_stages)):
+        _check(checks, f"stage_p50_ms.{stage}", base_stages[stage],
+               new_stages[stage], thr["stage_p50_ms"], "lower")
+
+    # compile-cache accounting: a shape the base loaded from the
+    # persistent cache must not recompile fresh in the new run
+    recompiled = []
+    base_detail = base.get("detail") or {}
+    new_detail = new.get("detail") or {}
+    for shape, bv in base_detail.items():
+        nv = new_detail.get(shape)
+        if isinstance(bv, dict) and isinstance(nv, dict) \
+                and "cache_load_s" in bv and "compile_s" in nv:
+            recompiled.append(shape)
+    _check_absolute(
+        checks, "compile_cache_serving",
+        recompiled if (base_detail and new_detail) else None,
+        lambda shapes: not shapes,
+        "shapes the base run cache-loaded but the new run recompiled")
+
+    # dedup gates (PR-5 acceptance properties, absolute)
+    f8 = _get(new, "h2c_dedup", "factors", "8") or {}
+    _check_absolute(
+        checks, "dedup_speedup_8x",
+        f8.get("speedup_vs_1x", new.get("dedup_speedup_8x")),
+        lambda v: v >= thr["dedup_speedup_8x_min"],
+        f"8x-duplication speedup must stay >= "
+        f"{thr['dedup_speedup_8x_min']}")
+    warm = _get(new, "h2c_dedup", "warm") or {}
+    _check_absolute(
+        checks, "warm_h2c_dispatches",
+        warm.get("h2c_dispatches", new.get("warm_h2c_dispatches")),
+        lambda v: v == 0,
+        "a fully-warm H(m) cache must dispatch zero h2c")
+
+    regressions = [c for c in checks if c["status"] == "regression"]
+    return {"verdict": "regression" if regressions else "pass",
+            "regressions": len(regressions),
+            "checks": checks,
+            "thresholds": thr}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_diff",
+        description="compare two bench result JSONs; exit 1 on "
+                    "regression")
+    ap.add_argument("base", help="baseline BENCH_*.json")
+    ap.add_argument("new", help="candidate BENCH_*.json")
+    ap.add_argument("--threshold", action="append", default=[],
+                    metavar="NAME=FRACTION",
+                    help="override a threshold, e.g. sigs_per_sec=0.2")
+    ap.add_argument("--quiet", action="store_true",
+                    help="print only the one-line verdict, not the "
+                         "full check list")
+    args = ap.parse_args(argv)
+    overrides: Dict[str, float] = {}
+    for spec in args.threshold:
+        name, _, value = spec.partition("=")
+        if not value:
+            ap.error(f"--threshold {spec!r}: expected NAME=FRACTION")
+        try:
+            overrides[name] = float(value)
+        except ValueError:
+            ap.error(f"--threshold {spec!r}: {value!r} is not a number")
+    try:
+        base = load_result(args.base)
+        new = load_result(args.new)
+    except (OSError, ValueError) as exc:
+        print(json.dumps({"verdict": "error", "error": str(exc)}))
+        return 2
+    out = compare(base, new, overrides)
+    if args.quiet:
+        out = {"verdict": out["verdict"],
+               "regressions": out["regressions"],
+               "failed": [c["metric"] for c in out["checks"]
+                          if c["status"] == "regression"]}
+    print(json.dumps(out, indent=1))
+    return 1 if out["verdict"] == "regression" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
