@@ -149,6 +149,7 @@ class BeaconRestApi(RestApi):
         g("/teku/v1/admin/readiness", self._admin_readiness)
         g("/teku/v1/admin/flight_recorder", self._admin_flight_recorder)
         g("/teku/v1/admin/capacity", self._admin_capacity)
+        g("/teku/v1/admin/admission", self._admin_admission)
         g("/teku/v1/admin/profile", self._admin_profile)
         g("/metrics", self._metrics)
 
@@ -262,6 +263,16 @@ class BeaconRestApi(RestApi):
         sup = getattr(self.node, "supervisor", None)
         if sup is not None:
             out["backend"] = sup.snapshot()
+        # brownout state rides the readiness body: an autoscaler or
+        # load balancer deciding where to send traffic needs "this
+        # node is deliberately shedding OPTIMISTIC/GOSSIP" next to
+        # the per-check verdicts, not on a separate endpoint
+        admission = getattr(self.node, "admission", None)
+        if admission is not None:
+            snap = admission.snapshot()
+            out["admission"] = {"brownout": snap["brownout"],
+                                "plan": snap["plan"],
+                                "inputs": snap["inputs"]}
         return out
 
     async def _admin_flight_recorder(self, query=None):
@@ -297,6 +308,24 @@ class BeaconRestApi(RestApi):
         even between node health ticks."""
         from ..infra import capacity
         return {"data": capacity.refresh()}
+
+    async def _admin_admission(self):
+        """The overload controller's state (services/admission.py):
+        the current BatchPlan (adaptive pow-2 batch size + flush
+        deadline and the modeled device time behind them), the
+        brownout state machine (level, shed classes, hysteresis
+        counters, edge counts), the driving inputs (utilization, p50
+        burn rate, queue depth), the full knob config, and the
+        per-class queue depths/ages from the signature service."""
+        ctl = getattr(self.node, "admission", None)
+        if ctl is None:
+            raise HttpError(503, "admission controller not wired "
+                                 "(overload control off)")
+        out = {"controller": ctl.snapshot()}
+        svc = getattr(self.node, "sig_service", None)
+        if svc is not None:
+            out["queues"] = svc.queue_snapshot()
+        return {"data": out}
 
     async def _admin_profile(self, query=None):
         """On-demand jax.profiler capture (infra/profiling.py):

@@ -88,6 +88,32 @@ def _configure_tracing(args, yaml_cfg) -> str:
     return choice
 
 
+def _configure_overload(args, yaml_cfg) -> str:
+    """Overload-control switch (default on): the node wires an
+    AdmissionController — deadline-aware adaptive batching, priority
+    classes with strict-priority drain, and shed-by-class brownout
+    under SLO feedback (`services/admission.py`).  ``off`` restores the
+    fixed max-batch drain and overflow-only shedding.  The thresholds
+    themselves are env knobs (TEKU_TPU_BROWNOUT_*,
+    TEKU_TPU_ADMISSION_*, TEKU_TPU_VERIFY_CLASS_*_DEADLINE_MS —
+    README "Overload & priority classes")."""
+    def norm(v):
+        if isinstance(v, bool):
+            return "on" if v else "off"
+        return str(v).lower()
+
+    choice = layered_value("overload-control",
+                           getattr(args, "overload_control", None),
+                           yaml_cfg, "on", cast=norm)
+    if choice not in ("on", "off"):
+        raise SystemExit(
+            f"invalid --overload-control {choice!r} (use on or off)")
+    # the env var is how the choice reaches BeaconNode (and every
+    # devnet node constructed inside the process)
+    os.environ["TEKU_TPU_OVERLOAD_CONTROL"] = choice
+    return choice
+
+
 # mirror of ops/mxu.py PATHS, spelled locally so the boot path never
 # imports the ops package (whose __init__ imports jax) on the main
 # thread — the env var is how the choice reaches the kernel layer
@@ -161,6 +187,7 @@ def cmd_node(args) -> int:
     yaml_cfg = _load_yaml(args.config_file)
     _configure_log_format(args, yaml_cfg)
     _configure_tracing(args, yaml_cfg)
+    _configure_overload(args, yaml_cfg)
     # arm the crash path before anything can wedge: faulthandler file
     # + flight-recorder JSONL dump on fatal crash (infra/flightrecorder)
     from .infra import flightrecorder
@@ -375,6 +402,7 @@ def cmd_devnet(args) -> int:
 
     _configure_log_format(args, {})
     _configure_tracing(args, {})
+    _configure_overload(args, {})
     mont_path = _configure_kernel(args, {})
     _, bls_supervisor = _configure_bls(args, {}, mont_path=mont_path)
 
@@ -782,6 +810,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "device is a TPU).  mxu on a non-TPU device "
                         "falls back to vpu with one warning.  Env: "
                         "TEKU_TPU_MONT_MUL")
+    n.add_argument("--overload-control", default=None,
+                   choices=["on", "off"],
+                   help="adaptive batching + priority classes + "
+                        "shed-by-class brownout under SLO feedback "
+                        "(default on; thresholds via TEKU_TPU_BROWNOUT_"
+                        "*/TEKU_TPU_ADMISSION_* env knobs)")
     n.add_argument("--tracing", default=None, choices=["on", "off"],
                    help="hot-path verify tracing: per-stage latency "
                         "histograms on /metrics and the slow-trace "
@@ -804,6 +838,8 @@ def build_parser() -> argparse.ArgumentParser:
     d.add_argument("--mont-path", default=None,
                    choices=["vpu", "mxu", "auto"])
     d.add_argument("--tracing", default=None, choices=["on", "off"])
+    d.add_argument("--overload-control", default=None,
+                   choices=["on", "off"])
     d.add_argument("--log-format", default=None,
                    choices=["text", "json"])
     d.set_defaults(fn=cmd_devnet)

@@ -226,6 +226,25 @@ class ShapeLatencyModel:
         with entry.lock:
             return self._stats_locked(entry)[stat]
 
+    def latency_for_lanes(self, lanes: int, stat: str = "p50_s"
+                          ) -> Optional[float]:
+        """Modeled device time of a prospective `lanes`-wide dispatch:
+        the WORST matching estimate across paths and kmax variants of
+        the ``{lanes}x{kmax}`` shape family the provider labels (the
+        admission controller sizes batches against this, and a
+        conservative bound never talks it into a batch that blows the
+        latency budget).  None = no evidence for this width yet."""
+        prefix = f"{int(lanes)}x"
+        with self._lock:
+            keys = [k for k in self._entries if k[0].startswith(prefix)]
+        worst: Optional[float] = None
+        for shape, path in keys:
+            value = self.latency_s(shape, path, stat)
+            if value is not None and value > 0 \
+                    and (worst is None or value > worst):
+                worst = value
+        return worst
+
 
 class DeviceOccupancyTracker:
     """True device-time accounting under async overlap.
@@ -305,8 +324,9 @@ class CapacityTelemetry:
             labelnames=("source",))
         registry.gauge(
             "bls_queue_depth",
-            "current pending verification tasks (capacity view of the "
-            "batching queue)",
+            "current pending verification signatures/triples (capacity "
+            "view of the batching queue, in the same unit as the "
+            "arrival rate and batch plan)",
             supplier=lambda: float(self.queue_depth.current))
         registry.gauge(
             "bls_device_occupancy_ratio",

@@ -1,0 +1,21 @@
+"""Typed environment-variable reads shared by the knob-heavy modules.
+
+A malformed value reads as the default instead of raising: a typo in
+an operator's unit file must degrade the knob, never the node.
+"""
+
+import os
+
+
+def env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default

@@ -34,8 +34,11 @@ inclusion directly, which is why these SLOs run *inside* the node.
 Thresholds are env-tunable (documented in README/PERF):
 ``TEKU_TPU_SLO_VERIFY_P50_MS``, ``TEKU_TPU_SLO_VERIFY_SUCCESS_RATIO``,
 ``TEKU_TPU_SLO_DEVICE_RATIO``, ``TEKU_TPU_LOOP_LAG_DEGRADED_S``,
-``TEKU_TPU_LOOP_LAG_DOWN_S``, ``TEKU_TPU_HEALTH_QUEUE_SAT_DEGRADED``,
-``TEKU_TPU_HEALTH_WORKER_STALL_S``, ``TEKU_TPU_HEALTH_TICK_S``.
+``TEKU_TPU_LOOP_LAG_DOWN_S``, ``TEKU_TPU_HEALTH_UTIL_DEGRADED``
+(capacity-model utilization; defaults to the brownout entry
+threshold), ``TEKU_TPU_HEALTH_QUEUE_SAT_DEGRADED`` (raw full-queue
+backstop), ``TEKU_TPU_HEALTH_WORKER_STALL_S``,
+``TEKU_TPU_HEALTH_TICK_S``.
 """
 
 import asyncio
@@ -48,6 +51,7 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from . import flightrecorder, tracing
+from .env import env_float as _env_float
 from .metrics import GLOBAL_REGISTRY, MetricsRegistry
 
 _LOG = logging.getLogger(__name__)
@@ -67,13 +71,6 @@ _SEVERITY = {HealthStatus.UP: 0, HealthStatus.DEGRADED: 1,
 class CheckResult:
     status: HealthStatus
     detail: str = ""
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except (TypeError, ValueError):
-        return default
 
 
 # --------------------------------------------------------------------------
@@ -465,6 +462,11 @@ class SloEngine:
             self._in_breach[obj.name] = breached
         return self.snapshot()
 
+    def burn_rate(self, objective: str) -> float:
+        """Last evaluated burn for one objective (0.0 before evidence)
+        — the admission controller's feedback input."""
+        return self._burn.get(objective, 0.0)
+
     def snapshot(self) -> dict:
         return {obj.name: {
             "description": obj.description,
@@ -523,15 +525,31 @@ def supervisor_check(supervisor_getter: Callable) -> Callable[[], CheckResult]:
 
 
 def signature_service_check(service,
-                            saturation_degraded: Optional[float] = None,
+                            utilization_degraded: Optional[float] = None,
                             stall_down_s: Optional[float] = None
                             ) -> Callable[[], CheckResult]:
-    """Signature-queue saturation + worker stall: a near-full queue is
-    shedding-imminent (DEGRADED); queued work with no worker progress
-    for `stall_down_s` means verdicts are not being produced (DOWN)."""
-    sat_limit = (saturation_degraded if saturation_degraded is not None
-                 else _env_float("TEKU_TPU_HEALTH_QUEUE_SAT_DEGRADED",
-                                 0.8))
+    """Signature-pipeline saturation + worker stall.
+
+    Saturation is read from the CAPACITY MODEL embedded in the
+    service's health snapshot (``capacity_model.utilization`` —
+    demand / sustainable throughput), not the raw queue depth: depth
+    lags the overload it signals (a queue only backs up after capacity
+    is already exhausted), and the brownout controller keys on the
+    same utilization signal — so DEGRADED here and brownout there flip
+    on ONE measurement instead of two drifting ones.  The default
+    threshold IS the brownout entry threshold
+    (``TEKU_TPU_BROWNOUT_UTIL_ENTER``, override with
+    ``TEKU_TPU_HEALTH_UTIL_DEGRADED``).  A physically full queue still
+    degrades as a backstop (utilization can read low before dispatch
+    evidence exists; ``TEKU_TPU_HEALTH_QUEUE_SAT_DEGRADED``, default
+    0.95).  Queued work with no worker progress for
+    `stall_down_s` means verdicts are not being produced (DOWN)."""
+    util_limit = (utilization_degraded
+                  if utilization_degraded is not None
+                  else _env_float(
+                      "TEKU_TPU_HEALTH_UTIL_DEGRADED",
+                      _env_float("TEKU_TPU_BROWNOUT_UTIL_ENTER", 1.0)))
+    sat_limit = _env_float("TEKU_TPU_HEALTH_QUEUE_SAT_DEGRADED", 0.95)
     stall_limit = (stall_down_s if stall_down_s is not None
                    else _env_float("TEKU_TPU_HEALTH_WORKER_STALL_S",
                                    30.0))
@@ -543,14 +561,53 @@ def signature_service_check(service,
                 HealthStatus.DOWN,
                 f"workers stalled {snap['stalled_s']:.1f}s with "
                 f"{snap['queue_size']} tasks queued")
+        model = snap.get("capacity_model") or {}
+        util = model.get("utilization", 0.0)
+        headroom = model.get("headroom_ratio", 1.0)
+        if util >= util_limit:
+            return CheckResult(
+                HealthStatus.DEGRADED,
+                f"demand at {util:.0%} of sustainable capacity "
+                f"(headroom {headroom:.0%}, queue "
+                f"{snap['queue_size']}/{snap['capacity']})")
         if snap["saturation"] >= sat_limit:
+            # backstop: a queue at its hard bound is shedding-imminent
+            # even while the model is still gathering evidence
             return CheckResult(
                 HealthStatus.DEGRADED,
                 f"queue {snap['queue_size']}/{snap['capacity']} "
                 f"({snap['saturation']:.0%} full)")
         return CheckResult(
             HealthStatus.UP,
-            f"queue {snap['queue_size']}/{snap['capacity']}")
+            f"utilization {util:.0%}, queue "
+            f"{snap['queue_size']}/{snap['capacity']}")
+    return check
+
+
+def admission_controller_check(controller_getter: Callable
+                               ) -> Callable[[], CheckResult]:
+    """Overload-controller health: brownout (any level) reads DEGRADED
+    — the node is still correct, it is deliberately shedding the
+    lowest classes to protect BLOCK_IMPORT latency — with the level,
+    shed classes, and the driving signals in the detail line."""
+    def check() -> CheckResult:
+        ctl = controller_getter()
+        if ctl is None:
+            return CheckResult(HealthStatus.UP,
+                               "no admission controller (fixed policy)")
+        snap = ctl.snapshot()
+        brown = snap["brownout"]
+        if brown["level"] >= 1:
+            return CheckResult(
+                HealthStatus.DEGRADED,
+                f"brownout level {brown['level']}: shedding "
+                f"{'+'.join(brown['shedding']) or 'nothing'} "
+                f"(util {snap['inputs']['utilization']:.2f}, burn "
+                f"{snap['inputs']['burn_rate']:.2f})")
+        return CheckResult(
+            HealthStatus.UP,
+            f"batch {snap['plan']['batch_size']}, util "
+            f"{snap['inputs']['utilization']:.2f}")
     return check
 
 

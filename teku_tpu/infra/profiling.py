@@ -31,6 +31,7 @@ import time
 from typing import Callable, Optional
 
 from . import flightrecorder, tracing
+from .env import env_float as _env_float
 from .metrics import GLOBAL_REGISTRY, MetricsRegistry
 
 _LOG = logging.getLogger(__name__)
@@ -39,13 +40,6 @@ _LOG = logging.getLogger(__name__)
 def default_profile_dir() -> str:
     return os.environ.get("TEKU_TPU_PROFILE_DIR") or os.path.join(
         tempfile.gettempdir(), "teku_tpu_profiles")
-
-
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, default))
-    except (TypeError, ValueError):
-        return default
 
 
 class JaxProfilerBackend:

@@ -56,7 +56,16 @@ class SszTopicHandler(TopicHandler):
             return ValidationResult.REJECT
         try:
             return await self.processor(msg)
-        except Exception:
+        except Exception as exc:
+            from ..services.signatures import (
+                ServiceCapacityExceededError)
+            if isinstance(exc, ServiceCapacityExceededError):
+                # brownout/overflow shed: load shedding working as
+                # designed — IGNORE the message quietly (the shed is
+                # already counted and flight-recorded by the service);
+                # a stack trace per shed at 10x overload would be its
+                # own denial of service on the log pipeline
+                return ValidationResult.IGNORE
             _LOG.exception("processor for %s failed", self.name)
             return ValidationResult.IGNORE
 

@@ -19,13 +19,16 @@ from ..infra import flightrecorder
 from ..infra.events import EventChannels, SlotEventsChannel
 from ..infra.health import (CheckResult, EventLoopLagWatchdog,
                             HealthRegistry, HealthStatus, SloEngine,
+                            admission_controller_check,
                             signature_service_check, supervisor_check)
 from ..infra.logs import log_slot_event
 from ..infra.service import Service
-from ..services.signatures import AggregatingSignatureVerificationService
+from ..services.admission import AdmissionController, VerifyClass
+from ..services.signatures import (
+    AggregatingSignatureVerificationService, ServiceCapacityExceededError)
 from ..spec import Spec
 from ..spec import helpers as H
-from ..spec.verifiers import ServiceAsyncSignatureVerifier
+from ..spec.verifiers import ServiceAsyncSignatureVerifier, verify_class
 from ..storage.store import Store
 from .chaindata import RecentChainData
 from .gossip import (AGGREGATE_TOPIC, ATTESTER_SLASHING_TOPIC,
@@ -53,7 +56,8 @@ class BeaconNode(Service):
     def __init__(self, spec: Spec, genesis_state, gossip: GossipNetwork,
                  name: str = "node", num_sig_workers: int = 2,
                  max_batch_size: int = 250,
-                 store: Optional[Store] = None):
+                 store: Optional[Store] = None,
+                 overload_control: Optional[bool] = None):
         super().__init__(name)
         self.spec = spec
         # backend supervisor (infra/supervisor.py), injected by the
@@ -76,9 +80,22 @@ class BeaconNode(Service):
             store = Store(spec.config, genesis_state, anchor)
         self.store = store
         self.chain = RecentChainData(spec, self.store, self.channels)
+        # SLO engine first: the admission controller closes its loop
+        # on the attestation_verify_p50 burn rate it computes
+        self.slo = SloEngine(name=name)
+        if overload_control is None:
+            overload_control = os.environ.get(
+                "TEKU_TPU_OVERLOAD_CONTROL", "on") not in (
+                "0", "off", "false")
+        self.admission = AdmissionController(
+            burn_getter=lambda: self.slo.burn_rate(
+                "attestation_verify_p50"),
+            max_batch=max_batch_size,
+            name=name) if overload_control else None
         self.sig_service = AggregatingSignatureVerificationService(
             num_workers=num_sig_workers, max_batch_size=max_batch_size,
-            name=f"{name}_signature_verifications")
+            name=f"{name}_signature_verifications",
+            controller=self.admission)
         self.verifier = ServiceAsyncSignatureVerifier(self.sig_service)
         self.pool = AggregatingAttestationPool(spec)
         from .oppool import make_operation_pools
@@ -121,11 +138,13 @@ class BeaconNode(Service):
         self.flight_recorder = flightrecorder.RECORDER
         self.health = HealthRegistry(name=name)
         self.loop_watchdog = EventLoopLagWatchdog(name=name)
-        self.slo = SloEngine(name=name)
         self.health.register("backend",
                              supervisor_check(lambda: self.supervisor))
         self.health.register("signature_queue",
                              signature_service_check(self.sig_service))
+        self.health.register(
+            "admission",
+            admission_controller_check(lambda: self.admission))
         self.health.register("event_loop", self.loop_watchdog.check)
         # late binding: bench/tests may swap the engine after wiring
         self.health.register("slo", lambda: self.slo.check())
@@ -153,6 +172,12 @@ class BeaconNode(Service):
             await asyncio.sleep(interval)
             try:
                 slo_snap = self.slo.tick()
+                # the admission controller re-plans lazily on traffic;
+                # this tick covers the idle edge (a brownout must EXIT
+                # when load stops arriving, not wait for the next
+                # arrival to trigger a plan)
+                if self.admission is not None:
+                    self.admission.tick()
                 self.health.evaluate()
                 # capacity refresh fires the edge-triggered headroom-
                 # exhausted event; the profiler poll stops an overdue
@@ -410,23 +435,43 @@ class BeaconNode(Service):
 
     async def _retry_deferred(self) -> None:
         """Re-validate deferred gossip (new slot or new blocks may have
-        unblocked it); three strikes and a message is dropped."""
+        unblocked it); three strikes and a message is dropped.
+
+        Retries run at OPTIMISTIC class: they are speculative (the
+        message already failed once), so under brownout they are the
+        first thing shed — live gossip must not queue behind them."""
         items, self._deferred_gossip = self._deferred_gossip, []
-        for kind, msg, tries in items:
-            if kind == "att":
-                result = await self.attestation_validator.validate(msg)
-                if result is ValidationResult.ACCEPT:
-                    self.attestation_manager.add_attestation(msg)
+        with verify_class(VerifyClass.OPTIMISTIC):
+            for kind, msg, tries in items:
+                try:
+                    if kind == "att":
+                        result = await \
+                            self.attestation_validator.validate(msg)
+                        if result is ValidationResult.ACCEPT:
+                            self.attestation_manager.add_attestation(msg)
+                            continue
+                    else:
+                        result = await \
+                            self.aggregate_validator.validate(msg)
+                        if result is ValidationResult.ACCEPT:
+                            self.attestation_manager.add_attestation(
+                                msg.message.aggregate)
+                            continue
+                except ServiceCapacityExceededError:
+                    # an OPTIMISTIC retry shed by brownout is load
+                    # shedding working as designed, not a lost
+                    # message class
                     continue
-            else:
-                result = await self.aggregate_validator.validate(msg)
-                if result is ValidationResult.ACCEPT:
-                    self.attestation_manager.add_attestation(
-                        msg.message.aggregate)
+                except Exception:
+                    # anything else is a real validator defect: keep
+                    # the retry loop alive but make the drop loud
+                    _LOG.exception(
+                        "deferred %s gossip revalidation failed", kind)
                     continue
-            if (result is ValidationResult.SAVE_FOR_FUTURE
-                    and tries < 3 and len(self._deferred_gossip) < 1024):
-                self._deferred_gossip.append((kind, msg, tries + 1))
+                if (result is ValidationResult.SAVE_FOR_FUTURE
+                        and tries < 3
+                        and len(self._deferred_gossip) < 1024):
+                    self._deferred_gossip.append((kind, msg, tries + 1))
 
     # ------------------------------------------------------------------
     async def do_start(self) -> None:

@@ -23,6 +23,7 @@ from ..spec.config import (DOMAIN_AGGREGATE_AND_PROOF,
                            DOMAIN_BEACON_ATTESTER, DOMAIN_BEACON_PROPOSER)
 from ..infra.collections import LimitedSet
 from ..spec.builder import is_aggregator
+from ..services.admission import VerifyClass
 from ..spec.verifiers import (AsyncBatchSignatureVerifier,
                               AsyncSignatureVerifier)
 from .chaindata import RecentChainData
@@ -104,6 +105,10 @@ def normalize_attestation(spec: Spec, state, attestation):
 class AttestationValidator:
     """Single (unaggregated) attestation gossip rules + batched sig."""
 
+    # single attestations are the bulk gossip class: sheddable under
+    # level-2 brownout, behind aggregates in the priority drain
+    verify_cls = VerifyClass.GOSSIP
+
     def __init__(self, spec: Spec, chain: RecentChainData,
                  verifier: AsyncSignatureVerifier):
         self.spec = spec
@@ -154,7 +159,8 @@ class AttestationValidator:
         root = H.compute_signing_root(data, domain)
         pubkey = target_state.validators[validator_index].pubkey
         ok = await self.verifier.verify([pubkey], root,
-                                        attestation.signature)
+                                        attestation.signature,
+                                        cls=self.verify_cls)
         if not ok:
             return REJECT
         self._seen.add(key)
@@ -165,6 +171,10 @@ class AggregateValidator:
     """SignedAggregateAndProof rules; the three signatures (selection
     proof, aggregator, aggregate) verify as ONE atomic batch task
     (reference AggregateAttestationValidator.java:124-126,242)."""
+
+    # an aggregate carries a committee's worth of fork-choice weight:
+    # it outranks single-attestation gossip and is never brownout-shed
+    verify_cls = VerifyClass.SYNC_CRITICAL
 
     def __init__(self, spec: Spec, chain: RecentChainData,
                  verifier: AsyncSignatureVerifier):
@@ -212,7 +222,8 @@ class AggregateValidator:
             return REJECT
 
         # three signatures, one atomic task
-        batch = AsyncBatchSignatureVerifier(self.verifier)
+        batch = AsyncBatchSignatureVerifier(self.verifier,
+                                            cls=self.verify_cls)
         agg_pubkey = state.validators[msg.aggregator_index].pubkey
         sel_root = H.selection_proof_signing_root(cfg, state, data.slot)
         batch.verify([agg_pubkey], sel_root, msg.selection_proof)
@@ -245,6 +256,8 @@ class ContributionValidator:
     live slot, valid subcommittee, aggregator is a member, selection
     proof selects them — then the three signatures (selection proof,
     envelope, contribution aggregate) verify as ONE atomic batch."""
+
+    verify_cls = VerifyClass.GOSSIP
 
     def __init__(self, spec: Spec, chain: RecentChainData,
                  verifier: AsyncSignatureVerifier):
@@ -288,7 +301,8 @@ class ContributionValidator:
                                                msg.selection_proof):
             return REJECT
 
-        batch = AsyncBatchSignatureVerifier(self.verifier)
+        batch = AsyncBatchSignatureVerifier(self.verifier,
+                                            cls=self.verify_cls)
         batch.verify([agg_pubkey],
                      AH.sync_selection_proof_signing_root(
                          cfg, state, slot,
@@ -315,6 +329,11 @@ class BlockGossipValidator:
     """Block gossip rules (reference BlockGossipValidator.java): slot
     not from the future/too old, first block per (slot, proposer),
     known parent, proposer signature against the parent's state."""
+
+    # the proposer signature gates the whole slot's import: ONE
+    # signature on the critical path — the VIP lane dispatches it
+    # alone, ahead of every queued batch
+    verify_cls = VerifyClass.VIP
 
     def __init__(self, spec: Spec, chain: RecentChainData,
                  verifier: AsyncSignatureVerifier):
@@ -353,7 +372,8 @@ class BlockGossipValidator:
         domain = H.get_domain(cfg, pre, DOMAIN_BEACON_PROPOSER)
         root = H.compute_signing_root(block, domain)
         if not await self.verifier.verify([proposer.pubkey], root,
-                                          signed_block.signature):
+                                          signed_block.signature,
+                                          cls=self.verify_cls):
             return REJECT
         self._seen.add(key)
         return ACCEPT

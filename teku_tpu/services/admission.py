@@ -1,0 +1,406 @@
+"""Overload control: priority classes, adaptive batching, brownout.
+
+ROADMAP item 3 closes the loop the previous PRs instrumented: PR 2 gave
+per-stage latency, PR 3 the SLO burn-rate engine, PR 6 the capacity
+model (per-shape device latency, utilization/headroom).  This module is
+the controller that acts on those sensors so the node HOLDS its 100 ms
+attestation-verify p50 under 10x sustained load instead of collapsing
+(ACE Runtime, PAPERS.md: sub-second cryptographic finality as a runtime
+property enforced by feedback control).
+
+Three pieces:
+
+- ``VerifyClass`` — the priority vocabulary every verification carries:
+  ``VIP > BLOCK_IMPORT > SYNC_CRITICAL > GOSSIP > OPTIMISTIC``.  VIP is
+  the single-signature express lane (a block's proposer signature gates
+  the whole slot): it bypasses aggregation entirely — a VIP task is
+  dispatched ALONE the moment a worker sees it.  Classes are a closed
+  set on purpose: they are also metric label values, and the
+  exposition's cardinality must stay bounded.
+- ``AdmissionController`` — per-tick feedback controller producing a
+  ``BatchPlan``: the drain target (pow-2 bucket-aligned, so padded
+  dispatch shapes match the shapes the latency model already measured
+  and padding waste stays low), the flush deadline (how long a worker
+  may wait to fill a batch — zero when latency-optimal, nonzero only
+  when utilization says throughput is the constraint), and the brownout
+  level.  Inputs: live queue depth, the per-``{shape,path}``
+  ``ShapeLatencyModel`` (the modeled device time of each candidate
+  pow-2 batch), capacity-model utilization, and the
+  ``attestation_verify_p50`` burn rate.
+- Brownout state machine — EDGE-TRIGGERED and HYSTERETIC: entry at
+  ``utilization >= UTIL_ENTER`` or ``burn >= BURN_ENTER`` (level 1
+  sheds OPTIMISTIC; escalation thresholds raise it to level 2 which
+  also sheds GOSSIP by oldest deadline), exit only after the signals
+  have stayed below the LOWER exit thresholds for ``HOLD_TICKS``
+  consecutive ticks — a controller oscillating around one threshold
+  cannot flap.  Load that settles BETWEEN the exit and enter bands
+  de-escalates one level per hold window instead of pinning the
+  spike's level forever.  Every transition records one
+  flight-recorder event with the originating trace id.  BLOCK_IMPORT
+  and VIP are never shed.
+
+The batching service (``services/signatures.py``) consumes the plan at
+enqueue (admission control) and drain (batch assembly) time; the node
+health tick keeps the controller evaluating while the queue is idle.
+
+Knobs (env, documented in README "Overload & priority classes"):
+``TEKU_TPU_ADMISSION_TICK_S``, ``TEKU_TPU_BROWNOUT_UTIL_ENTER`` /
+``_EXIT``, ``TEKU_TPU_BROWNOUT_BURN_ENTER`` / ``_EXIT``,
+``TEKU_TPU_BROWNOUT_HOLD_TICKS``, ``TEKU_TPU_ADMISSION_DEVICE_BUDGET``,
+``TEKU_TPU_VERIFY_CLASS_<CLASS>_DEADLINE_MS``.
+"""
+
+import enum
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+from ..infra import capacity, flightrecorder, tracing
+from ..infra.env import env_float, env_int
+from ..infra.metrics import GLOBAL_REGISTRY, MetricsRegistry
+
+_LOG = logging.getLogger(__name__)
+
+
+class VerifyClass(enum.IntEnum):
+    """Priority of one verification task; LOWER value = drained first.
+
+    The enum is the complete label vocabulary for every per-class
+    metric family (``{class}`` label) — adding a member here is the
+    only way the cardinality can grow."""
+
+    VIP = 0             # single-sig express lane, bypasses aggregation
+    BLOCK_IMPORT = 1    # gates block import — never shed
+    SYNC_CRITICAL = 2   # aggregates/sync-weight — never shed
+    GOSSIP = 3          # ordinary gossip — shed under level-2 brownout
+    OPTIMISTIC = 4      # speculative re-validation — shed first
+
+    @property
+    def label(self) -> str:
+        return self.name.lower()
+
+
+# shed order under pressure; everything else is NEVER shed
+SHEDDABLE = (VerifyClass.OPTIMISTIC, VerifyClass.GOSSIP)
+
+CLASS_LABELS = tuple(c.label for c in VerifyClass)
+
+
+# per-class latency deadlines: the budget a task of that class has from
+# enqueue to verdict before a brownout shed considers it already lost
+# (oldest-deadline-first shedding drops the tasks least likely to make
+# their SLO, not the freshest arrivals)
+_DEADLINE_DEFAULT_MS = {
+    VerifyClass.VIP: 50.0,
+    VerifyClass.BLOCK_IMPORT: 1000.0,
+    VerifyClass.SYNC_CRITICAL: 250.0,
+    VerifyClass.GOSSIP: 100.0,
+    VerifyClass.OPTIMISTIC: 400.0,
+}
+
+
+def class_deadline_s(cls: VerifyClass) -> float:
+    """The class's enqueue-to-verdict deadline budget in seconds
+    (``TEKU_TPU_VERIFY_CLASS_<CLASS>_DEADLINE_MS`` overrides)."""
+    return env_float(
+        f"TEKU_TPU_VERIFY_CLASS_{cls.name}_DEADLINE_MS",
+        _DEADLINE_DEFAULT_MS[cls]) / 1e3
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, (int(n) - 1).bit_length())
+
+
+@dataclass(frozen=True)
+class BatchPlan:
+    """One tick's output: what the drain loop should do right now."""
+
+    batch_size: int            # pow-2 drain target (triples)
+    flush_deadline_s: float    # max wait to fill a batch (0 = none)
+    brownout_level: int        # 0 none | 1 shed OPTIMISTIC | 2 +GOSSIP
+    utilization: float = 0.0
+    burn_rate: float = 0.0
+    modeled_batch_s: Optional[float] = None  # device time at batch_size
+
+    def sheds(self, cls: VerifyClass) -> bool:
+        """Does the current brownout level shed this class?"""
+        if self.brownout_level >= 1 and cls is VerifyClass.OPTIMISTIC:
+            return True
+        if self.brownout_level >= 2 and cls is VerifyClass.GOSSIP:
+            return True
+        return False
+
+
+class AdmissionController:
+    """Deadline-aware adaptive batching + shed-by-class brownout.
+
+    ``plan()`` is the hot-path read: it lazily re-ticks when the last
+    evaluation is older than ``tick_s`` (the worker drain loop and the
+    enqueue path both call it, so the controller stays fresh exactly as
+    fast as traffic moves; the node health tick covers the idle case).
+    The clock is injectable so every control decision is deterministic
+    under test."""
+
+    def __init__(self,
+                 telemetry: Optional[capacity.CapacityTelemetry] = None,
+                 burn_getter: Optional[Callable[[], float]] = None,
+                 min_bucket: int = 8, max_batch: int = 256,
+                 slo_p50_s: Optional[float] = None,
+                 tick_s: Optional[float] = None,
+                 hold_ticks: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry: MetricsRegistry = GLOBAL_REGISTRY,
+                 recorder: Optional[flightrecorder.FlightRecorder]
+                 = None,
+                 name: str = "node"):
+        self.telemetry = telemetry or capacity.TELEMETRY
+        self.burn_getter = burn_getter or (lambda: 0.0)
+        self.min_bucket = max(1, _next_pow2(min_bucket))
+        self.max_batch = max(self.min_bucket, _next_pow2(max_batch))
+        self.slo_p50_s = (slo_p50_s if slo_p50_s is not None else
+                          env_float("TEKU_TPU_SLO_VERIFY_P50_MS",
+                                     100.0) / 1e3)
+        self.tick_s = (tick_s if tick_s is not None else
+                       env_float("TEKU_TPU_ADMISSION_TICK_S", 0.5))
+        # the fraction of the p50 SLO one device dispatch may consume:
+        # queue wait + host prep need the rest of the budget
+        self.device_budget_s = self.slo_p50_s * env_float(
+            "TEKU_TPU_ADMISSION_DEVICE_BUDGET", 0.5)
+        self.util_enter = env_float("TEKU_TPU_BROWNOUT_UTIL_ENTER", 1.0)
+        self.util_exit = env_float("TEKU_TPU_BROWNOUT_UTIL_EXIT", 0.7)
+        self.burn_enter = env_float("TEKU_TPU_BROWNOUT_BURN_ENTER", 1.5)
+        self.burn_exit = env_float("TEKU_TPU_BROWNOUT_BURN_EXIT", 0.8)
+        self.hold_ticks = max(1, hold_ticks if hold_ticks is not None
+                              else env_int(
+                                  "TEKU_TPU_BROWNOUT_HOLD_TICKS", 3))
+        # utilization at which a worker starts WAITING to fill batches
+        # (below it, latency wins: dispatch whatever is queued)
+        self.gather_util = env_float("TEKU_TPU_ADMISSION_GATHER_UTIL",
+                                      0.6)
+        self._clock = clock
+        self._recorder = recorder or flightrecorder.RECORDER
+        self.name = name
+        self._lock = threading.Lock()
+        self._level = 0
+        self._calm_ticks = 0
+        self._deesc_ticks = 0
+        self._ticks = 0
+        self._enters = 0
+        self._exits = 0
+        self._deescalations = 0
+        self._last_tick_t: Optional[float] = None
+        self._plan = BatchPlan(batch_size=self.max_batch,
+                               flush_deadline_s=0.0, brownout_level=0)
+        # families are prefixed with the controller's name, like the
+        # signature service's: a multi-node process (devnet) must not
+        # silently collapse every node onto node0's gauges
+        self._m_batch = registry.gauge(
+            f"{name}_admission_batch_size",
+            "current adaptive drain target (pow-2 triples per batch)",
+            supplier=lambda: float(self._plan.batch_size))
+        self._m_flush = registry.gauge(
+            f"{name}_admission_flush_deadline_seconds",
+            "current max wait to fill a batch before flushing",
+            supplier=lambda: self._plan.flush_deadline_s)
+        self._m_level = registry.gauge(
+            f"{name}_admission_brownout_level",
+            "0 = normal, 1 = shedding OPTIMISTIC, 2 = also shedding "
+            "GOSSIP by oldest deadline",
+            supplier=lambda: float(self._level))
+        self._m_transitions = registry.labeled_counter(
+            f"{name}_admission_brownout_transitions_total",
+            "edge-triggered brownout state changes",
+            labelnames=("direction",))
+
+    # ------------------------------------------------------------------
+    def plan(self) -> BatchPlan:
+        """The current plan, re-ticking lazily when stale."""
+        now = self._clock()
+        with self._lock:
+            fresh = (self._last_tick_t is not None
+                     and now - self._last_tick_t < self.tick_s)
+        if fresh:
+            return self._plan
+        return self.tick()
+
+    def tick(self) -> BatchPlan:
+        """Recompute the plan from the live sensors and run the
+        brownout edge logic.  Cheap enough for every drain."""
+        util = self.telemetry.utilization()
+        try:
+            burn = float(self.burn_getter() or 0.0)
+        except Exception:  # noqa: BLE001 - a sick sensor reads calm
+            burn = 0.0
+        depth = self.telemetry.queue_depth.current
+        size, modeled = self._pick_batch(depth, util, burn)
+        flush = self._pick_flush(depth, size, util)
+        with self._lock:
+            self._ticks += 1
+            level = self._brownout_edge_locked(util, burn)
+            self._plan = BatchPlan(
+                batch_size=size, flush_deadline_s=flush,
+                brownout_level=level, utilization=round(util, 4),
+                burn_rate=round(burn, 4), modeled_batch_s=modeled)
+            self._last_tick_t = self._clock()
+            return self._plan
+
+    # ------------------------------------------------------------------
+    def _fit_batch(self) -> int:
+        """Largest pow-2 batch whose MODELED device time fits the
+        per-dispatch latency budget (no shape evidence = max_batch:
+        until the model has data there is nothing to act on)."""
+        b = self.max_batch
+        while b > self.min_bucket:
+            lat = self.telemetry.latency.latency_for_lanes(b)
+            if lat is None or lat <= self.device_budget_s:
+                break
+            b //= 2
+        return b
+
+    def _pick_batch(self, depth: int, util: float,
+                    burn: float) -> tuple:
+        fit = self._fit_batch()
+        if util >= self.gather_util or burn > 1.0:
+            # throughput mode: queueing dominates latency, so drain the
+            # largest batch that still fits the device budget — fewer
+            # dispatch overheads raise sustainable capacity
+            size = fit
+        else:
+            # latency mode: smallest pow-2 covering what is queued cuts
+            # padding waste without adding wait
+            size = min(fit, max(self.min_bucket,
+                                _next_pow2(max(depth, 1))))
+        return size, self.telemetry.latency.latency_for_lanes(size)
+
+    def _pick_flush(self, depth: int, size: int, util: float) -> float:
+        """How long a worker may hold a partial batch open.  Only under
+        pressure (filling batches raises capacity), bounded by the
+        time demand needs to supply the missing triples and by half the
+        remaining latency budget."""
+        if util < self.gather_util or depth >= size:
+            return 0.0
+        demand = self.telemetry.demand_sigs_per_second()
+        if demand <= 0:
+            return 0.0
+        return round(min((size - depth) / demand,
+                         self.device_budget_s * 0.5), 6)
+
+    # ------------------------------------------------------------------
+    def _brownout_edge_locked(self, util: float, burn: float) -> int:
+        """Edge-triggered, hysteretic brownout transitions (caller
+        holds the lock)."""
+        target = 0
+        if util >= self.util_enter or burn >= self.burn_enter:
+            target = 1
+        if util >= self.util_enter * 1.5 or burn >= self.burn_enter * 2:
+            target = 2
+        if target > self._level:
+            old, self._level = self._level, target
+            self._calm_ticks = 0
+            self._deesc_ticks = 0
+            self._enters += 1
+            self._m_transitions.labels(direction="enter").inc()
+            trace_id = (tracing.current_trace_id()
+                        or self._recorder.last_trace_id())
+            self._recorder.record(
+                "brownout_enter", trace_id=trace_id, level=target,
+                from_level=old, utilization=round(util, 3),
+                burn_rate=round(burn, 3),
+                detail="shedding " + "+".join(
+                    c.label for c in SHEDDABLE[:target]))
+            _LOG.warning(
+                "brownout ENTER level %d (util %.2f, burn %.2f): "
+                "shedding %s", target, util, burn,
+                "+".join(c.label for c in SHEDDABLE[:target]))
+        elif self._level > 0:
+            calm = util <= self.util_exit and burn <= self.burn_exit
+            self._calm_ticks = self._calm_ticks + 1 if calm else 0
+            self._deesc_ticks = (self._deesc_ticks + 1
+                                 if target < self._level else 0)
+            if self._calm_ticks >= self.hold_ticks:
+                old, self._level = self._level, 0
+                self._calm_ticks = 0
+                self._deesc_ticks = 0
+                self._exits += 1
+                self._m_transitions.labels(direction="exit").inc()
+                self._recorder.record(
+                    "brownout_exit", from_level=old,
+                    utilization=round(util, 3),
+                    burn_rate=round(burn, 3),
+                    detail=f"calm for {self.hold_ticks} ticks")
+                _LOG.info("brownout EXIT (util %.2f, burn %.2f)",
+                          util, burn)
+            elif (self._level > 1
+                  and self._deesc_ticks >= self.hold_ticks):
+                # DE-ESCALATE one level: the signals no longer justify
+                # this level (below its entry threshold for a full
+                # hold window) but are not calm enough for a full
+                # exit — without this step a node whose load settles
+                # in the exit..enter band after a spike would shed
+                # GOSSIP forever on a stale level-2 verdict
+                old, self._level = self._level, self._level - 1
+                self._deesc_ticks = 0
+                self._deescalations += 1
+                self._m_transitions.labels(
+                    direction="deescalate").inc()
+                self._recorder.record(
+                    "brownout_deescalate", from_level=old,
+                    level=self._level, utilization=round(util, 3),
+                    burn_rate=round(burn, 3),
+                    detail=f"below level-{old} entry for "
+                           f"{self.hold_ticks} ticks")
+                _LOG.info(
+                    "brownout DE-ESCALATE to level %d "
+                    "(util %.2f, burn %.2f)", self._level, util, burn)
+        return self._level
+
+    # ------------------------------------------------------------------
+    @property
+    def brownout_level(self) -> int:
+        return self._level
+
+    def snapshot(self) -> dict:
+        """The /teku/v1/admin/admission controller view."""
+        with self._lock:
+            plan = self._plan
+            return {
+                "plan": {
+                    "batch_size": plan.batch_size,
+                    "flush_deadline_s": plan.flush_deadline_s,
+                    "modeled_batch_s": plan.modeled_batch_s,
+                },
+                "inputs": {
+                    "utilization": plan.utilization,
+                    "burn_rate": plan.burn_rate,
+                    "queue_depth": self.telemetry.queue_depth.current,
+                },
+                "brownout": {
+                    "level": self._level,
+                    "shedding": [c.label
+                                 for c in SHEDDABLE[:self._level]],
+                    "calm_ticks": self._calm_ticks,
+                    "deesc_ticks": self._deesc_ticks,
+                    "enters": self._enters,
+                    "exits": self._exits,
+                    "deescalations": self._deescalations,
+                },
+                "config": {
+                    "tick_s": self.tick_s,
+                    "min_bucket": self.min_bucket,
+                    "max_batch": self.max_batch,
+                    "slo_p50_ms": round(self.slo_p50_s * 1e3, 1),
+                    "device_budget_ms": round(
+                        self.device_budget_s * 1e3, 1),
+                    "util_enter": self.util_enter,
+                    "util_exit": self.util_exit,
+                    "burn_enter": self.burn_enter,
+                    "burn_exit": self.burn_exit,
+                    "hold_ticks": self.hold_ticks,
+                    "class_deadlines_ms": {
+                        c.label: round(class_deadline_s(c) * 1e3, 1)
+                        for c in VerifyClass},
+                },
+                "ticks": self._ticks,
+            }

@@ -11,13 +11,45 @@ TPU batching service without the spec logic knowing.
 """
 
 import asyncio
+import contextlib
+import contextvars
 from dataclasses import dataclass
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from ..crypto import bls
 from ..infra import faults, tracing
+from ..services.admission import VerifyClass
 
 Triple = Tuple[Sequence[bytes], bytes, bytes]
+
+# ambient class override: lets a call site that does not own the
+# verifier (e.g. the node's deferred-gossip retry loop re-running a
+# validator) demote everything submitted inside the block to a lower
+# class without threading a parameter through every layer.  ContextVars
+# propagate through awaits within the task, so the whole validate()
+# coroutine inherits it.
+_CLASS_OVERRIDE: contextvars.ContextVar = contextvars.ContextVar(
+    "verify_class_override", default=None)
+
+
+@contextlib.contextmanager
+def verify_class(cls: VerifyClass):
+    """Run the enclosed (possibly async) code with every service-bound
+    verification submitted at `cls` — e.g. OPTIMISTIC for speculative
+    re-validation of deferred gossip."""
+    token = _CLASS_OVERRIDE.set(cls)
+    try:
+        yield
+    finally:
+        _CLASS_OVERRIDE.reset(token)
+
+
+def effective_class(cls: Optional[VerifyClass]
+                    ) -> Optional[VerifyClass]:
+    """The ambient override beats the call-site default: a retry loop
+    demoting to OPTIMISTIC wins over a validator's GOSSIP."""
+    override = _CLASS_OVERRIDE.get()
+    return override if override is not None else cls
 
 
 class SignatureVerifier:
@@ -62,7 +94,15 @@ class BatchSignatureVerifier(SignatureVerifier):
     parallel stream + one completeBatchVerify; here one padded device
     dispatch via bls.batch_verify).  Use once per imported block; a
     False batch_verify invalidates every optimistic True.
+
+    Class: BLOCK_IMPORT — this path bypasses the batching queue
+    entirely (one direct dispatch), which IS the strongest priority:
+    it never waits behind gossip and can never be shed.  The class is
+    stamped on the trace and the capacity model's arrival accounting
+    so overload attribution still sees block-import demand.
     """
+
+    cls = VerifyClass.BLOCK_IMPORT
 
     def __init__(self):
         self._jobs: List[Triple] = []
@@ -81,10 +121,16 @@ class BatchSignatureVerifier(SignatureVerifier):
         if not self._jobs:
             return True
         faults.check("verifiers.dispatch")
+        # offered-load accounting: block-import verifies are demand on
+        # the same device the gossip queue shares — the capacity
+        # model's utilization must see them or brownout reads low
+        from ..infra import capacity
+        capacity.record_arrival(self.cls.label, len(self._jobs))
         # root span per imported block's signature batch — the
         # provider's host_prep/device_enqueue/device_sync spans nest
         # inside
         with tracing.trace("verify", kind="block_import",
+                           cls=self.cls.label,
                            jobs=str(len(self._jobs))):
             with tracing.span("dispatch"):
                 ok = bls.batch_verify(self._jobs)
@@ -93,10 +139,13 @@ class BatchSignatureVerifier(SignatureVerifier):
 
 class AsyncSignatureVerifier:
     """Async seam: the gossip-side interface the batching service
-    implements (reference AsyncBLSSignatureVerifier)."""
+    implements (reference AsyncBLSSignatureVerifier).  ``cls`` is the
+    submitting call site's ``VerifyClass`` — implementations without a
+    priority queue ignore it."""
 
     async def verify(self, public_keys: Sequence[bytes], message: bytes,
-                     signature: bytes) -> bool:
+                     signature: bytes,
+                     cls: Optional[VerifyClass] = None) -> bool:
         raise NotImplementedError
 
     @staticmethod
@@ -108,23 +157,30 @@ class _WrappedAsync(AsyncSignatureVerifier):
     def __init__(self, inner: SignatureVerifier):
         self._inner = inner
 
-    async def verify(self, public_keys, message, signature) -> bool:
+    async def verify(self, public_keys, message, signature,
+                     cls: Optional[VerifyClass] = None) -> bool:
         return self._inner.verify(public_keys, message, signature)
 
 
 class ServiceAsyncSignatureVerifier(AsyncSignatureVerifier):
     """Adapter onto AggregatingSignatureVerificationService (the TPU
-    batcher) — futures resolve when the device batch lands."""
+    batcher) — futures resolve when the device batch lands.  Threads
+    the caller's priority class (validator default or the ambient
+    ``verify_class`` override) into the service's per-class queue."""
 
     def __init__(self, service):
         self._service = service
 
-    async def verify(self, public_keys, message, signature) -> bool:
+    async def verify(self, public_keys, message, signature,
+                     cls: Optional[VerifyClass] = None) -> bool:
         return await self._service.verify(
-            list(public_keys), message, signature)
+            list(public_keys), message, signature,
+            cls=effective_class(cls))
 
-    async def verify_multi(self, triples: Sequence[Triple]) -> bool:
-        return await self._service.verify_multi(list(triples))
+    async def verify_multi(self, triples: Sequence[Triple],
+                           cls: Optional[VerifyClass] = None) -> bool:
+        return await self._service.verify_multi(
+            list(triples), cls=effective_class(cls))
 
 
 class AsyncBatchSignatureVerifier:
@@ -133,11 +189,14 @@ class AsyncBatchSignatureVerifier:
     task to the async delegate, so e.g. a SignedAggregateAndProof's
     three signatures verify together or not at all (reference:
     AsyncBatchBLSSignatureVerifier.java:24-60, used at
-    AggregateAttestationValidator.java:124-126,242).
+    AggregateAttestationValidator.java:124-126,242).  The constructing
+    validator stamps its priority class on the whole atomic task.
     """
 
-    def __init__(self, delegate: AsyncSignatureVerifier):
+    def __init__(self, delegate: AsyncSignatureVerifier,
+                 cls: Optional[VerifyClass] = None):
         self._delegate = delegate
+        self._cls = cls
         self._jobs: List[Triple] = []
 
     def verify(self, public_keys, message, signature) -> bool:
@@ -148,8 +207,10 @@ class AsyncBatchSignatureVerifier:
         if not self._jobs:
             return True
         if isinstance(self._delegate, ServiceAsyncSignatureVerifier):
-            return await self._delegate.verify_multi(self._jobs)
+            return await self._delegate.verify_multi(self._jobs,
+                                                     cls=self._cls)
         for pks, msg, sig in self._jobs:
-            if not await self._delegate.verify(pks, msg, sig):
+            if not await self._delegate.verify(pks, msg, sig,
+                                               cls=self._cls):
                 return False
         return True

@@ -1,0 +1,392 @@
+"""Overload control: adaptive batching, brownout hysteresis, priority
+classes, shed-by-class — and the closed-loop 10x acceptance run.
+
+Everything runs on an injected virtual clock (the controller, the
+capacity telemetry and the simulated device share one), so control
+decisions are deterministic without sleeps and the full 10x overload
+acceptance property — p50 <= 100 ms, zero BLOCK_IMPORT sheds, sheds
+ordered OPTIMISTIC >= GOSSIP, edge-triggered brownout — runs in the
+fast tier."""
+
+import asyncio
+
+import pytest
+
+from teku_tpu.infra import capacity as capacity_mod
+from teku_tpu.infra import flightrecorder
+from teku_tpu.infra.health import (HealthStatus,
+                                   admission_controller_check)
+from teku_tpu.infra.metrics import MetricsRegistry
+from teku_tpu.services.admission import (AdmissionController, BatchPlan,
+                                         SHEDDABLE, VerifyClass,
+                                         class_deadline_s)
+from teku_tpu.services.overload_sim import (DEFAULT_MIX, VirtualClock,
+                                            run_overload_sim)
+
+
+class FakeClock(VirtualClock):
+    pass
+
+
+def make_controller(clock, telemetry=None, burn=lambda: 0.0, **kw):
+    reg = kw.pop("registry", MetricsRegistry())
+    recorder = kw.pop("recorder",
+                      flightrecorder.FlightRecorder(registry=reg))
+    telemetry = telemetry or capacity_mod.CapacityTelemetry(
+        registry=reg, window_s=10.0, clock=clock, recorder=recorder)
+    kw.setdefault("tick_s", 0.1)
+    ctl = AdmissionController(
+        telemetry=telemetry, burn_getter=burn, min_bucket=8,
+        max_batch=256, slo_p50_s=0.1, clock=clock, registry=reg,
+        recorder=recorder, **kw)
+    return ctl, telemetry, recorder
+
+
+# --------------------------------------------------------------------------
+# Class vocabulary
+# --------------------------------------------------------------------------
+
+def test_class_order_and_shed_set():
+    """The priority order is the drain order, and only the two lowest
+    classes are ever sheddable."""
+    order = sorted(VerifyClass)
+    assert order == [VerifyClass.VIP, VerifyClass.BLOCK_IMPORT,
+                     VerifyClass.SYNC_CRITICAL, VerifyClass.GOSSIP,
+                     VerifyClass.OPTIMISTIC]
+    assert SHEDDABLE == (VerifyClass.OPTIMISTIC, VerifyClass.GOSSIP)
+    assert VerifyClass.BLOCK_IMPORT not in SHEDDABLE
+    assert VerifyClass.VIP not in SHEDDABLE
+    # per-class deadlines are positive and env-overridable
+    for c in VerifyClass:
+        assert class_deadline_s(c) > 0
+
+
+def test_class_deadline_env_override(monkeypatch):
+    monkeypatch.setenv("TEKU_TPU_VERIFY_CLASS_GOSSIP_DEADLINE_MS",
+                       "250")
+    assert class_deadline_s(VerifyClass.GOSSIP) == pytest.approx(0.25)
+
+
+# --------------------------------------------------------------------------
+# Adaptive batch sizing
+# --------------------------------------------------------------------------
+
+def test_batch_size_pow2_from_depth_when_idle():
+    """Latency mode (low utilization): the drain target is the
+    smallest pow-2 covering the live queue depth, floored at the
+    min bucket — bucket-aligned so padding waste stays low."""
+    clock = FakeClock()
+    ctl, tel, _ = make_controller(clock)
+    tel.record_queue_depth(0)
+    assert ctl.tick().batch_size == 8          # floor
+    tel.record_queue_depth(37)
+    clock.advance(1.0)
+    assert ctl.tick().batch_size == 64         # next pow2 over 37
+    tel.record_queue_depth(300)
+    clock.advance(1.0)
+    assert ctl.tick().batch_size == 256        # capped at max_batch
+
+
+def test_batch_size_capped_by_modeled_device_latency():
+    """The per-shape latency model caps the batch: the largest pow-2
+    whose MODELED device time fits the per-dispatch budget (half the
+    100 ms SLO by default)."""
+    clock = FakeClock()
+    ctl, tel, _ = make_controller(clock)
+    # evidence: 256 lanes cost 258 ms, 128 cost 130 ms, 64 cost 66 ms,
+    # 32 cost 34 ms (only 32 fits the 50 ms device budget)
+    for lanes, cost in ((256, 0.258), (128, 0.130), (64, 0.066),
+                        (32, 0.034)):
+        for _ in range(3):
+            t0 = clock()
+            clock.advance(cost)
+            tel.record_dispatch(f"{lanes}x1", "sim", lanes, t0, clock())
+    tel.record_queue_depth(4000)
+    # drive utilization into throughput mode: heavy offered load
+    tel.record_arrival("t", 50_000)
+    plan = ctl.tick()
+    assert plan.batch_size == 32
+    assert plan.modeled_batch_s == pytest.approx(0.034, abs=0.002)
+
+
+def test_flush_deadline_only_under_pressure():
+    """Workers only hold a partial batch open when utilization says
+    throughput is the constraint; idle nodes dispatch immediately."""
+    clock = FakeClock()
+    ctl, tel, _ = make_controller(clock)
+    tel.record_queue_depth(3)
+    assert ctl.tick().flush_deadline_s == 0.0      # no pressure
+    # pressure: modeled dispatches + demand over capacity
+    for _ in range(4):
+        t0 = clock()
+        clock.advance(0.034)
+        tel.record_dispatch("32x1", "sim", 32, t0, clock())
+    tel.record_arrival("t", 20_000)
+    clock.advance(0.2)
+    plan = ctl.tick()
+    assert plan.utilization > ctl.gather_util
+    assert 0.0 < plan.flush_deadline_s <= ctl.device_budget_s * 0.5
+
+
+# --------------------------------------------------------------------------
+# Brownout state machine: edges + hysteresis
+# --------------------------------------------------------------------------
+
+def _pressurize(tel, clock, arrivals=50_000):
+    """Dispatch evidence + offered arrivals so utilization reads >> 1."""
+    for _ in range(3):
+        t0 = clock()
+        clock.advance(0.034)
+        tel.record_dispatch("32x1", "sim", 32, t0, clock())
+    tel.record_arrival("t", arrivals)
+
+
+def test_brownout_enter_is_edge_triggered_and_exit_hysteretic():
+    clock = FakeClock()
+    ctl, tel, rec = make_controller(clock, hold_ticks=3)
+    _pressurize(tel, clock)
+    level = None
+    for _ in range(5):               # sustained pressure, many ticks
+        clock.advance(0.2)
+        level = ctl.tick().brownout_level
+    assert level >= 1
+    enters = [e for e in rec.snapshot()
+              if e["kind"] == "brownout_enter"
+              and e.get("from_level") == 0]
+    assert len(enters) == 1          # ONE edge despite 5 ticks
+    # pressure drops below the EXIT threshold: the controller must
+    # stay browned out for hold_ticks calm ticks before exiting
+    clock.advance(tel.window_s + 1)  # arrival window decays to zero
+    exit_events = lambda: [e for e in rec.snapshot()
+                           if e["kind"] == "brownout_exit"]
+    for i in range(ctl.hold_ticks - 1):
+        clock.advance(0.2)
+        assert ctl.tick().brownout_level >= 1, f"early exit at tick {i}"
+    assert not exit_events()
+    clock.advance(0.2)
+    assert ctl.tick().brownout_level == 0
+    assert len(exit_events()) == 1
+
+
+def test_brownout_does_not_flap_on_oscillating_signal():
+    """A burn rate oscillating across the ENTER threshold every tick
+    produces ONE enter and zero exits (the calm ticks never reach
+    hold_ticks because the calm threshold is LOWER than the enter
+    threshold — hysteresis)."""
+    clock = FakeClock()
+    burn_values = iter([2.0, 1.0, 2.0, 1.0, 2.0, 1.0, 2.0, 1.0])
+    ctl, tel, rec = make_controller(
+        clock, burn=lambda: next(burn_values, 1.0), hold_ticks=3)
+    for _ in range(8):
+        clock.advance(0.2)
+        ctl.tick()
+    events = [e["kind"] for e in rec.snapshot()
+              if e["kind"].startswith("brownout")]
+    assert events == ["brownout_enter"]
+    assert ctl.brownout_level >= 1
+
+
+def test_brownout_escalates_to_level2_and_events_carry_levels():
+    clock = FakeClock()
+    burn_box = {"v": 1.6}            # >= burn_enter (1.5): level 1
+    ctl, tel, rec = make_controller(clock, burn=lambda: burn_box["v"])
+    clock.advance(0.2)
+    assert ctl.tick().brownout_level == 1
+    burn_box["v"] = 3.1              # >= 2x burn_enter: level 2
+    clock.advance(0.2)
+    plan = ctl.tick()
+    assert plan.brownout_level == 2
+    assert plan.sheds(VerifyClass.OPTIMISTIC)
+    assert plan.sheds(VerifyClass.GOSSIP)
+    assert not plan.sheds(VerifyClass.BLOCK_IMPORT)
+    assert not plan.sheds(VerifyClass.SYNC_CRITICAL)
+    assert not plan.sheds(VerifyClass.VIP)
+    enters = [e for e in rec.snapshot()
+              if e["kind"] == "brownout_enter"]
+    assert [e["level"] for e in enters] == [1, 2]
+    assert [e["from_level"] for e in enters] == [0, 1]
+
+
+def test_brownout_deescalates_one_level_in_the_exit_enter_band():
+    """Level 2 entered on a spike must step DOWN to level 1 (after a
+    full hold window below the level-2 entry threshold) when load
+    settles between the exit and enter thresholds — NOT stay at full
+    GOSSIP shedding forever on the stale spike verdict — and must not
+    fully exit while the signals are above the exit threshold."""
+    clock = FakeClock()
+    burn_box = {"v": 3.5}            # >= 2x burn_enter: level 2
+    ctl, tel, rec = make_controller(clock, burn=lambda: burn_box["v"],
+                                    hold_ticks=3)
+    clock.advance(0.2)
+    assert ctl.tick().brownout_level == 2
+    # load settles in the band: above burn_exit (0.8), below
+    # burn_enter (1.5) — justifies neither level 2 nor a full exit
+    burn_box["v"] = 1.0
+    for i in range(ctl.hold_ticks - 1):
+        clock.advance(0.2)
+        assert ctl.tick().brownout_level == 2, f"early step at {i}"
+    clock.advance(0.2)
+    assert ctl.tick().brownout_level == 1    # one de-escalation edge
+    deesc = [e for e in rec.snapshot()
+             if e["kind"] == "brownout_deescalate"]
+    assert [(e["from_level"], e["level"]) for e in deesc] == [(2, 1)]
+    # still in the band: level 1 is justified (target would be 0 only
+    # below enter; but exit needs <= burn_exit) — holds at 1, no exit
+    for _ in range(ctl.hold_ticks + 2):
+        clock.advance(0.2)
+        assert ctl.tick().brownout_level == 1
+    assert not [e for e in rec.snapshot()
+                if e["kind"] == "brownout_exit"]
+    # genuinely calm: full exit after the hold window
+    burn_box["v"] = 0.1
+    for _ in range(ctl.hold_ticks):
+        clock.advance(0.2)
+        ctl.tick()
+    assert ctl.brownout_level == 0
+    assert len([e for e in rec.snapshot()
+                if e["kind"] == "brownout_exit"]) == 1
+
+
+def test_controller_health_check_reads_brownout():
+    clock = FakeClock()
+    ctl, tel, _ = make_controller(clock)
+    check = admission_controller_check(lambda: ctl)
+    assert check().status is HealthStatus.UP
+    _pressurize(tel, clock)
+    clock.advance(0.2)
+    ctl.tick()
+    res = check()
+    assert res.status is HealthStatus.DEGRADED
+    assert "brownout" in res.detail
+    assert admission_controller_check(lambda: None)().status \
+        is HealthStatus.UP
+
+
+def test_snapshot_shape_for_admin_endpoint():
+    clock = FakeClock()
+    ctl, _, _ = make_controller(clock)
+    ctl.tick()
+    snap = ctl.snapshot()
+    assert {"plan", "inputs", "brownout", "config", "ticks"} \
+        <= set(snap)
+    assert snap["plan"]["batch_size"] >= 8
+    assert snap["brownout"]["level"] == 0
+    assert set(snap["config"]["class_deadlines_ms"]) \
+        == {c.label for c in VerifyClass}
+
+
+def test_latency_for_lanes_is_conservative():
+    """The controller sizes batches against the WORST matching shape
+    estimate (across kmax variants and paths)."""
+    clock = FakeClock()
+    reg = MetricsRegistry()
+    model = capacity_mod.ShapeLatencyModel(registry=reg)
+    for _ in range(4):
+        model.observe("64x1", "vpu", 0.020)
+        model.observe("64x3", "vpu", 0.055)    # multi-key rows: slower
+        model.observe("8x1", "vpu", 0.004)
+    assert model.latency_for_lanes(64) == pytest.approx(0.055,
+                                                        abs=0.005)
+    assert model.latency_for_lanes(8) == pytest.approx(0.004,
+                                                       abs=0.002)
+    assert model.latency_for_lanes(128) is None
+
+
+# --------------------------------------------------------------------------
+# Closed-loop acceptance: 10x sustained offered load (ISSUE 7)
+# --------------------------------------------------------------------------
+
+def test_closed_loop_10x_holds_slo_and_sheds_by_class():
+    """THE acceptance property: at 10x sustained offered load the
+    control plane holds the 100 ms attestation-verify p50 by shedding
+    OPTIMISTIC first and GOSSIP second, never BLOCK_IMPORT, with ONE
+    edge-triggered brownout episode (no flapping) — all in virtual
+    time on the real service + controller code paths."""
+    out = asyncio.run(run_overload_sim(
+        offered_x=10.0, duration_s=3.0,
+        capacity_sigs_per_sec=1000.0, clock=FakeClock()))
+    # the SLO holds for what was ADMITTED
+    assert out["completed"] > 300
+    assert out["p50_ms"] <= 100.0, out
+    # shed ordering: OPTIMISTIC >= GOSSIP, protected classes never
+    sheds = out["sheds"]
+    assert sheds["block_import"] == 0
+    assert sheds["vip"] == 0
+    assert sheds["sync_critical"] == 0
+    assert sheds["optimistic"] >= sheds["gossip"] > 0
+    # brownout: one edge in, at most one out, no flap
+    assert out["brownout"]["enters"] == 1
+    assert out["brownout"]["exits"] == 1
+    assert out["brownout"]["flapped"] is False
+    assert out["brownout"]["final_level"] == 0   # recovered after load
+    # the protected core kept express latency
+    assert out["p50_ms_by_class"]["vip"] <= 50.0
+    assert out["p50_ms_by_class"]["block_import"] <= 100.0
+    # shed events in the flight recorder carry class labels (checked
+    # via counts here; the event shape is covered in the service tests)
+    assert out["shed_total"] == sum(sheds.values())
+
+
+def test_closed_loop_light_load_never_browns_out():
+    """At 0.3x offered load the controller must stay quiet: no
+    brownout episode, nothing shed, p50 well inside the SLO."""
+    out = asyncio.run(run_overload_sim(
+        offered_x=0.3, duration_s=2.0,
+        capacity_sigs_per_sec=1000.0, clock=FakeClock()))
+    assert out["brownout"]["enters"] == 0
+    assert out["shed_total"] == 0
+    assert out["p50_ms"] <= 100.0
+    assert out["completed"] == out["submitted"]
+
+
+def test_default_mix_is_shed_ordered_and_protected_fits():
+    """The bench mix's invariants: optimistic share >= gossip share
+    (so admission sheds preserve the ordering) and the protected core
+    at 10x stays under nominal capacity."""
+    protected = sum(share for cls, share in DEFAULT_MIX.items()
+                    if cls not in SHEDDABLE)
+    assert protected * 10 < 1.0
+    assert DEFAULT_MIX[VerifyClass.OPTIMISTIC] \
+        >= DEFAULT_MIX[VerifyClass.GOSSIP]
+    assert abs(sum(DEFAULT_MIX.values()) - 1.0) < 1e-9
+
+
+# --------------------------------------------------------------------------
+# Admin endpoint
+# --------------------------------------------------------------------------
+
+def test_admin_admission_endpoint_serves_controller_state():
+    """GET /teku/v1/admin/admission serves the controller's plan,
+    brownout state, inputs, and knob config plus the service's
+    per-class queue view; a node without overload control answers
+    503 so a dashboard never mistakes "off" for "healthy"."""
+    import asyncio as aio
+
+    from teku_tpu.api import BeaconRestApi
+    from teku_tpu.infra.restapi import HttpError
+    from teku_tpu.services.signatures import (
+        AggregatingSignatureVerificationService)
+
+    clock = FakeClock()
+    ctl, telemetry, _ = make_controller(clock)
+
+    class FakeNode:
+        admission = ctl
+
+    FakeNode.sig_service = AggregatingSignatureVerificationService(
+        num_workers=1, registry=MetricsRegistry(),
+        name="adm_endpoint", controller=ctl, telemetry=telemetry)
+    api = BeaconRestApi(FakeNode())
+    body = aio.run(api._admin_admission())["data"]
+    controller = body["controller"]
+    assert controller["plan"]["batch_size"] >= 8
+    assert controller["brownout"]["level"] == 0
+    assert controller["config"]["hold_ticks"] >= 1
+    assert set(controller["config"]["class_deadlines_ms"]) == set(
+        c.label for c in VerifyClass)
+    queues = body["queues"]
+    assert set(queues["classes"]) == {c.label for c in VerifyClass}
+    # overload control off: explicit 503, not an empty 200
+    with pytest.raises(HttpError) as err:
+        aio.run(BeaconRestApi(None)._admin_admission())
+    assert err.value.status == 503

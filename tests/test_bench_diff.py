@@ -40,6 +40,13 @@ def test_regressed_fixture_is_flagged():
     # dedup gates: 8x speedup fell under 1.5, warm pass dispatched h2c
     assert checks["dedup_speedup_8x"]["status"] == "regression"
     assert checks["warm_h2c_dispatches"]["status"] == "regression"
+    # overload gates: p50 at 10x blew the 100 ms SLO, BLOCK_IMPORT got
+    # shed, sheds inverted (gossip > optimistic), brownout flapped
+    assert checks["overload_p50_ms"]["status"] == "regression"
+    assert checks["overload_block_import_sheds"]["status"] \
+        == "regression"
+    assert checks["overload_shed_order"]["status"] == "regression"
+    assert checks["overload_brownout_stable"]["status"] == "regression"
 
 
 def test_base_vs_itself_passes():
@@ -47,7 +54,30 @@ def test_base_vs_itself_passes():
     out = bench_diff.compare(base, base)
     assert out["verdict"] == "pass"
     assert out["regressions"] == 0
-    assert _by_metric(out)["sigs_per_sec"]["ratio"] == 1.0
+    checks = _by_metric(out)
+    assert checks["sigs_per_sec"]["ratio"] == 1.0
+    # the overload acceptance gates pass on the healthy fixture
+    assert checks["overload_p50_ms"]["status"] == "ok"
+    assert checks["overload_block_import_sheds"]["status"] == "ok"
+    assert checks["overload_shed_order"]["status"] == "ok"
+    assert checks["overload_brownout_stable"]["status"] == "ok"
+
+
+def test_overload_gates_absent_are_skipped_and_threshold_overrides():
+    """A run without the overload phase skips the gates (budget-starved
+    rounds must not fail); the p50 gate threshold is operator-tunable
+    via --threshold overload_p50_ms_max=N."""
+    base = bench_diff.load_result(BASE)
+    stripped = {k: v for k, v in base.items() if k != "overload"}
+    out = bench_diff.compare(base, stripped)
+    checks = _by_metric(out)
+    for gate in ("overload_p50_ms", "overload_block_import_sheds",
+                 "overload_shed_order", "overload_brownout_stable"):
+        assert checks[gate]["status"] == "skipped"
+    # tighten the SLO gate below the fixture's measured 49 ms: flags
+    out = bench_diff.compare(base, base,
+                             {"overload_p50_ms_max": 40.0})
+    assert _by_metric(out)["overload_p50_ms"]["status"] == "regression"
 
 
 def test_current_bench_r05_vs_itself_passes():

@@ -9,6 +9,15 @@ and record canonicalization without a foreign client binary
 this exact format).
 """
 
+import pytest
+
+# the p2p/keystore stack imports the optional `cryptography`
+# module at package import time; absent it, skip cleanly
+# instead of erroring collection (tier-1 must report zero
+# collection errors)
+pytest.importorskip("cryptography")
+
+
 import asyncio
 import secrets as _secrets
 

@@ -232,12 +232,15 @@ def test_overflow_shed_counts_and_warns(caplog):
             with pytest.raises(ServiceCapacityExceededError):
                 svc.verify([PKS[0]], b"shed", sig)
         await svc.stop()
-        rejected = reg.counter(
-            "signature_verifications_rejected_total").value
+        # sheds carry the priority class (gossip is the default)
+        rejected = reg.metrics()[
+            "signature_verifications_rejected_total"].labels(
+            **{"class": "gossip"}).value
         assert rejected == 1
-        assert any("shedding task" in r.getMessage()
+        assert any("shedding" in r.getMessage()
                    for r in caplog.records)
-        assert "signature_verifications_rejected_total 1" in reg.expose()
+        assert ('signature_verifications_rejected_total'
+                '{class="gossip"} 1') in reg.expose()
     run(main())
 
 
@@ -256,8 +259,9 @@ def test_real_queue_overflow_also_counted():
                 futs.append(svc.verify([PKS[0]], msgs[i], sigs[i]))
         await asyncio.gather(*futs)
         await svc.stop()
-        assert reg.counter(
-            "signature_verifications_rejected_total").value >= 1
+        assert reg.metrics()[
+            "signature_verifications_rejected_total"].labels(
+            **{"class": "gossip"}).value >= 1
     run(main())
 
 

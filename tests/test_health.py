@@ -319,21 +319,34 @@ def test_breaker_trip_dumps_flight_recorder(tmp_path, monkeypatch):
 # Subsystem check factories
 # --------------------------------------------------------------------------
 
-def test_signature_service_check_saturation_and_stall():
+def test_signature_service_check_utilization_and_stall():
+    """The saturation signal is the CAPACITY MODEL's utilization (the
+    same signal the brownout controller keys on), with a raw
+    queue-full backstop for the pre-evidence window."""
     class FakeService:
         def __init__(self):
             self.snap = {"queue_size": 0, "capacity": 100,
                          "saturation": 0.0, "workers": 2,
-                         "stalled_s": 0.0}
+                         "stalled_s": 0.0,
+                         "capacity_model": {"utilization": 0.1,
+                                            "headroom_ratio": 0.9}}
 
         def health_snapshot(self):
             return dict(self.snap)
 
     svc = FakeService()
-    check = signature_service_check(svc, saturation_degraded=0.8,
+    check = signature_service_check(svc, utilization_degraded=1.0,
                                     stall_down_s=30.0)
     assert check().status is UP
-    svc.snap.update(queue_size=85, saturation=0.85)
+    # demand over sustainable capacity degrades even with a short queue
+    svc.snap["capacity_model"] = {"utilization": 1.2,
+                                  "headroom_ratio": 0.0}
+    res = check()
+    assert res.status is DEGRADED and "capacity" in res.detail
+    # back under capacity, but the queue physically full: backstop
+    svc.snap["capacity_model"] = {"utilization": 0.2,
+                                  "headroom_ratio": 0.8}
+    svc.snap.update(queue_size=97, saturation=0.97)
     assert check().status is DEGRADED
     svc.snap.update(stalled_s=45.0)
     res = check()
@@ -348,8 +361,14 @@ def test_real_signature_service_health_snapshot():
         name="t_health_sigs")
     snap = svc.health_snapshot()
     capacity_model = snap.pop("capacity_model")
+    classes = snap.pop("classes")
     assert snap == {"queue_size": 0, "capacity": 10, "saturation": 0.0,
-                    "workers": 0, "stalled_s": 0.0}
+                    "workers": 0, "stalled_s": 0.0,
+                    "brownout_level": 0}
+    # per-class queue view: every VerifyClass present, all idle
+    from teku_tpu.services.admission import VerifyClass
+    assert set(classes) == {c.label for c in VerifyClass}
+    assert all(v["depth"] == 0 for v in classes.values())
     # the embedded capacity view (infra/capacity.py) rides along for
     # the SLO engine / adaptive batcher
     assert {"utilization", "headroom_ratio",

@@ -405,6 +405,127 @@ def test_capacity_profiler_family_naming_lint():
         assert fam in fams and fams[fam]["type"] is not None
 
 
+def test_overload_class_family_naming_lint():
+    """The PR-7 per-class/admission families must not drift: every
+    ``{class}`` label value comes from the CLOSED VerifyClass enum
+    (bounded cardinality — an adversary cannot grow the scrape by
+    inventing classes, because the label is typed at the API), sheds
+    are ``_total`` counters labeled by class, the per-class depth/age
+    gauges carry unit suffixes, and the admission controller exports
+    its plan/brownout gauges + edge-transition counter."""
+    from teku_tpu.services.admission import (AdmissionController,
+                                             CLASS_LABELS, VerifyClass)
+    from teku_tpu.services.signatures import (
+        AggregatingSignatureVerificationService)
+
+    # the class label vocabulary IS the enum — closed and tiny
+    assert CLASS_LABELS == ("vip", "block_import", "sync_critical",
+                            "gossip", "optimistic")
+    assert len(CLASS_LABELS) == len(VerifyClass)
+
+    reg = MetricsRegistry()
+    AggregatingSignatureVerificationService(registry=reg,
+                                            name="lint_sigs")
+    metrics = reg.metrics()
+    rejected = metrics["lint_sigs_rejected_total"]
+    assert isinstance(rejected, LabeledCounter)
+    assert tuple(rejected.labelnames) == ("class",)
+    depth = metrics["lint_sigs_class_queue_depth"]
+    age = metrics["lint_sigs_class_oldest_wait_seconds"]
+    assert isinstance(depth, LabeledGauge)
+    assert isinstance(age, LabeledGauge)
+    # bounded cardinality: the service pre-registers EXACTLY the enum's
+    # series (scrape-complete from the first exposition, and nothing
+    # can add a sixth class without extending the enum)
+    assert {key[0] for key, _ in depth._items()} == set(CLASS_LABELS)
+    assert {key[0] for key, _ in age._items()} == set(CLASS_LABELS)
+
+    # admission controller families: name-prefixed like the service's
+    # (a multi-node devnet process must not collapse every node onto
+    # one shared gauge)
+    reg2 = MetricsRegistry()
+    from teku_tpu.infra.flightrecorder import FlightRecorder
+    AdmissionController(registry=reg2, name="lint_adm",
+                        recorder=FlightRecorder(registry=reg2))
+    m2 = reg2.metrics()
+    assert {"lint_adm_admission_batch_size",
+            "lint_adm_admission_flush_deadline_seconds",
+            "lint_adm_admission_brownout_level",
+            "lint_adm_admission_brownout_transitions_total"} <= set(m2)
+    trans = m2["lint_adm_admission_brownout_transitions_total"]
+    assert isinstance(trans, LabeledCounter)
+    assert tuple(trans.labelnames) == ("direction",)
+
+    problems = []
+    for name, m in {**metrics, **m2}.items():
+        if not name.startswith(("lint_sigs_", "lint_adm_")):
+            continue
+        if isinstance(m, (Counter, LabeledCounter)) \
+                and not name.endswith("_total"):
+            problems.append(f"counter {name} must end _total")
+        if name.endswith("_total") \
+                and not isinstance(m, (Counter, LabeledCounter)):
+            problems.append(f"{name} ends _total but is not a counter")
+        if _DURATION_HINT.search(name) and not name.endswith("_seconds"):
+            problems.append(f"duration metric {name} must end _seconds")
+    assert not problems, "\n".join(problems)
+
+    # the combined exposition stays structurally valid; the rejected
+    # counter's family is DECLARED (HELP/TYPE) before any shed has
+    # produced a series, so dashboards can discover it at scrape 1
+    exposed = reg.expose()
+    assert "# TYPE lint_sigs_rejected_total counter" in exposed
+    fams = parse_exposition(exposed)
+    for fam in ("lint_sigs_class_queue_depth",
+                "lint_sigs_class_oldest_wait_seconds"):
+        assert fam in fams and fams[fam]["type"] == "gauge"
+        labels = {s[1].get("class") for s in fams[fam]["samples"]}
+        assert labels == set(CLASS_LABELS)
+    fams2 = parse_exposition(reg2.expose())
+    assert fams2["lint_adm_admission_brownout_level"]["type"] == "gauge"
+
+
+def test_queue_shed_events_carry_class_labels():
+    """Flight-recorder queue_shed events must name the shed class and
+    the shedding reason (the incident-report contract)."""
+    import asyncio
+    from teku_tpu.infra import flightrecorder
+    from teku_tpu.services.admission import VerifyClass
+    from teku_tpu.services.signatures import (
+        AggregatingSignatureVerificationService,
+        ServiceCapacityExceededError)
+
+    async def main():
+        svc = AggregatingSignatureVerificationService(
+            num_workers=1, queue_capacity=1,
+            registry=MetricsRegistry(), name="lint_shed")
+        await svc.start()
+        before = len(flightrecorder.RECORDER.snapshot())
+        blocker = svc.verify([b"\xa0" + bytes(47)], b"b1", b"s")
+        await asyncio.sleep(0.05)
+        f1 = svc.verify([b"\xa0" + bytes(47)], b"b2", b"s",
+                        cls=VerifyClass.OPTIMISTIC)
+        with pytest.raises(ServiceCapacityExceededError):
+            svc.verify([b"\xa0" + bytes(47)], b"b3", b"s",
+                       cls=VerifyClass.OPTIMISTIC)
+        for fut in (blocker, f1):
+            try:
+                await fut
+            except Exception:
+                pass
+        await svc.stop()
+        return flightrecorder.RECORDER.snapshot()[before:]
+
+    events = asyncio.run(main())
+    sheds = [e for e in events if e["kind"] == "queue_shed"]
+    assert sheds, "no queue_shed event recorded"
+    for e in sheds:
+        assert e["class"] == "optimistic"
+        assert e["reason"] in ("overflow", "preempted", "brownout")
+        assert e["service"] == "lint_shed"
+        assert "trace_id" in e
+
+
 def test_slo_health_family_naming_lint():
     """The PR-3 families must not drift from the conventions: states as
     labeled/state gauges (never bare numbers encoding an enum), burn

@@ -30,7 +30,12 @@ a failure — budget-starved runs drop phases):
   the persistent cache stopped serving (absolute check);
 - dedup gates (absolute): ``h2c_dedup`` 8x speedup ≥ 1.5 and the
   fully-warm pass's ``h2c_dispatches == 0`` — the PR-5 acceptance
-  properties must not silently rot.
+  properties must not silently rot;
+- overload gates (absolute, on the closed-loop ``overload.at_max``
+  run): p50 under max offered load ≤ ``overload_p50_ms_max`` (default
+  the 100 ms SLO), ZERO BLOCK_IMPORT sheds, shed counts ordered
+  OPTIMISTIC ≥ GOSSIP, and an unflapped brownout (one enter edge, at
+  most one exit) — the PR-7 acceptance properties.
 """
 
 import argparse
@@ -45,6 +50,7 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
     "p99_ms": 0.30,
     "stage_p50_ms": 0.30,
     "dedup_speedup_8x_min": 1.5,
+    "overload_p50_ms_max": 100.0,
 }
 
 
@@ -164,6 +170,38 @@ def compare(base: dict, new: dict,
         warm.get("h2c_dispatches", new.get("warm_h2c_dispatches")),
         lambda v: v == 0,
         "a fully-warm H(m) cache must dispatch zero h2c")
+
+    # overload gates (PR-7 acceptance properties, absolute): the
+    # closed-loop phase's max-offered-load run must hold the SLO by
+    # shedding the right classes, never block import, without flapping
+    at_max = _get(new, "overload", "at_max") or {}
+    _check_absolute(
+        checks, "overload_p50_ms",
+        at_max.get("p50_ms", new.get("overload_p50_ms")),
+        lambda v: v <= thr["overload_p50_ms_max"],
+        f"p50 under max offered load must stay <= "
+        f"{thr['overload_p50_ms_max']} ms")
+    sheds = at_max.get("sheds") or {}
+    _check_absolute(
+        checks, "overload_block_import_sheds",
+        sheds.get("block_import",
+                  new.get("overload_block_import_sheds")),
+        lambda v: v == 0,
+        "BLOCK_IMPORT must never be shed under overload")
+    _check_absolute(
+        checks, "overload_shed_order",
+        ((sheds.get("optimistic"), sheds.get("gossip"))
+         if sheds else None),
+        lambda v: v[0] is not None and v[1] is not None
+        and v[0] >= v[1],
+        "shed counts must be ordered OPTIMISTIC >= GOSSIP")
+    brownout = at_max.get("brownout") or {}
+    _check_absolute(
+        checks, "overload_brownout_stable",
+        brownout.get("flapped") if brownout else None,
+        lambda v: v is False,
+        "brownout must be edge-triggered: one enter, at most one "
+        "exit, no flapping")
 
     regressions = [c for c in checks if c["status"] == "regression"]
     return {"verdict": "regression" if regressions else "pass",
