@@ -114,22 +114,31 @@ def _configure_overload(args, yaml_cfg) -> str:
     return choice
 
 
-# mirror of ops/mxu.py PATHS, spelled locally so the boot path never
-# imports the ops package (whose __init__ imports jax) on the main
-# thread — the env var is how the choice reaches the kernel layer
+# mirrors of ops/mxu.py and ops/msm.py PATHS, spelled locally so the
+# boot path never imports the ops package (whose __init__ imports jax)
+# on the main thread — the env vars are how the choices reach the
+# kernel layer
 _MONT_PATHS = ("vpu", "mxu", "auto", "mxu-force")
+_MSM_PATHS = ("ladder", "pippenger", "auto")
 
 
-def _configure_kernel(args, yaml_cfg) -> str:
+def _configure_kernel(args, yaml_cfg):
     """Kernel-layer knobs that must be decided BEFORE jax loads:
 
     - the mont_mul engine (`--mont-path` / TEKU_TPU_MONT_MUL: vpu |
       mxu | auto; auto = the int8 digit-split MXU path exactly when
       the dispatch device is a TPU) — resolved by ops/mxu.py at trace
       time in the probe/dispatch threads;
+    - the scalars-stage MSM path (`--msm-path` / TEKU_TPU_MSM: ladder
+      | pippenger | auto; auto = the GLV+Pippenger bucketed MSM
+      exactly when the dispatch device is a TPU and the batch clears
+      the duplication crossover) — resolved by ops/msm.py per
+      dispatch;
     - the persistent XLA compile cache (TEKU_TPU_XLA_CACHE_DIR, ON by
       default; =off disables) so warm boots load the multi-minute
       per-shape kernel compiles from disk instead of repaying them.
+
+    Returns (mont_path, msm_path).
     """
     from .infra import compilecache
 
@@ -140,12 +149,19 @@ def _configure_kernel(args, yaml_cfg) -> str:
         raise SystemExit(f"invalid --mont-path {choice!r} (use one of "
                          f"{'/'.join(_MONT_PATHS)})")
     os.environ["TEKU_TPU_MONT_MUL"] = choice
+    msm_choice = str(layered_value(
+        "msm-path", getattr(args, "msm_path", None), yaml_cfg,
+        "auto")).lower()
+    if msm_choice not in _MSM_PATHS:
+        raise SystemExit(f"invalid --msm-path {msm_choice!r} (use one "
+                         f"of {'/'.join(_MSM_PATHS)})")
+    os.environ["TEKU_TPU_MSM"] = msm_choice
     compilecache.configure()
-    return choice
+    return choice, msm_choice
 
 
 def _configure_bls(args, yaml_cfg, *, supervise: bool = True,
-                   mont_path=None):
+                   mont_path=None, msm_path=None):
     """Choose the BLS bring-up shape BEFORE any service starts.
 
     ``auto`` (the default) and ``supervised`` boot the node immediately
@@ -160,13 +176,15 @@ def _configure_bls(args, yaml_cfg, *, supervise: bool = True,
                            yaml_cfg, "auto")
     if choice in ("auto", "supervised") and supervise:
         loader.configure("supervised")      # oracle serves from slot 0
-        supervisor = loader.make_supervisor(mont_path=mont_path)
+        supervisor = loader.make_supervisor(mont_path=mont_path,
+                                            msm_path=msm_path)
         print("BLS implementation: pure (supervised device bring-up "
               "in background)")
         return "supervised", supervisor
     try:
         name = loader.configure("pure" if choice == "supervised"
-                                else choice, mont_path=mont_path)
+                                else choice, mont_path=mont_path,
+                                msm_path=msm_path)
     except loader.BlsLoadError as exc:
         raise SystemExit(f"BLS preflight failed: {exc}")
     print(f"BLS implementation: {name}")
@@ -192,9 +210,10 @@ def cmd_node(args) -> int:
     # + flight-recorder JSONL dump on fatal crash (infra/flightrecorder)
     from .infra import flightrecorder
     flightrecorder.install_crash_hooks()
-    mont_path = _configure_kernel(args, yaml_cfg)
+    mont_path, msm_path = _configure_kernel(args, yaml_cfg)
     _, bls_supervisor = _configure_bls(args, yaml_cfg,
-                                       mont_path=mont_path)
+                                       mont_path=mont_path,
+                                       msm_path=msm_path)
     network = layered_value("network", args.network, yaml_cfg, "minimal")
     port = int(layered_value("p2p-port", args.p2p_port, yaml_cfg, 0, int))
     rest_port = int(layered_value("rest-port", args.rest_port, yaml_cfg,
@@ -403,8 +422,9 @@ def cmd_devnet(args) -> int:
     _configure_log_format(args, {})
     _configure_tracing(args, {})
     _configure_overload(args, {})
-    mont_path = _configure_kernel(args, {})
-    _, bls_supervisor = _configure_bls(args, {}, mont_path=mont_path)
+    mont_path, msm_path = _configure_kernel(args, {})
+    _, bls_supervisor = _configure_bls(args, {}, mont_path=mont_path,
+                                       msm_path=msm_path)
 
     async def run():
         net = Devnet(n_nodes=args.nodes, n_validators=args.validators)
@@ -699,8 +719,9 @@ def cmd_validator_client(args) -> int:
     # the VC's hot path is signing (host-side); no background bring-up
     _configure_log_format(args, {})
     _configure_tracing(args, {})
-    mont_path = _configure_kernel(args, {})
-    _configure_bls(args, {}, supervise=False, mont_path=mont_path)
+    mont_path, msm_path = _configure_kernel(args, {})
+    _configure_bls(args, {}, supervise=False, mont_path=mont_path,
+                   msm_path=msm_path)
     spec = create_spec(args.network or "minimal")
     remote = RemoteValidatorApi(spec, args.beacon_node)
     genesis = remote._get_json("/eth/v1/beacon/genesis")["data"]
@@ -810,6 +831,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "device is a TPU).  mxu on a non-TPU device "
                         "falls back to vpu with one warning.  Env: "
                         "TEKU_TPU_MONT_MUL")
+    n.add_argument("--msm-path", default=None,
+                   choices=["ladder", "pippenger", "auto"],
+                   help="scalars-stage engine for the batch-verify "
+                        "multiplier folds: ladder (per-lane windowed "
+                        "double-and-add), pippenger (GLV half-scalar "
+                        "split + windowed bucket MSM, one doubling "
+                        "chain per message group), auto (default: "
+                        "pippenger exactly when the dispatch device "
+                        "is a TPU and the batch clears the "
+                        "duplication crossover; see PERF.md).  Env: "
+                        "TEKU_TPU_MSM")
     n.add_argument("--overload-control", default=None,
                    choices=["on", "off"],
                    help="adaptive batching + priority classes + "
@@ -837,6 +869,8 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["auto", "supervised", "jax", "pure"])
     d.add_argument("--mont-path", default=None,
                    choices=["vpu", "mxu", "auto"])
+    d.add_argument("--msm-path", default=None,
+                   choices=["ladder", "pippenger", "auto"])
     d.add_argument("--tracing", default=None, choices=["on", "off"])
     d.add_argument("--overload-control", default=None,
                    choices=["on", "off"])
@@ -892,6 +926,8 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=["auto", "supervised", "jax", "pure"])
     vc.add_argument("--mont-path", default=None,
                     choices=["vpu", "mxu", "auto"])
+    vc.add_argument("--msm-path", default=None,
+                    choices=["ladder", "pippenger", "auto"])
     vc.add_argument("--tracing", default=None, choices=["on", "off"])
     vc.add_argument("--log-format", default=None,
                     choices=["text", "json"])

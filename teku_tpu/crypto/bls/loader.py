@@ -56,19 +56,27 @@ class BlsLoadError(RuntimeError):
     """The requested BLS implementation could not be brought up."""
 
 
-def _probe_jax(max_batch: int, min_bucket: int, mont_path=None):
+def _probe_jax(max_batch: int, min_bucket: int, mont_path=None,
+               msm_path=None):
     """Instantiate the device provider and prove the backend executes:
     one pubkey-validation dispatch (the small program; the five staged
     verify programs compile lazily on first real batch).
 
     `mont_path` installs the process-global mont_mul engine choice
-    (vpu | mxu | auto, ops/mxu.py) BEFORE any kernel traces — this is
-    the seam the CLI's `--mont-path` threads through."""
-    from ...ops import mxu
+    (vpu | mxu | auto, ops/mxu.py) and `msm_path` the scalars-stage
+    choice (ladder | pippenger | auto, ops/msm.py) BEFORE any kernel
+    traces — the seams the CLI's `--mont-path`/`--msm-path` thread
+    through.  The warmup batches downstream then compile whichever
+    scalars program the resolved path dispatches (the dup-8 committee
+    warmup is the shape `auto` sends to pippenger) off the gossip
+    path."""
+    from ...ops import msm, mxu
     from ...ops.provider import JaxBls12381
 
     if mont_path is not None:
         mxu.set_path(mont_path)
+    if msm_path is not None:
+        msm.set_path(msm_path)
     impl = JaxBls12381(max_batch=max_batch, min_bucket=min_bucket)
     if not impl.public_key_is_valid(_PROBE_PK):
         raise BlsLoadError("device probe rejected the generator pubkey")
@@ -239,6 +247,7 @@ def make_supervisor(*, max_batch: int = 256, min_bucket: int = 16,
                     registry: MetricsRegistry = GLOBAL_REGISTRY,
                     breaker: Optional[CircuitBreaker] = None,
                     warm: bool = True, mont_path: Optional[str] = None,
+                    msm_path: Optional[str] = None,
                     **supervisor_kw) -> BackendSupervisor:
     """Build the production BackendSupervisor: boot-on-oracle now,
     background JAX bring-up, breaker-guarded hot-swap at READY for both
@@ -270,7 +279,8 @@ def make_supervisor(*, max_batch: int = 256, min_bucket: int = 16,
     installed: dict = {}
 
     def probe():
-        return _probe_jax(max_batch, min_bucket, mont_path=mont_path)
+        return _probe_jax(max_batch, min_bucket, mont_path=mont_path,
+                          msm_path=msm_path)
 
     def warmup(backend):
         if not warm:
@@ -435,7 +445,8 @@ class GuardedKzgBackend:
 def configure(choice: str = "auto", *, max_batch: int = 256,
               min_bucket: int = 16,
               probe_timeout_s: Optional[float] = None,
-              mont_path: Optional[str] = None) -> str:
+              mont_path: Optional[str] = None,
+              msm_path: Optional[str] = None) -> str:
     """Install the BLS provider for this process; returns its name.
 
     auto: try the JAX/TPU provider under a deadline, fall back to the
@@ -463,7 +474,8 @@ def configure(choice: str = "auto", *, max_batch: int = 256,
     def run():
         try:
             result["ok"] = _probe_jax(max_batch, min_bucket,
-                                      mont_path=mont_path)
+                                      mont_path=mont_path,
+                                      msm_path=msm_path)
         except BaseException as exc:  # noqa: BLE001 - report any failure
             result["err"] = exc
 

@@ -1,0 +1,459 @@
+"""MSM-grade scalars stage: Pippenger bucket accumulation + GLV.
+
+The r-weighted pubkey fold ``sum_i [r_i]pk_i`` that stage_scalars +
+stage_group compute per unique message IS a multi-scalar multiplication
+— the unit of cryptographic throughput (2G2T, PAPERS.md) — and after
+the PR-5 dedup work it dominates the per-lane budget (PERF.md stage
+profile: ~2,600 mont_muls/lane on the ladder).  This module replaces
+the per-lane fixed-window ladder with the two classic MSM levers,
+expressed as constant-shape batched JAX so the MXU digit-split
+mont_mul (ops/mxu.py) does every inner field op:
+
+1. GLV ENDOMORPHISM.  phi(x, y) = (beta*x, y) acts as [lambda] on G1
+   (lambda = -z^2 mod r, the eigenvalue ops/points.py verifies on the
+   generator at import), and psi^2 acts as [z^2] = [-lambda] on G2.
+   Instead of decomposing a sampled 64-bit multiplier (its honest
+   lattice split mod r would GROW the halves to ~128 bits — the GLV
+   short vectors have norm ~sqrt(r)), the batch multipliers are
+   SAMPLED directly in decomposed form: (k1, k2) <- [0, 2^32)^2 minus
+   (0, 0), effective multiplier r = k1 + k2*lambda mod r.  The map is
+   injective on that range (a collision would be a lattice vector of
+   norm < 2^33 against a 2^127 minimum; PERF.md "MSM scalars stage"
+   has the bound), so the multiplier set still has 2^64 - 1 elements
+   and batch-verify soundness is unchanged — while every scalar walk
+   is 32 bits instead of 64.
+
+2. PIPPENGER BUCKETING.  For each (message-group, window) the lanes'
+   w-bit digits accumulate into 2^w - 1 bucket points via a
+   constant-shape scan (gather bucket[d-1], one batched point_add,
+   one-hot select scatter — every step does identical work regardless
+   of digit values, so the batch semantics stay constant-time), then
+   buckets collapse with the suffix-sum identity
+   ``sum_b b*B_b = sum_b (suffix sums)`` and windows combine Horner-
+   style.  The doubling chain runs once per GROUP (32 - w doublings)
+   instead of once per lane, and the per-lane add count is
+   2 points x nwin windows — O(lanes + groups * 2^w) point adds
+   total vs the ladder's O(lanes * 64/w) adds + O(lanes * 64)
+   doublings.
+
+Path selection mirrors ops/mxu.py: process-global config (CLI
+``--msm-path`` / env ``TEKU_TPU_MSM`` / ``set_path()``), resolved per
+DISPATCH (the crossover is shape-dependent):
+
+- ``ladder``    — the per-lane windowed ladder + stage_group fold
+  (the bit-identical parity oracle; scalar_mul_bits);
+- ``pippenger`` — the bucketed MSM path on any device (CPU A/B and
+  the bench gate use this explicitly);
+- ``auto``      — pippenger exactly when the dispatch device is a TPU
+  AND the batch clears the measured crossover (lanes >=
+  TEKU_TPU_MSM_AUTO_MIN_LANES and lanes/group-rows >=
+  TEKU_TPU_MSM_AUTO_MIN_DUP); everything else stays on the ladder so
+  small/all-unique dispatches never pay the per-group bucket
+  overhead.  Why auto resolves this way is measured + documented in
+  PERF.md.
+
+The sharded (multi-chip) kernel always takes the ladder: bucketing is
+a per-message-group operation and groups cross shard boundaries.
+"""
+
+import logging
+import os
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..crypto.bls.constants import R, X_ABS
+from ..infra.env import env_float, env_int
+from . import points as PT
+
+_LOG = logging.getLogger(__name__)
+
+PATHS = ("ladder", "pippenger", "auto")
+ENV_VAR = "TEKU_TPU_MSM"
+ENV_WINDOW = "TEKU_TPU_MSM_WINDOW"
+ENV_SEG = "TEKU_TPU_MSM_SEG"
+ENV_AUTO_MIN_LANES = "TEKU_TPU_MSM_AUTO_MIN_LANES"
+ENV_AUTO_MIN_DUP = "TEKU_TPU_MSM_AUTO_MIN_DUP"
+
+# half-scalar width: multipliers are sampled as (k1, k2) in [0, 2^32)^2
+GLV_BITS = 32
+
+# the shared GLV eigenvalue: phi = [LAMBDA] on G1, psi^2 = [-LAMBDA] on
+# G2 (z < 0 for BLS12-381, so z^2 = X_ABS^2 and LAMBDA = -z^2 mod r)
+LAMBDA = (-(X_ABS * X_ABS)) % R
+
+_lock = threading.Lock()
+_state = {"path": None}               # None -> read ENV_VAR at resolve()
+_warned_invalid = [False]
+
+
+def set_path(path) -> None:
+    """Install the process-global MSM path (CLI/loader seam).
+
+    ``None`` resets to env/default resolution."""
+    if path is not None and path not in PATHS:
+        raise ValueError(
+            f"unknown msm path {path!r} (use one of {'/'.join(PATHS)})")
+    with _lock:
+        _state["path"] = path
+        _warned_invalid[0] = False
+
+
+def get_path() -> str:
+    """The CONFIGURED path (may be 'auto'); see resolve()."""
+    configured = _state["path"]
+    if configured is None:
+        configured = os.environ.get(ENV_VAR, "auto") or "auto"
+    if configured not in PATHS:
+        with _lock:
+            if not _warned_invalid[0]:
+                _warned_invalid[0] = True
+                _LOG.warning("%s=%r is not one of %s; using auto",
+                             ENV_VAR, configured, "/".join(PATHS))
+        configured = "auto"
+    return configured
+
+
+def _device_is_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - no backend at all
+        return False
+
+
+def resolve(lanes=None, rows=None, sharded: bool = False) -> str:
+    """The EFFECTIVE path for one dispatch: 'ladder' or 'pippenger'.
+
+    `lanes`/`rows` are the dispatch's real lane count and Miller-row
+    count (their ratio is the duplication factor the crossover model
+    keys on); `auto` without shape context resolves to the ladder."""
+    if sharded:
+        return "ladder"          # grouping crosses shard boundaries
+    configured = get_path()
+    if configured in ("ladder", "pippenger"):
+        return configured
+    # auto: the bucketed path wins when the per-group overhead
+    # (2^w - 1 buckets reduced per window) amortizes over enough
+    # duplicated lanes AND the device is the one it was tuned for
+    if not _device_is_tpu():
+        return "ladder"
+    if not lanes or not rows:
+        return "ladder"
+    # shared degrade-never-fail env readers: resolve() sits on the
+    # live dispatch path, so a typo'd threshold must fall back to the
+    # default, not fail every verification
+    min_lanes = env_int(ENV_AUTO_MIN_LANES, 32)
+    min_dup = env_float(ENV_AUTO_MIN_DUP, 2.0)
+    if lanes >= min_lanes and lanes / rows >= min_dup:
+        return "pippenger"
+    return "ladder"
+
+
+class force:
+    """Context manager pinning the path (tests / bench A/B)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = _state["path"]
+        set_path(self._path)
+        return self
+
+    def __exit__(self, *exc):
+        set_path(self._prev)
+        return False
+
+
+# --------------------------------------------------------------------------
+# Window geometry + host-side digit packing
+# --------------------------------------------------------------------------
+
+_warned_window = [False]
+
+
+def window_env() -> int:
+    """The configured bucket window width w (digits are w-bit).
+
+    Read host-side per dispatch (the digit-array SHAPE then carries
+    the choice into the traced program via window_for_nwin).  An
+    invalid value degrades to the default with one warning — the same
+    contract as an invalid TEKU_TPU_MSM: a typo'd tuning knob must
+    never start failing live verifications at dispatch time."""
+    raw = os.environ.get(ENV_WINDOW, "4")
+    try:
+        w = int(raw)
+        if not 1 <= w <= 8:
+            raise ValueError
+        return w
+    except ValueError:
+        with _lock:
+            if not _warned_window[0]:
+                _warned_window[0] = True
+                _LOG.warning("%s=%r is not an int in 1..8; using 4",
+                             ENV_WINDOW, raw)
+        return 4
+
+
+def n_windows(window: int) -> int:
+    return -(-GLV_BITS // window)
+
+
+def window_for_nwin(nwin: int) -> int:
+    """Invert n_windows: digit-array shapes fully determine the window
+    (w in 1..8 <-> nwin in {32,16,11,8,7,6,5,4} is a bijection), so
+    the jitted stages never read env at trace time."""
+    return -(-GLV_BITS // nwin)
+
+
+def effective_scalar(k1: int, k2: int) -> int:
+    """The multiplier a (k1, k2) pair encodes: k1 + k2*lambda mod r.
+    Host-side; the parity tests drive scalar_mul_bits with its bits."""
+    return (int(k1) + int(k2) * LAMBDA) % R
+
+
+def glv_sample_from_uint64(raw: np.ndarray):
+    """uint64 entropy (N,) -> (k1, k2) 32-bit half-scalar arrays.
+
+    (0, 0) is nudged to (1, 0) — the only pair whose effective
+    multiplier is 0 (PERF.md: for k2 != 0, |k2*lambda mod r| >= z^2 >
+    2^127 > k1), mirroring the ladder path's zero-nudge with the same
+    negligible 2^-64 bias."""
+    raw = np.asarray(raw, dtype=np.uint64)
+    k1 = (raw & np.uint64(0xFFFFFFFF)).copy()
+    k2 = raw >> np.uint64(32)
+    k1[(k1 | k2) == 0] = 1
+    return k1, k2
+
+
+def glv_digits_np(k1, k2, window=None) -> np.ndarray:
+    """Half-scalar arrays (N,) -> (N, 2, nwin) int32 w-bit digits,
+    MSB-first (Horner order).  Row [:, 0] drives the base point P,
+    row [:, 1] drives the endomorphism point [lambda]P."""
+    w = window_env() if window is None else window
+    nwin = n_windows(w)
+    k1 = np.asarray(k1, dtype=np.uint64)
+    k2 = np.asarray(k2, dtype=np.uint64)
+    if k1.size and (int(k1.max()) >> GLV_BITS or int(k2.max()) >> GLV_BITS):
+        raise ValueError("GLV half-scalars must be < 2^%d" % GLV_BITS)
+    mask = np.uint64((1 << w) - 1)
+    out = np.zeros(k1.shape + (2, nwin), dtype=np.int32)
+    for j in range(nwin):
+        shift = np.uint64((nwin - 1 - j) * w)
+        out[..., 0, j] = ((k1 >> shift) & mask).astype(np.int32)
+        out[..., 1, j] = ((k2 >> shift) & mask).astype(np.int32)
+    return out
+
+
+_seg_cache: list = []
+
+
+def _seg_len() -> int:
+    """G2 accumulation segment length (TEKU_TPU_MSM_SEG, pow-2).
+
+    g2_msm only ever runs under jit, so this executes at TRACE time
+    and the jit cache keys on input shapes — which seg does not
+    change.  Reading the env per call would therefore silently pin
+    whatever value the first trace saw; instead the value is resolved
+    ONCE per process (a kernel-layer boot knob, like the CLI-set
+    TEKU_TPU_MONT_MUL: decide before the first dispatch), and an
+    invalid value degrades to the default with one warning."""
+    if not _seg_cache:
+        raw = os.environ.get(ENV_SEG, "32")
+        try:
+            seg = int(raw)
+            if seg < 1 or seg & (seg - 1):
+                raise ValueError
+        except ValueError:
+            _LOG.warning("%s=%r is not a power of two; using 32",
+                         ENV_SEG, raw)
+            seg = 32
+        with _lock:
+            if not _seg_cache:
+                _seg_cache.append(seg)
+    return _seg_cache[0]
+
+
+# --------------------------------------------------------------------------
+# Device kernels: bucket accumulate -> reduce -> window combine
+# --------------------------------------------------------------------------
+
+def _tree(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+def _infinity_batch(kit, like_elem, batch_shape):
+    """Infinity point with an explicit batch shape, dtyped like a
+    field element's leaves."""
+    template = _tree(
+        lambda a: jnp.zeros(batch_shape + a.shape[-1:], a.dtype),
+        like_elem)
+    return PT.infinity_like(kit, template)
+
+
+def bucket_accumulate(kit, pts, digits, include):
+    """Scatter-accumulate points into per-(row, window, bucket) sums.
+
+    pts: point with leaves (R, C, ...); digits (R, C, nwin) int32 in
+    [0, 2^w); include (R, C) — excluded columns touch nothing.
+    Returns bucket points with leaves (R, nwin, B), B = 2^w - 1;
+    bucket b holds the sum of included points whose digit == b + 1
+    (digit 0 contributes nowhere — it is the 'add infinity' of the
+    ladder, spelled as a no-op select).
+
+    One lax.scan over C: each step gathers every (row, window)'s
+    target bucket, performs ONE batched point_add, and scatters it
+    back with a one-hot select — identical work per step regardless
+    of digit values (constant-shape, constant-time), and duplicate
+    bucket indices across steps are sequenced by the scan.
+    """
+    R, C, nwin = digits.shape
+    w = window_for_nwin(nwin)
+    B = (1 << w) - 1
+    buckets = _infinity_batch(kit, pts[0], (R, nwin, B))
+    xs = (_tree(lambda a: jnp.moveaxis(a, 1, 0), pts),
+          jnp.moveaxis(digits, 1, 0),
+          jnp.moveaxis(include, 1, 0))
+    barange = jnp.arange(B, dtype=digits.dtype)
+
+    def step(bk, inp):
+        p, d, inc = inp                     # p leaves (R, L); d (R, nwin)
+        idx = jnp.maximum(d - 1, 0)
+
+        def take(leaf):                     # (R, nwin, B, L) -> (R, nwin, L)
+            i = jnp.broadcast_to(idx[..., None, None],
+                                 idx.shape + (1, leaf.shape[-1]))
+            return jnp.take_along_axis(leaf, i, axis=2)[..., 0, :]
+
+        cur = _tree(take, bk)
+        pb = _tree(lambda a: jnp.broadcast_to(
+            a[:, None], (R, nwin) + a.shape[1:]), p)
+        added = PT.point_add(kit, cur, pb)
+        hit = ((barange == idx[..., None]) & (d >= 1)[..., None]
+               & inc[:, None, None])        # (R, nwin, B)
+        added_b = _tree(lambda a: jnp.broadcast_to(
+            a[..., None, :], a.shape[:-1] + (B, a.shape[-1])), added)
+        return PT._select_point(kit, hit, added_b, bk), None
+
+    buckets, _ = lax.scan(step, buckets, xs)
+    return buckets
+
+
+def bucket_reduce(kit, buckets):
+    """Collapse buckets to per-(row, window) sums: sum_b (b+1)*B_b via
+    the standard top-down suffix-sum pair (2 adds per bucket)."""
+    leaves = jax.tree_util.tree_leaves(buckets)
+    R, nwin = leaves[0].shape[:2]
+    xs = _tree(lambda a: jnp.moveaxis(a, 2, 0)[::-1], buckets)
+    inf = _infinity_batch(kit, buckets[0], (R, nwin))
+
+    def step(carry, bpt):
+        acc, tot = carry
+        acc = PT.point_add(kit, acc, bpt)
+        tot = PT.point_add(kit, tot, acc)
+        return (acc, tot), None
+
+    (_, tot), _ = lax.scan(step, (inf, inf), xs)
+    return tot
+
+
+def window_combine(kit, wsums, window: int):
+    """Horner fold of per-window sums (leaves (R, nwin), MSB-first):
+    w doublings + 1 add per window — the ONE doubling chain each row
+    pays, (nwin - 1) * w doublings total."""
+    ws = _tree(lambda a: jnp.moveaxis(a, 1, 0), wsums)
+    acc = _tree(lambda a: a[0], ws)
+    rest = _tree(lambda a: a[1:], ws)
+
+    def step(acc, wpt):
+        for _ in range(window):
+            acc = PT.point_double(kit, acc)
+        return PT.point_add(kit, acc, wpt), None
+
+    acc, _ = lax.scan(step, acc, rest)
+    return acc
+
+
+def msm_rows(kit, pts, digits, include):
+    """R independent MSMs: row r computes sum_c [s_rc]P_rc where s_rc
+    is the MSB-first digit recomposition of digits[r, c].  Returns a
+    (R,)-batched Jacobian point."""
+    nwin = digits.shape[-1]
+    w = window_for_nwin(nwin)
+    buckets = bucket_accumulate(kit, pts, digits, include)
+    return window_combine(kit, bucket_reduce(kit, buckets), w)
+
+
+# --------------------------------------------------------------------------
+# The two pipeline MSMs
+# --------------------------------------------------------------------------
+
+def g1_grouped_msm(pk_jac, digits, group_idx, group_present,
+                   miller_mask):
+    """Per-message-group G1 fold: row u gets sum over its lanes of
+    [r_i]pk_i = [k1_i]pk_i + [k2_i]phi(pk_i) — each group's MSM runs
+    over 2G columns (the lane points and their phi images share one
+    bucket grid; phi costs ONE mont_mul per lane, not a ladder).
+
+    Same masking contract as stage_group: miller_mask'd-out lanes are
+    selected to infinity BEFORE the gather, group padding columns are
+    excluded, padded rows come out infinity.  Returns the (U,)-batched
+    Jacobian aggregates (the caller derives u_mask + affine)."""
+    inf = PT.infinity_like(PT.G1_KIT, pk_jac[0])
+    masked = PT._select_point(PT.G1_KIT, miller_mask, pk_jac, inf)
+    grouped = _tree(lambda x: x[group_idx], masked)       # (U, G, ...)
+    phi = PT.g1_phi(grouped)
+    pts = _tree(lambda a, b: jnp.concatenate([a, b], axis=1),
+                grouped, phi)                             # (U, 2G, ...)
+    dg = digits[group_idx]                                # (U, G, 2, nwin)
+    dg = jnp.concatenate([dg[:, :, 0, :], dg[:, :, 1, :]], axis=1)
+    inc = jnp.concatenate([group_present, group_present], axis=1)
+    return msm_rows(PT.G1_KIT, pts, dg, inc)
+
+
+def g2_lambda_point(q):
+    """[lambda]Q on G2: psi acts as [z] (z < 0), so psi^2 = [z^2] and
+    [lambda]Q = [-z^2]Q = -psi^2(Q).  Two cheap Frobenius-type maps
+    instead of a 127-bit ladder; coordinates are compressed back to
+    one unit (psi's fq2_muls emit lazy values and point_add requires
+    unit inputs)."""
+    lam = PT.point_neg(PT.G2_KIT, PT.g2_psi(PT.g2_psi(q)))
+    return tuple(PT.G2_KIT.compress(c) for c in lam)
+
+
+def g2_msm(sig_jac, digits):
+    """The whole-batch G2 fold sum_i [r_i]sig_i as ONE MSM over 2N
+    columns (each lane contributes sig_i and [lambda]sig_i).
+
+    stage_finish only ever consumes the SUM of the weighted signature
+    points, so the per-lane wsig array disappears: the MSM is split
+    into TEKU_TPU_MSM_SEG-column segments bucket-accumulated in
+    parallel (bounding the scan's sequential depth), the segment
+    bucket tables tree-add (bucket sums are additive across disjoint
+    column sets), and one reduce + Horner chain finishes.  Returns a
+    (1,)-batched Jacobian point — point_batch_sum of a 1-batch is the
+    identity, so stage_finish's contract is unchanged."""
+    lam = g2_lambda_point(sig_jac)
+    pts = _tree(lambda a, b: jnp.concatenate([a, b], axis=0),
+                sig_jac, lam)                             # (2N, ...)
+    dg = jnp.concatenate([digits[:, 0, :], digits[:, 1, :]], axis=0)
+    n2 = dg.shape[0]
+    C = min(_seg_len(), n2)
+    S = n2 // C                   # both pow-2: exact split
+    pts_r = _tree(lambda a: a.reshape((S, C) + a.shape[1:]), pts)
+    dg_r = dg.reshape(S, C, dg.shape[-1])
+    inc = jnp.ones((S, C), dtype=bool)
+    buckets = bucket_accumulate(PT.G2_KIT, pts_r, dg_r, inc)
+    if S > 1:
+        merged = PT.point_batch_sum(PT.G2_KIT, buckets)   # (nwin, B)
+    else:
+        merged = _tree(lambda a: a[0], buckets)
+    merged = _tree(lambda a: a[None], merged)             # (1, nwin, B)
+    wsums = bucket_reduce(PT.G2_KIT, merged)              # (1, nwin)
+    return window_combine(PT.G2_KIT, wsums,
+                          window_for_nwin(dg.shape[-1]))

@@ -114,11 +114,24 @@ G2_KIT = FieldKit(
 # Point structure: (X, Y, Z) tuple of field elements; Z == 0 <=> infinity.
 # --------------------------------------------------------------------------
 
+def leaf_shape(x):
+    """Shape of a field element's first array leaf.
+
+    Tower elements nest coordinate tuples ((c0, c1) for Fq2, deeper
+    for Fq6/Fq12); every leaf shares one (batch..., L) shape, so the
+    first leaf names it.  Shared by the broadcast helpers below and
+    scalar_mul_static's dense-exponent fallback (which used to unwrap
+    tuples with its own while-loop)."""
+    while isinstance(x, tuple):
+        x = x[0]
+    return x.shape
+
+
 def _broadcast_const(k: FieldKit, c, like):
     if k is G1_KIT:
         return jnp.broadcast_to(c, like.shape)
-    return (jnp.broadcast_to(c[0], like[0].shape),
-            jnp.broadcast_to(c[1], like[1].shape))
+    shape = leaf_shape(like)
+    return (jnp.broadcast_to(c[0], shape), jnp.broadcast_to(c[1], shape))
 
 
 def _zero_like(k: FieldKit, x):
@@ -234,6 +247,27 @@ def point_eq(k: FieldKit, p, q):
 SCALAR_WINDOW = 4
 
 
+def ladder_plan(nbits: int, window: int):
+    """Host-side plan for scalar_mul_bits: MSB zero-padding to a
+    window multiple + window count.  Returns (pad, n_windows)."""
+    pad = -nbits % window
+    return pad, (nbits + pad) // window
+
+
+def ladder_op_counts(nbits: int, window: int) -> dict:
+    """Executed point-op counts of the windowed ladder for a given
+    bit width — the observable the irregular-width regression test
+    pins (and PERF.md's cost model cites).  Derived from the SAME
+    ladder_plan scalar_mul_bits executes."""
+    _, nwin = ladder_plan(nbits, window)
+    return {
+        "doubles": (nwin - 1) * window,
+        "adds": nwin - 1,              # one gathered add per digit
+        "table_adds": 1 << window,     # build scan length
+        "total": (nwin - 1) * (window + 1) + (1 << window),
+    }
+
+
 def scalar_mul_bits(k: FieldKit, bits, p, window: int = SCALAR_WINDOW):
     """[s]P for runtime scalars given as a bit array.
 
@@ -246,10 +280,20 @@ def scalar_mul_bits(k: FieldKit, bits, p, window: int = SCALAR_WINDOW):
     64 doubles + 16 adds + 14 build adds: ~35% fewer point ops in the
     scalars stage.  Still constant-time: every digit gathers and adds
     (digit 0 adds the infinity row, which point_add absorbs).
+
+    Irregular widths (e.g. 33-bit GLV half-scalars, 255-bit parity
+    oracles) are MSB zero-padded to a window multiple instead of
+    demoting to the bit-serial ladder — a leading zero digit just
+    starts the accumulator at the (absorbed) infinity row, and the
+    op count stays the windowed one (ladder_op_counts pins the win).
     """
     nbits = bits.shape[-1]
-    if nbits % window:
-        window = 1                       # irregular widths: bit ladder
+    pad, _ = ladder_plan(nbits, window)
+    if pad:
+        bits = jnp.concatenate(
+            [jnp.zeros(bits.shape[:-1] + (pad,), bits.dtype), bits],
+            axis=-1)
+        nbits += pad
     # table rows [0]P..[2^w - 1]P, stacked on a leading axis.  Built
     # with a scan so the graph holds ONE point_add body (an unrolled
     # build inlines 2^w - 2 adds and measurably bloats XLA compiles).
@@ -317,10 +361,7 @@ def scalar_mul_static(k: FieldKit, e: int, p):
         nbits = len(bits) + 1
         bit_arr = jnp.asarray([int(c) for c in bin(e)[2:]],
                               dtype=jnp.int64)
-        leaf = p[0]                     # G2 coords are (c0, c1) tuples
-        while isinstance(leaf, tuple):
-            leaf = leaf[0]
-        lane_shape = leaf.shape[:-1]    # broadcast bits over the batch
+        lane_shape = leaf_shape(p[0])[:-1]   # bits over the batch dims
         bit_arr = jnp.broadcast_to(bit_arr, lane_shape + (nbits,))
         return scalar_mul_bits(k, bit_arr, p)
 
@@ -333,6 +374,28 @@ def scalar_mul_static(k: FieldKit, e: int, p):
         if has_add:
             acc = point_add(k, acc, p)
     return acc
+
+
+def point_batch_sum(k: FieldKit, p):
+    """Sum points over the leading batch axis via log-depth pairwise
+    adds.  (Lives here so the MSM kernels (ops/msm.py) and the verify
+    pipeline (ops/verify.py) share one reduction.)"""
+    n = jax.tree_util.tree_leaves(p)[0].shape[0]
+    while n > 1:
+        half = n // 2
+        odd = n - 2 * half
+        a = jax.tree_util.tree_map(lambda x: x[:half], p)
+        b = jax.tree_util.tree_map(lambda x: x[half:2 * half], p)
+        s = point_add(k, a, b)
+        if odd:
+            tail = jax.tree_util.tree_map(lambda x: x[2 * half:], p)
+            p = jax.tree_util.tree_map(
+                lambda x, y: jnp.concatenate([x, y], axis=0), s, tail)
+            n = half + 1
+        else:
+            p = s
+            n = half
+    return jax.tree_util.tree_map(lambda x: x[0], p)
 
 
 def scalar_from_uint64(vals):

@@ -55,6 +55,7 @@ from ..crypto.bls.spi import (BLS12381, BatchSemiAggregate,
                               ResolvedHandle)
 from . import h2c_cache as HC
 from . import limbs as fp
+from . import msm
 from . import mxu
 from . import points as PT
 from . import verify as V
@@ -101,6 +102,21 @@ _M_H2C_UNIQUE = GLOBAL_REGISTRY.counter(
 _M_H2C_DISPATCH = GLOBAL_REGISTRY.counter(
     "bls_h2c_dispatch_total",
     "hash-to-curve device dispatches (0 growth = H(m) cache warm)")
+
+# MSM scalars-stage path observability: every verify dispatch resolves
+# to the per-lane windowed ladder or the GLV+Pippenger bucketed MSM
+# (ops/msm.py resolve(); `auto` is shape-aware), and capacity planning
+# needs the lane split, not just the dispatch split — the closed
+# {ladder, pippenger} vocabulary is linted in test_metrics_exposition
+_M_MSM = GLOBAL_REGISTRY.labeled_counter(
+    "bls_msm_dispatch_total",
+    "verify dispatches by resolved scalars-stage path "
+    "(ladder|pippenger, ops/msm.py)",
+    labelnames=("path",))
+_M_MSM_LANES = GLOBAL_REGISTRY.labeled_counter(
+    "bls_msm_lanes_total",
+    "real lanes dispatched by resolved scalars-stage path",
+    labelnames=("path",))
 
 
 def _dedup_ratio() -> float:
@@ -334,6 +350,9 @@ class JaxBls12381(BLS12381):
         # the dispatch metric labels with this, not a re-resolution
         # (a mid-process set_path() affects only not-yet-traced shapes)
         self.mont_path = mxu.resolve()
+        # per-provider MSM path evidence (the parity/auto tests read
+        # this; the global bls_msm_* counters serve dashboards)
+        self.msm_dispatches = {"ladder": 0, "pippenger": 0}
 
     # ------------------------------------------------------------------
     # Host-side SPI ops delegated to the oracle (rare, non-batch paths)
@@ -653,17 +672,39 @@ class JaxBls12381(BLS12381):
                 group_present[r, :len(g)] = True
             sx1 = bytes_to_limbs_np(sig_bytes[:, 0])
             sx0 = bytes_to_limbs_np(sig_bytes[:, 1])
+            # scalars-stage path: the per-lane windowed ladder (64-bit
+            # multipliers) or the GLV+Pippenger bucketed MSM (32-bit
+            # half-scalar pairs, ops/msm.py).  Resolved per dispatch —
+            # `auto` keys on the duplication factor (lanes per Miller
+            # row); the sharded kernel always ladders (grouping
+            # crosses shard boundaries)
+            msm_path = msm.resolve(lanes=n, rows=len(rows),
+                                   sharded=self._sharded is not None)
+            r_bits = glv_digits = None
             if randomize:
                 # one os-entropy draw for the whole batch (the
                 # reference uses SecureRandom per multiplier,
-                # BlstBLS12381.java:191-195); zero lanes are nudged to
-                # 1 (2^-64 bias, negligible)
-                rs = np.frombuffer(secrets.token_bytes(8 * padded),
-                                   dtype=np.uint64).copy()
-                rs[rs == 0] = 1
+                # BlstBLS12381.java:191-195); zero multipliers are
+                # nudged to 1 (2^-64 bias, negligible) — on the
+                # pippenger path the same 64 bits split into the
+                # (k1, k2) half-scalars whose effective multiplier
+                # k1 + k2*lambda ranges over 2^64 - 1 values
+                raw = np.frombuffer(secrets.token_bytes(8 * padded),
+                                    dtype=np.uint64).copy()
+                if msm_path == "pippenger":
+                    glv_digits = msm.glv_digits_np(
+                        *msm.glv_sample_from_uint64(raw))
+                else:
+                    raw[raw == 0] = 1
+                    r_bits = np.asarray(PT.scalar_from_uint64(raw))
+            elif msm_path == "pippenger":
+                # r = 1 exactly: (k1, k2) = (1, 0)
+                glv_digits = msm.glv_digits_np(
+                    np.ones(padded, dtype=np.uint64),
+                    np.zeros(padded, dtype=np.uint64))
             else:
-                rs = np.ones(padded, dtype=np.uint64)
-            r_bits = np.asarray(PT.scalar_from_uint64(rs))
+                r_bits = np.asarray(PT.scalar_from_uint64(
+                    np.ones(padded, dtype=np.uint64)))
             # H(m) host half (digests + cache lookups + field draws)
             # belongs to host_prep; only the dispatch/gather below is
             # device work
@@ -672,8 +713,10 @@ class JaxBls12381(BLS12381):
         # the staged jits are module-level (shared across providers),
         # but a ShardedVerifier's jit cache is per-instance — key the
         # seen-set on the kernel that will actually serve the dispatch
+        # (and on the MSM path: ladder and pippenger are different
+        # programs at the same padded shape)
         cache_key = (id(self._sharded) if self._sharded is not None
-                     else 0, shape)
+                     else 0, shape, msm_path)
         with _SEEN_LOCK:
             first = cache_key not in _SEEN_SHAPES
             _SEEN_SHAPES.add(cache_key)
@@ -689,6 +732,9 @@ class JaxBls12381(BLS12381):
         _M_LANES_REAL.inc(n)
         _M_H2C_LANES.inc(n)
         _M_H2C_UNIQUE.inc(len(uniq_msgs))
+        _M_MSM.labels(path=msm_path).inc()
+        _M_MSM_LANES.labels(path=msm_path).inc(n)
+        self.msm_dispatches[msm_path] += 1
         # device section: every launch below is async (XLA compiles
         # synchronously on a first shape, then enqueues); the enqueue
         # span ends when the launches return, and the handle's
@@ -707,6 +753,11 @@ class JaxBls12381(BLS12381):
                 ok, lane_ok = self._sharded(
                     pk_xs, pk_ys, pk_present, hm, (sx0, sx1),
                     s_large, s_inf, r_bits, lane_valid)
+            elif msm_path == "pippenger":
+                ok, lane_ok = V.verify_staged_pippenger(
+                    pk_xs, pk_ys, pk_present, hm_uniq, group_idx,
+                    group_present, (sx0, sx1), s_large, s_inf,
+                    glv_digits, lane_valid)
             else:
                 ok, lane_ok = V.verify_staged_grouped(
                     pk_xs, pk_ys, pk_present, hm_uniq, group_idx,
@@ -721,5 +772,14 @@ class JaxBls12381(BLS12381):
             t_enq_end = time.perf_counter()
             tracing.record_stage("device_enqueue", t_enq_end - t_dev0,
                                  traces)
+        # the capacity model's per-(shape, path) latency series must
+        # distinguish the scalars engine: under msm auto, SAME-shape
+        # dispatches can run ladder or pippenger (resolve() keys on
+        # real lanes/rows), and blending two ~1.8x-apart programs into
+        # one series would mis-model device time for the admission
+        # controller's batch planner.  The jit metric above keeps the
+        # plain mont vocabulary (its label contract is linted).
+        lat_path = (mont_path if msm_path == "ladder"
+                    else f"{mont_path}+pip")
         return _DispatchHandle(ok, lane_ok, n, traces, shape,
-                               mont_path, t_enq_end)
+                               lat_path, t_enq_end)

@@ -38,6 +38,7 @@ import jax.numpy as jnp
 from ..crypto.bls import curve as C
 from . import h2c
 from . import limbs as fp
+from . import msm as MSM
 from . import pairing as PR
 from . import points as PT
 from . import towers as T
@@ -48,24 +49,8 @@ _NEG_G1_X = np.asarray(fp.int_to_mont(_NEG_G1[0]))
 _NEG_G1_Y = np.asarray(fp.int_to_mont(_NEG_G1[1]))
 
 
-def point_batch_sum(k, p):
-    """Sum points over the leading batch axis via log-depth pairwise adds."""
-    n = jax.tree_util.tree_leaves(p)[0].shape[0]
-    while n > 1:
-        half = n // 2
-        odd = n - 2 * half
-        a = jax.tree_util.tree_map(lambda x: x[:half], p)
-        b = jax.tree_util.tree_map(lambda x: x[half:2 * half], p)
-        s = PT.point_add(k, a, b)
-        if odd:
-            tail = jax.tree_util.tree_map(lambda x: x[2 * half:], p)
-            p = jax.tree_util.tree_map(
-                lambda x, y: jnp.concatenate([x, y], axis=0), s, tail)
-            n = half + 1
-        else:
-            p = s
-            n = half
-    return jax.tree_util.tree_map(lambda x: x[0], p)
+# shared with the MSM kernels; re-exported for the KZG/parallel callers
+point_batch_sum = PT.point_batch_sum
 
 
 def to_affine_g1(p):
@@ -258,6 +243,26 @@ def stage_group(pk_r_jac, miller_mask, group_idx, group_present):
     return to_affine_g1(agg), u_mask
 
 
+def stage_scalars_pippenger(pk_jac, sig_jac, glv_digits, group_idx,
+                            group_present, miller_mask):
+    """The MSM-grade replacement for stage_scalars + stage_group
+    (ops/msm.py): multipliers arrive GLV-decomposed as (N, 2, nwin)
+    w-bit digit arrays (r_i = k1_i + k2_i*lambda mod r), the per-group
+    G1 folds run as Pippenger bucket MSMs over (lane, phi(lane))
+    columns — ONE doubling chain per group row — and the whole-batch
+    G2 signature fold collapses to a single bucketed MSM (stage_finish
+    only ever consumes the wsig SUM, so `wsig` comes back as a
+    1-batch point and point_batch_sum is the identity on it).
+
+    Same output contract as stage_group + the wsig half of
+    stage_scalars: (agg_aff (U, ...), u_mask (U,), wsig (1, ...))."""
+    agg = MSM.g1_grouped_msm(pk_jac, glv_digits, group_idx,
+                             group_present, miller_mask)
+    u_mask = ~PT.is_infinity(PT.G1_KIT, agg)
+    wsig = MSM.g2_msm(sig_jac, glv_digits)
+    return to_affine_g1(agg), u_mask, wsig
+
+
 def stage_miller(pk_r_aff, hm_aff, mask):
     """Miller loops — width-polymorphic: per-lane inputs on the
     hm-gather path, per-unique aggregates on the grouped path."""
@@ -285,6 +290,7 @@ def staged_jits():
                     "scalars": jax.jit(stage_scalars),
                     "affine": jax.jit(stage_lane_affine),
                     "group": jax.jit(stage_group),
+                    "scalars_pip": jax.jit(stage_scalars_pippenger),
                     "miller": jax.jit(stage_miller),
                     "finish": jax.jit(stage_finish),
                 }
@@ -339,6 +345,27 @@ def verify_staged_grouped(pk_xs, pk_ys, pk_present, hm_uniq, group_idx,
     pk_r_jac, wsig = run("scalars", pk_jac, sig_jac, r_bits)
     agg_aff, u_mask = run("group", pk_r_jac, miller_mask, group_idx,
                           group_present)
+    ml = run("miller", agg_aff, hm_uniq, u_mask)
+    ok = run("finish", ml, wsig)
+    return ok, lane_ok
+
+
+def verify_staged_pippenger(pk_xs, pk_ys, pk_present, hm_uniq,
+                            group_idx, group_present, sig_x_plain,
+                            sig_large, sig_inf, glv_digits, lane_valid,
+                            on_stage=None):
+    """The staged GROUPED pipeline with the MSM-grade scalars stage
+    (`--msm-path pippenger`): GLV digit arrays replace r_bits, the
+    scalars_pip program absorbs stage_group, verdict contract is
+    bit-identical to verify_staged_grouped driven with the effective
+    multipliers r_i = k1_i + k2_i*lambda (tests/test_msm.py)."""
+    run = _stage_runner(on_stage)
+    pk_jac, sig_jac, lane_ok, miller_mask = run(
+        "prepare", pk_xs, pk_ys, pk_present, sig_x_plain, sig_large,
+        sig_inf, lane_valid)
+    agg_aff, u_mask, wsig = run("scalars_pip", pk_jac, sig_jac,
+                                glv_digits, group_idx, group_present,
+                                miller_mask)
     ml = run("miller", agg_aff, hm_uniq, u_mask)
     ok = run("finish", ml, wsig)
     return ok, lane_ok

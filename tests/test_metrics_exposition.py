@@ -287,6 +287,37 @@ def test_dispatch_and_cache_label_contract():
     assert pv  # imported above; JaxBls12381 instances carry .mont_path
 
 
+def test_msm_path_family_label_contract():
+    """The PR-8 MSM scalars-path families must not drift: the dispatch
+    and lane counters carry exactly one `path` label whose vocabulary
+    is the CLOSED {ladder, pippenger} set resolve() can emit —
+    dashboards ratio pippenger lanes over total to see how much
+    traffic rides the bucketed stage."""
+    import teku_tpu.ops.provider  # noqa: F401 - registers families
+    from teku_tpu.infra.metrics import GLOBAL_REGISTRY
+    from teku_tpu.ops import msm
+
+    metrics = GLOBAL_REGISTRY.metrics()
+    resolved_vocab = {"ladder", "pippenger"}
+    for fam in ("bls_msm_dispatch_total", "bls_msm_lanes_total"):
+        m = metrics[fam]
+        assert isinstance(m, LabeledCounter), fam
+        assert tuple(m.labelnames) == ("path",), fam
+        assert fam.endswith("_total")
+        # any series already recorded stays inside the closed set
+        for key, _child in m._items():
+            assert set(key) <= resolved_vocab, (fam, key)
+    # the resolver can only emit the documented vocabulary, on every
+    # input shape (incl. the sharded override and no-context auto)
+    for kw in ({}, {"lanes": 4096, "rows": 16},
+               {"lanes": 8, "rows": 8, "sharded": True},
+               {"lanes": 0, "rows": 0}):
+        assert msm.resolve(**kw) in resolved_vocab
+    # and the configured vocabulary matches the CLI mirror
+    from teku_tpu.cli import _MSM_PATHS
+    assert tuple(msm.PATHS) == _MSM_PATHS
+
+
 def test_h2c_dedup_and_coalesce_family_naming_lint():
     """The PR-5 dedup/cache/coalesce families must not drift: hit/miss/
     evict/dispatch counters end ``_total``, the dedup gauge is a

@@ -201,3 +201,63 @@ def test_on_curve():
     bad = (dev[0], bad_y, dev[2])
     got = np.asarray(jax.jit(lambda p: PT.is_on_curve(PT.G1_KIT, p))(bad))
     assert not got[0] and got[1] and got[2]
+
+
+def test_leaf_shape_walks_tower_tuples():
+    g1 = stack_g1([rand_g1(), rand_g1()])
+    g2 = stack_g2([rand_g2(), rand_g2()])
+    assert PT.leaf_shape(g1[0]) == (2, fp.L)
+    assert PT.leaf_shape(g2[0]) == (2, fp.L)     # (c0, c1) tuple
+    assert PT.leaf_shape(((g2[0],),)) == (2, fp.L)   # deeper nesting
+    # infinity_like's broadcast helper rides the same leaf shape
+    inf = PT.infinity_like(PT.G2_KIT, g2[0])
+    assert PT.leaf_shape(inf[0]) == (2, fp.L)
+
+
+def test_scalar_mul_bits_irregular_width_pads_not_demotes():
+    """33-bit scalars (the GLV half-scalar worst case) must stay on
+    the windowed fast path via MSB zero-padding — the old behavior
+    silently demoted window -> 1 whenever nbits % window != 0."""
+    # op-count pin: the padded window-4 plan beats the bit-serial
+    # ladder the demotion used to fall back to
+    c4 = PT.ladder_op_counts(33, 4)
+    c1 = PT.ladder_op_counts(33, 1)
+    assert PT.ladder_plan(33, 4) == (3, 9)
+    assert c4["doubles"] == c1["doubles"] == 32
+    assert c4["adds"] < c1["adds"]          # 8 gathered vs 32 serial
+    assert c4["total"] < c1["total"]
+    # and the padded walk is correct on BOTH groups
+    scalars = [rng.getrandbits(32) | (1 << 32) for _ in range(2)]
+    bits = np.zeros((2, 33), dtype=np.int64)
+    for i, s in enumerate(scalars):
+        for j in range(33):
+            bits[i, 32 - j] = (s >> j) & 1
+    p1 = [rand_g1(), rand_g1()]
+    out1 = jax.jit(
+        lambda b, p: PT.scalar_mul_bits(PT.G1_KIT, b, p))(
+            bits, stack_g1(p1))
+    p2 = [rand_g2(), rand_g2()]
+    out2 = jax.jit(
+        lambda b, p: PT.scalar_mul_bits(PT.G2_KIT, b, p))(
+            bits, stack_g2(p2))
+    for i, s in enumerate(scalars):
+        check_eq_g1(out1, i, C.point_mul(C.FQ_OPS, s, p1[i]))
+        check_eq_g2(out2, i, C.point_mul(C.FQ2_OPS, s, p2[i]))
+
+
+def test_scalar_mul_static_dense_exponent_g1_and_g2():
+    """The >16-runs dense-exponent fallback (one masked-add scan
+    instead of an unrolled add per one-bit — the unrolled form once
+    segfaulted CPU-XLA) had no dedicated test and never ran on G2,
+    whose coordinate tuples the old hand-rolled leaf unwrapping was
+    written for.  34 bits / 17 one-runs also exercises the irregular
+    width (34 % 4 != 0) through the new padding path."""
+    e = int("10" * 17, 2)                   # 17 runs > 16: dense path
+    p = rand_g1()
+    out = jax.jit(lambda x: PT.scalar_mul_static(PT.G1_KIT, e, x))(
+        stack_g1([p]))
+    check_eq_g1(out, 0, C.point_mul(C.FQ_OPS, e, p))
+    q = rand_g2()
+    out2 = jax.jit(lambda x: PT.scalar_mul_static(PT.G2_KIT, e, x))(
+        stack_g2([q]))
+    check_eq_g2(out2, 0, C.point_mul(C.FQ2_OPS, e, q))
