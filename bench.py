@@ -997,6 +997,52 @@ def _overload_phase(deadline):
           p50_at_max=(out.get("at_max") or {}).get("p50_ms"))
 
 
+def _mainnet_phase(deadline):
+    """Mainnet-shape traffic replay (`teku_tpu/loadgen`): seeded
+    gossip-replay scenarios — committee-duplicated subnets, aggregation
+    waves, sync committee, blob waves, epoch-boundary storms, and
+    adversarial shapes (invalid-sig flood exercising bisect,
+    equivocation replay exercising coalescing, dup-collapse starving
+    the H(m) cache) — against the REAL signature service + admission
+    controller on a virtual clock.  Per-scenario sigs/sec, per-class
+    p50/p99, shed counts, dedup ratio and brownout transitions land in
+    OUT["mainnet"]; tools/bench_diff.py gates BLOCK_IMPORT sheds == 0
+    under every scenario, the critical-class p50 bound, and the
+    dedup-ratio floor on committee-shaped mixes."""
+    from teku_tpu.loadgen import driver, scenarios
+
+    seed = int(os.environ.get("BENCH_MAINNET_SEED", "1"))
+    slots = int(os.environ.get("BENCH_MAINNET_SLOTS", "2"))
+    names = [s for s in os.environ.get(
+        "BENCH_MAINNET_SCENARIOS",
+        ",".join(scenarios.DEFAULT_SWEEP)).split(",") if s]
+    _beat("mainnet_phase_start", scenarios=names, seed=seed,
+          slots=slots)
+    out: dict = {"seed": seed, "slots": slots, "scenarios": {}}
+    OUT["mainnet"] = out
+    for name in names:
+        if time.time() > deadline - 30 and out["scenarios"]:
+            out["scenarios"][name] = "skipped: budget"
+            continue
+        try:
+            WD.arm(max(deadline - time.time(), 60) + 120,
+                   f"mainnet scenario {name}")
+            rep = driver.run_scenario(name, seed=seed, slots=slots)
+            WD.disarm()
+            out["scenarios"][name] = rep
+            _beat("mainnet_scenario_done", scenario=name,
+                  sigs_per_sec=rep["sigs_per_sec"],
+                  p50_ms=rep["p50_ms"], sheds=rep["shed_total"],
+                  dedup_ratio=rep["dedup_ratio"],
+                  bisect=rep["bisect_dispatches"],
+                  brownout_enters=rep["brownout"]["enters"])
+        except Exception as exc:
+            out["scenarios"][name] = {
+                "error": f"{type(exc).__name__}: {exc}"}
+    out["summary"] = driver.summarize(out["scenarios"])
+    _beat("mainnet_phase_done", **out["summary"])
+
+
 _TRAJECTORY_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "BENCH_TRAJECTORY.json")
 
@@ -1034,6 +1080,13 @@ def trajectory_entry(out: dict, run_id: str) -> dict:
     entry["overload_p50_ms"] = at_max.get("p50_ms")
     entry["overload_block_import_sheds"] = (
         at_max.get("sheds") or {}).get("block_import")
+    mainnet = (out.get("mainnet") or {}).get("summary") or {}
+    entry["mainnet_block_import_sheds"] = mainnet.get(
+        "block_import_sheds_worst")
+    entry["mainnet_critical_p50_ms"] = mainnet.get(
+        "critical_p50_ms_worst")
+    entry["mainnet_dedup_ratio_min"] = mainnet.get(
+        "committee_dedup_ratio_min")
     return entry
 
 
@@ -1109,8 +1162,13 @@ def main():
     # shape -> p50 latency (reuses the warm 256 bucket) -> epoch
     # transition (host-side, cheap) -> the remaining batch shapes.
     detail: dict = {}
+    # BENCH_THROUGHPUT=0 skips the kernel-compile phases entirely: the
+    # virtual-clock phases (overload, mainnet) need no device kernel,
+    # so a control-plane-focused run should not pay minutes of XLA
+    run_throughput = os.environ.get("BENCH_THROUGHPUT", "1") != "0"
     try:
-        _throughput_phase(jax, deadline, batches[:1], detail)
+        if run_throughput:
+            _throughput_phase(jax, deadline, batches[:1], detail)
     except Exception as exc:
         OUT["error"] = f"throughput: {type(exc).__name__}: {exc}"
         OUT["trace"] = traceback.format_exc(limit=3)
@@ -1156,6 +1214,16 @@ def main():
             WD.disarm()
         except Exception as exc:
             OUT["overload_error"] = f"{type(exc).__name__}: {exc}"
+    if os.environ.get("BENCH_MAINNET", "1") != "0":
+        try:
+            # virtual-clock phase like overload: wall-cheap, so it
+            # runs even on budget-starved rounds
+            WD.arm(max(deadline - time.time(), 60) + 300,
+                   "mainnet phase")
+            _mainnet_phase(deadline)
+            WD.disarm()
+        except Exception as exc:
+            OUT["mainnet_error"] = f"{type(exc).__name__}: {exc}"
     if os.environ.get("BENCH_EPOCH", "1") != "0":
         try:
             WD.arm(max(deadline - time.time(), 60) + 300, "epoch phase")
@@ -1164,7 +1232,8 @@ def main():
         except Exception as exc:
             OUT["epoch_error"] = f"{type(exc).__name__}: {exc}"
     try:
-        _throughput_phase(jax, deadline, batches[1:], detail)
+        if run_throughput:
+            _throughput_phase(jax, deadline, batches[1:], detail)
     except Exception as exc:
         OUT["error"] = f"throughput2: {type(exc).__name__}: {exc}"
         OUT["trace"] = traceback.format_exc(limit=3)

@@ -773,6 +773,48 @@ def cmd_peer(args) -> int:
     return 0
 
 
+def cmd_loadgen(args) -> int:
+    """Mainnet-shape load generator: replay seeded-deterministic
+    gossip traffic (committee duplication, aggregation waves, sync
+    committee, blob waves, adversarial storms) against the REAL
+    signature service + admission controller on a virtual clock and
+    print the per-scenario/per-class evidence."""
+    from .loadgen import driver, scenarios
+
+    if args.list:
+        for name, sc in scenarios.SCENARIOS.items():
+            print(f"{name:24s} {sc.description}")
+        return 0
+    names = (list(scenarios.DEFAULT_SWEEP) if args.scenario == "all"
+             else [s.strip() for s in args.scenario.split(",")])
+    for name in names:
+        if name not in scenarios.SCENARIOS:
+            print(f"unknown scenario {name!r}; known: "
+                  f"{', '.join(scenarios.SCENARIOS)}", file=sys.stderr)
+            return 2
+    out = driver.run_scenarios(names, seed=args.seed, slots=args.slots,
+                               validators=args.validators)
+    if args.out:
+        Path(args.out).write_text(json.dumps(out, indent=1))
+    if args.json:
+        print(json.dumps(out, indent=1))
+    else:
+        hdr = (f"{'scenario':24s} {'sigs/s':>8s} {'p50ms':>8s} "
+               f"{'p99ms':>9s} {'dedup':>6s} {'sheds':>6s} "
+               f"{'bisect':>6s} {'brownout':>8s}")
+        print(hdr)
+        for name, rep in out["scenarios"].items():
+            print(f"{name:24s} {rep['sigs_per_sec']:>8.1f} "
+                  f"{rep['p50_ms']:>8.1f} {rep['p99_ms']:>9.1f} "
+                  f"{rep['dedup_ratio']:>6.2f} "
+                  f"{rep['shed_total']:>6d} "
+                  f"{rep['bisect_dispatches']:>6d} "
+                  f"{rep['brownout']['enters']:>8d}")
+        print("summary:", json.dumps(out["summary"]))
+    summary = out["summary"]
+    return 0 if summary["block_import_sheds_worst"] == 0 else 1
+
+
 # --------------------------------------------------------------------------
 
 def build_parser() -> argparse.ArgumentParser:
@@ -935,6 +977,29 @@ def build_parser() -> argparse.ArgumentParser:
 
     pe = sub.add_parser("peer", help="generate a node identity")
     pe.set_defaults(fn=cmd_peer)
+
+    lg = sub.add_parser(
+        "loadgen",
+        help="mainnet-shape load generator (virtual clock, real "
+             "service + admission controller)")
+    lg.add_argument("--scenario", default="all",
+                    help="comma-separated scenario names, or 'all' "
+                         "(see --list)")
+    lg.add_argument("--list", action="store_true",
+                    help="list known scenarios and exit")
+    lg.add_argument("--seed", type=int, default=1,
+                    help="traffic-model seed (same seed = identical "
+                         "event stream)")
+    lg.add_argument("--slots", type=int, default=2,
+                    help="slots of traffic per scenario")
+    lg.add_argument("--validators", type=int, default=None,
+                    help="modeled network size (default 1,000,000)")
+    lg.add_argument("--json", action="store_true",
+                    help="print the full JSON report instead of the "
+                         "table")
+    lg.add_argument("--out", default=None,
+                    help="also write the JSON report to this path")
+    lg.set_defaults(fn=cmd_loadgen)
 
     mg = sub.add_parser("migrate-database",
                         help="convert a data dir between storage modes")

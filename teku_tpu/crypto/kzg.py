@@ -354,6 +354,7 @@ def _verify_blob_kzg_proof_host(blob: bytes, commitment: bytes,
 def verify_blob_kzg_proof(blob: bytes, commitment: bytes, proof: bytes,
                           setup: Optional[TrustedSetup] = None) -> bool:
     """reference KZG.verifyBlobKzgProof (CKZG4844.java:104-113)."""
+    _record_kzg_arrival(1)
     if _BACKEND is not None and len(blob) == BYTES_PER_BLOB:
         try:
             return _BACKEND.verify_blob_kzg_proof(
@@ -386,6 +387,33 @@ def backend_name() -> str:
         "host-pure"
 
 
+# Blob verification is a DA prerequisite for import/sync: its demand
+# stream competes with signature verification for the same device, so
+# arrivals are accounted under their own capacity source and the
+# sync-critical class (never sheddable — a shed blob check stalls the
+# chain, not a gossip opinion).
+KZG_ARRIVAL_SOURCE = "kzg"
+
+
+def kzg_verify_class():
+    """The VerifyClass blob verification is accounted under
+    (SYNC_CRITICAL).  Lazy import: crypto must stay importable without
+    the services layer."""
+    from ..services.admission import VerifyClass
+    return VerifyClass.SYNC_CRITICAL
+
+
+def _record_kzg_arrival(n: int) -> None:
+    """Blob-batch demand into the capacity model (source="kzg"), so
+    utilization and brownout see blob storms.  Accounting must never
+    fail a verification."""
+    try:
+        from ..infra import capacity
+        capacity.record_arrival(KZG_ARRIVAL_SOURCE, n)
+    except Exception:
+        pass
+
+
 def verify_blob_kzg_proof_batch(blobs: Sequence[bytes],
                                 commitments: Sequence[bytes],
                                 proofs: Sequence[bytes],
@@ -398,6 +426,7 @@ def verify_blob_kzg_proof_batch(blobs: Sequence[bytes],
         return False
     if not blobs:
         return True
+    _record_kzg_arrival(len(blobs))
     if _BACKEND is not None:
         try:
             return _BACKEND.verify_blob_kzg_proof_batch(
@@ -405,13 +434,25 @@ def verify_blob_kzg_proof_batch(blobs: Sequence[bytes],
         except KzgError:
             return False
         except BackendUnavailable:
-            # the device just failed this batch: serve it entirely from
-            # the host path rather than paying a fresh device deadline
-            # per blob on a backend we know is sick
-            return all(_verify_blob_kzg_proof_host(b, c, p, setup)
-                       for b, c, p in zip(blobs, commitments, proofs))
-    return all(verify_blob_kzg_proof(b, c, p, setup)
-               for b, c, p in zip(blobs, commitments, proofs))
+            # the device just failed this batch: serve it entirely
+            # from the host path rather than paying a fresh device
+            # deadline per blob on a backend we know is sick
+            return _verify_batch_host(blobs, commitments, proofs,
+                                      setup)
+    # no backend installed: the host path directly — per-blob re-entry
+    # through verify_blob_kzg_proof would double-count the demand
+    return _verify_batch_host(blobs, commitments, proofs, setup)
+
+
+def _verify_batch_host(blobs, commitments, proofs, setup) -> bool:
+    """Per-blob host verification with an explicit first-failure exit:
+    once one blob fails the batch verdict is False, and each remaining
+    blob would cost a 4096-point barycentric pass + a 2-pairing check
+    on a host that is already degraded."""
+    for b, c, p in zip(blobs, commitments, proofs):
+        if not _verify_blob_kzg_proof_host(b, c, p, setup):
+            return False
+    return True
 
 
 def compute_challenge(blob: bytes, commitment: bytes) -> int:

@@ -56,6 +56,15 @@ DEFAULT_WINDOW_S = float(os.environ.get("TEKU_TPU_CAPACITY_WINDOW_S",
 DEFAULT_MAX_SHAPES = int(os.environ.get("TEKU_TPU_CAPACITY_MAX_SHAPES",
                                         "24"))
 
+# Well-known arrival sources: distinct demand streams the utilization
+# model attributes separately (bounded: a handful of named verbs plus
+# the per-service names, folding into "other" past MAX_SOURCES).  The
+# sync-committee verbs and the KZG blob-batch verb each get their own
+# stream so a blob storm or a sync-committee wave is visible as ITS
+# demand, not smeared into the gossip service's arrival rate.
+SOURCE_SYNC_COMMITTEE = "sync_committee"
+SOURCE_KZG = "kzg"
+
 
 class RateEstimator:
     """Windowed event-rate estimator with an injectable monotonic
@@ -491,6 +500,19 @@ class CapacityTelemetry:
 # flightrecorder.RECORDER: dispatch handles, worker threads and the
 # REST task all contribute, and the value is ONE combined view)
 TELEMETRY = CapacityTelemetry()
+
+
+def swap_default(telemetry: CapacityTelemetry) -> CapacityTelemetry:
+    """Swap the process-default telemetry, returning the old one.
+
+    The virtual-clock harnesses (overload sim, loadgen) build their own
+    ``CapacityTelemetry`` on an injectable clock; recorders that only
+    reach the module-level functions (the KZG facade's arrival
+    accounting) must land in THAT instance for the run.  Callers swap
+    in a try/finally and restore the original."""
+    global TELEMETRY
+    old, TELEMETRY = TELEMETRY, telemetry
+    return old
 
 
 def record_arrival(source: str, triples: int = 1) -> None:

@@ -1,0 +1,27 @@
+"""Mainnet-shape load generator (ROADMAP 4).
+
+Bench has always measured synthetic uniform batches; production
+traffic is bursty and committee-shaped — the exact regime the
+committee-consensus and bursty-arrival papers (PAPERS.md) measure, and
+the regime every PR-5..8 win is a function of.  This package generates
+that shape and replays it against the REAL verify pipeline:
+
+- ``model``     — seeded-deterministic gossip-replay traffic model of
+                  a 1M-validator network: 64 attestation subnets,
+                  committee-size/duplication curves derived from the
+                  validator count, slot-aligned aggregation waves,
+                  sync-committee messages + contributions, deneb blob
+                  waves, epoch-boundary storms;
+- ``scenarios`` — named traffic mixes, including adversarial shapes
+                  (invalid-signature floods, equivocation replays,
+                  dup-collapse) with declared VerifyClass mixes;
+- ``driver``    — replays a scenario against the real
+                  ``AggregatingSignatureVerificationService`` +
+                  ``AdmissionController`` under the injectable virtual
+                  clock, emitting per-scenario/per-class evidence
+                  (``cli loadgen`` and bench's ``mainnet`` phase).
+"""
+
+from . import model, scenarios, driver  # noqa: F401
+
+__all__ = ["model", "scenarios", "driver"]

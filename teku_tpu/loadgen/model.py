@@ -1,0 +1,432 @@
+"""Gossip-replay traffic model of a mainnet-scale validator set.
+
+Everything is DERIVED from the validator count the way the consensus
+spec derives it (reference: spec get_committee_count_per_slot /
+compute_subnet_for_attestation; SyncCommitteeUtil subcommittees), so a
+1M-validator model produces the real mainnet shape — 64 committees per
+slot across 64 attestation subnets, ~490-member committees whose
+members all sign the SAME AttestationData (the duplication curve the
+dedup pipeline exploits), slot-aligned aggregation waves (3-signature
+atomic sets, one of which re-uses the committee's message), a
+512-member sync committee whose members all sign the slot's head root,
+deneb blob batches, and epoch-boundary storms.
+
+Determinism contract (the bench reproducibility rule): event streams
+are a pure function of ``(model, seed, slots)`` — one ``random.Random``
+seeded from the arguments, NO wall clock, no process state.  The same
+seed replays bit-identical traffic on any host, so a regression gate
+can cite a scenario run the way it cites a bench shape.
+
+Synthetic crypto material: the device model under the virtual clock
+costs dispatches by SHAPE (lanes, unique messages), not by field
+arithmetic, so keys/signatures are compact deterministic tokens.
+Invalid signatures (adversarial floods) carry ``INVALID_SIG_PREFIX``
+so the device model — like a real device — fails the whole batch and
+forces the service's bisect path.
+"""
+
+import hashlib
+import random
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..services.admission import VerifyClass
+
+# closed event-kind vocabulary (also a metric label set — bounded)
+EVENT_KINDS = ("block", "block_import", "attestation", "aggregate",
+               "sync_message", "sync_contribution", "blob_batch")
+
+# a signature with this prefix fails device verification (the model
+# device's stand-in for a forged signature)
+INVALID_SIG_PREFIX = b"!BAD"
+
+# mainnet constants the shape derives from (spec values)
+SLOTS_PER_EPOCH = 32
+SECONDS_PER_SLOT = 12.0
+MAX_COMMITTEES_PER_SLOT = 64
+TARGET_COMMITTEE_SIZE = 128
+ATTESTATION_SUBNET_COUNT = 64
+TARGET_AGGREGATORS_PER_COMMITTEE = 16
+SYNC_COMMITTEE_SIZE = 512
+SYNC_COMMITTEE_SUBNET_COUNT = 4
+TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE = 16
+MAX_BLOBS_PER_BLOCK = 6
+
+
+def committees_per_slot(validators: int) -> int:
+    """Spec get_committee_count_per_slot."""
+    return max(1, min(MAX_COMMITTEES_PER_SLOT,
+                      validators // SLOTS_PER_EPOCH
+                      // TARGET_COMMITTEE_SIZE))
+
+
+def committee_size(validators: int) -> int:
+    """Members per committee at this validator count (the duplication
+    factor of one AttestationData's gossip)."""
+    return max(1, validators // SLOTS_PER_EPOCH
+               // committees_per_slot(validators))
+
+
+def subnet_for(validators: int, slot: int, committee: int) -> int:
+    """Spec compute_subnet_for_attestation."""
+    since_epoch_start = (committees_per_slot(validators)
+                         * (slot % SLOTS_PER_EPOCH))
+    return (since_epoch_start + committee) % ATTESTATION_SUBNET_COUNT
+
+
+@dataclass(frozen=True)
+class Event:
+    """One gossip arrival: a verification task (or blob batch) at a
+    virtual time offset from the window start."""
+
+    t: float                       # seconds from window start
+    kind: str                      # EVENT_KINDS member
+    cls: VerifyClass
+    triples: Tuple = ()            # ((pks, msg, sig), ...)
+    valid: bool = True
+    source: Optional[str] = None   # capacity arrival stream override
+    blobs: int = 0                 # blob_batch only
+    subnet: Optional[int] = None   # attestation events
+    committee: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class TrafficModel:
+    """Shape parameters; everything else derives from ``validators``.
+
+    The visibility fractions model ONE node's view: it subscribes to
+    ``local_subnets`` of the 64 attestation subnets (every committee
+    member's single attestation on those arrives), while the global
+    topics (blocks, aggregates, sync contributions) arrive from every
+    committee — sampled by the visibility fractions to keep one node's
+    stream at one node's volume."""
+
+    validators: int = 1_000_000
+    local_subnets: int = 2
+    participation: float = 0.95
+    # fraction of singles re-delivered by gossip (in-flight duplicate
+    # pressure on the coalescing layer even in the steady state)
+    redelivery: float = 0.10
+    # fraction of the global aggregate/sync-contribution waves one
+    # node's mesh actually delivers
+    aggregate_visibility: float = 0.25
+    sync_message_visibility: float = 0.25
+    sync_contribution_visibility: float = 0.5
+    # mean blobs per block (Poisson-ish, capped at the spec max)
+    blobs_per_block: float = 3.0
+    # epoch-boundary storm: multiplier on the boundary slot's
+    # attestation volume (late prev-epoch votes + re-broadcast) plus an
+    # OPTIMISTIC deferred-revalidation burst of the same size
+    storm_factor: float = 1.0
+    # adversarial knobs (scenario layer sets these)
+    invalid_rate: float = 0.0       # fraction of forged signatures
+    equivocation_rate: float = 0.0  # fraction of singles replayed
+    equivocation_copies: int = 3    # replays per equivocated message
+    dup_collapse: bool = False      # every lane's message unique
+    # first slot of the window (slot % 32 == 0 puts the epoch boundary
+    # inside the window)
+    first_slot: int = 1000
+
+    def with_overrides(self, **kw) -> "TrafficModel":
+        return replace(self, **kw)
+
+
+def _pk(validator_index: int) -> bytes:
+    return b"pk" + validator_index.to_bytes(6, "big")
+
+
+def _sig(msg: bytes, validator_index: int, valid: bool = True) -> bytes:
+    body = hashlib.blake2b(msg + validator_index.to_bytes(6, "big"),
+                           digest_size=12).digest()
+    return (INVALID_SIG_PREFIX if not valid else b"sig:") + body
+
+
+def _spread(rng: random.Random, mean: float) -> float:
+    """Propagation delay: exponential, bounded (a gossip mesh delivers
+    within a couple of seconds or not at all)."""
+    return min(rng.expovariate(1.0 / mean), 6 * mean)
+
+
+class _Counters:
+    """Mutable generation state threaded through the per-slot
+    emitters (member sampling without replacement per committee)."""
+
+    def __init__(self, rng: random.Random):
+        self.rng = rng
+        self.uniq = 0
+
+    def nonce(self) -> int:
+        self.uniq += 1
+        return self.uniq
+
+
+def generate_events(model: TrafficModel, seed: int,
+                    slots: int) -> List[Event]:
+    """The deterministic event stream: ``slots`` consecutive slots of
+    one node's gossip arrivals, sorted by arrival time."""
+    rng = random.Random(f"loadgen:{seed}:{model.validators}")
+    st = _Counters(rng)
+    events: List[Event] = []
+    n_committees = committees_per_slot(model.validators)
+    c_size = committee_size(model.validators)
+    sync_sub_size = SYNC_COMMITTEE_SIZE // SYNC_COMMITTEE_SUBNET_COUNT
+    for s in range(slots):
+        slot = model.first_slot + s
+        t0 = s * SECONDS_PER_SLOT
+        is_boundary = slot % SLOTS_PER_EPOCH == 0
+        storm = model.storm_factor if is_boundary else 1.0
+        events.extend(_slot_block(model, st, slot, t0, n_committees,
+                                  c_size))
+        events.extend(_slot_attestations(model, st, slot, t0,
+                                         n_committees, c_size, storm))
+        events.extend(_slot_aggregates(model, st, slot, t0,
+                                       n_committees, c_size, storm))
+        events.extend(_slot_sync(model, st, slot, t0, sync_sub_size))
+        events.extend(_slot_blobs(model, st, slot, t0))
+    events.sort(key=lambda e: e.t)
+    return events
+
+
+def _slot_block(model, st, slot, t0, n_committees,
+                c_size) -> List[Event]:
+    msg = b"block-%d" % slot
+    proposer = st.rng.randrange(model.validators)
+    block = Event(t=t0 + 0.05 + _spread(st.rng, 0.1), kind="block",
+                  cls=VerifyClass.VIP,
+                  triples=(((_pk(proposer),), msg,
+                            _sig(msg, proposer)),))
+    # the block's IMPORT signature batch follows: the body carries the
+    # previous slot's packed aggregates, re-verified as one
+    # BLOCK_IMPORT task — messages are the previous slot's committee
+    # AttestationData (duplication reaches across the import boundary)
+    import_triples = []
+    for c in range(min(8, n_committees)):
+        m = _att_msg(model, st, slot - 1, c)
+        signer = c * c_size + st.rng.randrange(c_size)
+        participants = tuple(
+            _pk(c * c_size + i)
+            for i in range(0, c_size, max(1, c_size // 16)))
+        import_triples.append((participants, m, _sig(m, signer)))
+    block_import = Event(
+        t=block.t + 0.15 + _spread(st.rng, 0.1), kind="block_import",
+        cls=VerifyClass.BLOCK_IMPORT, triples=tuple(import_triples))
+    return [block, block_import]
+
+
+def _att_msg(model, st, slot, committee) -> bytes:
+    base = b"att-%d-%d" % (slot, committee)
+    if model.dup_collapse:
+        # adversarial dup-collapse: every lane a fresh message — the
+        # H(m) cache and the unique-message pipeline get zero reuse
+        return base + b"/%d" % st.nonce()
+    return base
+
+
+def _slot_attestations(model, st, slot, t0, n_committees, c_size,
+                       storm) -> List[Event]:
+    """Single attestations on the locally-subscribed subnets: every
+    participating member of each local committee signs the committee's
+    ONE AttestationData — the duplication curve is the committee
+    size."""
+    rng = st.rng
+    out: List[Event] = []
+    due = t0 + SECONDS_PER_SLOT / 3
+    # one committee per locally-subscribed subnet; the spec mapping
+    # rotates which SUBNET each committee lands on as slots advance
+    local = list(range(min(model.local_subnets, n_committees)))
+    for committee in local:
+        subnet = subnet_for(model.validators, slot, committee)
+        msg = None if model.dup_collapse else _att_msg(
+            model, st, slot, committee)
+        base = committee * c_size
+        n_members = int(c_size * model.participation * storm)
+        for j in range(n_members):
+            member = base + (j % c_size)
+            m = (_att_msg(model, st, slot, committee)
+                 if model.dup_collapse else msg)
+            valid = rng.random() >= model.invalid_rate
+            triple = ((_pk(member),), m, _sig(m, member, valid))
+            t = due + _spread(rng, 0.25)
+            out.append(Event(t=t, kind="attestation",
+                             cls=VerifyClass.GOSSIP, triples=(triple,),
+                             valid=valid, subnet=subnet,
+                             committee=committee))
+            if rng.random() < model.redelivery:
+                # gossip re-delivery: the identical triple again while
+                # likely still in flight (coalescing pressure)
+                out.append(Event(t=t + _spread(rng, 0.05),
+                                 kind="attestation",
+                                 cls=VerifyClass.GOSSIP,
+                                 triples=(triple,), valid=valid,
+                                 subnet=subnet, committee=committee))
+            if rng.random() < model.equivocation_rate:
+                # equivocation replay storm: the same triple hammered
+                # several times, one replica claiming a HIGHER class —
+                # exercises coalescing fan-out and lane promotion
+                for k in range(model.equivocation_copies):
+                    cls = (VerifyClass.SYNC_CRITICAL if k == 0
+                           else VerifyClass.GOSSIP)
+                    out.append(Event(
+                        t=t + 0.01 + _spread(rng, 0.03), cls=cls,
+                        kind="attestation", triples=(triple,),
+                        valid=valid, subnet=subnet,
+                        committee=committee))
+        if storm > 1.0:
+            # boundary storm rider: deferred prev-epoch votes
+            # re-entering as OPTIMISTIC revalidation
+            prev_msg = _att_msg(model, st, slot - 1, committee)
+            for j in range(int(n_members * (storm - 1.0) / storm)):
+                member = base + (j % c_size)
+                m = (_att_msg(model, st, slot - 1, committee)
+                     if model.dup_collapse else prev_msg)
+                out.append(Event(
+                    t=t0 + _spread(rng, 0.4), kind="attestation",
+                    cls=VerifyClass.OPTIMISTIC,
+                    triples=(((_pk(member),), m, _sig(m, member)),),
+                    subnet=subnet, committee=committee))
+    return out
+
+
+def _slot_aggregates(model, st, slot, t0, n_committees, c_size,
+                     storm) -> List[Event]:
+    """The aggregation wave at 2/3 slot: aggregates arrive from EVERY
+    committee (global topic), each a 3-signature atomic set whose third
+    message is the committee's AttestationData — committee duplication
+    reaches across the single/aggregate boundary."""
+    rng = st.rng
+    out: List[Event] = []
+    due = t0 + 2 * SECONDS_PER_SLOT / 3
+    n_aggs = int(n_committees * TARGET_AGGREGATORS_PER_COMMITTEE
+                 * model.aggregate_visibility * storm)
+    for a in range(n_aggs):
+        committee = a % n_committees
+        aggregator = committee * c_size + rng.randrange(c_size)
+        att_msg = _att_msg(model, st, slot, committee)
+        sel_msg = b"sel-%d-%d-%d" % (slot, committee, aggregator)
+        proof_msg = b"agg-%d-%d-%d" % (slot, committee, aggregator)
+        participants = tuple(
+            _pk(committee * c_size + i)
+            for i in range(0, c_size,
+                           max(1, c_size // 16)))  # compact pk set
+        valid = rng.random() >= model.invalid_rate
+        out.append(Event(
+            t=due + _spread(rng, 0.3), kind="aggregate",
+            cls=VerifyClass.SYNC_CRITICAL, valid=valid,
+            committee=committee,
+            subnet=subnet_for(model.validators, slot, committee),
+            triples=(
+                ((_pk(aggregator),), sel_msg,
+                 _sig(sel_msg, aggregator)),
+                ((_pk(aggregator),), proof_msg,
+                 _sig(proof_msg, aggregator)),
+                (participants, att_msg,
+                 _sig(att_msg, aggregator, valid)),
+            )))
+    return out
+
+
+def _slot_sync(model, st, slot, t0, sub_size) -> List[Event]:
+    """Sync-committee wave: every participating member signs the SAME
+    head root (maximum duplication — the second device verb's natural
+    shape), then per-subcommittee contributions aggregate it."""
+    rng = st.rng
+    out: List[Event] = []
+    msg = b"sync-%d" % slot
+    due = t0 + SECONDS_PER_SLOT / 3
+    n_msgs = int(SYNC_COMMITTEE_SIZE * model.participation
+                 * model.sync_message_visibility)
+    for j in range(n_msgs):
+        member = 7_000_000 + (slot * SYNC_COMMITTEE_SIZE
+                              + j) % model.validators
+        out.append(Event(
+            t=due + _spread(rng, 0.25), kind="sync_message",
+            cls=VerifyClass.GOSSIP, source="sync_committee",
+            triples=(((_pk(member),), msg, _sig(msg, member)),)))
+    n_contrib = int(SYNC_COMMITTEE_SUBNET_COUNT
+                    * TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE
+                    * model.sync_contribution_visibility)
+    contrib_due = t0 + 2 * SECONDS_PER_SLOT / 3
+    for c in range(n_contrib):
+        sub = c % SYNC_COMMITTEE_SUBNET_COUNT
+        aggregator = 7_000_000 + (slot * 64 + c) % model.validators
+        sel_msg = b"synsel-%d-%d-%d" % (slot, sub, aggregator)
+        env_msg = b"synenv-%d-%d-%d" % (slot, sub, aggregator)
+        participants = tuple(
+            _pk(7_000_000 + (slot * SYNC_COMMITTEE_SIZE + sub
+                             * sub_size + i) % model.validators)
+            for i in range(0, sub_size, max(1, sub_size // 16)))
+        out.append(Event(
+            t=contrib_due + _spread(rng, 0.3),
+            kind="sync_contribution", cls=VerifyClass.SYNC_CRITICAL,
+            source="sync_committee",
+            triples=(
+                ((_pk(aggregator),), sel_msg,
+                 _sig(sel_msg, aggregator)),
+                ((_pk(aggregator),), env_msg,
+                 _sig(env_msg, aggregator)),
+                (participants, msg, _sig(msg, aggregator)),
+            )))
+    return out
+
+
+def _slot_blobs(model, st, slot, t0) -> List[Event]:
+    if model.blobs_per_block <= 0:
+        return []
+    rng = st.rng
+    # Poisson-shaped count via the seeded rng, capped at the spec max
+    n = 0
+    lam = model.blobs_per_block
+    while rng.random() < lam / (lam + 1) and n < MAX_BLOBS_PER_BLOCK:
+        n += 1
+    if n == 0:
+        return []
+    # blob verification's class is declared where the verb lives
+    # (crypto/kzg.py): DA checks gate import/sync, never sheddable
+    from ..crypto.kzg import KZG_ARRIVAL_SOURCE, kzg_verify_class
+    return [Event(t=t0 + 0.3 + _spread(st.rng, 0.2),
+                  kind="blob_batch", cls=kzg_verify_class(),
+                  source=KZG_ARRIVAL_SOURCE, blobs=n)]
+
+
+# --------------------------------------------------------------------------
+# Stream introspection (tests + reports)
+# --------------------------------------------------------------------------
+
+def stream_stats(events: Sequence[Event]) -> dict:
+    """Structural summary of a generated stream: per-kind/per-class
+    counts, lane/unique-message totals, the attestation duplication
+    curve, and subnet coverage."""
+    by_kind: Dict[str, int] = {k: 0 for k in EVENT_KINDS}
+    by_class: Dict[str, int] = {c.label: 0 for c in VerifyClass}
+    lanes = 0
+    blobs = 0
+    msgs: Dict[bytes, int] = {}
+    att_msgs: Dict[bytes, int] = {}
+    subnets = set()
+    for e in events:
+        by_kind[e.kind] += 1
+        by_class[e.cls.label] += len(e.triples) or e.blobs
+        lanes += len(e.triples)
+        blobs += e.blobs
+        if e.subnet is not None:
+            subnets.add(e.subnet)
+        for _pks, m, _sig_ in e.triples:
+            msgs[m] = msgs.get(m, 0) + 1
+            if e.kind == "attestation":
+                att_msgs[m] = att_msgs.get(m, 0) + 1
+    dup_curve = (sorted(att_msgs.values()) if att_msgs else [])
+    return {
+        "events": len(events),
+        "lanes": lanes,
+        "unique_messages": len(msgs),
+        "dedup_ratio": round(1.0 - len(msgs) / lanes, 4) if lanes
+        else 0.0,
+        "by_kind": by_kind,
+        "by_class": by_class,
+        "blobs": blobs,
+        "subnets_seen": sorted(subnets),
+        "attestation_dup_mean": (round(sum(dup_curve)
+                                       / len(dup_curve), 2)
+                                 if dup_curve else 0.0),
+        "attestation_dup_max": dup_curve[-1] if dup_curve else 0,
+    }

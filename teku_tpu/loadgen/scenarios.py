@@ -1,0 +1,130 @@
+"""Named traffic scenarios: the closed mix vocabulary loadgen runs.
+
+Each scenario is a parameterization of the traffic model plus the
+declared ``VerifyClass`` mix it exercises — declared, because the
+point of a scenario is not just throughput: the priority/shed behavior
+under each shape is part of what the driver measures and the bench
+gates pin (BLOCK_IMPORT sheds must be zero under EVERY scenario,
+committee-shaped mixes must hold the dedup-ratio floor).
+
+The registry is a CLOSED vocabulary on purpose: scenario names are
+also metric label values (``loadgen_*{scenario=...}``), and the
+exposition's cardinality must stay bounded.
+"""
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+from .model import TrafficModel
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """One named mix: model overrides + what it is meant to exercise."""
+
+    name: str
+    description: str
+    model: TrafficModel
+    # the classes this mix submits (declared, asserted by tests so a
+    # scenario exercises priority handling, not just throughput)
+    classes: Tuple[str, ...]
+    # committee-shaped mixes must hold the dedup-ratio floor in the
+    # bench gate; adversarial dup-collapse opts out
+    committee_shaped: bool = True
+    adversarial: bool = False
+    # offered-load scale: multiplies the modeled device's capacity
+    # deficit (1.0 = the default driver capacity)
+    capacity_sigs_per_sec: float = 1500.0
+
+
+def _m(**kw) -> TrafficModel:
+    return TrafficModel(**kw)
+
+
+SCENARIOS: Dict[str, Scenario] = {}
+
+
+def _register(s: Scenario) -> Scenario:
+    SCENARIOS[s.name] = s
+    return s
+
+
+STEADY_STATE = _register(Scenario(
+    name="steady_state",
+    description="mid-epoch mainnet shape: committee-duplicated "
+                "attestation subnets, aggregation waves, sync "
+                "committee, a few blobs per block",
+    model=_m(),
+    classes=("vip", "block_import", "sync_critical", "gossip"),
+))
+
+EPOCH_BOUNDARY_STORM = _register(Scenario(
+    name="epoch_boundary_storm",
+    description="epoch-boundary slot: 3x attestation volume plus an "
+                "OPTIMISTIC deferred-revalidation burst — the shape "
+                "that drives brownout entry",
+    model=_m(first_slot=992,       # slot 992 % 32 == 0 in-window
+             storm_factor=3.0),
+    classes=("vip", "block_import", "sync_critical", "gossip",
+             "optimistic"),
+    # tight capacity: the boundary storm must actually OVERLOAD the
+    # modeled device so brownout entry + shed-by-class are exercised,
+    # not just higher queue depths
+    capacity_sigs_per_sec=300.0,
+))
+
+INVALID_SIG_FLOOD = _register(Scenario(
+    name="invalid_sig_flood",
+    description="adversarial forged-signature flood: failed batches "
+                "force the service's bisect recursion to isolate the "
+                "bad lanes",
+    model=_m(invalid_rate=0.25, blobs_per_block=0.0,
+             sync_message_visibility=0.0,
+             sync_contribution_visibility=0.0),
+    classes=("vip", "block_import", "sync_critical", "gossip"),
+    adversarial=True,
+))
+
+EQUIVOCATION_REPLAY = _register(Scenario(
+    name="equivocation_replay",
+    description="adversarial replay storm: identical triples "
+                "re-delivered in-flight (coalescing fan-out), some "
+                "replicas claiming a higher class (lane promotion)",
+    model=_m(equivocation_rate=0.4, redelivery=0.3,
+             blobs_per_block=0.0),
+    classes=("vip", "block_import", "sync_critical", "gossip"),
+    adversarial=True,
+))
+
+DUP_COLLAPSE = _register(Scenario(
+    name="dup_collapse",
+    description="adversarial dup-collapse: every lane a fresh "
+                "message, starving the H(m) cache and the "
+                "unique-message pipeline of all reuse",
+    model=_m(dup_collapse=True, blobs_per_block=0.0),
+    classes=("vip", "block_import", "sync_critical", "gossip"),
+    committee_shaped=False,
+    adversarial=True,
+))
+
+BLOB_STORM = _register(Scenario(
+    name="blob_storm",
+    description="deneb blob waves at the spec maximum through the "
+                "guarded KZG backend alongside the signature load — "
+                "blob demand must be visible as its own source",
+    model=_m(blobs_per_block=6.0),
+    classes=("vip", "block_import", "sync_critical", "gossip"),
+))
+
+# names in registration order — the default `cli loadgen --scenario
+# all` / bench `mainnet` phase sweep
+DEFAULT_SWEEP = tuple(SCENARIOS)
+
+
+def get(name: str) -> Scenario:
+    try:
+        return SCENARIOS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown scenario {name!r}; known: {', '.join(SCENARIOS)}"
+        ) from None

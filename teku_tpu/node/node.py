@@ -374,7 +374,10 @@ class BeaconNode(Service):
             return ValidationResult.REJECT     # not in the committee
         root = sync_message_signing_root(self.spec.config, state,
                                          msg.slot, msg.beacon_block_root)
-        if not await self.verifier.verify([pubkey], root, msg.signature):
+        from ..infra.capacity import SOURCE_SYNC_COMMITTEE
+        if not await self.verifier.verify(
+                [pubkey], root, msg.signature,
+                cls=VerifyClass.GOSSIP, source=SOURCE_SYNC_COMMITTEE):
             return ValidationResult.REJECT
         for pos in positions:
             self.sync_pool.add(msg.slot, msg.beacon_block_root, pos,

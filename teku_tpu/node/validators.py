@@ -255,9 +255,14 @@ class ContributionValidator:
     statetransition/synccommittee/SignedContributionAndProofValidator):
     live slot, valid subcommittee, aggregator is a member, selection
     proof selects them — then the three signatures (selection proof,
-    envelope, contribution aggregate) verify as ONE atomic batch."""
+    envelope, contribution aggregate) verify as ONE atomic batch
+    through the batched device provider, accounted under the
+    sync-committee demand stream."""
 
-    verify_cls = VerifyClass.GOSSIP
+    # a contribution carries a whole subcommittee's sync weight toward
+    # the next SyncAggregate — like attestation aggregates it outranks
+    # bulk gossip and is never brownout-shed
+    verify_cls = VerifyClass.SYNC_CRITICAL
 
     def __init__(self, spec: Spec, chain: RecentChainData,
                  verifier: AsyncSignatureVerifier):
@@ -301,24 +306,16 @@ class ContributionValidator:
                                                msg.selection_proof):
             return REJECT
 
-        batch = AsyncBatchSignatureVerifier(self.verifier,
-                                            cls=self.verify_cls)
-        batch.verify([agg_pubkey],
-                     AH.sync_selection_proof_signing_root(
-                         cfg, state, slot,
-                         contribution.subcommittee_index),
-                     msg.selection_proof)
-        batch.verify([agg_pubkey],
-                     AH.contribution_and_proof_signing_root(cfg, state,
-                                                            msg),
-                     signed.signature)
-        participants = [pk for pk, b in zip(
-            pubkeys, contribution.aggregation_bits) if b]
-        batch.verify(participants,
-                     AH.sync_message_signing_root(
-                         cfg, state, slot,
-                         contribution.beacon_block_root),
-                     contribution.signature)
+        triples = AH.contribution_signature_set(cfg, state, signed,
+                                                pubkeys)
+        if triples is None:
+            return REJECT
+        from ..infra.capacity import SOURCE_SYNC_COMMITTEE
+        batch = AsyncBatchSignatureVerifier(
+            self.verifier, cls=self.verify_cls,
+            source=SOURCE_SYNC_COMMITTEE)
+        for t_pks, t_root, t_sig in triples:
+            batch.verify(t_pks, t_root, t_sig)
         if not await batch.batch_verify():
             return REJECT
         self._seen.add(key)

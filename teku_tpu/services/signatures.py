@@ -455,11 +455,12 @@ class AggregatingSignatureVerificationService:
     # ------------------------------------------------------------------
     def verify(self, public_keys: Sequence[bytes], message: bytes,
                signature: bytes,
-               cls: Optional[VerifyClass] = None
+               cls: Optional[VerifyClass] = None,
+               source: Optional[str] = None
                ) -> "asyncio.Future[bool]":
         """Queue one fast-aggregate triple; resolves with the verdict."""
         return self.verify_multi([(public_keys, message, signature)],
-                                 cls=cls)
+                                 cls=cls, source=source)
 
     @staticmethod
     def _task_key(triples: Sequence[Triple]) -> tuple:
@@ -475,10 +476,16 @@ class AggregatingSignatureVerificationService:
             return None
 
     def verify_multi(self, triples: Sequence[Triple],
-                     cls: Optional[VerifyClass] = None
+                     cls: Optional[VerifyClass] = None,
+                     source: Optional[str] = None
                      ) -> "asyncio.Future[bool]":
         """Queue several triples as ONE atomic task (e.g. the three
         signatures of a SignedAggregateAndProof verify together).
+
+        ``source`` names the arrival's demand stream in the capacity
+        model (default: this service's name) — the sync-committee verbs
+        pass ``capacity.SOURCE_SYNC_COMMITTEE`` so their load is
+        attributable separately from attestation gossip.
 
         Identical in-flight submissions coalesce: gossip re-delivers
         the same (pks, msg, sig), and re-verifying a triple that is
@@ -509,7 +516,8 @@ class AggregatingSignatureVerificationService:
         # still demand (counting only accepted work would read
         # utilization low during exactly the overload the brownout
         # controller exists to manage)
-        self._telemetry.record_arrival(self._name, len(triples))
+        self._telemetry.record_arrival(source or self._name,
+                                       len(triples))
         plan = self._current_plan()
         if plan is not None and plan.sheds(cls):
             # brownout admission control: the controller already

@@ -153,6 +153,44 @@ def is_sync_committee_aggregator(cfg: SpecConfig, proof: bytes) -> bool:
     return int.from_bytes(H.hash32(proof)[:8], "little") % modulo == 0
 
 
+def contribution_signature_set(cfg: SpecConfig, state, signed,
+                               pubkeys: List[bytes]):
+    """The THREE (pubkeys, root, signature) triples of one
+    SignedContributionAndProof — selection proof, envelope, and the
+    aggregated contribution over its participants — as ONE signature
+    set for the batched device provider.
+
+    One definition shared by the gossip validator, the load generator
+    and the device/oracle parity tests, so the device path can never
+    drift from the per-signature oracle semantics (reference: the
+    SignatureVerificationService set built in
+    SignedContributionAndProofValidator.java).  ``pubkeys`` is the
+    aggregator's subcommittee (``sync_subcommittee_members``); returns
+    None when the contribution names no participants (REJECT — an
+    empty fast-aggregate set never verifies)."""
+    msg = signed.message
+    contribution = msg.contribution
+    agg_pubkey = state.validators[msg.aggregator_index].pubkey
+    participants = [pk for pk, b in zip(
+        pubkeys, contribution.aggregation_bits) if b]
+    if not participants:
+        return None
+    return [
+        ([agg_pubkey],
+         sync_selection_proof_signing_root(
+             cfg, state, contribution.slot,
+             contribution.subcommittee_index),
+         msg.selection_proof),
+        ([agg_pubkey],
+         contribution_and_proof_signing_root(cfg, state, msg),
+         signed.signature),
+        (participants,
+         sync_message_signing_root(cfg, state, contribution.slot,
+                                   contribution.beacon_block_root),
+         contribution.signature),
+    ]
+
+
 def contribution_and_proof_signing_root(cfg: SpecConfig, state,
                                         message) -> bytes:
     from ..config import DOMAIN_CONTRIBUTION_AND_PROOF

@@ -140,12 +140,15 @@ class BatchSignatureVerifier(SignatureVerifier):
 class AsyncSignatureVerifier:
     """Async seam: the gossip-side interface the batching service
     implements (reference AsyncBLSSignatureVerifier).  ``cls`` is the
-    submitting call site's ``VerifyClass`` — implementations without a
-    priority queue ignore it."""
+    submitting call site's ``VerifyClass``; ``source`` names the
+    arrival's demand stream in the capacity model (the sync-committee
+    verbs carry their own) — implementations without a priority queue
+    or capacity accounting ignore both."""
 
     async def verify(self, public_keys: Sequence[bytes], message: bytes,
                      signature: bytes,
-                     cls: Optional[VerifyClass] = None) -> bool:
+                     cls: Optional[VerifyClass] = None,
+                     source: Optional[str] = None) -> bool:
         raise NotImplementedError
 
     @staticmethod
@@ -158,7 +161,8 @@ class _WrappedAsync(AsyncSignatureVerifier):
         self._inner = inner
 
     async def verify(self, public_keys, message, signature,
-                     cls: Optional[VerifyClass] = None) -> bool:
+                     cls: Optional[VerifyClass] = None,
+                     source: Optional[str] = None) -> bool:
         return self._inner.verify(public_keys, message, signature)
 
 
@@ -166,21 +170,24 @@ class ServiceAsyncSignatureVerifier(AsyncSignatureVerifier):
     """Adapter onto AggregatingSignatureVerificationService (the TPU
     batcher) — futures resolve when the device batch lands.  Threads
     the caller's priority class (validator default or the ambient
-    ``verify_class`` override) into the service's per-class queue."""
+    ``verify_class`` override) and arrival source into the service's
+    per-class queue and capacity accounting."""
 
     def __init__(self, service):
         self._service = service
 
     async def verify(self, public_keys, message, signature,
-                     cls: Optional[VerifyClass] = None) -> bool:
+                     cls: Optional[VerifyClass] = None,
+                     source: Optional[str] = None) -> bool:
         return await self._service.verify(
             list(public_keys), message, signature,
-            cls=effective_class(cls))
+            cls=effective_class(cls), source=source)
 
     async def verify_multi(self, triples: Sequence[Triple],
-                           cls: Optional[VerifyClass] = None) -> bool:
+                           cls: Optional[VerifyClass] = None,
+                           source: Optional[str] = None) -> bool:
         return await self._service.verify_multi(
-            list(triples), cls=effective_class(cls))
+            list(triples), cls=effective_class(cls), source=source)
 
 
 class AsyncBatchSignatureVerifier:
@@ -194,9 +201,11 @@ class AsyncBatchSignatureVerifier:
     """
 
     def __init__(self, delegate: AsyncSignatureVerifier,
-                 cls: Optional[VerifyClass] = None):
+                 cls: Optional[VerifyClass] = None,
+                 source: Optional[str] = None):
         self._delegate = delegate
         self._cls = cls
+        self._source = source
         self._jobs: List[Triple] = []
 
     def verify(self, public_keys, message, signature) -> bool:
@@ -207,10 +216,11 @@ class AsyncBatchSignatureVerifier:
         if not self._jobs:
             return True
         if isinstance(self._delegate, ServiceAsyncSignatureVerifier):
-            return await self._delegate.verify_multi(self._jobs,
-                                                     cls=self._cls)
+            return await self._delegate.verify_multi(
+                self._jobs, cls=self._cls, source=self._source)
         for pks, msg, sig in self._jobs:
             if not await self._delegate.verify(pks, msg, sig,
-                                               cls=self._cls):
+                                               cls=self._cls,
+                                               source=self._source):
                 return False
         return True

@@ -217,3 +217,98 @@ def test_trajectory_corrupt_file_aborts_without_overwrite(tmp_path):
     assert bench.append_trajectory(_result(), path=str(missing),
                                    run_id="r01") == "appended"
     assert len(json.load(open(missing))["entries"]) == 1
+
+
+def test_mainnet_gates_on_fixtures():
+    """The loadgen acceptance gates: BLOCK_IMPORT/VIP sheds == 0 under
+    EVERY scenario, the critical p50 bound on production shapes only
+    (adversarial floods are exempt from the latency gate, not the
+    shed gate), and the dedup-ratio floor on committee-shaped mixes."""
+    base = bench_diff.load_result(BASE)
+    out = bench_diff.compare(base, base)
+    checks = _by_metric(out)
+    assert checks["mainnet_block_import_sheds.steady_state"][
+        "status"] == "ok"
+    assert checks["mainnet_vip_p50_ms.steady_state"]["status"] == "ok"
+    assert checks["mainnet_dedup_ratio.steady_state"]["status"] == "ok"
+    # adversarial scenarios carry no latency gate but keep the shed one
+    assert checks["mainnet_block_import_sheds.invalid_sig_flood"][
+        "status"] == "ok"
+    assert "mainnet_vip_p50_ms.invalid_sig_flood" not in checks
+    # non-committee-shaped mixes carry no dedup floor
+    assert "mainnet_dedup_ratio.dup_collapse" not in checks
+
+    reg = bench_diff.load_result(REGRESSED)
+    out = bench_diff.compare(base, reg)
+    checks = _by_metric(out)
+    assert out["verdict"] == "regression"
+    # block import was shed under the storm: the invariant gate fires
+    assert checks["mainnet_block_import_sheds.epoch_boundary_storm"][
+        "status"] == "regression"
+    # vip p50 blown on a production shape
+    assert checks["mainnet_vip_p50_ms.steady_state"]["status"] \
+        == "regression"
+    # a committee-shaped mix lost its duplication
+    assert checks["mainnet_dedup_ratio.blob_storm"]["status"] \
+        == "regression"
+
+
+def test_mainnet_gates_absent_are_skipped_and_thresholds():
+    """Runs without the mainnet phase (pre-loadgen results) compare
+    clean; the p50 bound and dedup floor are operator-tunable."""
+    base = bench_diff.load_result(BASE)
+    stripped = {k: v for k, v in base.items() if k != "mainnet"}
+    out = bench_diff.compare(base, stripped)
+    assert not any(c["metric"].startswith("mainnet_")
+                   for c in out["checks"])
+    assert out["verdict"] == "pass"
+    # tighten the critical p50 bound under the storm's measured 228 ms
+    out = bench_diff.compare(base, base,
+                             {"mainnet_critical_p50_ms_max": 100.0})
+    checks = _by_metric(out)
+    assert checks["mainnet_vip_p50_ms.epoch_boundary_storm"][
+        "status"] == "regression"
+    # raise the dedup floor past the fixtures' 0.30
+    out = bench_diff.compare(base, base,
+                             {"mainnet_dedup_ratio_min": 0.5})
+    assert _by_metric(out)["mainnet_dedup_ratio.steady_state"][
+        "status"] == "regression"
+
+
+def test_phase_focused_run_zero_value_skips_relative_gates():
+    """A control-plane-focused run (BENCH_THROUGHPUT=0) reports
+    value=0.0 — that is 'phase did not run', never a measured
+    collapse, so the relative gates skip instead of failing."""
+    base = bench_diff.load_result(BASE)
+    focused = dict(base)
+    focused["value"] = 0.0
+    out = bench_diff.compare(base, focused)
+    assert _by_metric(out)["sigs_per_sec"]["status"] == "skipped"
+    assert out["verdict"] == "pass"
+
+
+def test_current_bench_r09_mainnet_evidence_gates_clean():
+    """The checked-in mainnet-focused BENCH_r09 run: >= 4 scenarios
+    including the adversarial flood and the epoch-boundary storm, all
+    mainnet gates green against the r08 base."""
+    r08 = os.path.join(os.path.dirname(__file__), "..",
+                       "BENCH_r08.json")
+    r09 = os.path.join(os.path.dirname(__file__), "..",
+                       "BENCH_r09.json")
+    if not (os.path.exists(r08) and os.path.exists(r09)):
+        pytest.skip("checked-in bench results not present")
+    new = bench_diff.load_result(r09)
+    scen = new["mainnet"]["scenarios"]
+    assert len([v for v in scen.values() if isinstance(v, dict)
+                and "by_class" in v]) >= 4
+    assert "invalid_sig_flood" in scen
+    assert "epoch_boundary_storm" in scen
+    assert scen["invalid_sig_flood"]["bisect_dispatches"] > 0
+    assert scen["epoch_boundary_storm"]["brownout"]["enters"] >= 1
+    out = bench_diff.compare(bench_diff.load_result(r08), new)
+    assert out["verdict"] == "pass"
+    mainnet_checks = [c for c in out["checks"]
+                      if c["metric"].startswith("mainnet_")]
+    assert mainnet_checks
+    assert all(c["status"] in ("ok", "skipped")
+               for c in mainnet_checks)

@@ -134,3 +134,88 @@ def test_real_trusted_setup_end_to_end():
     bad = bytearray(blob)
     bad[40] ^= 1
     assert not verify_blob_kzg_proof(bytes(bad), commitment, proof, setup)
+
+
+def test_batch_host_fallback_short_circuits_on_first_failure(
+        monkeypatch):
+    """The BackendUnavailable host fallback must stop at the FIRST
+    failed blob: the batch verdict is already False, and a 4096-point
+    pairing per remaining blob would burn host time exactly while the
+    node is degraded.  Same property for the no-backend batch path."""
+    setup = insecure_setup()
+    blobs, commits, proofs = [], [], []
+    for seed in (5, 6, 7):
+        b = _random_blob(seed)
+        c = blob_to_kzg_commitment(b, setup)
+        p = compute_blob_kzg_proof(b, c, setup)
+        blobs.append(b), commits.append(c), proofs.append(p)
+    # first blob's proof is wrong; the rest are valid
+    bad_proofs = [proofs[1]] + proofs[1:]
+
+    calls = []
+    real_host = kzg._verify_blob_kzg_proof_host
+
+    def counting_host(b, c, p, s=None):
+        calls.append(b[:8])
+        return real_host(b, c, p, s)
+
+    monkeypatch.setattr(kzg, "_verify_blob_kzg_proof_host",
+                        counting_host)
+
+    class SickBackend:
+        name = "sick"
+
+        def verify_blob_kzg_proof_batch(self, *a, **kw):
+            raise kzg.BackendUnavailable("circuit open")
+
+    kzg.set_backend(SickBackend())
+    try:
+        assert not verify_blob_kzg_proof_batch(blobs, commits,
+                                               bad_proofs, setup)
+        assert len(calls) == 1          # stopped at the first failure
+        # a fully-valid batch still verifies every blob
+        calls.clear()
+        assert verify_blob_kzg_proof_batch(blobs, commits, proofs,
+                                           setup)
+        assert len(calls) == 3
+    finally:
+        kzg.set_backend(None)
+    # no-backend path short-circuits identically
+    calls.clear()
+    assert not verify_blob_kzg_proof_batch(blobs, commits, bad_proofs,
+                                           setup)
+    assert len(calls) == 1
+
+
+def test_kzg_arrivals_accounted_as_their_own_source():
+    """Blob verification demand lands in the capacity model under
+    source="kzg" (class SYNC_CRITICAL), so utilization and brownout
+    see blob storms — and a failed accounting layer can never fail a
+    verification."""
+    from teku_tpu.infra import capacity
+    from teku_tpu.infra.flightrecorder import FlightRecorder
+    from teku_tpu.infra.metrics import MetricsRegistry
+    from teku_tpu.services.admission import VerifyClass
+
+    assert kzg.KZG_ARRIVAL_SOURCE == capacity.SOURCE_KZG == "kzg"
+    assert kzg.kzg_verify_class() is VerifyClass.SYNC_CRITICAL
+
+    setup = insecure_setup()
+    blob = _random_blob(8)
+    commitment = blob_to_kzg_commitment(blob, setup)
+    proof = compute_blob_kzg_proof(blob, commitment, setup)
+
+    reg = MetricsRegistry()
+    telemetry = capacity.CapacityTelemetry(
+        registry=reg, recorder=FlightRecorder(registry=reg))
+    prev = capacity.swap_default(telemetry)
+    try:
+        assert verify_blob_kzg_proof_batch([blob], [commitment],
+                                           [proof], setup)
+        arrivals = telemetry.snapshot()["arrival_rate_per_second"]
+        assert capacity.SOURCE_KZG in arrivals
+        # single-blob verification is demand too
+        assert verify_blob_kzg_proof(blob, commitment, proof, setup)
+    finally:
+        restored = capacity.swap_default(prev)
+        assert restored is telemetry       # swap seam round-trips

@@ -604,3 +604,71 @@ def test_slo_health_family_naming_lint():
             if name.endswith(("_seconds", "_bytes", "_total")):
                 problems.append(f"burn rate {name} must be unitless")
     assert not problems, "\n".join(problems)
+
+
+def test_loadgen_sync_kzg_family_naming_lint():
+    """The loadgen / sync-committee / kzg-source label families must
+    not drift: every ``scenario`` label value comes from the CLOSED
+    scenario registry, every ``kind`` from the model's closed event
+    vocabulary, every ``class`` from the VerifyClass enum, and the
+    well-known arrival sources are pinned strings (dashboards key on
+    ``bls_arrival_rate_per_second{source="kzg"|"sync_committee"}``)."""
+    from teku_tpu.crypto import kzg
+    from teku_tpu.infra import capacity
+    from teku_tpu.infra.metrics import GLOBAL_REGISTRY
+    from teku_tpu.loadgen import driver  # noqa: F401 - registers
+    from teku_tpu.loadgen.model import EVENT_KINDS
+    from teku_tpu.loadgen.scenarios import SCENARIOS
+    from teku_tpu.services.admission import CLASS_LABELS, VerifyClass
+
+    # the closed vocabularies themselves
+    assert capacity.SOURCE_KZG == kzg.KZG_ARRIVAL_SOURCE == "kzg"
+    assert capacity.SOURCE_SYNC_COMMITTEE == "sync_committee"
+    assert kzg.kzg_verify_class() is VerifyClass.SYNC_CRITICAL
+    assert len(SCENARIOS) >= 4
+    assert set(EVENT_KINDS) == {"block", "block_import", "attestation",
+                                "aggregate", "sync_message",
+                                "sync_contribution", "blob_batch"}
+
+    metrics = GLOBAL_REGISTRY.metrics()
+    events = metrics["loadgen_events_total"]
+    assert isinstance(events, LabeledCounter)
+    assert tuple(events.labelnames) == ("scenario", "kind")
+    sheds = metrics["loadgen_sheds_total"]
+    assert isinstance(sheds, LabeledCounter)
+    assert tuple(sheds.labelnames) == ("scenario", "class")
+    dedup = metrics["loadgen_dedup_ratio"]
+    assert isinstance(dedup, LabeledGauge)
+    assert tuple(dedup.labelnames) == ("scenario",)
+    # any series already recorded stays inside the closed sets
+    for (scenario, kind), _c in events._items():
+        assert scenario in SCENARIOS and kind in EVENT_KINDS
+    for (scenario, cls), _c in sheds._items():
+        assert scenario in SCENARIOS and cls in CLASS_LABELS
+    for (scenario,), _c in dedup._items():
+        assert scenario in SCENARIOS
+
+    problems = []
+    for name, m in metrics.items():
+        if not name.startswith("loadgen_"):
+            continue
+        if isinstance(m, (Counter, LabeledCounter)) \
+                and not name.endswith("_total"):
+            problems.append(f"counter {name} must end _total")
+        if name.endswith("_total") \
+                and not isinstance(m, (Counter, LabeledCounter)):
+            problems.append(f"{name} ends _total but is not a counter")
+        if isinstance(m, (Gauge, LabeledGauge)) \
+                and not name.endswith(("_ratio", "_seconds",
+                                       "_per_second")):
+            problems.append(f"gauge {name} needs a unit suffix")
+        if _DURATION_HINT.search(name) and not name.endswith("_seconds"):
+            problems.append(f"duration metric {name} must end _seconds")
+    assert not problems, "\n".join(problems)
+
+    # the combined exposition stays structurally valid with the new
+    # families declared (HELP/TYPE from scrape 1)
+    fams = parse_exposition(GLOBAL_REGISTRY.expose())
+    for fam in ("loadgen_events_total", "loadgen_sheds_total",
+                "loadgen_dedup_ratio"):
+        assert fam in fams and fams[fam]["type"] is not None

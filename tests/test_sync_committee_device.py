@@ -1,0 +1,216 @@
+"""Sync-committee signature sets on the batched device provider.
+
+The second device verb (ROADMAP 4): contribution/sync-message
+verification rides the batched JAX provider end-to-end — including the
+multi-pubkey fast-aggregate lane over the shared sync root — with
+parity pinned against the per-signature pure oracle, and the demand
+accounted under its own ``sync_committee`` arrival source."""
+
+import asyncio
+import dataclasses
+
+import pytest
+
+from teku_tpu.crypto import bls
+from teku_tpu.crypto.bls import keygen
+from teku_tpu.crypto.bls.pure_impl import PureBls12381
+from teku_tpu.infra.capacity import (CapacityTelemetry, SOURCE_KZG,
+                                     SOURCE_SYNC_COMMITTEE)
+from teku_tpu.infra.metrics import MetricsRegistry
+from teku_tpu.services.admission import VerifyClass
+from teku_tpu.services.signatures import (
+    AggregatingSignatureVerificationService)
+
+jax = pytest.importorskip("jax")
+
+
+def _sync_set(tamper: bool = False):
+    """A synthetic sync-committee signature set in the production
+    shape: selection proof + envelope (single-key lanes) and the
+    aggregated contribution over the shared sync root (one multi-key
+    fast-aggregate lane)."""
+    oracle = PureBls12381()
+    agg_sk = keygen(b"\x51" * 32)
+    agg_pk = oracle.secret_key_to_public_key(agg_sk)
+    sel_root = b"sel-root".ljust(32, b"\x01")
+    env_root = b"env-root".ljust(32, b"\x02")
+    sync_root = b"sync-root".ljust(32, b"\x03")
+    member_sks = [keygen(bytes([0x60 + i]) * 32) for i in range(4)]
+    member_pks = [oracle.secret_key_to_public_key(sk)
+                  for sk in member_sks]
+    contribution_sig = oracle.aggregate_signatures(
+        [oracle.sign(sk, sync_root) for sk in member_sks])
+    env_sig = oracle.sign(agg_sk, env_root)
+    if tamper:
+        env_sig = oracle.sign(agg_sk, b"wrong-root".ljust(32, b"\x04"))
+    return [
+        ([agg_pk], sel_root, oracle.sign(agg_sk, sel_root)),
+        ([agg_pk], env_root, env_sig),
+        (member_pks, sync_root, contribution_sig),
+    ]
+
+
+def _oracle_verdict(triples) -> bool:
+    """The per-signature oracle path: each lane as one independent
+    fast-aggregate verify on the pure implementation."""
+    oracle = PureBls12381()
+    return all(oracle.fast_aggregate_verify(pks, msg, sig)
+               for pks, msg, sig in triples)
+
+
+@pytest.fixture(scope="module")
+def provider():
+    from teku_tpu.ops.provider import JaxBls12381
+    return JaxBls12381(max_batch=8, min_bucket=8)
+
+
+def test_sync_set_device_oracle_parity(provider):
+    """The acceptance pin: a sync-committee signature set verifies
+    through the batched device provider with the SAME verdict as the
+    per-signature oracle path — valid and tampered."""
+    good = _sync_set()
+    assert _oracle_verdict(good) is True
+    assert provider.batch_verify(good) is True
+    bad = _sync_set(tamper=True)
+    assert _oracle_verdict(bad) is False
+    assert provider.batch_verify(bad) is False
+
+
+def test_contribution_signature_set_shape():
+    """The shared triple-set definition (spec/altair/helpers) produces
+    exactly the three lanes the validator batches, with participants
+    filtered by the aggregation bits — and end-to-end, the set it
+    builds against a REAL altair state verifies on the device provider
+    and the oracle alike."""
+    from teku_tpu.spec import config as C
+    from teku_tpu.spec import helpers as H
+    from teku_tpu.spec.altair import helpers as AH
+    from teku_tpu.spec.altair.datastructures import get_altair_schemas
+    from teku_tpu.spec.genesis import interop_genesis
+
+    cfg = dataclasses.replace(C.MINIMAL, ALTAIR_FORK_EPOCH=0)
+    state, sks = interop_genesis(cfg, 8)
+    assert hasattr(state, "current_sync_committee")
+    S = get_altair_schemas(cfg)
+    pk_to_sk = {bls.secret_to_public_key(sk): sk for sk in sks}
+    sub = 1
+    positions, pubkeys = AH.sync_subcommittee_members(cfg, state, sub)
+    slot = 1
+    root = b"\x07" * 32
+    bits = tuple(i % 2 == 0 for i in range(len(pubkeys)))
+    sync_root = AH.sync_message_signing_root(cfg, state, slot, root)
+    contribution = S.SyncCommitteeContribution(
+        slot=slot, beacon_block_root=root, subcommittee_index=sub,
+        aggregation_bits=bits,
+        signature=bls.aggregate_signatures(
+            [bls.sign(pk_to_sk[pk], sync_root)
+             for pk, b in zip(pubkeys, bits) if b]))
+    aggregator_index = 3
+    agg_sk = sks[aggregator_index]
+    msg = S.ContributionAndProof(
+        aggregator_index=aggregator_index, contribution=contribution,
+        selection_proof=bls.sign(
+            agg_sk, AH.sync_selection_proof_signing_root(
+                cfg, state, slot, sub)))
+    signed = S.SignedContributionAndProof(
+        message=msg, signature=bls.sign(
+            agg_sk, AH.contribution_and_proof_signing_root(cfg, state,
+                                                           msg)))
+
+    triples = AH.contribution_signature_set(cfg, state, signed, pubkeys)
+    assert len(triples) == 3
+    sel, env, contrib = triples
+    assert sel[0] == env[0] == [state.validators[
+        aggregator_index].pubkey]
+    assert contrib[0] == [pk for pk, b in zip(pubkeys, bits) if b]
+    assert contrib[1] == sync_root
+    # the whole set verifies per-signature on the oracle
+    assert _oracle_verdict(triples) is True
+    # no participants -> None (the validator REJECTs)
+    empty = S.SignedContributionAndProof(
+        message=S.ContributionAndProof(
+            aggregator_index=aggregator_index,
+            contribution=contribution.copy_with(
+                aggregation_bits=tuple(False for _ in bits)),
+            selection_proof=msg.selection_proof),
+        signature=signed.signature)
+    assert AH.contribution_signature_set(cfg, state, empty,
+                                         pubkeys) is None
+
+
+def test_contribution_set_parity_on_device(provider):
+    """The real-state contribution set from the helper verifies
+    identically through the batched provider."""
+    from teku_tpu.spec import config as C
+    from teku_tpu.spec.altair import helpers as AH
+    from teku_tpu.spec.altair.datastructures import get_altair_schemas
+    from teku_tpu.spec.genesis import interop_genesis
+
+    cfg = dataclasses.replace(C.MINIMAL, ALTAIR_FORK_EPOCH=0)
+    state, sks = interop_genesis(cfg, 8)
+    S = get_altair_schemas(cfg)
+    pk_to_sk = {bls.secret_to_public_key(sk): sk for sk in sks}
+    positions, pubkeys = AH.sync_subcommittee_members(cfg, state, 0)
+    slot, root = 2, b"\x09" * 32
+    bits = tuple(True for _ in pubkeys)
+    sync_root = AH.sync_message_signing_root(cfg, state, slot, root)
+    contribution = S.SyncCommitteeContribution(
+        slot=slot, beacon_block_root=root, subcommittee_index=0,
+        aggregation_bits=bits,
+        signature=bls.aggregate_signatures(
+            [bls.sign(pk_to_sk[pk], sync_root) for pk in pubkeys]))
+    msg = S.ContributionAndProof(
+        aggregator_index=0, contribution=contribution,
+        selection_proof=bls.sign(
+            sks[0], AH.sync_selection_proof_signing_root(
+                cfg, state, slot, 0)))
+    signed = S.SignedContributionAndProof(
+        message=msg, signature=bls.sign(
+            sks[0], AH.contribution_and_proof_signing_root(cfg, state,
+                                                           msg)))
+    triples = AH.contribution_signature_set(cfg, state, signed, pubkeys)
+    assert _oracle_verdict(triples) is True
+    assert provider.batch_verify(triples) is True
+    # one flipped participant bit breaks the aggregate lane everywhere
+    tampered = [triples[0], triples[1],
+                (triples[2][0][:-1], triples[2][1], triples[2][2])]
+    assert _oracle_verdict(tampered) is False
+    assert provider.batch_verify(tampered) is False
+
+
+def test_sync_committee_arrival_source_accounting():
+    """A verification submitted with source="sync_committee" lands in
+    the capacity model as its OWN demand stream, separate from the
+    service's default source."""
+
+    async def main():
+        registry = MetricsRegistry()
+        telemetry = CapacityTelemetry(registry=registry)
+        svc = AggregatingSignatureVerificationService(
+            num_workers=1, registry=registry, name="sync_acct",
+            telemetry=telemetry)
+        await svc.start()
+        f1 = svc.verify([b"\xa0" + bytes(47)], b"m1", b"s1",
+                        cls=VerifyClass.GOSSIP)
+        f2 = svc.verify([b"\xa0" + bytes(47)], b"m2", b"s2",
+                        cls=VerifyClass.SYNC_CRITICAL,
+                        source=SOURCE_SYNC_COMMITTEE)
+        for f in (f1, f2):
+            try:
+                await f
+            except Exception:
+                pass
+        await svc.stop()
+        return telemetry.snapshot()["arrival_rate_per_second"]
+
+    arrivals = asyncio.run(main())
+    assert SOURCE_SYNC_COMMITTEE in arrivals
+    assert "sync_acct" in arrivals
+    assert SOURCE_KZG == "kzg" and SOURCE_SYNC_COMMITTEE \
+        == "sync_committee"
+
+
+def test_contribution_validator_class_is_sync_critical():
+    from teku_tpu.node.validators import ContributionValidator
+    assert ContributionValidator.verify_cls \
+        is VerifyClass.SYNC_CRITICAL

@@ -35,7 +35,12 @@ a failure — budget-starved runs drop phases):
   run): p50 under max offered load ≤ ``overload_p50_ms_max`` (default
   the 100 ms SLO), ZERO BLOCK_IMPORT sheds, shed counts ordered
   OPTIMISTIC ≥ GOSSIP, and an unflapped brownout (one enter edge, at
-  most one exit) — the PR-7 acceptance properties.
+  most one exit) — the PR-7 acceptance properties;
+- mainnet gates (absolute, per loadgen scenario in ``mainnet``):
+  BLOCK_IMPORT/VIP sheds == 0 under EVERY traffic shape, vip/
+  block_import p50 ≤ ``mainnet_critical_p50_ms_max`` on production
+  (non-adversarial) shapes, and dedup ratio ≥
+  ``mainnet_dedup_ratio_min`` on committee-shaped mixes.
 """
 
 import argparse
@@ -52,6 +57,11 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
     "dedup_speedup_8x_min": 1.5,
     "overload_p50_ms_max": 100.0,
     "msm_scalars_speedup_min": 1.3,
+    "mainnet_critical_p50_ms_max": 300.0,
+    # committee-shaped floor: steady mixes measure ~0.34, the boundary
+    # storm ~0.24 (brownout sheds duplicated gossip before dispatch);
+    # adversarial dup-collapse sits at ~0.03
+    "mainnet_dedup_ratio_min": 0.2,
 }
 
 
@@ -92,11 +102,15 @@ def _stage_p50s(doc: dict) -> Dict[str, float]:
 def _check(checks: list, metric: str, base, new, threshold: float,
            direction: str) -> None:
     """direction: "higher" = higher is better, "lower" = lower is
-    better.  None/zero on either side = skipped (no evidence)."""
+    better.  None/zero on either side = skipped (no evidence): every
+    relative metric here is strictly positive when measured, so a 0
+    means the phase did not run (budget-starved or phase-focused
+    runs), not a measured collapse."""
     entry = {"metric": metric, "base": base, "new": new,
              "threshold": threshold, "direction": direction}
     if not isinstance(base, (int, float)) \
-            or not isinstance(new, (int, float)) or base <= 0:
+            or not isinstance(new, (int, float)) or base <= 0 \
+            or new <= 0:
         entry["status"] = "skipped"
         checks.append(entry)
         return
@@ -218,6 +232,39 @@ def compare(base: dict, new: dict,
         lambda v: v is False,
         "brownout must be edge-triggered: one enter, at most one "
         "exit, no flapping")
+
+    # mainnet gates (loadgen acceptance properties, absolute, per
+    # scenario): protected classes are NEVER shed under any traffic
+    # shape, the critical-class p50 bound holds on every production
+    # (non-adversarial) shape, and committee-shaped mixes keep the
+    # dedup ratio the unique-message pipeline's wins depend on
+    for name, rep in sorted((_get(new, "mainnet", "scenarios")
+                             or {}).items()):
+        if not isinstance(rep, dict) or "by_class" not in rep:
+            continue
+        sheds = rep.get("sheds") or {}
+        _check_absolute(
+            checks, f"mainnet_block_import_sheds.{name}",
+            (sheds.get("block_import"), sheds.get("vip")),
+            lambda v: v[0] == 0 and v[1] == 0,
+            "BLOCK_IMPORT/VIP must never be shed, under every "
+            "scenario")
+        if not rep.get("adversarial"):
+            for cls in ("vip", "block_import"):
+                _check_absolute(
+                    checks, f"mainnet_{cls}_p50_ms.{name}",
+                    _get(rep, "by_class", cls, "p50_ms"),
+                    lambda v: v <= thr["mainnet_critical_p50_ms_max"],
+                    f"{cls} p50 must stay <= "
+                    f"{thr['mainnet_critical_p50_ms_max']} ms on "
+                    "production shapes")
+        if rep.get("committee_shaped"):
+            _check_absolute(
+                checks, f"mainnet_dedup_ratio.{name}",
+                rep.get("dedup_ratio"),
+                lambda v: v >= thr["mainnet_dedup_ratio_min"],
+                f"committee-shaped mixes must keep dedup ratio >= "
+                f"{thr['mainnet_dedup_ratio_min']}")
 
     regressions = [c for c in checks if c["status"] == "regression"]
     return {"verdict": "regression" if regressions else "pass",
