@@ -279,6 +279,19 @@ def _init_device(deadline):
         os.environ["JAX_PLATFORMS"] = "cpu"
         OUT["fallback"] = f"tpu init failed: {detail}"
         _backend_state("degraded", why=detail)
+    if platform in (None, "cpu") \
+            and os.environ.get("BENCH_MESH", "1") != "0" \
+            and os.environ.get("BENCH_THROUGHPUT", "1") != "0":
+        # the mesh phase needs devices to shard over: on the CPU
+        # (fallback) backend, force virtual host devices BEFORE jax
+        # imports (serialized on one core — the phase reports the
+        # per-device projection alongside measured wall rates).
+        # Gated exactly like the phase itself (BENCH_THROUGHPUT=0
+        # control-plane runs must keep their baseline topology)
+        from teku_tpu.infra.env import ensure_virtual_devices
+        n = int(os.environ.get("BENCH_MESH_FORCE_DEVICES", "8"))
+        if ensure_virtual_devices(n):
+            _beat("mesh_virtual_devices_forced", n=n)
 
     # the probe proved (or disproved) the backend in a disposable
     # process; the in-process init after a good probe should be quick,
@@ -832,6 +845,128 @@ def _dedup_phase(jax, deadline):
                                  ("dedup_ratio", "warm")})
 
 
+def _mesh_phase(jax, deadline):
+    """Device-count sweep of the GROUP-ALIGNED sharded verify path
+    (ROADMAP item 1): the committee-shaped dup-8 batch dispatched
+    through JaxBls12381(mesh=make_mesh(n)) at n = 1/2/4/8 devices,
+    per-count sigs/sec + scaling efficiency into OUT["mesh"].
+
+    On virtual CPU devices (xla_force_host_platform_device_count over
+    ONE host) the shards execute SERIALIZED, so measured wall rates
+    cannot rise with n; the phase additionally reports the per-device
+    projection — wall_n/n per-dispatch latency, i.e. what concurrent
+    shards would deliver, including the replicated finish and gather
+    overhead the mesh really adds (PERF.md "Multi-chip mesh" derives
+    why this equals real-mesh scaling up to ICI latency).  The
+    monotonicity/efficiency gates in tools/bench_diff.py key on the
+    ``series`` field: "measured" on real parallel hardware,
+    "projected_serialized_virtual" here."""
+    from teku_tpu import parallel
+    from teku_tpu.crypto.bls import keygen
+    from teku_tpu.ops.provider import JaxBls12381
+
+    batch = int(os.environ.get("BENCH_MESH_BATCH", "256"))
+    dup = int(os.environ.get("BENCH_MESH_DUP", "8"))
+    iters = int(os.environ.get("BENCH_MESH_ITERS", "2"))
+    counts = [int(c) for c in os.environ.get(
+        "BENCH_MESH_COUNTS", "1,2,4,8").split(",")]
+    avail = len(jax.devices())
+    virtual = jax.devices()[0].platform == "cpu"
+    out: dict = {"batch": batch, "dup": dup,
+                 "available_devices": avail,
+                 "series": ("projected_serialized_virtual" if virtual
+                            else "measured"),
+                 "devices": {}}
+    OUT["mesh"] = out
+    _beat("mesh_phase_start", batch=batch, dup=dup, counts=counts,
+          available=avail, virtual=virtual)
+    pure_sks = [keygen(bytes([41 + i]) * 32) for i in range(16)]
+    seq = [0]
+
+    def fresh_triples(impl, pks):
+        """One committee-shaped batch: batch/dup FRESH unique messages
+        (cold H(m) path), each signed by dup committee members."""
+        uniq = max(batch // dup, 1)
+        msgs = [b"mesh-%d-%d" % (seq[0], u) for u in range(uniq)]
+        seq[0] += 1
+        sig_cache: dict = {}
+        triples = []
+        for lane in range(batch):
+            m = msgs[lane % uniq]
+            k = lane % 16
+            if (k, m) not in sig_cache:
+                sig_cache[(k, m)] = impl.sign(pure_sks[k], m)
+            triples.append(([pks[k]], m, sig_cache[(k, m)]))
+        return triples
+
+    wall: dict = {}
+    for c in counts:
+        if c > avail:
+            out["devices"][str(c)] = "skipped: devices"
+            continue
+        remaining = deadline - time.time()
+        if remaining < 120 and wall:
+            out["devices"][str(c)] = "skipped: budget"
+            continue
+        try:
+            WD.arm(max(remaining, 60) + 600, f"mesh {c} devices")
+            mesh = None if c == 1 else parallel.make_mesh(c)
+            impl = JaxBls12381(max_batch=batch, min_bucket=batch,
+                               mesh=mesh)
+            pks = [impl.secret_key_to_public_key(sk)
+                   for sk in pure_sks]
+            t0 = time.time()
+            if not impl.batch_verify(fresh_triples(impl, pks)):
+                raise RuntimeError("mesh warmup batch failed")
+            compile_s = round(time.time() - t0, 1)
+            best_wall = None
+            for _ in range(iters):
+                triples = fresh_triples(impl, pks)
+                t0 = time.time()
+                okv = impl.batch_verify(triples)
+                dt = time.time() - t0
+                if not okv:
+                    raise RuntimeError("mesh batch did not verify")
+                best_wall = dt if best_wall is None \
+                    else min(best_wall, dt)
+            WD.disarm()
+            wall[c] = best_wall
+            entry = {"sigs_per_sec": round(batch / best_wall, 2),
+                     "wall_s": round(best_wall, 3),
+                     "compile_s": compile_s,
+                     "mesh_dispatches":
+                         impl.dispatch_count if mesh else 0}
+            # the scaling series: measured on real parallel devices,
+            # the wall/n per-device projection on serialized virtual
+            entry["mesh_sigs_per_sec"] = round(
+                batch * c / best_wall if virtual
+                else batch / best_wall, 2)
+            out["devices"][str(c)] = entry
+            _beat("mesh_count_done", devices=c, **{
+                k: entry[k] for k in ("sigs_per_sec",
+                                      "mesh_sigs_per_sec",
+                                      "compile_s")})
+        except Exception as exc:
+            out["devices"][str(c)] = {
+                "error": f"{type(exc).__name__}: {exc}"}
+    rates = [(c, out["devices"][str(c)]["mesh_sigs_per_sec"])
+             for c in counts
+             if isinstance(out["devices"].get(str(c)), dict)
+             and "mesh_sigs_per_sec" in out["devices"][str(c)]]
+    if len(rates) >= 2:
+        out["monotonic"] = all(b[1] >= a[1] for a, b in
+                               zip(rates, rates[1:]))
+        base_c, base_r = rates[0]
+        max_c, max_r = rates[-1]
+        out["max_devices"] = max_c
+        # efficiency vs linear scaling from the smallest count
+        out["scaling_efficiency_at_max"] = round(
+            (max_r / base_r) / (max_c / base_c), 4)
+    _beat("mesh_phase_done",
+          monotonic=out.get("monotonic"),
+          efficiency=out.get("scaling_efficiency_at_max"))
+
+
 def _epoch_transition_phase(deadline):
     """Altair epoch transition on a synthetic large-validator state —
     the reference's EpochTransitionBenchmark surface (eth-benchmark-
@@ -1087,6 +1222,11 @@ def trajectory_entry(out: dict, run_id: str) -> dict:
         "critical_p50_ms_worst")
     entry["mainnet_dedup_ratio_min"] = mainnet.get(
         "committee_dedup_ratio_min")
+    mesh_block = out.get("mesh") or {}
+    entry["mesh_monotonic"] = mesh_block.get("monotonic")
+    entry["mesh_series"] = mesh_block.get("series")
+    entry["mesh_scaling_efficiency"] = mesh_block.get(
+        "scaling_efficiency_at_max")
     return entry
 
 
@@ -1204,6 +1344,14 @@ def main():
             WD.disarm()
         except Exception as exc:
             OUT["dedup_error"] = f"{type(exc).__name__}: {exc}"
+    if os.environ.get("BENCH_MESH", "1") != "0" \
+            and run_throughput and time.time() < deadline:
+        try:
+            WD.arm(max(deadline - time.time(), 60) + 600, "mesh phase")
+            _mesh_phase(jax, deadline)
+            WD.disarm()
+        except Exception as exc:
+            OUT["mesh_error"] = f"{type(exc).__name__}: {exc}"
     if os.environ.get("BENCH_OVERLOAD", "1") != "0":
         try:
             # virtual-clock phase: a few wall seconds per factor, so

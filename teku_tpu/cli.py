@@ -122,6 +122,29 @@ _MONT_PATHS = ("vpu", "mxu", "auto", "mxu-force")
 _MSM_PATHS = ("ladder", "pippenger", "auto")
 
 
+def _validate_mesh(choice: str) -> str:
+    """`--mesh {off,auto,N}`: off | auto | a positive device count.
+
+    YAML parses bare off/on/no/yes as booleans before this layer sees
+    them, so the boolean spellings normalize instead of failing boot
+    (the mesh knob must never be able to fail a node)."""
+    if choice in ("off", "auto"):
+        return choice
+    if choice in ("false", "no", "none", "0", ""):
+        return "off"
+    if choice in ("true", "on", "yes"):
+        return "auto"
+    try:
+        n = int(choice)
+        if n < 1:
+            raise ValueError
+    except ValueError:
+        raise SystemExit(
+            f"invalid --mesh {choice!r} (use off, auto, or a positive "
+            "device count)")
+    return str(n)
+
+
 def _configure_kernel(args, yaml_cfg):
     """Kernel-layer knobs that must be decided BEFORE jax loads:
 
@@ -156,12 +179,26 @@ def _configure_kernel(args, yaml_cfg):
         raise SystemExit(f"invalid --msm-path {msm_choice!r} (use one "
                          f"of {'/'.join(_MSM_PATHS)})")
     os.environ["TEKU_TPU_MSM"] = msm_choice
+    # multi-chip mesh (`--mesh {off,auto,N}` / TEKU_TPU_MESH): resolved
+    # to a device mesh by the loader's probe (teku_tpu/parallel — auto
+    # takes the largest pow-2 <= available devices, a non-pow-2 or
+    # over-sized N demotes with one WARN instead of failing boot).  An
+    # EXPLICIT numeric N also forces N virtual host devices so a
+    # CPU-fallback node (or devnet) genuinely shards — this XLA flag
+    # must be set before jax loads, which is why it lives here; it
+    # only affects the host platform, never real TPU device counts.
+    mesh_choice = _validate_mesh(str(layered_value(
+        "mesh", getattr(args, "mesh", None), yaml_cfg, "off")).lower())
+    os.environ["TEKU_TPU_MESH"] = mesh_choice
+    if mesh_choice not in ("off", "auto") and int(mesh_choice) > 1:
+        from .infra.env import ensure_virtual_devices
+        ensure_virtual_devices(int(mesh_choice))
     compilecache.configure()
-    return choice, msm_choice
+    return choice, msm_choice, mesh_choice
 
 
 def _configure_bls(args, yaml_cfg, *, supervise: bool = True,
-                   mont_path=None, msm_path=None):
+                   mont_path=None, msm_path=None, mesh=None):
     """Choose the BLS bring-up shape BEFORE any service starts.
 
     ``auto`` (the default) and ``supervised`` boot the node immediately
@@ -177,14 +214,15 @@ def _configure_bls(args, yaml_cfg, *, supervise: bool = True,
     if choice in ("auto", "supervised") and supervise:
         loader.configure("supervised")      # oracle serves from slot 0
         supervisor = loader.make_supervisor(mont_path=mont_path,
-                                            msm_path=msm_path)
+                                            msm_path=msm_path,
+                                            mesh=mesh)
         print("BLS implementation: pure (supervised device bring-up "
               "in background)")
         return "supervised", supervisor
     try:
         name = loader.configure("pure" if choice == "supervised"
                                 else choice, mont_path=mont_path,
-                                msm_path=msm_path)
+                                msm_path=msm_path, mesh=mesh)
     except loader.BlsLoadError as exc:
         raise SystemExit(f"BLS preflight failed: {exc}")
     print(f"BLS implementation: {name}")
@@ -210,10 +248,10 @@ def cmd_node(args) -> int:
     # + flight-recorder JSONL dump on fatal crash (infra/flightrecorder)
     from .infra import flightrecorder
     flightrecorder.install_crash_hooks()
-    mont_path, msm_path = _configure_kernel(args, yaml_cfg)
+    mont_path, msm_path, mesh = _configure_kernel(args, yaml_cfg)
     _, bls_supervisor = _configure_bls(args, yaml_cfg,
                                        mont_path=mont_path,
-                                       msm_path=msm_path)
+                                       msm_path=msm_path, mesh=mesh)
     network = layered_value("network", args.network, yaml_cfg, "minimal")
     port = int(layered_value("p2p-port", args.p2p_port, yaml_cfg, 0, int))
     rest_port = int(layered_value("rest-port", args.rest_port, yaml_cfg,
@@ -422,9 +460,9 @@ def cmd_devnet(args) -> int:
     _configure_log_format(args, {})
     _configure_tracing(args, {})
     _configure_overload(args, {})
-    mont_path, msm_path = _configure_kernel(args, {})
+    mont_path, msm_path, mesh = _configure_kernel(args, {})
     _, bls_supervisor = _configure_bls(args, {}, mont_path=mont_path,
-                                       msm_path=msm_path)
+                                       msm_path=msm_path, mesh=mesh)
 
     async def run():
         net = Devnet(n_nodes=args.nodes, n_validators=args.validators)
@@ -719,9 +757,9 @@ def cmd_validator_client(args) -> int:
     # the VC's hot path is signing (host-side); no background bring-up
     _configure_log_format(args, {})
     _configure_tracing(args, {})
-    mont_path, msm_path = _configure_kernel(args, {})
+    mont_path, msm_path, mesh = _configure_kernel(args, {})
     _configure_bls(args, {}, supervise=False, mont_path=mont_path,
-                   msm_path=msm_path)
+                   msm_path=msm_path, mesh=mesh)
     spec = create_spec(args.network or "minimal")
     remote = RemoteValidatorApi(spec, args.beacon_node)
     genesis = remote._get_json("/eth/v1/beacon/genesis")["data"]
@@ -884,6 +922,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "is a TPU and the batch clears the "
                         "duplication crossover; see PERF.md).  Env: "
                         "TEKU_TPU_MSM")
+    n.add_argument("--mesh", default=None, metavar="{off,auto,N}",
+                   help="multi-chip verify mesh: off (default, "
+                        "single-device dispatch), auto (largest pow-2 "
+                        "<= available devices), or an explicit device "
+                        "count N (non-pow-2/over-sized N demotes with "
+                        "one warning; numeric N also forces N virtual "
+                        "host devices on CPU fallback).  The "
+                        "dedup-aware pipeline shards group-aligned: "
+                        "each chip owns whole message groups.  Env: "
+                        "TEKU_TPU_MESH")
     n.add_argument("--overload-control", default=None,
                    choices=["on", "off"],
                    help="adaptive batching + priority classes + "
@@ -913,6 +961,7 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["vpu", "mxu", "auto"])
     d.add_argument("--msm-path", default=None,
                    choices=["ladder", "pippenger", "auto"])
+    d.add_argument("--mesh", default=None, metavar="{off,auto,N}")
     d.add_argument("--tracing", default=None, choices=["on", "off"])
     d.add_argument("--overload-control", default=None,
                    choices=["on", "off"])
@@ -970,6 +1019,7 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=["vpu", "mxu", "auto"])
     vc.add_argument("--msm-path", default=None,
                     choices=["ladder", "pippenger", "auto"])
+    vc.add_argument("--mesh", default=None, metavar="{off,auto,N}")
     vc.add_argument("--tracing", default=None, choices=["on", "off"])
     vc.add_argument("--log-format", default=None,
                     choices=["text", "json"])

@@ -57,7 +57,7 @@ class BlsLoadError(RuntimeError):
 
 
 def _probe_jax(max_batch: int, min_bucket: int, mont_path=None,
-               msm_path=None):
+               msm_path=None, mesh=None):
     """Instantiate the device provider and prove the backend executes:
     one pubkey-validation dispatch (the small program; the five staged
     verify programs compile lazily on first real batch).
@@ -66,10 +66,14 @@ def _probe_jax(max_batch: int, min_bucket: int, mont_path=None,
     (vpu | mxu | auto, ops/mxu.py) and `msm_path` the scalars-stage
     choice (ladder | pippenger | auto, ops/msm.py) BEFORE any kernel
     traces — the seams the CLI's `--mont-path`/`--msm-path` thread
-    through.  The warmup batches downstream then compile whichever
-    scalars program the resolved path dispatches (the dup-8 committee
-    warmup is the shape `auto` sends to pippenger) off the gossip
-    path."""
+    through.  `mesh` (off | auto | N, CLI `--mesh` / TEKU_TPU_MESH;
+    None reads the env) resolves to the largest pow-2 device count
+    available (teku_tpu/parallel.resolve_mesh_devices — an
+    over-ambitious N demotes with one WARN, never fails bring-up) and
+    constructs JaxBls12381(mesh=...) so production dispatches shard
+    group-aligned across the chips.  The warmup batches downstream
+    then compile the resolved (mesh x scalars-path) shape set off the
+    gossip path."""
     from ...ops import msm, mxu
     from ...ops.provider import JaxBls12381
 
@@ -77,11 +81,22 @@ def _probe_jax(max_batch: int, min_bucket: int, mont_path=None,
         mxu.set_path(mont_path)
     if msm_path is not None:
         msm.set_path(msm_path)
-    impl = JaxBls12381(max_batch=max_batch, min_bucket=min_bucket)
+    if mesh is None:
+        mesh = os.environ.get("TEKU_TPU_MESH", "off")
+    from ... import parallel
+    mesh_obj = None
+    n_mesh = parallel.resolve_mesh_devices(mesh)
+    if n_mesh >= 2:
+        mesh_obj = parallel.make_mesh(n_mesh)
+    impl = JaxBls12381(max_batch=max_batch, min_bucket=min_bucket,
+                       mesh=mesh_obj)
     if not impl.public_key_is_valid(_PROBE_PK):
         raise BlsLoadError("device probe rejected the generator pubkey")
     import jax
-    return impl, str(jax.devices()[0])
+    device = str(jax.devices()[0])
+    if impl.mesh_info:
+        device = f"mesh[{impl.mesh_info['n_devices']}] {device}"
+    return impl, device
 
 
 # --------------------------------------------------------------------------
@@ -248,6 +263,7 @@ def make_supervisor(*, max_batch: int = 256, min_bucket: int = 16,
                     breaker: Optional[CircuitBreaker] = None,
                     warm: bool = True, mont_path: Optional[str] = None,
                     msm_path: Optional[str] = None,
+                    mesh: Optional[str] = None,
                     **supervisor_kw) -> BackendSupervisor:
     """Build the production BackendSupervisor: boot-on-oracle now,
     background JAX bring-up, breaker-guarded hot-swap at READY for both
@@ -280,7 +296,7 @@ def make_supervisor(*, max_batch: int = 256, min_bucket: int = 16,
 
     def probe():
         return _probe_jax(max_batch, min_bucket, mont_path=mont_path,
-                          msm_path=msm_path)
+                          msm_path=msm_path, mesh=mesh)
 
     def warmup(backend):
         if not warm:
@@ -338,6 +354,10 @@ def make_supervisor(*, max_batch: int = 256, min_bucket: int = 16,
             _LOG.warning("device KZG backend unavailable: %s", exc)
         if supervisor_box:
             supervisor_box[0].backend_detail = device
+            # the readiness snapshot must self-describe the mesh (which
+            # devices, how many, which axis) — MULTICHIP runs and
+            # multi-node operators read it from /teku/v1/admin/readiness
+            supervisor_box[0].mesh = getattr(impl, "mesh_info", None)
         _LOG.info("BLS implementation hot-swapped: %s on %s "
                   "(breaker deadline %.1fs)", impl.name, device,
                   breaker.deadline_s)
@@ -345,6 +365,11 @@ def make_supervisor(*, max_batch: int = 256, min_bucket: int = 16,
     def uninstall():
         reset_implementation()
         _reset_kzg_backend()
+        if supervisor_box:
+            # no installed backend => no serving mesh: the name-
+            # prefixed gauge and readiness snapshot must not keep
+            # advertising a mesh the oracle is serving for
+            supervisor_box[0].mesh = None
 
     def reprobe():
         # synthetic known-good dispatch for supervisor-driven half-open
@@ -366,6 +391,15 @@ def make_supervisor(*, max_batch: int = 256, min_bucket: int = 16,
         reprobe=reprobe, breaker=breaker, name=name, registry=registry,
         **supervisor_kw)
     supervisor_box.append(sup)
+    # supervisor-name-prefixed mesh gauge (multi-node devnets keep the
+    # series distinct, like the admission controller's families): the
+    # device count of the mesh THIS supervisor's backend dispatches
+    # over — 0 until a mesh backend installs
+    registry.gauge(
+        f"{name}_mesh_devices",
+        "device count of this supervisor's installed verify mesh "
+        "(0 = single-device or not yet installed)",
+        supplier=lambda: float((sup.mesh or {}).get("n_devices", 0)))
     return sup
 
 
@@ -446,7 +480,8 @@ def configure(choice: str = "auto", *, max_batch: int = 256,
               min_bucket: int = 16,
               probe_timeout_s: Optional[float] = None,
               mont_path: Optional[str] = None,
-              msm_path: Optional[str] = None) -> str:
+              msm_path: Optional[str] = None,
+              mesh: Optional[str] = None) -> str:
     """Install the BLS provider for this process; returns its name.
 
     auto: try the JAX/TPU provider under a deadline, fall back to the
@@ -475,7 +510,7 @@ def configure(choice: str = "auto", *, max_batch: int = 256,
         try:
             result["ok"] = _probe_jax(max_batch, min_bucket,
                                       mont_path=mont_path,
-                                      msm_path=msm_path)
+                                      msm_path=msm_path, mesh=mesh)
         except BaseException as exc:  # noqa: BLE001 - report any failure
             result["err"] = exc
 

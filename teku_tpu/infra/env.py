@@ -19,3 +19,23 @@ def env_int(name: str, default: int) -> int:
         return int(os.environ.get(name, default))
     except (TypeError, ValueError):
         return default
+
+
+def ensure_virtual_devices(n) -> bool:
+    """Make sure XLA's host (CPU) platform exposes `n` virtual devices
+    by appending ``--xla_force_host_platform_device_count=n`` to
+    XLA_FLAGS — ONE definition for the CLI's numeric ``--mesh N``, the
+    bench mesh phase and the driver's multichip dryrun.
+
+    Must run BEFORE jax imports (the flag is read at backend init);
+    an already-present count is left untouched (the caller's
+    environment wins).  Harmless on real TPU hosts — the flag only
+    affects the host platform.  Returns True when the flag was added.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        return False
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={int(n)}"
+    ).strip()
+    return True

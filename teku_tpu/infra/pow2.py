@@ -1,0 +1,19 @@
+"""Pow-2 bucket arithmetic — ONE definition for every padding rule.
+
+The provider's dispatch bucketing, the admission controller's batch
+planner and the mesh shard planner all pad to powers of two so jitted
+shapes stay static; a future change to the rule (e.g. an upper clamp)
+must change in one place or the planners silently disagree on bucket
+widths (the same hoisting argument as infra/env.py's shared readers).
+"""
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (1 for n <= 1)."""
+    return 1 << max(0, (int(n) - 1).bit_length())
+
+
+def floor_pow2(n: int) -> int:
+    """Largest power of two <= n (1 for n <= 1)."""
+    n = int(n)
+    return 1 << max(0, n.bit_length() - 1) if n >= 1 else 1

@@ -313,6 +313,10 @@ class BackendSupervisor(Service):
         self.warmup_deadline_s = warmup_deadline_s
         self.backend = None
         self.backend_detail: str = ""
+        # optional mesh self-description ({devices, n_devices, axis})
+        # the loader's install hook stamps for multi-chip backends —
+        # surfaced in snapshot() so readiness self-describes the mesh
+        self.mesh: Optional[dict] = None
         # WARMING's compile-cache verdict ({"hits", "misses", "s"}):
         # a warm boot shows hits>0, misses==0 — the multi-minute
         # per-shape compiles were served from disk
@@ -407,6 +411,8 @@ class BackendSupervisor(Service):
             out["circuit"] = self.breaker.state
         if self.warmup_cache:
             out["warmup_cache"] = self.warmup_cache
+        if self.mesh:
+            out["mesh"] = self.mesh
         return out
 
     async def wait_ready(self, timeout_s: Optional[float] = None) -> bool:

@@ -52,8 +52,11 @@ DISPATCH (the crossover is shape-dependent):
   overhead.  Why auto resolves this way is measured + documented in
   PERF.md.
 
-The sharded (multi-chip) kernel always takes the ladder: bucketing is
-a per-message-group operation and groups cross shard boundaries.
+The LEGACY lane-sharded kernel always takes the ladder (bucketing is a
+per-message-group operation and raw lane shards split groups) —
+``resolve(sharded=True)`` keeps that contract.  The production
+GROUP-ALIGNED mesh kernel (verify_kernel_sharded_grouped) keeps whole
+groups per shard, so its dispatches resolve by shape like any other.
 """
 
 import logging
@@ -130,9 +133,12 @@ def resolve(lanes=None, rows=None, sharded: bool = False) -> str:
 
     `lanes`/`rows` are the dispatch's real lane count and Miller-row
     count (their ratio is the duplication factor the crossover model
-    keys on); `auto` without shape context resolves to the ladder."""
+    keys on); `auto` without shape context resolves to the ladder.
+    `sharded=True` means the LEGACY lane-sharded kernel (always
+    ladders — raw lane shards split message groups); the group-aligned
+    mesh path resolves with sharded=False."""
     if sharded:
-        return "ladder"          # grouping crosses shard boundaries
+        return "ladder"          # lane shards split message groups
     configured = get_path()
     if configured in ("ladder", "pippenger"):
         return configured

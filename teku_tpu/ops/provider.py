@@ -28,6 +28,14 @@ bilinearity (ops/verify.py:stage_group).  begin_batch_verify exposes
 the async seam the batching service uses to overlap host_prep of the
 next batch with the in-flight device execute.
 
+MESH: constructed with mesh=..., dispatches shard GROUP-ALIGNED
+across the chips (teku_tpu/parallel.GroupShardedVerifier): whole
+message-group rows per shard, lanes permuted to follow their rows, so
+the dedup pipeline (unique-message h2c, grouped Miller rows, the
+Pippenger MSM) survives the mesh; one all_gather of per-device
+partials crosses the ICI and the verdict contract is unchanged
+(lane_ok un-permutes at the sync point).
+
 Batch sizes (and the per-lane key-count axis) are padded to powers of
 two so the jit cache stays small and shapes stay static (XLA recompiles
 nothing after warm-up).
@@ -118,6 +126,16 @@ _M_MSM_LANES = GLOBAL_REGISTRY.labeled_counter(
     "real lanes dispatched by resolved scalars-stage path",
     labelnames=("path",))
 
+# Mesh observability: sharded dispatches labeled by device count (a
+# closed pow-2 vocabulary — the resolver only ever yields pow-2 mesh
+# sizes, linted in test_metrics_exposition); the companion
+# bls_mesh_devices gauge lives in teku_tpu/parallel.
+_M_MESH_DISPATCH = GLOBAL_REGISTRY.labeled_counter(
+    "bls_mesh_dispatch_total",
+    "verify dispatches served by the group-aligned sharded mesh "
+    "kernel, by mesh device count",
+    labelnames=("devices",))
+
 
 def _dedup_ratio() -> float:
     # read unique BEFORE lanes (writers inc lanes first): a dispatch
@@ -156,11 +174,9 @@ GLOBAL_REGISTRY.gauge(
     supplier=_padding_waste)
 
 
-def _next_pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p *= 2
-    return p
+# one shared definition of the padding rule (infra/pow2.py) — the
+# admission planner and mesh shard planner pad with the same function
+from ..infra.pow2 import next_pow2 as _next_pow2  # noqa: E402
 
 
 def bytes_to_limbs_np(b: np.ndarray) -> np.ndarray:
@@ -216,9 +232,11 @@ class _DispatchHandle:
     """
 
     __slots__ = ("_ok", "_lane_ok", "_n", "_traces", "_done",
-                 "_verdict", "_shape", "_path", "_t_enq_end")
+                 "_verdict", "_shape", "_path", "_t_enq_end",
+                 "_lane_sel")
 
-    def __init__(self, ok, lane_ok, n, traces, shape, path, t_enq_end):
+    def __init__(self, ok, lane_ok, n, traces, shape, path, t_enq_end,
+                 lane_sel=None):
         self._ok = ok
         self._lane_ok = lane_ok
         self._n = n
@@ -226,6 +244,10 @@ class _DispatchHandle:
         self._shape = shape
         self._path = path
         self._t_enq_end = t_enq_end
+        # mesh dispatches PERMUTE lanes into group-aligned shard
+        # blocks: lane_sel maps original lane i -> its slot in the
+        # dispatched layout, so the verdict reads the right lanes
+        self._lane_sel = lane_sel
         self._done = False
         self._verdict = False
 
@@ -238,8 +260,10 @@ class _DispatchHandle:
             # np.asarray forces the device round-trip: this wait (and
             # nothing else) is the device_sync stage
             lane_ok = np.asarray(self._lane_ok)
-            verdict = bool(np.asarray(self._ok)) \
-                and bool(lane_ok[:self._n].all())
+            real = (lane_ok[self._lane_sel]
+                    if self._lane_sel is not None
+                    else lane_ok[:self._n])
+            verdict = bool(np.asarray(self._ok)) and bool(real.all())
         finally:
             t_end = time.perf_counter()
             tracing.record_stage("device_sync", t_end - t_sync0,
@@ -300,13 +324,19 @@ class JaxBls12381(BLS12381):
                  min_bucket: int = 4, mesh=None):
         self._pure = PureBls12381()
         self.max_batch = max_batch
-        # optional multi-chip dispatch: lanes shard over the mesh's dp
-        # axis, partial products ride one all_gather (teku_tpu/parallel)
+        # optional multi-chip dispatch: GROUP-ALIGNED sharding over the
+        # mesh's dp axis — every shard owns whole message-group rows,
+        # so the dedup pipeline (unique-message Miller grouping, the
+        # Pippenger MSM) survives the mesh; partial products ride one
+        # all_gather (teku_tpu/parallel.GroupShardedVerifier)
         self._sharded = None
+        self.mesh_info = None
         if mesh is not None:
-            from ..parallel import ShardedVerifier
-            self._sharded = ShardedVerifier(mesh, min_bucket=min_bucket)
+            from ..parallel import GroupShardedVerifier
+            self._sharded = GroupShardedVerifier(mesh,
+                                                 min_bucket=min_bucket)
             min_bucket = self._sharded.min_bucket
+            self.mesh_info = self._sharded.describe()
         self.max_keys_per_lane = max_keys_per_lane
         # tiny batches pad up to one shared bucket: a couple of masked
         # lanes cost microseconds on device, a fresh XLA compile costs
@@ -614,39 +644,20 @@ class JaxBls12381(BLS12381):
         self.dispatch_count += 1
         self.lanes_dispatched += n
         with tracing.span("host_prep"):
-            padded = max(_next_pow2(n), self.min_bucket)
             kmax = _next_pow2(max(len(s.pk_limbs) for s in semis))
-            pk_xs = np.zeros((padded, kmax, fp.L), dtype=np.int64)
-            pk_ys = np.zeros((padded, kmax, fp.L), dtype=np.int64)
-            pk_present = np.zeros((padded, kmax), dtype=bool)
-            sig_bytes = np.zeros((padded, 2, 48), dtype=np.uint8)
-            s_large = np.zeros(padded, dtype=bool)
-            s_inf = np.zeros(padded, dtype=bool)
-            lane_valid = np.zeros(padded, dtype=bool)
             # unique-message index + per-message lane groups: h2c AND
             # the Miller loops run at unique width (stage_group folds a
-            # message's lanes into one pairing input via bilinearity);
-            # padding lanes keep index 0 — masked downstream
-            lane_map = np.zeros(padded, dtype=np.int32)
+            # message's lanes into one pairing input via bilinearity)
             uniq_index: dict = {}
             uniq_msgs: List[bytes] = []
             groups: List[List[int]] = []
             for i, s in enumerate(semis):
-                for j, (x, y) in enumerate(s.pk_limbs):
-                    pk_xs[i, j] = x
-                    pk_ys[i, j] = y
-                    pk_present[i, j] = True
                 u = uniq_index.get(s.message)
                 if u is None:
                     u = uniq_index[s.message] = len(uniq_msgs)
                     uniq_msgs.append(s.message)
                     groups.append([])
                 groups[u].append(i)
-                lane_map[i] = u
-                sig_bytes[i] = s.sig_x_bytes
-                s_large[i] = s.sig_large
-                s_inf[i] = s.sig_inf
-                lane_valid[i] = True
             # split committees larger than the group cap across rows:
             # G stays bounded (the grouped gather materializes a
             # (U, G) lane matrix) and a split message simply owns
@@ -657,29 +668,77 @@ class JaxBls12381(BLS12381):
                 for off in range(0, len(g), cap):
                     rows.append((u, g[off:off + cap]))
             row_msgs = [uniq_msgs[u] for u, _ in rows]
-            # lane gather (sharded path) keys on hm ROWS: point every
-            # lane at the first row carrying its message's point
-            msg_to_row = np.zeros(len(uniq_msgs), dtype=np.int32)
-            for r in range(len(rows) - 1, -1, -1):
-                msg_to_row[rows[r][0]] = r
-            lane_map = msg_to_row[lane_map]
-            u_bucket = max(_next_pow2(len(rows)), self._h2c_min_bucket)
             g_bucket = _next_pow2(max(len(g) for _, g in rows))
-            group_idx = np.zeros((u_bucket, g_bucket), dtype=np.int32)
-            group_present = np.zeros((u_bucket, g_bucket), dtype=bool)
-            for r, (_, g) in enumerate(rows):
-                group_idx[r, :len(g)] = g
-                group_present[r, :len(g)] = True
+            # canonical unique bucket: the h2c dispatch / H(m) arena
+            # width.  Computed from the batch alone — IDENTICAL for
+            # single-device and mesh dispatch of the same batch, so
+            # the dedup counters and h2c dispatch count cannot depend
+            # on the mesh (pinned in tests/test_mesh_grouped.py)
+            u_hm = max(_next_pow2(len(rows)), self._h2c_min_bucket)
+            if self._sharded is not None:
+                # group-aligned shard layout: whole rows per shard,
+                # lanes permuted into each shard's contiguous block
+                plan = self._sharded.plan(
+                    rows, n, min_rows_total=self._h2c_min_bucket)
+                padded = plan.padded
+                u_total = plan.rows_total
+                lane_pos = plan.lane_pos
+            else:
+                plan = None
+                padded = max(_next_pow2(n), self.min_bucket)
+                u_total = u_hm
+                lane_pos = None
+            pk_xs = np.zeros((padded, kmax, fp.L), dtype=np.int64)
+            pk_ys = np.zeros((padded, kmax, fp.L), dtype=np.int64)
+            pk_present = np.zeros((padded, kmax), dtype=bool)
+            sig_bytes = np.zeros((padded, 2, 48), dtype=np.uint8)
+            s_large = np.zeros(padded, dtype=bool)
+            s_inf = np.zeros(padded, dtype=bool)
+            lane_valid = np.zeros(padded, dtype=bool)
+            for i, s in enumerate(semis):
+                p = i if lane_pos is None else int(lane_pos[i])
+                for j, (x, y) in enumerate(s.pk_limbs):
+                    pk_xs[p, j] = x
+                    pk_ys[p, j] = y
+                    pk_present[p, j] = True
+                sig_bytes[p] = s.sig_x_bytes
+                s_large[p] = s.sig_large
+                s_inf[p] = s.sig_inf
+                lane_valid[p] = True
+            group_idx = np.zeros((u_total, g_bucket), dtype=np.int32)
+            group_present = np.zeros((u_total, g_bucket), dtype=bool)
+            row_gather = None
+            if plan is None:
+                for r, (_, g) in enumerate(rows):
+                    group_idx[r, :len(g)] = g
+                    group_present[r, :len(g)] = True
+            else:
+                # group_idx carries SHARD-LOCAL lane indices (under
+                # shard_map each shard sees only its own lane block);
+                # row_gather scatters the canonical H(m) rows into the
+                # shard layout (padding rows gather slot 0 — masked)
+                row_gather = np.zeros(u_total, dtype=np.int32)
+                for pos, r in enumerate(plan.row_layout):
+                    if r < 0:
+                        continue
+                    g = rows[r][1]
+                    base = ((pos // plan.rows_per_shard)
+                            * plan.lanes_per_shard)
+                    group_idx[pos, :len(g)] = \
+                        lane_pos[np.asarray(g)] - base
+                    group_present[pos, :len(g)] = True
+                    row_gather[pos] = r
             sx1 = bytes_to_limbs_np(sig_bytes[:, 0])
             sx0 = bytes_to_limbs_np(sig_bytes[:, 1])
             # scalars-stage path: the per-lane windowed ladder (64-bit
             # multipliers) or the GLV+Pippenger bucketed MSM (32-bit
             # half-scalar pairs, ops/msm.py).  Resolved per dispatch —
             # `auto` keys on the duplication factor (lanes per Miller
-            # row); the sharded kernel always ladders (grouping
-            # crosses shard boundaries)
-            msm_path = msm.resolve(lanes=n, rows=len(rows),
-                                   sharded=self._sharded is not None)
+            # row).  The GROUP-ALIGNED mesh kernel supports both
+            # (groups never cross shards); msm.resolve(sharded=True)
+            # remains the LEGACY lane-sharded kernel's always-ladder
+            # contract and is not used here
+            msm_path = msm.resolve(lanes=n, rows=len(rows))
             r_bits = glv_digits = None
             if randomize:
                 # one os-entropy draw for the whole batch (the
@@ -708,8 +767,15 @@ class JaxBls12381(BLS12381):
             # H(m) host half (digests + cache lookups + field draws)
             # belongs to host_prep; only the dispatch/gather below is
             # device work
-            hm_plan = self._hm_host_plan(row_msgs, u_bucket)
-        shape = f"{padded}x{kmax}"
+            hm_plan = self._hm_host_plan(row_msgs, u_hm)
+        mesh_n = (self._sharded.n_devices
+                  if self._sharded is not None else 0)
+        # mesh dispatches get their own shape family (the capacity
+        # model's latency series must not blend an 8-chip program with
+        # the single-device one; latency_for_lanes prefix-matches
+        # "{lanes}x" so the admission planner still sees mesh-shaped
+        # device latencies for its batch sizing)
+        shape = f"{padded}x{kmax}" + (f"@m{mesh_n}" if mesh_n else "")
         # the staged jits are module-level (shared across providers),
         # but a ShardedVerifier's jit cache is per-instance — key the
         # seen-set on the kernel that will actually serve the dispatch
@@ -735,6 +801,8 @@ class JaxBls12381(BLS12381):
         _M_MSM.labels(path=msm_path).inc()
         _M_MSM_LANES.labels(path=msm_path).inc(n)
         self.msm_dispatches[msm_path] += 1
+        if mesh_n:
+            _M_MESH_DISPATCH.labels(devices=str(mesh_n)).inc()
         # device section: every launch below is async (XLA compiles
         # synchronously on a first shape, then enqueues); the enqueue
         # span ends when the launches return, and the handle's
@@ -745,14 +813,22 @@ class JaxBls12381(BLS12381):
         try:
             hm_uniq = self._hm_device(hm_plan)
             if self._sharded is not None:
-                # the sharded kernel is hm-INPUT (grouping by message
-                # would cross shard boundaries): scatter the unique
-                # points back into lanes with one gather
-                hm = V.staged_jits()["gather"](hm_uniq,
-                                               jnp.asarray(lane_map))
-                ok, lane_ok = self._sharded(
-                    pk_xs, pk_ys, pk_present, hm, (sx0, sx1),
-                    s_large, s_inf, r_bits, lane_valid)
+                # `bls.mesh_shard` fault site: a wedged SHARD wedges
+                # the whole mesh dispatch — the harness arms a hang
+                # here and the breaker must trip the entire mesh
+                # backend to oracle fallback
+                faults.check("bls.mesh_shard")
+                # scatter the canonical H(m) rows into the shard
+                # layout with one gather, then the group-aligned
+                # kernel runs the full dedup pipeline per shard
+                hm_rows = V.staged_jits()["gather"](
+                    hm_uniq, jnp.asarray(row_gather))
+                scalars = (glv_digits if msm_path == "pippenger"
+                           else r_bits)
+                ok, lane_ok = self._sharded.kernel(msm_path)(
+                    pk_xs, pk_ys, pk_present, hm_rows, group_idx,
+                    group_present, (sx0, sx1), s_large, s_inf,
+                    scalars, lane_valid)
             elif msm_path == "pippenger":
                 ok, lane_ok = V.verify_staged_pippenger(
                     pk_xs, pk_ys, pk_present, hm_uniq, group_idx,
@@ -782,4 +858,5 @@ class JaxBls12381(BLS12381):
         lat_path = (mont_path if msm_path == "ladder"
                     else f"{mont_path}+pip")
         return _DispatchHandle(ok, lane_ok, n, traces, shape,
-                               lat_path, t_enq_end)
+                               lat_path, t_enq_end,
+                               lane_sel=lane_pos)

@@ -385,10 +385,93 @@ def verify_staged(pk_xs, pk_ys, pk_present, u0, u1, group_idx,
                                  on_stage=on_stage)
 
 
+def verify_kernel_sharded_grouped(mesh, axis: str = "dp",
+                                  msm_path: str = "ladder"):
+    """Multi-chip variant of the DEDUP-AWARE pipeline: message groups
+    are the sharding unit, so every chip keeps the unique-message
+    Miller grouping (and, with ``msm_path="pippenger"``, the bucketed
+    MSM scalars stage) that the lane-sharded kernel forfeits.
+
+    GROUP-ALIGNED contract (the provider's shard planner,
+    teku_tpu/parallel.plan_group_shards, builds these layouts):
+
+    - lanes are PERMUTED so each shard's lane block holds exactly the
+      lanes of the message-group rows that shard owns (a group never
+      crosses a shard boundary); lane-sharded inputs: pk_xs/pk_ys
+      (N, K, L), pk_present (N, K), sig_x ((N, L), (N, L)), sig_large/
+      sig_inf/lane_valid (N,), and the scalars array — r_bits (N, 64)
+      on the ladder path, glv_digits (N, 2, nwin) on the pippenger
+      path;
+    - group rows are ROW-sharded: hm_rows (the per-row H(m) affine
+      tree, (U, L) leaves), group_idx (U, G) of SHARD-LOCAL lane
+      indices, group_present (U, G).  Padding rows aggregate to
+      infinity and mask themselves out of the Miller stage, so empty
+      shards contribute exactly the identity.
+
+    Per shard: prepare -> scalars+group (ladder) or the fused
+    Pippenger MSM -> Miller loops at LOCAL row width -> local Fq12
+    product + local G2 weighted-signature sum; then ONE all_gather of
+    those two tiny partials crosses the ICI and the final
+    exponentiation is replicated.  Returns (ok, lane_ok) with lane_ok
+    in the PERMUTED lane order (callers un-permute on the host).
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    lane = P(axis)
+    lane2 = P(axis, None)        # (N, L) / (N, 64) / (N, K)
+    lane3 = P(axis, None, None)  # (N, K, L) / (N, 2, nwin)
+    row2 = P(axis, None)         # (U, G) and the (U, L) hm leaves
+    pippenger = msm_path == "pippenger"
+
+    def shard_fn(pk_xs, pk_ys, pk_present, hm_rows, group_idx,
+                 group_present, sig_x, sig_large, sig_inf, scalars,
+                 lane_valid):
+        pk_jac, sig_jac, lane_ok, miller_mask = stage_prepare(
+            pk_xs, pk_ys, pk_present, sig_x, sig_large, sig_inf,
+            lane_valid)
+        if pippenger:
+            agg_aff, u_mask, wsig = stage_scalars_pippenger(
+                pk_jac, sig_jac, scalars, group_idx, group_present,
+                miller_mask)
+        else:
+            pk_r_jac, wsig = stage_scalars(pk_jac, sig_jac, scalars)
+            agg_aff, u_mask = stage_group(pk_r_jac, miller_mask,
+                                          group_idx, group_present)
+        ml = stage_miller(agg_aff, hm_rows, u_mask)
+        local_prod = PR.batch_product(ml)
+        local_sum = point_batch_sum(PT.G2_KIT, wsig)
+        # the tiny per-device partials (one Fq12 value + one G2 point)
+        # are the ONLY cross-chip traffic; combine + finish replicated
+        gathered_prod = jax.tree_util.tree_map(
+            lambda x: jax.lax.all_gather(x, axis), local_prod)
+        gathered_sum = jax.tree_util.tree_map(
+            lambda x: jax.lax.all_gather(x, axis), local_sum)
+        ok = _finish(PR.batch_product(gathered_prod),
+                     point_batch_sum(PT.G2_KIT, gathered_sum))
+        return ok, lane_ok
+
+    in_specs = (lane3, lane3, lane2,
+                ((row2, row2), (row2, row2)),   # hm rows (affine x, y)
+                row2, row2,                     # group idx / present
+                (lane2, lane2), lane, lane,
+                lane3 if pippenger else lane2,  # glv digits | r bits
+                lane)
+    out_specs = (P(), lane)
+    return shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=False)
+
+
 def verify_kernel_sharded(mesh, axis: str = "dp"):
-    """Multi-chip variant: lanes sharded over `axis`, per-device local
-    reductions, then an all_gather of one Fq12 value + one G2 point per
-    device rides the ICI; the final exponentiation is replicated.
+    """LEGACY multi-chip variant: lanes sharded over `axis` with NO
+    message grouping (every lane pays its own Miller row — groups
+    would cross shard boundaries), per-device local reductions, then
+    an all_gather of one Fq12 value + one G2 point per device rides
+    the ICI; the final exponentiation is replicated.  The production
+    mesh path uses verify_kernel_sharded_grouped, which keeps the
+    dedup pipeline by making group rows the sharding unit; this form
+    remains the dryrun/CI harness kernel and the hm-input parity
+    surface.
 
     hm-INPUT contract: the caller supplies per-lane H(m) affine points
     (hash-to-curve over unique messages is a global operation — the

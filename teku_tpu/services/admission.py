@@ -109,8 +109,9 @@ def class_deadline_s(cls: VerifyClass) -> float:
         _DEADLINE_DEFAULT_MS[cls]) / 1e3
 
 
-def _next_pow2(n: int) -> int:
-    return 1 << max(0, (int(n) - 1).bit_length())
+from ..infra.pow2 import next_pow2 as _next_pow2  # noqa: E402 - the
+# shared padding rule (provider bucketing and the mesh shard planner
+# use the same definition)
 
 
 @dataclass(frozen=True)

@@ -275,6 +275,67 @@ def test_mainnet_gates_absent_are_skipped_and_thresholds():
         "status"] == "regression"
 
 
+def test_mesh_gates_on_fixtures():
+    """The PR-10 mesh acceptance gates: the device-count sweep must be
+    monotonic, and on real parallel hardware (series == "measured")
+    the efficiency at the max device count must hold >= 0.7x linear."""
+    base = bench_diff.load_result(BASE)
+    out = bench_diff.compare(base, base)
+    checks = _by_metric(out)
+    assert checks["mesh_monotonic"]["status"] == "ok"
+    assert checks["mesh_scaling_efficiency"]["status"] == "ok"
+
+    reg = bench_diff.load_result(REGRESSED)
+    out = bench_diff.compare(base, reg)
+    checks = _by_metric(out)
+    assert out["verdict"] == "regression"
+    assert checks["mesh_monotonic"]["status"] == "regression"
+    assert checks["mesh_scaling_efficiency"]["status"] == "regression"
+
+
+def test_mesh_gates_skip_when_missing_or_virtual():
+    """Skip-if-missing like every phase gate; on a serialized-virtual
+    sweep (one host, forced device count) the efficiency gate skips —
+    the per-device projection's Amdahl saturation is expected there —
+    while monotonicity of the projection is still gated.  The
+    threshold is operator-tunable."""
+    base = bench_diff.load_result(BASE)
+    stripped = {k: v for k, v in base.items() if k != "mesh"}
+    out = bench_diff.compare(base, stripped)
+    checks = _by_metric(out)
+    assert checks["mesh_monotonic"]["status"] == "skipped"
+    assert checks["mesh_scaling_efficiency"]["status"] == "skipped"
+    assert out["verdict"] == "pass"
+
+    virtual = dict(base)
+    virtual["mesh"] = dict(base["mesh"],
+                           series="projected_serialized_virtual",
+                           scaling_efficiency_at_max=0.35)
+    out = bench_diff.compare(base, virtual)
+    checks = _by_metric(out)
+    assert checks["mesh_scaling_efficiency"]["status"] == "skipped"
+    assert checks["mesh_monotonic"]["status"] == "ok"
+    # a non-monotonic virtual projection still fails
+    virtual["mesh"] = dict(virtual["mesh"], monotonic=False)
+    out = bench_diff.compare(base, virtual)
+    assert _by_metric(out)["mesh_monotonic"]["status"] == "regression"
+    # operator override tightens the measured gate past the fixture
+    out = bench_diff.compare(base, base,
+                             {"mesh_efficiency_min": 0.9})
+    assert _by_metric(out)["mesh_scaling_efficiency"]["status"] \
+        == "regression"
+    # trajectory entries carry the FLATTENED mesh fields: the gates
+    # read them with the standard fallback, like every other phase
+    flat = {"mesh_monotonic": True, "mesh_series": "measured",
+            "mesh_scaling_efficiency": 0.8}
+    checks = _by_metric(bench_diff.compare({}, flat))
+    assert checks["mesh_monotonic"]["status"] == "ok"
+    assert checks["mesh_scaling_efficiency"]["status"] == "ok"
+    flat["mesh_series"] = "projected_serialized_virtual"
+    assert _by_metric(bench_diff.compare({}, flat))[
+        "mesh_scaling_efficiency"]["status"] == "skipped"
+
+
 def test_phase_focused_run_zero_value_skips_relative_gates():
     """A control-plane-focused run (BENCH_THROUGHPUT=0) reports
     value=0.0 — that is 'phase did not run', never a measured

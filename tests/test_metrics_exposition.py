@@ -318,6 +318,43 @@ def test_msm_path_family_label_contract():
     assert tuple(msm.PATHS) == _MSM_PATHS
 
 
+def test_mesh_family_label_contract():
+    """The PR-10 mesh families must not drift: the sharded-dispatch
+    counter carries exactly one `devices` label whose values come from
+    the CLOSED pow-2 vocabulary resolve_mesh_devices can emit, the
+    process gauge is `bls_mesh_devices`, and supervisors export a
+    name-prefixed mesh gauge (multi-node devnets keep series
+    distinct, like the admission families)."""
+    import teku_tpu.ops.provider  # noqa: F401 - registers families
+    from teku_tpu import parallel
+    from teku_tpu.crypto.bls import loader
+    from teku_tpu.infra.metrics import GLOBAL_REGISTRY
+
+    metrics = GLOBAL_REGISTRY.metrics()
+    fam = metrics["bls_mesh_dispatch_total"]
+    assert isinstance(fam, LabeledCounter)
+    assert tuple(fam.labelnames) == ("devices",)
+    # closed vocabulary: pow-2 device counts (the resolver only ever
+    # yields pow-2 mesh sizes; bounded — label cardinality is the
+    # handful of mesh sizes a fleet actually runs)
+    pow2_vocab = {str(1 << i) for i in range(1, 9)}   # 2..256
+    for key, _child in fam._items():
+        assert set(key) <= pow2_vocab, key
+    # the resolver can only emit 0 (off) or a pow-2 >= 2
+    for spec, avail in (("auto", 8), ("auto", 5), ("auto", 1),
+                        ("6", 8), ("100", 8), ("3", 4), ("off", 8),
+                        ("garbage", 8)):
+        n = parallel.resolve_mesh_devices(spec, available=avail)
+        assert n == 0 or (n >= 2 and n & (n - 1) == 0), (spec, n)
+    assert isinstance(metrics["bls_mesh_devices"], Gauge)
+    # the supervisor-scoped gauge is name-prefixed
+    reg = MetricsRegistry()
+    loader.make_supervisor(registry=reg, warm=False,
+                           name="lint_mesh",
+                           breaker_name="lint_mesh_dev")
+    assert isinstance(reg.metrics()["lint_mesh_mesh_devices"], Gauge)
+
+
 def test_h2c_dedup_and_coalesce_family_naming_lint():
     """The PR-5 dedup/cache/coalesce families must not drift: hit/miss/
     evict/dispatch counters end ``_total``, the dedup gauge is a

@@ -40,7 +40,12 @@ a failure — budget-starved runs drop phases):
   BLOCK_IMPORT/VIP sheds == 0 under EVERY traffic shape, vip/
   block_import p50 ≤ ``mainnet_critical_p50_ms_max`` on production
   (non-adversarial) shapes, and dedup ratio ≥
-  ``mainnet_dedup_ratio_min`` on committee-shaped mixes.
+  ``mainnet_dedup_ratio_min`` on committee-shaped mixes;
+- mesh gates (absolute, on the device-count sweep in ``mesh``): the
+  scaling series must be monotonic in device count, and on real
+  parallel hardware (``series == "measured"``) efficiency at the max
+  count ≥ ``mesh_efficiency_min`` × linear (serialized-virtual runs
+  report efficiency but only monotonicity is gated).
 """
 
 import argparse
@@ -62,6 +67,11 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
     # storm ~0.24 (brownout sheds duplicated gossip before dispatch);
     # adversarial dup-collapse sits at ~0.03
     "mainnet_dedup_ratio_min": 0.2,
+    # mesh scaling at the max device count must keep >= 0.7x linear —
+    # enforced on MEASURED series (real parallel hardware) only; the
+    # serialized-virtual projection reports efficiency but its Amdahl
+    # saturation (replicated finish) is expected, so it is not gated
+    "mesh_efficiency_min": 0.7,
 }
 
 
@@ -232,6 +242,29 @@ def compare(base: dict, new: dict,
         lambda v: v is False,
         "brownout must be edge-triggered: one enter, at most one "
         "exit, no flapping")
+
+    # mesh gates (PR-10 acceptance properties, absolute, skip-if-
+    # missing): the device-count sweep's scaling series must rise
+    # monotonically with chips, and on real parallel hardware the
+    # efficiency at the max count must stay >= mesh_efficiency_min of
+    # linear.  A virtual (serialized single-host) run reports
+    # efficiency but only the monotonicity of its per-device
+    # projection is gated — its wall time physically cannot drop.
+    mesh_block = _get(new, "mesh") or {}
+    _check_absolute(
+        checks, "mesh_monotonic",
+        mesh_block.get("monotonic", new.get("mesh_monotonic")),
+        lambda v: v is True,
+        "mesh sigs/sec must rise monotonically with device count")
+    mesh_series = mesh_block.get("series", new.get("mesh_series"))
+    mesh_eff = mesh_block.get("scaling_efficiency_at_max",
+                              new.get("mesh_scaling_efficiency"))
+    _check_absolute(
+        checks, "mesh_scaling_efficiency",
+        mesh_eff if mesh_series == "measured" else None,
+        lambda v: v >= thr["mesh_efficiency_min"],
+        f"scaling efficiency at the max device count must stay >= "
+        f"{thr['mesh_efficiency_min']}x linear on real hardware")
 
     # mainnet gates (loadgen acceptance properties, absolute, per
     # scenario): protected classes are NEVER shed under any traffic
