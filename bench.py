@@ -91,6 +91,35 @@ def _beat(stage: str, **extra) -> None:
     sys.stderr.flush()
 
 
+def _ledger_mark() -> int:
+    """Dispatch-ledger high-water mark (record seq) at a phase start."""
+    try:
+        from teku_tpu.infra import dispatchledger
+        return dispatchledger.LEDGER.recorded_total
+    except Exception:
+        return 0
+
+
+def _ledger_phase_summary(phase: str, since: int, **extra) -> None:
+    """Per-phase dispatch-ledger summary into OUT["ledger"][phase]:
+    padding-waste per stage bucket (and per lane bucket), dedup ratio,
+    mesh shard imbalance, and the decision/compile histograms — so the
+    perf trajectory records WHY each phase performed as it did, not
+    just how fast it went (tools/bench_diff.py gates on the waste and
+    imbalance ratios).  ``extra`` annotates the summary — e.g.
+    ``pinned_min_bucket`` when the phase deliberately pins the
+    dispatch bucket for compile budget (waste then reflects the pin,
+    not the planner, and the diff gate skips it)."""
+    try:
+        from teku_tpu.infra import dispatchledger
+        summary = dispatchledger.LEDGER.summary(since_seq=since)
+        if summary.get("records"):
+            summary.update(extra)
+            OUT.setdefault("ledger", {})[phase] = summary
+    except Exception:
+        pass
+
+
 def _on_term(signum, frame):  # pragma: no cover - signal path
     """An external timeout (driver harness) must still get the JSON
     line: a TPU-side compile can block past any soft deadline, and
@@ -450,6 +479,7 @@ def _latency_phase(jax, deadline):
     if trace_on:
         tracing.set_sampler(_sampler)
 
+    led0 = _ledger_mark()
     # min_bucket=256 pins EVERY service dispatch to the one 256-lane
     # shape the throughput phase already compiled — no extra kernel
     # compiles in this phase (only the small pubkey-validation program)
@@ -544,6 +574,11 @@ def _latency_phase(jax, deadline):
                                       ("ewma_s", "p50_s", "samples")}
                                for path, stats in paths.items()}
                        for shape, paths in cap["shapes"].items()}}
+        # min_bucket is PINNED to 256 above (compile budget): the lane
+        # waste in this summary measures the pin + the burst's
+        # coalescing, not the production planner — flagged so the
+        # bench_diff waste gate skips this phase
+        _ledger_phase_summary("latency", led0, pinned_min_bucket=256)
     finally:
         tracing.set_sampler(None)
         bls.reset_implementation()
@@ -756,6 +791,7 @@ def _dedup_phase(jax, deadline):
     factors = [int(f) for f in os.environ.get(
         "BENCH_DEDUP_FACTORS", "1,8,64").split(",")]
     iters = int(os.environ.get("BENCH_DEDUP_ITERS", "3"))
+    led0 = _ledger_mark()
     impl = JaxBls12381(max_batch=batch, min_bucket=batch)
     out: dict = {"batch": batch, "factors": {}}
     OUT["h2c_dedup"] = out
@@ -841,6 +877,7 @@ def _dedup_phase(jax, deadline):
             out["warm"] = {"error": f"{type(exc).__name__}: {exc}"}
     out["dedup_ratio"] = round(pv._dedup_ratio(), 4)
     out["cache"] = impl._h2c_cache.stats()
+    _ledger_phase_summary("dedup", led0)
     _beat("dedup_phase_done", **{k: out.get(k) for k in
                                  ("dedup_ratio", "warm")})
 
@@ -872,6 +909,7 @@ def _mesh_phase(jax, deadline):
         "BENCH_MESH_COUNTS", "1,2,4,8").split(",")]
     avail = len(jax.devices())
     virtual = jax.devices()[0].platform == "cpu"
+    led0 = _ledger_mark()
     out: dict = {"batch": batch, "dup": dup,
                  "available_devices": avail,
                  "series": ("projected_serialized_virtual" if virtual
@@ -962,6 +1000,7 @@ def _mesh_phase(jax, deadline):
         # efficiency vs linear scaling from the smallest count
         out["scaling_efficiency_at_max"] = round(
             (max_r / base_r) / (max_c / base_c), 4)
+    _ledger_phase_summary("mesh", led0)
     _beat("mesh_phase_done",
           monotonic=out.get("monotonic"),
           efficiency=out.get("scaling_efficiency_at_max"))

@@ -149,6 +149,7 @@ class BeaconRestApi(RestApi):
         g("/teku/v1/admin/readiness", self._admin_readiness)
         g("/teku/v1/admin/flight_recorder", self._admin_flight_recorder)
         g("/teku/v1/admin/capacity", self._admin_capacity)
+        g("/teku/v1/admin/dispatches", self._admin_dispatches)
         g("/teku/v1/admin/admission", self._admin_admission)
         g("/teku/v1/admin/profile", self._admin_profile)
         g("/metrics", self._metrics)
@@ -308,6 +309,35 @@ class BeaconRestApi(RestApi):
         even between node health ticks."""
         from ..infra import capacity
         return {"data": capacity.refresh()}
+
+    async def _admin_dispatches(self, query=None):
+        """The dispatch decision ledger (infra/dispatchledger.py):
+        bounded structured per-dispatch records — batch plan mode and
+        brownout level, real vs padded lanes and unique counts (waste
+        split by stage bucket), H(m) cache hits/misses, resolved msm
+        path + why, mesh shard plan + makespan ratio, compile outcome
+        with duration, device sync/busy spans, verdict — each stamped
+        with its originating trace ids.  ``?last=N`` tails,
+        ``?trace_id=X`` filters to the record serving that trace (the
+        slow-trace ring's join key), ``?slow=1`` filters to records
+        linked to the current slow-trace ring."""
+        from ..infra import dispatchledger
+        last = None
+        if query and query.get("last"):
+            try:
+                last = max(1, int(query["last"]))
+            except ValueError:
+                raise HttpError(400, "last must be an integer")
+        trace_id = (query or {}).get("trace_id") or None
+        slow = (query or {}).get("slow") in ("1", "true")
+        ledger = dispatchledger.LEDGER
+        records = ledger.snapshot(last=last, trace_id=trace_id,
+                                  slow=slow)
+        return {"data": {
+            "records": records,
+            "summary": dispatchledger.summarize(records),
+            "capacity": ledger.capacity,
+            "recorded_total": ledger.recorded_total}}
 
     async def _admin_admission(self):
         """The overload controller's state (services/admission.py):

@@ -853,6 +853,123 @@ def cmd_loadgen(args) -> int:
     return 0 if summary["block_import_sheds_worst"] == 0 else 1
 
 
+def _doctor_fetch_remote(base_url: str, last: int) -> dict:
+    """Operator mode: read a LIVE node's admin endpoints and hand the
+    snapshots to the engine — nothing here mutates the node."""
+    import urllib.request
+
+    def fetch(path):
+        with urllib.request.urlopen(base_url.rstrip("/") + path,
+                                    timeout=10) as resp:
+            return json.loads(resp.read())
+
+    out = {"records": [], "capacity": None, "slo": None,
+           "flight": [], "admission": None}
+    try:
+        dispatches = fetch(f"/teku/v1/admin/dispatches?last={last}")
+    except Exception as exc:  # noqa: BLE001 - operator-facing CLI
+        raise SystemExit(
+            f"doctor: cannot read {base_url.rstrip('/')}"
+            f"/teku/v1/admin/dispatches ({exc}) — is the node up and "
+            "does it serve the dispatch ledger?")
+    out["records"] = dispatches.get("data", {}).get("records", [])
+    try:
+        out["capacity"] = fetch("/teku/v1/admin/capacity")["data"]
+    except Exception:
+        pass
+    try:
+        out["flight"] = fetch("/teku/v1/admin/flight_recorder").get(
+            "data", [])
+    except Exception:
+        pass
+    try:
+        readiness = fetch("/teku/v1/admin/readiness")
+        out["slo"] = readiness.get("slo")
+        out["admission"] = readiness.get("admission")
+    except Exception:
+        pass
+    return out
+
+
+def _doctor_probe_devnet(args) -> dict:
+    """Local mode: run a short LIVE in-process devnet on the REAL
+    device provider (hard jax preflight — the whole point is that the
+    ledger/capacity/SLO state being diagnosed is live dispatch
+    evidence, not a stub), then harvest every diagnosis input."""
+    from .node import Devnet
+    from .crypto.bls import loader
+    from .infra import capacity as cap
+    from .infra import dispatchledger, flightrecorder
+
+    mont_path, msm_path, mesh = _configure_kernel(args, {})
+    try:
+        loader.configure(args.bls_impl or "jax", mont_path=mont_path,
+                         msm_path=msm_path, mesh=mesh)
+    except loader.BlsLoadError as exc:
+        raise SystemExit(f"doctor probe: BLS preflight failed: {exc}")
+
+    async def run():
+        net = Devnet(n_nodes=args.nodes, n_validators=args.validators)
+        await net.start()
+        try:
+            for slot in range(1, args.slots + 1):
+                await net.run_slot(slot)
+            node = net.nodes[0]
+            slo = node.slo.snapshot() if node.slo is not None else None
+            admission = (node.admission.snapshot()
+                         if node.admission is not None else None)
+            return slo, admission
+        finally:
+            await net.stop()
+
+    slo, admission = asyncio.run(run())
+    # same clamp the admin endpoint applies: a zero/negative --last
+    # must not flip records[-last:] into a head-drop
+    return {"records": dispatchledger.LEDGER.snapshot(
+                last=max(1, args.last)),
+            "capacity": cap.snapshot(), "slo": slo,
+            "flight": flightrecorder.RECORDER.snapshot(),
+            "admission": admission}
+
+
+def cmd_doctor(args) -> int:
+    """Explainability engine over the dispatch decision ledger: WHY is
+    the latency budget being spent the way it is — cold compiles per
+    shape, mesh shard makespan skew, padding waste per lane bucket,
+    H(m) cache coldness, msm auto-demotions, brownouts/sheds/SLO
+    burn — ranked, with every finding citing its evidence (dispatch
+    records by seq + trace id, flight-recorder events).  Reads a live
+    node via --url, or (default) runs a short live in-process devnet
+    on the real device provider and diagnoses it."""
+    from .infra import doctor
+
+    _configure_log_format(args, {})
+    _configure_tracing(args, {})
+    _configure_overload(args, {})
+    if args.url:
+        inputs = _doctor_fetch_remote(args.url, args.last)
+    else:
+        inputs = _doctor_probe_devnet(args)
+    diagnosis = doctor.diagnose(
+        inputs["records"], capacity=inputs.get("capacity"),
+        slo=inputs.get("slo"), flight_events=inputs.get("flight"),
+        admission=inputs.get("admission"))
+    if args.json:
+        print(json.dumps(diagnosis, indent=1, default=str))
+    else:
+        print(doctor.render_text(diagnosis))
+    if args.out:
+        Path(args.out).write_text(
+            json.dumps(diagnosis, indent=1, default=str))
+    if not inputs["records"] and not args.url:
+        # the local probe RAN a devnet: an empty ledger means the
+        # device provider never dispatched — that is itself a defect
+        print("doctor: probe produced no dispatch records",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 # --------------------------------------------------------------------------
 
 def build_parser() -> argparse.ArgumentParser:
@@ -1050,6 +1167,43 @@ def build_parser() -> argparse.ArgumentParser:
     lg.add_argument("--out", default=None,
                     help="also write the JSON report to this path")
     lg.set_defaults(fn=cmd_loadgen)
+
+    dr = sub.add_parser(
+        "doctor",
+        help="explain the current latency budget from the dispatch "
+             "decision ledger + capacity/SLO/flight-recorder state")
+    dr.add_argument("--url", default=None,
+                    help="base URL of a live node's REST API to "
+                         "diagnose (e.g. http://127.0.0.1:5051); "
+                         "default runs a short live in-process devnet "
+                         "on the real device provider")
+    dr.add_argument("--last", type=int, default=128,
+                    help="how many ledger records to read")
+    dr.add_argument("--json", action="store_true",
+                    help="print the machine-readable diagnosis")
+    dr.add_argument("--out", default=None,
+                    help="also write the JSON diagnosis to this path")
+    dr.add_argument("--slots", type=int, default=4,
+                    help="probe devnet: slots to run")
+    dr.add_argument("--nodes", type=int, default=1,
+                    help="probe devnet: node count")
+    dr.add_argument("--validators", type=int, default=8,
+                    help="probe devnet: validator count")
+    dr.add_argument("--bls-impl", default=None,
+                    help="probe devnet BLS implementation (default "
+                         "jax: the probe exists to exercise the real "
+                         "device dispatch path)")
+    dr.add_argument("--mont-path", default=None,
+                    choices=list(_MONT_PATHS))
+    dr.add_argument("--msm-path", default=None,
+                    choices=list(_MSM_PATHS))
+    dr.add_argument("--mesh", default=None,
+                    help="probe devnet mesh spec (off, auto, or N)")
+    dr.add_argument("--log-format", default=None,
+                    choices=["text", "json"])
+    dr.add_argument("--tracing", default=None)
+    dr.add_argument("--overload-control", default=None)
+    dr.set_defaults(fn=cmd_doctor)
 
     mg = sub.add_parser("migrate-database",
                         help="convert a data dir between storage modes")

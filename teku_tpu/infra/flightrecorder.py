@@ -168,6 +168,17 @@ def record(kind: str, trace_id: Optional[str] = None, **fields) -> dict:
     return RECORDER.record(kind, trace_id=trace_id, **fields)
 
 
+def config_demotion(subsystem: str, requested, resolved,
+                    detail: str, **extra) -> dict:
+    """ONE definition of the ``config_demotion`` event schema — the
+    doctor keys on the literal kind string and reads ``subsystem``/
+    ``detail`` from each event, so a hand-rolled copy at a new
+    demotion site could silently emit events it ignores."""
+    return record("config_demotion", subsystem=subsystem,
+                  requested=str(requested), resolved=str(resolved),
+                  detail=detail, **extra)
+
+
 # --------------------------------------------------------------------------
 # Fatal-crash hooks (installed by the CLI entry points, NOT on import —
 # a library import must never mutate process-global handlers)

@@ -117,6 +117,13 @@ def get_path() -> str:
                 _warned_invalid[0] = True
                 _LOG.warning("%s=%r is not one of %s; using auto",
                              ENV_VAR, configured, "/".join(PATHS))
+                # self-explaining boot: the demotion lands in the
+                # flight recorder, not only a scrolled-away WARN
+                from ..infra import flightrecorder
+                flightrecorder.config_demotion(
+                    "msm", configured, "auto",
+                    f"{ENV_VAR} not one of "
+                    f"{'/'.join(PATHS)}; using auto")
         configured = "auto"
     return configured
 
@@ -128,6 +135,47 @@ def _device_is_tpu() -> bool:
         return False
 
 
+def explain(lanes=None, rows=None, sharded: bool = False):
+    """``resolve`` plus WHY: ``(path, why)`` where ``why`` is the
+    JSON-able decision context the dispatch ledger records — the
+    configured path, the auto rule's inputs (device, lane count,
+    duplication factor, thresholds), and the rule that fired.  The
+    doctor engine cites this verbatim when it explains an msm
+    auto-demotion."""
+    why = {"configured": get_path(), "lanes": lanes, "rows": rows}
+    if sharded:
+        why["rule"] = "legacy lane-sharded kernel always ladders"
+        return "ladder", why     # lane shards split message groups
+    configured = why["configured"]
+    if configured in ("ladder", "pippenger"):
+        why["rule"] = "explicitly configured"
+        return configured, why
+    # auto: the bucketed path wins when the per-group overhead
+    # (2^w - 1 buckets reduced per window) amortizes over enough
+    # duplicated lanes AND the device is the one it was tuned for
+    why["tpu"] = _device_is_tpu()
+    if not why["tpu"]:
+        why["rule"] = "auto: dispatch device is not a TPU"
+        return "ladder", why
+    if not lanes or not rows:
+        why["rule"] = "auto: no shape context"
+        return "ladder", why
+    # shared degrade-never-fail env readers: resolve() sits on the
+    # live dispatch path, so a typo'd threshold must fall back to the
+    # default, not fail every verification
+    why["auto_min_lanes"] = env_int(ENV_AUTO_MIN_LANES, 32)
+    why["auto_min_dup"] = env_float(ENV_AUTO_MIN_DUP, 2.0)
+    # the rule compares the EXACT ratio (rounding first would flip the
+    # decision at the crossover boundary); the record stores it rounded
+    dup = lanes / rows
+    why["dup"] = round(dup, 3)
+    if lanes >= why["auto_min_lanes"] and dup >= why["auto_min_dup"]:
+        why["rule"] = "auto: lanes and duplication clear the crossover"
+        return "pippenger", why
+    why["rule"] = "auto: below the lanes/duplication crossover"
+    return "ladder", why
+
+
 def resolve(lanes=None, rows=None, sharded: bool = False) -> str:
     """The EFFECTIVE path for one dispatch: 'ladder' or 'pippenger'.
 
@@ -137,26 +185,7 @@ def resolve(lanes=None, rows=None, sharded: bool = False) -> str:
     `sharded=True` means the LEGACY lane-sharded kernel (always
     ladders — raw lane shards split message groups); the group-aligned
     mesh path resolves with sharded=False."""
-    if sharded:
-        return "ladder"          # lane shards split message groups
-    configured = get_path()
-    if configured in ("ladder", "pippenger"):
-        return configured
-    # auto: the bucketed path wins when the per-group overhead
-    # (2^w - 1 buckets reduced per window) amortizes over enough
-    # duplicated lanes AND the device is the one it was tuned for
-    if not _device_is_tpu():
-        return "ladder"
-    if not lanes or not rows:
-        return "ladder"
-    # shared degrade-never-fail env readers: resolve() sits on the
-    # live dispatch path, so a typo'd threshold must fall back to the
-    # default, not fail every verification
-    min_lanes = env_int(ENV_AUTO_MIN_LANES, 32)
-    min_dup = env_float(ENV_AUTO_MIN_DUP, 2.0)
-    if lanes >= min_lanes and lanes / rows >= min_dup:
-        return "pippenger"
-    return "ladder"
+    return explain(lanes=lanes, rows=rows, sharded=sharded)[0]
 
 
 class force:

@@ -103,6 +103,11 @@ def get_path() -> str:
                 _warned_invalid[0] = True
                 _LOG.warning("%s=%r is not one of %s; using auto",
                              ENV_VAR, configured, "/".join(PATHS))
+                from ..infra import flightrecorder
+                flightrecorder.config_demotion(
+                    "mont_mul", configured, "auto",
+                    f"{ENV_VAR} not one of "
+                    f"{'/'.join(PATHS)}; using auto")
         configured = "auto"
     return configured
 
@@ -144,6 +149,15 @@ def resolve() -> str:
                 "--mont-path mxu requested but the dispatch device is "
                 "%r (not a TPU); falling back to the vpu path (use "
                 "mxu-force to override for A/B testing)", device)
+            # mirror the WARN into the flight recorder so a
+            # mis-knobbed node boot self-explains at
+            # /teku/v1/admin/flight_recorder
+            from ..infra import flightrecorder
+            flightrecorder.config_demotion(
+                "mont_mul", "mxu", "vpu",
+                "mxu requested on a non-TPU device; vpu path "
+                "serves (mxu-force overrides for A/B)",
+                device=str(device))
     return "vpu"
 
 

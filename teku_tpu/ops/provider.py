@@ -54,7 +54,8 @@ import jax
 import jax.numpy as jnp
 
 from ..crypto.bls import hash_to_curve as OH
-from ..infra import capacity, compilecache, faults, tracing
+from ..infra import (capacity, compilecache, dispatchledger, faults,
+                     tracing)
 from ..infra.collections import LimitedMap
 from ..infra.metrics import GLOBAL_REGISTRY
 from ..crypto.bls.constants import P, R
@@ -157,21 +158,12 @@ _EVICT_PK = HC.evictions_counter("pk")
 _EVICT_U = HC.evictions_counter("u")
 
 
-def _padding_waste() -> float:
-    # read real BEFORE padded (writers inc padded first): a dispatch
-    # landing between the reads skews the ratio high, never negative
-    real = _M_LANES_REAL.value
-    padded = _M_LANES_PADDED.value
-    return (padded - real) / padded if padded else 0.0
-
-
-# pow-2 padding trades jit-cache size for dead lanes: this is the dead
-# fraction, a direct throughput observable (0.3 means 30% of device
-# work verified nothing)
-GLOBAL_REGISTRY.gauge(
-    "bls_dispatch_padding_waste_ratio",
-    "fraction of dispatched lanes that were pow-2 padding",
-    supplier=_padding_waste)
+# pow-2 padding trades jit-cache size for dead lanes; the dead
+# fraction is a direct throughput observable (0.3 means 30% of device
+# work verified nothing).  The gauge moved to the dispatch ledger
+# (infra/dispatchledger.py) as bls_dispatch_padding_waste_ratio{stage}
+# — SPLIT by stage bucket (lane vs unique-h2c row), fed from the same
+# per-dispatch counts the records below carry.
 
 
 # one shared definition of the padding rule (infra/pow2.py) — the
@@ -233,10 +225,10 @@ class _DispatchHandle:
 
     __slots__ = ("_ok", "_lane_ok", "_n", "_traces", "_done",
                  "_verdict", "_shape", "_path", "_t_enq_end",
-                 "_lane_sel")
+                 "_lane_sel", "_rec", "_recorded")
 
     def __init__(self, ok, lane_ok, n, traces, shape, path, t_enq_end,
-                 lane_sel=None):
+                 lane_sel=None, rec=None):
         self._ok = ok
         self._lane_ok = lane_ok
         self._n = n
@@ -248,7 +240,12 @@ class _DispatchHandle:
         # blocks: lane_sel maps original lane i -> its slot in the
         # dispatched layout, so the verdict reads the right lanes
         self._lane_sel = lane_sel
+        # the open dispatch-ledger record _begin_dispatch assembled:
+        # result() completes it (sync duration, overlap-corrected
+        # device time, verdict) and publishes it into the ring
+        self._rec = rec
         self._done = False
+        self._recorded = False
         self._verdict = False
 
     def result(self) -> bool:
@@ -256,6 +253,7 @@ class _DispatchHandle:
         if self._done:
             return self._verdict
         t_sync0 = time.perf_counter()
+        synced = False
         try:
             # np.asarray forces the device round-trip: this wait (and
             # nothing else) is the device_sync stage
@@ -264,20 +262,45 @@ class _DispatchHandle:
                     if self._lane_sel is not None
                     else lane_ok[:self._n])
             verdict = bool(np.asarray(self._ok)) and bool(real.all())
+            synced = True
         finally:
             t_end = time.perf_counter()
             tracing.record_stage("device_sync", t_end - t_sync0,
                                  self._traces)
+            if not synced and self._rec is not None:
+                # a raising sync is still a decision worth its ledger
+                # entry — the doctor wants to see the dispatch that
+                # wedged, with its full decision context
+                self._rec["device"] = {
+                    "sync_s": round(t_end - t_sync0, 6),
+                    "sync_error": True}
+                self._rec["verdict"] = None
+                if not self._recorded:
+                    dispatchledger.record(self._rec)
+                    self._recorded = True
         # true device time = enqueue-end → sync-end, clamped by the
         # tracker so overlapped dispatches never double-count.  Only a
         # SUCCESSFUL sync counts its lanes: a raising dispatch gets
         # bisected and re-dispatched, and crediting its lanes here
         # would inflate sustainable capacity during exactly the fault
         # incidents the capacity endpoint is meant to diagnose.
-        capacity.record_dispatch(self._shape, self._path, self._n,
-                                 self._t_enq_end, t_end)
+        busy = capacity.record_dispatch(self._shape, self._path,
+                                        self._n, self._t_enq_end,
+                                        t_end)
         self._done = True
         self._verdict = faults.transform("bls.dispatch", verdict)
+        if self._rec is not None:
+            self._rec["device"] = {
+                "sync_s": round(t_end - t_sync0, 6),
+                "busy_s": round(busy, 6)}
+            self._rec["verdict"] = self._verdict
+            # a retry after a raising sync already published this dict
+            # into the ring: the in-place update above is enough — a
+            # second record() would double-count its waste/decision
+            # metrics and give one trace id two ring entries
+            if not self._recorded:
+                dispatchledger.record(self._rec)
+                self._recorded = True
         return self._verdict
 
 
@@ -738,7 +761,7 @@ class JaxBls12381(BLS12381):
             # (groups never cross shards); msm.resolve(sharded=True)
             # remains the LEGACY lane-sharded kernel's always-ladder
             # contract and is not used here
-            msm_path = msm.resolve(lanes=n, rows=len(rows))
+            msm_path, msm_why = msm.explain(lanes=n, rows=len(rows))
             r_bits = glv_digits = None
             if randomize:
                 # one os-entropy draw for the whole batch (the
@@ -768,6 +791,25 @@ class JaxBls12381(BLS12381):
             # belongs to host_prep; only the dispatch/gather below is
             # device work
             hm_plan = self._hm_host_plan(row_msgs, u_hm)
+            # per-dispatch H(m) arena accounting for the ledger: a
+            # bypassed/disabled cache means every row pays h2c at the
+            # canonical unique bucket; otherwise misses pay at the
+            # missing-message bucket and hits cost one gather
+            plan_slots, plan_missing, _, plan_draws = hm_plan
+            # the bucket actually dispatched is read off the plan's
+            # own padded draws (first dim) — never re-derived, so a
+            # change to the plan's bucket rule can't skew the ledger
+            h2c_bucket = (plan_draws[0][0].shape[0]
+                          if plan_draws is not None else 0)
+            if plan_slots is None:
+                h2c_stats = {"cache_hits": 0,
+                             "cache_misses": len(row_msgs),
+                             "dispatch_bucket": h2c_bucket}
+            else:
+                misses = len(plan_missing)
+                h2c_stats = {"cache_hits": len(row_msgs) - misses,
+                             "cache_misses": misses,
+                             "dispatch_bucket": h2c_bucket}
         mesh_n = (self._sharded.n_devices
                   if self._sharded is not None else 0)
         # mesh dispatches get their own shape family (the capacity
@@ -808,8 +850,36 @@ class JaxBls12381(BLS12381):
         # span ends when the launches return, and the handle's
         # result() records the blocking wait as device_sync
         traces = tracing.current_traces()
+        # the dispatch-ledger record: the full decision context of THIS
+        # dispatch, completed by the handle's result().  open_record()
+        # also merges the batching service's context annotations (plan
+        # mode, brownout level, class mix) — asyncio.to_thread copied
+        # them into this worker thread.
+        if plan is not None:
+            mesh_block = {"devices": mesh_n,
+                          "shard_lanes": plan.shard_lanes,
+                          "shard_rows": plan.shard_rows,
+                          "lanes_per_shard": plan.lanes_per_shard,
+                          "rows_per_shard": plan.rows_per_shard,
+                          "makespan_ratio": round(
+                              plan.makespan_ratio, 4)}
+        else:
+            mesh_block = {"devices": 0}
+        rec = dispatchledger.open_record(
+            trace_ids=[t.trace_id for t in traces],
+            shape=shape, mont_path=mont_path, randomized=randomize,
+            lanes=n, kmax=kmax,
+            unique_messages=len(uniq_msgs), rows=len(rows),
+            group_bucket=g_bucket,
+            dedup_ratio=round((n - len(uniq_msgs)) / n, 4),
+            waste={"lane": {"real": n, "padded": padded},
+                   "h2c": {"real": len(rows), "padded": u_total}},
+            h2c=h2c_stats,
+            msm={"path": msm_path, "why": msm_why},
+            mesh=mesh_block)
         t_dev0 = time.perf_counter()
         outcome = "cache_hit"
+        enqueued = False
         try:
             hm_uniq = self._hm_device(hm_plan)
             if self._sharded is not None:
@@ -839,6 +909,7 @@ class JaxBls12381(BLS12381):
                     pk_xs, pk_ys, pk_present, hm_uniq, group_idx,
                     group_present, (sx0, sx1), s_large, s_inf,
                     r_bits, lane_valid)
+            enqueued = True
         finally:
             if first:
                 outcome = compilecache.classify_first_dispatch(
@@ -848,6 +919,20 @@ class JaxBls12381(BLS12381):
             t_enq_end = time.perf_counter()
             tracing.record_stage("device_enqueue", t_enq_end - t_dev0,
                                  traces)
+            # on a first shape the enqueue duration IS the XLA cost
+            # this dispatch paid (fresh compile or disk cache load) —
+            # the doctor's cold-compile findings cite it per record
+            rec["compile"] = {"outcome": outcome,
+                              "enqueue_s": round(
+                                  t_enq_end - t_dev0, 6)}
+            if not enqueued:
+                # a raising enqueue (fault injection, XLA error) never
+                # constructs the handle whose result() would publish
+                # the record — and the dispatch that DIED is exactly
+                # the one the doctor most needs to see
+                rec["device"] = {"enqueue_error": True}
+                rec["verdict"] = None
+                dispatchledger.record(rec)
         # the capacity model's per-(shape, path) latency series must
         # distinguish the scalars engine: under msm auto, SAME-shape
         # dispatches can run ladder or pippenger (resolve() keys on
@@ -859,4 +944,4 @@ class JaxBls12381(BLS12381):
                     else f"{mont_path}+pip")
         return _DispatchHandle(ok, lane_ok, n, traces, shape,
                                lat_path, t_enq_end,
-                               lane_sel=lane_pos)
+                               lane_sel=lane_pos, rec=rec)

@@ -31,6 +31,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh
 
+from ..infra import flightrecorder
 from ..infra.metrics import GLOBAL_REGISTRY
 from ..infra.pow2 import floor_pow2 as _floor_pow2
 from ..infra.pow2 import next_pow2 as _next_pow2
@@ -89,6 +90,12 @@ def resolve_mesh_devices(spec, available: Optional[int] = None) -> int:
             _warned_demotion[0] = True
             _LOG.warning("%s=%r is not off/auto/N; mesh disabled",
                          ENV_VAR, spec)
+            # a mis-knobbed boot must self-explain in the flight
+            # recorder, not only in a log line that scrolled away
+            flightrecorder.config_demotion(
+                "mesh", spec, 0,
+                f"{ENV_VAR} not off/auto/N; mesh disabled",
+                available=available)
         return 0
     if requested <= 1:
         return 0
@@ -99,6 +106,11 @@ def resolve_mesh_devices(spec, available: Optional[int] = None) -> int:
             "mesh of %d devices unavailable (have %d, shards must be "
             "a power of two); demoting to a %d-device mesh",
             requested, available, n)
+        flightrecorder.config_demotion(
+            "mesh", requested, n,
+            "mesh demoted to the largest pow-2 <= "
+            "min(requested, available)",
+            available=available)
     return n if n >= 2 else 0
 
 
@@ -151,10 +163,12 @@ class ShardPlan:
     """
 
     __slots__ = ("n_shards", "lanes_per_shard", "rows_per_shard",
-                 "padded", "rows_total", "lane_pos", "row_layout")
+                 "padded", "rows_total", "lane_pos", "row_layout",
+                 "shard_lanes", "shard_rows")
 
     def __init__(self, n_shards, lanes_per_shard, rows_per_shard,
-                 lane_pos, row_layout):
+                 lane_pos, row_layout, shard_lanes=None,
+                 shard_rows=None):
         self.n_shards = n_shards
         self.lanes_per_shard = lanes_per_shard
         self.rows_per_shard = rows_per_shard
@@ -162,6 +176,21 @@ class ShardPlan:
         self.rows_total = n_shards * rows_per_shard
         self.lane_pos = lane_pos
         self.row_layout = row_layout
+        # per-shard REAL loads (pre-padding): the dispatch ledger's
+        # makespan/imbalance evidence — which chip the LPT packer made
+        # the straggler, and by how much
+        self.shard_lanes = list(shard_lanes or [])
+        self.shard_rows = list(shard_rows or [])
+
+    @property
+    def makespan_ratio(self) -> float:
+        """max shard lane load / mean shard lane load (>= 1.0; the
+        sharded dispatch's wall time is the max shard's, so this IS
+        the imbalance overhead factor)."""
+        total = sum(self.shard_lanes)
+        if not total or not self.n_shards:
+            return 0.0
+        return max(self.shard_lanes) / (total / self.n_shards)
 
 
 def plan_group_shards(rows: Sequence[Tuple[int, List[int]]],
@@ -199,7 +228,9 @@ def plan_group_shards(rows: Sequence[Tuple[int, List[int]]],
             for i in rows[r][1]:
                 lane_pos[i] = cursor
                 cursor += 1
-    return ShardPlan(m, lanes_per, rows_per, lane_pos, row_layout)
+    return ShardPlan(m, lanes_per, rows_per, lane_pos, row_layout,
+                     shard_lanes=bin_lanes,
+                     shard_rows=[len(br) for br in bin_rows])
 
 
 class ShardedVerifier:

@@ -124,6 +124,10 @@ class BatchPlan:
     utilization: float = 0.0
     burn_rate: float = 0.0
     modeled_batch_s: Optional[float] = None  # device time at batch_size
+    # which rule sized the batch: "latency" (smallest pow-2 covering
+    # the queue) or "throughput" (largest fit under the device budget)
+    # — the dispatch ledger's plan_mode decision label
+    mode: str = "latency"
 
     def sheds(self, cls: VerifyClass) -> bool:
         """Does the current brownout level shed this class?"""
@@ -226,6 +230,13 @@ class AdmissionController:
             return self._plan
         return self.tick()
 
+    def last_plan(self) -> BatchPlan:
+        """The most recently computed plan, with NO lazy re-tick — a
+        passive read for observability annotation (plan() may run the
+        brownout edge logic as a side effect)."""
+        with self._lock:
+            return self._plan
+
     def tick(self) -> BatchPlan:
         """Recompute the plan from the live sensors and run the
         brownout edge logic.  Cheap enough for every drain."""
@@ -235,7 +246,7 @@ class AdmissionController:
         except Exception:  # noqa: BLE001 - a sick sensor reads calm
             burn = 0.0
         depth = self.telemetry.queue_depth.current
-        size, modeled = self._pick_batch(depth, util, burn)
+        size, modeled, mode = self._pick_batch(depth, util, burn)
         flush = self._pick_flush(depth, size, util)
         with self._lock:
             self._ticks += 1
@@ -243,7 +254,8 @@ class AdmissionController:
             self._plan = BatchPlan(
                 batch_size=size, flush_deadline_s=flush,
                 brownout_level=level, utilization=round(util, 4),
-                burn_rate=round(burn, 4), modeled_batch_s=modeled)
+                burn_rate=round(burn, 4), modeled_batch_s=modeled,
+                mode=mode)
             self._last_tick_t = self._clock()
             return self._plan
 
@@ -267,13 +279,15 @@ class AdmissionController:
             # throughput mode: queueing dominates latency, so drain the
             # largest batch that still fits the device budget — fewer
             # dispatch overheads raise sustainable capacity
-            size = fit
+            size, mode = fit, "throughput"
         else:
             # latency mode: smallest pow-2 covering what is queued cuts
             # padding waste without adding wait
             size = min(fit, max(self.min_bucket,
                                 _next_pow2(max(depth, 1))))
-        return size, self.telemetry.latency.latency_for_lanes(size)
+            mode = "latency"
+        return (size, self.telemetry.latency.latency_for_lanes(size),
+                mode)
 
     def _pick_flush(self, depth: int, size: int, util: float) -> float:
         """How long a worker may hold a partial batch open.  Only under
@@ -371,6 +385,7 @@ class AdmissionController:
                     "batch_size": plan.batch_size,
                     "flush_deadline_s": plan.flush_deadline_s,
                     "modeled_batch_s": plan.modeled_batch_s,
+                    "mode": plan.mode,
                 },
                 "inputs": {
                     "utilization": plan.utilization,

@@ -65,7 +65,8 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..crypto import bls
-from ..infra import capacity, faults, flightrecorder, tracing
+from ..infra import (capacity, dispatchledger, faults, flightrecorder,
+                     tracing)
 from ..infra.metrics import (GLOBAL_REGISTRY, LATENCY_BUCKETS_S,
                              MetricsRegistry)
 from ..infra.env import env_float
@@ -344,6 +345,27 @@ class AggregatingSignatureVerificationService:
             "TEKU_TPU_SHED_EVENT_COOLDOWN_S", 1.0)
         self._shed_event_last: Dict[tuple, float] = {}
         self._shed_event_suppressed: Dict[tuple, int] = {}
+        # REAL-TIME flush failsafe: the batch-fill hold runs on the
+        # service clock (virtual in sims), with a wall-clock
+        # termination bound so a stalled virtual clock can never hold
+        # a worker forever.  Env-tunable (TEKU_TPU_FLUSH_FAILSAFE_MS;
+        # 0 = the plan's own flush deadline, the legacy bound).  The
+        # r10 investigation SUSPECTED this silent failsafe for a 3.6 s
+        # loadgen block-import p50 on 1-core boxes — each firing is
+        # now counted, flight-recorded, and stamped into the fired
+        # batch's own ledger record (and that evidence shows the
+        # loadgen inflation fires ZERO failsafes, ruling this path
+        # out).
+        # clamped: a negative typo'd value would read truthy and put
+        # the wall deadline in the past, firing the failsafe on EVERY
+        # fill hold (degrade-never-fail, like every env knob here)
+        self._flush_failsafe_s = max(0.0, env_float(
+            "TEKU_TPU_FLUSH_FAILSAFE_MS", 0.0) / 1e3)
+        self._failsafe_event_last = 0.0
+        self._m_flush_failsafe = registry.counter(
+            f"{name}_flush_failsafe_total",
+            "batch-fill holds terminated by the wall-clock failsafe "
+            "instead of the service-clock flush deadline")
         self.num_workers = num_workers
         self._name = name
         self.overlap = _overlap_default() if overlap is None else overlap
@@ -708,7 +730,8 @@ class AggregatingSignatureVerificationService:
                         # time is the scarce resource now
                         self._shed_task(first, reason="brownout")
                         continue
-                tasks = await self._take_batch(first, plan)
+                tasks, failsafe_fired = await self._take_batch(
+                    first, plan)
                 if not tasks:
                     continue
                 vip_streak = all(t.cls is VerifyClass.VIP
@@ -716,13 +739,16 @@ class AggregatingSignatureVerificationService:
                 try:
                     handle = t0 = None
                     if self.overlap and bls.supports_async_verify():
-                        handle, t0 = await self._begin(tasks)
+                        handle, t0 = await self._begin(
+                            tasks, plan, failsafe_fired)
                     if handle is None:
                         # sync path: implementation has no async seam
                         if inflight is not None:
                             prev, inflight = inflight, None
                             await self._retire(*prev)
-                        await self._verify_batch(tasks)
+                        await self._verify_batch(
+                            tasks, plan=plan,
+                            flush_failsafe=failsafe_fired)
                     else:
                         prev, inflight = inflight, (tasks, handle, t0)
                         if prev is not None:
@@ -750,18 +776,22 @@ class AggregatingSignatureVerificationService:
                         if not fut.done():
                             fut.cancel()
 
-    async def _take_batch(self, first: _Task,
-                          plan: Optional[BatchPlan]) -> List[_Task]:
+    async def _take_batch(
+            self, first: _Task,
+            plan: Optional[BatchPlan]) -> Tuple[List[_Task], bool]:
         """Assemble one dispatch batch under the current plan: VIP
         bypasses aggregation (dispatched alone, immediately); other
         classes drain up to the plan's pow-2 batch size, optionally
         holding the batch open up to the flush deadline when the
-        controller says throughput is the constraint."""
+        controller says throughput is the constraint.  Returns
+        ``(tasks, failsafe_fired)`` — the flag rides with THIS batch
+        into its ledger annotation (a shared instance flag would let
+        one worker's firing stamp another worker's record)."""
         # recompute the effective class first: a cancelled VIP primary
         # with GOSSIP waiters must not hold the express lane
         live = self._drop_cancelled([first])
         if not live:
-            return []
+            return [], False
         first = live[0]
         budget = plan.batch_size if plan is not None \
             else self.max_batch_size
@@ -771,7 +801,8 @@ class AggregatingSignatureVerificationService:
             # padded shape serves them all; leaving them behind would
             # cost a full extra dispatch each)
             return self._drop_cancelled(
-                self._assemble(first, budget, vip_only=True))
+                self._assemble(first, budget, vip_only=True)), False
+        failsafe_fired = False
         if plan is not None and plan.flush_deadline_s > 0:
             needed = budget - len(first.triples)
             # elapsed runs on the service clock (virtual in the sim, so
@@ -779,9 +810,12 @@ class AggregatingSignatureVerificationService:
             # arrivals pulse re-checks); the REAL-time deadline is the
             # termination failsafe — a virtual clock that stops
             # advancing (sim load window over) must not hold a worker
-            # forever
+            # forever.  TEKU_TPU_FLUSH_FAILSAFE_MS tightens the wall
+            # bound independently of the plan's (virtual) deadline.
             start = self._clock()
-            real_deadline = time.monotonic() + plan.flush_deadline_s
+            failsafe_s = self._flush_failsafe_s \
+                or plan.flush_deadline_s
+            real_deadline = time.monotonic() + failsafe_s
             while self._queue.triples < needed:
                 best = self._queue.best_class()
                 if best is not None and best < first.cls:
@@ -793,11 +827,41 @@ class AggregatingSignatureVerificationService:
                 remaining = (plan.flush_deadline_s
                              - (self._clock() - start))
                 real_remaining = real_deadline - time.monotonic()
-                if remaining <= 0 or real_remaining <= 0:
+                if remaining <= 0:
+                    break
+                if real_remaining <= 0:
+                    # the wall clock beat the service clock: the
+                    # failsafe (not the flush policy) ended this hold
+                    # — the silent 1-core latency source r10 chased
+                    self._note_flush_failsafe(plan, failsafe_s,
+                                              remaining)
+                    failsafe_fired = True
                     break
                 await self._queue.wait_arrival(
                     min(remaining, real_remaining))
-        return self._drop_cancelled(self._assemble(first, budget))
+        return (self._drop_cancelled(self._assemble(first, budget)),
+                failsafe_fired)
+
+    def _note_flush_failsafe(self, plan: BatchPlan, failsafe_s: float,
+                             virtual_remaining_s: float) -> None:
+        """Stamp a real-time flush-failsafe firing: counter always,
+        flight-recorder event edge-throttled (a stalled virtual clock
+        fires once per drain); the ledger flag rides _take_batch's
+        return with the batch whose hold fired it."""
+        self._m_flush_failsafe.inc()
+        now = time.monotonic()
+        if now - self._failsafe_event_last \
+                >= self._shed_event_cooldown_s:
+            self._failsafe_event_last = now
+            self._recorder.record(
+                "flush_failsafe", service=self._name,
+                failsafe_ms=round(failsafe_s * 1e3, 3),
+                flush_deadline_ms=round(
+                    plan.flush_deadline_s * 1e3, 3),
+                virtual_remaining_ms=round(
+                    virtual_remaining_s * 1e3, 3),
+                detail="wall clock beat the service clock during the "
+                       "batch-fill hold (TEKU_TPU_FLUSH_FAILSAFE_MS)")
 
     def _assemble(self, first: _Task, budget_triples: int,
                   vip_only: bool = False) -> List[_Task]:
@@ -838,13 +902,51 @@ class AggregatingSignatureVerificationService:
                 tracing.record_stage("assembly", assembly, trs)
         return tasks
 
-    async def _begin(self, tasks: List[_Task]):
+    def _dispatch_annotations(self, tasks: List[_Task],
+                              plan: Optional[BatchPlan] = None,
+                              flush_failsafe: bool = False) -> dict:
+        """The admission context the dispatch-ledger record carries:
+        the plan that GOVERNED this batch (the worker passes the plan
+        it assembled under — re-fetching controller.plan() here could
+        tick a brownout edge mid-flight and stamp a mode the batch was
+        never admitted under), the batch's verify-class mix, and
+        whether the real-time flush failsafe ended the fill hold.
+        Bound via dispatchledger.annotate() so asyncio.to_thread
+        carries it into the provider's _begin_dispatch.  Bisect
+        re-dispatches carry no governing plan and fall back to a
+        passive last_plan() read (no tick side effects)."""
+        mix: Dict[str, int] = {}
+        for t in tasks:
+            mix[t.cls.label] = mix.get(t.cls.label, 0) + 1
+        ann: dict = {"classes": mix, "service": self._name}
+        if plan is None and self.controller is not None:
+            try:
+                plan = self.controller.last_plan()
+            except Exception:  # noqa: BLE001 - annotation must not kill
+                plan = None
+        if plan is not None:
+            ann.update(plan_mode=plan.mode,
+                       brownout_level=plan.brownout_level,
+                       plan_batch_size=plan.batch_size,
+                       flush_deadline_s=plan.flush_deadline_s)
+        else:
+            ann.update(plan_mode=None, brownout_level=0)
+        if flush_failsafe:
+            ann["flush_failsafe"] = True
+        return ann
+
+    async def _begin(self, tasks: List[_Task],
+                     plan: Optional[BatchPlan] = None,
+                     flush_failsafe: bool = False):
         """Async-dispatch a batch: host_prep + device enqueue on a
         worker thread.  Returns (handle, t0); handle is None when the
         active implementation has no async path."""
         triples = [tr for t in tasks for tr in t.triples]
         t0 = time.perf_counter()
-        with tracing.attach([t.trace for t in tasks]):
+        with tracing.attach([t.trace for t in tasks]), \
+                dispatchledger.annotate(
+                    **self._dispatch_annotations(
+                        tasks, plan, flush_failsafe)):
             with tracing.span("dispatch"):
                 handle = await asyncio.to_thread(
                     bls.begin_batch_verify, triples)
@@ -903,7 +1005,9 @@ class AggregatingSignatureVerificationService:
         return live
 
     async def _verify_batch(self, tasks: List[_Task],
-                            first_try: bool = True) -> None:
+                            first_try: bool = True,
+                            plan: Optional[BatchPlan] = None,
+                            flush_failsafe: bool = False) -> None:
         tasks = self._drop_cancelled(tasks)
         if not tasks:
             return
@@ -917,7 +1021,10 @@ class AggregatingSignatureVerificationService:
         # host_prep/device_enqueue/device_sync spans attribute to
         # every trace
         t0 = time.perf_counter()
-        with tracing.attach([t.trace for t in tasks]):
+        with tracing.attach([t.trace for t in tasks]), \
+                dispatchledger.annotate(
+                    **self._dispatch_annotations(
+                        tasks, plan, flush_failsafe)):
             with tracing.span("dispatch"):
                 ok = await asyncio.to_thread(bls.batch_verify, triples)
         self._m_batch_duration.observe(time.perf_counter() - t0)

@@ -336,6 +336,65 @@ def test_mesh_gates_skip_when_missing_or_virtual():
         "mesh_scaling_efficiency"]["status"] == "skipped"
 
 
+def test_ledger_gates_on_fixtures():
+    """The PR-13 dispatch-ledger gates: per bench phase, lane-bucket
+    padding waste must stay <= padding_waste_max (0.5) and the mesh
+    shard makespan ratio <= mesh_imbalance_max (1.5)."""
+    base = bench_diff.load_result(BASE)
+    out = bench_diff.compare(base, base)
+    checks = _by_metric(out)
+    assert checks["ledger_padding_waste.latency"]["status"] == "ok"
+    assert checks["ledger_padding_waste.mesh"]["status"] == "ok"
+    assert checks["ledger_mesh_imbalance.mesh"]["status"] == "ok"
+    # a phase without mesh dispatches skips its imbalance gate
+    assert checks["ledger_mesh_imbalance.latency"]["status"] \
+        == "skipped"
+
+    reg = bench_diff.load_result(REGRESSED)
+    out = bench_diff.compare(base, reg)
+    checks = _by_metric(out)
+    assert out["verdict"] == "regression"
+    # the seeded regressions: latency-phase lane waste 0.61 > 0.5,
+    # mesh-phase makespan 1.82 > 1.5
+    assert checks["ledger_padding_waste.latency"]["status"] \
+        == "regression"
+    assert checks["ledger_mesh_imbalance.mesh"]["status"] \
+        == "regression"
+    assert checks["ledger_padding_waste.mesh"]["status"] == "ok"
+
+
+def test_ledger_gates_skip_when_missing_and_thresholds():
+    """Skip-if-missing (pre-ledger results and budget-starved runs
+    carry no `ledger` block); thresholds are operator-tunable."""
+    base = bench_diff.load_result(BASE)
+    stripped = {k: v for k, v in base.items() if k != "ledger"}
+    out = bench_diff.compare(base, stripped)
+    assert not any(c["metric"].startswith("ledger_")
+                   for c in out["checks"])
+    assert out["verdict"] == "pass"
+    # a phase that PINNED its dispatch bucket for compile budget
+    # (bench latency phase) skips the waste gate: the waste measures
+    # the pin, not the production planner
+    pinned = json.loads(json.dumps(base))
+    pinned["ledger"]["latency"]["padding_waste"]["lane"] = 0.73
+    pinned["ledger"]["latency"]["pinned_min_bucket"] = 256
+    out = bench_diff.compare(base, pinned)
+    assert _by_metric(out)["ledger_padding_waste.latency"]["status"] \
+        == "skipped"
+    assert out["verdict"] == "pass"
+    # tighten the waste gate below the healthy fixture's 0.0312: flags
+    out = bench_diff.compare(base, base,
+                             {"padding_waste_max": 0.01})
+    assert _by_metric(out)["ledger_padding_waste.latency"]["status"] \
+        == "regression"
+    # loosen the imbalance gate past the regressed fixture's 1.82
+    reg = bench_diff.load_result(REGRESSED)
+    out = bench_diff.compare(base, reg,
+                             {"mesh_imbalance_max": 2.0})
+    assert _by_metric(out)["ledger_mesh_imbalance.mesh"]["status"] \
+        == "ok"
+
+
 def test_phase_focused_run_zero_value_skips_relative_gates():
     """A control-plane-focused run (BENCH_THROUGHPUT=0) reports
     value=0.0 — that is 'phase did not run', never a measured

@@ -709,3 +709,62 @@ def test_loadgen_sync_kzg_family_naming_lint():
     for fam in ("loadgen_events_total", "loadgen_sheds_total",
                 "loadgen_dedup_ratio"):
         assert fam in fams and fams[fam]["type"] is not None
+
+
+def test_dispatch_ledger_family_label_contract():
+    """The PR-13 dispatch-ledger families must not drift: the
+    padding-waste gauge carries exactly one `stage` label from the
+    CLOSED {lane, h2c} set (the lane series keeps the pre-ledger
+    unlabeled gauge's semantics), the imbalance gauge is unlabeled,
+    and the decision counter's three label vocabularies are all
+    closed — {ladder, pippenger} x {0, pow-2 devices} x the five plan
+    modes.  The ring itself is bounded memory."""
+    import teku_tpu.ops.provider  # noqa: F401 - registers families
+    from teku_tpu.infra import dispatchledger
+    from teku_tpu.infra.metrics import GLOBAL_REGISTRY
+
+    metrics = GLOBAL_REGISTRY.metrics()
+    waste = metrics["bls_dispatch_padding_waste_ratio"]
+    assert isinstance(waste, LabeledGauge)
+    assert tuple(waste.labelnames) == ("stage",)
+    stages = set(dispatchledger.WASTE_STAGES)
+    assert stages == {"lane", "h2c"}
+    for key, _child in waste._items():
+        assert set(key) <= stages, key
+    # both stage series exist from scrape 1 (pre-seeded)
+    assert {key[0] for key, _ in waste._items()} == stages
+
+    assert isinstance(metrics["bls_mesh_shard_imbalance_ratio"], Gauge)
+
+    dec = metrics["bls_dispatch_decision_total"]
+    assert isinstance(dec, LabeledCounter)
+    assert tuple(dec.labelnames) == ("msm_path", "mesh", "plan_mode")
+    pow2_vocab = {"0"} | {str(1 << i) for i in range(1, 9)}
+    for (msm_path, mesh, plan_mode), _child in dec._items():
+        assert msm_path in ("ladder", "pippenger"), msm_path
+        assert mesh in pow2_vocab, mesh
+        assert plan_mode in dispatchledger.PLAN_MODES, plan_mode
+    # the label folder can only emit the documented plan modes, on
+    # arbitrary (including garbage) inputs
+    for mode in (None, "latency", "throughput", "garbage", 3):
+        for level in (None, 0, 1, 2, 9, "x"):
+            assert dispatchledger.plan_mode_label(mode, level) \
+                in dispatchledger.PLAN_MODES
+
+    # bounded ring memory: capacity records retained, seq keeps counting
+    led = dispatchledger.DispatchLedger(capacity=4,
+                                        registry=MetricsRegistry())
+    for _ in range(9):
+        led.record({"lanes": 1,
+                    "waste": {"lane": {"real": 1, "padded": 2}},
+                    "msm": {"path": "ladder"}, "mesh": {"devices": 0},
+                    "admission": {}})
+    assert len(led.snapshot()) == 4
+    assert led.recorded_total == 9
+
+    # exposition stays structurally valid with the families declared
+    fams = parse_exposition(GLOBAL_REGISTRY.expose())
+    for fam in ("bls_dispatch_padding_waste_ratio",
+                "bls_mesh_shard_imbalance_ratio",
+                "bls_dispatch_decision_total"):
+        assert fam in fams and fams[fam]["type"] is not None

@@ -376,6 +376,13 @@ def test_resolve_auto_rules(monkeypatch):
         assert msm.resolve(lanes=256, rows=256) == "ladder"  # dup 1
         assert msm.resolve(lanes=8, rows=2) == "ladder"      # tiny
         assert msm.resolve(lanes=None, rows=None) == "ladder"
+        # crossover boundary compares the EXACT ratio: dup 1.9996
+        # must stay below the 2.0 threshold even though the ledger
+        # record's rounded why["dup"] reads 2.0
+        path, why = msm.explain(lanes=4999, rows=2500)
+        assert path == "ladder"
+        assert why["dup"] == 2.0                   # rounded for record
+        assert msm.resolve(lanes=5000, rows=2500) == "pippenger"
     # invalid env value degrades to auto with one warning
     monkeypatch.setenv(msm.ENV_VAR, "bogus")
     msm.set_path(None)

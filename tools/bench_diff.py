@@ -45,7 +45,12 @@ a failure — budget-starved runs drop phases):
   scaling series must be monotonic in device count, and on real
   parallel hardware (``series == "measured"``) efficiency at the max
   count ≥ ``mesh_efficiency_min`` × linear (serialized-virtual runs
-  report efficiency but only monotonicity is gated).
+  report efficiency but only monotonicity is gated);
+- ledger gates (absolute, per bench phase under ``ledger``): the
+  dispatch decision ledger's lane-bucket padding waste ≤
+  ``padding_waste_max`` and mesh shard makespan ratio ≤
+  ``mesh_imbalance_max`` on every phase that emitted them
+  (skip-if-missing).
 """
 
 import argparse
@@ -72,6 +77,13 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
     # serialized-virtual projection reports efficiency but its Amdahl
     # saturation (replicated finish) is expected, so it is not gated
     "mesh_efficiency_min": 0.7,
+    # dispatch-ledger gates (per bench phase that emitted a ledger
+    # summary): pow-2 lane-bucket padding waste must stay bounded, and
+    # the mesh shard makespan (max shard lane load / mean) must stay
+    # near balanced — both direct throughput observables the ledger
+    # (infra/dispatchledger.py) now records per dispatch
+    "padding_waste_max": 0.5,
+    "mesh_imbalance_max": 1.5,
 }
 
 
@@ -298,6 +310,32 @@ def compare(base: dict, new: dict,
                 lambda v: v >= thr["mainnet_dedup_ratio_min"],
                 f"committee-shaped mixes must keep dedup ratio >= "
                 f"{thr['mainnet_dedup_ratio_min']}")
+
+    # ledger gates (absolute, per phase, skip-if-missing): each bench
+    # phase's dispatch-ledger summary must keep padding waste and mesh
+    # shard imbalance inside the bounds — a regression here means the
+    # batch/shard planners started dispatching dead work even if the
+    # headline sigs/sec survived
+    for phase, led in sorted((new.get("ledger") or {}).items()):
+        if not isinstance(led, dict):
+            continue
+        waste = (led.get("padding_waste") or {}).get("lane")
+        if led.get("pinned_min_bucket"):
+            # the phase pinned its dispatch bucket for compile budget
+            # (bench latency phase): the waste measures the pin, not
+            # the production batch planner — skip, don't fail
+            waste = None
+        _check_absolute(
+            checks, f"ledger_padding_waste.{phase}", waste,
+            lambda v: v <= thr["padding_waste_max"],
+            f"lane-bucket padding waste must stay <= "
+            f"{thr['padding_waste_max']}")
+        _check_absolute(
+            checks, f"ledger_mesh_imbalance.{phase}",
+            (led.get("mesh_imbalance") or {}).get("max"),
+            lambda v: v <= thr["mesh_imbalance_max"],
+            f"mesh shard makespan ratio must stay <= "
+            f"{thr['mesh_imbalance_max']}")
 
     regressions = [c for c in checks if c["status"] == "regression"]
     return {"verdict": "regression" if regressions else "pass",
