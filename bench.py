@@ -1006,6 +1006,184 @@ def _mesh_phase(jax, deadline):
           efficiency=out.get("scaling_efficiency_at_max"))
 
 
+def _chaos_phase(jax, deadline):
+    """Mesh self-healing recovery-time objective (RTO) on the REAL
+    8-virtual-device mesh: serve committee batches through a
+    breaker-guarded mesh provider with the self-healer wired
+    (`parallel/selfheal.py` + `loader.make_mesh_healer`), wedge one
+    shard mid-serving via the keyed ``bls.mesh_shard`` fault, and
+    measure the full cycle — eject exactly the sick device, reshape
+    to the surviving pow-2 subset, AOT-warm, atomic swap, keep
+    serving on-device — then clear the fault and measure the readmit
+    grow-back.  Every verdict along the way is checked against the
+    expected truth (valid batches True, a tampered batch False):
+    ``wrong_verdicts`` must be ZERO in every run.
+
+    On virtual (serialized CPU) devices wall recovery time is
+    dominated by XLA compiles of the smaller sharded shape and by the
+    serialized shards, so ``series="virtual"`` and tools/bench_diff.py
+    gates only the correctness properties; real parallel hardware
+    reports ``series="measured"`` and must also beat
+    ``mesh_recovery_s_max``.  The fault kind defaults to a fast Raise
+    on virtual (wall-cheap) and a true Hang (deadline overrun) on
+    hardware; BENCH_CHAOS_FAULT={raise,hang} overrides."""
+    from teku_tpu import parallel
+    from teku_tpu.crypto.bls import keygen
+    from teku_tpu.crypto.bls.loader import (GuardedBls12381,
+                                            make_mesh_healer)
+    from teku_tpu.infra import faults
+    from teku_tpu.infra.supervisor import CircuitBreaker
+    from teku_tpu.ops.provider import JaxBls12381
+
+    from teku_tpu.infra.pow2 import floor_pow2
+    n_dev = floor_pow2(min(8, len(jax.devices())))
+    if n_dev < 4:
+        OUT["chaos"] = "skipped: needs >= 4 devices"
+        return
+    batch = int(os.environ.get("BENCH_CHAOS_BATCH", "64"))
+    dup = 8
+    virtual = jax.devices()[0].platform == "cpu"
+    fault_kind = os.environ.get(
+        "BENCH_CHAOS_FAULT", "raise" if virtual else "hang")
+    deadline_s = float(os.environ.get("BENCH_CHAOS_DEADLINE_S",
+                                      "5" if virtual else "20"))
+    led0 = _ledger_mark()
+    out: dict = {"devices": n_dev, "batch": batch, "dup": dup,
+                 "series": "virtual" if virtual else "measured",
+                 "fault": fault_kind}
+    OUT["chaos"] = out
+    _beat("chaos_phase_start", devices=n_dev, batch=batch,
+          fault=fault_kind)
+    warm_env_prev = os.environ.get("TEKU_TPU_MESH_WARM_BATCH")
+    # reshape warm = the serving shape set: the first post-swap
+    # dispatch must hit the jit cache, so recovery time includes the
+    # real AOT cost and nothing compiles on the serving path
+    os.environ["TEKU_TPU_MESH_WARM_BATCH"] = str(batch)
+    healer = None
+    try:
+        impl = JaxBls12381(max_batch=batch, min_bucket=batch,
+                           mesh=parallel.make_mesh(n_dev))
+        sick = impl.mesh_info["devices"][n_dev // 2 - 1]
+        breaker = CircuitBreaker(
+            failure_threshold=3, deadline_s=deadline_s,
+            cooldown_s=5.0, name="bench_chaos_device")
+        guarded = GuardedBls12381(impl, breaker)
+        healer = make_mesh_healer(
+            guarded, breaker, max_batch=batch, min_bucket=batch,
+            trip_threshold=1, probe_deadline_s=max(deadline_s, 2.0),
+            reprobe_s=1.0)
+        sks = [keygen(bytes([71 + i]) * 32) for i in range(16)]
+        pks = [impl.secret_key_to_public_key(sk) for sk in sks]
+        seq = [0]
+
+        def fresh():
+            uniq = max(batch // dup, 1)
+            seq[0] += 1
+            msgs = [b"chaos-%d-%d" % (seq[0], u) for u in range(uniq)]
+            sig_cache: dict = {}
+            triples = []
+            for lane in range(batch):
+                m = msgs[lane % uniq]
+                k = lane % 16
+                if (k, m) not in sig_cache:
+                    sig_cache[(k, m)] = impl.sign(sks[k], m)
+                triples.append(([pks[k]], m, sig_cache[(k, m)]))
+            return triples
+
+        wrong = 0
+
+        def check_serving(tag):
+            """One valid + one tampered batch; verdicts must match
+            the oracle truth exactly."""
+            nonlocal wrong
+            good = fresh()
+            if guarded.batch_verify(good) is not True:
+                wrong += 1
+            bad = list(good)
+            bad[3] = (bad[3][0], b"chaos-tampered", bad[3][2])
+            if guarded.batch_verify(bad) is not False:
+                wrong += 1
+            _beat("chaos_check", stage_name=tag, wrong=wrong)
+
+        WD.arm(max(deadline - time.time(), 60) + 900, "chaos warmup")
+        t0 = time.time()
+        if not impl.batch_verify(fresh()):
+            raise RuntimeError("chaos warmup batch failed")
+        out["warm_s"] = round(time.time() - t0, 1)
+        check_serving("before_fault")
+        # ---- the wedge: one shard of the live mesh goes sick -------
+        # times=None on BOTH kinds: the fault must keep firing for the
+        # sick device's ISOLATION PROBE after the collective dispatch
+        # consumed a firing — a budgeted fault would make the probe
+        # pass and attribution impossible (the probe deadline bounds
+        # each hang; the collective stops matching once ejected)
+        if fault_kind == "hang":
+            faults.inject("bls.mesh_shard", faults.Hang(
+                deadline_s + 10, key=sick))
+        else:
+            faults.inject("bls.mesh_shard", faults.Raise(
+                RuntimeError("bench chaos: shard wedged"), key=sick))
+        t_fault = time.time()
+        # this dispatch fails/overruns; the ORACLE serves it (correct
+        # verdict, zero failed in-flight) and the healer starts
+        if guarded.batch_verify(fresh()) is not True:
+            wrong += 1
+        # wait for the eject+reshape swap (includes the m{n/2} kernel
+        # compile on a cold cache); bounded by the REMAINING budget so
+        # a starved run records chaos_error and moves on instead of
+        # eating the phases behind it
+        swap_bound = max(120.0, min(900.0, deadline - time.time()))
+        while guarded.device is impl \
+                and time.time() - t_fault < swap_bound:
+            time.sleep(0.2)
+        if guarded.device is impl:
+            raise RuntimeError("healer never swapped the provider")
+        out["recovery_s"] = healer.last_recovery_s
+        out["recovery_wall_s"] = round(time.time() - t_fault, 1)
+        out["ejected_device"] = sick
+        out["live_after_eject"] = len(healer.live_devices)
+        faults.clear("bls.mesh_shard")
+        check_serving("on_shrunken_mesh")
+        out["serving_after_eject"] = guarded.serving
+        _beat("chaos_recovered", recovery_s=out["recovery_s"],
+              live=out["live_after_eject"])
+        # ---- readmit: the device recovered; the mesh grows back ----
+        # the grow completes at the INSTALL, not the ledger readmit —
+        # wait for the live width, bounded by the remaining budget
+        t_clear = time.time()
+        grow_bound = max(120.0, min(600.0, deadline - time.time()))
+        while (healer.ledger.ejected()
+               or len(healer.live_devices) < n_dev) \
+                and time.time() - t_clear < grow_bound:
+            time.sleep(0.2)
+        regrown = (not healer.ledger.ejected()
+                   and len(healer.live_devices) == n_dev)
+        out["regrow_s"] = (round(time.time() - t_clear, 1)
+                           if regrown else None)
+        out["live_after_readmit"] = len(healer.live_devices)
+        out["recovered"] = regrown
+        check_serving("after_readmit")
+        out["wrong_verdicts"] = wrong
+        out["reshapes"] = dict(healer.reshapes)
+        out["mesh"] = healer.snapshot()
+        _ledger_phase_summary("chaos", led0)
+        _beat("chaos_phase_done", recovery_s=out.get("recovery_s"),
+              regrow_s=out.get("regrow_s"), wrong=wrong,
+              recovered=out.get("recovered"))
+    finally:
+        # a raising phase must not leak a live reprobe daemon (it
+        # would keep probing/reshaping under the LATER bench phases)
+        # or leave the watchdog armed
+        if healer is not None:
+            healer.close()
+        WD.disarm()
+        faults.clear("bls.mesh_shard")
+        if warm_env_prev is None:
+            os.environ.pop("TEKU_TPU_MESH_WARM_BATCH", None)
+        else:
+            os.environ["TEKU_TPU_MESH_WARM_BATCH"] = warm_env_prev
+
+
 def _epoch_transition_phase(deadline):
     """Altair epoch transition on a synthetic large-validator state —
     the reference's EpochTransitionBenchmark surface (eth-benchmark-
@@ -1266,6 +1444,12 @@ def trajectory_entry(out: dict, run_id: str) -> dict:
     entry["mesh_series"] = mesh_block.get("series")
     entry["mesh_scaling_efficiency"] = mesh_block.get(
         "scaling_efficiency_at_max")
+    chaos = out.get("chaos") or {}
+    if isinstance(chaos, dict):
+        entry["chaos_recovery_s"] = chaos.get("recovery_s")
+        entry["chaos_wrong_verdicts"] = chaos.get("wrong_verdicts")
+        entry["chaos_series"] = chaos.get("series")
+        entry["chaos_recovered"] = chaos.get("recovered")
     return entry
 
 
@@ -1418,6 +1602,23 @@ def main():
             WD.disarm()
         except Exception as exc:
             OUT["epoch_error"] = f"{type(exc).__name__}: {exc}"
+    # chaos AFTER the wall-cheap virtual phases: its compiles (the
+    # reshaped kernel + warm shapes) must never starve them, and its
+    # own floor keeps a budget-tight run recording "skipped" instead
+    # of a watchdog kill
+    chaos_floor = float(os.environ.get("BENCH_CHAOS_MIN_BUDGET_S",
+                                       "600"))
+    if os.environ.get("BENCH_CHAOS", "1") != "0" and run_throughput:
+        if time.time() < deadline - chaos_floor:
+            try:
+                WD.arm(max(deadline - time.time(), 60) + 900,
+                       "chaos phase")
+                _chaos_phase(jax, deadline)
+                WD.disarm()
+            except Exception as exc:
+                OUT["chaos_error"] = f"{type(exc).__name__}: {exc}"
+        else:
+            OUT["chaos"] = "skipped: budget"
     try:
         if run_throughput:
             _throughput_phase(jax, deadline, batches[1:], detail)
@@ -1447,6 +1648,14 @@ def main():
     OUT["trajectory"] = append_trajectory(OUT)
     _beat("bench_done", total_s=OUT["total_s"])
     _emit()
+    # forced virtual host devices (the CPU-fallback mesh topology) can
+    # abort XLA teardown AFTER the result line was emitted, turning a
+    # clean run into rc 134 — same guard as `cli devnet`
+    try:
+        from teku_tpu.cli import _hard_exit_if_virtual_devices
+        _hard_exit_if_virtual_devices(0)
+    except Exception:
+        pass
 
 
 if __name__ == "__main__":

@@ -453,6 +453,47 @@ def cmd_node(args) -> int:
     return 0
 
 
+def _hard_exit_if_virtual_devices(rc: int) -> None:
+    """Devnet clean-shutdown guard (pre-existing issue, noted in PR
+    10): with a FORCED virtual host device count
+    (``--xla_force_host_platform_device_count``, the numeric ``--mesh
+    N`` path), XLA's CPU client teardown can race Python interpreter
+    finalization and segfault/abort AFTER all devnet work completed
+    and the verdict was printed — turning a clean run into rc 134/139.
+    Once jax has been imported under that flag, skip interpreter
+    teardown entirely: flush the evidence, disarm faulthandler (its
+    atexit hook would write to a closing file), and ``os._exit`` with
+    the real verdict.  Nothing of value runs after this point — the
+    flight recorder dumps on failure paths, the compile cache writes
+    at compile time.
+
+    Scope (``TEKU_TPU_DEVNET_HARD_EXIT``: auto|1|0): the guard is for
+    STANDALONE CLI processes whose next act is exiting anyway.  An
+    embedding process (the in-process pytest suite calls
+    ``main(["devnet", ...])`` directly) must never be os._exit'ed out
+    from under its caller — ``auto`` (default) skips whenever pytest
+    is loaded; ``1`` forces, ``0`` disables."""
+    mode = os.environ.get("TEKU_TPU_DEVNET_HARD_EXIT", "auto")
+    if mode in ("0", "off", "false"):
+        return
+    if mode != "1" and "pytest" in sys.modules:
+        return
+    if "jax" not in sys.modules:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        return
+    try:
+        import faulthandler
+        faulthandler.disable()
+    except Exception:
+        pass
+    logging.shutdown()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)
+
+
 def cmd_devnet(args) -> int:
     """In-process devnet: N nodes, loopback gossip, fast clock."""
     from .node import Devnet
@@ -486,7 +527,9 @@ def cmd_devnet(args) -> int:
         finally:
             await net.stop()
 
-    return asyncio.run(run())
+    rc = asyncio.run(run())
+    _hard_exit_if_virtual_devices(rc)
+    return rc
 
 
 def cmd_transition(args) -> int:
@@ -864,7 +907,7 @@ def _doctor_fetch_remote(base_url: str, last: int) -> dict:
             return json.loads(resp.read())
 
     out = {"records": [], "capacity": None, "slo": None,
-           "flight": [], "admission": None}
+           "flight": [], "admission": None, "mesh": None}
     try:
         dispatches = fetch(f"/teku/v1/admin/dispatches?last={last}")
     except Exception as exc:  # noqa: BLE001 - operator-facing CLI
@@ -886,6 +929,9 @@ def _doctor_fetch_remote(base_url: str, last: int) -> dict:
         readiness = fetch("/teku/v1/admin/readiness")
         out["slo"] = readiness.get("slo")
         out["admission"] = readiness.get("admission")
+        # the supervisor's mesh self-description (self_heal block):
+        # keeps mesh_degraded diagnosable after the flight ring rolls
+        out["mesh"] = (readiness.get("backend") or {}).get("mesh")
     except Exception:
         pass
     return out
@@ -918,18 +964,20 @@ def _doctor_probe_devnet(args) -> dict:
             slo = node.slo.snapshot() if node.slo is not None else None
             admission = (node.admission.snapshot()
                          if node.admission is not None else None)
-            return slo, admission
+            sup = getattr(node, "supervisor", None)
+            mesh = sup.mesh if sup is not None else None
+            return slo, admission, mesh
         finally:
             await net.stop()
 
-    slo, admission = asyncio.run(run())
+    slo, admission, mesh = asyncio.run(run())
     # same clamp the admin endpoint applies: a zero/negative --last
     # must not flip records[-last:] into a head-drop
     return {"records": dispatchledger.LEDGER.snapshot(
                 last=max(1, args.last)),
             "capacity": cap.snapshot(), "slo": slo,
             "flight": flightrecorder.RECORDER.snapshot(),
-            "admission": admission}
+            "admission": admission, "mesh": mesh}
 
 
 def cmd_doctor(args) -> int:
@@ -953,7 +1001,7 @@ def cmd_doctor(args) -> int:
     diagnosis = doctor.diagnose(
         inputs["records"], capacity=inputs.get("capacity"),
         slo=inputs.get("slo"), flight_events=inputs.get("flight"),
-        admission=inputs.get("admission"))
+        admission=inputs.get("admission"), mesh=inputs.get("mesh"))
     if args.json:
         print(json.dumps(diagnosis, indent=1, default=str))
     else:

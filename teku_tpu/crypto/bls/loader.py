@@ -126,24 +126,60 @@ class GuardedBls12381(BLS12381):
     """
 
     def __init__(self, device: BLS12381, breaker: CircuitBreaker,
-                 oracle: Optional[BLS12381] = None):
-        self.device = device
+                 oracle: Optional[BLS12381] = None,
+                 registry: MetricsRegistry = GLOBAL_REGISTRY):
         self.breaker = breaker
         self.oracle = oracle or PureBls12381()
+        # optional mesh self-healer (parallel/selfheal.MeshHealer):
+        # dispatch failures are reported so shard-level fault
+        # isolation can eject the sick device and reshape, instead of
+        # the whole-backend breaker cliff being the only containment
+        self.healer = None
         # degraded-mode visibility: every guarded dispatch labeled by
         # the backend that actually served it and why — a node quietly
         # paying oracle latency must show up on one PromQL ratio
-        self._m_requests = GLOBAL_REGISTRY.labeled_counter(
+        self._m_requests = registry.labeled_counter(
             "bls_verify_requests_total",
             "guarded BLS dispatches by serving backend and reason",
             labelnames=("backend", "reason"))
-        # serializes device entry: a timed-out dispatch's orphaned
-        # thread may still be running (e.g. finishing a cold compile)
-        # and the provider's caches are not safe under concurrent
-        # mutation.  A later dispatch blocks here until the orphan
-        # drains; the breaker deadline bounds that wait and accounts
-        # it as a timeout, so a busy device reads as a busy device
-        self._device_lock = threading.Lock()
+        # (provider, device-entry lock) as ONE atomically-swapped pair.
+        # The lock serializes device entry: a timed-out dispatch's
+        # orphaned thread may still be running (e.g. finishing a cold
+        # compile) and the provider's caches are not safe under
+        # concurrent mutation.  A later dispatch blocks there until
+        # the orphan drains; the breaker deadline bounds that wait and
+        # accounts it as a timeout, so a busy device reads as a busy
+        # device.  The mesh-reshape hot-swap replaces the PAIR in one
+        # reference assignment: dispatches that grabbed the old pair
+        # complete on the old plan (their orphans keep the old lock),
+        # new dispatches take the new provider immediately and never
+        # queue behind a wedged orphan.
+        self._serving = (device, threading.Lock())
+
+    @property
+    def device(self) -> BLS12381:
+        return self._serving[0]
+
+    @property
+    def _device_lock(self) -> threading.Lock:
+        return self._serving[1]
+
+    def swap_device(self, new_device: BLS12381) -> None:
+        """Atomic mid-mesh hot-swap (the reshape install hook): one
+        reference assignment, same invariant as the PR-1 install swap
+        — in-flight verifies complete on the implementation pair they
+        grabbed, new verifies take the reshaped provider."""
+        self._serving = (new_device, threading.Lock())
+
+    def _notify_healer(self, exc: BaseException, timeout: bool) -> None:
+        healer = self.healer
+        if healer is None:
+            return
+        try:
+            healer.on_dispatch_failure(
+                error=f"{type(exc).__name__}: {exc}", timeout=timeout)
+        except Exception:  # pragma: no cover - healing must not kill
+            _LOG.exception("mesh healer notification failed")
 
     @property
     def name(self) -> str:
@@ -173,10 +209,13 @@ class GuardedBls12381(BLS12381):
 
     # --- guarded device dispatches ------------------------------------
     def _guarded(self, op: str, *args):
-        device_fn = getattr(self.device, op)
+        # ONE read of the serving pair: the provider and its entry
+        # lock stay consistent even when a reshape swaps mid-call
+        device, lock = self._serving
+        device_fn = getattr(device, op)
 
         def locked():
-            with self._device_lock:
+            with lock:
                 return device_fn(*args)
 
         try:
@@ -192,12 +231,14 @@ class GuardedBls12381(BLS12381):
                                     reason="fallback").inc()
             _LOG.warning("device %s overran deadline (%s); serving "
                          "this call from the oracle", op, exc)
+            self._notify_healer(exc, timeout=True)
         except Exception as exc:  # noqa: BLE001 - any device fault
             self._m_requests.labels(backend="oracle",
                                     reason="fallback").inc()
             _LOG.warning("device %s failed (%s: %s); serving this "
                          "call from the oracle", op,
                          type(exc).__name__, exc)
+            self._notify_healer(exc, timeout=False)
         # the oracle serving a device's call IS the degraded-mode cost:
         # a separate stage so traces show where the p50 went
         with tracing.span("oracle_execute"):
@@ -252,6 +293,197 @@ class GuardedBls12381(BLS12381):
         return ok
 
 
+def _warmup_batches(impl, max_batch: int) -> None:
+    """Compile the verify pipeline OFF the gossip path (VERDICT r5
+    weak #3: the first real batch used to pay a multi-minute staged
+    compile in the hot path), at the two batch shapes the node
+    dispatches most: the min_bucket pad and the primary bucket.
+    Other (pow-2 × kmax) shapes still compile lazily — a cold compile
+    that overruns the breaker deadline serves that call from the
+    oracle while the orphaned dispatch thread finishes populating the
+    jit cache, so the shape warms itself.  Shared by supervisor
+    WARMING and the mesh self-healer's reshape warm (the shrunken
+    sharded shape set must compile off-path too).  Raises
+    WarmupVetoError on a wrong verdict — a device that gets a KNOWN
+    answer wrong must never serve."""
+    oracle = PureBls12381()
+    msg = b"teku-tpu warmup"
+    sig = oracle.sign(1, msg)
+    triple = ([_PROBE_PK], msg, sig)
+    if not impl.batch_verify([triple]):
+        raise WarmupVetoError("warmup batch (x1) did not verify")
+    # primary bucket with DISTINCT messages: the dedup-aware
+    # pipeline specializes on the unique-message bucket, and
+    # all-unique (fresh gossip, dup factor 1) is the worst-case
+    # shape — warm that first
+    batch = [([_PROBE_PK], m, oracle.sign(1, m))
+             for m in (b"teku-tpu warmup %d" % i
+                       for i in range(max_batch))]
+    if not impl.batch_verify(batch):
+        # a wrong verdict on a known-good signature is a device
+        # we must never install
+        raise WarmupVetoError(
+            f"warmup batch (x{max_batch}) did not verify")
+    if max_batch >= 8:
+        # committee-duplicated shape (dup factor 8, the common
+        # gossip mix): the grouped pipeline specializes on the
+        # (unique, group) bucket pair, and the first REAL committee
+        # batch must not pay that compile inside a breaker-guarded
+        # live dispatch
+        dup = [batch[i // 8] for i in range(max_batch)]
+        if not impl.batch_verify(dup):
+            raise WarmupVetoError(
+                f"warmup batch (x{max_batch}, dup 8) did not verify")
+
+
+# --------------------------------------------------------------------------
+# Mesh self-healing wiring (parallel/selfheal.MeshHealer, jax world)
+# --------------------------------------------------------------------------
+
+def make_mesh_healer(guarded: GuardedBls12381,
+                     breaker: Optional[CircuitBreaker] = None, *,
+                     max_batch: int = 256, min_bucket: int = 16,
+                     supervisor=None,
+                     registry: MetricsRegistry = GLOBAL_REGISTRY,
+                     warm: bool = True,
+                     **healer_kw):
+    """Wire shard-level fault isolation around a mesh-backed guarded
+    provider: per-device health ledger, eject + reshape onto the
+    largest surviving pow-2 subset, AOT warm of the shrunken shape
+    set, atomic ``swap_device`` install, background readmit.
+
+    Returns the ``MeshHealer`` (also assigned to ``guarded.healer``),
+    or None when the serving provider is not mesh-backed or
+    ``TEKU_TPU_MESH_SELF_HEAL=0`` opts out."""
+    import numpy as _np
+
+    from ...infra import capacity
+    from ... import parallel
+    from ...parallel import selfheal
+
+    impl = guarded.device
+    sharded = getattr(impl, "_sharded", None)
+    if sharded is None or os.environ.get(
+            "TEKU_TPU_MESH_SELF_HEAL", "1") in ("0", "off", "false"):
+        return None
+    mesh_devices = list(_np.ravel(sharded.mesh.devices))
+    names = [str(d) for d in mesh_devices]
+
+    def probe(idx: int) -> None:
+        # the keyed fault site first (keys are device NAMES, the same
+        # vocabulary the collective dispatch passes): the chaos
+        # harness wedges exactly one chip by key, and only that
+        # chip's probe may fail here
+        faults.check(selfheal.FAULT_SITE, keys=(names[idx],))
+        import jax
+        import jax.numpy as jnp
+        # a tiny computation PLACED on the device proves its runtime
+        # executes and answers; the reshape warm below proves the
+        # full verify pipeline on the surviving collective
+        x = jax.device_put(_np.arange(8, dtype=_np.int32),
+                           mesh_devices[idx])
+        if int(jnp.sum(x)) != 28:
+            raise BlsLoadError(
+                f"device {names[idx]} probe computed garbage")
+
+    def make_backend(live):
+        from ...ops.provider import JaxBls12381
+        if len(live) >= 2:
+            # advertise=False: this is a CANDIDATE — the gauge and
+            # readiness keep describing the SERVING mesh until the
+            # install hook swaps (a vetoed warm must leave them
+            # untouched)
+            mesh_obj = parallel.make_mesh(
+                devices=[mesh_devices[i] for i in live],
+                advertise=False)
+            return JaxBls12381(max_batch=max_batch,
+                               min_bucket=min_bucket, mesh=mesh_obj)
+        # one healthy chip left: single-device dispatch
+        return JaxBls12381(max_batch=max_batch, min_bucket=min_bucket)
+
+    def heal_warm(new_impl, live):
+        if not warm:
+            return
+        # bounded reshape warm: recovery time is the objective, so the
+        # warm batch is a knob (default a fraction of the service
+        # bucket; the persistent compile cache usually turns this into
+        # disk loads).  A wrong verdict VETOES the install.
+        from ...infra.env import env_int
+        wb = max(1, env_int("TEKU_TPU_MESH_WARM_BATCH",
+                            min(max_batch, 64)))
+        try:
+            _warmup_batches(new_impl, wb)
+        except WarmupVetoError as exc:
+            raise selfheal.InstallVetoError(str(exc)) from exc
+
+    healer_box: list = []
+
+    def heal_install(backend, live, epoch):
+        if backend is None:
+            # mesh shrank to ZERO healthy devices: the oracle is the
+            # last resort — keep the old guarded pair; its breaker
+            # trips on the next failure and owns recovery from there.
+            # The gauge must agree with the readiness snapshot below:
+            # no serving mesh to advertise
+            parallel.reset_active_mesh()
+            _LOG.error(
+                "mesh shrank to zero healthy devices; oracle is the "
+                "last resort (backend breaker owns recovery)")
+        else:
+            backend.mesh_epoch = epoch
+            guarded.swap_device(backend)
+            # the INSTALLED topology is now the serving truth: publish
+            # it (candidate meshes were built with advertise=False)
+            mesh_info = getattr(backend, "mesh_info", None)
+            if mesh_info:
+                parallel.advertise_mesh(mesh_info["devices"],
+                                        mesh_info.get("axis")
+                                        or parallel.DEFAULT_AXIS)
+            else:
+                parallel.reset_active_mesh()
+            try:
+                # the admission planner's batch sizing must model the
+                # LIVE topology: retire latency series recorded under
+                # the old mesh size so plans shrink with the mesh
+                capacity.TELEMETRY.latency.retire_mesh_shapes(
+                    len(live) if len(live) >= 2 else 0)
+            except Exception:  # pragma: no cover - advisory
+                _LOG.exception("latency-series retirement failed")
+            if breaker is not None:
+                # the reshape warm just verified known-good signatures
+                # on the new backend: close the circuit so serving
+                # resumes immediately instead of waiting out a cooldown
+                breaker.record_success()
+        if supervisor is not None:
+            mesh_desc = (getattr(backend, "mesh_info", None)
+                         if backend is not None else None)
+            if mesh_desc is None and backend is not None:
+                mesh_desc = {"devices": [names[i] for i in live],
+                             "n_devices": len(live), "axis": None}
+            sup_mesh = dict(mesh_desc
+                            or {"devices": [], "n_devices": 0,
+                                "axis": None})
+            if healer_box:
+                # the FULL healer snapshot, same schema the initial
+                # install publishes — with live/epoch overridden from
+                # the hook args (the healer updates its installed-live
+                # field only after this hook returns)
+                snap = healer_box[0].snapshot()
+                snap["live"] = len(live)
+                snap["live_devices"] = [names[i] for i in live]
+                snap["epoch"] = epoch
+                sup_mesh["self_heal"] = snap
+            supervisor.mesh = sup_mesh
+
+    healer = selfheal.MeshHealer(
+        names, probe=probe, make_backend=make_backend,
+        install=heal_install, warm=heal_warm,
+        registry=registry, **healer_kw)
+    healer_box.append(healer)
+    guarded.healer = healer
+    return healer
+
+
 # --------------------------------------------------------------------------
 # Supervised bring-up (the CLI's `auto`)
 # --------------------------------------------------------------------------
@@ -302,43 +534,7 @@ def make_supervisor(*, max_batch: int = 256, min_bucket: int = 16,
         if not warm:
             return
         impl, _ = backend
-        # compile the verify pipeline OFF the gossip path (VERDICT r5
-        # weak #3: the first real batch used to pay a multi-minute
-        # staged compile in the hot path), at the two batch shapes the
-        # node dispatches most: the min_bucket pad and the batching
-        # service's primary bucket.  Other (pow-2 × kmax) shapes still
-        # compile lazily — a cold compile that overruns the breaker
-        # deadline serves that call from the oracle while the orphaned
-        # dispatch thread finishes populating the jit cache, so the
-        # shape warms itself.
-        oracle = PureBls12381()
-        msg = b"teku-tpu warmup"
-        sig = oracle.sign(1, msg)
-        triple = ([_PROBE_PK], msg, sig)
-        if not impl.batch_verify([triple]):
-            raise WarmupVetoError("warmup batch (x1) did not verify")
-        # primary bucket with DISTINCT messages: the dedup-aware
-        # pipeline specializes on the unique-message bucket, and
-        # all-unique (fresh gossip, dup factor 1) is the worst-case
-        # shape — warm that first
-        batch = [([_PROBE_PK], m, oracle.sign(1, m))
-                 for m in (b"teku-tpu warmup %d" % i
-                           for i in range(max_batch))]
-        if not impl.batch_verify(batch):
-            # a wrong verdict on a known-good signature is a device
-            # we must never install
-            raise WarmupVetoError(
-                f"warmup batch (x{max_batch}) did not verify")
-        if max_batch >= 8:
-            # committee-duplicated shape (dup factor 8, the common
-            # gossip mix): the grouped pipeline specializes on the
-            # (unique, group) bucket pair, and the first REAL committee
-            # batch must not pay that compile inside a breaker-guarded
-            # live dispatch
-            dup = [batch[i // 8] for i in range(max_batch)]
-            if not impl.batch_verify(dup):
-                raise WarmupVetoError(
-                    f"warmup batch (x{max_batch}, dup 8) did not verify")
+        _warmup_batches(impl, max_batch)
 
     def install(backend):
         impl, device = backend
@@ -358,6 +554,27 @@ def make_supervisor(*, max_batch: int = 256, min_bucket: int = 16,
             # devices, how many, which axis) — MULTICHIP runs and
             # multi-node operators read it from /teku/v1/admin/readiness
             supervisor_box[0].mesh = getattr(impl, "mesh_info", None)
+        if getattr(impl, "mesh_info", None):
+            # shard-level fault isolation: a wedged chip costs 1/N
+            # capacity (eject + reshape + readmit), not the whole-mesh
+            # breaker cliff.  Failure here degrades to the PR-10
+            # semantics (one breaker per backend), never blocks install
+            try:
+                healer = make_mesh_healer(
+                    guarded, breaker, max_batch=max_batch,
+                    min_bucket=min_bucket, registry=registry,
+                    supervisor=(supervisor_box[0] if supervisor_box
+                                else None))
+                if healer is not None:
+                    installed["healer"] = healer
+                    if supervisor_box:
+                        sup_mesh = dict(impl.mesh_info)
+                        sup_mesh["self_heal"] = healer.snapshot()
+                        supervisor_box[0].mesh = sup_mesh
+            except Exception:  # pragma: no cover - defensive
+                _LOG.exception("mesh self-healing unavailable; the "
+                               "whole-mesh breaker remains the only "
+                               "containment")
         _LOG.info("BLS implementation hot-swapped: %s on %s "
                   "(breaker deadline %.1fs)", impl.name, device,
                   breaker.deadline_s)
@@ -365,6 +582,9 @@ def make_supervisor(*, max_batch: int = 256, min_bucket: int = 16,
     def uninstall():
         reset_implementation()
         _reset_kzg_backend()
+        healer = installed.pop("healer", None)
+        if healer is not None:
+            healer.close()
         if supervisor_box:
             # no installed backend => no serving mesh: the name-
             # prefixed gauge and readiness snapshot must not keep
@@ -381,8 +601,12 @@ def make_supervisor(*, max_batch: int = 256, min_bucket: int = 16,
         oracle = PureBls12381()
         msg = b"teku-tpu reprobe"
         sig = oracle.sign(1, msg)
-        with guarded._device_lock:     # same orphan-thread rule
-            ok = guarded.device.batch_verify([([_PROBE_PK], msg, sig)])
+        # ONE read of the (provider, lock) pair — two property reads
+        # could straddle a reshape swap and dispatch on the new
+        # provider while holding the OLD pair's lock
+        device, lock = guarded._serving
+        with lock:                     # same orphan-thread rule
+            ok = device.batch_verify([([_PROBE_PK], msg, sig)])
         if not ok:
             raise BlsLoadError("reprobe batch did not verify")
 

@@ -173,6 +173,13 @@ class ShapeLatencyModel:
         self._entries: Dict[Tuple[str, str], _ShapeEntry] = {}
         self._shapes: set = set()
         self._lock = threading.Lock()
+        # live-topology filter (set by retire_mesh_shapes): None = no
+        # filter; "" = single-device serving; "@mN" = mesh of N.  The
+        # hot-swap lets in-flight dispatches COMPLETE on the old plan
+        # after a reshape, and their late observe() must not resurrect
+        # the retired series the admission planner just stopped
+        # modeling against.
+        self._topology: Optional[str] = None
         self._m_latency = registry.labeled_gauge(
             "bls_shape_device_latency_seconds",
             "modeled per-shape device latency (true device time under "
@@ -180,20 +187,34 @@ class ShapeLatencyModel:
             "shape and mont_mul path",
             labelnames=("shape", "path", "stat"))
 
-    def _key(self, shape: str, path: str) -> Tuple[str, str]:
+    def _stale_topology(self, shape: str) -> bool:
+        """Does `shape` belong to a topology other than the live one?
+        (caller holds the lock; None filter = nothing is stale)"""
+        if self._topology is None:
+            return False
+        if "@m" in shape:
+            return not (self._topology
+                        and shape.endswith(self._topology))
+        return bool(self._topology)
+
+    def observe(self, shape: str, path: str, seconds: float) -> None:
+        shape, path = str(shape), str(path)
         with self._lock:
+            if self._stale_topology(shape):
+                # a dispatch that completed late on a RETIRED topology
+                # (the reshape hot-swap lets old-plan dispatches
+                # finish): recording it would re-create the dead
+                # series and latency_for_lanes' worst-match would keep
+                # sizing batches against it — drop the sample
+                return
             if shape not in self._shapes:
                 if len(self._shapes) >= self.max_shapes:
                     shape = self.OVERFLOW
                 self._shapes.add(shape)
             key = (shape, path)
-            if key not in self._entries:
-                self._entries[key] = _ShapeEntry(self.window)
-            return key
-
-    def observe(self, shape: str, path: str, seconds: float) -> None:
-        key = self._key(str(shape), str(path))
-        entry = self._entries[key]
+            entry = self._entries.get(key)
+            if entry is None:
+                entry = self._entries[key] = _ShapeEntry(self.window)
         with entry.lock:
             entry.count += 1
             entry.samples.append(seconds)
@@ -234,6 +255,40 @@ class ShapeLatencyModel:
             return None
         with entry.lock:
             return self._stats_locked(entry)[stat]
+
+    def retire(self, predicate: Callable[[str], bool]) -> int:
+        """Drop every series whose SHAPE matches `predicate` and free
+        its slot in the bounded shape set.  The exported gauge
+        children keep their last value (Prometheus series are
+        append-only here); the MODEL — what latency_for_lanes and the
+        admission planner read — forgets them.  Returns the number of
+        series dropped."""
+        with self._lock:
+            victims = [k for k in self._entries if predicate(k[0])]
+            for k in victims:
+                del self._entries[k]
+            self._shapes = {k[0] for k in self._entries}
+            return len(victims)
+
+    def retire_mesh_shapes(self, live_devices: int) -> int:
+        """Mesh reshape hook: retire latency series recorded under any
+        OTHER topology (a different ``@mN`` suffix, or the no-mesh
+        family when a mesh now serves, or any mesh family when the
+        healer fell back to single-device), and install the live
+        topology as the model's filter so LATE observes from old-plan
+        dispatches cannot resurrect them.  Without this, the
+        admission controller's worst-match ``latency_for_lanes`` would
+        size batches against the dead topology's device times — e.g.
+        keep 8-chip batch plans after the mesh shrank to 4."""
+        suffix = f"@m{int(live_devices)}" if live_devices else ""
+        with self._lock:
+            self._topology = suffix
+
+        def stale(shape: str) -> bool:
+            if "@m" in shape:
+                return not (suffix and shape.endswith(suffix))
+            return bool(suffix)   # single-device series, mesh serving
+        return self.retire(stale)
 
     def latency_for_lanes(self, lanes: int, stat: str = "p50_s"
                           ) -> Optional[float]:

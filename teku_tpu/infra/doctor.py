@@ -215,6 +215,100 @@ def _msm_findings(records: List[dict]) -> List[dict]:
         metrics={"dispatches": len(demoted), "why": why})]
 
 
+def _mesh_health_findings(events: List[dict],
+                          records: List[dict],
+                          mesh: Optional[dict] = None) -> List[dict]:
+    """Self-healing mesh diagnosis:
+
+    - ``mesh_degraded``: the mesh is serving below its configured
+      width — 1/N-reduced device capacity right now.  The CURRENT
+      state comes from the supervisor's mesh snapshot (``self_heal``
+      block on the readiness body) when available: the bounded flight
+      ring can roll the reshape event off while the mesh is still
+      degraded (the same bug class the brownout_active finding fixed
+      in PR 11); the flight events remain the evidence citations —
+      the ejection carries the trace id of the dispatch that killed
+      the chip — and the fallback source when no snapshot was given.
+    - ``mesh_flap``: repeated eject↔readmit cycles of the same device
+      — a chip that keeps passing the readmit probe and then wedging
+      again under real load (marginal interconnect, thermal) costs a
+      reshape + AOT warm per cycle and should be held out manually.
+    """
+    out = []
+    ejects = [e for e in events or [] if e.get("kind") == "mesh_eject"]
+    reshapes = [e for e in events or []
+                if e.get("kind") == "mesh_reshape"]
+    readmits = [e for e in events or []
+                if e.get("kind") == "mesh_readmit"]
+
+    def linked(evs):
+        cites = [_cite_event(e) for e in evs[-3:]]
+        ids = {e.get("trace_id") for e in evs if e.get("trace_id")}
+        for r in records:
+            if ids & set(r.get("trace_ids") or ()):
+                cites.append(_cite(r))
+        return cites
+
+    # current degraded state: snapshot first (authoritative), last
+    # reshape event as the fallback
+    to_n = configured = epoch = None
+    heal = (mesh or {}).get("self_heal") or {}
+    if isinstance(heal.get("live"), (int, float)) \
+            and isinstance(heal.get("configured"), (int, float)):
+        to_n, configured = heal["live"], heal["configured"]
+        epoch = heal.get("epoch")
+    elif reshapes:
+        last = reshapes[-1]
+        to_n = last.get("to_devices")
+        configured = last.get("configured")
+        epoch = last.get("epoch")
+    if isinstance(to_n, (int, float)) \
+            and isinstance(configured, (int, float)) \
+            and to_n < configured:
+        lost = 1.0 - (to_n / configured if configured else 0.0)
+        out.append(_finding(
+            "mesh_degraded", 45 + 30 * lost,
+            f"mesh running at {int(to_n)}/{int(configured)} "
+            f"configured device(s) (epoch {epoch}, "
+            f"{len(ejects)} ejection(s) in the event window)",
+            "the self-healer ejected sick device(s) and reshaped "
+            "onto the largest surviving pow-2 subset — serving "
+            "continues on-device at reduced capacity while the "
+            "background reprobe waits for the chip to recover; "
+            "the cited ejections name the dispatch that killed "
+            "each device.  Expect capacity to step back up 1/N "
+            "at a time on readmit (PERF.md 'Mesh self-healing')",
+            evidence=linked(ejects[-2:] + reshapes[-1:]),
+            metrics={"live_devices": to_n,
+                     "configured_devices": configured,
+                     "epoch": epoch,
+                     "ejects": len(ejects),
+                     "recovery_s": (reshapes[-1].get("recovery_s")
+                                    if reshapes else None)}))
+    by_device: Dict[str, int] = {}
+    for e in ejects:
+        d = str(e.get("device", "?"))
+        by_device[d] = by_device.get(d, 0) + 1
+    flappers = {d: n for d, n in by_device.items() if n >= 2}
+    if flappers:
+        worst = max(flappers, key=flappers.get)
+        out.append(_finding(
+            "mesh_flap", 55 + 5 * min(flappers[worst], 5),
+            f"device {worst} ejected {flappers[worst]}x "
+            f"({len(readmits)} readmit(s) in the window)",
+            "eject↔readmit cycling: the chip passes the synthetic "
+            "readmit probe, rejoins the mesh, then wedges again under "
+            "real load — every cycle pays a reshape + AOT warm of the "
+            "sharded shape set.  A marginal device should be held out "
+            "of TEKU_TPU_MESH explicitly until serviced; raising "
+            "TEKU_TPU_MESH_REPROBE_S slows the flapping meanwhile",
+            evidence=linked([e for e in ejects
+                             if str(e.get("device")) == worst]),
+            metrics={"by_device": by_device,
+                     "readmits": len(readmits)}))
+    return out
+
+
 def _flight_findings(events: List[dict],
                      records: List[dict]) -> List[dict]:
     out = []
@@ -375,10 +469,15 @@ def diagnose(records: List[dict],
              capacity: Optional[dict] = None,
              slo: Optional[dict] = None,
              flight_events: Optional[List[dict]] = None,
-             admission: Optional[dict] = None) -> dict:
+             admission: Optional[dict] = None,
+             mesh: Optional[dict] = None) -> dict:
     """Rank everything the ledger + sensors can explain about the
     current latency budget.  All inputs are plain JSON-able snapshots
-    (local globals or fetched from a remote node's admin endpoints)."""
+    (local globals or fetched from a remote node's admin endpoints);
+    ``mesh`` is the supervisor's mesh self-description (the readiness
+    body's ``backend.mesh``, carrying the healer's ``self_heal``
+    block) so a degraded mesh stays diagnosable after its events roll
+    off the bounded flight ring."""
     records = list(records or [])
     summary = dispatchledger.summarize(records)
     findings: List[dict] = []
@@ -387,6 +486,8 @@ def diagnose(records: List[dict],
     findings += _padding_findings(records, summary)
     findings += _h2c_findings(records, summary)
     findings += _msm_findings(records)
+    findings += _mesh_health_findings(flight_events or [], records,
+                                      mesh=mesh)
     findings += _flight_findings(flight_events or [], records)
     findings += _capacity_findings(capacity)
     findings += _admission_findings(admission)

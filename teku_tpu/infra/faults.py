@@ -12,6 +12,15 @@ Sites in use (grep for `faults.check` / `faults.transform`):
 
 - ``backend.init``       device bring-up probe (SlowRamp / Raise / Hang)
 - ``bls.dispatch``       JaxBls12381 device dispatch (begin + result)
+- ``bls.mesh_shard``     the sharded mesh dispatch.  Faults here may
+                         carry a ``key`` (a device index): the
+                         collective dispatch passes the LIVE device
+                         set (a wedged shard wedges the whole
+                         collective), while the self-healing mesh's
+                         per-device isolation probes pass one index —
+                         so a keyed fault models exactly one sick
+                         chip, and only that chip's probe fails
+                         (teku_tpu/parallel/selfheal.py)
 - ``bls.batch_verify``   the BLS facade's batch entry (WrongResult)
 - ``h2c.cache``          H(m) device-cache slot resolution
                          (WrongResult(value=slot) poisons a hit; the
@@ -41,13 +50,24 @@ class Fault:
     """One injectable failure.  `times` bounds how often it fires
     (None = every time until cleared).  `kind` decides whether the
     fault spends its budget at check() (entry) or transform() (result)
-    — a WrongResult must not be consumed by the entry hook."""
+    — a WrongResult must not be consumed by the entry hook.  `key`
+    scopes the fault to one member of a keyed site (e.g. a mesh device
+    index): it fires only when the site's check() names that key in
+    its ``keys`` — a keyless fault fires on every call, and a keyed
+    fault never fires at a call that passes no keys (the caller is
+    not key-aware, so a device-scoped fault cannot leak into it)."""
 
     kind = "check"
 
-    def __init__(self, times: Optional[int] = None):
+    def __init__(self, times: Optional[int] = None, key=None):
         self.times = times
+        self.key = key
         self.fired = 0
+
+    def _matches(self, keys) -> bool:
+        if self.key is None:
+            return True
+        return keys is not None and self.key in keys
 
     def _consume(self) -> bool:
         if self.times is not None and self.fired >= self.times:
@@ -67,8 +87,9 @@ class Hang(Fault):
     """Dispatch hang: the call blocks for `seconds` (long enough to
     overrun a breaker deadline, short enough for tests)."""
 
-    def __init__(self, seconds: float, times: Optional[int] = None):
-        super().__init__(times)
+    def __init__(self, seconds: float, times: Optional[int] = None,
+                 key=None):
+        super().__init__(times, key=key)
         self.seconds = seconds
 
     def on_check(self) -> None:
@@ -79,8 +100,8 @@ class Raise(Fault):
     """Dispatch exception: the call raises `exc` (an instance or a
     zero-arg factory)."""
 
-    def __init__(self, exc, times: Optional[int] = None):
-        super().__init__(times)
+    def __init__(self, exc, times: Optional[int] = None, key=None):
+        super().__init__(times, key=key)
         self.exc = exc
 
     def on_check(self) -> None:
@@ -94,8 +115,9 @@ class WrongResult(Fault):
 
     kind = "transform"
 
-    def __init__(self, value=None, times: Optional[int] = None):
-        super().__init__(times)
+    def __init__(self, value=None, times: Optional[int] = None,
+                 key=None):
+        super().__init__(times, key=key)
         self.value = value
 
     def on_transform(self, result):
@@ -117,8 +139,9 @@ class Overflow(Fault):
     """Queue overflow: admission raises the overflow error class the
     site's shed path handles (default asyncio.QueueFull)."""
 
-    def __init__(self, exc=None, times: Optional[int] = None):
-        super().__init__(times)
+    def __init__(self, exc=None, times: Optional[int] = None,
+                 key=None):
+        super().__init__(times, key=key)
         self.exc = exc
 
     def on_check(self) -> None:
@@ -163,26 +186,33 @@ def fired_count(site: str) -> int:
         return sum(f.fired for f in _FAULTS.get(site, ()))
 
 
-def check(site: str) -> None:
+def check(site: str, keys=None) -> None:
     """Call at a dispatch site BEFORE the real work: installed faults
-    may sleep (Hang/SlowRamp) or raise (Raise/Overflow)."""
+    may sleep (Hang/SlowRamp) or raise (Raise/Overflow).  ``keys``
+    names the site members this call touches (e.g. the live mesh
+    device indices): keyed faults fire only when their key is named,
+    so a per-device fault wedges the collective dispatch AND that one
+    device's isolation probe, and nothing else."""
     if not _ACTIVE:
         return
     with _LOCK:
         faults = [f for f in _FAULTS.get(site, ())
-                  if f.kind == "check" and f._consume()]
+                  if f.kind == "check" and f._matches(keys)
+                  and f._consume()]
     for f in faults:
         f.on_check()
 
 
-def transform(site: str, value):
+def transform(site: str, value, keys=None):
     """Call at a dispatch site on the RESULT: WrongResult faults
-    corrupt the value on its way out."""
+    corrupt the value on its way out (same ``keys`` scoping as
+    check())."""
     if not _ACTIVE:
         return value
     with _LOCK:
         faults = [f for f in _FAULTS.get(site, ())
-                  if f.kind == "transform" and f._consume()]
+                  if f.kind == "transform" and f._matches(keys)
+                  and f._consume()]
     for f in faults:
         value = f.on_transform(value)
     return value

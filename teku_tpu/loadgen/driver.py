@@ -24,15 +24,36 @@ fallback seams are the production code paths.
 Per-scenario evidence (the ``cli loadgen`` report and bench's
 ``mainnet`` phase): sigs/sec, per-class p50/p99 and shed counts,
 dedup ratio, coalesced/bisect counts, and every brownout transition.
+
+CHAOS scenarios (``Scenario.mesh_devices`` + a ``chaos`` schedule)
+route the model through the REAL supervisor machinery —
+``GuardedBls12381`` + breaker + ``parallel/selfheal.MeshHealer`` over
+a model mesh — and arm timed device-keyed ``bls.mesh_shard`` faults
+mid-run, so eject/reshape/readmit runs under traffic and the report
+carries the full recovery evidence (``rep["chaos"]``).
+
+VIRTUAL-CLOCK DISCIPLINE: the driver advances the clock ONLY while
+the service is quiescent at the thread boundary
+(``svc.inflight_dispatches == 0``).  Advancing while a dispatch
+crossed into ``asyncio.to_thread`` charged GIL-scheduling wall time
+to virtual latency — on a 1-core box each thread handoff costs a
+~5 ms GIL switch interval of driver spinning, which at 20 ms of
+virtual time per spin inflated the r10/r11 block-import p50 to
+~3.6 s.  With the gate, virtual latency is queue wait + modeled
+device time on any host.
 """
 
 import asyncio
+import time
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 from ..crypto import bls, kzg
+from ..crypto.bls.loader import GuardedBls12381
 from ..infra import capacity as capacity_mod
-from ..infra import flightrecorder
+from ..infra import faults, flightrecorder
 from ..infra.metrics import GLOBAL_REGISTRY, MetricsRegistry
+from ..infra.supervisor import CircuitBreaker
+from ..parallel import selfheal
 from ..services.admission import AdmissionController, VerifyClass
 from ..services.overload_sim import VirtualClock, _next_pow2
 from ..services.signatures import (AggregatingSignatureVerificationService,
@@ -68,7 +89,8 @@ class DedupAwareDevice:
                  telemetry: capacity_mod.CapacityTelemetry,
                  lane_sigs_per_sec: float = 3000.0,
                  h2c_msgs_per_sec: float = 1500.0,
-                 overhead_s: float = 0.002, min_pad: int = 8):
+                 overhead_s: float = 0.002, min_pad: int = 8,
+                 completed_at: Optional[Dict[tuple, float]] = None):
         self.clock = clock
         self.telemetry = telemetry
         self.lane_s = 1.0 / lane_sigs_per_sec
@@ -78,7 +100,11 @@ class DedupAwareDevice:
         self.dispatches = 0
         self.lanes_total = 0
         self.unique_total = 0
-        self.completed_at: Dict[tuple, float] = {}
+        # shareable across backends: the chaos scenario swaps model
+        # backends mid-run (eject/reshape) and the latency stamps must
+        # land in ONE dict the driver's callbacks read
+        self.completed_at: Dict[tuple, float] = (
+            completed_at if completed_at is not None else {})
 
     def batch_verify(self, triples) -> bool:
         n = len(triples)
@@ -109,6 +135,33 @@ class DedupAwareDevice:
         if not self.lanes_total:
             return 0.0
         return 1.0 - self.unique_total / self.lanes_total
+
+
+class MeshModelDevice(DedupAwareDevice):
+    """Model MESH: the dedup-aware cost model scaled by the live
+    device subset (losing a chip costs 1/N of throughput), with every
+    dispatch passing the REAL ``bls.mesh_shard`` fault site keyed by
+    the live device names — the production seam the chaos schedule
+    arms, so a keyed wedge fails the collective exactly while the
+    sick device is in the live set and stops once it is ejected."""
+
+    def __init__(self, clock: VirtualClock,
+                 telemetry: capacity_mod.CapacityTelemetry,
+                 live: Sequence[int], total: int,
+                 lane_sigs_per_sec: float, h2c_msgs_per_sec: float,
+                 completed_at: Optional[Dict[tuple, float]] = None):
+        frac = len(live) / max(total, 1)
+        super().__init__(clock, telemetry,
+                         lane_sigs_per_sec=lane_sigs_per_sec * frac,
+                         h2c_msgs_per_sec=h2c_msgs_per_sec * frac,
+                         completed_at=completed_at)
+        self.live_names = tuple(f"vdev{i}" for i in live)
+        self.mesh_info = {"devices": list(self.live_names),
+                          "n_devices": len(live), "axis": "dp"}
+
+    def batch_verify(self, triples) -> bool:
+        faults.check(selfheal.FAULT_SITE, keys=self.live_names)
+        return super().batch_verify(triples)
 
 
 class ModelKzgBackend:
@@ -167,10 +220,64 @@ async def _run_scenario(scenario: Scenario, seed: int, slots: int,
         registry=registry, window_s=2.5, clock=clock, recorder=recorder)
     # dedup-aware device scaled so the scenario's offered rate is a
     # meaningful fraction of capacity (storms overload, steady holds)
-    device = DedupAwareDevice(
-        clock, telemetry,
-        lane_sigs_per_sec=scenario.capacity_sigs_per_sec * 2,
-        h2c_msgs_per_sec=scenario.capacity_sigs_per_sec)
+    base_lane = scenario.capacity_sigs_per_sec * 2
+    base_h2c = scenario.capacity_sigs_per_sec
+    completed_at: Dict[tuple, float] = {}
+    backends: List[DedupAwareDevice] = []
+    guarded = healer = breaker = None
+    if scenario.mesh_devices:
+        # chaos wiring: the model mesh behind the REAL supervisor
+        # machinery — GuardedBls12381 (oracle-model fallback, breaker)
+        # + parallel/selfheal.MeshHealer — so a timed bls.mesh_shard
+        # wedge exercises production eject/reshape/readmit, measured
+        # under traffic
+        total = scenario.mesh_devices
+
+        def make_backend(live):
+            if not live:
+                return None
+            be = MeshModelDevice(clock, telemetry, live, total,
+                                 base_lane, base_h2c,
+                                 completed_at=completed_at)
+            backends.append(be)
+            return be
+
+        device = make_backend(tuple(range(total)))
+        # the last-resort cliff a wedged dispatch falls to mid-heal:
+        # same verdict rule, oracle (~CPU) speed — the very cliff
+        # self-healing exists to avoid paying for the whole mesh
+        oracle = DedupAwareDevice(
+            clock, telemetry, lane_sigs_per_sec=base_lane / 20,
+            h2c_msgs_per_sec=base_h2c / 20, completed_at=completed_at)
+        breaker = CircuitBreaker(
+            failure_threshold=6, deadline_s=5.0, cooldown_s=0.5,
+            name="loadgen_mesh", registry=registry)
+        guarded = GuardedBls12381(device, breaker, oracle=oracle,
+                                  registry=registry)
+
+        def heal_install(be, live, epoch):
+            if be is None:
+                return        # zero healthy: oracle stays last resort
+            guarded.swap_device(be)
+            # production wiring parity (loader.make_mesh_healer): the
+            # reshaped backend is known-good, so serving resumes now
+            breaker.record_success()
+
+        healer = selfheal.MeshHealer(
+            [f"vdev{i}" for i in range(total)],
+            probe=lambda i: faults.check(selfheal.FAULT_SITE,
+                                         keys=(f"vdev{i}",)),
+            make_backend=make_backend, install=heal_install,
+            trip_threshold=1, probe_deadline_s=1.0, reprobe_s=0.05,
+            registry=registry, recorder=recorder)
+        guarded.healer = healer
+        impl = guarded
+    else:
+        device = DedupAwareDevice(
+            clock, telemetry, lane_sigs_per_sec=base_lane,
+            h2c_msgs_per_sec=base_h2c, completed_at=completed_at)
+        backends.append(device)
+        impl = device
     kzg_backend = ModelKzgBackend(clock, telemetry)
     controller = AdmissionController(
         telemetry=telemetry, min_bucket=8, max_batch=256,
@@ -198,7 +305,7 @@ async def _run_scenario(scenario: Scenario, seed: int, slots: int,
         def _cb(f):
             if f.cancelled() or f.exception() is not None:
                 return
-            done_at = device.completed_at.get(key)
+            done_at = completed_at.get(key)
             if done_at is not None:
                 by_class.setdefault(cls_label, []).append(
                     done_at - t_sub)
@@ -206,8 +313,58 @@ async def _run_scenario(scenario: Scenario, seed: int, slots: int,
 
     t_start = clock()
     horizon = t_start + slots * model_mod.SECONDS_PER_SLOT
+    # the PER-DISPATCH real-time bound: virtual progress is gated on
+    # service quiescence below, so a genuinely wedged dispatch must
+    # fail the harness by wall clock, not hang it.  PROGRESS-BASED —
+    # reset whenever the service goes quiescent — so a long healthy
+    # run (many slots, slow box) can never trip it cumulatively
+    wall_stall_s = 120.0
+    wall_deadline = time.monotonic() + wall_stall_s
+    chaos = sorted(scenario.chaos, key=lambda c: c.t)
+    chaos_idx = 0
+    chaos_log: List[dict] = []
 
-    bls.set_implementation(device)
+    def fire_chaos():
+        """Arm/clear the schedule's faults as virtual time reaches
+        them — the timed bls.mesh_shard wedge mid-steady-state."""
+        nonlocal chaos_idx
+        while chaos_idx < len(chaos) \
+                and clock() - t_start >= chaos[chaos_idx].t:
+            ce = chaos[chaos_idx]
+            chaos_idx += 1
+            if ce.action == "wedge":
+                faults.inject(selfheal.FAULT_SITE, faults.Raise(
+                    RuntimeError(f"chaos: vdev{ce.device} wedged"),
+                    times=ce.times, key=f"vdev{ce.device}"))
+            else:
+                faults.clear(selfheal.FAULT_SITE)
+            chaos_log.append({"t": round(clock() - t_start, 3),
+                              "action": ce.action,
+                              "device": ce.device})
+
+    async def park_for_dispatch():
+        """A dispatch is crossing the thread boundary: hold the
+        VIRTUAL clock and park in a real sleep so the executor thread
+        gets the GIL immediately.  Spinning sleep(0) here while
+        advancing the clock was the r10/r11 block-import p50
+        inflation: on a 1-core box the driver keeps the GIL for the
+        full switch interval (~5 ms) per thread handoff, and every
+        spin charged idle_tick VIRTUAL seconds to whatever was in
+        flight — ~3.6 s p50 from pure scheduler wall time.  Holding
+        the clock makes virtual latency what the model says it is
+        (queue wait + modeled device time), on any core count."""
+        if time.monotonic() > wall_deadline:
+            raise RuntimeError(
+                f"loadgen made no dispatch progress for "
+                f"{wall_stall_s:.0f}s of wall time (wedged executor "
+                "thread?)")
+        await asyncio.sleep(0.0005)
+
+    def note_progress():
+        nonlocal wall_deadline
+        wall_deadline = time.monotonic() + wall_stall_s
+
+    bls.set_implementation(impl)
     kzg_prev_backend = kzg.get_backend()
     kzg.set_backend(kzg_backend)
     telemetry_prev = capacity_mod.swap_default(telemetry)
@@ -216,10 +373,15 @@ async def _run_scenario(scenario: Scenario, seed: int, slots: int,
         idx = 0
         idle_tick = 0.02
         while True:
+            fire_chaos()
             if idx < len(events):
                 ev = events[idx]
                 t_ev = t_start + ev.t
                 if clock() < t_ev:
+                    if svc.inflight_dispatches:
+                        await park_for_dispatch()
+                        continue
+                    note_progress()
                     # advance to the next arrival (bounded tick so the
                     # controller and flush deadlines stay live)
                     clock.advance(min(t_ev - clock(), idle_tick))
@@ -267,6 +429,10 @@ async def _run_scenario(scenario: Scenario, seed: int, slots: int,
                 raise RuntimeError(
                     "loadgen drain did not settle within the virtual "
                     "horizon (wedged task?)")
+            if svc.inflight_dispatches:
+                await park_for_dispatch()
+                continue
+            note_progress()
             clock.advance(idle_tick)
             await asyncio.sleep(0)
 
@@ -296,11 +462,37 @@ async def _run_scenario(scenario: Scenario, seed: int, slots: int,
             clock.advance(max(telemetry.window_s / 4,
                               controller.tick_s))
             controller.tick()
+        if healer is not None and chaos_idx >= len(chaos):
+            # the schedule cleared its faults: give the background
+            # reprobe (real time) a bounded window to readmit and grow
+            # the mesh back, so the report shows the full cycle.  The
+            # gate is the LIVE width (the grow INSTALL), not the
+            # ledger — readmit precedes the grow reshape in the
+            # reprobe loop, and exiting between the two would build
+            # the report with reshapes.grow still 0
+            total = scenario.mesh_devices
+            t_wait = time.monotonic() + 5.0
+            while (healer.ledger.ejected()
+                   or len(healer.live_devices) < total) \
+                    and time.monotonic() < t_wait:
+                await asyncio.sleep(0.02)
         await svc.stop()
     finally:
+        if scenario.chaos:
+            faults.clear(selfheal.FAULT_SITE)
+        if healer is not None:
+            healer.close()
         capacity_mod.swap_default(telemetry_prev)
         kzg.set_backend(kzg_prev_backend)
         bls.reset_implementation()
+
+    # aggregate device evidence across every backend that served (the
+    # chaos scenario swaps model backends on eject/readmit; counting
+    # only the last would hide the wedge-window work)
+    dev_dispatches = sum(b.dispatches for b in backends)
+    dev_lanes = sum(b.lanes_total for b in backends)
+    dev_unique = sum(b.unique_total for b in backends)
+    dedup_ratio = (1.0 - dev_unique / dev_lanes) if dev_lanes else 0.0
 
     all_lats = [lat for ls in by_class.values() for lat in ls]
     p50, p99 = _percentiles(all_lats)
@@ -320,8 +512,37 @@ async def _run_scenario(scenario: Scenario, seed: int, slots: int,
         registry.metrics()["loadgen_coalesced_total"].value)
     b_events = [e for e in recorder.snapshot()
                 if e["kind"].startswith("brownout_")]
-    _M_DEDUP.labels(scenario=scenario.name).set(
-        round(device.dedup_ratio(), 4))
+    _M_DEDUP.labels(scenario=scenario.name).set(round(dedup_ratio, 4))
+    chaos_block = None
+    if healer is not None:
+        mesh_events = [e for e in recorder.snapshot()
+                       if e["kind"].startswith("mesh_")]
+        req = registry.metrics().get("bls_verify_requests_total")
+        served = {}
+        if req is not None:
+            for (backend, reason), child in req._items():
+                served[f"{backend}:{reason}"] = int(child.value)
+        chaos_block = {
+            "schedule": chaos_log,
+            "mesh": healer.snapshot(),
+            "ejects": sum(1 for e in mesh_events
+                          if e["kind"] == "mesh_eject"),
+            "readmits": sum(1 for e in mesh_events
+                            if e["kind"] == "mesh_readmit"),
+            "reshapes": dict(healer.reshapes),
+            "recovery_s": healer.last_recovery_s,
+            "recovered": not healer.ledger.ejected(),
+            # no invalid signatures in this mix: every failed verdict
+            # during device loss would be a WRONG verdict — the
+            # zero-wrong-verdict chaos gate reads this
+            "wrong_verdicts": failed_verdicts,
+            "served": served,
+            "events": [{k: e.get(k) for k in
+                        ("kind", "device", "direction",
+                         "from_devices", "to_devices", "epoch",
+                         "trace_id")}
+                       for e in mesh_events[:24]],
+        }
     return {
         "scenario": scenario.name,
         "seed": seed,
@@ -340,13 +561,14 @@ async def _run_scenario(scenario: Scenario, seed: int, slots: int,
         "by_class": per_class,
         "sheds": sheds,
         "shed_total": sum(sheds.values()),
-        "dedup_ratio": round(device.dedup_ratio(), 4),
+        "dedup_ratio": round(dedup_ratio, 4),
         "coalesced": coalesced,
         "dispatches": dispatches,
         "bisect_dispatches": dispatches.get("bisect", 0),
-        "device": {"dispatches": device.dispatches,
-                   "lanes": device.lanes_total,
-                   "unique": device.unique_total},
+        "device": {"dispatches": dev_dispatches,
+                   "lanes": dev_lanes,
+                   "unique": dev_unique},
+        **({"chaos": chaos_block} if chaos_block is not None else {}),
         "kzg": {"batches": kzg_backend.batches,
                 "blobs": kzg_backend.blobs,
                 "source_accounted": capacity_mod.SOURCE_KZG in

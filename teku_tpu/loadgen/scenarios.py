@@ -13,9 +13,25 @@ exposition's cardinality must stay bounded.
 """
 
 from dataclasses import dataclass
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 from .model import TrafficModel
+
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    """One timed fault action on the scenario's virtual clock.
+
+    ``wedge`` arms a device-keyed Raise at the ``bls.mesh_shard``
+    site (infra/faults.py) — the model mesh's collective dispatch AND
+    that device's isolation probe fail, driving the REAL
+    GuardedBls12381 + MeshHealer eject/reshape path; ``clear`` removes
+    the faults so the background reprobe re-admits the device."""
+
+    t: float                 # virtual seconds into the run
+    action: str              # "wedge" | "clear"
+    device: int = 0          # sick device index (wedge)
+    times: Optional[int] = None   # fault budget (None = until clear)
 
 
 @dataclass(frozen=True)
@@ -35,6 +51,13 @@ class Scenario:
     # offered-load scale: multiplies the modeled device's capacity
     # deficit (1.0 = the default driver capacity)
     capacity_sigs_per_sec: float = 1500.0
+    # > 0: route the model through the REAL supervisor machinery —
+    # GuardedBls12381 + breaker + parallel/selfheal.MeshHealer over a
+    # model mesh of this many devices — so the chaos schedule below
+    # exercises production eject/reshape/readmit, not a stub
+    mesh_devices: int = 0
+    # timed fault schedule on the virtual clock (requires mesh_devices)
+    chaos: Tuple[ChaosEvent, ...] = ()
 
 
 def _m(**kw) -> TrafficModel:
@@ -114,6 +137,25 @@ BLOB_STORM = _register(Scenario(
                 "blob demand must be visible as its own source",
     model=_m(blobs_per_block=6.0),
     classes=("vip", "block_import", "sync_critical", "gossip"),
+))
+
+CHAOS_DEVICE_LOSS = _register(Scenario(
+    name="chaos_device_loss",
+    description="mid-steady-state device loss: a timed bls.mesh_shard "
+                "wedge kills one chip of the 8-device model mesh; the "
+                "REAL healer must eject it, reshape to 4 and keep "
+                "serving with ZERO protected-class sheds and zero "
+                "wrong verdicts, then grow back on the clear",
+    model=_m(),
+    classes=("vip", "block_import", "sync_critical", "gossip"),
+    # adversarial: the p50 bound is waived (capacity deliberately
+    # halves mid-run) — the gates are sheds==0 and wrong verdicts==0;
+    # the committee shape itself is unchanged, so the dedup floor holds
+    committee_shaped=True,
+    adversarial=True,
+    mesh_devices=8,
+    chaos=(ChaosEvent(t=4.0, action="wedge", device=3),
+           ChaosEvent(t=14.0, action="clear")),
 ))
 
 # names in registration order — the default `cli loadgen --scenario

@@ -398,6 +398,11 @@ class JaxBls12381(BLS12381):
         # h2c dispatches this provider issued: the warm-cache tests
         # assert a fully-warm batch leaves this untouched
         self.h2c_dispatch_count = 0
+        # reshape generation stamp (parallel/selfheal.MeshHealer sets
+        # it on the provider it installs): dispatch-ledger records and
+        # doctor findings name WHICH live device set served a dispatch
+        # across eject/readmit cycles
+        self.mesh_epoch = 0
         # the mont_mul engine resolved when this provider was built —
         # jitted programs KEEP the engine they were traced with, so
         # the dispatch metric labels with this, not a re-resolution
@@ -818,13 +823,14 @@ class JaxBls12381(BLS12381):
         # "{lanes}x" so the admission planner still sees mesh-shaped
         # device latencies for its batch sizing)
         shape = f"{padded}x{kmax}" + (f"@m{mesh_n}" if mesh_n else "")
-        # the staged jits are module-level (shared across providers),
-        # but a ShardedVerifier's jit cache is per-instance — key the
-        # seen-set on the kernel that will actually serve the dispatch
-        # (and on the MSM path: ladder and pippenger are different
-        # programs at the same padded shape)
-        cache_key = (id(self._sharded) if self._sharded is not None
-                     else 0, shape, msm_path)
+        # the staged jits are module-level (shared across providers)
+        # and the sharded kernels are process-memoized by (device set,
+        # axis, msm path) — key the seen-set on the kernel identity
+        # that will actually serve the dispatch, so a reshaped
+        # provider over known devices reads cache_hit, not compile
+        cache_key = (self._sharded.kernel_key(msm_path)
+                     if self._sharded is not None else 0,
+                     shape, msm_path)
         with _SEEN_LOCK:
             first = cache_key not in _SEEN_SHAPES
             _SEEN_SHAPES.add(cache_key)
@@ -856,7 +862,12 @@ class JaxBls12381(BLS12381):
         # mode, brownout level, class mix) — asyncio.to_thread copied
         # them into this worker thread.
         if plan is not None:
+            # `devices` + `epoch` stamp the LIVE device set serving
+            # this dispatch: after a self-healing reshape the ledger
+            # shows which records ran on the shrunken/regrown mesh
             mesh_block = {"devices": mesh_n,
+                          "epoch": self.mesh_epoch,
+                          "live": list(self._sharded.devices),
                           "shard_lanes": plan.shard_lanes,
                           "shard_rows": plan.shard_rows,
                           "lanes_per_shard": plan.lanes_per_shard,
@@ -864,7 +875,7 @@ class JaxBls12381(BLS12381):
                           "makespan_ratio": round(
                               plan.makespan_ratio, 4)}
         else:
-            mesh_block = {"devices": 0}
+            mesh_block = {"devices": 0, "epoch": self.mesh_epoch}
         rec = dispatchledger.open_record(
             trace_ids=[t.trace_id for t in traces],
             shape=shape, mont_path=mont_path, randomized=randomize,
@@ -884,10 +895,14 @@ class JaxBls12381(BLS12381):
             hm_uniq = self._hm_device(hm_plan)
             if self._sharded is not None:
                 # `bls.mesh_shard` fault site: a wedged SHARD wedges
-                # the whole mesh dispatch — the harness arms a hang
-                # here and the breaker must trip the entire mesh
-                # backend to oracle fallback
-                faults.check("bls.mesh_shard")
+                # the whole mesh dispatch.  The LIVE device names ride
+                # as keys so the chaos harness can wedge exactly one
+                # chip: the keyed fault fires here (the collective
+                # includes it) AND at that device's isolation probe
+                # (parallel/selfheal.py), and stops firing once the
+                # sick device is ejected from the live set
+                faults.check("bls.mesh_shard",
+                             keys=self._sharded.devices)
                 # scatter the canonical H(m) rows into the shard
                 # layout with one gather, then the group-aligned
                 # kernel runs the full dedup pipeline per shard

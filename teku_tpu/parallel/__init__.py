@@ -63,6 +63,31 @@ def describe_mesh() -> dict:
                 "n_devices": _ACTIVE["n"], "axis": _ACTIVE["axis"]}
 
 
+def reset_active_mesh() -> None:
+    """Clear the active-mesh self-description: the self-healer shrank
+    to a single device (or the oracle) and the ``bls_mesh_devices``
+    gauge must stop advertising a mesh nothing dispatches over."""
+    with _lock:
+        _ACTIVE["devices"] = []
+        _ACTIVE["n"] = 0
+
+
+def advertise_mesh(device_names: Sequence[str],
+                   axis: str = DEFAULT_AXIS) -> None:
+    """Publish the SERVING mesh self-description.  The self-healer's
+    install hook calls this when the reshaped provider actually
+    swaps in — constructing a candidate mesh must NOT advertise it
+    (a vetoed install would leave the gauge/readiness pointing at a
+    mesh that never served)."""
+    names = [str(d) for d in device_names]
+    with _lock:
+        _ACTIVE["devices"] = names
+        _ACTIVE["n"] = len(names)
+        _ACTIVE["axis"] = axis
+    _LOG.info("verify mesh: %d device(s) over axis %r: %s",
+              len(names), axis, ", ".join(names))
+
+
 def resolve_mesh_devices(spec, available: Optional[int] = None) -> int:
     """Resolve a ``--mesh {off,auto,N}`` spec to a usable device count.
 
@@ -115,27 +140,32 @@ def resolve_mesh_devices(spec, available: Optional[int] = None) -> int:
 
 
 def make_mesh(n_devices: Optional[int] = None,
-              axis: str = DEFAULT_AXIS) -> Mesh:
-    """1-D device mesh over the first n available devices.
+              axis: str = DEFAULT_AXIS, devices=None,
+              advertise: bool = True) -> Mesh:
+    """1-D device mesh over the first n available devices, or over an
+    EXPLICIT device list (``devices=``) — the self-healing reshape
+    path builds meshes over the surviving healthy subset, which is not
+    a prefix of jax.devices() once a middle chip is ejected.
 
     On hardware this is the ICI ring; in tests/dry runs it is the
     virtual CPU mesh (xla_force_host_platform_device_count).  The
     chosen device set is LOGGED and exported (``bls_mesh_devices``
     gauge + describe_mesh() for the readiness snapshot) so multi-chip
-    runs self-describe instead of silently taking the first N."""
-    devices = jax.devices()
-    if n_devices is not None:
-        if len(devices) < n_devices:
-            raise ValueError(
-                f"need {n_devices} devices, have {len(devices)}")
-        devices = devices[:n_devices]
-    names = [str(d) for d in devices]
-    with _lock:
-        _ACTIVE["devices"] = names
-        _ACTIVE["n"] = len(names)
-        _ACTIVE["axis"] = axis
-    _LOG.info("verify mesh: %d device(s) over axis %r: %s",
-              len(names), axis, ", ".join(names))
+    runs self-describe instead of silently taking the first N —
+    except under ``advertise=False`` (the healer's CANDIDATE meshes:
+    a reshape advertises at install time, after the warm proved it,
+    never at construction)."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"need {n_devices} devices, have {len(devices)}")
+            devices = devices[:n_devices]
+    else:
+        devices = list(devices)
+    if advertise:
+        advertise_mesh([str(d) for d in devices], axis)
     return Mesh(np.array(devices), (axis,))
 
 
@@ -256,6 +286,17 @@ class ShardedVerifier:
         return self._fn(*args)
 
 
+# process-level sharded-kernel memo, keyed by (device set, axis, msm
+# path): two GroupShardedVerifier instances over the SAME devices are
+# the same program, so they must share ONE jitted callable — and its
+# in-memory jit cache of compiled shapes.  This is what makes the
+# self-healer's GROW reshape near-free: re-admitting a device rebuilds
+# a mesh the process already served, and every warmed shape is still
+# resident (eject→readmit cycles re-trace nothing).
+_KERNELS: dict = {}
+_KERNELS_LOCK = threading.Lock()
+
+
 class GroupShardedVerifier:
     """Group-aligned production mesh dispatch.
 
@@ -275,8 +316,6 @@ class GroupShardedVerifier:
             raise ValueError("mesh size must be a power of two")
         self.min_bucket = max(min_bucket, self.n_devices)
         self.devices = [str(d) for d in np.ravel(mesh.devices)]
-        self._fns: dict = {}
-        self._fns_lock = threading.Lock()
 
     def describe(self) -> dict:
         return {"devices": list(self.devices),
@@ -289,12 +328,19 @@ class GroupShardedVerifier:
             min_lanes=self.min_bucket // self.n_devices,
             min_rows=max(min_rows_total // self.n_devices, 1))
 
+    def kernel_key(self, msm_path: str) -> tuple:
+        """The identity of the shared jitted kernel serving this
+        verifier (the provider's jit-outcome accounting keys on it:
+        a fresh instance over known devices is NOT a fresh program)."""
+        return (tuple(self.devices), self.axis, msm_path)
+
     def kernel(self, msm_path: str):
-        with self._fns_lock:
-            fn = self._fns.get(msm_path)
+        key = self.kernel_key(msm_path)
+        with _KERNELS_LOCK:
+            fn = _KERNELS.get(key)
             if fn is None:
                 from ..ops import verify as V
                 fn = jax.jit(V.verify_kernel_sharded_grouped(
                     self.mesh, self.axis, msm_path))
-                self._fns[msm_path] = fn
+                _KERNELS[key] = fn
         return fn

@@ -225,6 +225,18 @@ async def run_overload_sim(offered_x: float = 10.0,
                 # which accrues the next arrivals — the closed loop)
                 await asyncio.sleep(0)
                 continue
+            if svc.inflight_dispatches:
+                # a dispatch is crossing the thread boundary: hold the
+                # virtual clock and park in a REAL sleep so the
+                # executor thread gets the GIL now.  Spinning sleep(0)
+                # while advancing charged wall scheduler time (the
+                # ~5 ms GIL switch interval per handoff on a 1-core
+                # box) to VIRTUAL latency — the flaky
+                # light-load-burns-out failure and the r10 loadgen
+                # block-import p50 inflation (loadgen/driver.py has
+                # the same gate)
+                await asyncio.sleep(0.0005)
+                continue
             if clock() < t_end:
                 # queue drained faster than credit accrues (light
                 # offered load): idle time still accrues offered work

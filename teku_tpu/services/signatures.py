@@ -447,6 +447,15 @@ class AggregatingSignatureVerificationService:
         # dispatch, which no throughput counter can distinguish from
         # simple idleness
         self._last_worker_progress = time.monotonic()
+        # dispatches currently crossing the thread boundary (inside an
+        # asyncio.to_thread BLS call).  Event-loop-only mutation, no
+        # lock.  Virtual-clock harnesses gate their clock advancement
+        # on this: while a dispatch is in flight, spinning the event
+        # loop (and the virtual clock) starves the executor thread of
+        # the GIL on small hosts, charging wall scheduling time to the
+        # task's VIRTUAL latency — the r10 3.6 s loadgen block-import
+        # p50 on a 1-core box (see loadgen/driver.py)
+        self._inflight_dispatches = 0
 
     # ------------------------------------------------------------------
     async def start(self) -> None:
@@ -487,6 +496,22 @@ class AggregatingSignatureVerificationService:
     @staticmethod
     def _task_key(triples: Sequence[Triple]) -> tuple:
         return tuple((tuple(pks), msg, sig) for pks, msg, sig in triples)
+
+    @property
+    def inflight_dispatches(self) -> int:
+        """Dispatches currently inside an ``asyncio.to_thread`` BLS
+        call (enqueue or sync).  0 = the service is quiescent at the
+        thread boundary — the virtual-clock harness gate."""
+        return self._inflight_dispatches
+
+    async def _dispatch_in_thread(self, fn, *args):
+        """One BLS call on a worker thread, counted as in-flight for
+        the whole thread round-trip."""
+        self._inflight_dispatches += 1
+        try:
+            return await asyncio.to_thread(fn, *args)
+        finally:
+            self._inflight_dispatches -= 1
 
     def _current_plan(self) -> Optional[BatchPlan]:
         if self.controller is None:
@@ -948,7 +973,7 @@ class AggregatingSignatureVerificationService:
                     **self._dispatch_annotations(
                         tasks, plan, flush_failsafe)):
             with tracing.span("dispatch"):
-                handle = await asyncio.to_thread(
+                handle = await self._dispatch_in_thread(
                     bls.begin_batch_verify, triples)
         if handle is None:
             return None, t0
@@ -964,7 +989,7 @@ class AggregatingSignatureVerificationService:
             # the handle records the device_enqueue/device_sync spans
             # itself (it
             # captured the batch's traces at dispatch time)
-            ok = await asyncio.to_thread(handle.result)
+            ok = await self._dispatch_in_thread(handle.result)
         except asyncio.CancelledError:
             raise
         except Exception as exc:
@@ -1026,7 +1051,8 @@ class AggregatingSignatureVerificationService:
                     **self._dispatch_annotations(
                         tasks, plan, flush_failsafe)):
             with tracing.span("dispatch"):
-                ok = await asyncio.to_thread(bls.batch_verify, triples)
+                ok = await self._dispatch_in_thread(
+                    bls.batch_verify, triples)
         self._m_batch_duration.observe(time.perf_counter() - t0)
         await self._resolve_batch(tasks, ok)
 

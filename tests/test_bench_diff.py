@@ -336,6 +336,88 @@ def test_mesh_gates_skip_when_missing_or_virtual():
         "mesh_scaling_efficiency"]["status"] == "skipped"
 
 
+def test_chaos_gates_on_fixtures():
+    """The mesh self-healing acceptance gates: zero wrong verdicts
+    through eject/reshape/readmit, full grow-back, and (on measured
+    series) recovery <= mesh_recovery_s_max — in BOTH the bench chaos
+    phase and the loadgen chaos_device_loss scenario."""
+    base = bench_diff.load_result(BASE)
+    out = bench_diff.compare(base, base)
+    checks = _by_metric(out)
+    assert checks["chaos_wrong_verdicts"]["status"] == "ok"
+    assert checks["chaos_recovered"]["status"] == "ok"
+    assert checks["chaos_recovery_s"]["status"] == "ok"
+    assert checks["mainnet_chaos_wrong_verdicts"]["status"] == "ok"
+    assert checks["mainnet_chaos_recovered"]["status"] == "ok"
+    # the chaos scenario also rides the per-scenario protected-class
+    # shed gate like every other traffic shape
+    assert checks["mainnet_block_import_sheds.chaos_device_loss"][
+        "status"] == "ok"
+
+    reg = bench_diff.load_result(REGRESSED)
+    out = bench_diff.compare(base, reg)
+    checks = _by_metric(out)
+    assert out["verdict"] == "regression"
+    assert checks["chaos_wrong_verdicts"]["status"] == "regression"
+    assert checks["chaos_recovered"]["status"] == "regression"
+    assert checks["chaos_recovery_s"]["status"] == "regression"
+    assert checks["mainnet_chaos_wrong_verdicts"]["status"] \
+        == "regression"
+    assert checks["mainnet_chaos_recovered"]["status"] == "regression"
+
+
+def test_chaos_gates_skip_when_missing_or_virtual():
+    """Skip-if-missing (budget-starved runs drop the phase) and
+    skip-on-virtual for the recovery-time gate: serialized virtual
+    devices pay XLA compile wall time that means nothing, so only the
+    correctness gates (wrong verdicts, recovered) apply there.  The
+    RTO threshold is operator-tunable."""
+    base = bench_diff.load_result(BASE)
+    stripped = {k: v for k, v in base.items() if k != "chaos"}
+    stripped["mainnet"] = {
+        "scenarios": {k: v for k, v
+                      in base["mainnet"]["scenarios"].items()
+                      if k != "chaos_device_loss"}}
+    out = bench_diff.compare(base, stripped)
+    checks = _by_metric(out)
+    for m in ("chaos_wrong_verdicts", "chaos_recovered",
+              "chaos_recovery_s"):
+        assert checks[m]["status"] == "skipped", m
+    # the loadgen-chaos gates follow the per-scenario precedent:
+    # absent scenario => no mainnet_* checks at all
+    assert "mainnet_chaos_wrong_verdicts" not in checks
+    assert "mainnet_chaos_recovered" not in checks
+    # a skipped bench phase leaves a "skipped: ..." STRING, not a dict
+    stringy = dict(stripped, chaos="skipped: needs >= 4 devices")
+    out = bench_diff.compare(base, stringy)
+    assert _by_metric(out)["chaos_wrong_verdicts"]["status"] \
+        == "skipped"
+
+    virtual = dict(base)
+    virtual["chaos"] = dict(base["chaos"], series="virtual",
+                            recovery_s=240.0)
+    out = bench_diff.compare(base, virtual)
+    checks = _by_metric(out)
+    assert checks["chaos_recovery_s"]["status"] == "skipped"
+    assert checks["chaos_wrong_verdicts"]["status"] == "ok"
+    # a virtual run that flips a verdict still fails
+    virtual["chaos"] = dict(virtual["chaos"], wrong_verdicts=1)
+    assert _by_metric(bench_diff.compare(base, virtual))[
+        "chaos_wrong_verdicts"]["status"] == "regression"
+    # operator override tightens the measured RTO gate
+    out = bench_diff.compare(base, base,
+                             {"mesh_recovery_s_max": 5.0})
+    assert _by_metric(out)["chaos_recovery_s"]["status"] \
+        == "regression"
+    # trajectory entries carry the flattened chaos fields
+    flat = {"chaos_recovery_s": 8.0, "chaos_wrong_verdicts": 0,
+            "chaos_series": "measured", "chaos_recovered": True}
+    checks = _by_metric(bench_diff.compare({}, flat))
+    assert checks["chaos_wrong_verdicts"]["status"] == "ok"
+    assert checks["chaos_recovered"]["status"] == "ok"
+    assert checks["chaos_recovery_s"]["status"] == "ok"
+
+
 def test_ledger_gates_on_fixtures():
     """The PR-13 dispatch-ledger gates: per bench phase, lane-bucket
     padding waste must stay <= padding_waste_max (0.5) and the mesh

@@ -355,6 +355,36 @@ def test_mesh_family_label_contract():
     assert isinstance(reg.metrics()["lint_mesh_mesh_devices"], Gauge)
 
 
+def test_mesh_selfheal_family_label_contract():
+    """The self-healing families must not drift: the reshape counter
+    carries exactly ``{direction, devices}`` with direction from the
+    closed {shrink, grow} set and devices from {0, 1} ∪ pow-2 (the
+    healer's largest-surviving-pow-2 rule plus the single-device and
+    oracle floors), and the recovery/ejection readouts are plain
+    gauges."""
+    from teku_tpu.infra.metrics import GLOBAL_REGISTRY
+    from teku_tpu.parallel import selfheal
+
+    metrics = GLOBAL_REGISTRY.metrics()
+    fam = metrics["bls_mesh_reshape_total"]
+    assert isinstance(fam, LabeledCounter)
+    assert tuple(fam.labelnames) == ("direction", "devices")
+    assert selfheal.DIRECTIONS == ("shrink", "grow")
+    devices_vocab = {"0", "1"} | {str(1 << i) for i in range(1, 9)}
+    for (direction, devices), _child in fam._items():
+        assert direction in selfheal.DIRECTIONS, direction
+        assert devices in devices_vocab, devices
+    assert isinstance(metrics["bls_mesh_recovery_seconds"], Gauge)
+    assert isinstance(metrics["bls_mesh_ejected_devices"], Gauge)
+    # the flight-event kinds the doctor joins on are spelled once
+    # (a typo'd kind string would silently disable the findings)
+    from teku_tpu.infra import doctor
+    import inspect
+    src = inspect.getsource(doctor._mesh_health_findings)
+    for kind in ("mesh_eject", "mesh_reshape", "mesh_readmit"):
+        assert kind in src
+
+
 def test_h2c_dedup_and_coalesce_family_naming_lint():
     """The PR-5 dedup/cache/coalesce families must not drift: hit/miss/
     evict/dispatch counters end ``_total``, the dedup gauge is a

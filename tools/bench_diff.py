@@ -50,7 +50,13 @@ a failure — budget-starved runs drop phases):
   dispatch decision ledger's lane-bucket padding waste ≤
   ``padding_waste_max`` and mesh shard makespan ratio ≤
   ``mesh_imbalance_max`` on every phase that emitted them
-  (skip-if-missing).
+  (skip-if-missing);
+- chaos gates (mesh self-healing, absolute, skip-if-missing): zero
+  wrong verdicts through eject/reshape/readmit and full grow-back in
+  BOTH the bench ``chaos`` phase and the loadgen ``chaos_device_loss``
+  scenario, plus recovery ≤ ``mesh_recovery_s_max`` on measured
+  (real-hardware) series — virtual serialized runs report recovery
+  time but are compile-dominated, so the wall gate skips them.
 """
 
 import argparse
@@ -84,6 +90,11 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
     # (infra/dispatchledger.py) now records per dispatch
     "padding_waste_max": 0.5,
     "mesh_imbalance_max": 1.5,
+    # mesh self-healing recovery-time objective: losing a chip must
+    # cost eject + replan + AOT warm of the smaller shapes, bounded —
+    # gated on MEASURED (real parallel hardware) series only; virtual
+    # serialized runs pay compile wall time that means nothing
+    "mesh_recovery_s_max": 60.0,
 }
 
 
@@ -310,6 +321,55 @@ def compare(base: dict, new: dict,
                 lambda v: v >= thr["mainnet_dedup_ratio_min"],
                 f"committee-shaped mixes must keep dedup ratio >= "
                 f"{thr['mainnet_dedup_ratio_min']}")
+
+    # chaos gates (mesh self-healing acceptance, absolute,
+    # skip-if-missing): device loss must NEVER flip a verdict, the
+    # mesh must grow back to full width once the fault clears, and on
+    # real hardware the eject->reshape->serving recovery must beat the
+    # recovery-time objective (virtual serialized runs report the time
+    # but their wall clock is compile-dominated and not gated)
+    chaos = _get(new, "chaos") if isinstance(_get(new, "chaos"), dict) \
+        else {}
+    _check_absolute(
+        checks, "chaos_wrong_verdicts",
+        chaos.get("wrong_verdicts", new.get("chaos_wrong_verdicts")),
+        lambda v: v == 0,
+        "device loss must never flip a verdict (zero wrong verdicts "
+        "through eject/reshape/readmit)")
+    _check_absolute(
+        checks, "chaos_recovered",
+        chaos.get("recovered", new.get("chaos_recovered")),
+        lambda v: v is True,
+        "the mesh must readmit the recovered device and grow back to "
+        "its configured width")
+    chaos_series = chaos.get("series", new.get("chaos_series"))
+    _check_absolute(
+        checks, "chaos_recovery_s",
+        (chaos.get("recovery_s", new.get("chaos_recovery_s"))
+         if chaos_series == "measured" else None),
+        lambda v: v <= thr["mesh_recovery_s_max"],
+        f"eject->reshape->on-device-serving recovery must stay <= "
+        f"{thr['mesh_recovery_s_max']} s on real hardware")
+    # the loadgen chaos scenario (REAL supervisor machinery under
+    # traffic): zero wrong verdicts and full recovery; its
+    # protected-class shed gate already rides the per-scenario
+    # mainnet loop above (sheds==0 under EVERY scenario, chaos
+    # included).  Emitted only when the scenario ran — pre-loadgen
+    # results must compare with no mainnet_* checks at all (the
+    # per-scenario precedent above)
+    mchaos = _get(new, "mainnet", "scenarios", "chaos_device_loss",
+                  "chaos")
+    if isinstance(mchaos, dict):
+        _check_absolute(
+            checks, "mainnet_chaos_wrong_verdicts",
+            mchaos.get("wrong_verdicts"),
+            lambda v: v == 0,
+            "loadgen device loss must never flip a verdict")
+        _check_absolute(
+            checks, "mainnet_chaos_recovered",
+            mchaos.get("recovered"),
+            lambda v: v is True,
+            "the loadgen chaos mesh must readmit and grow back")
 
     # ledger gates (absolute, per phase, skip-if-missing): each bench
     # phase's dispatch-ledger summary must keep padding waste and mesh
