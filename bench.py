@@ -1031,7 +1031,9 @@ def _chaos_phase(jax, deadline):
     from teku_tpu.crypto.bls import keygen
     from teku_tpu.crypto.bls.loader import (GuardedBls12381,
                                             make_mesh_healer)
+    import contextlib
     from teku_tpu.infra import faults
+    from teku_tpu.infra.env import env_override
     from teku_tpu.infra.supervisor import CircuitBreaker
     from teku_tpu.ops.provider import JaxBls12381
 
@@ -1054,11 +1056,14 @@ def _chaos_phase(jax, deadline):
     OUT["chaos"] = out
     _beat("chaos_phase_start", devices=n_dev, batch=batch,
           fault=fault_kind)
-    warm_env_prev = os.environ.get("TEKU_TPU_MESH_WARM_BATCH")
     # reshape warm = the serving shape set: the first post-swap
     # dispatch must hit the jit cache, so recovery time includes the
-    # real AOT cost and nothing compiles on the serving path
-    os.environ["TEKU_TPU_MESH_WARM_BATCH"] = str(batch)
+    # real AOT cost and nothing compiles on the serving path.  The
+    # operator's value restores in the finally (env_override owns the
+    # None-means-unset dance; the try body is too far from a `with`).
+    warm_override = contextlib.ExitStack()
+    warm_override.enter_context(
+        env_override("TEKU_TPU_MESH_WARM_BATCH", str(batch)))
     healer = None
     try:
         impl = JaxBls12381(max_batch=batch, min_bucket=batch,
@@ -1178,10 +1183,7 @@ def _chaos_phase(jax, deadline):
             healer.close()
         WD.disarm()
         faults.clear("bls.mesh_shard")
-        if warm_env_prev is None:
-            os.environ.pop("TEKU_TPU_MESH_WARM_BATCH", None)
-        else:
-            os.environ["TEKU_TPU_MESH_WARM_BATCH"] = warm_env_prev
+        warm_override.close()
 
 
 def _epoch_transition_phase(deadline):
@@ -1450,6 +1452,10 @@ def trajectory_entry(out: dict, run_id: str) -> dict:
         entry["chaos_wrong_verdicts"] = chaos.get("wrong_verdicts")
         entry["chaos_series"] = chaos.get("series")
         entry["chaos_recovered"] = chaos.get("recovered")
+    lint = out.get("lint") or {}
+    if isinstance(lint, dict) and "error" not in lint:
+        entry["lint_unsuppressed"] = lint.get("unsuppressed")
+        entry["lint_suppressed"] = lint.get("suppressed")
     return entry
 
 
@@ -1642,6 +1648,24 @@ def main():
         OUT["mont_path"] = mxu.resolve()
     except Exception:
         pass
+    try:
+        # static-analysis state of the tree this run measured: finding
+        # counts per checker (all zero on a clean tree) so the
+        # trajectory shows the tree STAYING clean PR over PR.  Pure
+        # AST, ~a second; never the reason a bench run fails.
+        from teku_tpu.analysis import run_lint
+        lint_report = run_lint()
+        OUT["lint"] = {
+            "files": lint_report.files_scanned,
+            "unsuppressed": len(lint_report.unsuppressed),
+            "suppressed": (len(lint_report.findings)
+                           - len(lint_report.unsuppressed)),
+            "unused_suppressions": len(
+                lint_report.unused_suppressions),
+            "by_checker": lint_report.counts(),
+        }
+    except Exception as exc:  # noqa: BLE001 - evidence, not the result
+        OUT["lint"] = {"error": f"{type(exc).__name__}: {exc}"}
     OUT["total_s"] = round(time.time() - t_start, 1)
     # rolling trajectory: the regression gate (tools/bench_diff.py)
     # compares the latest entries across PRs

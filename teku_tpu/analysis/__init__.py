@@ -1,0 +1,39 @@
+"""tekulint: AST-based invariant analyzer for the teku-tpu tree.
+
+Twelve PRs of review hardening fixed the same bug classes by hand —
+typo'd ``TEKU_TPU_*`` knobs read raw from ``os.environ`` that degrade
+or kill boot, torn two-read access to atomically-swapped state,
+private copies of shared helpers, unbounded metric label vocabularies,
+and trace-time side effects inside jit'd kernels.  This package makes
+those invariants a BUILD property: a self-contained stdlib-``ast``
+analyzer with a checker registry, a finding model (file:line, checker
+id, evidence, fix hint), a suppression file requiring per-entry
+justification, and a ``cli lint`` front end that exits 1 on any
+unsuppressed finding.
+
+Checkers (see each module's docstring for the past bug it mechanizes):
+
+- ``env-knob``         every TEKU_TPU_* env read goes through
+                       ``infra/env.py`` helpers (env_knob.py)
+- ``knob-doc``         the auto-extracted knob registry matches the
+                       README knob docs both ways (knob_docs.py)
+- ``jit-purity``       functions reachable from jax.jit / shard_map /
+                       lax.scan closures perform no host side effects
+                       (jit_purity.py)
+- ``torn-read``        registered swap attributes are read at most
+                       once per function (torn_read.py)
+- ``metric-contract``  counter/histogram naming by type + bounded
+                       label-value expressions (metric_contract.py)
+- ``closed-registry``  fault sites and flight-recorder event kinds are
+                       declared in their registry modules
+                       (registries.py)
+- ``dup-helper``       no near-identical private helper is defined in
+                       two modules (dup_helpers.py)
+
+The analyzer never imports the code it checks — a tree that cannot
+even import (the exact failure mode the env checker guards against)
+still lints.
+"""
+
+from .findings import Finding, Report                     # noqa: F401
+from .runner import run_lint, DEFAULT_SUPPRESSIONS        # noqa: F401

@@ -1,0 +1,71 @@
+"""dup-helper: no near-identical private helper defined in two modules.
+
+The mechanized bug class: three private copies each of ``_next_pow2``
+(hoisted to ``infra/pow2.py`` in PR 10 — after the mesh self-heal PR
+found a FOURTH inline copy) and ``_env_float`` (hoisted to
+``infra/env.py`` in PR 7).  Copies drift: one gains a clamp, the
+others keep the bug, and the reviewer has to notice that three
+modules changed when one did.
+
+Detection: module-level ``_``-prefixed function defs are normalized
+(docstring stripped, then structural ``ast.dump`` — argument NAMES
+count, so only genuinely copy-pasted bodies match) and grouped by
+(name, normalized body) across modules.  Groups spanning ≥2 modules
+fire one finding per extra copy, pointing at the first definition as
+the hoist target.  Tiny passthroughs (< MIN_NODES AST nodes) are
+ignored — a two-line property is idiom, not duplication.
+"""
+
+import ast
+import copy
+from typing import Dict, List, Tuple
+
+from .astutil import Project
+from .findings import Finding
+
+CHECKER = "dup-helper"
+MIN_NODES = 10
+
+
+def _normalized(func: ast.AST) -> Tuple[str, int]:
+    """(structural dump of the body minus docstring, node count)."""
+    node = copy.deepcopy(func)
+    body = node.body
+    if body and isinstance(body[0], ast.Expr) \
+            and isinstance(body[0].value, ast.Constant) \
+            and isinstance(body[0].value.value, str):
+        body = body[1:]
+    wrapper = ast.Module(body=body, type_ignores=[])
+    count = sum(1 for _ in ast.walk(wrapper))
+    return ast.dump(wrapper, annotate_fields=False), count
+
+
+def check(project: Project) -> List[Finding]:
+    groups: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+    for idx in project.modules.values():
+        for name, func in idx.functions.items():
+            if not name.startswith("_") or name.startswith("__"):
+                continue
+            dump, count = _normalized(func)
+            if count < MIN_NODES:
+                continue
+            groups.setdefault((name, dump), []).append(
+                (idx.relpath, func.lineno))
+    findings: List[Finding] = []
+    for (name, _dump), sites in groups.items():
+        if len({path for path, _ in sites}) < 2:
+            continue
+        sites = sorted(sites)
+        canonical = sites[0]
+        for path, line in sites[1:]:
+            findings.append(Finding(
+                checker=CHECKER, path=path, line=line,
+                message=f"private helper `{name}` duplicates the "
+                        f"definition at {canonical[0]}:{canonical[1]}",
+                evidence=f"{len(sites)} identical copies: " + ", ".join(
+                    f"{p}:{ln}" for p, ln in sites),
+                fix_hint="hoist ONE definition into a shared infra "
+                         "module (the _next_pow2 -> infra/pow2.py "
+                         "precedent) and import it",
+                token=name))
+    return findings

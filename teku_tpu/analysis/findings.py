@@ -1,0 +1,107 @@
+"""Finding model + report envelope for the static analyzer.
+
+A Finding carries everything a reviewer (or the suppression matcher)
+needs: WHERE (repo-relative path, 1-based line), WHAT (checker id +
+one-line message), WHY IT'S REAL (evidence string quoting the code
+fact that fired the rule), HOW TO FIX (fix_hint naming the shared
+helper / registry to use), and a STABLE KEY.  The key deliberately
+excludes the line number: suppressions anchor on (checker, path,
+semantic token) so unrelated edits shifting lines cannot silently
+orphan — or worse, silently widen — a suppression.
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# bump when the --json field set changes shape (tests pin this)
+SCHEMA_VERSION = 1
+
+
+@dataclass
+class Finding:
+    checker: str          # registry id, e.g. "env-knob"
+    path: str             # repo-relative, forward slashes
+    line: int             # 1-based
+    message: str          # one sentence: the violated invariant
+    evidence: str = ""    # the code fact (knob name, call chain, ...)
+    fix_hint: str = ""    # the shared helper / registry to use instead
+    token: str = ""       # stable semantic token (knob/metric/attr name)
+    suppressed: bool = False
+    justification: str = ""   # from the matching suppression entry
+
+    @property
+    def key(self) -> str:
+        """Stable suppression anchor: checker + path + semantic token
+        (NOT the line number)."""
+        return f"{self.checker}:{self.path}:{self.token or self.evidence}"
+
+    def to_dict(self) -> dict:
+        d = {"checker": self.checker, "path": self.path,
+             "line": self.line, "message": self.message,
+             "evidence": self.evidence, "fix_hint": self.fix_hint,
+             "key": self.key, "suppressed": self.suppressed}
+        if self.suppressed:
+            d["justification"] = self.justification
+        return d
+
+
+@dataclass
+class Report:
+    root: str
+    files_scanned: int = 0
+    findings: List[Finding] = field(default_factory=list)
+    unused_suppressions: List[dict] = field(default_factory=list)
+    knobs: List[dict] = field(default_factory=list)
+
+    @property
+    def unsuppressed(self) -> List[Finding]:
+        return [f for f in self.findings if not f.suppressed]
+
+    def counts(self) -> Dict[str, int]:
+        by: Dict[str, int] = {}
+        for f in self.unsuppressed:
+            by[f.checker] = by.get(f.checker, 0) + 1
+        return by
+
+    def to_dict(self) -> dict:
+        return {
+            "version": SCHEMA_VERSION,
+            "root": self.root,
+            "files_scanned": self.files_scanned,
+            "findings": [f.to_dict() for f in sorted(
+                self.findings, key=lambda f: (f.path, f.line, f.checker))],
+            "counts": {
+                "total": len(self.findings),
+                "unsuppressed": len(self.unsuppressed),
+                "suppressed": len(self.findings) - len(self.unsuppressed),
+                "by_checker": self.counts(),
+            },
+            "unused_suppressions": self.unused_suppressions,
+        }
+
+    def render_text(self) -> str:
+        lines: List[str] = []
+        for f in sorted(self.unsuppressed,
+                        key=lambda f: (f.path, f.line, f.checker)):
+            lines.append(f"{f.path}:{f.line}: [{f.checker}] {f.message}")
+            if f.evidence:
+                lines.append(f"    evidence: {f.evidence}")
+            if f.fix_hint:
+                lines.append(f"    fix: {f.fix_hint}")
+        n_sup = len(self.findings) - len(self.unsuppressed)
+        for entry in self.unused_suppressions:
+            lines.append(
+                f"lint_suppressions.json: UNUSED suppression "
+                f"{entry.get('checker')}:{entry.get('match')!r} — remove "
+                f"it (the finding it justified is gone)")
+        lines.append(
+            f"tekulint: {self.files_scanned} files, "
+            f"{len(self.unsuppressed)} finding(s)"
+            + (f", {n_sup} suppressed" if n_sup else "")
+            + (f", {len(self.unused_suppressions)} unused suppression(s)"
+               if self.unused_suppressions else ""))
+        return "\n".join(lines)
+
+    @property
+    def clean(self) -> bool:
+        return not self.unsuppressed and not self.unused_suppressions

@@ -1,0 +1,227 @@
+"""jit-purity: no host side effects reachable from traced closures.
+
+The mechanized bug class: code inside a function handed to ``jax.jit``
+/ ``shard_map`` / ``lax.scan`` (or any other tracing combinator) runs
+at TRACE time — once per compiled shape, in whatever thread triggered
+the compile — not once per dispatch.  A ``time.monotonic()`` there
+reads the compile's clock forever after; a metric ``.inc()`` charges
+one compile as one dispatch and silently corrupts the PR 2/11 per-stage
+attribution the doctor ranks findings by; a log line fires from inside
+a breaker dispatch thread mid-trace.  Reviewers caught these by eye
+for twelve PRs; this checker walks the actual call graph.
+
+Mechanics: entry points are callables passed to the tracing
+combinators (``jax.jit``, ``shard_map``, ``lax.scan`` /
+``while_loop`` / ``fori_loop`` / ``cond`` / ``switch``, ``vmap`` /
+``pmap``) or decorated with ``@jax.jit``, anywhere in the scanned
+tree.  From each entry the checker BFS-walks resolvable calls —
+nested defs, same-class methods (``self._kernel``), module functions,
+and imports into other scanned modules — and flags any call matching
+the impurity denylist (time/random/os.environ/logging/print/metrics
+mutation/flight-recorder/fault-site/tracing-span).  Unresolvable
+targets (jnp primitives, stdlib math) are opaque leaves, not errors.
+"""
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .astutil import ModuleIndex, Project, dotted
+from .findings import Finding
+
+CHECKER = "jit-purity"
+FIX_HINT = ("hoist the side effect to the host-side caller (provider "
+            "dispatch seam) — trace-time effects fire once per compile, "
+            "not per dispatch")
+
+# combinators whose callable arguments trace: {dotted suffix: arg spec}
+# "first" = first positional arg only; "all" = every callable-ish arg
+TRACING_ENTRY = {
+    "jax.jit": "first", "jit": "first",
+    "shard_map": "first",
+    "lax.scan": "first", "jax.lax.scan": "first",
+    "jax.vmap": "first", "vmap": "first",
+    "jax.pmap": "first", "pmap": "first",
+    "lax.cond": "all", "jax.lax.cond": "all",
+    "lax.switch": "all", "jax.lax.switch": "all",
+    "lax.while_loop": "all", "jax.lax.while_loop": "all",
+    "lax.fori_loop": "all", "jax.lax.fori_loop": "all",
+    "lax.map": "first", "jax.lax.map": "first",
+}
+
+_LOGGER_NAMES = {"log", "logger", "logging", "_log", "LOG", "_LOG",
+                 "LOGGER"}
+_LOG_METHODS = {"debug", "info", "warning", "warn", "error",
+                "exception", "critical", "log"}
+_TIME_FNS = {"time", "monotonic", "perf_counter", "perf_counter_ns",
+             "monotonic_ns", "time_ns", "sleep", "process_time"}
+_METRIC_MUTATORS = {"inc", "observe", "set_state", "labels"}
+_IMPURE_MODULES = ("teku_tpu.infra.flightrecorder",
+                   "teku_tpu.infra.faults",
+                   "teku_tpu.infra.tracing",
+                   "teku_tpu.infra.metrics",
+                   "teku_tpu.infra.env")
+
+
+def _impure_reason(idx: ModuleIndex, call: ast.Call) -> Optional[str]:
+    chain = dotted(call.func)
+    if chain is None:
+        return None
+    parts = chain.split(".")
+    head, last = parts[0], parts[-1]
+    if chain in ("print", "input", "open", "breakpoint"):
+        return f"host I/O `{chain}()`"
+    if head == "time" and idx.imports.get("time", "time") == "time" \
+            and len(parts) > 1 and last in _TIME_FNS:
+        return f"wall/monotonic clock `{chain}()`"
+    if head == "random" and idx.imports.get(
+            "random", "random") == "random" and len(parts) > 1:
+        return f"host RNG `{chain}()`"
+    if len(parts) >= 2 and parts[1] == "random" \
+            and idx.imports.get(head, "") in ("numpy", "numpy.random"):
+        return f"host RNG `{chain}()`"
+    if chain.endswith("os.environ.get") or chain.endswith("os.getenv") \
+            or chain in ("environ.get", "getenv"):
+        return f"environment read `{chain}()`"
+    if last in _LOG_METHODS and any(p in _LOGGER_NAMES for p in
+                                    parts[:-1]):
+        return f"logging call `{chain}()`"
+    if last in _METRIC_MUTATORS and len(parts) > 1:
+        return f"metric mutation `{chain}()`"
+    if last in ("record", "config_demotion") and any(
+            "recorder" in p.lower() or p == "flightrecorder"
+            for p in parts[:-1]):
+        return f"flight-recorder event `{chain}()`"
+    if last in ("check", "transform") and "faults" in parts[:-1]:
+        return f"fault-site hook `{chain}()`"
+    if last in ("span", "trace") and "tracing" in parts[:-1]:
+        return f"tracing span `{chain}()`"
+    # bare names imported from the impure infra modules; env helpers
+    # flag at THEIR call site so the finding (and any suppression)
+    # names the kernel-side read, not the shared helper body
+    if isinstance(call.func, ast.Name):
+        target = idx.imports.get(call.func.id, "")
+        if target.startswith("teku_tpu.infra.env."):
+            return f"environment read `{call.func.id}()`"
+        if target.startswith(_IMPURE_MODULES):
+            return f"infra side effect `{call.func.id}()` ({target})"
+    return None
+
+
+def _entry_args(call: ast.Call, spec: str) -> List[ast.AST]:
+    args = list(call.args)
+    if spec == "first":
+        return args[:1]
+    out = []
+    for a in args:
+        if isinstance(a, (ast.Name, ast.Attribute, ast.Lambda)):
+            out.append(a)
+    return out
+
+
+def _iter_calls_with_scope(idx: ModuleIndex):
+    """(scope function or None, Call node) for every call in the
+    module, scope tracked through nested defs."""
+    def visit(node: ast.AST, scope: Optional[ast.AST]):
+        for child in ast.iter_child_nodes(node):
+            child_scope = scope
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                child_scope = child
+            if isinstance(child, ast.Call):
+                yield scope, child
+            yield from visit(child, child_scope)
+    yield from visit(idx.tree, None)
+
+
+def _find_entries(idx: ModuleIndex
+                  ) -> List[Tuple[Optional[ast.AST], ast.AST, str]]:
+    """(call-site scope, callable expr, label) for every traced
+    closure handed to a combinator or decorated with one."""
+    entries: List[Tuple[Optional[ast.AST], ast.AST, str]] = []
+    for scope, call in _iter_calls_with_scope(idx):
+        chain = dotted(call.func)
+        if chain is None:
+            continue
+        for suffix, spec in TRACING_ENTRY.items():
+            if chain == suffix or chain.endswith("." + suffix):
+                for arg in _entry_args(call, spec):
+                    entries.append((scope, arg,
+                                    f"{chain}(...) at "
+                                    f"{idx.relpath}:{call.lineno}"))
+                break
+    for node in ast.walk(idx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                chain = dotted(target)
+                if chain is None:
+                    continue
+                is_jit = chain in ("jax.jit", "jit") \
+                    or chain.endswith(".jit")
+                if chain in ("partial", "functools.partial") \
+                        and isinstance(dec, ast.Call) and dec.args:
+                    inner = dotted(dec.args[0])
+                    is_jit = inner in ("jax.jit", "jit")
+                if is_jit:
+                    # the decorated def itself — NOT a synthetic Name,
+                    # which would only resolve for module-level
+                    # functions and silently drop decorated methods
+                    # and nested defs as entry points
+                    entries.append(
+                        (None, node,
+                         f"@{chain} on {node.name} at "
+                         f"{idx.relpath}:{node.lineno}"))
+    return entries
+
+
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    seen_findings: Set[str] = set()
+    visited: Set[int] = set()
+    # (module, function node, label of the entry that reached it)
+    queue: List[Tuple[ModuleIndex, ast.AST, str]] = []
+
+    def enqueue(idx: ModuleIndex, scope: Optional[ast.AST],
+                expr: ast.AST, label: str) -> None:
+        if isinstance(expr, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            queue.append((idx, expr, label))
+            return
+        resolved = project.resolve_call(idx, scope, expr)
+        if resolved is not None:
+            queue.append((resolved[0], resolved[1], label))
+
+    for idx in project.modules.values():
+        for scope, expr, label in _find_entries(idx):
+            enqueue(idx, scope, expr, label)
+
+    while queue:
+        idx, func, label = queue.pop()
+        if id(func) in visited:
+            continue
+        visited.add(id(func))
+        name = getattr(func, "name", "<lambda>")
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            reason = _impure_reason(idx, node)
+            if reason is not None:
+                token = f"{name}:{dotted(node.func)}"
+                dedup = f"{idx.relpath}:{token}"
+                if dedup in seen_findings:
+                    continue
+                seen_findings.add(dedup)
+                findings.append(Finding(
+                    checker=CHECKER, path=idx.relpath,
+                    line=node.lineno,
+                    message=f"{reason} inside `{name}`, which traces "
+                            "under a jit/scan/shard_map closure",
+                    evidence=f"reached from {label}",
+                    fix_hint=FIX_HINT, token=token))
+                continue
+            # the scope for resolution is the function whose body the
+            # call appears in (nearest enclosing def inside `func`)
+            resolved = project.resolve_call(idx, func, node.func)
+            if resolved is not None:
+                queue.append((resolved[0], resolved[1], label))
+    return findings

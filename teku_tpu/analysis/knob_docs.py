@@ -1,0 +1,94 @@
+"""knob-doc: the auto-extracted knob registry and the README knob
+docs agree, both ways.
+
+The mechanized bug class: README knob tables grew by hand PR over PR;
+a renamed knob leaves a stale doc row that operators copy into unit
+files (where, pre-PR-11, a typo'd name silently no-op'd — or worse,
+the OLD spelling silently no-op'd while the table still showed it).
+The registry side is extracted by ``env_knob.collect_knobs`` from the
+actual read sites, so the comparison is code-vs-doc, not doc-vs-doc.
+
+Matching: doc tokens may be patterns (``TEKU_TPU_VERIFY_CLASS_
+<CLASS>_DEADLINE_MS``, ``TEKU_TPU_BROWNOUT_*``) and code knobs may be
+patterns too (f-string reads); ``<...>`` normalizes to ``*`` and
+fnmatch runs in both directions.  Findings:
+
+- a code knob no README token covers -> undocumented knob;
+- a README token no code knob matches -> stale doc.
+"""
+
+import fnmatch
+import re
+from typing import Dict, List
+
+from .astutil import Project
+from .findings import Finding
+
+CHECKER = "knob-doc"
+_TOKEN_RE = re.compile(r"TEKU_TPU_[A-Z0-9_]*(?:<[A-Za-z_]+>[A-Z0-9_]*)*"
+                       r"(?:\*[A-Z0-9_]*)*")
+
+
+def _normalize(token: str) -> str:
+    token = re.sub(r"<[A-Za-z_]+>", "*", token)
+    return token.rstrip("_") if token.endswith("_") and \
+        not token.endswith("_*") else token
+
+
+def doc_tokens(readme_text: str) -> Dict[str, int]:
+    """{normalized token: first line} of every TEKU_TPU_* mention."""
+    tokens: Dict[str, int] = {}
+    for lineno, line in enumerate(readme_text.splitlines(), 1):
+        for m in _TOKEN_RE.finditer(line):
+            token = _normalize(m.group(0))
+            # the bare namespace wildcard ("every TEKU_TPU_* knob...")
+            # is prose, not documentation — counting it would make the
+            # undocumented-knob direction vacuously green
+            if len(token) > len("TEKU_TPU_") and token != "TEKU_TPU_*":
+                tokens.setdefault(token, lineno)
+    return tokens
+
+
+def _covers(doc_token: str, knob: str) -> bool:
+    if doc_token == knob:
+        return True
+    if "*" in doc_token and fnmatch.fnmatchcase(knob, doc_token):
+        return True
+    if "*" in knob and fnmatch.fnmatchcase(doc_token, knob):
+        return True
+    return False
+
+
+def check(project: Project, knobs: List[dict],
+          readme_text: str, readme_path: str = "README.md"
+          ) -> List[Finding]:
+    if not readme_text:
+        return []
+    tokens = doc_tokens(readme_text)
+    findings: List[Finding] = []
+    knob_names = sorted({str(k["name"]) for k in knobs})
+    for name in knob_names:
+        if not any(_covers(tok, name) for tok in tokens):
+            where = next(f"{k['path']}:{k['line']}" for k in knobs
+                         if k["name"] == name)
+            findings.append(Finding(
+                checker=CHECKER, path=where.split(":")[0],
+                line=int(where.split(":")[1]),
+                message=f"knob `{name}` is read here but never "
+                        f"documented in {readme_path}",
+                evidence=f"registry entry from {where}",
+                fix_hint="add the knob to the README knob table "
+                         "(`cli lint --knobs` emits the row)",
+                token=name))
+    for token, lineno in sorted(tokens.items()):
+        if not any(_covers(token, name) for name in knob_names):
+            findings.append(Finding(
+                checker=CHECKER, path=readme_path, line=lineno,
+                message=f"documented knob `{token}` matches no env "
+                        "read in the tree (stale doc)",
+                evidence=f"first mention at {readme_path}:{lineno}",
+                fix_hint="remove the stale row, or wire the knob "
+                         "through infra/env.py so the registry "
+                         "sees it",
+                token=token))
+    return findings

@@ -1,0 +1,223 @@
+"""metric-contract: full-tree static enforcement of the metric naming
+and label-boundedness conventions.
+
+The mechanized bug class: ``tests/test_metrics_exposition.py`` lints
+the families its test imports happen to register at RUNTIME — a new
+module whose metrics no imported test touches ships an unlinted
+vocabulary (this happened repeatedly; each PR extended the runtime
+lint by hand).  This checker statically enumerates every
+Counter/Gauge/Histogram/StateGauge construction in the tree:
+
+- counters (``counter`` / ``labeled_counter`` / the class ctors) end
+  in ``_total``; gauges never do;
+- histograms built on ``LATENCY_BUCKETS_S`` (or the labeled default,
+  which is latency) are durations and end ``_seconds``; count/size
+  histograms on ``DEFAULT_BUCKETS`` must not claim ``_seconds``;
+- metric names resolve statically (literal or prefix-f-string — the
+  node-name-prefixed families) so the enumeration is complete;
+- ``.labels(...)`` values must be bounded expressions: f-strings,
+  string concatenation/``%`` and ``.format`` produce open vocabularies
+  (label-cardinality explosions) and are rejected — label values come
+  from closed enums, module constants, or plain closed-fold helpers
+  (``plan_mode_label``-style).
+"""
+
+import ast
+from typing import List, Optional
+
+from .astutil import ModuleIndex, Project, dotted
+from .findings import Finding
+
+CHECKER = "metric-contract"
+METRICS_MODULE = "teku_tpu.infra.metrics"
+
+# factory attr / ctor name -> metric kind
+_KINDS = {
+    "counter": "counter", "labeled_counter": "counter",
+    "Counter": "counter", "LabeledCounter": "counter",
+    "gauge": "gauge", "labeled_gauge": "gauge",
+    "Gauge": "gauge", "LabeledGauge": "gauge",
+    "histogram": "histogram", "labeled_histogram": "histogram",
+    "Histogram": "histogram", "LabeledHistogram": "histogram",
+    "state_gauge": "state", "StateGauge": "state",
+}
+# constructions whose omitted `buckets` default to the latency buckets
+_LATENCY_DEFAULT = {"labeled_histogram", "LabeledHistogram"}
+
+
+def _metric_call_kind(idx: ModuleIndex, call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Attribute):
+        name = call.func.attr
+        if name in _KINDS and name[0].islower():
+            return name
+    elif isinstance(call.func, ast.Name):
+        name = call.func.id
+        if name in _KINDS and name[0].isupper() and idx.imports.get(
+                name, "").startswith(METRICS_MODULE + "."):
+            return name
+    return None
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _buckets_expr(call: ast.Call, ctor: str) -> Optional[ast.AST]:
+    expr = _kwarg(call, "buckets")
+    if expr is not None:
+        return expr
+    pos = {"histogram": 2, "Histogram": 2,
+           "labeled_histogram": 3, "LabeledHistogram": 3}.get(ctor)
+    if pos is not None and len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    for idx in project.modules.values():
+        if idx.modname == METRICS_MODULE:
+            continue    # the registry factories pass names through
+        for node in ast.walk(idx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            _check_labels_call(idx, node, findings)
+            ctor = _metric_call_kind(idx, node)
+            if ctor is None:
+                continue
+            kind = _KINDS[ctor]
+            name_expr = node.args[0] if node.args else _kwarg(node,
+                                                             "name")
+            parts = idx.str_parts(name_expr) if name_expr is not None \
+                else None
+            if parts is None:
+                continue    # not a string-ish first arg: not a metric
+            prefix, suffix, exact = parts
+            name = prefix if exact else f"{prefix}…{suffix}"
+            if not exact and not suffix:
+                findings.append(Finding(
+                    checker=CHECKER, path=idx.relpath, line=node.lineno,
+                    message=f"{kind} name is not statically "
+                            "enumerable (dynamic tail)",
+                    evidence=ast.get_source_segment(idx.source,
+                                                    name_expr) or name,
+                    fix_hint="give the family a constant suffix so the "
+                             "static lint can enforce naming",
+                    token=name))
+                continue
+            if kind == "counter" and not suffix.endswith("_total"):
+                findings.append(Finding(
+                    checker=CHECKER, path=idx.relpath, line=node.lineno,
+                    message=f"counter `{name}` must end in `_total`",
+                    evidence=f"{ctor}(...) construction",
+                    fix_hint="rename the family; Prometheus counter "
+                             "convention (test_metrics_exposition "
+                             "enforces it at runtime for imported "
+                             "modules)",
+                    token=name))
+            elif kind == "gauge" and suffix.endswith("_total"):
+                findings.append(Finding(
+                    checker=CHECKER, path=idx.relpath, line=node.lineno,
+                    message=f"gauge `{name}` must not end in `_total` "
+                            "(that suffix promises a counter)",
+                    evidence=f"{ctor}(...) construction",
+                    fix_hint="rename the gauge or use a counter",
+                    token=name))
+            elif kind == "histogram":
+                buckets = _buckets_expr(node, ctor)
+                bucket_chain = dotted(buckets) if buckets is not None \
+                    else None
+                if buckets is None:
+                    is_latency = ctor in _LATENCY_DEFAULT
+                elif bucket_chain is not None:
+                    if "LATENCY" in bucket_chain:
+                        is_latency = True
+                    elif "DEFAULT" in bucket_chain:
+                        is_latency = False
+                    else:
+                        continue    # custom named buckets: no claim
+                else:
+                    continue        # inline bucket literal: no claim
+                ends_seconds = suffix.endswith("_seconds")
+                if is_latency and not ends_seconds:
+                    findings.append(Finding(
+                        checker=CHECKER, path=idx.relpath,
+                        line=node.lineno,
+                        message=f"histogram `{name}` uses the latency "
+                                "buckets but is not named `*_seconds`",
+                        evidence=f"{ctor}(..., buckets="
+                                 f"{bucket_chain or 'default'})",
+                        fix_hint="durations are measured in seconds "
+                                 "and named *_seconds "
+                                 "(LATENCY_BUCKETS_S contract)",
+                        token=name))
+                elif not is_latency and ends_seconds:
+                    findings.append(Finding(
+                        checker=CHECKER, path=idx.relpath,
+                        line=node.lineno,
+                        message=f"histogram `{name}` claims seconds "
+                                "but uses count/size buckets",
+                        evidence=f"{ctor}(..., buckets="
+                                 f"{bucket_chain or 'default'})",
+                        fix_hint="pass LATENCY_BUCKETS_S or drop the "
+                                 "_seconds suffix",
+                        token=name))
+    return findings
+
+
+def _is_open_vocabulary(expr: ast.AST) -> Optional[str]:
+    """Why a label-value expression is an unbounded vocabulary, else
+    None.  Closed sources (names, enum attrs, constants, str() folds
+    of closed helpers) pass."""
+    if isinstance(expr, ast.JoinedStr) and any(
+            isinstance(v, ast.FormattedValue) for v in expr.values):
+        return "f-string label value"
+    if isinstance(expr, ast.BinOp) and isinstance(
+            expr.op, (ast.Add, ast.Mod)):
+        for side in (expr.left, expr.right):
+            if isinstance(side, (ast.Constant, ast.JoinedStr)) and (
+                    not isinstance(side, ast.Constant)
+                    or isinstance(side.value, str)):
+                return "string-built label value"
+        return None
+    if isinstance(expr, ast.Call) and isinstance(expr.func,
+                                                 ast.Attribute) \
+            and expr.func.attr == "format":
+        return ".format() label value"
+    return None
+
+
+def _check_labels_call(idx: ModuleIndex, node: ast.Call,
+                       findings: List[Finding]) -> None:
+    if not (isinstance(node.func, ast.Attribute)
+            and node.func.attr == "labels" and node.keywords):
+        return
+    pairs = []      # (label name, value expr)
+    for kw in node.keywords:
+        if kw.arg is not None:
+            pairs.append((kw.arg, kw.value))
+        elif isinstance(kw.value, ast.Dict):
+            # labels(**{"class": ...}) — the tree's standard idiom for
+            # reserved-word label names; the dict values are label
+            # values all the same
+            for key, value in zip(kw.value.keys, kw.value.values):
+                name = key.value if isinstance(key, ast.Constant) \
+                    and isinstance(key.value, str) else "<dynamic>"
+                pairs.append((name, value))
+    for label_name, value_expr in pairs:
+        why = _is_open_vocabulary(value_expr)
+        if why is not None:
+            findings.append(Finding(
+                checker=CHECKER, path=idx.relpath, line=node.lineno,
+                message=f"label `{label_name}` built from an open "
+                        f"vocabulary ({why})",
+                evidence=ast.get_source_segment(idx.source, value_expr)
+                or why,
+                fix_hint="source label values from a closed enum / "
+                         "module constant / bounded fold helper — "
+                         "open vocabularies explode scrape "
+                         "cardinality",
+                token=f"labels:{label_name}"))

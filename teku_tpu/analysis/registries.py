@@ -1,0 +1,179 @@
+"""closed-registry: fault sites and flight-recorder event kinds are
+declared, in one registry module each.
+
+The mechanized bug class: ``faults.check("bls.mesh_shard")`` strings
+and flight-recorder event kinds grew by grep — the faults docstring
+lists sites "in use (grep for faults.check)", and the doctor keys on
+literal kind strings it hopes emitters spell the same way.  A typo'd
+site silently never fires its fault; a typo'd event kind silently
+never matches its doctor analyzer.  This checker closes both
+vocabularies:
+
+- ``infra/faults.py`` declares ``SITES``; every ``faults.check(site)``
+  / ``faults.transform(site, ...)`` literal must be a member, and
+  every member must be used somewhere (a dead site is a stale
+  contract);
+- ``infra/flightrecorder.py`` declares ``EVENT_KINDS``; every
+  ``record("kind", ...)`` on a recorder must be a member, and members
+  must be emitted somewhere in the tree.
+
+Dynamic (non-literal) sites/kinds outside the registry modules are
+findings too — an unverifiable vocabulary is an open one.  The
+registry modules themselves may forward dynamics (``record(kind)``).
+"""
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .astutil import ModuleIndex, Project, dotted
+from .findings import Finding
+
+CHECKER = "closed-registry"
+FAULTS_MODULE = "teku_tpu.infra.faults"
+FLIGHT_MODULE = "teku_tpu.infra.flightrecorder"
+SITES_NAME = "SITES"
+KINDS_NAME = "EVENT_KINDS"
+
+
+def _declared_set(idx: Optional[ModuleIndex], name: str
+                  ) -> Optional[Dict[str, int]]:
+    """{member: line} of a module-level ``NAME = frozenset({...})``
+    (or set/tuple/list literal), else None when absent."""
+    if idx is None:
+        return None
+    for node in idx.tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name):
+            continue
+        value = node.value
+        if isinstance(value, ast.Call) and dotted(value.func) in (
+                "frozenset", "set") and value.args:
+            value = value.args[0]
+        if isinstance(value, (ast.Set, ast.Tuple, ast.List)):
+            return {elt.value: elt.lineno for elt in value.elts
+                    if isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, str)}
+    return None
+
+
+def _fault_site_arg(idx: ModuleIndex, call: ast.Call
+                    ) -> Optional[ast.AST]:
+    chain = dotted(call.func)
+    if chain is not None and chain.split(".")[-1] in ("check",
+                                                      "transform"):
+        parts = chain.split(".")
+        if "faults" in parts[:-1]:
+            return call.args[0] if call.args else None
+    if isinstance(call.func, ast.Name) and idx.imports.get(
+            call.func.id, "").startswith(FAULTS_MODULE + "."):
+        if call.func.id in ("check", "transform") or idx.imports[
+                call.func.id].rsplit(".", 1)[1] in ("check",
+                                                    "transform"):
+            return call.args[0] if call.args else None
+    return None
+
+
+def _event_kind_arg(idx: ModuleIndex, call: ast.Call
+                    ) -> Optional[Tuple[ast.AST, bool]]:
+    """(kind expr, is_config_demotion) of a flight-recorder emit."""
+    chain = dotted(call.func)
+    if chain is not None:
+        parts = chain.split(".")
+        last = parts[-1]
+        recorder_ish = any("recorder" in p.lower()
+                           or p == "flightrecorder"
+                           for p in parts[:-1])
+        if last == "record" and recorder_ish:
+            return (call.args[0], False) if call.args else None
+        if last == "config_demotion" and ("flightrecorder" in parts[:-1]
+                                          or len(parts) == 1):
+            return None     # fixed-kind helper; kind is closed by def
+    if isinstance(call.func, ast.Name):
+        target = idx.imports.get(call.func.id, "")
+        if target == f"{FLIGHT_MODULE}.record":
+            return (call.args[0], False) if call.args else None
+    return None
+
+
+def check(project: Project) -> List[Finding]:
+    findings: List[Finding] = []
+    faults_idx = project.modules.get(FAULTS_MODULE)
+    flight_idx = project.modules.get(FLIGHT_MODULE)
+    specs = [
+        ("fault site", faults_idx, FAULTS_MODULE, SITES_NAME,
+         _declared_set(faults_idx, SITES_NAME), _fault_site_arg,
+         "declare the site in infra/faults.py SITES"),
+        ("event kind", flight_idx, FLIGHT_MODULE, KINDS_NAME,
+         _declared_set(flight_idx, KINDS_NAME),
+         lambda idx, call: _event_kind_arg(idx, call) and
+         _event_kind_arg(idx, call)[0],
+         "declare the kind in infra/flightrecorder.py EVENT_KINDS"),
+    ]
+    for (label, reg_idx, reg_mod, reg_name, declared, extract,
+         hint) in specs:
+        if reg_idx is None:
+            continue        # registry module not in the scanned tree
+        if declared is None:
+            findings.append(Finding(
+                checker=CHECKER, path=reg_idx.relpath, line=1,
+                message=f"registry module declares no `{reg_name}` — "
+                        f"the {label} vocabulary is open",
+                evidence=f"{reg_mod} has no module-level {reg_name}",
+                fix_hint=hint, token=reg_name))
+            continue
+        # members the registry module itself emits do so through its
+        # own internals (rec.record("fatal_crash"), the dump header
+        # dict) — count any string literal inside its FUNCTION BODIES
+        # as a local reference (the declaration itself is module-level
+        # and must not mark its own members used)
+        used: Set[str] = set()
+        for fnode in ast.walk(reg_idx.tree):
+            if isinstance(fnode, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                for sub in ast.walk(fnode):
+                    if isinstance(sub, ast.Constant) \
+                            and isinstance(sub.value, str) \
+                            and sub.value in declared:
+                        used.add(sub.value)
+        for idx in project.modules.values():
+            for node in ast.walk(idx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                arg = extract(idx, node)
+                if arg is None:
+                    continue
+                value = project.resolve_str(idx, arg)
+                if value is None:
+                    if idx.modname != reg_mod:
+                        findings.append(Finding(
+                            checker=CHECKER, path=idx.relpath,
+                            line=node.lineno,
+                            message=f"dynamic {label} — the closed "
+                                    "vocabulary cannot be verified",
+                            evidence=ast.get_source_segment(
+                                idx.source, node) or "<dynamic>",
+                            fix_hint="pass a literal (or registry-"
+                                     "declared constant) " + label,
+                            token=f"dynamic:{idx.modname}"))
+                    continue
+                used.add(value)
+                if value not in declared:
+                    findings.append(Finding(
+                        checker=CHECKER, path=idx.relpath,
+                        line=node.lineno,
+                        message=f"undeclared {label} `{value}`",
+                        evidence=ast.get_source_segment(
+                            idx.source, node) or value,
+                        fix_hint=hint, token=value))
+        for member, line in declared.items():
+            if member not in used:
+                findings.append(Finding(
+                    checker=CHECKER, path=reg_idx.relpath, line=line,
+                    message=f"declared {label} `{member}` is never "
+                            "used in the tree",
+                    evidence=f"{reg_name} member with no emit site",
+                    fix_hint="remove the stale member (or wire the "
+                             "missing emitter)",
+                    token=member))
+    return findings

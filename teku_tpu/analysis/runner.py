@@ -1,0 +1,127 @@
+"""Orchestration: walk the tree, parse once, run every checker, apply
+suppressions, render.
+
+Scope: the production tree — the ``teku_tpu`` package, ``tools/``,
+and ``bench.py``.  Tests are deliberately OUT of scope (they
+monkeypatch env vars and fabricate metric families as fixtures; the
+invariants guard production code).  When pointed at a root with no
+``teku_tpu`` package (the fixture trees in tests/test_analysis.py)
+every ``*.py`` under the root is scanned instead, so checkers prove
+out on small synthetic trees.
+
+A file that fails to PARSE is itself a finding (checker ``parse``) —
+the analyzer must never report "clean" on a tree it could not read.
+"""
+
+import ast
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import (dup_helpers, env_knob, jit_purity, knob_docs,
+               metric_contract, registries, suppress, torn_read)
+from .astutil import ModuleIndex, Project
+from .findings import Finding, Report
+
+DEFAULT_SUPPRESSIONS = "lint_suppressions.json"
+
+# id -> run(project) — the checker registry (knob-doc runs separately:
+# it needs the extracted knob list and the README text)
+CHECKERS: List[Tuple[str, Callable[[Project], List[Finding]]]] = [
+    (env_knob.CHECKER, env_knob.check),
+    (jit_purity.CHECKER, jit_purity.check),
+    (torn_read.CHECKER, torn_read.check),
+    (metric_contract.CHECKER, metric_contract.check),
+    (registries.CHECKER, registries.check),
+    (dup_helpers.CHECKER, dup_helpers.check),
+]
+
+
+def default_root() -> str:
+    """The repo root: parent of the teku_tpu package directory."""
+    package_dir = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    return os.path.dirname(package_dir)
+
+
+def discover_files(root: str) -> List[str]:
+    """Repo-relative paths of the production tree (or every *.py for
+    a fixture root without the package)."""
+    out: List[str] = []
+    package = os.path.join(root, "teku_tpu")
+    if os.path.isdir(package):
+        scan_dirs = [package, os.path.join(root, "tools")]
+        for path in (os.path.join(root, "bench.py"),):
+            if os.path.isfile(path):
+                out.append(os.path.relpath(path, root))
+    else:
+        scan_dirs = [root]
+    for base in scan_dirs:
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if d != "__pycache__"
+                           and not d.startswith(".")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.relpath(
+                        os.path.join(dirpath, fn), root))
+    return sorted(set(p.replace(os.sep, "/") for p in out))
+
+
+def build_project(root: str, relpaths: List[str]
+                  ) -> Tuple[Project, List[Finding]]:
+    modules: Dict[str, ModuleIndex] = {}
+    parse_findings: List[Finding] = []
+    for relpath in relpaths:
+        path = os.path.join(root, relpath)
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            tree = ast.parse(source, filename=relpath)
+        except (OSError, SyntaxError, ValueError) as exc:
+            parse_findings.append(Finding(
+                checker="parse", path=relpath,
+                line=getattr(exc, "lineno", 1) or 1,
+                message=f"file cannot be parsed: {exc}",
+                fix_hint="a tree the analyzer cannot read cannot be "
+                         "declared clean",
+                token="parse-error"))
+            continue
+        idx = ModuleIndex(path, relpath, tree, source)
+        modules[idx.modname] = idx
+    return Project(root, modules), parse_findings
+
+
+def run_lint(root: Optional[str] = None,
+             suppressions_path: Optional[str] = None,
+             checker_ids: Optional[List[str]] = None) -> Report:
+    """Run the analyzer over `root` (default: this repo).  Raises
+    suppress.SuppressionError on an invalid suppression file."""
+    root = os.path.abspath(root or default_root())
+    relpaths = discover_files(root)
+    project, findings = build_project(root, relpaths)
+
+    for checker_id, run in CHECKERS:
+        if checker_ids is not None and checker_id not in checker_ids:
+            continue
+        findings.extend(run(project))
+
+    knobs = env_knob.collect_knobs(project)
+    if checker_ids is None or knob_docs.CHECKER in checker_ids:
+        readme = os.path.join(root, "README.md")
+        readme_text = ""
+        if os.path.isfile(readme):
+            with open(readme, encoding="utf-8") as fh:
+                readme_text = fh.read()
+        findings.extend(knob_docs.check(project, knobs, readme_text))
+
+    entries = suppress.load(
+        suppressions_path if suppressions_path is not None
+        else os.path.join(root, DEFAULT_SUPPRESSIONS))
+    findings, unused = suppress.apply(findings, entries)
+
+    report = Report(root=root, files_scanned=len(relpaths),
+                    findings=findings, unused_suppressions=unused,
+                    knobs=knobs)
+    return report

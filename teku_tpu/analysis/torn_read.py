@@ -1,0 +1,77 @@
+"""torn-read: registered swap attributes are read at most once per
+function.
+
+The mechanized bug class (fixed twice in PR 12 alone): state that hot-
+swaps atomically — ``GuardedBls12381._serving`` holds its (provider,
+device-entry lock) as ONE tuple precisely so readers can't observe a
+half-swap — is only atomic if each reader performs ONE attribute load
+and destructures the snapshot.  Two reads in the same function
+(``self._serving[0]`` … ``self._serving[1]``, or a re-read after a
+blocking call) can straddle a swap and pair the new provider with the
+old lock: the exact bug the supervisor reprobe and the bench chaos
+phase each shipped once.
+
+Registration lives with the owning module: a module-level
+
+    __swap_attrs__ = ("_serving",)
+
+declares its atomically-swapped attributes; the checker collects every
+declaration in the tree and then enforces the single-read rule on all
+scanned functions (any module — cross-module readers like
+``loader._warmup`` read ``guarded._serving`` too).
+"""
+
+import ast
+from typing import Dict, List, Set
+
+from .astutil import Project, all_functions, iter_scope
+from .findings import Finding
+
+CHECKER = "torn-read"
+DECL = "__swap_attrs__"
+
+
+def declared_swap_attrs(project: Project) -> Set[str]:
+    attrs: Set[str] = set()
+    for idx in project.modules.values():
+        for node in idx.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == DECL \
+                    and isinstance(node.value, (ast.Tuple, ast.List)):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, str):
+                        attrs.add(elt.value)
+    return attrs
+
+
+def check(project: Project) -> List[Finding]:
+    swap_attrs = declared_swap_attrs(project)
+    if not swap_attrs:
+        return []
+    findings: List[Finding] = []
+    for idx in project.modules.values():
+        for qualname, func in all_functions(idx):
+            reads: Dict[str, List[int]] = {}
+            for node in iter_scope(func):
+                if isinstance(node, ast.Attribute) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and node.attr in swap_attrs:
+                    reads.setdefault(node.attr, []).append(node.lineno)
+            for attr, lines in reads.items():
+                if len(lines) > 1:
+                    findings.append(Finding(
+                        checker=CHECKER, path=idx.relpath,
+                        line=lines[1],
+                        message=f"swap attribute `{attr}` read "
+                                f"{len(lines)} times in `{qualname}` — "
+                                "a second read can straddle an atomic "
+                                "swap",
+                        evidence=f"reads at lines "
+                                 f"{', '.join(map(str, lines))}",
+                        fix_hint="read once into a local "
+                                 f"(`snap = x.{attr}`) and destructure "
+                                 "the snapshot",
+                        token=f"{qualname}:{attr}"))
+    return findings

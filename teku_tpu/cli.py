@@ -20,6 +20,7 @@ import sys
 from pathlib import Path
 from typing import Any, Dict, Optional
 
+from .infra.env import env_raw, env_str
 from .infra.logs import configure as configure_logging
 
 ENV_PREFIX = "TEKU_TPU_"
@@ -27,10 +28,16 @@ ENV_PREFIX = "TEKU_TPU_"
 
 def layered_value(name: str, cli_value, yaml_cfg: Dict[str, Any],
                   default=None, cast=str):
-    """CLI > env > YAML > default (reference CascadingParamsProvider)."""
+    """CLI > env > YAML > default (reference CascadingParamsProvider).
+
+    env_raw, not a typed helper: "unset" must stay distinguishable
+    from every real value so YAML and defaults cascade beneath, and a
+    malformed value fails flag validation loudly at boot with the
+    operator present — the one place typo-degrades is the wrong
+    contract."""
     if cli_value is not None:
         return cli_value
-    env = os.environ.get(ENV_PREFIX + name.upper().replace("-", "_"))
+    env = env_raw(ENV_PREFIX + name.upper().replace("-", "_"))
     if env is not None:
         return cast(env)
     if name in yaml_cfg:
@@ -473,7 +480,7 @@ def _hard_exit_if_virtual_devices(rc: int) -> None:
     ``main(["devnet", ...])`` directly) must never be os._exit'ed out
     from under its caller — ``auto`` (default) skips whenever pytest
     is loaded; ``1`` forces, ``0`` disables."""
-    mode = os.environ.get("TEKU_TPU_DEVNET_HARD_EXIT", "auto")
+    mode = env_str("TEKU_TPU_DEVNET_HARD_EXIT", "auto")
     if mode in ("0", "off", "false"):
         return
     if mode != "1" and "pytest" in sys.modules:
@@ -1018,6 +1025,42 @@ def cmd_doctor(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """tekulint: the AST-based invariant analyzer (teku_tpu/analysis).
+
+    Mechanizes the review-hardening bug classes of PRs 1-12 — raw
+    TEKU_TPU_* env reads, trace-time side effects inside jit'd
+    kernels, torn two-read access to swap attributes, metric naming /
+    label-vocabulary violations, undeclared fault sites and flight
+    event kinds, duplicated private helpers, and README knob drift.
+    Exit 0 = clean, 1 = unsuppressed findings (or stale suppression
+    entries), 2 = the suppression file itself is invalid."""
+    from .analysis import run_lint
+    from .analysis.env_knob import render_knob_table
+    from .analysis.suppress import SuppressionError
+
+    try:
+        report = run_lint(root=args.root,
+                          suppressions_path=args.suppressions)
+    except SuppressionError as exc:
+        print(f"lint: {exc}", file=sys.stderr)
+        return 2
+    if args.knobs:
+        table = render_knob_table(report.knobs)
+        if args.out:
+            Path(args.out).write_text(table + "\n")
+        print(table)
+        return 0
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=1))
+    else:
+        print(report.render_text())
+    if args.out:
+        Path(args.out).write_text(
+            json.dumps(report.to_dict(), indent=1))
+    return 0 if report.clean else 1
+
+
 # --------------------------------------------------------------------------
 
 def build_parser() -> argparse.ArgumentParser:
@@ -1252,6 +1295,28 @@ def build_parser() -> argparse.ArgumentParser:
     dr.add_argument("--tracing", default=None)
     dr.add_argument("--overload-control", default=None)
     dr.set_defaults(fn=cmd_doctor)
+
+    ln = sub.add_parser(
+        "lint",
+        help="AST-based invariant analyzer over the production tree "
+             "(env-knob discipline, jit purity, torn reads, metric "
+             "contract, closed registries, duplicate helpers, knob "
+             "doc drift)")
+    ln.add_argument("--root", default=None,
+                    help="tree to analyze (default: this repo)")
+    ln.add_argument("--suppressions", default=None,
+                    help="suppression file (default: "
+                         "<root>/lint_suppressions.json; every entry "
+                         "needs a justification)")
+    ln.add_argument("--json", action="store_true",
+                    help="print the machine-readable report")
+    ln.add_argument("--out", default=None,
+                    help="also write the JSON report (or --knobs "
+                         "table) to this path")
+    ln.add_argument("--knobs", action="store_true",
+                    help="emit the auto-extracted TEKU_TPU_* knob "
+                         "registry as a markdown table and exit 0")
+    ln.set_defaults(fn=cmd_lint)
 
     mg = sub.add_parser("migrate-database",
                         help="convert a data dir between storage modes")

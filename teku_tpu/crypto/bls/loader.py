@@ -35,6 +35,7 @@ from typing import Optional, Sequence, Tuple
 
 from . import get_implementation, reset_implementation, set_implementation
 from ...infra import faults, tracing
+from ...infra.env import env_bool, env_float, env_int, env_str
 from ...infra.metrics import GLOBAL_REGISTRY, MetricsRegistry
 from ...infra.supervisor import (BackendSupervisor, CircuitBreaker,
                                  CircuitOpenError, DispatchTimeoutError,
@@ -82,7 +83,7 @@ def _probe_jax(max_batch: int, min_bucket: int, mont_path=None,
     if msm_path is not None:
         msm.set_path(msm_path)
     if mesh is None:
-        mesh = os.environ.get("TEKU_TPU_MESH", "off")
+        mesh = env_str("TEKU_TPU_MESH", "off")
     from ... import parallel
     mesh_obj = None
     n_mesh = parallel.resolve_mesh_devices(mesh)
@@ -102,6 +103,16 @@ def _probe_jax(max_batch: int, min_bucket: int, mont_path=None,
 # --------------------------------------------------------------------------
 # Guarded provider: the hot-swap target installed at READY
 # --------------------------------------------------------------------------
+
+# Atomically-swapped state registration for the static analyzer:
+# `_serving` holds the (provider, device-entry lock) PAIR as one tuple
+# so a reader can never observe a half-swap — which is only true if
+# every reader performs exactly ONE attribute load and destructures
+# the snapshot.  `cli lint`'s torn-read checker enforces the
+# single-read rule tree-wide for every attribute declared here (the
+# two-read bug shipped twice during PR 12 review).
+__swap_attrs__ = ("_serving",)
+
 
 class _DeferredSemi(BatchSemiAggregate):
     """Raw triple held until complete_batch_verify, so the guarded
@@ -363,8 +374,7 @@ def make_mesh_healer(guarded: GuardedBls12381,
 
     impl = guarded.device
     sharded = getattr(impl, "_sharded", None)
-    if sharded is None or os.environ.get(
-            "TEKU_TPU_MESH_SELF_HEAL", "1") in ("0", "off", "false"):
+    if sharded is None or not env_bool("TEKU_TPU_MESH_SELF_HEAL", True):
         return None
     mesh_devices = list(_np.ravel(sharded.mesh.devices))
     names = [str(d) for d in mesh_devices]
@@ -408,7 +418,6 @@ def make_mesh_healer(guarded: GuardedBls12381,
         # warm batch is a knob (default a fraction of the service
         # bucket; the persistent compile cache usually turns this into
         # disk loads).  A wrong verdict VETOES the install.
-        from ...infra.env import env_int
         wb = max(1, env_int("TEKU_TPU_MESH_WARM_BATCH",
                             min(max_batch, 64)))
         try:
@@ -507,12 +516,12 @@ def make_supervisor(*, max_batch: int = 256, min_bucket: int = 16,
     def _make_breaker(bname: str) -> CircuitBreaker:
         return CircuitBreaker(
             name=bname, registry=registry,
-            failure_threshold=int(os.environ.get(
-                "TEKU_TPU_BREAKER_THRESHOLD", "3")),
-            deadline_s=float(os.environ.get(
-                "TEKU_TPU_DISPATCH_DEADLINE_S", "30")),
-            cooldown_s=float(os.environ.get(
-                "TEKU_TPU_BREAKER_COOLDOWN_S", "30")))
+            failure_threshold=env_int("TEKU_TPU_BREAKER_THRESHOLD", 3,
+                                      lo=1),
+            deadline_s=env_float("TEKU_TPU_DISPATCH_DEADLINE_S", 30.0,
+                                 lo=0.1),
+            cooldown_s=env_float("TEKU_TPU_BREAKER_COOLDOWN_S", 30.0,
+                                 lo=0.1))
 
     if breaker is None:
         # `bls_device_*` metric series, per the README/PERF.md contract
@@ -725,8 +734,8 @@ def configure(choice: str = "auto", *, max_batch: int = 256,
         _reset_kzg_backend()
         return "pure"
     if probe_timeout_s is None:
-        probe_timeout_s = float(
-            os.environ.get("TEKU_TPU_BLS_PROBE_TIMEOUT_S", "120"))
+        probe_timeout_s = env_float("TEKU_TPU_BLS_PROBE_TIMEOUT_S",
+                                    120.0, lo=1.0)
 
     result: dict = {}
 

@@ -40,21 +40,20 @@ cost — which is why the latency model keys on the padded dispatch
 shape, not a scalar average.
 """
 
-import os
 import threading
 import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 from . import flightrecorder, tracing
+from .env import env_float, env_int
 from .metrics import GLOBAL_REGISTRY, MetricsRegistry
 
-DEFAULT_WINDOW_S = float(os.environ.get("TEKU_TPU_CAPACITY_WINDOW_S",
-                                        "60"))
+DEFAULT_WINDOW_S = env_float("TEKU_TPU_CAPACITY_WINDOW_S", 60.0,
+                             lo=1.0)
 
 # distinct `shape` label values before the model folds into "other"
-DEFAULT_MAX_SHAPES = int(os.environ.get("TEKU_TPU_CAPACITY_MAX_SHAPES",
-                                        "24"))
+DEFAULT_MAX_SHAPES = env_int("TEKU_TPU_CAPACITY_MAX_SHAPES", 24, lo=1)
 
 # Well-known arrival sources: distinct demand streams the utilization
 # model attributes separately (bounded: a handful of named verbs plus

@@ -28,6 +28,7 @@ import os
 import sys
 import threading
 
+from .env import env_float, env_str
 from .metrics import GLOBAL_REGISTRY
 
 _LOG = logging.getLogger(__name__)
@@ -93,7 +94,7 @@ def configure(cache_dir=None, min_compile_s=None, enabled=True):
     dir, 2 s minimum compile time so trivial programs don't churn the
     disk).  TEKU_TPU_XLA_CACHE_DIR=off disables.
     """
-    env_dir = os.environ.get(ENV_DIR)
+    env_dir = env_str(ENV_DIR)
     if cache_dir is None:
         cache_dir = env_dir
     if (not enabled or (cache_dir is not None
@@ -117,7 +118,7 @@ def configure(cache_dir=None, min_compile_s=None, enabled=True):
     if cache_dir is None:
         cache_dir = default_dir()
     if min_compile_s is None:
-        min_compile_s = float(os.environ.get(ENV_MIN_COMPILE_S, "2"))
+        min_compile_s = env_float(ENV_MIN_COMPILE_S, 2.0, lo=0.0)
     settings = {
         "jax_compilation_cache_dir": str(cache_dir),
         "jax_persistent_cache_min_compile_time_secs": min_compile_s,

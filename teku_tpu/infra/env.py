@@ -1,24 +1,157 @@
 """Typed environment-variable reads shared by the knob-heavy modules.
 
-A malformed value reads as the default instead of raising: a typo in
-an operator's unit file must degrade the knob, never the node.
+The ONE definition of the knob-read contract (enforced full-tree by
+``cli lint``'s env-knob checker — teku_tpu/analysis/env_knob.py):
+
+- a malformed value DEGRADES to the default with one WARN per knob,
+  never raises: a typo in an operator's unit file must cost the knob,
+  not the node (the PR 11 ledger-capacity precedent, now universal);
+- numeric knobs may declare clamp bounds (`lo`/`hi`); an out-of-range
+  value clamps with the same one-WARN contract (a negative
+  flush-failsafe once put a wall deadline in the past);
+- every read site is statically visible to the analyzer, which
+  auto-extracts the knob registry behind ``cli lint --knobs`` and the
+  README drift check — reading through these helpers IS the
+  registration.
+
+``env_raw`` exists for the CLI's layering seam (CLI > env > YAML needs
+the unparsed string to cascade) and ``env_override`` for bench-style
+save/set/restore; neither parses, both keep raw ``os.environ`` access
+inside this module.
 """
 
+import contextlib
+import logging
 import os
+import threading
+from typing import Iterator, Optional, Sequence
+
+_LOG = logging.getLogger(__name__)
+
+# one WARN per (knob, complaint) per process: knob reads run on hot
+# paths (dispatch planning, health ticks) and a typo must not flood
+_warn_lock = threading.Lock()
+_warned = set()
 
 
-def env_float(name: str, default: float) -> float:
+def _warn_once(name: str, complaint: str) -> None:
+    with _warn_lock:
+        key = (name, complaint)
+        if key in _warned:
+            return
+        _warned.add(key)
+    _LOG.warning("%s %s", name, complaint)
+
+
+def _reset_warnings() -> None:
+    """Test seam: let a regression test assert the one-WARN contract."""
+    with _warn_lock:
+        _warned.clear()
+
+
+def _clamp(name: str, value, lo, hi):
+    if lo is not None and value < lo:
+        _warn_once(name, f"={value!r} below minimum {lo}; clamping")
+        return lo
+    if hi is not None and value > hi:
+        _warn_once(name, f"={value!r} above maximum {hi}; clamping")
+        return hi
+    return value
+
+
+def env_float(name: str, default: float, lo: Optional[float] = None,
+              hi: Optional[float] = None) -> float:
+    raw = os.environ.get(name)
+    if raw is None:
+        return float(default)
     try:
-        return float(os.environ.get(name, default))
+        value = float(raw)
     except (TypeError, ValueError):
-        return default
+        _warn_once(name, f"={raw!r} is not a number; using default "
+                         f"{default}")
+        return float(default)
+    return _clamp(name, value, lo, hi)
 
 
-def env_int(name: str, default: int) -> int:
+def env_int(name: str, default: int, lo: Optional[int] = None,
+            hi: Optional[int] = None) -> int:
+    raw = os.environ.get(name)
+    if raw is None:
+        return int(default)
     try:
-        return int(os.environ.get(name, default))
+        value = int(raw)
     except (TypeError, ValueError):
+        _warn_once(name, f"={raw!r} is not an integer; using default "
+                         f"{default}")
+        return int(default)
+    return _clamp(name, value, lo, hi)
+
+
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    """A string knob (paths, modes with site-local validation).  An
+    EMPTY value reads as unset — `TEKU_TPU_X=` in a unit file means
+    "default", not "empty-string mode"."""
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
         return default
+    return raw
+
+
+_TRUE = ("1", "on", "true", "yes")
+_FALSE = ("0", "off", "false", "no")
+
+
+def env_bool(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    lowered = raw.strip().lower()
+    if lowered in _TRUE:
+        return True
+    if lowered in _FALSE:
+        return False
+    _warn_once(name, f"={raw!r} is not a boolean "
+                     f"({'/'.join(_TRUE)} | {'/'.join(_FALSE)}); "
+                     f"using default {default}")
+    return default
+
+
+def env_choice(name: str, default: str,
+               choices: Sequence[str]) -> str:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    if raw in choices:
+        return raw
+    _warn_once(name, f"={raw!r} is not one of {'/'.join(choices)}; "
+                     f"using default {default!r}")
+    return default
+
+
+def env_raw(name: str, default: Optional[str] = None) -> Optional[str]:
+    """The unparsed value (None-able): the CLI layering seam, where
+    "unset" must stay distinguishable from every real value so YAML
+    and defaults can cascade beneath it."""
+    return os.environ.get(name, default)
+
+
+@contextlib.contextmanager
+def env_override(name: str, value: Optional[str]) -> Iterator[None]:
+    """Save/set/restore one knob around a scope (bench phases force
+    knobs for a measurement and must put the operator's value back;
+    ``None`` unsets)."""
+    prev = os.environ.get(name)
+    try:
+        if value is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = str(value)
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prev
 
 
 def ensure_virtual_devices(n) -> bool:

@@ -8,27 +8,17 @@ the supervisor/breaker state machine end to end — dispatch hangs,
 dispatch exceptions, wrong results, slow-ramp backend init, and queue
 overflow — without ever touching a real accelerator.
 
-Sites in use (grep for `faults.check` / `faults.transform`):
-
-- ``backend.init``       device bring-up probe (SlowRamp / Raise / Hang)
-- ``bls.dispatch``       JaxBls12381 device dispatch (begin + result)
-- ``bls.mesh_shard``     the sharded mesh dispatch.  Faults here may
-                         carry a ``key`` (a device index): the
-                         collective dispatch passes the LIVE device
-                         set (a wedged shard wedges the whole
-                         collective), while the self-healing mesh's
-                         per-device isolation probes pass one index —
-                         so a keyed fault models exactly one sick
-                         chip, and only that chip's probe fails
-                         (teku_tpu/parallel/selfheal.py)
-- ``bls.batch_verify``   the BLS facade's batch entry (WrongResult)
-- ``h2c.cache``          H(m) device-cache slot resolution
-                         (WrongResult(value=slot) poisons a hit; the
-                         cache must re-verify by digest and recompute,
-                         never flip a verdict — ops/h2c_cache.py)
-- ``kzg.dispatch``       device KZG backend calls
-- ``sigservice.enqueue`` batching-service queue admission (Overflow)
-- ``verifiers.dispatch`` the spec-level verifier seam
+The site vocabulary is CLOSED: ``SITES`` below declares every legal
+site string, and the static analyzer (`cli lint`, closed-registry
+checker) verifies both directions — no undeclared call site, no dead
+member.  Keyed sites: ``bls.mesh_shard`` faults may carry a ``key``
+(a device name) — the collective dispatch passes the LIVE device set
+(a wedged shard wedges the whole collective) while the self-healing
+mesh's per-device isolation probes pass one name, so a keyed fault
+models exactly one sick chip (teku_tpu/parallel/selfheal.py).
+``h2c.cache`` WrongResult(value=slot) poisons a cache hit; the cache
+must re-verify by digest and recompute, never flip a verdict
+(ops/h2c_cache.py).
 
 The no-fault fast path is one module-global bool check, so production
 traffic pays nothing for the instrumentation.  The registry is
@@ -42,8 +32,26 @@ import time
 from typing import Dict, List, Optional
 
 __all__ = ["Fault", "Hang", "Raise", "WrongResult", "SlowRamp",
-           "Overflow", "inject", "clear", "active", "check", "transform",
-           "fired_count"]
+           "Overflow", "SITES", "inject", "clear", "active", "check",
+           "transform", "fired_count"]
+
+# The CLOSED site vocabulary: every `check(site)` / `transform(site)`
+# string in the tree must be declared here, and every member must have
+# a live call site — enforced statically by `cli lint`'s
+# closed-registry checker (teku_tpu/analysis/registries.py), replacing
+# the grep-maintained list this docstring used to carry.  A typo'd
+# site would otherwise silently never fire its fault.
+SITES = frozenset({
+    "backend.init",         # device bring-up probe (SlowRamp/Raise/Hang)
+    "bls.dispatch",         # JaxBls12381 device dispatch (begin+result)
+    "bls.mesh_shard",       # sharded mesh dispatch; faults may carry a
+                            # device-name key (selfheal.FAULT_SITE)
+    "bls.batch_verify",     # BLS facade batch entry (WrongResult)
+    "h2c.cache",            # H(m) device-cache slot resolution
+    "kzg.dispatch",         # device KZG backend calls
+    "sigservice.enqueue",   # batching-service queue admission (Overflow)
+    "verifiers.dispatch",   # spec-level verifier seam
+})
 
 
 class Fault:

@@ -40,20 +40,48 @@ from collections import deque
 from typing import List, Optional
 
 from . import tracing
+from .env import env_float, env_int, env_str
 from .metrics import GLOBAL_REGISTRY, MetricsRegistry
 
 _LOG = logging.getLogger(__name__)
 
-DEFAULT_CAPACITY = int(os.environ.get(
-    "TEKU_TPU_FLIGHT_RECORDER_CAPACITY", "512"))
+# The CLOSED event-kind vocabulary: every `record("kind", ...)` in the
+# tree must be declared here — enforced statically by `cli lint`'s
+# closed-registry checker (teku_tpu/analysis/registries.py).  The
+# doctor and the admin-endpoint consumers key on these LITERAL strings
+# (infra/doctor.py analyzers, the bench flight tail), so an undeclared
+# kind is an event nothing will ever match.
+EVENT_KINDS = frozenset({
+    # backend supervision (PR 1)
+    "backend_state", "breaker_trip", "breaker_reclose", "warmup_cache",
+    # SLO / health (PR 3)
+    "slo_breach", "slo_recovery", "health_flip",
+    # service shedding + admission control (PRs 1/7)
+    "queue_shed", "flush_failsafe",
+    "brownout_enter", "brownout_exit", "brownout_deescalate",
+    # capacity + profiler (PR 6)
+    "capacity_headroom_exhausted", "capacity_headroom_recovered",
+    "profiler_capture_start", "profiler_capture_stop",
+    "profiler_capture_error",
+    # config self-explanation (PR 11)
+    "config_demotion",
+    # mesh self-healing (PR 12)
+    "mesh_eject", "mesh_readmit", "mesh_reshape",
+    "mesh_reshape_vetoed", "mesh_heal_unattributed",
+    # the recorder's own crash/dump machinery
+    "fatal_crash", "dump_header",
+})
+
+DEFAULT_CAPACITY = env_int("TEKU_TPU_FLIGHT_RECORDER_CAPACITY", 512,
+                           lo=1)
 
 # minimum seconds between automatic dumps (breaker trips can flap)
-THROTTLE_S = float(os.environ.get(
-    "TEKU_TPU_FLIGHT_RECORDER_THROTTLE_S", "30"))
+THROTTLE_S = env_float("TEKU_TPU_FLIGHT_RECORDER_THROTTLE_S", 30.0,
+                       lo=0.0)
 
 
 def default_dump_dir() -> str:
-    return os.environ.get("TEKU_TPU_FLIGHT_RECORDER_DIR") or os.path.join(
+    return env_str("TEKU_TPU_FLIGHT_RECORDER_DIR") or os.path.join(
         tempfile.gettempdir(), "teku_tpu_flightrecorder")
 
 

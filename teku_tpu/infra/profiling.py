@@ -32,13 +32,14 @@ from typing import Callable, Optional
 
 from . import flightrecorder, tracing
 from .env import env_float as _env_float
+from .env import env_str as _env_str
 from .metrics import GLOBAL_REGISTRY, MetricsRegistry
 
 _LOG = logging.getLogger(__name__)
 
 
 def default_profile_dir() -> str:
-    return os.environ.get("TEKU_TPU_PROFILE_DIR") or os.path.join(
+    return _env_str("TEKU_TPU_PROFILE_DIR") or os.path.join(
         tempfile.gettempdir(), "teku_tpu_profiles")
 
 

@@ -38,6 +38,8 @@ from contextlib import contextmanager
 from contextvars import ContextVar
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from .env import env_int
+
 from .metrics import GLOBAL_REGISTRY, LATENCY_BUCKETS_S
 
 # The canonical hot-path stages (bench reports percentiles for these;
@@ -168,7 +170,7 @@ class _SlowTraceRing:
 
 
 _RING = _SlowTraceRing(
-    int(os.environ.get("TEKU_TPU_SLOW_TRACE_RING", "32")))
+    env_int("TEKU_TPU_SLOW_TRACE_RING", 32, lo=1))
 
 
 def slow_traces() -> List[dict]:

@@ -17,6 +17,8 @@ import tempfile
 from pathlib import Path
 from typing import Optional
 
+from ..infra.env import env_str
+
 _LOG = logging.getLogger(__name__)
 
 _SRC = Path(__file__).parent / "src"
@@ -51,8 +53,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
     if _tried:
         return _lib
     _tried = True
-    build_dir = Path(os.environ.get(
-        "TEKU_TPU_NATIVE_DIR", Path(__file__).parent / "build"))
+    build_dir = Path(env_str("TEKU_TPU_NATIVE_DIR")
+                     or Path(__file__).parent / "build")
     try:
         build_dir.mkdir(parents=True, exist_ok=True)
         path = _build(build_dir)

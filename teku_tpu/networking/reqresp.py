@@ -13,11 +13,11 @@ messages where libp2p uses streams; the payload bytes are identical.
 
 import asyncio
 import logging
-import os
 import struct
 from typing import List, Optional, Sequence
 
 from ..infra.aio import retry_with_backoff
+from ..infra.env import env_float
 from ..spec import helpers as H
 from ..spec.codec import (deserialize_signed_block,
                           serialize_signed_block)
@@ -97,8 +97,8 @@ class BeaconRpc:
         self.net = net
         self.node = node
         if request_timeout_s is None:
-            request_timeout_s = float(os.environ.get(
-                "TEKU_TPU_REQRESP_TIMEOUT_S", "30"))
+            request_timeout_s = env_float("TEKU_TPU_REQRESP_TIMEOUT_S",
+                                          30.0, lo=0.1)
         self.request_timeout_s = request_timeout_s
         self.request_attempts = request_attempts
         self.seq_number = 0

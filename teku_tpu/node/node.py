@@ -12,10 +12,10 @@ timer or a devnet driver invokes.
 
 import asyncio
 import logging
-import os
 from typing import List, Optional
 
 from ..infra import flightrecorder
+from ..infra.env import env_bool, env_float
 from ..infra.events import EventChannels, SlotEventsChannel
 from ..infra.health import (CheckResult, EventLoopLagWatchdog,
                             HealthRegistry, HealthStatus, SloEngine,
@@ -84,9 +84,8 @@ class BeaconNode(Service):
         # on the attestation_verify_p50 burn rate it computes
         self.slo = SloEngine(name=name)
         if overload_control is None:
-            overload_control = os.environ.get(
-                "TEKU_TPU_OVERLOAD_CONTROL", "on") not in (
-                "0", "off", "false")
+            overload_control = env_bool("TEKU_TPU_OVERLOAD_CONTROL",
+                                        True)
         self.admission = AdmissionController(
             burn_getter=lambda: self.slo.burn_rate(
                 "attestation_verify_p50"),
@@ -166,7 +165,7 @@ class BeaconNode(Service):
         any single broken check/objective — losing the watchdog because
         one gauge raised would be the observability layer's own
         silent-failure bug."""
-        interval = float(os.environ.get("TEKU_TPU_HEALTH_TICK_S", "5"))
+        interval = env_float("TEKU_TPU_HEALTH_TICK_S", 5.0, lo=0.01)
         from ..infra import capacity, profiling
         while True:
             await asyncio.sleep(interval)

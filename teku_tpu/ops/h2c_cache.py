@@ -27,7 +27,6 @@ Knobs: ``TEKU_TPU_H2C_CACHE_CAP`` — arena capacity in points (default
 pipeline still dedups within each batch).
 """
 
-import os
 import threading
 from collections import OrderedDict
 from typing import List, Optional, Sequence
@@ -37,6 +36,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from ..infra import faults
+from ..infra.env import env_str
 from ..infra.metrics import GLOBAL_REGISTRY
 from . import limbs as fp
 
@@ -65,7 +65,7 @@ def evictions_counter(cache: str):
 
 
 def configured_capacity() -> int:
-    raw = os.environ.get(ENV_CAP, "")
+    raw = env_str(ENV_CAP, "") or ""
     if raw.strip().lower() in ("off", "false", "no"):
         return 0
     try:

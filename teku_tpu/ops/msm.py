@@ -60,7 +60,6 @@ groups per shard, so its dispatches resolve by shape like any other.
 """
 
 import logging
-import os
 import threading
 
 import numpy as np
@@ -70,7 +69,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..crypto.bls.constants import R, X_ABS
-from ..infra.env import env_float, env_int
+from ..infra.env import env_float, env_int, env_str
 from . import points as PT
 
 _LOG = logging.getLogger(__name__)
@@ -110,7 +109,7 @@ def get_path() -> str:
     """The CONFIGURED path (may be 'auto'); see resolve()."""
     configured = _state["path"]
     if configured is None:
-        configured = os.environ.get(ENV_VAR, "auto") or "auto"
+        configured = env_str(ENV_VAR, "auto")
     if configured not in PATHS:
         with _lock:
             if not _warned_invalid[0]:
@@ -220,7 +219,7 @@ def window_env() -> int:
     invalid value degrades to the default with one warning — the same
     contract as an invalid TEKU_TPU_MSM: a typo'd tuning knob must
     never start failing live verifications at dispatch time."""
-    raw = os.environ.get(ENV_WINDOW, "4")
+    raw = env_str(ENV_WINDOW, "4")
     try:
         w = int(raw)
         if not 1 <= w <= 8:
@@ -299,7 +298,7 @@ def _seg_len() -> int:
     TEKU_TPU_MONT_MUL: decide before the first dispatch), and an
     invalid value degrades to the default with one warning."""
     if not _seg_cache:
-        raw = os.environ.get(ENV_SEG, "32")
+        raw = env_str(ENV_SEG, "32")
         try:
             seg = int(raw)
             if seg < 1 or seg & (seg - 1):

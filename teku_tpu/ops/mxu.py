@@ -54,10 +54,11 @@ tests/test_ops_limbs.py asserts bit-identical `canonical()` images.
 """
 
 import logging
-import os
 import threading
 
 import numpy as np
+
+from ..infra.env import env_str
 
 _LOG = logging.getLogger(__name__)
 
@@ -94,7 +95,7 @@ def get_path() -> str:
     effective one."""
     configured = _state["path"]
     if configured is None:
-        configured = os.environ.get(ENV_VAR, "auto") or "auto"
+        configured = env_str(ENV_VAR, "auto")
     if configured not in PATHS:
         # warn ONCE: get_path() runs per mont_mul call during tracing,
         # so an unthrottled warn would emit thousands of lines

@@ -57,6 +57,7 @@ from ..crypto.bls import hash_to_curve as OH
 from ..infra import (capacity, compilecache, dispatchledger, faults,
                      tracing)
 from ..infra.collections import LimitedMap
+from ..infra.env import env_int
 from ..infra.metrics import GLOBAL_REGISTRY
 from ..crypto.bls.constants import P, R
 from ..crypto.bls.pure_impl import PureBls12381
@@ -379,13 +380,12 @@ class JaxBls12381(BLS12381):
         self._h2c_cache = HC.H2cPointCache()
         # h2c dispatches pad the unique bucket to a pow-2 with this
         # floor so the h2c program keeps very few distinct shapes
-        self._h2c_min_bucket = int(
-            os.environ.get("TEKU_TPU_H2C_MIN_BUCKET", "8"))
+        self._h2c_min_bucket = env_int("TEKU_TPU_H2C_MIN_BUCKET", 8,
+                                       lo=1)
         # stage_group materializes a (U, G) lane matrix: cap G and
         # split oversized committees across rows (a message may own
         # several Miller rows — same verdict, bounded memory)
-        self._group_cap = max(1, int(
-            os.environ.get("TEKU_TPU_H2C_GROUP_CAP", "32")))
+        self._group_cap = env_int("TEKU_TPU_H2C_GROUP_CAP", 32, lo=1)
         # staged dispatch: small programs instead of one monolith whose
         # TPU compile is unbounded (ops/verify.py staged_jits); h2c
         # runs separately over unique messages (see _begin_dispatch)

@@ -69,7 +69,7 @@ from ..infra import (capacity, dispatchledger, faults, flightrecorder,
                      tracing)
 from ..infra.metrics import (GLOBAL_REGISTRY, LATENCY_BUCKETS_S,
                              MetricsRegistry)
-from ..infra.env import env_float
+from ..infra.env import env_bool, env_float
 from .admission import (AdmissionController, BatchPlan, SHEDDABLE,
                         VerifyClass, class_deadline_s)
 
@@ -88,7 +88,7 @@ ENV_OVERLAP = "TEKU_TPU_ASYNC_OVERLAP"
 
 
 def _overlap_default() -> bool:
-    return os.environ.get(ENV_OVERLAP, "1") not in ("0", "off", "false")
+    return env_bool(ENV_OVERLAP, True)
 
 
 class ServiceCapacityExceededError(Exception):

@@ -8,15 +8,9 @@ from functools import lru_cache
 
 from ...ssz import (Bitvector, Bytes4, Bytes32, Bytes48, Bytes96,
                     Container, List, uint8, uint64, Vector)
-from ...ssz.types import _ContainerMeta
 from ..config import SpecConfig
-from ..datastructures import (BeaconBlockHeader, Checkpoint, Eth1Data,
-                              Fork, get_schemas, Validator)
-
-
-def _container(name, fields):
-    return _ContainerMeta(name, (Container,),
-                          {"__annotations__": dict(fields)})
+from ..datastructures import (_container, BeaconBlockHeader, Checkpoint,
+                              Eth1Data, Fork, get_schemas, Validator)
 
 
 class AltairSchemas:

@@ -10,19 +10,16 @@ from functools import lru_cache
 from ...ssz import (Bitvector, ByteList, Bytes4, Bytes20, Bytes32,
                     Bytes48, Bytes96, Container, List, uint8, uint64,
                     uint256, Vector)
-from ...ssz.types import _ContainerMeta
 from ..config import SpecConfig
 from ..altair.datastructures import get_altair_schemas
+# ONE shared Container-from-pairs builder (capella re-imports it from
+# here; the phase0 module owns the definition)
+from ..datastructures import _container
 
 MAX_BYTES_PER_TRANSACTION = 2 ** 30
 MAX_TRANSACTIONS_PER_PAYLOAD = 2 ** 20
 BYTES_PER_LOGS_BLOOM = 256
 MAX_EXTRA_DATA_BYTES = 32
-
-
-def _container(name, fields):
-    return _ContainerMeta(name, (Container,),
-                          {"__annotations__": dict(fields)})
 
 
 _PAYLOAD_COMMON = [

@@ -20,10 +20,10 @@ import dataclasses
 import functools
 import inspect
 import json
-import os
 from pathlib import Path
 from typing import Dict, Iterator, List, Optional, Tuple
 
+from ..infra.env import env_str
 from ..native import snappyc
 from . import config as C
 from .milestones import build_fork_schedule, SpecMilestone
@@ -39,7 +39,7 @@ FORK_NAMES = {
 
 
 def vectors_root() -> Optional[Path]:
-    path = os.environ.get("TEKU_TPU_VECTORS")
+    path = env_str("TEKU_TPU_VECTORS")
     if not path:
         return None
     root = Path(path)

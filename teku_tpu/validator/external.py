@@ -21,6 +21,7 @@ import urllib.request
 from typing import Dict, List, Optional, Sequence
 
 from ..spec import helpers as H
+from ..ssz.json import _hex
 from ..spec.config import (DOMAIN_AGGREGATE_AND_PROOF,
                            DOMAIN_BEACON_ATTESTER,
                            DOMAIN_BEACON_PROPOSER, SpecConfig)
@@ -198,10 +199,6 @@ class ExternalSigner(DutySigner):
             "SYNC_COMMITTEE_CONTRIBUTION_AND_PROOF",
             {"fork_info": _fork_info(state),
              "contribution_and_proof": _container_json(msg)})
-
-
-def _hex(b: bytes) -> str:
-    return "0x" + bytes(b).hex()
 
 
 def _container_json(obj):

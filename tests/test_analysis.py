@@ -1,0 +1,599 @@
+"""tekulint: the AST-based invariant analyzer (teku_tpu/analysis).
+
+Each checker is proven on ≥ 1 fixture true positive AND ≥ 1 clean
+negative (synthetic trees under tmp_path — the analyzer never imports
+what it scans, so fixtures are plain text).  Suppressions round-trip
+(missing/short justification = hard error, unused entry = not clean),
+the --json schema is pinned, and the tier-1 acceptance test at the
+bottom runs the analyzer over THIS LIVE REPO and fails on any
+unsuppressed finding — the enforcement point for "raw TEKU_TPU_*
+os.environ reads outside infra/env.py are zero".
+
+The second half regression-tests the infra/env.py degrade contract
+for every knob this PR hoisted off a raw (boot-killing) read: a
+garbage value degrades to the default with exactly ONE WARN instead
+of raising.
+"""
+
+import json
+import logging
+import textwrap
+
+import pytest
+
+from teku_tpu.analysis import run_lint
+from teku_tpu.analysis.env_knob import collect_knobs, render_knob_table
+from teku_tpu.analysis.findings import SCHEMA_VERSION
+from teku_tpu.analysis.runner import build_project, discover_files
+from teku_tpu.analysis.suppress import SuppressionError
+from teku_tpu.infra import env
+
+
+# --------------------------------------------------------------------------
+# fixture plumbing
+# --------------------------------------------------------------------------
+
+def make_tree(tmp_path, files, suppressions=None, readme=None):
+    """Write a fixture tree; returns its root as str."""
+    for relpath, source in files.items():
+        path = tmp_path / relpath
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+    if suppressions is not None:
+        (tmp_path / "lint_suppressions.json").write_text(
+            json.dumps(suppressions))
+    if readme is not None:
+        (tmp_path / "README.md").write_text(textwrap.dedent(readme))
+    return str(tmp_path)
+
+
+def lint(tmp_path, files, **kw):
+    return run_lint(root=make_tree(tmp_path, files, **kw))
+
+
+def by_checker(report, checker):
+    return [f for f in report.unsuppressed if f.checker == checker]
+
+
+# --------------------------------------------------------------------------
+# env-knob
+# --------------------------------------------------------------------------
+
+RAW_READS = """
+    import os
+
+    ENV_NAME = "TEKU_TPU_CONST_KNOB"
+
+    direct = os.environ.get("TEKU_TPU_DIRECT", "5")
+    via_getenv = os.getenv("TEKU_TPU_GETENV")
+    via_const = os.environ.get(ENV_NAME, "x")
+    subscript = os.environ["TEKU_TPU_SUBSCRIPT"]
+"""
+
+CLEAN_READS = """
+    import os
+    from teku_tpu.infra.env import env_int, env_str
+
+    helper = env_int("TEKU_TPU_HELPER_KNOB", 5)
+    other_ns = os.environ.get("HOME", "/")
+    write = None
+    os.environ["TEKU_TPU_WRITE_SEAM"] = "on"
+"""
+
+
+def test_env_knob_flags_raw_reads(tmp_path):
+    report = lint(tmp_path, {"raw.py": RAW_READS})
+    tokens = {f.token for f in by_checker(report, "env-knob")}
+    assert tokens == {"TEKU_TPU_DIRECT", "TEKU_TPU_GETENV",
+                      "TEKU_TPU_CONST_KNOB", "TEKU_TPU_SUBSCRIPT"}
+
+
+def test_env_knob_clean_on_helper_reads_and_writes(tmp_path):
+    report = lint(tmp_path, {"clean.py": CLEAN_READS})
+    assert by_checker(report, "env-knob") == []
+
+
+# --------------------------------------------------------------------------
+# jit-purity
+# --------------------------------------------------------------------------
+
+IMPURE_KERNEL = """
+    import time
+    import jax
+
+    def helper(x):
+        return x + time.monotonic()
+
+    def kernel(x):
+        return helper(x) * 2
+
+    jitted = jax.jit(kernel)
+"""
+
+PURE_KERNEL = """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def step(carry, x):
+        return carry + x, None
+
+    def kernel(x):
+        total, _ = lax.scan(step, x, jnp.arange(4))
+        return total
+
+    jitted = jax.jit(kernel)
+
+    def host_driver(x):
+        import time
+        t0 = time.monotonic()      # host side: NOT reachable from jit
+        return jitted(x), t0
+"""
+
+
+def test_jit_purity_flags_clock_through_call_graph(tmp_path):
+    report = lint(tmp_path, {"impure.py": IMPURE_KERNEL})
+    findings = by_checker(report, "jit-purity")
+    assert len(findings) == 1
+    assert findings[0].token == "helper:time.monotonic"
+    assert "jax.jit" in findings[0].evidence
+
+
+def test_jit_purity_clean_kernel_and_host_side_effects_ok(tmp_path):
+    report = lint(tmp_path, {"pure.py": PURE_KERNEL})
+    assert by_checker(report, "jit-purity") == []
+
+
+def test_jit_purity_decorated_method_is_an_entry(tmp_path):
+    """@jax.jit on a METHOD (or nested def) must enter the walk — a
+    synthetic module-level name lookup would silently drop it."""
+    src = """
+        import jax
+        import time
+
+        class Kernels:
+            @jax.jit
+            def _kernel(self, x):
+                return x + time.time()
+    """
+    report = lint(tmp_path, {"meth.py": src})
+    tokens = {f.token for f in by_checker(report, "jit-purity")}
+    assert "_kernel:time.time" in tokens
+
+
+def test_jit_purity_scan_body_metric_mutation(tmp_path):
+    src = """
+        from jax import lax
+        from somewhere import METRIC
+
+        def body(c, x):
+            METRIC.labels(kind="step").inc()
+            return c, x
+
+        def run(xs):
+            return lax.scan(body, 0, xs)
+    """
+    report = lint(tmp_path, {"scanbody.py": src})
+    tokens = {f.token for f in by_checker(report, "jit-purity")}
+    assert "body:METRIC.labels.inc" in tokens or \
+        "body:METRIC.labels" in tokens
+
+
+# --------------------------------------------------------------------------
+# torn-read
+# --------------------------------------------------------------------------
+
+TORN = """
+    __swap_attrs__ = ("_serving",)
+
+    class Guarded:
+        def torn(self):
+            provider = self._serving[0]
+            lock = self._serving[1]        # second read: torn
+            return provider, lock
+
+        def atomic(self):
+            provider, lock = self._serving
+            return provider, lock
+"""
+
+
+def test_torn_read_flags_double_read_only(tmp_path):
+    report = lint(tmp_path, {"swap.py": TORN})
+    findings = by_checker(report, "torn-read")
+    assert [f.token for f in findings] == ["Guarded.torn:_serving"]
+
+
+def test_torn_read_needs_registration(tmp_path):
+    unregistered = TORN.replace('__swap_attrs__ = ("_serving",)\n', "")
+    report = lint(tmp_path, {"swap.py": unregistered})
+    assert by_checker(report, "torn-read") == []
+
+
+# --------------------------------------------------------------------------
+# metric-contract
+# --------------------------------------------------------------------------
+
+BAD_METRICS = """
+    from somewhere import REG
+
+    c = REG.counter("requests_count", "not a counter name")
+    g = REG.gauge("work_done_total", "gauge claiming counter")
+    h = REG.labeled_histogram("verify_ms", "latency without _seconds",
+                              ("stage",))
+    ok = REG.counter("requests_total", "fine")
+    ok.labels(shape=f"{1}x{2}").inc()
+"""
+
+GOOD_METRICS = """
+    from somewhere import REG, LATENCY_BUCKETS_S
+
+    c = REG.counter("requests_total", "h")
+    g = REG.gauge("queue_depth", "h")
+    h = REG.labeled_histogram("verify_seconds", "h", ("stage",))
+    h2 = REG.histogram("batch_size", "h")
+    h3 = REG.histogram("wait_seconds", "h", buckets=LATENCY_BUCKETS_S)
+    c.labels(kind=kind).inc()
+"""
+
+
+def test_metric_contract_flags_naming_and_labels(tmp_path):
+    report = lint(tmp_path, {"bad.py": BAD_METRICS})
+    tokens = {f.token for f in by_checker(report, "metric-contract")}
+    assert "requests_count" in tokens       # counter without _total
+    assert "work_done_total" in tokens      # gauge with _total
+    assert "verify_ms" in tokens            # latency without _seconds
+    assert "labels:shape" in tokens         # f-string label value
+
+
+def test_metric_contract_clean(tmp_path):
+    report = lint(tmp_path, {"good.py": GOOD_METRICS})
+    assert by_checker(report, "metric-contract") == []
+
+
+def test_metric_contract_sees_dict_unpacked_labels(tmp_path):
+    """labels(**{"class": ...}) is the tree's reserved-word idiom —
+    the open-vocabulary rule must look through the ** dict."""
+    src = """
+        from somewhere import REG
+        c = REG.counter("sheds_total", "h")
+        c.labels(**{"class": f"{cls}", "reason": reason}).inc()
+    """
+    report = lint(tmp_path, {"unpack.py": src})
+    tokens = {f.token for f in by_checker(report, "metric-contract")}
+    assert tokens == {"labels:class"}       # f-string caught, Name ok
+
+
+# --------------------------------------------------------------------------
+# closed-registry (needs the real module names inside the fixture tree)
+# --------------------------------------------------------------------------
+
+REGISTRY_TREE = {
+    "teku_tpu/infra/faults.py": """
+        SITES = frozenset({"good.site", "dead.site"})
+
+        def check(site, keys=None):
+            pass
+    """,
+    "teku_tpu/infra/flightrecorder.py": """
+        EVENT_KINDS = frozenset({"good_kind", "dead_kind"})
+
+        class FlightRecorder:
+            def record(self, kind, **fields):
+                pass
+
+        RECORDER = FlightRecorder()
+
+        def record(kind, **fields):
+            return RECORDER.record(kind)
+    """,
+    "teku_tpu/user.py": """
+        from .infra import faults, flightrecorder
+
+        def work(recorder):
+            faults.check("good.site")
+            faults.check("rogue.site")
+            flightrecorder.record("good_kind")
+            recorder.record("rogue_kind")
+    """,
+}
+
+
+def test_closed_registry_both_directions(tmp_path):
+    report = lint(tmp_path, dict(REGISTRY_TREE))
+    tokens = {f.token for f in by_checker(report, "closed-registry")}
+    assert "rogue.site" in tokens       # used but undeclared
+    assert "rogue_kind" in tokens
+    assert "dead.site" in tokens        # declared but never used
+    assert "dead_kind" in tokens
+    assert "good.site" not in tokens    # declared + used = clean
+    assert "good_kind" not in tokens
+
+
+def test_closed_registry_missing_declaration(tmp_path):
+    tree = dict(REGISTRY_TREE)
+    tree["teku_tpu/infra/faults.py"] = "def check(site):\n    pass\n"
+    report = lint(tmp_path, tree)
+    assert any(f.token == "SITES"
+               for f in by_checker(report, "closed-registry"))
+
+
+# --------------------------------------------------------------------------
+# dup-helper
+# --------------------------------------------------------------------------
+
+DUP_BODY = """
+    def _shared_helper(value):
+        total = 0
+        for item in value:
+            if item > 0:
+                total += item * item
+        return total
+"""
+
+
+def test_dup_helper_flags_identical_cross_module_copies(tmp_path):
+    report = lint(tmp_path, {"mod_a.py": DUP_BODY,
+                             "mod_b.py": DUP_BODY})
+    findings = by_checker(report, "dup-helper")
+    assert len(findings) == 1           # one finding per EXTRA copy
+    assert findings[0].token == "_shared_helper"
+    assert "mod_a.py" in findings[0].evidence
+
+
+def test_dup_helper_ignores_divergent_and_tiny(tmp_path):
+    divergent = DUP_BODY.replace("item * item", "item")
+    tiny = "def _tiny(x):\n    return x\n"
+    report = lint(tmp_path, {"mod_a.py": DUP_BODY,
+                             "mod_b.py": divergent,
+                             "mod_c.py": tiny, "mod_d.py": tiny})
+    assert by_checker(report, "dup-helper") == []
+
+
+# --------------------------------------------------------------------------
+# knob-doc
+# --------------------------------------------------------------------------
+
+KNOB_CODE = """
+    from teku_tpu.infra.env import env_float, env_int
+
+    a = env_int("TEKU_TPU_DOCUMENTED", 5)
+    b = env_float("TEKU_TPU_UNDOCUMENTED", 1.0)
+
+    def deadline(cls):
+        return env_float(f"TEKU_TPU_CLASS_{cls}_MS", 2.0)
+"""
+
+KNOB_README = """
+    | Knob | Default |
+    | --- | --- |
+    | `TEKU_TPU_DOCUMENTED` | 5 |
+    | `TEKU_TPU_CLASS_<CLS>_MS` | 2.0 |
+    | `TEKU_TPU_STALE_ROW` | gone |
+"""
+
+
+def test_knob_doc_drift_both_directions(tmp_path):
+    report = lint(tmp_path, {"knobs.py": KNOB_CODE},
+                  readme=KNOB_README)
+    tokens = {f.token for f in by_checker(report, "knob-doc")}
+    assert "TEKU_TPU_UNDOCUMENTED" in tokens
+    assert "TEKU_TPU_STALE_ROW" in tokens
+    # exact match and <X>-pattern match are both covered
+    assert "TEKU_TPU_DOCUMENTED" not in tokens
+    assert not any("CLASS" in t for t in tokens)
+
+
+def test_knob_registry_extraction_and_table(tmp_path):
+    root = make_tree(tmp_path, {"knobs.py": KNOB_CODE})
+    project, _ = build_project(root, discover_files(root))
+    knobs = collect_knobs(project)
+    names = {k["name"] for k in knobs}
+    assert names == {"TEKU_TPU_DOCUMENTED", "TEKU_TPU_UNDOCUMENTED",
+                     "TEKU_TPU_CLASS_*_MS"}
+    table = render_knob_table(knobs)
+    assert "| `TEKU_TPU_DOCUMENTED` | env_int | `5` |" in table
+
+
+# --------------------------------------------------------------------------
+# suppressions
+# --------------------------------------------------------------------------
+
+def test_suppression_round_trip(tmp_path):
+    entry = {"checker": "env-knob", "match": "raw.py:TEKU_TPU_DIRECT",
+             "justification": "fixture: a deliberate raw read kept "
+                              "for this round-trip test"}
+    report = lint(tmp_path, {"raw.py": RAW_READS},
+                  suppressions={"suppressions": [entry]})
+    suppressed = [f for f in report.findings if f.suppressed]
+    assert len(suppressed) == 1
+    assert suppressed[0].token == "TEKU_TPU_DIRECT"
+    assert suppressed[0].justification == entry["justification"]
+    # the other raw reads still fail the run
+    assert report.unsuppressed and not report.clean
+
+
+@pytest.mark.parametrize("bad_entry", [
+    {"checker": "env-knob", "match": "X"},                # missing
+    {"checker": "env-knob", "match": "X", "justification": ""},
+    {"checker": "env-knob", "match": "X", "justification": "wontfix"},
+    {"match": "X", "justification": "long enough but no checker id"},
+])
+def test_suppression_without_justification_is_hard_error(tmp_path,
+                                                         bad_entry):
+    with pytest.raises(SuppressionError):
+        lint(tmp_path, {"raw.py": RAW_READS},
+             suppressions={"suppressions": [bad_entry]})
+
+
+def test_suppression_match_is_exact_never_a_prefix(tmp_path):
+    """A justified entry must not silently WIDEN: matching is exact
+    key equality, so an entry for one knob cannot absorb a future
+    finding whose token merely extends it."""
+    entry = {"checker": "env-knob", "match": "raw.py:TEKU_TPU_DIREC",
+             "justification": "prefix of a real token: must NOT match"}
+    report = lint(tmp_path, {"raw.py": RAW_READS},
+                  suppressions={"suppressions": [entry]})
+    assert not any(f.suppressed for f in report.findings)
+    assert report.unused_suppressions == [entry]
+
+
+def test_unused_suppression_is_reported_and_fails_clean(tmp_path):
+    entry = {"checker": "env-knob", "match": "TEKU_TPU_NO_SUCH",
+             "justification": "stale entry kept after the fix landed"}
+    report = lint(tmp_path, {"clean.py": CLEAN_READS},
+                  suppressions={"suppressions": [entry]})
+    assert not report.unsuppressed
+    assert report.unused_suppressions == [entry]
+    assert not report.clean
+
+
+# --------------------------------------------------------------------------
+# --json schema stability
+# --------------------------------------------------------------------------
+
+def test_json_schema_is_stable(tmp_path):
+    report = lint(tmp_path, {"raw.py": RAW_READS})
+    doc = json.loads(json.dumps(report.to_dict()))
+    assert set(doc) == {"version", "root", "files_scanned", "findings",
+                        "counts", "unused_suppressions"}
+    assert doc["version"] == SCHEMA_VERSION == 1
+    assert set(doc["counts"]) == {"total", "unsuppressed",
+                                  "suppressed", "by_checker"}
+    finding = doc["findings"][0]
+    assert set(finding) == {"checker", "path", "line", "message",
+                            "evidence", "fix_hint", "key",
+                            "suppressed"}
+    assert finding["key"].startswith("env-knob:raw.py:")
+    # findings sort deterministically (path, line, checker)
+    ordered = [(f["path"], f["line"]) for f in doc["findings"]]
+    assert ordered == sorted(ordered)
+
+
+# --------------------------------------------------------------------------
+# tier-1 acceptance: the LIVE tree is clean
+# --------------------------------------------------------------------------
+
+def test_live_tree_is_clean():
+    """`cli lint` exits 0 over this repo: zero unsuppressed findings,
+    zero stale suppressions.  This is the build-property enforcement
+    of every mechanized invariant — in particular, raw TEKU_TPU_*
+    os.environ/os.getenv reads outside infra/env.py are ZERO."""
+    report = run_lint()
+    details = "\n".join(
+        f"{f.path}:{f.line} [{f.checker}] {f.message}"
+        for f in report.unsuppressed)
+    assert not report.unsuppressed, f"lint findings:\n{details}"
+    assert not report.unused_suppressions, report.unused_suppressions
+    assert report.files_scanned > 100      # the walk saw the real tree
+
+
+def test_live_tree_cli_lint_json(capsys):
+    """The `cli lint --json` front end over the live tree: exit 0 and
+    a parseable report (the --json schema acceptance on real data)."""
+    from teku_tpu.cli import main
+    rc = main(["lint", "--json"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["counts"]["unsuppressed"] == 0
+
+
+def test_live_knob_registry_covers_readme(capsys):
+    """--knobs emits the registry table; every row's knob appears in
+    the README (the drift check's forward direction, end to end)."""
+    from teku_tpu.cli import main
+    rc = main(["lint", "--knobs"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out.startswith("| Knob | Reader | Default | Where |")
+    assert "TEKU_TPU_MESH_WARM_BATCH" in out
+
+
+# --------------------------------------------------------------------------
+# infra/env.py: the degrade contract for every previously-raw knob
+# --------------------------------------------------------------------------
+
+# (knob, helper, default used at the real read site) for every knob
+# this PR hoisted off a raw os.environ read that RAISED on garbage
+# (int()/float() around the read) — the regression being pinned is
+# "a typo'd unit file degrades the knob with one WARN, never the node"
+HOISTED_NUMERIC_KNOBS = [
+    ("TEKU_TPU_HEALTH_TICK_S", env.env_float, 5.0),
+    ("TEKU_TPU_H2C_MIN_BUCKET", env.env_int, 8),
+    ("TEKU_TPU_H2C_GROUP_CAP", env.env_int, 32),
+    ("TEKU_TPU_BREAKER_THRESHOLD", env.env_int, 3),
+    ("TEKU_TPU_DISPATCH_DEADLINE_S", env.env_float, 30.0),
+    ("TEKU_TPU_BREAKER_COOLDOWN_S", env.env_float, 30.0),
+    ("TEKU_TPU_BLS_PROBE_TIMEOUT_S", env.env_float, 120.0),
+    ("TEKU_TPU_CAPACITY_WINDOW_S", env.env_float, 60.0),
+    ("TEKU_TPU_CAPACITY_MAX_SHAPES", env.env_int, 24),
+    ("TEKU_TPU_SLOW_TRACE_RING", env.env_int, 32),
+    ("TEKU_TPU_FLIGHT_RECORDER_CAPACITY", env.env_int, 512),
+    ("TEKU_TPU_FLIGHT_RECORDER_THROTTLE_S", env.env_float, 30.0),
+    ("TEKU_TPU_REQRESP_TIMEOUT_S", env.env_float, 30.0),
+    ("TEKU_TPU_XLA_CACHE_MIN_COMPILE_S", env.env_float, 2.0),
+]
+
+
+@pytest.mark.parametrize("name,helper,default", HOISTED_NUMERIC_KNOBS,
+                         ids=[k[0] for k in HOISTED_NUMERIC_KNOBS])
+def test_garbage_knob_degrades_with_one_warn(name, helper, default,
+                                             monkeypatch, caplog):
+    monkeypatch.setenv(name, "garbage!!")
+    env._reset_warnings()
+    with caplog.at_level(logging.WARNING, logger="teku_tpu.infra.env"):
+        assert helper(name, default) == default     # no raise
+        assert helper(name, default) == default     # second read
+    warns = [r for r in caplog.records if name in r.getMessage()]
+    assert len(warns) == 1, "exactly one WARN per knob per process"
+
+
+def test_env_clamp_warns_once(monkeypatch, caplog):
+    monkeypatch.setenv("TEKU_TPU_FLUSH_FAILSAFE_MS", "-5")
+    env._reset_warnings()
+    with caplog.at_level(logging.WARNING, logger="teku_tpu.infra.env"):
+        assert env.env_float("TEKU_TPU_FLUSH_FAILSAFE_MS", 0.0,
+                             lo=0.0) == 0.0
+    assert any("clamping" in r.getMessage() for r in caplog.records)
+
+
+def test_env_bool_and_choice_degrade(monkeypatch, caplog):
+    env._reset_warnings()
+    monkeypatch.setenv("TEKU_TPU_MESH_SELF_HEAL", "maybe")
+    monkeypatch.setenv("TEKU_TPU_DEVNET_HARD_EXIT", "")
+    with caplog.at_level(logging.WARNING, logger="teku_tpu.infra.env"):
+        assert env.env_bool("TEKU_TPU_MESH_SELF_HEAL", True) is True
+        assert env.env_choice("TEKU_TPU_X_CHOICE", "auto",
+                              ("on", "off", "auto")) == "auto"
+        monkeypatch.setenv("TEKU_TPU_X_CHOICE", "sideways")
+        assert env.env_choice("TEKU_TPU_X_CHOICE", "auto",
+                              ("on", "off", "auto")) == "auto"
+    # empty string reads as unset for env_str (TEKU_TPU_X= in a unit
+    # file means "default", not "empty-string mode")
+    assert env.env_str("TEKU_TPU_DEVNET_HARD_EXIT", "auto") == "auto"
+    assert env.env_bool("TEKU_TPU_MESH_SELF_HEAL", True) is True
+
+
+def test_env_override_round_trips(monkeypatch):
+    import os
+    monkeypatch.setenv("TEKU_TPU_MESH_WARM_BATCH", "7")
+    with env.env_override("TEKU_TPU_MESH_WARM_BATCH", "64"):
+        assert os.environ["TEKU_TPU_MESH_WARM_BATCH"] == "64"
+    assert os.environ["TEKU_TPU_MESH_WARM_BATCH"] == "7"
+    monkeypatch.delenv("TEKU_TPU_MESH_WARM_BATCH")
+    with env.env_override("TEKU_TPU_MESH_WARM_BATCH", "64"):
+        assert os.environ["TEKU_TPU_MESH_WARM_BATCH"] == "64"
+    assert "TEKU_TPU_MESH_WARM_BATCH" not in os.environ
+
+
+def test_previously_killing_reads_now_boot(monkeypatch):
+    """Functional spot checks: module-level/constructor reads that used
+    to be `float(os.environ.get(...))` (boot-killing on a typo) now
+    construct fine under garbage env."""
+    from teku_tpu.infra.flightrecorder import FlightRecorder
+    from teku_tpu.ops.h2c_cache import configured_capacity
+    monkeypatch.setenv("TEKU_TPU_H2C_CACHE_CAP", "not-a-number")
+    assert configured_capacity() > 0               # default, no raise
+    rec = FlightRecorder(capacity=8)               # import survived
+    rec.record("warmup_cache", note="env test")
+    assert rec.snapshot()[-1]["kind"] == "warmup_cache"

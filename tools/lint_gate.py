@@ -1,0 +1,41 @@
+"""CI gate: run tekulint over the repo and fail on any unsuppressed
+finding.
+
+The standard verify flow runs this (alongside the tier-1 pytest
+acceptance test `tests/test_analysis.py::test_live_tree_is_clean`,
+which embeds the same call):
+
+    python tools/lint_gate.py [--json]
+
+Exit codes: 0 clean, 1 unsuppressed findings or stale suppression
+entries, 2 invalid suppression file.  `--json` prints the
+machine-readable report for archival next to BENCH_*.json.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    from teku_tpu.analysis import run_lint
+    from teku_tpu.analysis.suppress import SuppressionError
+
+    argv = sys.argv[1:] if argv is None else argv
+    try:
+        report = run_lint()
+    except SuppressionError as exc:
+        print(f"lint_gate: {exc}", file=sys.stderr)
+        return 2
+    if "--json" in argv:
+        print(json.dumps(report.to_dict(), indent=1))
+    else:
+        print(report.render_text())
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
