@@ -536,7 +536,11 @@ def _latency_phase(jax, deadline):
                 lat.append(time.perf_counter() - t_submit)
             await svc.stop()
 
+        from teku_tpu.infra import timeline
+        ring0 = timeline.RING.mark()
+        t_tl0 = time.perf_counter()
         asyncio.run(run())
+        t_tl1 = time.perf_counter()
         lat_ms = np.asarray(sorted(lat)) * 1e3
         OUT["p50_ms"] = round(float(np.percentile(lat_ms, 50)), 2)
         OUT["p99_ms"] = round(float(np.percentile(lat_ms, 99)), 2)
@@ -562,6 +566,31 @@ def _latency_phase(jax, deadline):
                  "device_enqueue", "device_sync")
                 if s in stages)
             OUT["latency_p50_attributed_ms"] = round(attributed, 3)
+        # causal-timeline attribution over the burst window: what share
+        # of wall the device actually worked while the queue held tasks
+        # (overlap_efficiency) and how much host_prep stayed serial
+        # outside device-busy (host_prep_serial_share) — None when the
+        # ring is off, and tools/bench_diff.py skips its gate then
+        from teku_tpu.infra import dispatchledger
+        tl_events = timeline.RING.snapshot(since_seq=ring0)
+        attr = timeline.attribution(
+            tl_events, t_tl0, t_tl1,
+            stage_sums={s: sum(v) for s, v in stage_samples.items()},
+            compile_s=dispatchledger.LEDGER.summary(
+                since_seq=led0).get("compile_s"))
+        OUT["attribution"] = attr
+        OUT["overlap_efficiency"] = attr.get("overlap_efficiency")
+        OUT["host_prep_serial_share"] = attr.get(
+            "host_prep_serial_share")
+        # the instrumentation measures itself: ring-append cost times
+        # the events this phase actually emitted, as a share of the
+        # burst wall (the ≤2% budget the timeline PR promises)
+        ovh = timeline.measure_overhead()
+        OUT["timeline_overhead"] = {
+            "per_event_us": ovh["per_event_us"],
+            "events": len(tl_events),
+            "share": round(len(tl_events) * ovh["per_event_us"] * 1e-6
+                           / max(t_tl1 - t_tl0, 1e-9), 6)}
         # capacity evidence: the same derived signals the node's
         # /teku/v1/admin/capacity serves, measured over this phase's
         # live dispatches (per-shape latency model + occupancy)
@@ -1430,6 +1459,8 @@ def trajectory_entry(out: dict, run_id: str) -> dict:
                                     if isinstance(warm, dict) else None)
     cap = out.get("capacity") or {}
     entry["occupancy_ratio"] = cap.get("occupancy_ratio")
+    entry["overlap_efficiency"] = out.get("overlap_efficiency")
+    entry["host_prep_serial_share"] = out.get("host_prep_serial_share")
     at_max = (out.get("overload") or {}).get("at_max") or {}
     entry["overload_p50_ms"] = at_max.get("p50_ms")
     entry["overload_block_import_sheds"] = (

@@ -59,7 +59,9 @@ _IMPURE_MODULES = ("teku_tpu.infra.flightrecorder",
                    "teku_tpu.infra.faults",
                    "teku_tpu.infra.tracing",
                    "teku_tpu.infra.metrics",
-                   "teku_tpu.infra.env")
+                   "teku_tpu.infra.env",
+                   "teku_tpu.infra.timeline",
+                   "teku_tpu.infra.clock")
 
 
 def _impure_reason(idx: ModuleIndex, call: ast.Call) -> Optional[str]:

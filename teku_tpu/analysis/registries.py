@@ -16,6 +16,12 @@ vocabularies:
 - ``infra/flightrecorder.py`` declares ``EVENT_KINDS``; every
   ``record("kind", ...)`` on a recorder must be a member, and members
   must be emitted somewhere in the tree.
+- ``infra/timeline.py`` declares ``TRACKS`` and ``PHASES``; every
+  ``timeline.interval(track, phase, ...)`` /
+  ``timeline.instant(track, phase, ...)`` emit must name declared
+  members, and every member must have an emit site — the Perfetto
+  export and the doctor's stall analyzers key on these exact strings,
+  so a typo'd phase silently lands on the wrong track.
 
 Dynamic (non-literal) sites/kinds outside the registry modules are
 findings too — an unverifiable vocabulary is an open one.  The
@@ -33,6 +39,9 @@ FAULTS_MODULE = "teku_tpu.infra.faults"
 FLIGHT_MODULE = "teku_tpu.infra.flightrecorder"
 SITES_NAME = "SITES"
 KINDS_NAME = "EVENT_KINDS"
+TIMELINE_MODULE = "teku_tpu.infra.timeline"
+TRACKS_NAME = "TRACKS"
+PHASES_NAME = "PHASES"
 
 
 def _declared_set(idx: Optional[ModuleIndex], name: str
@@ -96,10 +105,43 @@ def _event_kind_arg(idx: ModuleIndex, call: ast.Call
     return None
 
 
+def _timeline_emit_call(idx: ModuleIndex, call: ast.Call) -> bool:
+    """True when the call is a ``timeline.interval``/``.instant``
+    emit (dotted through any alias containing "timeline", or a
+    bare name imported from infra/timeline)."""
+    chain = dotted(call.func)
+    if chain is not None:
+        parts = chain.split(".")
+        if parts[-1] in ("interval", "instant") and any(
+                "timeline" in p for p in parts[:-1]):
+            return True
+    if isinstance(call.func, ast.Name):
+        target = idx.imports.get(call.func.id, "")
+        if target in (f"{TIMELINE_MODULE}.interval",
+                      f"{TIMELINE_MODULE}.instant"):
+            return True
+    return False
+
+
+def _timeline_track_arg(idx: ModuleIndex, call: ast.Call
+                        ) -> Optional[ast.AST]:
+    if _timeline_emit_call(idx, call):
+        return call.args[0] if call.args else None
+    return None
+
+
+def _timeline_phase_arg(idx: ModuleIndex, call: ast.Call
+                        ) -> Optional[ast.AST]:
+    if _timeline_emit_call(idx, call):
+        return call.args[1] if len(call.args) > 1 else None
+    return None
+
+
 def check(project: Project) -> List[Finding]:
     findings: List[Finding] = []
     faults_idx = project.modules.get(FAULTS_MODULE)
     flight_idx = project.modules.get(FLIGHT_MODULE)
+    timeline_idx = project.modules.get(TIMELINE_MODULE)
     specs = [
         ("fault site", faults_idx, FAULTS_MODULE, SITES_NAME,
          _declared_set(faults_idx, SITES_NAME), _fault_site_arg,
@@ -109,6 +151,14 @@ def check(project: Project) -> List[Finding]:
          lambda idx, call: _event_kind_arg(idx, call) and
          _event_kind_arg(idx, call)[0],
          "declare the kind in infra/flightrecorder.py EVENT_KINDS"),
+        ("timeline track", timeline_idx, TIMELINE_MODULE, TRACKS_NAME,
+         _declared_set(timeline_idx, TRACKS_NAME),
+         _timeline_track_arg,
+         "declare the track in infra/timeline.py TRACKS"),
+        ("timeline phase", timeline_idx, TIMELINE_MODULE, PHASES_NAME,
+         _declared_set(timeline_idx, PHASES_NAME),
+         _timeline_phase_arg,
+         "declare the phase in infra/timeline.py PHASES"),
     ]
     for (label, reg_idx, reg_mod, reg_name, declared, extract,
          hint) in specs:

@@ -152,6 +152,7 @@ class BeaconRestApi(RestApi):
         g("/teku/v1/admin/dispatches", self._admin_dispatches)
         g("/teku/v1/admin/admission", self._admin_admission)
         g("/teku/v1/admin/profile", self._admin_profile)
+        g("/teku/v1/admin/timeline", self._admin_timeline)
         g("/metrics", self._metrics)
 
     # -- resolution helpers -------------------------------------------
@@ -338,6 +339,41 @@ class BeaconRestApi(RestApi):
             "summary": dispatchledger.summarize(records),
             "capacity": ledger.capacity,
             "recorded_total": ledger.recorded_total}}
+
+    async def _admin_timeline(self, query=None):
+        """The unified causal timeline (infra/timeline.py): every
+        observability ring joined on the shared clock spine.  With
+        ``?trace_id=X`` returns the full joined view for that trace —
+        gap-free span tree, the ledger record that served it, its
+        flight-recorder entries and timeline ring events — as a
+        schema-versioned envelope.  Without a trace id returns the
+        anchor, the slow-trace ring and the timeline ring (the raw
+        material ``cli timeline`` turns into a Perfetto trace)."""
+        from ..infra import dispatchledger, schema, timeline
+        trace_id = (query or {}).get("trace_id") or None
+        recorder = getattr(self.node, "flight_recorder", None)
+        flight = recorder.snapshot() if recorder is not None else []
+        if trace_id:
+            return timeline.join(
+                trace_id,
+                tracing.slow_traces(),
+                dispatchledger.LEDGER.snapshot(trace_id=trace_id),
+                [e for e in flight
+                 if e.get("trace_id") == trace_id],
+                timeline.RING.snapshot(trace_id=trace_id))
+        last = None
+        if query and query.get("last"):
+            try:
+                last = max(1, int(query["last"]))
+            except ValueError:
+                raise HttpError(400, "last must be an integer")
+        from ..infra import clock
+        return schema.envelope("timeline", {
+            "anchor": clock.anchor_dict(),
+            "enabled": timeline.enabled(),
+            "traces": tracing.slow_traces(),
+            "ring": timeline.RING.snapshot(last=last),
+        })
 
     async def _admin_admission(self):
         """The overload controller's state (services/admission.py):

@@ -914,7 +914,8 @@ def _doctor_fetch_remote(base_url: str, last: int) -> dict:
             return json.loads(resp.read())
 
     out = {"records": [], "capacity": None, "slo": None,
-           "flight": [], "admission": None, "mesh": None}
+           "flight": [], "admission": None, "mesh": None,
+           "timeline": None}
     try:
         dispatches = fetch(f"/teku/v1/admin/dispatches?last={last}")
     except Exception as exc:  # noqa: BLE001 - operator-facing CLI
@@ -941,6 +942,12 @@ def _doctor_fetch_remote(base_url: str, last: int) -> dict:
         out["mesh"] = (readiness.get("backend") or {}).get("mesh")
     except Exception:
         pass
+    try:
+        tl = fetch("/teku/v1/admin/timeline")
+        out["timeline"] = {"traces": tl.get("traces") or [],
+                           "events": tl.get("ring") or []}
+    except Exception:
+        pass
     return out
 
 
@@ -952,7 +959,7 @@ def _doctor_probe_devnet(args) -> dict:
     from .node import Devnet
     from .crypto.bls import loader
     from .infra import capacity as cap
-    from .infra import dispatchledger, flightrecorder
+    from .infra import dispatchledger, flightrecorder, timeline, tracing
 
     mont_path, msm_path, mesh = _configure_kernel(args, {})
     try:
@@ -984,7 +991,9 @@ def _doctor_probe_devnet(args) -> dict:
                 last=max(1, args.last)),
             "capacity": cap.snapshot(), "slo": slo,
             "flight": flightrecorder.RECORDER.snapshot(),
-            "admission": admission, "mesh": mesh}
+            "admission": admission, "mesh": mesh,
+            "timeline": {"traces": tracing.slow_traces(),
+                         "events": timeline.RING.snapshot()}}
 
 
 def cmd_doctor(args) -> int:
@@ -1008,7 +1017,8 @@ def cmd_doctor(args) -> int:
     diagnosis = doctor.diagnose(
         inputs["records"], capacity=inputs.get("capacity"),
         slo=inputs.get("slo"), flight_events=inputs.get("flight"),
-        admission=inputs.get("admission"), mesh=inputs.get("mesh"))
+        admission=inputs.get("admission"), mesh=inputs.get("mesh"),
+        timeline=inputs.get("timeline"))
     if args.json:
         print(json.dumps(diagnosis, indent=1, default=str))
     else:
@@ -1020,6 +1030,68 @@ def cmd_doctor(args) -> int:
         # the local probe RAN a devnet: an empty ledger means the
         # device provider never dispatched — that is itself a defect
         print("doctor: probe produced no dispatch records",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_timeline(args) -> int:
+    """Unified causal timeline export (infra/timeline.py).  Joins the
+    slow-trace ring, the dispatch decision ledger, the flight recorder
+    and the timeline ring on the shared clock spine, then either
+    resolves one trace id to its gap-free span tree (--trace-id) or
+    writes the whole window as a Perfetto/Chrome trace-event file
+    (--out trace.json — load in chrome://tracing or ui.perfetto.dev).
+    Reads a live node via --url, or (default) runs a short live
+    in-process devnet on the real device provider."""
+    from .infra import schema, timeline
+
+    _configure_log_format(args, {})
+    _configure_tracing(args, {})
+    _configure_overload(args, {})
+    if args.url:
+        inputs = _doctor_fetch_remote(args.url, args.last)
+        tl = inputs.get("timeline") or {}
+        traces, ring = tl.get("traces") or [], tl.get("events") or []
+    else:
+        inputs = _doctor_probe_devnet(args)
+        tl = inputs["timeline"]
+        traces, ring = tl["traces"], tl["events"]
+    records = inputs.get("records") or []
+    flight = inputs.get("flight") or []
+
+    if args.trace_id:
+        joined = timeline.join(
+            args.trace_id, traces,
+            [r for r in records
+             if args.trace_id in (r.get("trace_ids") or [])],
+            [e for e in flight
+             if e.get("trace_id") == args.trace_id],
+            [e for e in ring
+             if e.get("trace_id") == args.trace_id])
+        text = json.dumps(joined, indent=1, default=str)
+        if args.out:
+            Path(args.out).write_text(text)
+        print(text)
+        return 0 if joined["tree"] is not None else 1
+
+    events = timeline.perfetto(traces, records, flight, ring)
+    doc = schema.envelope("perfetto", {"traceEvents": events})
+    if args.out:
+        Path(args.out).write_text(json.dumps(doc, default=str))
+    if args.json and not args.out:
+        print(json.dumps(doc, default=str))
+    else:
+        tracks = sorted(e["args"]["name"] for e in events
+                        if e["ph"] == "M" and e["name"] == "thread_name")
+        print(f"timeline: {len(events)} trace events, "
+              f"{len(traces)} trace(s), {len(records)} dispatch "
+              f"record(s), tracks: {', '.join(tracks)}"
+              + (f" -> {args.out}" if args.out else ""))
+    # an export with nothing but track metadata means the probe saw no
+    # dispatches at all — surface that the same way doctor does
+    if not traces and not records and not args.url:
+        print("timeline: probe produced no traces or dispatch records",
               file=sys.stderr)
         return 1
     return 0
@@ -1295,6 +1367,46 @@ def build_parser() -> argparse.ArgumentParser:
     dr.add_argument("--tracing", default=None)
     dr.add_argument("--overload-control", default=None)
     dr.set_defaults(fn=cmd_doctor)
+
+    tl = sub.add_parser(
+        "timeline",
+        help="unified causal timeline: join traces + dispatch ledger "
+             "+ flight recorder + timeline ring on one clock spine; "
+             "export a Perfetto trace or resolve one trace id to its "
+             "gap-free span tree")
+    tl.add_argument("--url", default=None,
+                    help="base URL of a live node's REST API (default "
+                         "runs a short live in-process devnet on the "
+                         "real device provider)")
+    tl.add_argument("--trace-id", default=None,
+                    help="resolve this trace id to its joined span "
+                         "tree instead of exporting the whole window")
+    tl.add_argument("--out", default=None,
+                    help="write the Perfetto/Chrome trace-event JSON "
+                         "(or joined tree) to this path")
+    tl.add_argument("--json", action="store_true",
+                    help="print the trace-event JSON to stdout")
+    tl.add_argument("--last", type=int, default=128,
+                    help="how many ledger records to read")
+    tl.add_argument("--slots", type=int, default=4,
+                    help="probe devnet: slots to run")
+    tl.add_argument("--nodes", type=int, default=1,
+                    help="probe devnet: node count")
+    tl.add_argument("--validators", type=int, default=8,
+                    help="probe devnet: validator count")
+    tl.add_argument("--bls-impl", default=None,
+                    help="probe devnet BLS implementation")
+    tl.add_argument("--mont-path", default=None,
+                    choices=list(_MONT_PATHS))
+    tl.add_argument("--msm-path", default=None,
+                    choices=list(_MSM_PATHS))
+    tl.add_argument("--mesh", default=None,
+                    help="probe devnet mesh spec (off, auto, or N)")
+    tl.add_argument("--log-format", default=None,
+                    choices=["text", "json"])
+    tl.add_argument("--tracing", default=None)
+    tl.add_argument("--overload-control", default=None)
+    tl.set_defaults(fn=cmd_timeline)
 
     ln = sub.add_parser(
         "lint",

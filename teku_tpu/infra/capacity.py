@@ -45,7 +45,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
-from . import flightrecorder, tracing
+from . import clock, flightrecorder, tracing
 from .env import env_float, env_int
 from .metrics import GLOBAL_REGISTRY, MetricsRegistry
 
@@ -268,6 +268,15 @@ class ShapeLatencyModel:
                 del self._entries[k]
             self._shapes = {k[0] for k in self._entries}
             return len(victims)
+
+    def clear_topology_filter(self) -> None:
+        """Forget the live-topology filter: observe() accepts every
+        shape family again.  Ops/test seam — the chaos tests drive the
+        real self-heal path, which installs the filter on the GLOBAL
+        model; without this restore, every later non-mesh test's
+        samples would be silently dropped as a retired topology."""
+        with self._lock:
+            self._topology = None
 
     def retire_mesh_shapes(self, live_devices: int) -> int:
         """Mesh reshape hook: retire latency series recorded under any
@@ -518,6 +527,10 @@ class CapacityTelemetry:
         util = self.utilization()
         return {
             "window_s": self.window_s,
+            # clock-spine anchor: the occupancy intervals underlying
+            # these rates live on the mono axis — remote timeline
+            # consumers convert through this pair (infra/clock.py)
+            "anchor": clock.anchor_dict(),
             "arrival_rate_per_second": arrivals,
             "queue_depth": {"current": self.queue_depth.current,
                             "series": self.queue_depth.snapshot()},

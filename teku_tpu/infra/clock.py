@@ -1,0 +1,79 @@
+"""The ONE clock spine for every observability surface.
+
+The tree grew four timestamp dialects — tracing spans on
+``perf_counter``, flight-recorder events on ``time.time()``,
+dispatch-ledger records on a rounded ``t_wall``, capacity/occupancy
+intervals on raw ``perf_counter`` stamps — and records from different
+surfaces could not be ordered against each other: wall clocks step
+(NTP), monotonic clocks have an arbitrary epoch, and each module
+rounded differently.  This module is the single definition:
+
+- ``now()`` returns the shared ``(t_wall, t_mono)`` pair — one wall
+  read and one monotonic read taken back-to-back.  Every record that
+  wants to be joinable on the causal timeline carries BOTH: ``t_wall``
+  for humans and cross-process merge, ``t_mono`` for intra-process
+  ordering and gap-free interval arithmetic;
+- ``stamp(rec)`` writes the pair into a dict with the tree's
+  established rounding (wall ms, mono µs) — the helper tracing,
+  flightrecorder, dispatchledger, capacity, selfheal and compilecache
+  all stamp through, so the rounding contract has one home;
+- ``wall_of(t_mono)`` / ``mono_of(t_wall)`` convert through the
+  process anchor (the pair captured at import): exporters place
+  monotonic-only stamps (trace stage offsets, occupancy intervals)
+  on the wall axis with at-import skew, which is exact for ordering
+  within one process — the only join this module promises.
+
+``t_mono`` is ``time.perf_counter()`` — the SAME base tracing and the
+capacity occupancy tracker already use, so adopting the spine did not
+re-base any existing stamp.  ``time.monotonic()`` callers (the mesh
+healer's recovery stopwatch) must convert by duration, never by
+subtracting across bases.
+"""
+
+import time
+from typing import Dict, Tuple
+
+# Process anchor: the (t_wall, t_mono) correspondence every
+# mono<->wall conversion routes through.  Captured once at import —
+# a stable mapping matters more than tracking NTP steps, because the
+# timeline orders records by t_mono and only LABELS them with wall
+# time.
+ANCHOR_WALL = time.time()
+ANCHOR_MONO = time.perf_counter()
+
+
+def now() -> Tuple[float, float]:
+    """The shared ``(t_wall, t_mono)`` stamp pair, read back-to-back."""
+    return time.time(), time.perf_counter()
+
+
+def mono() -> float:
+    """The spine's monotonic clock (``perf_counter`` base)."""
+    return time.perf_counter()
+
+
+def stamp(rec: Dict) -> Dict:
+    """Stamp ``rec`` in place with the shared pair — ``t_wall``
+    rounded to ms (the ledger/flight-recorder precedent, human-facing)
+    and ``t_mono`` rounded to µs (interval arithmetic)."""
+    t_wall, t_mono = now()
+    rec["t_wall"] = round(t_wall, 3)
+    rec["t_mono"] = round(t_mono, 6)
+    return rec
+
+
+def wall_of(t_mono: float) -> float:
+    """Place a monotonic stamp on the wall axis via the anchor."""
+    return ANCHOR_WALL + (t_mono - ANCHOR_MONO)
+
+
+def mono_of(t_wall: float) -> float:
+    """Place a wall stamp on the monotonic axis via the anchor."""
+    return ANCHOR_MONO + (t_wall - ANCHOR_WALL)
+
+
+def anchor_dict() -> Dict[str, float]:
+    """The process anchor as a JSON-able block — snapshots carry it so
+    remote consumers can convert the payload's ``t_mono`` stamps."""
+    return {"t_wall": round(ANCHOR_WALL, 6),
+            "t_mono": round(ANCHOR_MONO, 6)}

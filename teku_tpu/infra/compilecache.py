@@ -28,6 +28,7 @@ import os
 import sys
 import threading
 
+from . import clock
 from .env import env_float, env_str
 from .metrics import GLOBAL_REGISTRY
 
@@ -43,6 +44,9 @@ _MISS_EVENT = "/jax/compilation_cache/cache_misses"
 _lock = threading.Lock()
 _counts = {"hit": 0, "miss": 0}
 _installed = {"listener": False, "dir": None}
+# clock-spine stamp of the most recent cache event: the timeline
+# orders "which dispatch paid that cache load" against trace spans
+_last_event = {"outcome": None, "t_wall": None, "t_mono": None}
 
 _M_CACHE = GLOBAL_REGISTRY.labeled_counter(
     "xla_compile_cache_total",
@@ -64,8 +68,10 @@ def _on_event(event: str, **_kw) -> None:
         key = "miss"
     else:
         return
+    stamp = clock.stamp({"outcome": key})
     with _lock:
         _counts[key] += 1
+        _last_event.update(stamp)
     _M_CACHE.labels(outcome=key).inc()
 
 
@@ -169,7 +175,8 @@ def stats() -> dict:
         ensure_instrumented()
     with _lock:
         return {"dir": _installed["dir"], "hits": _counts["hit"],
-                "misses": _counts["miss"]}
+                "misses": _counts["miss"],
+                "last_event": dict(_last_event)}
 
 
 def delta(before: dict, after=None) -> dict:

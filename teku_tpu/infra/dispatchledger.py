@@ -61,6 +61,7 @@ from contextlib import contextmanager
 from contextvars import ContextVar
 from typing import Dict, List, Optional, Tuple
 
+from . import clock
 from .env import env_int
 from .metrics import GLOBAL_REGISTRY, MetricsRegistry
 
@@ -325,5 +326,10 @@ def open_record(**fields) -> dict:
     """Start a record at dispatch-begin time: wall stamp + the
     service-side annotations active in the calling context."""
     ann = current_annotations()
-    rec = {"t_wall": round(time.time(), 3), "admission": ann, **fields}
+    # the shared (t_wall, t_mono) clock-spine stamp (infra/clock.py):
+    # t_wall keeps its historical form, t_mono joins the record to the
+    # timeline's mono axis
+    rec = clock.stamp({})
+    rec["admission"] = ann
+    rec.update(fields)
     return rec

@@ -22,7 +22,8 @@ dict is the machine form (``cli doctor --json``).
 
 from typing import Dict, List, Optional
 
-from . import dispatchledger
+from . import dispatchledger, schema, timeline as timeline_mod
+from .env import env_float
 
 # findings below this severity are listed but don't flip `healthy`
 ATTENTION_SEVERITY = 40.0
@@ -461,6 +462,91 @@ def _slo_findings(slo: Optional[dict]) -> List[dict]:
     return out
 
 
+def _timeline_findings(timeline: Optional[dict],
+                       records: List[dict]) -> List[dict]:
+    """Causal-timeline analyzers: the two evidence gates the roadmap's
+    open items (stage-graph executor, zero-copy ingest) need.
+
+    - ``host_prep_serial``: at production batch sizes (>= 256 lanes)
+      host-side packing dominates the end-to-end trace — the serial
+      term zero-copy ingest must remove.  Cites the worst dispatch.
+    - ``overlap_stall``: the device sat idle while the queue held
+      work — the async-overlap machinery is NOT hiding host time.
+      Cites the gap interval and the dispatch that followed it.
+    """
+    if not timeline:
+        return []
+    out = []
+    traces = timeline.get("traces") or []
+    events = timeline.get("events") or []
+    by_trace = {t.get("trace_id"): t for t in traces}
+    share_thr = env_float("TEKU_TPU_DOCTOR_HOST_PREP_SHARE", 0.35,
+                          lo=0.0, hi=1.0)
+    worst = None     # (share, host_prep_ms, total_ms, rec)
+    for rec in records:
+        if (rec.get("lanes") or 0) < 256:
+            continue
+        for tid in rec.get("trace_ids") or []:
+            tr = by_trace.get(tid)
+            if tr is None or not tr.get("total_ms"):
+                continue
+            hp = sum(s.get("ms", 0.0) for s in tr.get("stages", [])
+                     if s.get("stage") == "host_prep")
+            share = hp / tr["total_ms"]
+            if share >= share_thr and (worst is None
+                                       or share > worst[0]):
+                worst = (share, hp, tr["total_ms"], rec)
+    if worst is not None:
+        share, hp, total, rec = worst
+        out.append(_finding(
+            "host_prep_serial", 35 + 40 * min(share, 1.0),
+            f"host_prep is {share:.0%} of a {rec.get('lanes')}-lane "
+            f"verify ({hp:.1f} of {total:.1f} ms)",
+            "at production batch sizes the host-side limb packing is "
+            "the serial term on the verify path — device overlap "
+            "cannot hide work that happens before the enqueue; "
+            "zero-copy ingest (packing into pinned buffers at gossip "
+            "decode time) removes it",
+            evidence=[_cite(rec)],
+            metrics={"share": round(share, 4),
+                     "host_prep_ms": round(hp, 3),
+                     "total_ms": round(total, 3),
+                     "lanes": rec.get("lanes"),
+                     "threshold": share_thr}))
+    stall_thr = env_float("TEKU_TPU_DOCTOR_OVERLAP_STALL", 0.25,
+                          lo=0.0, hi=1.0)
+    nonempty_s = timeline_mod._total(
+        timeline_mod._phase_intervals(events, "queue_nonempty"))
+    gaps = timeline_mod.stalls(events)
+    gap_s = timeline_mod._total(gaps)
+    if nonempty_s > 0 and gaps and gap_s / nonempty_s >= stall_thr:
+        g0, g1 = max(gaps, key=lambda g: g[1] - g[0])
+        # the dispatch that eventually followed the worst gap — the
+        # one whose host_prep/assembly the device idled behind
+        after = [r for r in records
+                 if isinstance(r.get("t_mono"), (int, float))
+                 and r["t_mono"] >= g0]
+        evidence = ([_cite(min(after, key=lambda r: r["t_mono"]))]
+                    if after else [])
+        out.append(_finding(
+            "overlap_stall", 30 + 50 * min(gap_s / nonempty_s, 1.0),
+            f"device idle {gap_s:.3f} s of {nonempty_s:.3f} s with a "
+            "nonempty queue "
+            f"({gap_s / nonempty_s:.0%}, worst gap {g1 - g0:.3f} s)",
+            "queued work waited while no dispatch occupied the "
+            "device: batch assembly, host_prep or the enqueue path "
+            "is serializing ahead of the device instead of "
+            "overlapping with it",
+            evidence=evidence,
+            metrics={"stall_share": round(gap_s / nonempty_s, 4),
+                     "stall_s": round(gap_s, 4),
+                     "queue_nonempty_s": round(nonempty_s, 4),
+                     "worst_gap": {"t_mono": round(g0, 6),
+                                   "dur_s": round(g1 - g0, 4)},
+                     "threshold": stall_thr}))
+    return out
+
+
 # --------------------------------------------------------------------------
 # The engine
 # --------------------------------------------------------------------------
@@ -470,14 +556,19 @@ def diagnose(records: List[dict],
              slo: Optional[dict] = None,
              flight_events: Optional[List[dict]] = None,
              admission: Optional[dict] = None,
-             mesh: Optional[dict] = None) -> dict:
+             mesh: Optional[dict] = None,
+             timeline: Optional[dict] = None) -> dict:
     """Rank everything the ledger + sensors can explain about the
     current latency budget.  All inputs are plain JSON-able snapshots
     (local globals or fetched from a remote node's admin endpoints);
     ``mesh`` is the supervisor's mesh self-description (the readiness
     body's ``backend.mesh``, carrying the healer's ``self_heal``
     block) so a degraded mesh stays diagnosable after its events roll
-    off the bounded flight ring."""
+    off the bounded flight ring; ``timeline`` is the causal-timeline
+    snapshot (``{"traces": [...], "events": [...]}`` — slow traces
+    plus the timeline ring) powering the host_prep_serial and
+    overlap_stall analyzers.  The result is a schema-versioned
+    envelope (shared with the timeline export)."""
     records = list(records or [])
     summary = dispatchledger.summarize(records)
     findings: List[dict] = []
@@ -492,12 +583,13 @@ def diagnose(records: List[dict],
     findings += _capacity_findings(capacity)
     findings += _admission_findings(admission)
     findings += _slo_findings(slo)
+    findings += _timeline_findings(timeline, records)
     findings.sort(key=lambda f: -f["severity"])
     for rank, f in enumerate(findings, 1):
         f["rank"] = rank
     attention = [f for f in findings
                  if f["severity"] >= ATTENTION_SEVERITY]
-    return {
+    return schema.envelope("doctor", {
         "healthy": not attention,
         "findings": findings,
         "attention": len(attention),
@@ -508,8 +600,9 @@ def diagnose(records: List[dict],
             "capacity": bool(capacity),
             "slo": bool(slo),
             "admission": bool(admission),
+            "timeline": bool(timeline),
         },
-    }
+    })
 
 
 def render_text(diagnosis: dict) -> str:

@@ -39,7 +39,7 @@ import time
 from collections import deque
 from typing import List, Optional
 
-from . import tracing
+from . import clock, tracing
 from .env import env_float, env_int, env_str
 from .metrics import GLOBAL_REGISTRY, MetricsRegistry
 
@@ -114,8 +114,13 @@ class FlightRecorder:
         SLO engine blame the verification that originated a breach."""
         if trace_id is None:
             trace_id = tracing.current_trace_id()
-        event = {"seq": 0, "t_wall": round(time.time(), 3),
-                 "kind": kind, "trace_id": trace_id or "", **fields}
+        # the shared (t_wall, t_mono) clock-spine stamp (infra/clock):
+        # t_wall keeps its historical rounding for endpoint schema
+        # compatibility, t_mono makes events orderable against trace
+        # spans and ledger records on the timeline
+        event = clock.stamp({"seq": 0})
+        event.update({"kind": kind, "trace_id": trace_id or "",
+                      **fields})
         with self._lock:
             self._seq += 1
             event["seq"] = self._seq

@@ -1,0 +1,29 @@
+"""Shared schema versioning for machine-readable export envelopes.
+
+``cli doctor --json`` and the timeline exports each need a version
+field so downstream tooling (bench_diff-style gates, dashboards) can
+detect incompatible payloads.  Hand-rolling ``{"version": 1, ...}``
+per exporter is how the analysis report and the doctor diverged once
+already; this module is the one edit point for a bump.
+
+An envelope is additive: ``envelope(name, body)`` prefixes the body
+with ``schema`` + ``version`` keys and never removes anything, so
+existing consumers keyed on body fields keep working.
+"""
+
+from typing import Dict
+
+# One row per versioned export surface.  Bumping a version here is THE
+# schema-change commit — tests pin these values.
+VERSIONS: Dict[str, int] = {
+    "doctor": 1,
+    "timeline": 1,
+    "perfetto": 1,
+}
+
+
+def envelope(schema: str, body: Dict) -> Dict:
+    """Wrap ``body`` in the versioned envelope for ``schema``.  An
+    unknown schema name is a programming error, not an operator input —
+    raise so the test suite catches it."""
+    return {"schema": schema, "version": VERSIONS[schema], **body}

@@ -1,0 +1,580 @@
+"""The unified causal timeline: every observability surface on one
+clock spine, joined by trace id.
+
+The tree records a verification's life four ways — tracing spans
+(per-stage durations), flight-recorder events (incident instants),
+dispatch-ledger records (per-dispatch cost attribution), and the
+capacity model's occupancy intervals — and before this module none of
+them could be ORDERED against each other (four timestamp dialects,
+see infra/clock.py).  The timeline stitches them:
+
+- a bounded streaming ring of ``interval``/``instant`` events stamped
+  with the shared ``(t_wall, t_mono)`` pair — the provider publishes
+  device-busy and host_prep intervals, the signature service publishes
+  queue-nonempty intervals and coalesce instants, admission publishes
+  brownout transitions, the mesh healer publishes reshape/eject marks;
+- ``span_tree(trace)`` — the gap-free causal tree for ONE trace id:
+  stage spans (now carrying start offsets) nest by containment and
+  every hole between siblings becomes an explicit ``unattributed``
+  child, so children always tile their parent and unexplained time is
+  first-class rather than invisible;
+- ``join(trace_id, ...)`` — the three-way join (trace ring + dispatch
+  ledger + flight recorder + timeline ring) behind
+  ``GET /teku/v1/admin/timeline?trace_id=``;
+- ``perfetto(...)`` — Chrome trace-event export (``cli timeline
+  --out``): one track per worker/device/admission/flight/mesh, ``X``
+  slices for spans, ``i`` instants for flight events, ``b``/``e``
+  async arrows for coalesced waiters and enqueue→sync overlap;
+- ``attribution(...)`` — the derived bench metrics the roadmap's two
+  open items gate on: ``overlap_efficiency`` (device-busy ÷ wall time
+  while the queue is nonempty), ``host_prep_serial_share``,
+  ``queue_wait_share``, ``compile_wall_share``.
+
+Track and phase vocabularies are CLOSED (``TRACKS`` / ``PHASES``,
+enforced both directions by tekulint's closed-registry checker, the
+EVENT_KINDS contract).  ``TEKU_TPU_TIMELINE=0`` restores the
+instrumentation-free path (emit calls return before touching the
+ring); a garbage knob degrades to the default with one WARN, never a
+boot failure.  The ring is self-measuring: ``measure_overhead()``
+reports the per-event stamp cost bench uses to bound the timeline's
+share of the latency phase.
+"""
+
+import threading
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from . import clock, schema
+from .env import env_bool, env_int
+from .metrics import GLOBAL_REGISTRY
+
+# The closed track vocabulary: one Perfetto track per member, one
+# `tid` each.  Adding a track means adding it HERE (the closed-registry
+# checker flags undeclared and dynamic track names tree-wide).
+TRACKS = frozenset({"worker", "device", "admission", "flight", "mesh"})
+
+# The closed phase vocabulary: every ring event's name.  Same
+# both-directions contract — an emitter with a typo'd phase and a
+# declared-but-never-emitted phase are both findings.
+PHASES = frozenset({
+    "busy",             # device executing a dispatch (enqueue→sync)
+    "queue_nonempty",   # service queue held work (overlap denominator)
+    "host_prep",        # host-side limb packing inside a dispatch
+    "coalesce",         # duplicate submission joined an in-flight task
+    "brownout_enter",   # admission brownout level raised
+    "brownout_exit",    # admission brownout cleared
+    "brownout_deescalate",  # admission brownout level lowered
+    "reshape",          # mesh healer installed a new topology
+    "eject",            # mesh healer ejected a device
+    "unattributed",     # synthesized span-tree filler (never emitted)
+})
+
+_enabled = env_bool("TEKU_TPU_TIMELINE", True)
+
+_M_EVENTS = GLOBAL_REGISTRY.labeled_counter(
+    "timeline_events_total",
+    "events recorded into the causal-timeline ring, by track",
+    labelnames=("track",))
+
+
+def set_enabled(on: bool) -> None:
+    """Test/CLI seam mirroring tracing.set_enabled."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+class TimelineRing:
+    """Bounded ring of timeline events (newest win), thread-safe."""
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = env_int("TEKU_TPU_TIMELINE_RING", 4096, lo=1)
+        self.capacity = capacity
+        self._events: deque = deque(maxlen=capacity)
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def append(self, event: dict) -> dict:
+        with self._lock:
+            self._seq += 1
+            event["seq"] = self._seq
+            self._events.append(event)
+        return event
+
+    def mark(self) -> int:
+        """Current seq — bench phases bracket a window with two marks
+        and snapshot(since_seq=...) the delta."""
+        with self._lock:
+            return self._seq
+
+    def snapshot(self, last: Optional[int] = None,
+                 trace_id: Optional[str] = None,
+                 since_seq: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            events = list(self._events)
+        if since_seq is not None:
+            events = [e for e in events if e["seq"] > since_seq]
+        if trace_id:
+            events = [e for e in events
+                      if e.get("trace_id") == trace_id]
+        if last is not None:
+            events = events[-max(1, last):]
+        return [dict(e) for e in events]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+
+# the process-wide ring every instrumented module records into
+RING = TimelineRing()
+
+
+def _stamp_event(track: str, phase: str, dur_s: float,
+                 t_mono: Optional[float], trace_id: str,
+                 fields: dict) -> dict:
+    t_wall_end, t_mono_end = clock.now()
+    start = t_mono_end - dur_s if t_mono is None else t_mono
+    ev = {"seq": 0, "track": track, "phase": phase,
+          "t_wall": round(t_wall_end - (t_mono_end - start), 6),
+          "t_mono": round(start, 6),
+          "dur_s": round(dur_s, 6),
+          "trace_id": trace_id or ""}
+    if fields:
+        ev.update(fields)
+    return ev
+
+
+def interval(track: str, phase: str, dur_s: float,
+             t_mono: Optional[float] = None, trace_id: str = "",
+             **fields) -> Optional[dict]:
+    """Record a completed interval.  ``t_mono`` is the start stamp on
+    the spine's monotonic base; when omitted the interval is assumed
+    to end NOW (the emit-at-completion idiom, which also lets
+    ``time.monotonic()`` callers pass a duration without mixing clock
+    bases).  Returns None (and does no work) when disabled."""
+    if not _enabled:
+        return None
+    ev = _stamp_event(track, phase, dur_s, t_mono, trace_id, fields)
+    RING.append(ev)
+    _M_EVENTS.labels(track=track).inc()
+    return ev
+
+
+def instant(track: str, phase: str, trace_id: str = "",
+            **fields) -> Optional[dict]:
+    """Record a zero-duration mark (state transitions, coalesce
+    joins).  Disabled mode returns immediately."""
+    if not _enabled:
+        return None
+    ev = _stamp_event(track, phase, 0.0, None, trace_id, fields)
+    RING.append(ev)
+    _M_EVENTS.labels(track=track).inc()
+    return ev
+
+
+def measure_overhead(n: int = 2000) -> dict:
+    """Self-measurement: the per-event cost of the full stamp path
+    (clock pair + dict build + ring append) against a SCRATCH ring, so
+    bench can report the timeline's share of a phase without polluting
+    the live ring."""
+    ring = TimelineRing(capacity=min(n, 4096))
+    t0 = clock.mono()
+    for _ in range(n):
+        ring.append(_stamp_event("worker", "host_prep", 0.0, None,
+                                 "", {}))
+    total = clock.mono() - t0
+    return {"events": n, "total_s": round(total, 6),
+            "per_event_us": round(total / n * 1e6, 3)}
+
+
+# --------------------------------------------------------------------------
+# Interval arithmetic (pure; all on the t_mono axis)
+# --------------------------------------------------------------------------
+
+def _merge(intervals: Iterable[Tuple[float, float]]
+           ) -> List[Tuple[float, float]]:
+    """Sorted disjoint union of (t0, t1) intervals."""
+    out: List[Tuple[float, float]] = []
+    for t0, t1 in sorted(i for i in intervals if i[1] > i[0]):
+        if out and t0 <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], t1))
+        else:
+            out.append((t0, t1))
+    return out
+
+
+def _total(intervals: Sequence[Tuple[float, float]]) -> float:
+    return sum(t1 - t0 for t0, t1 in intervals)
+
+
+def _intersect(a: Sequence[Tuple[float, float]],
+               b: Sequence[Tuple[float, float]]
+               ) -> List[Tuple[float, float]]:
+    out = []
+    for a0, a1 in a:
+        for b0, b1 in b:
+            lo, hi = max(a0, b0), min(a1, b1)
+            if hi > lo:
+                out.append((lo, hi))
+    return _merge(out)
+
+
+def _subtract(a: Sequence[Tuple[float, float]],
+              b: Sequence[Tuple[float, float]]
+              ) -> List[Tuple[float, float]]:
+    """Parts of `a` not covered by `b` (both disjoint-sorted)."""
+    out = []
+    for a0, a1 in a:
+        cur = a0
+        for b0, b1 in b:
+            if b1 <= cur or b0 >= a1:
+                continue
+            if b0 > cur:
+                out.append((cur, b0))
+            cur = max(cur, b1)
+            if cur >= a1:
+                break
+        if cur < a1:
+            out.append((cur, a1))
+    return out
+
+
+def _clip(intervals: Sequence[Tuple[float, float]], t0: float,
+          t1: float) -> List[Tuple[float, float]]:
+    return [(max(a, t0), min(b, t1)) for a, b in intervals
+            if min(b, t1) > max(a, t0)]
+
+
+def _phase_intervals(events: Sequence[dict], phase: str
+                     ) -> List[Tuple[float, float]]:
+    return _merge((e["t_mono"], e["t_mono"] + e.get("dur_s", 0.0))
+                  for e in events if e.get("phase") == phase
+                  and e.get("dur_s", 0.0) > 0)
+
+
+def attribution(events: Sequence[dict], t_mono0: float,
+                t_mono1: float,
+                stage_sums: Optional[Dict[str, float]] = None,
+                compile_s: Optional[float] = None) -> dict:
+    """The derived attribution metrics over a [t_mono0, t_mono1)
+    window of ring events.  Metrics whose inputs are absent come back
+    None (skip-if-missing, the bench_diff gate contract):
+
+    - ``overlap_efficiency``: device-busy time ÷ wall time while the
+      queue was nonempty — 1.0 means the device never starved while
+      work waited (the async-overlap win), low means host-side serial
+      work is the bottleneck;
+    - ``host_prep_serial_share``: host_prep time NOT overlapped by
+      device-busy, as a share of the window (the zero-copy-ingest
+      target);
+    - ``queue_wait_share``: queue_wait ÷ complete from the caller's
+      stage sums (bench's raw trace samples);
+    - ``compile_wall_share``: ledger-attributed compile/cache-load
+      seconds ÷ window.
+    """
+    window_s = max(t_mono1 - t_mono0, 0.0)
+    in_window = [e for e in events
+                 if e["t_mono"] + e.get("dur_s", 0.0) > t_mono0
+                 and e["t_mono"] < t_mono1]
+    busy = _clip(_phase_intervals(in_window, "busy"),
+                 t_mono0, t_mono1)
+    nonempty = _clip(_phase_intervals(in_window, "queue_nonempty"),
+                     t_mono0, t_mono1)
+    host_prep = _clip(_phase_intervals(in_window, "host_prep"),
+                      t_mono0, t_mono1)
+    busy_s = _total(busy)
+    nonempty_s = _total(nonempty)
+    serial_s = _total(_subtract(host_prep, busy))
+    out = {
+        "window_s": round(window_s, 6),
+        "events": len(in_window),
+        "device_busy_s": round(busy_s, 6),
+        "queue_nonempty_s": round(nonempty_s, 6),
+        "host_prep_s": round(_total(host_prep), 6),
+        "host_prep_serial_s": round(serial_s, 6),
+        "overlap_efficiency": (
+            round(min(_total(_intersect(busy, nonempty))
+                      / nonempty_s, 1.0), 4)
+            if nonempty_s > 0 else None),
+        "host_prep_serial_share": (
+            round(min(serial_s / window_s, 1.0), 4)
+            if window_s > 0 and host_prep else None),
+        "queue_wait_share": None,
+        "compile_wall_share": None,
+    }
+    if stage_sums:
+        qw = stage_sums.get("queue_wait", 0.0)
+        total = stage_sums.get("complete", 0.0)
+        if total > 0:
+            out["queue_wait_share"] = round(min(qw / total, 1.0), 4)
+    if compile_s is not None and window_s > 0:
+        out["compile_wall_share"] = round(
+            min(max(compile_s, 0.0) / window_s, 1.0), 4)
+    return out
+
+
+def stalls(events: Sequence[dict]) -> List[Tuple[float, float]]:
+    """Gap intervals where the queue was nonempty but the device was
+    idle — the overlap_stall doctor finding's evidence."""
+    nonempty = _phase_intervals(events, "queue_nonempty")
+    busy = _phase_intervals(events, "busy")
+    return _subtract(nonempty, busy)
+
+
+# --------------------------------------------------------------------------
+# Span trees
+# --------------------------------------------------------------------------
+
+# gaps below the clock spine's resolution are tiling, not holes
+RESOLUTION_S = 1e-4
+
+
+def _node(phase: str, t0: float, t1: float) -> dict:
+    return {"phase": phase, "t_mono": round(t0, 6),
+            "t_wall": round(clock.wall_of(t0), 6),
+            "dur_ms": round((t1 - t0) * 1e3, 3), "children": []}
+
+
+def _fill_gaps(node: dict, t0: float, t1: float) -> None:
+    """Insert explicit `unattributed` children so the node's children
+    tile [t0, t1] exactly — unexplained time becomes visible instead
+    of being a hole in the tree."""
+    children = node["children"]
+    if not children:
+        return
+    tiled: List[dict] = []
+    cursor = t0
+    for child in children:
+        c0 = child["t_mono"]
+        c1 = c0 + child["dur_ms"] / 1e3
+        if c0 - cursor > RESOLUTION_S:
+            tiled.append(_node("unattributed", cursor, c0))
+        else:
+            # snap the child to the cursor: sub-resolution seams must
+            # tile EXACTLY so the gap-free assertion is an equality
+            child["t_mono"] = round(cursor, 6)
+            child["dur_ms"] = round((c1 - cursor) * 1e3, 3)
+        tiled.append(child)
+        cursor = max(cursor, c1)
+    if t1 - cursor > RESOLUTION_S:
+        tiled.append(_node("unattributed", cursor, t1))
+    elif tiled:
+        last = tiled[-1]
+        last["dur_ms"] = round((t1 - last["t_mono"]) * 1e3, 3)
+    node["children"] = tiled
+
+
+def span_tree(trace: dict) -> dict:
+    """The gap-free causal tree for one trace dict (the extended
+    ``Trace.to_dict()`` form carrying ``t_mono`` and per-stage
+    ``stages[].t_mono`` start offsets).  Stage spans nest by interval
+    containment; gaps become ``unattributed`` nodes, so at every level
+    the children tile the parent within ``RESOLUTION_S``."""
+    t0 = float(trace.get("t_mono", 0.0))
+    t1 = t0 + float(trace.get("total_ms", 0.0)) / 1e3
+    root = _node(trace.get("name", "trace"), t0, t1)
+    root["phase"] = trace.get("name", "trace")
+    root["trace_id"] = trace.get("trace_id", "")
+    root["labels"] = dict(trace.get("labels") or {})
+    spans = []
+    for st in trace.get("stages", []):
+        if "t_mono" not in st:
+            continue
+        s0 = max(t0, float(st["t_mono"]))
+        s1 = min(t1, s0 + float(st.get("ms", 0.0)) / 1e3)
+        if s1 > s0:
+            spans.append((s0, -(s1 - s0), st["stage"], s1))
+    # sort by start, longest-first at equal starts → parents precede
+    # the children they contain
+    stack = [root]
+    for s0, _neg, stage, s1 in sorted(spans):
+        while len(stack) > 1:
+            top = stack[-1]
+            top_end = top["t_mono"] + top["dur_ms"] / 1e3
+            if s0 >= top_end - RESOLUTION_S:
+                stack.pop()
+            else:
+                break
+        node = _node(stage, s0, s1)
+        stack[-1]["children"].append(node)
+        stack.append(node)
+
+    def fill(node: dict) -> None:
+        n0 = node["t_mono"]
+        _fill_gaps(node, n0, n0 + node["dur_ms"] / 1e3)
+        for child in node["children"]:
+            if child["phase"] != "unattributed":
+                fill(child)
+
+    fill(root)
+    return root
+
+
+def join(trace_id: str,
+         traces: Optional[Sequence[dict]] = None,
+         records: Optional[Sequence[dict]] = None,
+         flight_events: Optional[Sequence[dict]] = None,
+         ring_events: Optional[Sequence[dict]] = None) -> dict:
+    """The three-way join for ONE trace id: its span tree from the
+    trace ring, its dispatch-ledger records, its flight-recorder
+    events, and its timeline-ring events — the admin endpoint's
+    response body (schema v1, versioned by infra/schema.py)."""
+    trace = next((t for t in (traces or [])
+                  if t.get("trace_id") == trace_id), None)
+    recs = [r for r in (records or [])
+            if trace_id in (r.get("trace_ids") or [])]
+    flight = [e for e in (flight_events or [])
+              if e.get("trace_id") == trace_id]
+    ring = [e for e in (ring_events or [])
+            if e.get("trace_id") == trace_id]
+    return schema.envelope("timeline", {
+        "anchor": clock.anchor_dict(),
+        "trace_id": trace_id,
+        "tree": span_tree(trace) if trace is not None else None,
+        "records": [dict(r) for r in recs],
+        "flight": [dict(e) for e in flight],
+        "ring": ring,
+    })
+
+
+# --------------------------------------------------------------------------
+# Perfetto / Chrome trace-event export
+# --------------------------------------------------------------------------
+
+def _track_tid(track: str) -> int:
+    order = sorted(TRACKS)
+    return order.index(track) + 1 if track in order else len(order) + 1
+
+
+def _phase_track(phase: str) -> str:
+    if phase in ("device_enqueue", "device_sync", "busy"):
+        return "device"
+    if phase.startswith("brownout"):
+        return "admission"
+    if phase in ("reshape", "eject"):
+        return "mesh"
+    return "worker"
+
+
+def perfetto(traces: Optional[Sequence[dict]] = None,
+             records: Optional[Sequence[dict]] = None,
+             flight_events: Optional[Sequence[dict]] = None,
+             ring_events: Optional[Sequence[dict]] = None
+             ) -> List[dict]:
+    """Chrome trace-event list (``chrome://tracing`` / Perfetto's
+    legacy JSON importer): thread-name metadata declares one track per
+    TRACKS member; trace stages become ``X`` complete slices on the
+    worker/device tracks; ledger records become admission-track slices
+    (plan mode + compile outcome); flight events become ``i``
+    instants; coalesce marks and device-busy intervals become
+    ``b``/``e`` async pairs (the arrows for coalesced waiters and
+    enqueue→sync overlap).  Timestamps are µs on the wall axis,
+    rebased to the earliest event."""
+    pid = 1
+    events: List[dict] = []
+    for track in sorted(TRACKS):
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": _track_tid(track), "ts": 0,
+                       "cat": "__metadata",
+                       "args": {"name": track}})
+    events.append({"ph": "M", "name": "process_name", "pid": pid,
+                   "tid": 0, "ts": 0, "cat": "__metadata",
+                   "args": {"name": "teku-tpu"}})
+
+    def us(t_wall: float) -> float:
+        return t_wall * 1e6
+
+    body: List[dict] = []
+    for tr in traces or []:
+        tree = span_tree(tr)
+        tid_root = _track_tid("worker")
+        body.append({"ph": "X", "name": tree["phase"],
+                     "cat": "trace", "pid": pid, "tid": tid_root,
+                     "ts": us(tree["t_wall"]),
+                     "dur": tree["dur_ms"] * 1e3,
+                     "args": {"trace_id": tree.get("trace_id", "")}})
+
+        def walk(node: dict, trace_id: str) -> None:
+            for child in node["children"]:
+                body.append({
+                    "ph": "X", "name": child["phase"],
+                    "cat": "stage", "pid": pid,
+                    "tid": _track_tid(_phase_track(child["phase"])),
+                    "ts": us(child["t_wall"]),
+                    "dur": child["dur_ms"] * 1e3,
+                    "args": {"trace_id": trace_id}})
+                walk(child, trace_id)
+
+        walk(tree, tree.get("trace_id", ""))
+    for rec in records or []:
+        t_mono = rec.get("t_mono")
+        t_wall = (clock.wall_of(t_mono) if t_mono is not None
+                  else rec.get("t_wall", 0.0))
+        comp = rec.get("compile") or {}
+        dev = rec.get("device") or {}
+        dur_s = (comp.get("enqueue_s") or 0.0) + (dev.get("sync_s")
+                                                  or 0.0)
+        mode = ((rec.get("admission") or {}).get("plan") or {}
+                ).get("mode", "steady")
+        body.append({"ph": "X", "name": f"dispatch:{mode}",
+                     "cat": "admission", "pid": pid,
+                     "tid": _track_tid("admission"),
+                     "ts": us(t_wall),
+                     "dur": max(dur_s, 1e-6) * 1e6,
+                     "args": {"seq": rec.get("seq"),
+                              "shape": rec.get("shape"),
+                              "compile": comp.get("outcome"),
+                              "trace_id": (rec.get("trace_ids")
+                                           or [""])[0]}})
+    for ev in flight_events or []:
+        t_mono = ev.get("t_mono")
+        t_wall = (clock.wall_of(t_mono) if t_mono is not None
+                  else ev.get("t_wall", 0.0))
+        body.append({"ph": "i", "s": "t",
+                     "name": ev.get("kind", "event"),
+                     "cat": "flight", "pid": pid,
+                     "tid": _track_tid("flight"),
+                     "ts": us(t_wall),
+                     "args": {"seq": ev.get("seq"),
+                              "trace_id": ev.get("trace_id", "")}})
+    for ev in ring_events or []:
+        track = ev.get("track", "worker")
+        t_wall = ev.get("t_wall", 0.0)
+        dur_s = ev.get("dur_s", 0.0)
+        phase = ev.get("phase", "")
+        base = {"name": phase, "pid": pid,
+                "tid": _track_tid(track), "cat": track,
+                "args": {"seq": ev.get("seq"),
+                         "trace_id": ev.get("trace_id", "")}}
+        if dur_s > 0:
+            body.append({**base, "ph": "X", "ts": us(t_wall),
+                         "dur": dur_s * 1e6})
+        else:
+            body.append({**base, "ph": "i", "s": "t",
+                         "ts": us(t_wall)})
+        if phase == "coalesce":
+            aid = f"co-{ev.get('seq')}"
+            body.append({**base, "ph": "b", "id": aid,
+                         "cat": "coalesce", "ts": us(t_wall)})
+            body.append({**base, "ph": "e", "id": aid,
+                         "cat": "coalesce", "ts": us(t_wall)})
+        elif phase == "busy":
+            aid = f"ov-{ev.get('seq')}"
+            body.append({**base, "ph": "b", "id": aid,
+                         "cat": "overlap", "ts": us(t_wall)})
+            body.append({**base, "ph": "e", "id": aid,
+                         "cat": "overlap",
+                         "ts": us(t_wall + dur_s)})
+    if body:
+        t_base = min(e["ts"] for e in body)
+        for e in body:
+            e["ts"] = round(e["ts"] - t_base, 3)
+            if "dur" in e:
+                e["dur"] = round(e["dur"], 3)
+    body.sort(key=lambda e: (e["ts"], e["tid"]))
+    return events + body

@@ -38,6 +38,7 @@ from contextlib import contextmanager
 from contextvars import ContextVar
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from . import clock
 from .env import env_int
 
 from .metrics import GLOBAL_REGISTRY, LATENCY_BUCKETS_S
@@ -103,21 +104,33 @@ class Trace:
     """
 
     __slots__ = ("trace_id", "name", "labels", "t_start", "t_wall",
-                 "_end", "stages", "_lock")
+                 "_end", "stages", "spans", "_lock")
 
     def __init__(self, name: str, labels: Dict[str, str]):
         self.trace_id = _next_trace_id()
         self.name = name
         self.labels = labels
-        self.t_start = time.perf_counter()
-        self.t_wall = time.time()
+        # the shared clock-spine pair (infra/clock.py): t_wall and
+        # t_start (mono) are ONE stamp, so this trace joins the
+        # flight-recorder and dispatch-ledger rings on either axis
+        self.t_wall, self.t_start = clock.now()
         self._end: Optional[float] = None
         self.stages: List[Tuple[str, float]] = []
+        # (stage, t_mono_start, seconds): the stage intervals the
+        # timeline's gap-free span tree is built from.  `stages` keeps
+        # the historical (stage, seconds) pairs — consumers iterate it
+        # as 2-tuples
+        self.spans: List[Tuple[str, float, float]] = []
         self._lock = threading.Lock()
 
-    def add_stage(self, stage: str, seconds: float) -> None:
+    def add_stage(self, stage: str, seconds: float,
+                  t0: Optional[float] = None) -> None:
+        if t0 is None:
+            # recorded at stage end: derive the start offset
+            t0 = time.perf_counter() - seconds
         with self._lock:
             self.stages.append((stage, seconds))
+            self.spans.append((stage, t0, seconds))
 
     @property
     def complete(self) -> bool:
@@ -130,14 +143,16 @@ class Trace:
 
     def to_dict(self) -> dict:
         with self._lock:
-            stages = list(self.stages)
+            spans = list(self.spans)
         return {"trace_id": self.trace_id,
                 "name": self.name,
                 "labels": dict(self.labels),
                 "t_wall": round(self.t_wall, 3),
+                "t_mono": round(self.t_start, 6),
                 "total_ms": round(self.total_s * 1e3, 3),
-                "stages": [{"stage": s, "ms": round(d * 1e3, 3)}
-                           for s, d in stages]}
+                "stages": [{"stage": s, "ms": round(d * 1e3, 3),
+                            "t_mono": round(t0, 6)}
+                           for s, t0, d in spans]}
 
 
 class _SlowTraceRing:
@@ -187,14 +202,19 @@ def clear_slow_traces() -> None:
 # --------------------------------------------------------------------------
 
 def record_stage(stage: str, seconds: float,
-                 traces: Optional[Sequence[Trace]] = None) -> None:
+                 traces: Optional[Sequence[Trace]] = None,
+                 t0: Optional[float] = None) -> None:
     """Attribute an already-measured duration: stage histogram + the
-    given traces (default: the context's current traces)."""
+    given traces (default: the context's current traces).  ``t0`` is
+    the stage's start on the mono axis (spans pass it exactly; when
+    omitted the stage is assumed to end NOW)."""
     if not _enabled:
         return
     _STAGE_HIST.labels(stage=stage).observe(seconds)
+    if t0 is None:
+        t0 = time.perf_counter() - seconds
     for t in (traces if traces is not None else _CURRENT.get()):
-        t.add_stage(stage, seconds)
+        t.add_stage(stage, seconds, t0=t0)
 
 
 class _Span:
@@ -210,7 +230,7 @@ class _Span:
 
     def __exit__(self, *exc) -> None:
         record_stage(self.stage, time.perf_counter() - self._t0,
-                     self._traces)
+                     self._traces, t0=self._t0)
 
 
 class _NoopSpan:

@@ -50,7 +50,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 from ..crypto import bls, kzg
 from ..crypto.bls.loader import GuardedBls12381
 from ..infra import capacity as capacity_mod
-from ..infra import faults, flightrecorder
+from ..infra import faults, flightrecorder, timeline
 from ..infra.metrics import GLOBAL_REGISTRY, MetricsRegistry
 from ..infra.supervisor import CircuitBreaker
 from ..parallel import selfheal
@@ -368,6 +368,13 @@ async def _run_scenario(scenario: Scenario, seed: int, slots: int,
     kzg_prev_backend = kzg.get_backend()
     kzg.set_backend(kzg_backend)
     telemetry_prev = capacity_mod.swap_default(telemetry)
+    # causal-timeline window: ring events are stamped on the REAL
+    # monotonic clock even while scenario time is virtual, so the
+    # attribution below reads real-wall overlap (model backends emit
+    # no device-busy events — those metrics honestly come back
+    # None/zero, the skip-if-missing contract)
+    ring_mark = timeline.RING.mark()
+    t_real0 = time.perf_counter()
     try:
         await svc.start()
         idx = 0
@@ -486,6 +493,10 @@ async def _run_scenario(scenario: Scenario, seed: int, slots: int,
         kzg.set_backend(kzg_prev_backend)
         bls.reset_implementation()
 
+    t_real1 = time.perf_counter()
+    attribution = timeline.attribution(
+        timeline.RING.snapshot(since_seq=ring_mark), t_real0, t_real1)
+
     # aggregate device evidence across every backend that served (the
     # chaos scenario swaps model backends on eject/readmit; counting
     # only the last would hide the wedge-window work)
@@ -563,6 +574,7 @@ async def _run_scenario(scenario: Scenario, seed: int, slots: int,
         "shed_total": sum(sheds.values()),
         "dedup_ratio": round(dedup_ratio, 4),
         "coalesced": coalesced,
+        "attribution": attribution,
         "dispatches": dispatches,
         "bisect_dispatches": dispatches.get("bisect", 0),
         "device": {"dispatches": dev_dispatches,

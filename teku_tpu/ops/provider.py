@@ -55,7 +55,7 @@ import jax.numpy as jnp
 
 from ..crypto.bls import hash_to_curve as OH
 from ..infra import (capacity, compilecache, dispatchledger, faults,
-                     tracing)
+                     timeline, tracing)
 from ..infra.collections import LimitedMap
 from ..infra.env import env_int
 from ..infra.metrics import GLOBAL_REGISTRY
@@ -267,7 +267,16 @@ class _DispatchHandle:
         finally:
             t_end = time.perf_counter()
             tracing.record_stage("device_sync", t_end - t_sync0,
-                                 self._traces)
+                                 self._traces, t0=t_sync0)
+            # the timeline's device-busy interval: enqueue-end →
+            # sync-end, the numerator of overlap_efficiency (a raising
+            # sync still occupied the device until it raised)
+            timeline.interval(
+                "device", "busy", t_end - self._t_enq_end,
+                t_mono=self._t_enq_end,
+                trace_id=(self._traces[0].trace_id if self._traces
+                          else ""),
+                shape=self._shape)
             if not synced and self._rec is not None:
                 # a raising sync is still a decision worth its ledger
                 # entry — the doctor wants to see the dispatch that
@@ -671,6 +680,7 @@ class JaxBls12381(BLS12381):
         n = len(semis)
         self.dispatch_count += 1
         self.lanes_dispatched += n
+        t_hp0 = time.perf_counter()
         with tracing.span("host_prep"):
             kmax = _next_pow2(max(len(s.pk_limbs) for s in semis))
             # unique-message index + per-message lane groups: h2c AND
@@ -815,6 +825,12 @@ class JaxBls12381(BLS12381):
                 h2c_stats = {"cache_hits": len(row_msgs) - misses,
                              "cache_misses": misses,
                              "dispatch_bucket": h2c_bucket}
+        # the timeline's host-prep interval: the serial host-side term
+        # host_prep_serial_share is computed from (subtracting any
+        # overlap with device-busy intervals)
+        timeline.interval(
+            "worker", "host_prep", time.perf_counter() - t_hp0,
+            t_mono=t_hp0, trace_id=tracing.current_trace_id())
         mesh_n = (self._sharded.n_devices
                   if self._sharded is not None else 0)
         # mesh dispatches get their own shape family (the capacity
@@ -933,7 +949,7 @@ class JaxBls12381(BLS12381):
                           path=mont_path).inc()
             t_enq_end = time.perf_counter()
             tracing.record_stage("device_enqueue", t_enq_end - t_dev0,
-                                 traces)
+                                 traces, t0=t_dev0)
             # on a first shape the enqueue duration IS the XLA cost
             # this dispatch paid (fresh compile or disk cache load) —
             # the doctor's cold-compile findings cite it per record

@@ -50,7 +50,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-from ..infra import flightrecorder, tracing
+from ..infra import flightrecorder, timeline, tracing
 from ..infra.env import env_float, env_int
 from ..infra.metrics import GLOBAL_REGISTRY, MetricsRegistry
 from ..infra.pow2 import floor_pow2 as _floor_pow2
@@ -376,6 +376,10 @@ class MeshHealer:
                 probe_error=err, dispatch_error=str(error)[:200],
                 dispatch_timeout=timeout,
                 eject_count=self.ledger.eject_count(idx))
+            # mesh overlay track on the causal timeline
+            timeline.instant(
+                "mesh", "eject", trace_id=trace_id,
+                device=self.ledger.device_names[idx])
         try:
             self._reshape("shrink", recovery_t0=t0, trace_id=trace_id)
         finally:
@@ -501,6 +505,15 @@ class MeshHealer:
                 direction=direction, from_devices=old_n,
                 to_devices=n, configured=self.configured_n,
                 epoch=self.epoch, recovery_s=round(dt, 3))
+            # mesh-heal interval on the causal timeline: the duration
+            # rides alone (the healer's stopwatch is time.monotonic —
+            # a different base than the spine's mono axis, so the
+            # interval is placed by its END, never by subtracting
+            # across clock bases)
+            timeline.interval(
+                "mesh", "reshape", dt, trace_id=trace_id,
+                direction=direction, devices=n,
+                epoch=self.epoch)
             return True
 
     # ------------------------------------------------------------------

@@ -57,7 +57,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Optional
 
-from ..infra import capacity, flightrecorder, tracing
+from ..infra import capacity, flightrecorder, timeline, tracing
 from ..infra.env import env_float, env_int
 from ..infra.metrics import GLOBAL_REGISTRY, MetricsRegistry
 
@@ -325,6 +325,10 @@ class AdmissionController:
                 burn_rate=round(burn, 3),
                 detail="shedding " + "+".join(
                     c.label for c in SHEDDABLE[:target]))
+            # admission overlay track: the timeline pairs this with
+            # the matching exit/deescalate mark into a state interval
+            timeline.instant("admission", "brownout_enter",
+                             trace_id=trace_id, level=target)
             _LOG.warning(
                 "brownout ENTER level %d (util %.2f, burn %.2f): "
                 "shedding %s", target, util, burn,
@@ -345,6 +349,8 @@ class AdmissionController:
                     utilization=round(util, 3),
                     burn_rate=round(burn, 3),
                     detail=f"calm for {self.hold_ticks} ticks")
+                timeline.instant("admission", "brownout_exit",
+                                 level=0, from_level=old)
                 _LOG.info("brownout EXIT (util %.2f, burn %.2f)",
                           util, burn)
             elif (self._level > 1
@@ -366,6 +372,8 @@ class AdmissionController:
                     burn_rate=round(burn, 3),
                     detail=f"below level-{old} entry for "
                            f"{self.hold_ticks} ticks")
+                timeline.instant("admission", "brownout_deescalate",
+                                 level=self._level, from_level=old)
                 _LOG.info(
                     "brownout DE-ESCALATE to level %d "
                     "(util %.2f, burn %.2f)", self._level, util, burn)

@@ -66,7 +66,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..crypto import bls
 from ..infra import (capacity, dispatchledger, faults, flightrecorder,
-                     tracing)
+                     timeline, tracing)
 from ..infra.metrics import (GLOBAL_REGISTRY, LATENCY_BUCKETS_S,
                              MetricsRegistry)
 from ..infra.env import env_bool, env_float
@@ -149,6 +149,19 @@ class _PriorityQueue:
         self._nonempty = asyncio.Event()
         # pulse on every put: flush-deadline waiters wake per arrival
         self._arrival = asyncio.Event()
+        # timeline: start of the current queue-nonempty interval (the
+        # wall-time denominator of overlap_efficiency); None while the
+        # queue is empty or the timeline is disabled
+        self._t_nonempty: Optional[float] = None
+
+    def _note_size_change(self) -> None:
+        """Close the queue-nonempty timeline interval when the queue
+        drains (every decrement path funnels here)."""
+        if self._size == 0 and self._t_nonempty is not None:
+            t0 = self._t_nonempty
+            self._t_nonempty = None
+            timeline.interval("worker", "queue_nonempty",
+                              time.perf_counter() - t0, t_mono=t0)
 
     def qsize(self) -> int:
         return self._size
@@ -170,6 +183,8 @@ class _PriorityQueue:
         self._qs[task.cls].append(task)
         self._size += 1
         self._triples += len(task.triples)
+        if self._t_nonempty is None and timeline.enabled():
+            self._t_nonempty = time.perf_counter()
         self._nonempty.set()
         self._arrival.set()
 
@@ -222,6 +237,7 @@ class _PriorityQueue:
             del q[idx]
         self._size -= 1
         self._triples -= len(task.triples)
+        self._note_size_change()
         return task
 
     def remove(self, task: _Task) -> bool:
@@ -264,6 +280,7 @@ class _PriorityQueue:
             self._size -= 1
             self._triples -= len(t.triples)
         q.clear()
+        self._note_size_change()
         return victims
 
     def _drop_many(self, cls: VerifyClass,
@@ -281,6 +298,7 @@ class _PriorityQueue:
         for t in victims:
             self._size -= 1
             self._triples -= len(t.triples)
+        self._note_size_change()
 
     def drain_expired(self, cls: VerifyClass, now: float
                       ) -> List[_Task]:
@@ -558,6 +576,15 @@ class AggregatingSignatureVerificationService:
                     pending.deadline,
                     self._clock() + class_deadline_s(cls))
             self._m_coalesced.inc()
+            # timeline: the waiter's trace joins the pending task's
+            # in-flight lane — the Perfetto export draws the async
+            # arrow from this mark to the carrying dispatch
+            timeline.instant(
+                "worker", "coalesce",
+                trace_id=(pending.trace.trace_id
+                          if pending.trace is not None else ""),
+                waiter_class=cls.label,
+                waiters=len(pending.waiters))
             return fut
         # capacity input: demand is OFFERED load — a shed arrival is
         # still demand (counting only accepted work would read
@@ -922,9 +949,14 @@ class AggregatingSignatureVerificationService:
             assembly = time.perf_counter() - t_first
             for t in tasks:
                 trs = (t.trace,) if t.trace is not None else ()
+                # exact start offsets: queue_wait began at enqueue,
+                # assembly at the drain — the timeline's span tree
+                # tiles on these
                 tracing.record_stage(
-                    "queue_wait", t_first - t.t_enqueue, trs)
-                tracing.record_stage("assembly", assembly, trs)
+                    "queue_wait", t_first - t.t_enqueue, trs,
+                    t0=t.t_enqueue)
+                tracing.record_stage("assembly", assembly, trs,
+                                     t0=t_first)
         return tasks
 
     def _dispatch_annotations(self, tasks: List[_Task],

@@ -310,6 +310,41 @@ def test_closed_registry_both_directions(tmp_path):
     assert "good_kind" not in tokens
 
 
+TIMELINE_TREE = {
+    "teku_tpu/infra/timeline.py": """
+        TRACKS = frozenset({"worker", "ghost_track"})
+        PHASES = frozenset({"busy", "ghost_phase"})
+
+        def interval(track, phase, dur_s, **fields):
+            pass
+
+        def instant(track, phase, **fields):
+            pass
+    """,
+    "teku_tpu/user.py": """
+        from .infra import timeline
+
+        def work():
+            timeline.interval("worker", "busy", 0.1)
+            timeline.instant("rogue_track", "rogue_phase")
+    """,
+}
+
+
+def test_closed_registry_timeline_tracks_and_phases(tmp_path):
+    """The timeline's track/phase vocabulary is closed the same both-
+    directions way as EVENT_KINDS: undeclared emits and declared-but-
+    never-emitted members are both findings."""
+    report = lint(tmp_path, dict(TIMELINE_TREE))
+    tokens = {f.token for f in by_checker(report, "closed-registry")}
+    assert "rogue_track" in tokens      # emitted but undeclared
+    assert "rogue_phase" in tokens
+    assert "ghost_track" in tokens      # declared but never emitted
+    assert "ghost_phase" in tokens
+    assert "worker" not in tokens       # declared + emitted = clean
+    assert "busy" not in tokens
+
+
 def test_closed_registry_missing_declaration(tmp_path):
     tree = dict(REGISTRY_TREE)
     tree["teku_tpu/infra/faults.py"] = "def check(site):\n    pass\n"

@@ -80,6 +80,35 @@ def test_overload_gates_absent_are_skipped_and_threshold_overrides():
     assert _by_metric(out)["overload_p50_ms"]["status"] == "regression"
 
 
+def test_overlap_efficiency_gate_flags_skips_and_overrides():
+    """The timeline PR gate: the latency burst's device-busy share of
+    queue-nonempty time (bench stamps it from infra/timeline.py's
+    attribution) must stay >= the floor; results that predate the
+    timeline ring or ran with TEKU_TPU_TIMELINE=0 carry no value and
+    skip; the floor defaults to 0.0 (the CPU reference box measures
+    ~0 — drain-then-dispatch never overlaps) and is raised per
+    deployment where enqueue genuinely overlaps device execution."""
+    base = bench_diff.load_result(BASE)
+    reg = bench_diff.load_result(REGRESSED)
+    assert _by_metric(bench_diff.compare(base, base))[
+        "overlap_efficiency"]["status"] == "ok"
+    # default floor is vacuous: even the regressed fixture passes
+    assert _by_metric(bench_diff.compare(base, reg))[
+        "overlap_efficiency"]["status"] == "ok"
+    # a deployment floor flags it
+    assert _by_metric(bench_diff.compare(
+        base, reg, {"overlap_efficiency_min": 0.3}))[
+        "overlap_efficiency"]["status"] == "regression"
+    stripped = {k: v for k, v in base.items()
+                if k != "overlap_efficiency"}
+    assert _by_metric(bench_diff.compare(base, stripped))[
+        "overlap_efficiency"]["status"] == "skipped"
+    out = bench_diff.compare(base, base,
+                             {"overlap_efficiency_min": 0.9})
+    assert _by_metric(out)["overlap_efficiency"]["status"] \
+        == "regression"
+
+
 def test_msm_gate_flags_skips_and_overrides():
     """The PR-8 MSM gate: pippenger's scalars-stage p50 must beat the
     ladder >= 1.3x at every measured batch >= 256; absent evidence

@@ -45,6 +45,18 @@ from teku_tpu.parallel.selfheal import (DeviceHealthLedger,
 pytest_plugins: list = []
 
 
+@pytest.fixture(autouse=True)
+def _restore_global_topology_filter():
+    """The chaos tests drive the REAL self-heal path, which retires
+    latency series on the process-global capacity model and installs
+    its live-topology filter.  Left in place, the filter silently
+    drops every later non-mesh test's capacity samples in the same
+    process (test_msm's per-path latency-series assertions were the
+    first to notice)."""
+    yield
+    capacity.TELEMETRY.latency.clear_topology_filter()
+
+
 def _wait(predicate, timeout_s=10.0, what="condition"):
     t0 = time.monotonic()
     while not predicate():

@@ -1,0 +1,381 @@
+"""The unified causal timeline (infra/timeline.py + infra/clock.py +
+infra/schema.py): one clock spine, three exports.
+
+Pinned here: schema v1 (the envelope shared by doctor and the
+timeline), the three-way join by trace id, Perfetto trace-event
+validity, the gap-free span-tree invariant on a REAL in-process
+dispatch, the TEKU_TPU_TIMELINE=0 instrumentation-free path, the
+self-measured overhead, the doctor's host_prep_serial/overlap_stall
+analyzers, and one-WARN degradation for every new knob."""
+
+import asyncio
+import logging
+
+import pytest
+
+from teku_tpu.crypto import bls
+from teku_tpu.crypto.bls import keygen
+from teku_tpu.infra import clock, doctor, env, schema, timeline, tracing
+from teku_tpu.infra.metrics import MetricsRegistry
+from teku_tpu.services.signatures import (
+    AggregatingSignatureVerificationService)
+
+SKS = [keygen(bytes([60 + i]) * 32) for i in range(4)]
+PKS = [bls.secret_to_public_key(sk) for sk in SKS]
+
+
+# --------------------------------------------------------------------------
+# schema v1 — ONE versioning helper for doctor + timeline
+# --------------------------------------------------------------------------
+
+def test_schema_v1_is_pinned():
+    assert schema.VERSIONS == {"doctor": 1, "timeline": 1,
+                               "perfetto": 1}
+    env_ = schema.envelope("timeline", {"body": 1})
+    assert env_["schema"] == "timeline" and env_["version"] == 1
+    assert env_["body"] == 1
+    with pytest.raises(KeyError):
+        schema.envelope("unknown", {})
+
+
+def test_doctor_and_timeline_share_the_envelope():
+    diag = doctor.diagnose([])
+    assert diag["schema"] == "doctor" and diag["version"] == 1
+    joined = timeline.join("t-x")
+    assert joined["schema"] == "timeline" and joined["version"] == 1
+
+
+def test_clock_spine_anchor_round_trips():
+    t_wall, t_mono = clock.now()
+    assert abs(clock.wall_of(t_mono) - t_wall) < 0.05
+    assert abs(clock.mono_of(t_wall) - t_mono) < 0.05
+    rec = clock.stamp({})
+    assert set(rec) >= {"t_wall", "t_mono"}
+    anchor = clock.anchor_dict()
+    assert set(anchor) == {"t_wall", "t_mono"}
+
+
+# --------------------------------------------------------------------------
+# attribution metrics (pure interval arithmetic)
+# --------------------------------------------------------------------------
+
+def _ev(phase, t_mono, dur_s=0.0, **kw):
+    return {"seq": 0, "track": "worker", "phase": phase,
+            "t_mono": t_mono, "dur_s": dur_s, "trace_id": "", **kw}
+
+
+def test_attribution_overlap_and_serial_shares():
+    events = [
+        _ev("queue_nonempty", 10.0, 1.0),
+        _ev("busy", 10.0, 0.4),         # 0.4 of 1.0 nonempty covered
+        _ev("host_prep", 10.5, 0.2),    # fully outside busy → serial
+    ]
+    out = timeline.attribution(events, 10.0, 12.0,
+                               stage_sums={"queue_wait": 1.0,
+                                           "complete": 4.0},
+                               compile_s=0.5)
+    assert out["overlap_efficiency"] == pytest.approx(0.4)
+    assert out["host_prep_serial_share"] == pytest.approx(0.1)
+    assert out["queue_wait_share"] == pytest.approx(0.25)
+    assert out["compile_wall_share"] == pytest.approx(0.25)
+    assert out["events"] == 3
+
+
+def test_attribution_missing_inputs_come_back_none():
+    out = timeline.attribution([], 0.0, 1.0)
+    assert out["overlap_efficiency"] is None
+    assert out["host_prep_serial_share"] is None
+    assert out["queue_wait_share"] is None
+    assert out["compile_wall_share"] is None
+
+
+def test_stalls_are_nonempty_minus_busy():
+    events = [_ev("queue_nonempty", 5.0, 2.0),
+              _ev("busy", 5.5, 0.5)]
+    gaps = timeline.stalls(events)
+    assert gaps == [(5.0, 5.5), (6.0, 7.0)]
+
+
+# --------------------------------------------------------------------------
+# the three-way join
+# --------------------------------------------------------------------------
+
+def _trace_dict(trace_id="t-join", t_mono=100.0, total_ms=10.0,
+                stages=None):
+    return {"trace_id": trace_id, "name": "verify", "labels": {},
+            "t_wall": clock.wall_of(t_mono), "t_mono": t_mono,
+            "total_ms": total_ms, "stages": stages or []}
+
+
+def test_join_filters_every_ring_by_trace_id():
+    traces = [_trace_dict("t-join"), _trace_dict("t-other")]
+    records = [{"seq": 1, "trace_ids": ["t-join"], "t_mono": 100.0},
+               {"seq": 2, "trace_ids": ["t-other"]}]
+    flight = [{"seq": 7, "kind": "slo_breach", "trace_id": "t-join",
+               "t_mono": 100.2},
+              {"seq": 8, "kind": "slo_breach", "trace_id": "zzz"}]
+    ring = [_ev("busy", 100.0, 0.01, trace_id="t-join"),
+            _ev("busy", 100.0, 0.01, trace_id="t-other")]
+    out = timeline.join("t-join", traces, records, flight, ring)
+    assert out["trace_id"] == "t-join"
+    assert out["tree"]["trace_id"] == "t-join"
+    assert [r["seq"] for r in out["records"]] == [1]
+    assert [e["seq"] for e in out["flight"]] == [7]
+    assert len(out["ring"]) == 1
+    assert set(out["anchor"]) == {"t_wall", "t_mono"}
+    # unknown trace id: honest empty join, not an error
+    missing = timeline.join("t-none", traces, records, flight, ring)
+    assert missing["tree"] is None and missing["records"] == []
+
+
+# --------------------------------------------------------------------------
+# span trees: gap-free by construction
+# --------------------------------------------------------------------------
+
+def _assert_gap_free(node):
+    """Every node's children tile it EXACTLY: contiguous starts, and
+    the last child ends at the parent's end."""
+    children = node["children"]
+    if not children:
+        return
+    cursor = node["t_mono"]
+    for child in children:
+        assert abs(child["t_mono"] - cursor) <= 2e-6, \
+            f"hole before {child['phase']} in {node['phase']}"
+        cursor = child["t_mono"] + child["dur_ms"] / 1e3
+    parent_end = node["t_mono"] + node["dur_ms"] / 1e3
+    assert abs(cursor - parent_end) <= 2e-6 + timeline.RESOLUTION_S
+    for child in children:
+        _assert_gap_free(child)
+
+
+def test_span_tree_nests_fills_and_tiles():
+    tr = _trace_dict(total_ms=10.0, stages=[
+        {"stage": "dispatch", "ms": 6.0, "t_mono": 100.002},
+        {"stage": "host_prep", "ms": 2.0, "t_mono": 100.003},
+        # starts 0.02 ms before host_prep's end: a sub-resolution
+        # seam that must SNAP, not synthesize a filler node
+        {"stage": "device_sync", "ms": 2.5, "t_mono": 100.00498},
+    ])
+    tree = timeline.span_tree(tr)
+    phases = [c["phase"] for c in tree["children"]]
+    # the pre-dispatch hole and the post-dispatch tail are explicit
+    assert phases == ["unattributed", "dispatch", "unattributed"]
+    dispatch = tree["children"][1]
+    assert [c["phase"] for c in dispatch["children"]] == [
+        "unattributed", "host_prep", "device_sync", "unattributed"]
+    _assert_gap_free(tree)
+
+
+def test_span_tree_on_a_real_in_process_dispatch():
+    """End-to-end: a verification through the aggregating service
+    (pure-python provider) yields a trace whose span tree is gap-free
+    and whose dispatch actually hit the timeline ring."""
+    prev_tracing = tracing.enabled()
+    prev_timeline = timeline.enabled()
+    tracing.set_enabled(True)
+    timeline.set_enabled(True)
+    mark = timeline.RING.mark()
+
+    async def main():
+        svc = AggregatingSignatureVerificationService(
+            num_workers=1, registry=MetricsRegistry())
+        await svc.start()
+        tr = tracing.new_trace("verify_test")
+        msg = b"timeline-e2e"
+        sig = bls.sign(SKS[0], msg)
+        with tracing.attach((tr,)):
+            ok = await svc.verify([PKS[0]], msg, sig)
+        tracing.finish(tr)
+        await svc.stop()
+        return ok, tr
+
+    try:
+        ok, tr = asyncio.run(main())
+    finally:
+        tracing.set_enabled(prev_tracing)
+        timeline.set_enabled(prev_timeline)
+    assert ok
+    doc = tr.to_dict()
+    assert doc["t_mono"] > 0
+    stages = {s["stage"] for s in doc["stages"]}
+    assert "dispatch" in stages
+    assert all("t_mono" in s for s in doc["stages"])
+    tree = timeline.span_tree(doc)
+    assert tree["children"], "no spans nested under the trace"
+    _assert_gap_free(tree)
+    # the service's queue instrumentation reached the shared ring
+    ring = timeline.RING.snapshot(since_seq=mark)
+    assert any(e["phase"] == "queue_nonempty" for e in ring)
+
+
+# --------------------------------------------------------------------------
+# Perfetto export
+# --------------------------------------------------------------------------
+
+def test_perfetto_events_validate_and_declare_tracks():
+    traces = [_trace_dict(stages=[
+        {"stage": "dispatch", "ms": 8.0, "t_mono": 100.001},
+        {"stage": "device_sync", "ms": 3.0, "t_mono": 100.004}])]
+    records = [{"seq": 3, "trace_ids": ["t-join"], "t_mono": 100.0,
+                "shape": "256x2", "admission": {"plan": {
+                    "mode": "steady"}},
+                "compile": {"outcome": "cache_hit",
+                            "enqueue_s": 0.004},
+                "device": {"sync_s": 0.003}}]
+    flight = [{"seq": 9, "kind": "brownout_enter",
+               "trace_id": "t-join", "t_mono": 100.001}]
+    ring = [_ev("coalesce", 100.002, trace_id="t-join"),
+            _ev("busy", 100.003, 0.004, trace_id="t-join",
+                track="device")]
+    events = timeline.perfetto(traces, records, flight, ring)
+    tracks = {e["args"]["name"] for e in events
+              if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert tracks == timeline.TRACKS and len(tracks) >= 4
+    for e in events:
+        assert e["ph"] in ("M", "X", "i", "b", "e")
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert e["pid"] == 1 and isinstance(e["tid"], int)
+        assert "cat" in e and "name" in e
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+        if e["ph"] == "i":
+            assert e["s"] == "t"
+    # async arrows: coalesce and overlap pairs are id-matched b/e
+    for cat in ("coalesce", "overlap"):
+        pairs = [e for e in events if e["ph"] in ("b", "e")
+                 and e["cat"] == cat]
+        assert pairs and len(pairs) % 2 == 0
+        by_id = {}
+        for e in pairs:
+            by_id.setdefault(e["id"], []).append(e["ph"])
+        assert all(sorted(v) == ["b", "e"] for v in by_id.values())
+    body = [e for e in events if e["ph"] != "M"]
+    assert body == sorted(body, key=lambda e: (e["ts"], e["tid"]))
+
+
+# --------------------------------------------------------------------------
+# disabled mode + self-measurement
+# --------------------------------------------------------------------------
+
+def test_timeline_disabled_is_instrumentation_free():
+    prev = timeline.enabled()
+    mark = timeline.RING.mark()
+    try:
+        timeline.set_enabled(False)
+        assert timeline.interval("worker", "host_prep", 0.01) is None
+        assert timeline.instant("worker", "coalesce") is None
+        assert timeline.RING.snapshot(since_seq=mark) == []
+        timeline.set_enabled(True)
+        ev = timeline.interval("worker", "host_prep", 0.01)
+        assert ev is not None and ev["seq"] > mark
+    finally:
+        timeline.set_enabled(prev)
+
+
+def test_measure_overhead_is_scratch_and_bounded():
+    mark = timeline.RING.mark()
+    out = timeline.measure_overhead(n=500)
+    assert out["events"] == 500
+    assert out["per_event_us"] > 0
+    # the self-measurement must not pollute the live ring
+    assert timeline.RING.snapshot(since_seq=mark) == []
+    # sanity ceiling: a stamp is a dict build + deque append; even on
+    # a loaded 1-core box it stays far under a millisecond
+    assert out["per_event_us"] < 1000
+
+
+def test_ring_is_bounded_and_markable():
+    ring = timeline.TimelineRing(capacity=4)
+    for i in range(10):
+        ring.append({"t_mono": float(i), "trace_id": "t",
+                     "phase": "busy", "track": "device",
+                     "dur_s": 0.0})
+    snap = ring.snapshot()
+    assert len(snap) == 4 and snap[-1]["seq"] == 10
+    assert ring.snapshot(last=2)[0]["seq"] == 9
+    assert ring.snapshot(since_seq=8)[0]["seq"] == 9
+    assert ring.snapshot(trace_id="nope") == []
+
+
+# --------------------------------------------------------------------------
+# doctor analyzers
+# --------------------------------------------------------------------------
+
+def _tl(traces=None, events=None):
+    return {"traces": traces or [], "events": events or []}
+
+
+def test_doctor_host_prep_serial_cites_the_worst_dispatch():
+    rec = {"seq": 11, "trace_ids": ["t-hp"], "shape": "256x2",
+           "lanes": 256, "t_mono": 100.0}
+    tr = _trace_dict("t-hp", total_ms=100.0, stages=[
+        {"stage": "host_prep", "ms": 60.0, "t_mono": 100.001}])
+    diag = doctor.diagnose([rec], timeline=_tl(traces=[tr]))
+    f = next(x for x in diag["findings"]
+             if x["kind"] == "host_prep_serial")
+    assert f["evidence"][0]["seq"] == 11
+    assert f["evidence"][0]["trace_id"] == "t-hp"
+    assert f["metrics"]["share"] == pytest.approx(0.6)
+    assert f["metrics"]["lanes"] == 256
+    # small batches never trip it: host_prep dominating a 1-lane
+    # verify is expected, not a finding
+    small = dict(rec, lanes=4)
+    diag = doctor.diagnose([small], timeline=_tl(traces=[tr]))
+    assert not [x for x in diag["findings"]
+                if x["kind"] == "host_prep_serial"]
+
+
+def test_doctor_overlap_stall_cites_the_gap():
+    events = [_ev("queue_nonempty", 100.0, 1.0),
+              _ev("busy", 100.0, 0.3, track="device")]
+    rec = {"seq": 21, "trace_ids": ["t-st"], "shape": "256x2",
+           "t_mono": 100.5}
+    diag = doctor.diagnose([rec], timeline=_tl(events=events))
+    f = next(x for x in diag["findings"]
+             if x["kind"] == "overlap_stall")
+    assert f["metrics"]["stall_share"] == pytest.approx(0.7)
+    assert f["metrics"]["worst_gap"]["dur_s"] == pytest.approx(0.7)
+    assert f["evidence"][0]["seq"] == 21
+    assert diag["inputs"]["timeline"] is True
+    # a well-overlapped window is quiet
+    good = [_ev("queue_nonempty", 100.0, 1.0),
+            _ev("busy", 100.0, 0.95, track="device")]
+    diag = doctor.diagnose([], timeline=_tl(events=good))
+    assert not [x for x in diag["findings"]
+                if x["kind"] == "overlap_stall"]
+
+
+# --------------------------------------------------------------------------
+# knob hygiene: garbage degrades with ONE WARN, never a boot failure
+# --------------------------------------------------------------------------
+
+def test_garbage_timeline_knobs_degrade_with_one_warn(monkeypatch,
+                                                      caplog):
+    monkeypatch.setenv("TEKU_TPU_TIMELINE", "sideways")
+    monkeypatch.setenv("TEKU_TPU_TIMELINE_RING", "garbage!!")
+    env._reset_warnings()
+    with caplog.at_level(logging.WARNING, logger="teku_tpu.infra.env"):
+        assert env.env_bool("TEKU_TPU_TIMELINE", True) is True
+        ring = timeline.TimelineRing()
+        assert ring.capacity == 4096        # default survived
+        timeline.TimelineRing()             # second read: no new WARN
+    for knob in ("TEKU_TPU_TIMELINE", "TEKU_TPU_TIMELINE_RING"):
+        warns = [r for r in caplog.records
+                 if r.getMessage().startswith(knob + " ")]
+        assert len(warns) == 1, knob
+
+
+def test_garbage_doctor_knobs_degrade_with_one_warn(monkeypatch,
+                                                    caplog):
+    monkeypatch.setenv("TEKU_TPU_DOCTOR_HOST_PREP_SHARE", "garbage!!")
+    monkeypatch.setenv("TEKU_TPU_DOCTOR_OVERLAP_STALL", "2.5")
+    env._reset_warnings()
+    with caplog.at_level(logging.WARNING, logger="teku_tpu.infra.env"):
+        diag = doctor.diagnose([], timeline=_tl())
+        doctor.diagnose([], timeline=_tl())
+    assert diag["healthy"]
+    for knob in ("TEKU_TPU_DOCTOR_HOST_PREP_SHARE",
+                 "TEKU_TPU_DOCTOR_OVERLAP_STALL"):
+        warns = [r for r in caplog.records if knob in r.getMessage()]
+        assert len(warns) == 1, knob

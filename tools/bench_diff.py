@@ -95,6 +95,17 @@ DEFAULT_THRESHOLDS: Dict[str, float] = {
     # gated on MEASURED (real parallel hardware) series only; virtual
     # serialized runs pay compile wall time that means nothing
     "mesh_recovery_s_max": 60.0,
+    # causal-timeline gate (timeline PR): while the latency burst's
+    # queue holds work, the device must be executing a dispatch at
+    # least this share of the time — the direct measurement of the
+    # async-overlap machinery doing its job.  Skip-if-missing: absent
+    # when TEKU_TPU_TIMELINE=0 or the result predates the ring.
+    # Default 0.0 (vacuous): the CPU reference box MEASURES ~0 —
+    # the service drains the queue into one batch and only then
+    # dispatches, so the queue is empty again before the device gets
+    # busy (BENCH_r18 latency phase: 0.0002).  Raise to ~0.3 on real
+    # parallel hardware where enqueue overlaps device execution.
+    "overlap_efficiency_min": 0.0,
 }
 
 
@@ -265,6 +276,17 @@ def compare(base: dict, new: dict,
         lambda v: v is False,
         "brownout must be edge-triggered: one enter, at most one "
         "exit, no flapping")
+
+    # causal-timeline gate (timeline PR, absolute, skip-if-missing):
+    # device-busy ∩ queue-nonempty over queue-nonempty during the
+    # latency burst — overlap collapsing means host work serialized
+    # ahead of the device again
+    _check_absolute(
+        checks, "overlap_efficiency",
+        new.get("overlap_efficiency"),
+        lambda v: v >= thr["overlap_efficiency_min"],
+        f"device-busy share of queue-nonempty time must stay >= "
+        f"{thr['overlap_efficiency_min']}")
 
     # mesh gates (PR-10 acceptance properties, absolute, skip-if-
     # missing): the device-count sweep's scaling series must rise
