@@ -1097,6 +1097,95 @@ def cmd_timeline(args) -> int:
     return 0
 
 
+def cmd_precompile(args) -> int:
+    """Build the serving shape set into the AOT executable store
+    (ops/shapeset.py enumerates it; infra/aotstore.py persists it).
+    Install-time twin of supervisor WARMING: every program a boot of
+    this config would compile is lowered+compiled HERE and serialized,
+    so boots — and selfheal reshapes over the same device set — warm
+    by deserializing in seconds instead of paying XLA.  Reports
+    per-shape compile vs load (re-runs are incremental: valid entries
+    are skipped as loads)."""
+    import time as _time
+
+    from .infra import aotstore, compilecache
+
+    _configure_log_format(args, {})
+    if args.store_dir:
+        os.environ["TEKU_TPU_AOT_STORE_DIR"] = args.store_dir
+    mont = str(args.mont_path).lower()
+    if mont not in _MONT_PATHS:
+        raise SystemExit(f"invalid --mont-path {mont!r} (use one of "
+                         f"{'/'.join(_MONT_PATHS)})")
+    os.environ["TEKU_TPU_MONT_MUL"] = mont
+    msm_choice = str(args.msm_path).lower()
+    if msm_choice not in _MSM_PATHS:
+        raise SystemExit(f"invalid --msm-path {msm_choice!r} (use one "
+                         f"of {'/'.join(_MSM_PATHS)})")
+    os.environ["TEKU_TPU_MSM"] = msm_choice
+    mesh_choice = _validate_mesh(str(args.mesh).lower())
+    os.environ["TEKU_TPU_MESH"] = mesh_choice
+    mesh_n = (int(mesh_choice)
+              if mesh_choice not in ("off", "auto") else 0)
+    if mesh_n > 1:
+        from .infra.env import ensure_virtual_devices
+        ensure_virtual_devices(mesh_n)
+    compilecache.configure()
+    if aotstore.store_dir() is None:
+        raise SystemExit("AOT store is off (TEKU_TPU_AOT_STORE / "
+                         "TEKU_TPU_AOT_STORE_DIR) — nothing to build")
+
+    from .ops import shapeset
+    from .ops import verify as V
+    from .ops.provider import JaxBls12381
+
+    mesh_obj = None
+    if mesh_n >= 2:
+        from . import parallel
+        mesh_obj = parallel.make_mesh(mesh_n, advertise=False)
+    max_batch = args.max_batch or shapeset.SERVICE_MAX_BATCH
+    min_bucket = args.min_bucket or shapeset.SERVICE_MIN_BUCKET
+    # constructing the provider registers the pk_validate dispatcher;
+    # staged_jits() registers the stage dispatchers; the mesh kernel
+    # registers per msm path below
+    impl = JaxBls12381(max_batch=max_batch,
+                       min_bucket=min_bucket, mesh=mesh_obj)
+    V.staged_jits()
+    programs = list(shapeset.enumerate_programs(
+        max_batch=max_batch, min_bucket=impl.min_bucket,
+        h2c_min_bucket=impl._h2c_min_bucket,
+        group_cap=impl._group_cap, mesh=mesh_obj))
+    print(f"precompile: {len(programs)} program(s) -> "
+          f"{aotstore.store_dir()}")
+    outcomes = {"compile": 0, "load": 0, "error": 0}
+    t_all = _time.monotonic()
+    for kernel, avals, meta in programs:
+        if meta.get("stage") == "mesh_kernel":
+            impl._sharded.kernel(meta["msm_path"])
+        disp = aotstore.dispatchers().get(kernel)
+        if disp is None:
+            print(f"  SKIP {kernel}: no registered dispatcher "
+                  f"({meta})", file=sys.stderr)
+            outcomes["error"] += 1
+            continue
+        t0 = _time.monotonic()
+        try:
+            outcome = disp.precompile(avals)
+        except Exception as exc:
+            print(f"  FAIL {kernel} {meta.get('shape', '')}: "
+                  f"{type(exc).__name__}: {exc}", file=sys.stderr)
+            outcomes["error"] += 1
+            continue
+        outcomes[outcome] += 1
+        print(f"  {outcome:>7} {kernel:<28} "
+              f"profile={meta.get('profile', '-'):<10} "
+              f"{_time.monotonic() - t0:8.1f}s")
+    print(f"precompile done in {_time.monotonic() - t_all:.1f}s: "
+          f"{outcomes['compile']} compiled, {outcomes['load']} "
+          f"already stored, {outcomes['error']} failed")
+    return 1 if outcomes["error"] else 0
+
+
 def cmd_lint(args) -> int:
     """tekulint: the AST-based invariant analyzer (teku_tpu/analysis).
 
@@ -1429,6 +1518,34 @@ def build_parser() -> argparse.ArgumentParser:
                     help="emit the auto-extracted TEKU_TPU_* knob "
                          "registry as a markdown table and exit 0")
     ln.set_defaults(fn=cmd_lint)
+
+    pc = sub.add_parser(
+        "precompile",
+        help="build the serving shape set into the AOT executable "
+             "store (install-time compile: boots then warm by "
+             "deserializing, not compiling)")
+    pc.add_argument("--max-batch", type=int,
+                    default=None, dest="max_batch",
+                    help="service max batch (default: the service "
+                         "tier's 256)")
+    pc.add_argument("--min-bucket", type=int,
+                    default=None, dest="min_bucket",
+                    help="smallest lane bucket (default: the service "
+                         "tier's 16)")
+    pc.add_argument("--mesh", default="off",
+                    help="mesh width to precompile for (off or N; "
+                         "forces N virtual devices on CPU like `node "
+                         "--mesh N`)")
+    pc.add_argument("--msm-path", default="auto", dest="msm_path",
+                    help="scalar-multiplication engine "
+                         f"({'/'.join(_MSM_PATHS)})")
+    pc.add_argument("--mont-path", default="auto", dest="mont_path",
+                    help="mont_mul engine "
+                         f"({'/'.join(_MONT_PATHS)})")
+    pc.add_argument("--store-dir", default=None, dest="store_dir",
+                    help="AOT store directory (default: repo-adjacent "
+                         ".jax_aot / TEKU_TPU_AOT_STORE_DIR)")
+    pc.set_defaults(fn=cmd_precompile)
 
     mg = sub.add_parser("migrate-database",
                         help="convert a data dir between storage modes")

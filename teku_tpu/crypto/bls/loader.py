@@ -31,10 +31,11 @@ degrades, only latency.
 import logging
 import os
 import threading
+import time
 from typing import Optional, Sequence, Tuple
 
 from . import get_implementation, reset_implementation, set_implementation
-from ...infra import faults, tracing
+from ...infra import aotstore, compilecache, faults, tracing
 from ...infra.env import env_bool, env_float, env_int, env_str
 from ...infra.metrics import GLOBAL_REGISTRY, MetricsRegistry
 from ...infra.supervisor import (BackendSupervisor, CircuitBreaker,
@@ -420,10 +421,24 @@ def make_mesh_healer(guarded: GuardedBls12381,
         # disk loads).  A wrong verdict VETOES the install.
         wb = max(1, env_int("TEKU_TPU_MESH_WARM_BATCH",
                             min(max_batch, 64)))
+        cc_before = compilecache.stats()
+        aot_before = aotstore.stats()
+        t0 = time.monotonic()
         try:
             _warmup_batches(new_impl, wb)
         except WarmupVetoError as exc:
             raise selfheal.InstallVetoError(str(exc)) from exc
+        moved = compilecache.delta(cc_before)
+        aot_moved = aotstore.delta(aot_before)
+        # the reshape-under-fire observable: recovery warm must be
+        # load-not-compile (AOT store / disk cache), never a fresh
+        # multi-minute XLA compile while the backlog deepens
+        _LOG.info(
+            "reshape warm (x%d) in %.1fs: %d AOT load(s), %d "
+            "compile-cache load(s), %d fresh compile(s) (%d "
+            "kernel-grade)", wb, time.monotonic() - t0,
+            aot_moved["loads"], moved["hits"], moved["misses"],
+            moved["kernel_compiles"])
 
     healer_box: list = []
 

@@ -36,13 +36,24 @@ _LOG = logging.getLogger(__name__)
 
 ENV_DIR = "TEKU_TPU_XLA_CACHE_DIR"
 ENV_MIN_COMPILE_S = "TEKU_TPU_XLA_CACHE_MIN_COMPILE_S"
+ENV_KERNEL_COMPILE_S = "TEKU_TPU_KERNEL_COMPILE_MIN_S"
+ENV_COMPILE_SPAN_MIN_S = "TEKU_TPU_COMPILE_SPAN_MIN_S"
 _OFF_VALUES = ("off", "0", "none", "disabled")
 
 _HIT_EVENT = "/jax/compilation_cache/cache_hits"
 _MISS_EVENT = "/jax/compilation_cache/cache_misses"
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
 _lock = threading.Lock()
 _counts = {"hit": 0, "miss": 0}
+# backend compiles: EVERY XLA backend_compile this process performed,
+# with durations.  `kernel` counts only compiles >= the kernel-grade
+# threshold — a fresh process op-by-op-dispatches a handful of
+# millisecond micro programs (jnp.asarray, arena scatter) no store
+# can eliminate, so "zero fresh compiles at warm boot" is defined over
+# KERNEL-grade compiles (PERF.md documents the definition); raw counts
+# stay visible alongside.
+_compiles = {"count": 0, "seconds": 0.0, "kernel": 0}
 _installed = {"listener": False, "dir": None}
 # clock-spine stamp of the most recent cache event: the timeline
 # orders "which dispatch paid that cache load" against trace spans
@@ -52,6 +63,12 @@ _M_CACHE = GLOBAL_REGISTRY.labeled_counter(
     "xla_compile_cache_total",
     "persistent XLA compile cache lookups by outcome",
     labelnames=("outcome",))
+_M_BACKEND = GLOBAL_REGISTRY.labeled_counter(
+    "xla_backend_compile_total",
+    "XLA backend compiles this process performed, by grade "
+    "(kernel = duration >= TEKU_TPU_KERNEL_COMPILE_MIN_S, micro = "
+    "op-by-op dispatch of trivial host programs)",
+    labelnames=("grade",))
 
 
 def default_dir() -> str:
@@ -75,9 +92,48 @@ def _on_event(event: str, **_kw) -> None:
     _M_CACHE.labels(outcome=key).inc()
 
 
+_cfg: dict = {}
+
+
+def _compile_cfg() -> dict:
+    """Lazy knob reads (memoized; tests clear _cfg around
+    env_override).  kernel_s splits kernel-grade compiles from
+    micro-op dispatch; span_s floors timeline compile spans so
+    micro compiles don't flood the ring."""
+    if not _cfg:
+        _cfg["kernel_s"] = env_float(ENV_KERNEL_COMPILE_S, 1.0,
+                                     lo=0.0)
+        _cfg["span_s"] = env_float(ENV_COMPILE_SPAN_MIN_S, 0.05,
+                                   lo=0.0)
+    return _cfg
+
+
+def _on_compile_duration(event: str, duration: float, **_kw) -> None:
+    if event != _COMPILE_EVENT:
+        return
+    cfg = _compile_cfg()
+    kernel = duration >= cfg["kernel_s"]
+    with _lock:
+        _compiles["count"] += 1
+        _compiles["seconds"] += duration
+        if kernel:
+            _compiles["kernel"] += 1
+    _M_BACKEND.labels(grade="kernel" if kernel else "micro").inc()
+    if duration >= cfg["span_s"]:
+        # first-class compile span on the shared clock spine: the
+        # attribution window sees the TRUE in-window compile overlap
+        # instead of clamping ledger-side enqueue seconds at 1.0
+        from . import timeline, tracing
+        # emit-at-completion: the listener fires when the backend
+        # compile returns, so the interval ends NOW
+        timeline.interval("worker", "compile", duration,
+                          trace_id=tracing.current_trace_id())
+
+
 def ensure_instrumented() -> bool:
-    """Register the monitoring listener (idempotent).  Imports jax, so
-    callers on the boot path defer this until jax is loaded anyway."""
+    """Register the monitoring listeners (idempotent).  Imports jax,
+    so callers on the boot path defer this until jax is loaded
+    anyway."""
     with _lock:
         if _installed["listener"]:
             return True
@@ -88,6 +144,8 @@ def ensure_instrumented() -> bool:
     with _lock:
         if not _installed["listener"]:
             monitoring.register_event_listener(_on_event)
+            monitoring.register_event_duration_secs_listener(
+                _on_compile_duration)
             _installed["listener"] = True
     return True
 
@@ -176,21 +234,36 @@ def stats() -> dict:
     with _lock:
         return {"dir": _installed["dir"], "hits": _counts["hit"],
                 "misses": _counts["miss"],
+                "backend_compiles": _compiles["count"],
+                "backend_compile_s": round(_compiles["seconds"], 6),
+                "kernel_compiles": _compiles["kernel"],
                 "last_event": dict(_last_event)}
 
 
 def delta(before: dict, after=None) -> dict:
-    """Hit/miss movement between two stats() snapshots."""
+    """Counter movement between two stats() snapshots (``.get`` so
+    pre-existing snapshots without the backend-compile keys diff)."""
     if after is None:
         after = stats()
-    return {"hits": after["hits"] - before["hits"],
-            "misses": after["misses"] - before["misses"]}
+    out = {"hits": after["hits"] - before["hits"],
+           "misses": after["misses"] - before["misses"]}
+    for key in ("backend_compiles", "kernel_compiles"):
+        out[key] = after.get(key, 0) - before.get(key, 0)
+    out["backend_compile_s"] = round(
+        after.get("backend_compile_s", 0.0)
+        - before.get("backend_compile_s", 0.0), 6)
+    return out
 
 
-def classify_first_dispatch(d: dict) -> str:
+def classify_first_dispatch(d: dict, aot=None) -> str:
     """Jit outcome for the FIRST dispatch of a shape, from the cache
-    delta observed around it: pure disk hits -> ``cache_load``; any
-    fresh XLA work (or no persistent cache at all) -> ``compile``."""
+    delta observed around it (and optionally the AOT-store delta):
+    pure disk hits -> ``cache_load``; serialized-executable loads
+    with NO persistent-cache traffic at all -> ``aot_load``; any
+    fresh XLA work (or no cache/store) -> ``compile``."""
     if d["hits"] > 0 and d["misses"] == 0:
         return "cache_load"
+    if (aot and aot.get("loads", 0) > 0 and d["hits"] == 0
+            and d["misses"] == 0):
+        return "aot_load"
     return "compile"

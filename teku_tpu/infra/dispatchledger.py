@@ -287,7 +287,7 @@ def summarize(records: List[dict], since_seq: int = 0) -> dict:
         outcome = comp.get("outcome")
         if outcome:
             compile_hist[outcome] = compile_hist.get(outcome, 0) + 1
-            if outcome in ("compile", "cache_load"):
+            if outcome in ("compile", "cache_load", "aot_load"):
                 compile_s += comp.get("enqueue_s", 0.0)
         h2c = r.get("h2c") or {}
         h2c_hits += h2c.get("cache_hits", 0)

@@ -57,7 +57,8 @@ def _cite_event(ev: dict) -> dict:
 def _compile_findings(records: List[dict]) -> List[dict]:
     out = []
     for outcome, base, name in (("compile", 40.0, "cold compile"),
-                                ("cache_load", 15.0, "cache load")):
+                                ("cache_load", 15.0, "cache load"),
+                                ("aot_load", 5.0, "AOT store load")):
         by_shape: Dict[str, List[dict]] = {}
         for r in records:
             comp = r.get("compile") or {}
@@ -82,6 +83,60 @@ def _compile_findings(records: List[dict]) -> List[dict]:
                 metrics={"shape": shape, "dispatches": len(recs),
                          "total_s": round(total_s, 2),
                          "outcome": outcome}))
+    return out
+
+
+def _precompile_findings(records: List[dict]) -> List[dict]:
+    """``cold_compile_on_hot_path``: a serving dispatch paid a FRESH
+    XLA compile for a shape the shapeset registry covers — ``cli
+    precompile`` (or a prior boot's self-populated AOT store) would
+    have had the executable on disk.  Distinct from the generic
+    compile_latency finding: this one names the fix."""
+    by_shape: Dict[str, List[dict]] = {}
+    for r in records:
+        comp = r.get("compile") or {}
+        if comp.get("outcome") == "compile":
+            by_shape.setdefault(str(r.get("shape")), []).append(r)
+    if not by_shape:
+        return []
+    covered_memo: Dict[int, set] = {}
+
+    def _covered(shape: str) -> bool:
+        mesh_n = 0
+        if "@m" in shape:
+            try:
+                mesh_n = int(shape.split("@m", 1)[1])
+            except ValueError:
+                return False
+        if mesh_n not in covered_memo:
+            try:
+                from ..ops import shapeset
+                covered_memo[mesh_n] = shapeset.serving_shapes(
+                    mesh_devices=mesh_n)
+            except Exception:  # pragma: no cover - odd mesh widths
+                covered_memo[mesh_n] = set()
+        return shape in covered_memo[mesh_n]
+
+    out = []
+    for shape, recs in sorted(by_shape.items()):
+        if not _covered(shape):
+            continue
+        total_s = sum((r.get("compile") or {}).get("enqueue_s", 0)
+                      for r in recs)
+        out.append(_finding(
+            "cold_compile_on_hot_path", 50.0 + min(total_s, 50),
+            f"shape {shape} compiled on the serving path "
+            f"({len(recs)} dispatch(es), {total_s:.1f} s) — the "
+            "shapeset registry covers it",
+            "this shape is in the default serving set "
+            "(ops/shapeset.py), so the compile was avoidable: `cli "
+            "precompile` serializes the whole set into the AOT store "
+            "at install time, after which boots and first dispatches "
+            "deserialize in seconds (outcome aot_load) instead of "
+            "paying XLA synchronously under live traffic",
+            evidence=[_cite(r) for r in recs[:5]],
+            metrics={"shape": shape, "dispatches": len(recs),
+                     "total_s": round(total_s, 2)}))
     return out
 
 
@@ -573,6 +628,7 @@ def diagnose(records: List[dict],
     summary = dispatchledger.summarize(records)
     findings: List[dict] = []
     findings += _compile_findings(records)
+    findings += _precompile_findings(records)
     findings += _imbalance_findings(records)
     findings += _padding_findings(records, summary)
     findings += _h2c_findings(records, summary)

@@ -36,7 +36,7 @@ import threading
 import time
 from typing import Callable, List, Optional, Tuple
 
-from . import compilecache, faults, flightrecorder
+from . import aotstore, compilecache, faults, flightrecorder
 from .aio import retry_with_backoff
 from .metrics import GLOBAL_REGISTRY, MetricsRegistry
 from .service import Service
@@ -538,6 +538,7 @@ class BackendSupervisor(Service):
             # minutes (fresh compiles) or seconds (cache loads) —
             # report which, so a slow bring-up explains itself
             cache_before = compilecache.stats()
+            aot_before = aotstore.stats()
             warm_t0 = time.monotonic()
             try:
                 # bounded: WARMING must not become the one phase that
@@ -570,15 +571,26 @@ class BackendSupervisor(Service):
                 _LOG.exception("backend warmup failed; installing "
                                "anyway (first batch compiles lazily)")
             moved = compilecache.delta(cache_before)
+            aot_moved = aotstore.delta(aot_before)
             self.warmup_cache = {
                 "hits": moved["hits"], "misses": moved["misses"],
+                # AOT-store loads skip XLA entirely; kernel_compiles
+                # counts the backend compiles above the kernel-grade
+                # threshold this warmup actually performed — the
+                # "warm boot does zero fresh compiles" observable
+                "aot_loads": aot_moved["loads"],
+                "backend_compiles": moved["backend_compiles"],
+                "kernel_compiles": moved["kernel_compiles"],
                 "s": round(time.monotonic() - warm_t0, 1)}
             flightrecorder.record("warmup_cache", supervisor=self.name,
                                   **self.warmup_cache)
             _LOG.info(
-                "backend %s warmup in %.1fs: %d compile-cache load(s), "
-                "%d fresh compile(s)%s", self.name,
-                self.warmup_cache["s"], moved["hits"], moved["misses"],
+                "backend %s warmup in %.1fs: %d AOT load(s), %d "
+                "compile-cache load(s), %d fresh compile(s) (%d "
+                "kernel-grade)%s", self.name,
+                self.warmup_cache["s"], aot_moved["loads"],
+                moved["hits"], moved["misses"],
+                moved["kernel_compiles"],
                 "" if compilecache.cache_dir() else
                 " (persistent cache not configured)")
         self.backend = backend

@@ -60,6 +60,7 @@ PHASES = frozenset({
     "busy",             # device executing a dispatch (enqueue→sync)
     "queue_nonempty",   # service queue held work (overlap denominator)
     "host_prep",        # host-side limb packing inside a dispatch
+    "compile",          # XLA backend compile (compilecache listener)
     "coalesce",         # duplicate submission joined an in-flight task
     "brownout_enter",   # admission brownout level raised
     "brownout_exit",    # admission brownout cleared
@@ -274,8 +275,15 @@ def attribution(events: Sequence[dict], t_mono0: float,
       target);
     - ``queue_wait_share``: queue_wait ÷ complete from the caller's
       stage sums (bench's raw trace samples);
-    - ``compile_wall_share``: ledger-attributed compile/cache-load
-      seconds ÷ window.
+    - ``compile_wall_share``: in-window union of first-class
+      ``compile`` ring spans ÷ window.  Clipped interval math — the
+      union cannot exceed the window, so the value is a TRUE share
+      (the old ledger-seconds ÷ window ratio clamped at a misleading
+      1.0 whenever worker-thread compile seconds exceeded the wall
+      window; PERF.md documents the regression).  ``compile_s``
+      (ledger-attributed seconds) remains the fallback numerator for
+      rings too small to still hold the compile spans, and is always
+      reported raw as ``compile_attr_s``.
     """
     window_s = max(t_mono1 - t_mono0, 0.0)
     in_window = [e for e in events
@@ -312,9 +320,20 @@ def attribution(events: Sequence[dict], t_mono0: float,
         total = stage_sums.get("complete", 0.0)
         if total > 0:
             out["queue_wait_share"] = round(min(qw / total, 1.0), 4)
-    if compile_s is not None and window_s > 0:
-        out["compile_wall_share"] = round(
-            min(max(compile_s, 0.0) / window_s, 1.0), 4)
+    compile_iv = _clip(_phase_intervals(in_window, "compile"),
+                      t_mono0, t_mono1)
+    out["compile_spans_s"] = round(_total(compile_iv), 6)
+    out["compile_attr_s"] = (round(max(compile_s, 0.0), 6)
+                             if compile_s is not None else None)
+    if window_s > 0:
+        if compile_iv:
+            # interval union, clipped to the window: a true share by
+            # construction — no clamp needed or applied
+            out["compile_wall_share"] = round(
+                _total(compile_iv) / window_s, 4)
+        elif compile_s is not None:
+            out["compile_wall_share"] = round(
+                min(max(compile_s, 0.0) / window_s, 1.0), 4)
     return out
 
 

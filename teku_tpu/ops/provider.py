@@ -81,16 +81,18 @@ _G2_INF = bytes([0xC0] + [0] * 95)
 # verify jits in ops/verify.py are shared across provider instances).
 # First dispatch of a (padded, kmax) bucket shape is the one that pays
 # the XLA work — `compile` when it was a fresh compile, `cache_load`
-# when the persistent compile cache served it from disk; everything
-# after hits the in-memory jit cache (`cache_hit`).  `path` is the
-# active mont_mul engine (vpu | mxu, ops/mxu.py).
+# when the persistent compile cache served it from disk, `aot_load`
+# when the serialized-executable store (infra/aotstore.py) skipped
+# XLA entirely; everything after hits the in-memory jit cache
+# (`cache_hit`).  `path` is the active mont_mul engine (vpu | mxu,
+# ops/mxu.py).
 _SEEN_SHAPES: set = set()
 _SEEN_LOCK = threading.Lock()
 _M_JIT = GLOBAL_REGISTRY.labeled_counter(
     "bls_jit_dispatch_total",
     "verify dispatches by padded bucket shape (lanes x keys), "
-    "jit-cache outcome (compile|cache_load|cache_hit) and mont_mul "
-    "path (vpu|mxu)",
+    "jit-cache outcome (compile|cache_load|aot_load|cache_hit) and "
+    "mont_mul path (vpu|mxu)",
     labelnames=("shape", "outcome", "path"))
 _M_LANES_REAL = GLOBAL_REGISTRY.counter(
     "bls_dispatch_lanes_real_total",
@@ -170,6 +172,12 @@ _EVICT_U = HC.evictions_counter("u")
 # one shared definition of the padding rule (infra/pow2.py) — the
 # admission planner and mesh shard planner pad with the same function
 from ..infra.pow2 import next_pow2 as _next_pow2  # noqa: E402
+# the bucket POLICY (floors, group split, shape labels) lives in
+# ops/shapeset.py so `cli precompile` enumerates the exact programs
+# this module dispatches — provider has no private copy of any rule
+# (drift is structurally impossible; tests/test_shapeset.py pins it)
+from . import shapeset as SS  # noqa: E402
+from ..infra import aotstore  # noqa: E402
 
 
 def bytes_to_limbs_np(b: np.ndarray) -> np.ndarray:
@@ -398,7 +406,9 @@ class JaxBls12381(BLS12381):
         # staged dispatch: small programs instead of one monolith whose
         # TPU compile is unbounded (ops/verify.py staged_jits); h2c
         # runs separately over unique messages (see _begin_dispatch)
-        self._pk_validate_jit = jax.jit(self._pk_validate_kernel)
+        self._pk_validate_jit = aotstore.wrap(
+            f"pk_validate:{mxu.resolve()}",
+            jax.jit(self._pk_validate_kernel))
         # observability: proof that node traffic actually reaches the
         # device path (mirrors the reference's signature_verifications_*
         # counters at AggregatingSignatureVerificationService.java:76-98)
@@ -482,7 +492,7 @@ class JaxBls12381(BLS12381):
             return resolved
         # floor of 16 keeps the validation program at very few distinct
         # shapes (same compile-cost argument as the verify min_bucket)
-        n = max(_next_pow2(len(miss)), 16)
+        n = SS.pk_validate_bucket(len(miss))
         xs = np.zeros((n, fp.L), dtype=np.int64)
         large = np.zeros(n, dtype=bool)
         for i, (_, (x, lg, _inf)) in enumerate(miss):
@@ -646,7 +656,8 @@ class JaxBls12381(BLS12381):
                 slots[j] = slot
         draws = None
         if missing:
-            mb = max(_next_pow2(len(missing)), self._h2c_min_bucket)
+            mb = SS.h2c_miss_bucket(len(missing),
+                                    self._h2c_min_bucket)
             draws = self._uniq_draws([uniq_msgs[j] for j in missing],
                                      mb)
         return slots, missing, digests, draws
@@ -682,7 +693,7 @@ class JaxBls12381(BLS12381):
         self.lanes_dispatched += n
         t_hp0 = time.perf_counter()
         with tracing.span("host_prep"):
-            kmax = _next_pow2(max(len(s.pk_limbs) for s in semis))
+            kmax = SS.kmax_bucket(max(len(s.pk_limbs) for s in semis))
             # unique-message index + per-message lane groups: h2c AND
             # the Miller loops run at unique width (stage_group folds a
             # message's lanes into one pairing input via bilinearity)
@@ -700,19 +711,16 @@ class JaxBls12381(BLS12381):
             # G stays bounded (the grouped gather materializes a
             # (U, G) lane matrix) and a split message simply owns
             # several Miller rows backed by the SAME H(m) point
-            cap = self._group_cap
-            rows: List[Tuple[int, List[int]]] = []
-            for u, g in enumerate(groups):
-                for off in range(0, len(g), cap):
-                    rows.append((u, g[off:off + cap]))
+            rows: List[Tuple[int, List[int]]] = SS.group_rows(
+                groups, self._group_cap)
             row_msgs = [uniq_msgs[u] for u, _ in rows]
-            g_bucket = _next_pow2(max(len(g) for _, g in rows))
+            g_bucket = SS.group_bucket(rows)
             # canonical unique bucket: the h2c dispatch / H(m) arena
             # width.  Computed from the batch alone — IDENTICAL for
             # single-device and mesh dispatch of the same batch, so
             # the dedup counters and h2c dispatch count cannot depend
             # on the mesh (pinned in tests/test_mesh_grouped.py)
-            u_hm = max(_next_pow2(len(rows)), self._h2c_min_bucket)
+            u_hm = SS.unique_bucket(len(rows), self._h2c_min_bucket)
             if self._sharded is not None:
                 # group-aligned shard layout: whole rows per shard,
                 # lanes permuted into each shard's contiguous block
@@ -723,7 +731,7 @@ class JaxBls12381(BLS12381):
                 lane_pos = plan.lane_pos
             else:
                 plan = None
-                padded = max(_next_pow2(n), self.min_bucket)
+                padded = SS.lane_bucket(n, self.min_bucket)
                 u_total = u_hm
                 lane_pos = None
             pk_xs = np.zeros((padded, kmax, fp.L), dtype=np.int64)
@@ -838,7 +846,7 @@ class JaxBls12381(BLS12381):
         # the single-device one; latency_for_lanes prefix-matches
         # "{lanes}x" so the admission planner still sees mesh-shaped
         # device latencies for its batch sizing)
-        shape = f"{padded}x{kmax}" + (f"@m{mesh_n}" if mesh_n else "")
+        shape = SS.shape_label(padded, kmax, mesh_n)
         # the staged jits are module-level (shared across providers)
         # and the sharded kernels are process-memoized by (device set,
         # axis, msm path) — key the seen-set on the kernel identity
@@ -856,6 +864,7 @@ class JaxBls12381(BLS12381):
         # from a disk cache load (racy under concurrent first
         # dispatches — the label may misattribute, the counts don't)
         cache_before = compilecache.stats() if first else None
+        aot_before = aotstore.stats() if first else None
         # padded first: a scrape between the two incs must read the
         # ratio high, never negative
         _M_LANES_PADDED.inc(padded)
@@ -944,7 +953,8 @@ class JaxBls12381(BLS12381):
         finally:
             if first:
                 outcome = compilecache.classify_first_dispatch(
-                    compilecache.delta(cache_before))
+                    compilecache.delta(cache_before),
+                    aot=aotstore.delta(aot_before))
             _M_JIT.labels(shape=shape, outcome=outcome,
                           path=mont_path).inc()
             t_enq_end = time.perf_counter()

@@ -283,16 +283,27 @@ def staged_jits():
     if _STAGED_JITS is None:
         with _STAGED_LOCK:      # batch_verify runs via asyncio.to_thread
             if _STAGED_JITS is None:
+                from ..infra import aotstore
+                from . import mxu
+                # mont path is part of the traced program, so it is
+                # part of the store identity (an executable traced
+                # for vpu must not serve an mxu process)
+                mont = mxu.resolve()
+
+                def _wrap(name, fn):
+                    return aotstore.wrap(f"stage:{name}:{mont}",
+                                         jax.jit(fn))
                 _STAGED_JITS = {
-                    "prepare": jax.jit(stage_prepare),
-                    "h2c": jax.jit(stage_h2c),
-                    "gather": jax.jit(stage_gather_hm),
-                    "scalars": jax.jit(stage_scalars),
-                    "affine": jax.jit(stage_lane_affine),
-                    "group": jax.jit(stage_group),
-                    "scalars_pip": jax.jit(stage_scalars_pippenger),
-                    "miller": jax.jit(stage_miller),
-                    "finish": jax.jit(stage_finish),
+                    "prepare": _wrap("prepare", stage_prepare),
+                    "h2c": _wrap("h2c", stage_h2c),
+                    "gather": _wrap("gather", stage_gather_hm),
+                    "scalars": _wrap("scalars", stage_scalars),
+                    "affine": _wrap("affine", stage_lane_affine),
+                    "group": _wrap("group", stage_group),
+                    "scalars_pip": _wrap("scalars_pip",
+                                         stage_scalars_pippenger),
+                    "miller": _wrap("miller", stage_miller),
+                    "finish": _wrap("finish", stage_finish),
                 }
     return _STAGED_JITS
 

@@ -297,6 +297,22 @@ _KERNELS: dict = {}
 _KERNELS_LOCK = threading.Lock()
 
 
+def kernel_store_name(devices: Sequence[str], axis: str,
+                      msm_path: str) -> str:
+    """AOT-store kernel name for a sharded verify program.  The
+    device LIST (not just the count) is part of the name: a serialized
+    executable binds its device assignment, so an entry compiled for
+    mesh [0..3] must never deserialize onto a healed mesh that ejected
+    device 2 — those are different programs to the store.  Mont path
+    likewise (it changes the traced field arithmetic)."""
+    import hashlib
+
+    from ..ops import mxu
+    dev = hashlib.sha256(repr(tuple(devices)).encode()).hexdigest()[:8]
+    return (f"mesh:{len(devices)}:{axis}:{msm_path}:"
+            f"{mxu.resolve()}:{dev}")
+
+
 class GroupShardedVerifier:
     """Group-aligned production mesh dispatch.
 
@@ -339,8 +355,12 @@ class GroupShardedVerifier:
         with _KERNELS_LOCK:
             fn = _KERNELS.get(key)
             if fn is None:
+                from ..infra import aotstore
                 from ..ops import verify as V
-                fn = jax.jit(V.verify_kernel_sharded_grouped(
-                    self.mesh, self.axis, msm_path))
+                fn = aotstore.wrap(
+                    kernel_store_name(self.devices, self.axis,
+                                      msm_path),
+                    jax.jit(V.verify_kernel_sharded_grouped(
+                        self.mesh, self.axis, msm_path)))
                 _KERNELS[key] = fn
         return fn

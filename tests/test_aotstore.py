@@ -1,0 +1,246 @@
+"""AOT executable store: round-trip, degradation, and the serving seam.
+
+Fast-tier gates for the compile-wall killer (`infra/aotstore.py`):
+
+- a serialized executable must ROUND-TRIP: the first call through a
+  wrapped kernel self-populates the store, and after the in-process
+  memo + jit caches are dropped (a fresh process in miniature) the same
+  signature is served by DESERIALIZATION — zero backend compiles — with
+  bit-identical results;
+- a true fresh process (subprocess, slow tier) must load the entry the
+  parent wrote and agree bit-for-bit;
+- corrupt blobs and identity mismatches (jax upgrade, code edit,
+  different device) must degrade to a fresh compile with ONE WARN per
+  complaint kind — a stale store may cost time, never correctness or a
+  log flood;
+- the provider's first-dispatch classifier must read a store hit as
+  the third outcome, ``aot_load``.
+"""
+
+import logging
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from teku_tpu.infra import aotstore, compilecache
+
+
+@pytest.fixture
+def aot_dir(tmp_path, monkeypatch):
+    """Point the store at a fresh dir; re-arm the one-WARN guards."""
+    base = tmp_path / "aot"
+    monkeypatch.setenv(aotstore.ENV_DIR, str(base))
+    monkeypatch.delenv(aotstore.ENV_ON, raising=False)
+    aotstore._reset_warnings()
+    yield str(base)
+    aotstore._reset_warnings()
+
+
+def _oracle(x):
+    return x * 7 + 3
+
+
+def test_round_trip_bit_identical_and_classified(aot_dir):
+    x = jnp.arange(8, dtype=jnp.int64)
+    disp = aotstore.wrap("test:roundtrip", jax.jit(_oracle))
+
+    before = aotstore.stats()
+    first = np.asarray(disp(x))
+    moved = aotstore.delta(before)
+    # the serving path is self-populating: a miss compiles through the
+    # explicit AOT path and SAVES, so the next process loads
+    assert moved["misses"] == 1
+    assert moved["saves"] == 1
+    assert os.listdir(aot_dir), "miss must write the store entry"
+
+    # a fresh process in miniature: drop the per-process memo and the
+    # in-memory jit caches, then re-dispatch the same signature
+    disp.reset_memo()
+    jax.clear_caches()
+    a_before = aotstore.stats()
+    c_before = compilecache.stats()
+    second = np.asarray(disp(x))
+    a_moved = aotstore.delta(a_before)
+    c_moved = compilecache.delta(c_before)
+    assert a_moved["loads"] == 1
+    assert a_moved["misses"] == 0 and a_moved["saves"] == 0
+    # deserialization IS the point: no XLA backend compile fired
+    assert c_moved.get("backend_compiles", 0) == 0
+
+    oracle = _oracle(np.arange(8, dtype=np.int64))
+    np.testing.assert_array_equal(first, oracle)
+    np.testing.assert_array_equal(second, oracle)
+
+    # the provider-facing classifier reads this as the third outcome
+    assert compilecache.classify_first_dispatch(
+        c_moved, aot=a_moved) == "aot_load"
+
+
+@pytest.mark.slow
+def test_fresh_process_round_trip_bit_identical(aot_dir):
+    """The real thing, not the miniature: a SUBPROCESS with the same
+    store dir must deserialize the parent's entry (loads==1, zero
+    misses) and produce bit-identical output."""
+    x = jnp.arange(16, dtype=jnp.int64)
+    disp = aotstore.wrap("test:freshproc", jax.jit(_oracle))
+    parent = np.asarray(disp(x))
+    assert aotstore.stats()["saves"] >= 1
+
+    script = (
+        "import json, numpy as np\n"
+        "import jax, jax.numpy as jnp\n"
+        "from teku_tpu.infra import aotstore\n"
+        "disp = aotstore.wrap('test:freshproc',"
+        " jax.jit(lambda v: v * 7 + 3))\n"
+        "out = disp(jnp.arange(16, dtype=jnp.int64))\n"
+        "print(json.dumps({'out': np.asarray(out).tolist(),"
+        " 'aot': aotstore.stats()}))\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               **{aotstore.ENV_DIR: aot_dir})
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    import json
+    got = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert got["aot"]["loads"] == 1, got["aot"]
+    assert got["aot"]["misses"] == 0
+    np.testing.assert_array_equal(np.asarray(got["out"]), parent)
+
+
+def test_corrupt_blob_one_warn_and_fresh_compile(aot_dir, caplog):
+    x = jnp.arange(4, dtype=jnp.int64)
+    disp = aotstore.wrap("test:corrupt", jax.jit(_oracle))
+    disp(x)
+    disp2 = aotstore.wrap("test:corrupt2", jax.jit(lambda v: v - 5))
+    disp2(x)
+    for name in os.listdir(aot_dir):
+        with open(os.path.join(aot_dir, name), "wb") as fh:
+            fh.write(b"not a pickle")
+
+    aotstore.reset_memos()
+    aotstore._reset_warnings()
+    with caplog.at_level(logging.WARNING,
+                         logger="teku_tpu.infra.aotstore"):
+        before = aotstore.stats()
+        out = np.asarray(disp(x))
+        out2 = np.asarray(disp2(x))
+    np.testing.assert_array_equal(
+        out, _oracle(np.arange(4, dtype=np.int64)))
+    np.testing.assert_array_equal(
+        out2, np.arange(4, dtype=np.int64) - 5)
+    moved = aotstore.delta(before)
+    assert moved["errors"] >= 2, "corrupt entries count as errors"
+    assert moved["loads"] == 0
+    warns = [r for r in caplog.records if "corrupt" in r.message]
+    assert len(warns) == 1, "one WARN per complaint kind, not per blob"
+
+
+def test_identity_mismatch_one_warn_and_fresh_compile(
+        aot_dir, caplog):
+    x = jnp.arange(4, dtype=jnp.int64)
+    disp = aotstore.wrap("test:ident", jax.jit(_oracle))
+    disp(x)
+    # a jax upgrade in miniature: rewrite the blob's identity header
+    (entry_name,) = os.listdir(aot_dir)
+    path = os.path.join(aot_dir, entry_name)
+    with open(path, "rb") as fh:
+        entry = pickle.loads(fh.read())
+    entry["identity"]["jax"] = "0.0.0-from-another-era"
+    with open(path, "wb") as fh:
+        fh.write(pickle.dumps(entry))
+
+    disp.reset_memo()
+    aotstore._reset_warnings()
+    with caplog.at_level(logging.WARNING,
+                         logger="teku_tpu.infra.aotstore"):
+        before = aotstore.stats()
+        out = np.asarray(disp(x))
+        moved = aotstore.delta(before)
+        # the mismatch degrades to a fresh compile... which re-SAVES,
+        # healing the stale entry for the next process
+        assert moved["loads"] == 0 and moved["errors"] >= 1
+        assert moved["saves"] == 1
+        disp.reset_memo()
+        before = aotstore.stats()
+        disp(x)
+        assert aotstore.delta(before)["loads"] == 1, \
+            "the re-saved entry must serve the next resolve"
+    np.testing.assert_array_equal(
+        out, _oracle(np.arange(4, dtype=np.int64)))
+    warns = [r for r in caplog.records if "environment" in r.message]
+    assert len(warns) == 1
+    assert "precompile" in warns[0].message, \
+        "the WARN must name the fix (re-run cli precompile)"
+
+
+def test_store_off_serves_from_jit_without_counting(monkeypatch):
+    monkeypatch.setenv(aotstore.ENV_ON, "0")
+    assert aotstore.store_dir() is None
+    disp = aotstore.wrap("test:off", jax.jit(_oracle))
+    before = aotstore.stats()
+    out = np.asarray(disp(jnp.arange(4, dtype=jnp.int64)))
+    np.testing.assert_array_equal(
+        out, _oracle(np.arange(4, dtype=np.int64)))
+    assert aotstore.delta(before) == {
+        "loads": 0, "misses": 0, "saves": 0, "errors": 0}
+
+
+def test_shape_sig_same_for_avals_and_concrete():
+    """The precompiler enumerates ShapeDtypeStructs; the serving
+    wrapper sees concrete arrays.  Both must derive the SAME key or
+    the store never hits."""
+    concrete = (jnp.zeros((4, 6), jnp.int64),
+                (jnp.zeros((4,), jnp.int32), jnp.ones((2,), bool)))
+    avals = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), concrete)
+    assert aotstore.shape_sig(concrete) == aotstore.shape_sig(avals)
+
+
+def test_call_drift_falls_back_to_jit_with_one_warn(aot_dir, caplog):
+    x = jnp.arange(4, dtype=jnp.int64)
+    disp = aotstore.wrap("test:drift", jax.jit(_oracle))
+    sig = aotstore.shape_sig((x,))
+
+    def rejects(*_a):
+        raise TypeError("executable/argument drift")
+
+    disp._memo[sig] = rejects
+    with caplog.at_level(logging.WARNING,
+                         logger="teku_tpu.infra.aotstore"):
+        out = np.asarray(disp(x))
+    np.testing.assert_array_equal(
+        out, _oracle(np.arange(4, dtype=np.int64)))
+    # the fallback is PERMANENT for that signature
+    assert disp._memo[sig] is disp._jit
+    assert any("rejected" in r.message for r in caplog.records)
+
+
+def test_size_cap_evicts_oldest(aot_dir, monkeypatch):
+    monkeypatch.setenv(aotstore.ENV_MAX_MB, "1")
+    os.makedirs(aot_dir, exist_ok=True)
+    old = os.path.join(aot_dir, "old.aotx")
+    new = os.path.join(aot_dir, "new.aotx")
+    for path in (old, new):
+        with open(path, "wb") as fh:
+            fh.write(b"\0" * (700 * 1024))
+    os.utime(old, (1, 1))
+    aotstore._enforce_cap(aot_dir)
+    assert not os.path.exists(old), "oldest entry must be evicted"
+    assert os.path.exists(new)
+
+
+def test_entry_key_is_filename_safe_and_stable():
+    sig = (("*", "*"), (((4, 6), "int64"),))
+    key = aotstore.entry_key("mesh:2:dp:ladder:vpu:deadbeef", sig)
+    assert key == aotstore.entry_key(
+        "mesh:2:dp:ladder:vpu:deadbeef", sig), "stable across calls"
+    assert all(c.isalnum() or c in "._-" for c in key), key
+    assert key != aotstore.entry_key("mesh:4:dp:ladder:vpu:deadbeef",
+                                     sig)

@@ -195,6 +195,53 @@ def test_doctor_ranks_findings_and_cites_records():
     assert doctor.diagnose([])["healthy"]
 
 
+def _compile_rec(seq, shape, outcome, enqueue_s=30.0):
+    return {"seq": seq, "shape": shape, "lanes": 8,
+            "trace_ids": [f"bb-{seq:06d}"],
+            "unique_messages": 8,
+            "waste": {"lane": {"real": 8, "padded": 8},
+                      "h2c": {"real": 8, "padded": 8}},
+            "h2c": {"cache_hits": 8, "cache_misses": 0},
+            "msm": {"path": "ladder"}, "mesh": {"devices": 0},
+            "admission": {},
+            "compile": {"outcome": outcome, "enqueue_s": enqueue_s}}
+
+
+def test_doctor_cold_compile_on_hot_path_finding():
+    """A serving dispatch that paid a FRESH compile for a shape the
+    shapeset registry covers gets its own ranked finding naming the
+    fix (`cli precompile` -> AOT store), citing dispatch seq + trace
+    id per the PR-11 evidence contract.  Shapes OUTSIDE the registry
+    (operator ran an exotic batch) and non-compile outcomes
+    (aot_load, cache_load) must NOT fire it."""
+    records = [
+        # covered: 256x1 is the default service-tier primary bucket
+        _compile_rec(11, "256x1", "compile", 314.0),
+        _compile_rec(12, "256x1", "compile", 2.0),
+        # covered shape but served by the AOT store: not a finding
+        _compile_rec(13, "16x1", "aot_load", 0.4),
+        # NOT covered (kmax 8 is outside the default serving set)
+        _compile_rec(14, "512x8", "compile", 41.0),
+    ]
+    diagnosis = doctor.diagnose(records)
+    cold = [f for f in diagnosis["findings"]
+            if f["kind"] == "cold_compile_on_hot_path"]
+    assert len(cold) == 1, cold
+    f = cold[0]
+    assert "256x1" in f["title"]
+    assert f["metrics"]["dispatches"] == 2
+    assert f["metrics"]["total_s"] == 316.0
+    assert "precompile" in f["detail"], "the finding must name the fix"
+    # evidence cites the dispatch records: seq + trace id
+    cited = {(e["seq"], e["trace_id"]) for e in f["evidence"]}
+    assert cited == {(11, "bb-000011"), (12, "bb-000012")}
+    # severity puts an avoidable 316 s compile wall above the generic
+    # compile_latency finding for the same records
+    generic = [x for x in diagnosis["findings"]
+               if x["kind"] == "compile_latency"]
+    assert generic and f["severity"] > generic[0]["severity"]
+
+
 def test_flush_failsafe_env_knob_and_evidence():
     """TEKU_TPU_FLUSH_FAILSAFE_MS bounds the WALL time a worker may
     hold a batch open when the service clock stalls (the r10 loadgen

@@ -257,14 +257,15 @@ def test_global_exposition_is_well_formed_after_node_imports():
     assert "bls_dispatch_padding_waste_ratio" in fams
 
 
-_JIT_OUTCOMES = {"compile", "cache_load", "cache_hit"}
+_JIT_OUTCOMES = {"compile", "cache_load", "aot_load", "cache_hit"}
 
 
 def test_dispatch_and_cache_label_contract():
     """The mont-path/compile-cache label vocabulary must not drift:
-    dashboards key on `path` (vpu|mxu) and the three-way jit outcome
+    dashboards key on `path` (vpu|mxu) and the four-way jit outcome
     (compile = fresh XLA work, cache_load = served from the persistent
-    cache dir, cache_hit = in-memory jit cache)."""
+    cache dir, aot_load = deserialized from the AOT executable store,
+    cache_hit = in-memory jit cache)."""
     from teku_tpu.infra import compilecache  # noqa: F401 - registers
     from teku_tpu.infra.metrics import GLOBAL_REGISTRY
     import teku_tpu.ops.provider as pv
@@ -281,6 +282,13 @@ def test_dispatch_and_cache_label_contract():
     for d in ({"hits": 1, "misses": 0}, {"hits": 0, "misses": 1},
               {"hits": 3, "misses": 2}, {"hits": 0, "misses": 0}):
         assert compilecache.classify_first_dispatch(d) in _JIT_OUTCOMES
+    # the AOT executable store adds the fourth outcome: a first
+    # dispatch served by deserialization (no compile, no cache load)
+    assert compilecache.classify_first_dispatch(
+        {"hits": 0, "misses": 0},
+        aot={"loads": 1, "misses": 0, "saves": 0, "errors": 0}) \
+        == "aot_load"
+    assert "aot_load" in _JIT_OUTCOMES
     # and the path label values come from the resolver's closed set
     assert mxu.resolve() in ("vpu", "mxu")
     # provider records its engine for introspection

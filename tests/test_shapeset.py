@@ -1,0 +1,152 @@
+"""Shape-set registry: anti-drift pins against the dispatch path.
+
+`ops/shapeset.py` is only useful if it CANNOT diverge from what
+`provider._begin_dispatch` actually dispatches — a registry that
+enumerates yesterday's buckets precompiles the wrong programs and the
+compile wall comes back silently.  These tests pin the sharing:
+
+- structurally: provider imports the shapeset module object and calls
+  its bucket functions (no private copies);
+- behaviorally: the policy constants equal the provider/loader knob
+  defaults, and `batch_plan` reproduces the bucket decisions;
+- the enumeration yields the kernel names `ops/verify.py` and
+  `teku_tpu/parallel` register with the AOT store, deduplicated.
+"""
+
+import inspect
+
+import pytest
+
+from teku_tpu.ops import provider, shapeset
+from teku_tpu.ops.provider import JaxBls12381
+
+
+def test_provider_imports_shapeset_functions():
+    # the module object itself is shared...
+    assert provider.SS is shapeset
+    # ...and every bucket decision in the dispatch path calls through
+    # it: a private re-implementation is drift waiting to happen
+    src = inspect.getsource(provider)
+    for fn in ("SS.lane_bucket(", "SS.kmax_bucket(",
+               "SS.group_rows(", "SS.group_bucket(",
+               "SS.unique_bucket(", "SS.h2c_miss_bucket(",
+               "SS.pk_validate_bucket(", "SS.shape_label("):
+        assert fn in src, f"provider must bucket via shapeset: {fn}"
+
+
+def test_policy_constants_match_provider_knob_defaults():
+    impl = JaxBls12381(max_batch=8, min_bucket=4)
+    assert impl._h2c_min_bucket == shapeset.H2C_MIN_BUCKET_DEFAULT
+    assert impl._group_cap == shapeset.GROUP_CAP_DEFAULT
+
+
+def test_service_tier_constants_match_loader_defaults():
+    from teku_tpu.crypto.bls import loader
+    sig = inspect.signature(loader.make_supervisor)
+    assert sig.parameters["max_batch"].default \
+        == shapeset.SERVICE_MAX_BATCH
+    assert sig.parameters["min_bucket"].default \
+        == shapeset.SERVICE_MIN_BUCKET
+
+
+def test_bucket_helpers():
+    assert shapeset.lane_bucket(5, 4) == 8
+    assert shapeset.lane_bucket(1, 16) == 16
+    assert shapeset.pk_validate_bucket(1) \
+        == shapeset.PK_VALIDATE_FLOOR
+    assert shapeset.pk_validate_bucket(33) == 64
+    assert shapeset.h2c_miss_bucket(3, 8) == 8
+    assert shapeset.h2c_miss_bucket(9, 8) == 16
+    assert shapeset.shape_label(64, 2) == "64x2"
+    assert shapeset.shape_label(64, 1, mesh_devices=4) == "64x1@m4"
+
+
+def test_group_rows_polymorphic_over_counts_and_lane_lists():
+    """The registry enumerates lane COUNTS; dispatch splits lane-index
+    LISTS.  Same split rule, same row profile — or the enumerated
+    group/miller shapes are not the dispatched ones."""
+    counts = shapeset.group_rows([70, 3], group_cap=32)
+    assert counts == [(0, 32), (0, 32), (0, 6), (1, 3)]
+    lists = shapeset.group_rows([list(range(70)), [70, 71, 72]],
+                                group_cap=32)
+    assert [(u, len(c)) for u, c in lists] \
+        == [(u, n) for u, n in counts]
+    assert shapeset.group_bucket(counts) \
+        == shapeset.group_bucket(lists) == 32
+
+
+def test_batch_plan_all_unique_and_duplicated():
+    plan = shapeset.batch_plan([1] * 12, min_bucket=4)
+    assert plan["padded"] == 16 and plan["rows"] == 12
+    assert plan["u_hm"] == 16
+    assert plan["shape"] == "16x1"
+    assert plan["h2c_bucket"] == 16, "cold boot: all rows miss"
+
+    dup = shapeset.batch_plan([8] * 4, min_bucket=4, h2c_missing=0)
+    assert dup["lanes"] == 32 and dup["rows"] == 4
+    assert dup["group_bucket"] == 8
+    assert dup["h2c_bucket"] == 0, "fully warm arena: no h2c program"
+
+
+def test_warmup_profiles_shape():
+    assert shapeset.warmup_profiles(4) == [
+        ("x1", [1], None), ("x4", [1, 1, 1, 1], None)]
+    profiles = shapeset.warmup_profiles(256)
+    assert [name for name, _, _ in profiles] \
+        == ["x1", "x256", "x256dup8"]
+    name, groups, missing = profiles[2]
+    assert groups == [8] * 32
+    assert missing == 0, "dup8 rides the arena the x256 warm filled"
+
+
+def test_serving_shapes_cover_warmup_profiles():
+    shapes = shapeset.serving_shapes(max_batch=256, min_bucket=16)
+    for _name, groups, missing in shapeset.warmup_profiles(256):
+        plan = shapeset.batch_plan(groups, min_bucket=16,
+                                   h2c_missing=missing)
+        assert plan["shape"] in shapes
+    assert "16x1" in shapes, "the x1 probe shape is a serving shape"
+
+
+def test_enumerate_programs_names_and_dedup():
+    from teku_tpu.ops import mxu
+    mont = mxu.resolve()
+    programs = list(shapeset.enumerate_programs(
+        max_batch=8, min_bucket=4))
+    kernels = [k for k, _avals, _meta in programs]
+    assert f"pk_validate:{mont}" in kernels
+    stages = {m["stage"] for _k, _a, m in programs}
+    assert {"pk_validate", "h2c", "prepare", "miller",
+            "finish"} <= stages
+    # scalars comes on exactly one msm path per profile
+    assert stages & {"scalars", "scalars_pip"}
+    for k, _avals, meta in programs:
+        if meta["stage"] not in ("pk_validate",):
+            assert k.startswith("stage:"), k
+            assert k.endswith(f":{mont}"), k
+    # dedup: no (kernel, signature) appears twice
+    from teku_tpu.infra import aotstore
+    keys = [(k, aotstore.shape_sig(avals))
+            for k, avals, _m in programs]
+    assert len(keys) == len(set(keys))
+
+
+def test_enumerate_programs_mesh_kernels():
+    import jax
+    if jax.device_count() < 2:
+        pytest.skip("needs >= 2 virtual devices (conftest XLA_FLAGS)")
+    from teku_tpu import parallel
+    mesh = parallel.make_mesh(2, advertise=False)
+    programs = list(shapeset.enumerate_programs(
+        max_batch=8, min_bucket=4, mesh=mesh))
+    mesh_progs = [(k, m) for k, _a, m in programs
+                  if m["stage"] == "mesh_kernel"]
+    assert mesh_progs, "mesh config must enumerate the sharded kernel"
+    devices = [str(d) for d in mesh.devices.ravel()]
+    for kernel, meta in mesh_progs:
+        # the name the serving path registers for THIS device set —
+        # a healed mesh over different devices must miss, never load
+        # an executable bound to the wrong device assignment
+        assert kernel == parallel.kernel_store_name(
+            devices, "dp", meta["msm_path"])
+    assert any(m["stage"] == "gather" for _k, _a, m in programs)
